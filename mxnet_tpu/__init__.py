@@ -1,0 +1,22 @@
+"""mxnet_tpu — a TPU-native deep learning framework with the capability
+surface of Apache MXNet 1.3.1 (reference mounted at /root/reference).
+
+Compute lowers to XLA (jit-cached eager ops, whole-graph compiled
+executors); data parallelism is in-graph collectives over a device mesh;
+irregular kernels are Pallas.  See SURVEY.md for the full blueprint.
+"""
+
+__version__ = "0.1.0"
+
+from .base import MXNetError  # noqa: F401
+from .context import Context, cpu, gpu, tpu, current_context, num_gpus, \
+    num_tpus  # noqa: F401
+from . import ndarray  # noqa: F401
+from . import ndarray as nd  # noqa: F401
+from . import random  # noqa: F401
+from . import autograd  # noqa: F401
+from .runtime import engine  # noqa: F401
+
+
+def waitall():
+    engine.wait_all()

@@ -1,0 +1,111 @@
+"""Shared base utilities: dtype tables, registry helper, errors.
+
+Counterpart of the reference's ``python/mxnet/base.py`` (ctypes plumbing,
+op-module codegen at base.py:578).  Here there is no C ABI between the Python
+front end and the op registry — ops are registered in-process (see
+``mxnet_tpu/ops/registry.py``) and surfaced into the ``nd``/``sym`` namespaces
+by ``mxnet_tpu/ndarray/register.py`` / ``mxnet_tpu/symbol/register.py``.
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+__all__ = [
+    "MXNetError", "string_types", "numeric_types", "integer_types",
+    "DTYPE_NAMES", "np_dtype", "dtype_name", "registry",
+]
+
+
+class MXNetError(RuntimeError):
+    """Framework error type (reference: base.py MXNetError)."""
+
+
+string_types = (str,)
+numeric_types = (float, int, _np.generic)
+integer_types = (int, _np.integer)
+
+# dtype name <-> numpy dtype. bfloat16 is first-class on TPU (the reference's
+# float16 configs map to bfloat16 here; float16 is still accepted).
+import ml_dtypes as _ml_dtypes
+
+bfloat16 = _np.dtype(_ml_dtypes.bfloat16)
+
+_DTYPE_MAP = {
+    "float32": _np.dtype(_np.float32),
+    "float64": _np.dtype(_np.float64),
+    "float16": _np.dtype(_np.float16),
+    "bfloat16": bfloat16,
+    "uint8": _np.dtype(_np.uint8),
+    "int8": _np.dtype(_np.int8),
+    "int32": _np.dtype(_np.int32),
+    "int64": _np.dtype(_np.int64),
+    "bool": _np.dtype(_np.bool_),
+}
+DTYPE_NAMES = tuple(_DTYPE_MAP)
+
+
+def np_dtype(dtype):
+    """Normalize a dtype-ish (str/np.dtype/type/None) to a numpy dtype."""
+    if dtype is None:
+        return _np.dtype(_np.float32)
+    if isinstance(dtype, str):
+        if dtype in _DTYPE_MAP:
+            return _DTYPE_MAP[dtype]
+        return _np.dtype(dtype)
+    return _np.dtype(dtype)
+
+
+def dtype_name(dtype):
+    dt = np_dtype(dtype)
+    if dt == bfloat16:
+        return "bfloat16"
+    return dt.name
+
+
+class _Registry:
+    """Tiny name->object registry with alias support.
+
+    Plays the role of dmlc registry macros (DMLC_REGISTRY_*) used throughout
+    the reference for ops, optimizers, initializers, iterators and metrics.
+    """
+
+    def __init__(self, kind):
+        self.kind = kind
+        self._map = {}
+
+    def register(self, obj=None, name=None, aliases=()):
+        def _do(o):
+            key = name or getattr(o, "__name__", None)
+            if key is None:
+                raise ValueError("cannot infer registry name")
+            self._map[key.lower()] = o
+            for a in aliases:
+                self._map[a.lower()] = o
+            return o
+        if obj is None:
+            return _do
+        return _do(obj)
+
+    def get(self, name):
+        try:
+            return self._map[name.lower()]
+        except KeyError:
+            raise KeyError("%s %r is not registered; known: %s" %
+                           (self.kind, name, sorted(self._map)))
+
+    def __contains__(self, name):
+        return name.lower() in self._map
+
+    def keys(self):
+        return self._map.keys()
+
+
+_registries = {}
+
+
+def registry(kind):
+    """Get-or-create the registry for *kind* ('optimizer', 'metric', ...)."""
+    if kind not in _registries:
+        _registries[kind] = _Registry(kind)
+    return _registries[kind]

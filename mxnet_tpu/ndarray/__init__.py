@@ -1,0 +1,13 @@
+"""nd — imperative NDArray API (reference: python/mxnet/ndarray/)."""
+
+from .ndarray import (NDArray, array, zeros, ones, full, empty, arange,  # noqa
+                      zeros_like, ones_like, concatenate, waitall,
+                      imperative_invoke, moveaxis, transpose)
+from .utils import save, load  # noqa: F401
+from . import random  # noqa: F401
+from . import register as _register
+
+# Generated op functions (nd.dot, nd.FullyConnected, ...)
+_register.populate(globals())
+
+from . import sparse  # noqa: F401  (after op functions exist)

@@ -1,0 +1,639 @@
+"""NDArray — the imperative tensor.
+
+Reference: ``python/mxnet/ndarray/ndarray.py`` (class :170) over the C++
+``NDArray`` (``src/ndarray/ndarray.cc``, ``include/mxnet/ndarray.h``).
+
+TPU-native design: an NDArray owns a ``jax.Array``.  JAX dispatch is already
+async (the reference needed the threaded engine for this; PJRT gives it to
+us), so ops return immediately and ``asnumpy()`` is the sync point exactly
+like the reference's ``WaitToRead``.  Mutation (``a += b``, ``a[:] = x``,
+optimizer updates) rebinds the handle to a fresh functional value — with
+buffer donation under jit this reuses the same HBM, reproducing the in-place
+semantics without an engine var-graph.
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import np_dtype, dtype_name
+from ..context import Context, current_context
+from ..ops import registry as _reg
+from .. import autograd as _ag
+
+__all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
+           "zeros_like", "ones_like", "concatenate", "imperative_invoke",
+           "waitall", "moveaxis"]
+
+
+def _ctx_of(jarr):
+    try:
+        dev = list(jarr.devices())[0]
+    except Exception:
+        return current_context()
+    if dev.platform == "cpu":
+        return Context("cpu", dev.id)
+    return Context("tpu", dev.id)
+
+
+class NDArray:
+    """Multi-dimensional array on a device, with async semantics."""
+
+    __slots__ = ("_data", "_tape_entry", "_grad", "_stype", "_aux")
+
+    def __init__(self, data, ctx=None):
+        if isinstance(data, NDArray):
+            data = data._data
+        if not isinstance(data, jax.Array):
+            data = jnp.asarray(data)
+        if ctx is not None:
+            data = jax.device_put(data, Context(ctx).jax_device)
+        self._data = data
+        self._tape_entry = None
+        self._grad = None
+        self._stype = "default"
+        self._aux = None
+
+    # -- properties -------------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return _np.dtype(self._data.dtype)
+
+    @property
+    def size(self):
+        return int(self._data.size)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def context(self):
+        return _ctx_of(self._data)
+
+    ctx = context
+
+    @property
+    def stype(self):
+        return self._stype
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @property
+    def handle(self):
+        # the reference exposes a ctypes handle; ours is the jax.Array
+        return self._data
+
+    @property
+    def T(self):
+        return transpose(self)
+
+    # -- sync / conversion ------------------------------------------------
+    def asnumpy(self):
+        """Copy to a numpy array, blocking until the value is ready
+        (reference: WaitToRead + SyncCopyToCPU, ndarray.py asnumpy)."""
+        return _np.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("The current array is not a scalar")
+        return self.asnumpy().reshape(()).item()
+
+    def item(self):
+        return self.asscalar()
+
+    def wait_to_read(self):
+        self._data.block_until_ready()
+        return self
+
+    def astype(self, dtype, copy=True):
+        dt = np_dtype(dtype)
+        if not copy and self.dtype == dt:
+            return self
+        return _invoke("Cast", [self], {"dtype": dtype_name(dt)})
+
+    def copy(self):
+        return _invoke("_copy", [self], {})
+
+    def copyto(self, other):
+        if isinstance(other, NDArray):
+            other._data = jax.device_put(
+                self._data.astype(other._data.dtype)
+                if self._data.dtype != other._data.dtype else self._data,
+                list(other._data.devices())[0])
+            other._tape_entry = None
+            return other
+        if isinstance(other, Context):
+            return NDArray(self._data, ctx=other)
+        raise TypeError("copyto does not support type " + str(type(other)))
+
+    def as_in_context(self, ctx):
+        ctx = Context(ctx)
+        if ctx == self.context:
+            return self
+        return NDArray(self._data, ctx=ctx)
+
+    as_in_ctx = as_in_context
+
+    def tostype(self, stype):
+        if stype == "default":
+            return self
+        from . import sparse as _sp
+        return _sp.cast_storage(self, stype)
+
+    def asnpy(self):
+        return self.asnumpy()
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    # -- autograd ---------------------------------------------------------
+    def attach_grad(self, grad_req="write", stype=None):
+        """Allocate a gradient buffer and mark this array as a variable
+        (reference: ndarray.py attach_grad -> MarkVariables)."""
+        grad = zeros_like(self)
+        _ag.mark_variables([self], [grad], grad_req)
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        _ag.backward([self], [out_grad] if out_grad is not None else None,
+                     retain_graph=retain_graph, train_mode=train_mode)
+
+    def detach(self):
+        out = NDArray(self._data)
+        return out
+
+    # -- python protocol ---------------------------------------------------
+    def __repr__(self):
+        return "%s\n<NDArray %s @%s>" % (
+            str(self.asnumpy()), "x".join(map(str, self.shape)), self.context)
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __bool__(self):
+        if self.size == 1:
+            return bool(self.asnumpy())
+        raise ValueError("The truth value of an NDArray with multiple "
+                         "elements is ambiguous")
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __iter__(self):
+        for i in range(self.shape[0]):
+            yield self[i]
+
+    # arithmetic
+    def __add__(self, other):
+        return _binary("broadcast_add", "_plus_scalar", self, other)
+
+    def __radd__(self, other):
+        return self.__add__(other)
+
+    def __sub__(self, other):
+        return _binary("broadcast_sub", "_minus_scalar", self, other)
+
+    def __rsub__(self, other):
+        return _invoke("_rminus_scalar", [self], {"scalar": float(other)})
+
+    def __mul__(self, other):
+        return _binary("broadcast_mul", "_mul_scalar", self, other)
+
+    def __rmul__(self, other):
+        return self.__mul__(other)
+
+    def __truediv__(self, other):
+        return _binary("broadcast_div", "_div_scalar", self, other)
+
+    def __rtruediv__(self, other):
+        return _invoke("_rdiv_scalar", [self], {"scalar": float(other)})
+
+    def __mod__(self, other):
+        return _binary("broadcast_mod", "_mod_scalar", self, other)
+
+    def __rmod__(self, other):
+        return _invoke("_rmod_scalar", [self], {"scalar": float(other)})
+
+    def __pow__(self, other):
+        return _binary("broadcast_power", "_power_scalar", self, other)
+
+    def __rpow__(self, other):
+        return _invoke("_rpower_scalar", [self], {"scalar": float(other)})
+
+    def __neg__(self):
+        return _invoke("negative", [self], {})
+
+    def __abs__(self):
+        return _invoke("abs", [self], {})
+
+    def __eq__(self, other):
+        return _binary("broadcast_equal", "_equal_scalar", self, other)
+
+    def __ne__(self, other):
+        return _binary("broadcast_not_equal", "_not_equal_scalar", self,
+                       other)
+
+    def __gt__(self, other):
+        return _binary("broadcast_greater", "_greater_scalar", self, other)
+
+    def __ge__(self, other):
+        return _binary("broadcast_greater_equal", "_greater_equal_scalar",
+                       self, other)
+
+    def __lt__(self, other):
+        return _binary("broadcast_lesser", "_lesser_scalar", self, other)
+
+    def __le__(self, other):
+        return _binary("broadcast_lesser_equal", "_lesser_equal_scalar",
+                       self, other)
+
+    __hash__ = object.__hash__
+
+    # in-place (rebind; donation under jit reuses the buffer)
+    def __iadd__(self, other):
+        out = self.__add__(other)
+        self._data, self._tape_entry = out._data, out._tape_entry
+        return self
+
+    def __isub__(self, other):
+        out = self.__sub__(other)
+        self._data, self._tape_entry = out._data, out._tape_entry
+        return self
+
+    def __imul__(self, other):
+        out = self.__mul__(other)
+        self._data, self._tape_entry = out._data, out._tape_entry
+        return self
+
+    def __itruediv__(self, other):
+        out = self.__truediv__(other)
+        self._data, self._tape_entry = out._data, out._tape_entry
+        return self
+
+    # indexing
+    def __getitem__(self, key):
+        key = _clean_key(key)
+        out = NDArray(self._data[key])
+        if _ag.is_recording() and self._tape_entry is not None:
+            def fn(x):
+                return x[key]
+            _record_simple(fn, [self], [out])
+        return out
+
+    def __setitem__(self, key, value):
+        key = _clean_key(key)
+        if isinstance(value, NDArray):
+            value = value._data
+        elif isinstance(value, (int, float)):
+            value = jnp.asarray(value, self._data.dtype)
+        else:
+            value = jnp.asarray(value, self._data.dtype)
+        self._data = self._data.at[key].set(value.astype(self._data.dtype))
+        self._tape_entry = None
+
+    # -- op methods (mirror of reference NDArray methods) ------------------
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        shape = kwargs.get("shape", shape)
+        return _invoke("Reshape", [self],
+                       {"shape": tuple(shape),
+                        "reverse": kwargs.get("reverse", False)})
+
+    def reshape_like(self, other):
+        return _invoke("reshape_like", [self, other], {})
+
+    def expand_dims(self, axis):
+        return _invoke("expand_dims", [self], {"axis": axis})
+
+    def squeeze(self, axis=None):
+        return _invoke("squeeze", [self], {"axis": axis})
+
+    def flatten(self):
+        return _invoke("Flatten", [self], {})
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return _invoke("transpose", [self], {"axes": axes or None})
+
+    def swapaxes(self, dim1, dim2):
+        return _invoke("SwapAxis", [self], {"dim1": dim1, "dim2": dim2})
+
+    def broadcast_to(self, shape):
+        return _invoke("broadcast_to", [self], {"shape": tuple(shape)})
+
+    def broadcast_like(self, other):
+        return _invoke("broadcast_like", [self, other], {})
+
+    def slice(self, begin, end, step=None):
+        return _invoke("slice", [self],
+                       {"begin": tuple(begin), "end": tuple(end),
+                        "step": tuple(step) if step else ()})
+
+    def slice_axis(self, axis, begin, end):
+        return _invoke("slice_axis", [self],
+                       {"axis": axis, "begin": begin, "end": end})
+
+    def take(self, indices, axis=0, mode="clip"):
+        return _invoke("take", [self, _as_nd(indices)],
+                       {"axis": axis, "mode": mode})
+
+    def one_hot(self, depth, **kw):
+        return _invoke("one_hot", [self], dict(depth=depth, **kw))
+
+    def pick(self, index, axis=-1, keepdims=False):
+        idx = _as_nd(index)
+        data = jnp.take_along_axis(
+            self._data,
+            jnp.expand_dims(idx._data.astype(jnp.int32), axis), axis)
+        out = NDArray(data if keepdims else jnp.squeeze(data, axis))
+        if _ag.is_recording() and self._tape_entry is not None:
+            iarr = idx._data
+
+            def fn(x):
+                d = jnp.take_along_axis(
+                    x, jnp.expand_dims(iarr.astype(jnp.int32), axis), axis)
+                return d if keepdims else jnp.squeeze(d, axis)
+            _record_simple(fn, [self], [out])
+        return out
+
+    def clip(self, a_min=None, a_max=None):
+        return _invoke("clip", [self], {"a_min": a_min, "a_max": a_max})
+
+    def abs(self):
+        return _invoke("abs", [self], {})
+
+    def sign(self):
+        return _invoke("sign", [self], {})
+
+    def sqrt(self):
+        return _invoke("sqrt", [self], {})
+
+    def square(self):
+        return _invoke("square", [self], {})
+
+    def exp(self):
+        return _invoke("exp", [self], {})
+
+    def log(self):
+        return _invoke("log", [self], {})
+
+    def relu(self):
+        return _invoke("relu", [self], {})
+
+    def sigmoid(self):
+        return _invoke("sigmoid", [self], {})
+
+    def tanh(self):
+        return _invoke("tanh", [self], {})
+
+    def softmax(self, axis=-1):
+        return _invoke("softmax", [self], {"axis": axis})
+
+    def log_softmax(self, axis=-1):
+        return _invoke("log_softmax", [self], {"axis": axis})
+
+    def sum(self, axis=None, keepdims=False, **kw):
+        return _invoke("sum", [self], {"axis": axis, "keepdims": keepdims})
+
+    def mean(self, axis=None, keepdims=False, **kw):
+        return _invoke("mean", [self], {"axis": axis, "keepdims": keepdims})
+
+    def prod(self, axis=None, keepdims=False):
+        return _invoke("prod", [self], {"axis": axis, "keepdims": keepdims})
+
+    def max(self, axis=None, keepdims=False):
+        return _invoke("max", [self], {"axis": axis, "keepdims": keepdims})
+
+    def min(self, axis=None, keepdims=False):
+        return _invoke("min", [self], {"axis": axis, "keepdims": keepdims})
+
+    def norm(self, ord=2, axis=None, keepdims=False):
+        return _invoke("norm", [self],
+                       {"ord": ord, "axis": axis, "keepdims": keepdims})
+
+    def argmax(self, axis=None, keepdims=False):
+        return _invoke("argmax", [self], {"axis": axis, "keepdims": keepdims})
+
+    def argmin(self, axis=None, keepdims=False):
+        return _invoke("argmin", [self], {"axis": axis, "keepdims": keepdims})
+
+    def argsort(self, axis=-1, is_ascend=True):
+        return _invoke("argsort", [self],
+                       {"axis": axis, "is_ascend": is_ascend})
+
+    def sort(self, axis=-1, is_ascend=True):
+        return _invoke("sort", [self], {"axis": axis, "is_ascend": is_ascend})
+
+    def topk(self, axis=-1, k=1, ret_typ="indices", is_ascend=False):
+        return _invoke("topk", [self], {"axis": axis, "k": k,
+                                        "ret_typ": ret_typ,
+                                        "is_ascend": is_ascend})
+
+    def flip(self, axis):
+        return _invoke("reverse", [self], {"axis": axis})
+
+    def tile(self, reps):
+        return _invoke("tile", [self], {"reps": tuple(reps)})
+
+    def repeat(self, repeats, axis=None):
+        return _invoke("repeat", [self], {"repeats": repeats, "axis": axis})
+
+    def split(self, num_outputs, axis=1, squeeze_axis=False):
+        return _invoke("SliceChannel", [self],
+                       {"num_outputs": num_outputs, "axis": axis,
+                        "squeeze_axis": squeeze_axis})
+
+    def dot(self, other, transpose_a=False, transpose_b=False):
+        return _invoke("dot", [self, other],
+                       {"transpose_a": transpose_a,
+                        "transpose_b": transpose_b})
+
+    def zeros_like(self):
+        return zeros_like(self)
+
+    def ones_like(self):
+        return ones_like(self)
+
+
+# ---------------------------------------------------------------------------
+# dispatch
+# ---------------------------------------------------------------------------
+
+
+def _clean_key(key):
+    if isinstance(key, NDArray):
+        return key._data.astype(jnp.int32)
+    if isinstance(key, tuple):
+        return tuple(_clean_key(k) if isinstance(k, NDArray) else k
+                     for k in key)
+    return key
+
+
+def _as_nd(x, dtype=None):
+    if isinstance(x, NDArray):
+        return x
+    return NDArray(jnp.asarray(x, np_dtype(dtype) if dtype else None))
+
+
+def _binary(op_name, scalar_op, lhs, rhs):
+    if isinstance(rhs, NDArray):
+        return _invoke(op_name, [lhs, rhs], {})
+    if isinstance(rhs, (int, float, _np.generic)):
+        return _invoke(scalar_op, [lhs], {"scalar": float(rhs)})
+    return _invoke(op_name, [lhs, _as_nd(rhs)], {})
+
+
+def _record_simple(fn, nd_inputs, nd_outputs):
+    _ag.record_op(fn, nd_inputs, nd_outputs)
+
+
+def _invoke(op_name, nd_inputs, params, out=None):
+    """The eager dispatch path (reference stack 3.1: MXImperativeInvokeEx ->
+    Imperative::Invoke -> engine push; here: executable-cache call)."""
+    op = _reg.get_op(op_name)
+    arrays = [x._data for x in nd_inputs]
+    rng = None
+    if op.needs_rng:
+        from ..runtime import rng as _rngmod
+        rng = _rngmod.next_key()
+        extra = {k: v for k, v in params.items() if k != "training"}
+        if "training" in _op_param_names(op):
+            extra["training"] = _ag.is_training() or params.get(
+                "training", False)
+        params = extra
+    elif "training" in _op_param_names(op):
+        params = dict(params)
+        params.setdefault("training", _ag.is_training())
+    raw_out = _reg.invoke(op, arrays, params, rng=rng)
+    outputs = [NDArray(o) for o in raw_out]
+    if _ag.is_recording():
+        import functools
+        pf = functools.partial(op.fn, **{k: v for k, v in params.items()
+                                         if v is not None or k in
+                                         ("a_min", "a_max")})
+        node_fn = pf
+        _ag.record_op(node_fn if rng is None else node_fn, nd_inputs,
+                      outputs, rng=rng)
+    from ..runtime import engine as _eng
+    if _eng.is_naive():
+        for o in outputs:
+            o._data.block_until_ready()
+    visible = outputs[:op.n_visible(params)]
+    if out is not None:
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for dst, src in zip(outs, visible):
+            dst._data = src._data
+            dst._tape_entry = src._tape_entry
+        return out
+    if len(visible) == 1:
+        return visible[0]
+    return visible
+
+
+import functools as _functools
+
+
+@_functools.lru_cache(maxsize=None)
+def _op_param_names(op):
+    import inspect
+    try:
+        sig = inspect.signature(op.fn)
+    except (TypeError, ValueError):
+        return ()
+    return tuple(p.name for p in sig.parameters.values()
+                 if p.default is not inspect.Parameter.empty)
+
+
+def imperative_invoke(op_name, *nd_inputs, out=None, **params):
+    """Generic imperative invoke used by the generated op functions."""
+    return _invoke(op_name, list(nd_inputs), params, out=out)
+
+
+# ---------------------------------------------------------------------------
+# creation functions
+# ---------------------------------------------------------------------------
+
+
+def _place(arr, ctx):
+    ctx = Context(ctx) if ctx is not None else current_context()
+    return jax.device_put(arr, ctx.jax_device)
+
+
+def array(source_array, ctx=None, dtype=None):
+    if isinstance(source_array, NDArray):
+        source_array = source_array.asnumpy()
+    np_arr = _np.asarray(source_array)
+    if dtype is None and np_arr.dtype == _np.float64:
+        dtype = "float32"  # reference defaults float arrays to float32
+    arr = jnp.asarray(np_arr, np_dtype(dtype) if dtype else None)
+    return NDArray(_place(arr, ctx))
+
+
+def empty(shape, ctx=None, dtype=None):
+    return zeros(shape, ctx, dtype)
+
+
+def zeros(shape, ctx=None, dtype=None, **kwargs):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(_place(jnp.zeros(shape, np_dtype(dtype)), ctx))
+
+
+def ones(shape, ctx=None, dtype=None, **kwargs):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(_place(jnp.ones(shape, np_dtype(dtype)), ctx))
+
+
+def full(shape, val, ctx=None, dtype=None):
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(_place(jnp.full(shape, val, np_dtype(dtype)), ctx))
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None):
+    out = jnp.arange(start, stop, step, np_dtype(dtype))
+    if repeat != 1:
+        out = jnp.repeat(out, repeat)
+    return NDArray(_place(out, ctx))
+
+
+def zeros_like(other):
+    return NDArray(jnp.zeros_like(other._data))
+
+
+def ones_like(other):
+    return NDArray(jnp.ones_like(other._data))
+
+
+def concatenate(arrays, axis=0, always_copy=True):
+    return _invoke("Concat", list(arrays), {"dim": axis})
+
+
+def moveaxis(tensor, source, destination):
+    return NDArray(jnp.moveaxis(tensor._data, source, destination))
+
+
+def transpose(data, axes=None):
+    return _invoke("transpose", [data], {"axes": axes})
+
+
+def waitall():
+    from ..runtime import engine
+    engine.wait_all()

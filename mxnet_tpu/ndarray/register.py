@@ -1,0 +1,64 @@
+"""Generate the ``nd.*`` op functions from the registry.
+
+Reference: ``python/mxnet/ndarray/register.py:30``
+(_generate_ndarray_function_code) — the reference generates Python source
+per C-registered op at import; we close over the in-process registry
+instead.  Inputs may be passed positionally or by their declared names
+(e.g. ``nd.FullyConnected(data=x, weight=w, bias=b, num_hidden=10)``).
+"""
+
+from __future__ import annotations
+
+from ..ops import registry as _reg
+from .ndarray import NDArray, imperative_invoke
+
+
+def _make_fn(op):
+    def fn(*args, out=None, name=None, **kwargs):
+        inputs = []
+        pos_params = []
+        for a in args:
+            if isinstance(a, NDArray):
+                inputs.append(a)
+            else:
+                pos_params.append(a)
+        params = {}
+        named = {}
+        for k, v in kwargs.items():
+            if isinstance(v, NDArray):
+                named[k] = v
+            else:
+                params[k] = v
+        if pos_params:
+            # positional scalars map onto the op's params in order
+            # (e.g. nd.one_hot(indices, depth))
+            free = [p for p in op.param_names if p not in params]
+            if len(pos_params) > len(free):
+                raise TypeError("%s: too many positional arguments"
+                                % op.name)
+            for p, v in zip(free, pos_params):
+                params[p] = v
+        if named:
+            for nm in op.input_names[len(inputs):]:
+                if nm in named:
+                    inputs.append(named.pop(nm))
+            if named:
+                raise TypeError("%s got unexpected NDArray kwargs %s "
+                                "(inputs: %s)" %
+                                (op.name, sorted(named), op.input_names))
+        return imperative_invoke(op.name, *inputs, out=out, **params)
+
+    fn.__name__ = op.name
+    fn.__doc__ = op.doc
+    return fn
+
+
+def populate(namespace, filt=None):
+    """Install one function per registered op into *namespace*."""
+    for name in _reg.list_ops():
+        op = _reg.get_op(name)
+        if filt and not filt(name):
+            continue
+        namespace[name] = _make_fn(op)
+        # also expose hidden ops without the underscore clash risk
+    return namespace

@@ -1,0 +1,286 @@
+"""Sparse NDArrays: row_sparse and CSR.
+
+Reference: ``python/mxnet/ndarray/sparse.py`` (1,635 LoC) over the stype
+machinery in ``include/mxnet/ndarray.h:61-66``.
+
+TPU-native design: XLA has no native sparse tensors, so sparse arrays are
+(values, indices[, indptr]) pairs of dense jax arrays — SURVEY.md §7 "hard
+part (b)".  row_sparse is the gradient format for embeddings (values row
+block + row ids); CSR feeds the LibSVM linear-classification config.  Ops
+lower to gather/scatter/segment_sum HLO, which XLA handles well on TPU as
+long as nnz shapes are static per compilation.
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+import jax.numpy as jnp
+import jax
+
+from ..base import np_dtype
+from .ndarray import NDArray, array as _dense_array
+
+__all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
+           "row_sparse_array", "csr_matrix", "cast_storage", "zeros",
+           "dot", "retain", "sparse_add", "elemwise_mul"]
+
+
+class BaseSparseNDArray(NDArray):
+    """Common base for sparse stypes; wraps component dense arrays."""
+
+    __slots__ = ("_shape",)
+
+    def __init__(self, data, indices, shape, stype):
+        # _data holds the values array; indices et al. go in _aux
+        super().__init__(data._data if isinstance(data, NDArray) else data)
+        self._aux = [indices._data if isinstance(indices, NDArray)
+                     else jnp.asarray(indices)]
+        self._shape = tuple(int(s) for s in shape)
+        self._stype = stype
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def data(self):
+        return NDArray(self._data)
+
+    @property
+    def indices(self):
+        return NDArray(self._aux[0])
+
+    @property
+    def dtype(self):
+        return _np.dtype(self._data.dtype)
+
+    def asnumpy(self):
+        return self.todense().asnumpy()
+
+    def astype(self, dtype, copy=True):
+        out = self.copy()
+        out._data = out._data.astype(np_dtype(dtype))
+        return out
+
+    def __repr__(self):
+        return "\n<%s %s @%s>" % (type(self).__name__,
+                                  "x".join(map(str, self.shape)),
+                                  self.context)
+
+    def tostype(self, stype):
+        if stype == self._stype:
+            return self
+        return cast_storage(self, stype)
+
+    def wait_to_read(self):
+        self._data.block_until_ready()
+        return self
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """values: (nnz_rows, *row_shape); indices: (nnz_rows,) sorted row ids."""
+
+    def __init__(self, data, indices, shape):
+        super().__init__(data, indices, shape, "row_sparse")
+
+    def todense(self):
+        out = jnp.zeros(self._shape, self._data.dtype)
+        idx = self._aux[0].astype(jnp.int32)
+        return NDArray(out.at[idx].set(self._data))
+
+    def copy(self):
+        return RowSparseNDArray(NDArray(self._data), NDArray(self._aux[0]),
+                                self._shape)
+
+    def retain(self, rs_indices):
+        return retain(self, rs_indices)
+
+    def __add__(self, other):
+        return sparse_add(self, other)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """values/indices: (nnz,); indptr: (rows+1,)."""
+
+    def __init__(self, data, indices, indptr, shape):
+        super().__init__(data, indices, shape, "csr")
+        self._aux.append(indptr._data if isinstance(indptr, NDArray)
+                         else jnp.asarray(indptr))
+
+    @property
+    def indptr(self):
+        return NDArray(self._aux[1])
+
+    def todense(self):
+        rows = self._shape[0]
+        indptr = self._aux[1].astype(jnp.int32)
+        # row id per nnz via searchsorted over indptr
+        nnz = self._data.shape[0]
+        pos = jnp.arange(nnz)
+        row_ids = jnp.searchsorted(indptr, pos, side="right") - 1
+        out = jnp.zeros(self._shape, self._data.dtype)
+        cols = self._aux[0].astype(jnp.int32)
+        return NDArray(out.at[row_ids, cols].set(self._data))
+
+    def copy(self):
+        return CSRNDArray(NDArray(self._data), NDArray(self._aux[0]),
+                          NDArray(self._aux[1]), self._shape)
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            start = key.start or 0
+            stop = key.stop if key.stop is not None else self._shape[0]
+            indptr = _np.asarray(self._aux[1])
+            lo, hi = int(indptr[start]), int(indptr[stop])
+            new_indptr = indptr[start:stop + 1] - indptr[start]
+            return CSRNDArray(
+                NDArray(self._data[lo:hi]), NDArray(self._aux[0][lo:hi]),
+                NDArray(jnp.asarray(new_indptr)),
+                (stop - start,) + self._shape[1:])
+        raise TypeError("CSRNDArray indexing supports row slices only")
+
+
+# ---------------------------------------------------------------------------
+# constructors
+# ---------------------------------------------------------------------------
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        data = jnp.asarray(data, np_dtype(dtype) if dtype else None)
+        indices = jnp.asarray(indices, jnp.int32)
+        return RowSparseNDArray(NDArray(data), NDArray(indices), shape)
+    # dense input -> compress (host-side)
+    dense = _np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray)
+                        else arg1)
+    if dtype:
+        dense = dense.astype(np_dtype(dtype))
+    nz_rows = _np.where(_np.any(dense.reshape(dense.shape[0], -1) != 0,
+                                axis=1))[0]
+    return RowSparseNDArray(NDArray(jnp.asarray(dense[nz_rows])),
+                            NDArray(jnp.asarray(nz_rows, dtype=jnp.int32)),
+                            shape or dense.shape)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        data = jnp.asarray(data, np_dtype(dtype) if dtype else None)
+        return CSRNDArray(NDArray(data),
+                          NDArray(jnp.asarray(indices, jnp.int32)),
+                          NDArray(jnp.asarray(indptr, jnp.int32)), shape)
+    dense = _np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray)
+                        else arg1)
+    if dtype:
+        dense = dense.astype(np_dtype(dtype))
+    rows, cols = _np.nonzero(dense)
+    data = dense[rows, cols]
+    indptr = _np.zeros(dense.shape[0] + 1, _np.int32)
+    _np.add.at(indptr, rows + 1, 1)
+    indptr = _np.cumsum(indptr)
+    return CSRNDArray(NDArray(jnp.asarray(data)),
+                      NDArray(jnp.asarray(cols, dtype=jnp.int32)),
+                      NDArray(jnp.asarray(indptr)), shape or dense.shape)
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    dt = np_dtype(dtype)
+    if stype == "row_sparse":
+        row_shape = shape[1:]
+        return RowSparseNDArray(NDArray(jnp.zeros((0,) + row_shape, dt)),
+                                NDArray(jnp.zeros((0,), jnp.int32)), shape)
+    if stype == "csr":
+        return CSRNDArray(NDArray(jnp.zeros((0,), dt)),
+                          NDArray(jnp.zeros((0,), jnp.int32)),
+                          NDArray(jnp.zeros((shape[0] + 1,), jnp.int32)),
+                          shape)
+    if stype == "default":
+        from . import ndarray as _nd
+        return _nd.zeros(shape, ctx, dtype)
+    raise ValueError(stype)
+
+
+def cast_storage(arr, stype):
+    """dense<->sparse conversion (reference: cast_storage op,
+    src/operator/tensor/cast_storage-inl.h)."""
+    if arr.stype == stype:
+        return arr
+    if stype == "default":
+        return arr.todense()
+    if isinstance(arr, BaseSparseNDArray):
+        arr = arr.todense()
+    if stype == "row_sparse":
+        return row_sparse_array(arr, shape=arr.shape)
+    if stype == "csr":
+        return csr_matrix(arr, shape=arr.shape)
+    raise ValueError(stype)
+
+
+# ---------------------------------------------------------------------------
+# sparse ops
+# ---------------------------------------------------------------------------
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """csr × dense / dense × rsp dot (reference: src/operator/tensor/dot-inl.h
+    sparse paths)."""
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, NDArray) and \
+            not isinstance(rhs, BaseSparseNDArray):
+        vals = lhs._data
+        cols = lhs._aux[0].astype(jnp.int32)
+        indptr = lhs._aux[1].astype(jnp.int32)
+        nnz = vals.shape[0]
+        row_ids = jnp.searchsorted(indptr, jnp.arange(nnz), side="right") - 1
+        if transpose_a:
+            # out[c] += v * rhs[r]  -> scatter-add over columns
+            contrib = vals[:, None] * rhs._data[row_ids]
+            out = jnp.zeros((lhs.shape[1], rhs.shape[1]), vals.dtype)
+            out = out.at[cols].add(contrib)
+            return NDArray(out)
+        gathered = vals[:, None] * rhs._data[cols]
+        out = jax.ops.segment_sum(gathered, row_ids,
+                                  num_segments=lhs.shape[0])
+        return NDArray(out)
+    if not isinstance(lhs, BaseSparseNDArray) and \
+            isinstance(rhs, BaseSparseNDArray):
+        return NDArray(jnp.dot(lhs._data, rhs.todense()._data))
+    return NDArray(jnp.dot(lhs.todense()._data if isinstance(
+        lhs, BaseSparseNDArray) else lhs._data,
+        rhs.todense()._data if isinstance(rhs, BaseSparseNDArray)
+        else rhs._data))
+
+
+def retain(rsp, indices):
+    """Keep only the requested rows (reference: sparse_retain op)."""
+    want = indices._data.astype(jnp.int32) if isinstance(indices, NDArray) \
+        else jnp.asarray(indices, jnp.int32)
+    have = rsp._aux[0]
+    # position of each wanted row in `have` (or -1)
+    pos = jnp.searchsorted(have, want)
+    pos = jnp.clip(pos, 0, max(have.shape[0] - 1, 0))
+    ok = (have.shape[0] > 0) & (have[pos] == want) if have.shape[0] else \
+        jnp.zeros(want.shape, bool)
+    vals = jnp.where(ok.reshape((-1,) + (1,) * (rsp._data.ndim - 1)),
+                     rsp._data[pos] if have.shape[0] else
+                     jnp.zeros((want.shape[0],) + rsp._data.shape[1:],
+                               rsp._data.dtype),
+                     0)
+    return RowSparseNDArray(NDArray(vals), NDArray(want), rsp.shape)
+
+
+def sparse_add(a, b):
+    if isinstance(a, RowSparseNDArray) and isinstance(b, RowSparseNDArray):
+        idx = jnp.concatenate([a._aux[0], b._aux[0]])
+        vals = jnp.concatenate([a._data, b._data])
+        order = jnp.argsort(idx)
+        return RowSparseNDArray(NDArray(vals[order]), NDArray(idx[order]),
+                                a.shape)  # may contain dup rows; dense on use
+    return NDArray(a.todense()._data + (b.todense()._data if isinstance(
+        b, BaseSparseNDArray) else b._data))
+
+
+def elemwise_mul(a, b):
+    return NDArray(a.todense()._data * (b.todense()._data if isinstance(
+        b, BaseSparseNDArray) else b._data))

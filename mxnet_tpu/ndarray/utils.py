@@ -1,0 +1,87 @@
+"""NDArray save/load (reference: python/mxnet/ndarray/utils.py,
+src/ndarray/ndarray.cc:1574 Save / :1691 Load).
+
+Format: a zip archive (numpy ``.npz``) with a magic entry; dict keys are
+stored as ``key:<name>``, list items as ``idx:<i>``.  Sparse arrays store
+``<name>/data`` + ``<name>/indices`` (+ indptr) with an ``__stype__`` tag.
+This is this framework's native checkpoint tensor format (the reference's
+raw binary layout is CUDA-era and not reproduced bit-for-bit).
+"""
+
+from __future__ import annotations
+
+import os
+import numpy as _np
+
+from .ndarray import NDArray, array
+
+_MAGIC = "mxnet_tpu_ndarray_v1"
+
+
+def _flatten_for_save(data):
+    entries = {}
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        items = [("idx:%d" % i, v) for i, v in enumerate(data)]
+    elif isinstance(data, dict):
+        items = [("key:%s" % k, v) for k, v in data.items()]
+    else:
+        raise ValueError("save expects NDArray, list or dict")
+    for name, v in items:
+        if getattr(v, "stype", "default") != "default":
+            from . import sparse as _sp
+            entries[name + "/__stype__"] = _np.array(v.stype)
+            entries[name + "/data"] = v.data.asnumpy()
+            entries[name + "/indices"] = v.indices.asnumpy()
+            entries[name + "/shape"] = _np.array(v.shape)
+            if v.stype == "csr":
+                entries[name + "/indptr"] = v.indptr.asnumpy()
+        else:
+            entries[name] = v.asnumpy()
+    return entries
+
+
+def save(fname, data):
+    """Save NDArrays to file (reference: mx.nd.save)."""
+    entries = _flatten_for_save(data)
+    entries["__magic__"] = _np.array(_MAGIC)
+    tmp = fname + ".tmp.npz"
+    with open(tmp, "wb") as f:
+        _np.savez(f, **entries)
+    os.replace(tmp, fname)
+
+
+def load(fname):
+    """Load NDArrays saved by :func:`save`."""
+    with _np.load(fname, allow_pickle=False) as z:
+        keys = [k for k in z.files if k != "__magic__"]
+        groups = {}
+        for k in keys:
+            base = k.split("/")[0] if "/" in k else k
+            groups.setdefault(base, []).append(k)
+
+        def build(base):
+            sub = groups[base]
+            if len(sub) == 1 and "/" not in sub[0]:
+                return array(z[base])
+            from . import sparse as _sp
+            stype = str(z[base + "/__stype__"])
+            shape = tuple(int(s) for s in z[base + "/shape"])
+            if stype == "row_sparse":
+                return _sp.row_sparse_array(
+                    (z[base + "/data"], z[base + "/indices"]), shape=shape)
+            return _sp.csr_matrix(
+                (z[base + "/data"], z[base + "/indices"],
+                 z[base + "/indptr"]), shape=shape)
+
+        if all(k.split("/")[0].startswith("idx:") for k in groups):
+            out = [None] * len(groups)
+            for base in groups:
+                out[int(base[4:])] = build(base)
+            return out
+        result = {}
+        for base in groups:
+            name = base[4:] if base.startswith("key:") else base
+            result[name] = build(base)
+        return result

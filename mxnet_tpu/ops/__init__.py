@@ -1,0 +1,16 @@
+"""Operator library.
+
+Importing this package registers every built-in operator into the registry
+(``registry.py``), which the ``nd``/``sym`` front ends then expose as
+generated functions — the in-process equivalent of the reference's op
+reflection at import (``python/mxnet/base.py:578`` ``_init_op_module``).
+"""
+
+from .registry import (Op, register_op, get_op, list_ops, invoke,  # noqa
+                       alias)
+from . import elemwise      # noqa: F401
+from . import tensor        # noqa: F401
+from . import reduce        # noqa: F401
+from . import nn            # noqa: F401
+from . import random_ops    # noqa: F401
+from . import optimizer_ops  # noqa: F401

@@ -1,0 +1,31 @@
+"""Matmul/conv precision policy.
+
+On TPU the MXU natively multiplies bf16; XLA's DEFAULT precision lowers even
+fp32 contractions to bf16 passes.  The reference framework is fp32-exact
+(cuBLAS SGEMM), so fp32 inputs here use HIGHEST precision (3-pass bf16 on
+TPU ≈ fp32), while bf16/fp16 inputs take the fast path — speed comes from
+choosing bf16 dtypes, not from silently degrading fp32 math.  Override with
+MXNET_TPU_MATMUL_PRECISION=default|high|highest.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+_ENV = os.environ.get("MXNET_TPU_MATMUL_PRECISION", "")
+_MAP = {"default": jax.lax.Precision.DEFAULT,
+        "high": jax.lax.Precision.HIGH,
+        "highest": jax.lax.Precision.HIGHEST}
+
+
+def matmul_precision(*dtypes):
+    """Precision for a contraction over operands of the given dtypes."""
+    if _ENV:
+        return _MAP[_ENV]
+    if any(jnp.dtype(d) in (jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float16))
+           for d in dtypes):
+        return jax.lax.Precision.DEFAULT
+    return jax.lax.Precision.HIGHEST

@@ -1,0 +1,597 @@
+"""Neural-network operators.
+
+Covers the reference's ``src/operator/nn/`` family (Convolution,
+Deconvolution, FullyConnected, BatchNorm, LayerNorm, Pooling, Activation,
+softmax, Dropout, LRN, UpSampling — convolution-inl.h:58 etc.).  The whole
+cuDNN/MKLDNN wrapper layer disappears: these lower directly to XLA HLO
+(conv_general_dilated / reduce_window / dot_general hit the MXU natively).
+Layout is NCHW at the API (reference default); XLA's layout assignment
+re-tiles for the hardware, so no NHWC shim is needed.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op, alias
+from ..base import np_dtype
+from ._precision import matmul_precision
+
+# ---------------------------------------------------------------------------
+# FullyConnected / Activation / softmax
+# ---------------------------------------------------------------------------
+
+
+@register_op("FullyConnected", input_names=("data", "weight", "bias"))
+def _fully_connected(data, weight, *rest, num_hidden=0, no_bias=False,
+                     flatten=True):
+    if flatten and data.ndim > 2:
+        data = data.reshape(data.shape[0], -1)
+    # weight: (num_hidden, in_units) — contract on in_units (MXU matmul)
+    out = jax.lax.dot_general(
+        data, weight,
+        dimension_numbers=(((data.ndim - 1,), (1,)), ((), ())),
+        precision=matmul_precision(data.dtype, weight.dtype),
+        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16
+        else None)
+    if out.dtype != data.dtype:
+        out = out.astype(data.dtype)
+    if not no_bias and rest:
+        out = out + rest[0]
+    return out
+
+
+@register_op("Activation")
+def _activation(x, act_type="relu"):
+    if act_type == "relu":
+        return jnp.maximum(x, 0)
+    if act_type == "sigmoid":
+        return jax.nn.sigmoid(x)
+    if act_type == "tanh":
+        return jnp.tanh(x)
+    if act_type == "softrelu":
+        return jax.nn.softplus(x)
+    if act_type == "softsign":
+        return jax.nn.soft_sign(x)
+    if act_type == "gelu":
+        return jax.nn.gelu(x, approximate=False)
+    if act_type == "swish":
+        return x * jax.nn.sigmoid(x)
+    raise ValueError("unknown act_type %r" % act_type)
+
+
+@register_op("softmax")
+def _softmax(x, axis=-1, temperature=None, length=None):
+    if temperature is not None and temperature != 1.0:
+        x = x / temperature
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register_op("log_softmax")
+def _log_softmax(x, axis=-1, temperature=None):
+    if temperature is not None and temperature != 1.0:
+        x = x / temperature
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@register_op("softmin")
+def _softmin(x, axis=-1, temperature=None):
+    return jax.nn.softmax(-x, axis=axis)
+
+
+@register_op("SoftmaxActivation")
+def _softmax_activation(x, mode="instance"):
+    if mode == "channel":
+        return jax.nn.softmax(x, axis=1)
+    return jax.nn.softmax(x.reshape(x.shape[0], -1), axis=-1).reshape(x.shape)
+
+
+@register_op("SoftmaxOutput", aliases=("Softmax",))
+def _softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
+                    multi_output=False, use_ignore=False,
+                    preserve_shape=False, normalization="null",
+                    out_grad=False, smooth_alpha=0.0):
+    """Softmax forward with implicit cross-entropy backward.
+
+    Reference: ``src/operator/softmax_output-inl.h`` — the backward pass
+    ignores the incoming out_grad and emits (softmax - one_hot(label)),
+    which we reproduce with ``jax.custom_vjp`` so both the eager tape and
+    the fused graph executor see the same gradient.
+    """
+    if multi_output or (preserve_shape and data.ndim > 2):
+        cls_axis = 1 if multi_output else data.ndim - 1
+    else:
+        cls_axis = data.ndim - 1
+        if data.ndim > 2:
+            data = data.reshape(data.shape[0], -1)
+            cls_axis = 1
+
+    n_class = data.shape[cls_axis]
+
+    @jax.custom_vjp
+    def f(d, l):
+        return jax.nn.softmax(d, axis=cls_axis)
+
+    def fwd(d, l):
+        out = jax.nn.softmax(d, axis=cls_axis)
+        return out, (out, l)
+
+    def bwd(res, g):
+        out, l = res
+        li = l.astype(jnp.int32)
+        onehot = jax.nn.one_hot(li, n_class, dtype=out.dtype, axis=cls_axis)
+        if smooth_alpha:
+            onehot = onehot * (1 - smooth_alpha) + smooth_alpha / n_class
+        grad = out - onehot
+        if use_ignore:
+            mask = (l != ignore_label).astype(out.dtype)
+            grad = grad * jnp.expand_dims(mask, cls_axis)
+        scale = grad_scale
+        if normalization == "batch":
+            scale = scale / grad.shape[0]
+        elif normalization == "valid":
+            if use_ignore:
+                valid = jnp.maximum(jnp.sum(l != ignore_label), 1)
+            else:
+                valid = l.size
+            scale = scale / valid
+        grad = grad * scale
+        return grad, jnp.zeros_like(l)
+
+    f.defvjp(fwd, bwd)
+    return f(data, label)
+
+
+@register_op("softmax_cross_entropy")
+def _softmax_cross_entropy(data, label):
+    logp = jax.nn.log_softmax(data, axis=-1)
+    li = label.astype(jnp.int32)
+    nll = -jnp.take_along_axis(logp, li[:, None], axis=-1)
+    return jnp.sum(nll)
+
+
+@register_op("LinearRegressionOutput")
+def _linear_regression_output(data, label, grad_scale=1.0):
+    @jax.custom_vjp
+    def f(d, l):
+        return d * 1.0
+
+    def fwd(d, l):
+        return d * 1.0, (d, l)
+
+    def bwd(res, g):
+        d, l = res
+        return grad_scale * (d - l.reshape(d.shape)), jnp.zeros_like(l)
+
+    f.defvjp(fwd, bwd)
+    return f(data, label)
+
+
+@register_op("MAERegressionOutput")
+def _mae_regression_output(data, label, grad_scale=1.0):
+    @jax.custom_vjp
+    def f(d, l):
+        return d * 1.0
+
+    def fwd(d, l):
+        return d * 1.0, (d, l)
+
+    def bwd(res, g):
+        d, l = res
+        return grad_scale * jnp.sign(d - l.reshape(d.shape)), \
+            jnp.zeros_like(l)
+
+    f.defvjp(fwd, bwd)
+    return f(data, label)
+
+
+@register_op("LogisticRegressionOutput")
+def _logistic_regression_output(data, label, grad_scale=1.0):
+    @jax.custom_vjp
+    def f(d, l):
+        return jax.nn.sigmoid(d)
+
+    def fwd(d, l):
+        return jax.nn.sigmoid(d), (jax.nn.sigmoid(d), l)
+
+    def bwd(res, g):
+        p, l = res
+        return grad_scale * (p - l.reshape(p.shape)), jnp.zeros_like(l)
+
+    f.defvjp(fwd, bwd)
+    return f(data, label)
+
+
+# ---------------------------------------------------------------------------
+# Convolution family
+# ---------------------------------------------------------------------------
+
+
+def _conv_dnums(nd):
+    # NC + spatial; weights OI + spatial
+    spatial = "DHW"[-nd:] if nd <= 3 else None
+    lhs = "NC" + spatial
+    rhs = "OI" + spatial
+    return jax.lax.conv_dimension_numbers((1, 1) + (1,) * nd,
+                                          (1, 1) + (1,) * nd,
+                                          (lhs, rhs, lhs))
+
+
+def _tup(v, nd, default):
+    if v is None or (isinstance(v, (tuple, list)) and len(v) == 0):
+        return (default,) * nd
+    if isinstance(v, int):
+        return (v,) * nd
+    return tuple(v)
+
+
+@register_op("Convolution", input_names=("data", "weight", "bias"))
+def _convolution(data, weight, *rest, kernel=(), stride=(), dilate=(),
+                 pad=(), num_filter=0, num_group=1, workspace=1024,
+                 no_bias=False, cudnn_tune=None, cudnn_off=False,
+                 layout=None):
+    nd = len(kernel)
+    stride = _tup(stride, nd, 1)
+    dilate = _tup(dilate, nd, 1)
+    pad = _tup(pad, nd, 0)
+    dn = _conv_dnums(nd)
+    out = jax.lax.conv_general_dilated(
+        data, weight, window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=num_group,
+        precision=matmul_precision(data.dtype, weight.dtype))
+    if not no_bias and rest:
+        bias = rest[0]
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+@register_op("Deconvolution", input_names=("data", "weight", "bias"))
+def _deconvolution(data, weight, *rest, kernel=(), stride=(), dilate=(),
+                   pad=(), adj=(), target_shape=(), num_filter=0,
+                   num_group=1, workspace=1024, no_bias=True,
+                   cudnn_tune=None, cudnn_off=False, layout=None):
+    # weight layout: (C_in, num_filter//num_group, *kernel) — reference
+    # src/operator/nn/deconvolution-inl.h.  Implemented as the transpose
+    # conv = lhs-dilated convolution with the flipped, IO-swapped kernel.
+    nd = len(kernel)
+    stride = _tup(stride, nd, 1)
+    dilate = _tup(dilate, nd, 1)
+    pad = _tup(pad, nd, 0)
+    adj = _tup(adj, nd, 0)
+    g = num_group
+    cin = weight.shape[0]
+    og = weight.shape[1]
+    w = weight.reshape((g, cin // g, og) + tuple(kernel))
+    w = jnp.swapaxes(w, 1, 2)                      # (g, og, cin//g, *k)
+    w = w.reshape((g * og, cin // g) + tuple(kernel))
+    w = jnp.flip(w, axis=tuple(range(2, 2 + nd)))
+    dn = _conv_dnums(nd)
+    eff_k = tuple((kernel[i] - 1) * dilate[i] + 1 for i in range(nd))
+    padding = [(eff_k[i] - 1 - pad[i], eff_k[i] - 1 - pad[i] + adj[i])
+               for i in range(nd)]
+    out = jax.lax.conv_general_dilated(
+        data, w, window_strides=(1,) * nd, padding=padding,
+        lhs_dilation=stride, rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=g,
+        precision=matmul_precision(data.dtype, w.dtype))
+    if not no_bias and rest:
+        out = out + rest[0].reshape((1, -1) + (1,) * nd)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pooling
+# ---------------------------------------------------------------------------
+
+
+@register_op("Pooling")
+def _pooling(data, kernel=(), pool_type="max", global_pool=False,
+             cudnn_off=False, pooling_convention="valid", stride=(),
+             pad=(), p_value=2, count_include_pad=True):
+    nd = data.ndim - 2
+    if global_pool:
+        axes = tuple(range(2, data.ndim))
+        if pool_type == "max":
+            return jnp.max(data, axis=axes, keepdims=True)
+        if pool_type in ("avg", "sum"):
+            r = jnp.sum(data, axis=axes, keepdims=True)
+            if pool_type == "avg":
+                cnt = 1
+                for a in axes:
+                    cnt *= data.shape[a]
+                r = r / cnt
+            return r
+        if pool_type == "lp":
+            return jnp.power(
+                jnp.sum(jnp.power(jnp.abs(data), p_value), axis=axes,
+                        keepdims=True), 1.0 / p_value)
+    kernel = _tup(kernel, nd, 1)
+    stride = _tup(stride, nd, 1)
+    pad = _tup(pad, nd, 0)
+
+    def pads_for(i):
+        lo = pad[i]
+        hi = pad[i]
+        if pooling_convention == "full":
+            # ceil mode: add extra high padding so the last window fits
+            size = data.shape[2 + i] + 2 * pad[i]
+            rem = (size - kernel[i]) % stride[i]
+            if rem:
+                hi += stride[i] - rem
+        return (lo, hi)
+
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    pads = ((0, 0), (0, 0)) + tuple(pads_for(i) for i in range(nd))
+
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) \
+            else jnp.iinfo(data.dtype).min
+        return jax.lax.reduce_window(data, init, jax.lax.max, window,
+                                     strides, pads)
+    if pool_type in ("avg", "sum"):
+        s = jax.lax.reduce_window(data, 0.0 if jnp.issubdtype(
+            data.dtype, jnp.floating) else 0, jax.lax.add, window, strides,
+            pads)
+        if pool_type == "sum":
+            return s
+        if count_include_pad:
+            cnt = 1
+            for k in kernel:
+                cnt *= k
+            return s / cnt
+        ones = jnp.ones_like(data)
+        cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides,
+                                    pads)
+        return s / cnt
+    if pool_type == "lp":
+        s = jax.lax.reduce_window(jnp.power(jnp.abs(data), p_value), 0.0,
+                                  jax.lax.add, window, strides, pads)
+        return jnp.power(s, 1.0 / p_value)
+    raise ValueError("unknown pool_type %r" % pool_type)
+
+
+@register_op("ROIPooling")
+def _roi_pooling(data, rois, pooled_size=(), spatial_scale=1.0):
+    # reference: src/operator/roi_pooling-inl.h — max pool each scaled ROI
+    # to a fixed (ph, pw) grid.  Batched over rois with vmap.
+    ph, pw = pooled_size
+    H, W = data.shape[2], data.shape[3]
+
+    def one_roi(roi):
+        batch_idx = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * spatial_scale).astype(jnp.int32)
+        y1 = jnp.round(roi[2] * spatial_scale).astype(jnp.int32)
+        x2 = jnp.round(roi[3] * spatial_scale).astype(jnp.int32)
+        y2 = jnp.round(roi[4] * spatial_scale).astype(jnp.int32)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        img = data[batch_idx]                      # (C, H, W)
+        ys = jnp.arange(H)
+        xs = jnp.arange(W)
+
+        def cell(iy, ix):
+            hstart = y1 + (iy * rh) // ph
+            hend = y1 + ((iy + 1) * rh + ph - 1) // ph
+            wstart = x1 + (ix * rw) // pw
+            wend = x1 + ((ix + 1) * rw + pw - 1) // pw
+            mask = ((ys[:, None] >= hstart) & (ys[:, None] < hend) &
+                    (xs[None, :] >= wstart) & (xs[None, :] < wend))
+            masked = jnp.where(mask[None], img, -jnp.inf)
+            v = jnp.max(masked, axis=(1, 2))
+            return jnp.where(jnp.isfinite(v), v, 0.0)
+
+        iy, ix = jnp.meshgrid(jnp.arange(ph), jnp.arange(pw), indexing="ij")
+        cells = jax.vmap(jax.vmap(cell))(iy, ix)   # (ph, pw, C)
+        return jnp.transpose(cells, (2, 0, 1))
+
+    return jax.vmap(one_roi)(rois)
+
+
+# ---------------------------------------------------------------------------
+# Normalization
+# ---------------------------------------------------------------------------
+
+
+@register_op("BatchNorm", num_outputs=5, num_visible_outputs=1)
+def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
+                momentum=0.9, fix_gamma=True, use_global_stats=False,
+                output_mean_var=False, axis=1, cudnn_off=False,
+                training=True):
+    """Returns (out, batch_mean, batch_var, new_moving_mean, new_moving_var).
+
+    The reference mutates aux states in the kernel
+    (src/operator/nn/batch_norm-inl.h); functionally we return the updated
+    moving stats and the caller rebinds them.
+    """
+    red = tuple(i for i in range(data.ndim) if i != axis % data.ndim)
+    bshape = [1] * data.ndim
+    bshape[axis % data.ndim] = data.shape[axis % data.ndim]
+    if fix_gamma:
+        gamma = jnp.ones_like(gamma)
+    if training and not use_global_stats:
+        mean = jnp.mean(data, axis=red)
+        var = jnp.var(data, axis=red)
+        new_mm = moving_mean * momentum + mean * (1 - momentum)
+        new_mv = moving_var * momentum + var * (1 - momentum)
+    else:
+        mean, var = moving_mean, moving_var
+        new_mm, new_mv = moving_mean, moving_var
+    inv = jax.lax.rsqrt(var.astype(jnp.float32) + eps).astype(data.dtype)
+    out = (data - mean.reshape(bshape).astype(data.dtype)) * \
+        inv.reshape(bshape) * gamma.reshape(bshape).astype(data.dtype) + \
+        beta.reshape(bshape).astype(data.dtype)
+    return out, mean, var, new_mm, new_mv
+
+
+@register_op("LayerNorm", num_outputs=3,
+             num_visible_outputs=lambda p: 3 if p.get("output_mean_var") else 1)
+def _layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
+    mean = jnp.mean(data, axis=axis, keepdims=True)
+    var = jnp.var(data, axis=axis, keepdims=True)
+    inv = jax.lax.rsqrt(var + eps)
+    bshape = [1] * data.ndim
+    bshape[axis % data.ndim] = data.shape[axis % data.ndim]
+    out = (data - mean) * inv * gamma.reshape(bshape) + beta.reshape(bshape)
+    return out, jnp.squeeze(mean, axis), jnp.squeeze(var, axis)
+
+
+@register_op("InstanceNorm")
+def _instance_norm(data, gamma, beta, eps=1e-3):
+    red = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=red, keepdims=True)
+    var = jnp.var(data, axis=red, keepdims=True)
+    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    return (data - mean) * jax.lax.rsqrt(var + eps) * \
+        gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register_op("LRN", num_outputs=2, num_visible_outputs=1)
+def _lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
+    # across-channel local response normalization (src/operator/nn/lrn.cc)
+    sq = jnp.square(data)
+    half = nsize // 2
+    padded = jnp.pad(sq, ((0, 0), (half, half)) + ((0, 0),) *
+                     (data.ndim - 2))
+    acc = jnp.zeros_like(data)
+    for i in range(nsize):
+        acc = acc + jax.lax.dynamic_slice_in_dim(padded, i, data.shape[1],
+                                                 axis=1)
+    norm = jnp.power(knorm + (alpha / nsize) * acc, -beta)
+    return data * norm, norm
+
+
+# ---------------------------------------------------------------------------
+# Dropout
+# ---------------------------------------------------------------------------
+
+
+@register_op("Dropout", num_outputs=2, needs_rng=True,
+             num_visible_outputs=1)
+def _dropout(rng, data, p=0.5, mode="training", axes=(), cudnn_off=False,
+             training=True):
+    if not training or mode == "always" and False:
+        pass
+    if (not training and mode != "always") or p == 0.0:
+        return data, jnp.ones_like(data)
+    shape = list(data.shape)
+    for a in axes or ():
+        shape[a] = 1
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(rng, keep, tuple(shape)).astype(data.dtype) \
+        / keep
+    return data * mask, jnp.broadcast_to(mask, data.shape)
+
+
+# ---------------------------------------------------------------------------
+# Resize / upsampling
+# ---------------------------------------------------------------------------
+
+
+@register_op("UpSampling")
+def _upsampling(*args, scale=1, sample_type="nearest", num_args=1,
+                num_filter=0, multi_input_mode="concat", workspace=512):
+    data = args[0]
+    if sample_type == "nearest":
+        outs = []
+        for d in args:
+            o = jnp.repeat(jnp.repeat(d, scale, axis=2), scale, axis=3)
+            outs.append(o)
+        if len(outs) == 1:
+            return outs[0]
+        if multi_input_mode == "sum":
+            out = outs[0]
+            for o in outs[1:]:
+                out = out + o
+            return out
+        return jnp.concatenate(outs, axis=1)
+    # bilinear: weight is args[1] in the reference; use jax.image.resize
+    n, c, h, w = data.shape
+    return jax.image.resize(data, (n, c, h * scale, w * scale), "bilinear")
+
+
+@register_op("_contrib_BilinearResize2D")
+def _bilinear_resize2d(data, height=0, width=0, scale_height=None,
+                       scale_width=None, mode="size"):
+    n, c, h, w = data.shape
+    if scale_height is not None:
+        height = int(h * scale_height)
+        width = int(w * scale_width)
+    return jax.image.resize(data, (n, c, int(height), int(width)),
+                            "bilinear")
+
+
+@register_op("_contrib_AdaptiveAvgPooling2D")
+def _adaptive_avg_pool(data, output_size=()):
+    if not output_size:
+        oh = ow = 1
+    elif isinstance(output_size, int):
+        oh = ow = output_size
+    elif len(output_size) == 1:
+        oh = ow = output_size[0]
+    else:
+        oh, ow = output_size
+    n, c, h, w = data.shape
+    # exact adaptive pooling: mean over variable windows; use resize-style
+    # integral approach for the common divisible case, else interpolate
+    if h % oh == 0 and w % ow == 0:
+        x = data.reshape(n, c, oh, h // oh, ow, w // ow)
+        return x.mean(axis=(3, 5))
+    return jax.image.resize(data, (n, c, oh, ow), "linear")
+
+
+@register_op("_contrib_div_sqrt_dim")
+def _div_sqrt_dim(data):
+    # reference: src/operator/contrib/transformer.cc:33
+    return data / jnp.sqrt(jnp.float32(data.shape[-1])).astype(data.dtype)
+
+
+@register_op("BlockGrad", aliases=("stop_gradient",))
+def _block_grad(x):
+    return jax.lax.stop_gradient(x)
+
+
+@register_op("make_loss")
+def _make_loss(x):
+    return x * 1.0
+
+
+@register_op("Custom")
+def _custom_unsupported(*args, **kwargs):
+    raise NotImplementedError(
+        "Custom ops are registered via mxnet_tpu.operator.register "
+        "(python bridge), not invoked through the registry")
+
+
+# ---------------------------------------------------------------------------
+# Losses as ops (reference keeps most losses in Gluon; ctc here)
+# ---------------------------------------------------------------------------
+
+
+@register_op("CTCLoss", aliases=("ctc_loss", "_contrib_CTCLoss",
+                                 "_contrib_ctc_loss"))
+def _ctc_loss(data, label, use_data_lengths=False, use_label_lengths=False,
+              blank_label="first", data_lengths=None, label_lengths=None):
+    """CTC loss. data: (seq, batch, alphabet) reference layout
+    (src/operator/nn/ctc_loss.cc); lowered to optax.ctc_loss (blank=0)."""
+    import optax
+    seq, batch, nalpha = data.shape
+    logits = jnp.transpose(data, (1, 0, 2))          # (B, T, A)
+    labels = label.astype(jnp.int32)
+    if blank_label == "first":
+        # optax uses blank=0 as well; labels in mxnet 'first' mode are
+        # 1-based already
+        pass
+    else:
+        # 'last': blank is alphabet-1; rotate so blank becomes 0
+        logits = jnp.concatenate([logits[..., -1:], logits[..., :-1]], -1)
+        labels = labels + 1
+    logit_paddings = jnp.zeros((batch, seq), jnp.float32)
+    lab_valid = (labels > 0).astype(jnp.float32)
+    label_paddings = 1.0 - lab_valid
+    loss = optax.ctc_loss(jax.nn.log_softmax(logits, -1), logit_paddings,
+                          labels, label_paddings)
+    return loss

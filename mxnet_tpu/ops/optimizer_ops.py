@@ -1,0 +1,200 @@
+"""Fused optimizer update ops.
+
+Reference: ``src/operator/optimizer_op.cc:43-651`` (sgd_update,
+sgd_mom_update, mp_sgd*, adam_update, rmsprop, ftrl, signsgd, signum, ftml,
+nag, adagrad).  Each op is one fused XLA computation; the eager dispatcher
+marks the weight/state inputs as donated (``Op.donate``) so the update reuses
+the parameter's HBM buffer — the TPU equivalent of the reference's in-place
+kernel writes.
+
+All ops return the updated tensors (weight first, then states); callers
+rebind their NDArrays to the outputs.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _rescale_clip(grad, rescale_grad, clip_gradient, wd=0.0, weight=None):
+    grad = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        grad = jnp.clip(grad, -clip_gradient, clip_gradient)
+    if wd and weight is not None:
+        grad = grad + wd * weight
+    return grad
+
+
+@register_op("sgd_update", donate=(0,))
+def _sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                clip_gradient=-1.0, lazy_update=True):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
+    return weight - lr * g
+
+
+@register_op("sgd_mom_update", num_outputs=2, donate=(0, 2))
+def _sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
+    mom = momentum * mom - lr * g
+    return weight + mom, mom
+
+
+@register_op("nag_mom_update", num_outputs=2, donate=(0, 2))
+def _nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
+    mom = momentum * mom + g
+    return weight - lr * (g + momentum * mom), mom
+
+
+@register_op("mp_sgd_update", num_outputs=2, donate=(0, 2))
+def _mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0, lazy_update=True):
+    # multi-precision: bf16/fp16 weight with fp32 master copy
+    # (reference mp_sgd_update, optimizer_op.cc:43+)
+    g = _rescale_clip(grad.astype(jnp.float32), rescale_grad, clip_gradient,
+                      wd, weight32)
+    w32 = weight32 - lr * g
+    return w32.astype(weight.dtype), w32
+
+
+@register_op("mp_sgd_mom_update", num_outputs=3, donate=(0, 2, 3))
+def _mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
+                       wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                       lazy_update=True):
+    g = _rescale_clip(grad.astype(jnp.float32), rescale_grad, clip_gradient,
+                      wd, weight32)
+    mom = momentum * mom - lr * g
+    w32 = weight32 + mom
+    return w32.astype(weight.dtype), mom, w32
+
+
+@register_op("adam_update", num_outputs=3, donate=(0, 2, 3))
+def _adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                 lazy_update=True):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
+    mean = beta1 * mean + (1 - beta1) * g
+    var = beta2 * var + (1 - beta2) * jnp.square(g)
+    w = weight - lr * mean / (jnp.sqrt(var) + epsilon)
+    return w, mean, var
+
+
+@register_op("rmsprop_update", num_outputs=2, donate=(0, 2))
+def _rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.95, epsilon=1e-8,
+                    wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                    clip_weights=-1.0):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
+    n = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    w = weight - lr * g / jnp.sqrt(n + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, n
+
+
+@register_op("rmspropalex_update", num_outputs=4, donate=(0, 2, 3, 4))
+def _rmspropalex_update(weight, grad, n, g_avg, delta, lr=0.001, gamma1=0.95,
+                        gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                        clip_gradient=-1.0, clip_weights=-1.0):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
+    n = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    g_avg = gamma1 * g_avg + (1 - gamma1) * g
+    delta = gamma2 * delta - lr * g / jnp.sqrt(n - jnp.square(g_avg) +
+                                               epsilon)
+    w = weight + delta
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, n, g_avg, delta
+
+
+@register_op("ftrl_update", num_outputs=3, donate=(0, 2, 3))
+def _ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
+                 rescale_grad=1.0, clip_gradient=-1.0):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient)
+    new_n = n + jnp.square(g)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    z = z + g - sigma * weight
+    w = jnp.where(
+        jnp.abs(z) <= lamda1, jnp.zeros_like(weight),
+        -(z - jnp.sign(z) * lamda1) /
+        ((beta + jnp.sqrt(new_n)) / lr + wd))
+    return w, z, new_n
+
+
+@register_op("ftml_update", num_outputs=4, donate=(0, 2, 3, 4))
+def _ftml_update(weight, grad, d, v, z, lr=0.001, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, t=1, wd=0.0, rescale_grad=1.0,
+                 clip_grad=-1.0):
+    g = _rescale_clip(grad, rescale_grad, clip_grad, wd, weight)
+    v = beta2 * v + (1 - beta2) * jnp.square(g)
+    d_t = (1 - beta1 ** t) / lr * \
+        (jnp.sqrt(v / (1 - beta2 ** t)) + epsilon)
+    sigma = d_t - beta1 * d
+    z = beta1 * z + (1 - beta1) * g - sigma * weight
+    w = -z / d_t
+    return w, d_t, v, z
+
+
+@register_op("signsgd_update", donate=(0,))
+def _signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                    clip_gradient=-1.0):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient)
+    return weight - lr * (jnp.sign(g) + wd * weight)
+
+
+@register_op("signum_update", num_outputs=2, donate=(0, 2))
+def _signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
+    mom = momentum * mom - (1 - momentum) * g
+    w = (1 - lr * wd_lh) * weight + lr * jnp.sign(mom)
+    return w, mom
+
+
+@register_op("_sparse_adagrad_update", num_outputs=2, donate=(0, 2),
+             aliases=("adagrad_update",))
+def _adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-7, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
+    history = history + jnp.square(g)
+    return weight - lr * g / (jnp.sqrt(history) + epsilon), history
+
+
+@register_op("adadelta_update", num_outputs=3, donate=(0, 2, 3))
+def _adadelta_update(weight, grad, acc_g, acc_delta, rho=0.9, epsilon=1e-5,
+                     wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
+    acc_g = rho * acc_g + (1 - rho) * jnp.square(g)
+    delta = jnp.sqrt(acc_delta + epsilon) / jnp.sqrt(acc_g + epsilon) * g
+    acc_delta = rho * acc_delta + (1 - rho) * jnp.square(delta)
+    return weight - delta, acc_g, acc_delta
+
+
+@register_op("adamax_update", num_outputs=3, donate=(0, 2, 3))
+def _adamax_update(weight, grad, mean, var, lr=0.002, beta1=0.9, beta2=0.999,
+                   epsilon=1e-8, t=1, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
+    mean = beta1 * mean + (1 - beta1) * g
+    var = jnp.maximum(beta2 * var, jnp.abs(g))
+    w = weight - (lr / (1 - beta1 ** t)) * mean / (var + epsilon)
+    return w, mean, var
+
+
+@register_op("nadam_update", num_outputs=3, donate=(0, 2, 3))
+def _nadam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
+                  epsilon=1e-8, t=1, schedule_decay=0.004, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=-1.0):
+    g = _rescale_clip(grad, rescale_grad, clip_gradient, wd, weight)
+    m_t = beta1 * (1 - 0.5 * 0.96 ** (t * schedule_decay))
+    m_t1 = beta1 * (1 - 0.5 * 0.96 ** ((t + 1) * schedule_decay))
+    mean = beta1 * mean + (1 - beta1) * g
+    var = beta2 * var + (1 - beta2) * jnp.square(g)
+    g_hat = g / (1 - m_t)                      # prod approximation per step
+    m_hat = mean / (1 - m_t1)
+    m_bar = (1 - m_t) * g_hat + m_t1 * m_hat
+    w = weight - lr * m_bar / (jnp.sqrt(var / (1 - beta2 ** t)) + epsilon)
+    return w, mean, var

@@ -1,0 +1,120 @@
+"""Random samplers (reference: src/operator/random/ — sample_op.cc etc.).
+
+Every sampler takes a functional PRNG key as its first argument (supplied by
+the runtime's key stream for eager calls, or an explicit key input for traced
+graphs) — the TPU-native equivalent of the reference's kParallelRandom
+resource (include/mxnet/resource.h:104).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+from ..base import np_dtype
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+@register_op("_random_uniform", needs_rng=True, aliases=("uniform",))
+def _uniform(rng, low=0.0, high=1.0, shape=(), dtype="float32"):
+    return jax.random.uniform(rng, _shape(shape), np_dtype(dtype), low, high)
+
+
+@register_op("_random_normal", needs_rng=True,
+             aliases=("normal", "_random_gaussian"))
+def _normal(rng, loc=0.0, scale=1.0, shape=(), dtype="float32"):
+    return loc + scale * jax.random.normal(rng, _shape(shape),
+                                           np_dtype(dtype))
+
+
+@register_op("_random_gamma", needs_rng=True, aliases=("gamma_sample",))
+def _gamma(rng, alpha=1.0, beta=1.0, shape=(), dtype="float32"):
+    return beta * jax.random.gamma(rng, alpha, _shape(shape), np_dtype(dtype))
+
+
+@register_op("_random_exponential", needs_rng=True)
+def _exponential(rng, lam=1.0, shape=(), dtype="float32"):
+    return jax.random.exponential(rng, _shape(shape), np_dtype(dtype)) / lam
+
+
+@register_op("_random_poisson", needs_rng=True)
+def _poisson(rng, lam=1.0, shape=(), dtype="float32"):
+    return jax.random.poisson(rng, lam, _shape(shape)).astype(np_dtype(dtype))
+
+
+@register_op("_random_negative_binomial", needs_rng=True)
+def _neg_binomial(rng, k=1, p=1.0, shape=(), dtype="float32"):
+    k1, k2 = jax.random.split(rng)
+    lam = jax.random.gamma(k1, k, _shape(shape)) * (1 - p) / p
+    return jax.random.poisson(k2, lam).astype(np_dtype(dtype))
+
+
+@register_op("_random_generalized_negative_binomial", needs_rng=True)
+def _gen_neg_binomial(rng, mu=1.0, alpha=1.0, shape=(), dtype="float32"):
+    k1, k2 = jax.random.split(rng)
+    r = 1.0 / alpha
+    p = r / (r + mu)
+    lam = jax.random.gamma(k1, r, _shape(shape)) * (1 - p) / p
+    return jax.random.poisson(k2, lam).astype(np_dtype(dtype))
+
+
+@register_op("_random_randint", needs_rng=True)
+def _randint(rng, low=0, high=1, shape=(), dtype="int32"):
+    return jax.random.randint(rng, _shape(shape), int(low), int(high),
+                              np_dtype(dtype))
+
+
+@register_op("_sample_uniform", needs_rng=True)
+def _sample_uniform(rng, low, high, shape=(), dtype="float32"):
+    s = _shape(shape)
+    u = jax.random.uniform(rng, low.shape + s, np_dtype(dtype))
+    return low.reshape(low.shape + (1,) * len(s)) + \
+        (high - low).reshape(low.shape + (1,) * len(s)) * u
+
+
+@register_op("_sample_normal", needs_rng=True)
+def _sample_normal(rng, mu, sigma, shape=(), dtype="float32"):
+    s = _shape(shape)
+    z = jax.random.normal(rng, mu.shape + s, np_dtype(dtype))
+    return mu.reshape(mu.shape + (1,) * len(s)) + \
+        sigma.reshape(sigma.shape + (1,) * len(s)) * z
+
+
+@register_op("_sample_gamma", needs_rng=True)
+def _sample_gamma(rng, alpha, beta, shape=(), dtype="float32"):
+    s = _shape(shape)
+    a = alpha.reshape(alpha.shape + (1,) * len(s))
+    g = jax.random.gamma(rng, jnp.broadcast_to(a, alpha.shape + s),
+                         dtype=np_dtype(dtype))
+    return g * beta.reshape(beta.shape + (1,) * len(s))
+
+
+@register_op("_sample_multinomial", needs_rng=True,
+             aliases=("sample_multinomial",))
+def _sample_multinomial(rng, data, shape=(), get_prob=False, dtype="int32"):
+    s = _shape(shape)
+    n = 1
+    for d in s:
+        n *= d
+    logits = jnp.log(jnp.maximum(data, 1e-38))
+    if data.ndim == 1:
+        out = jax.random.categorical(rng, logits, shape=(n,))
+        out = out.reshape(s) if s else out.reshape(())
+    else:
+        out = jax.random.categorical(rng, logits[:, None, :],
+                                     axis=-1, shape=(data.shape[0], n))
+        out = out.reshape((data.shape[0],) + s)
+    return out.astype(np_dtype(dtype))
+
+
+@register_op("_random_bernoulli", needs_rng=True)
+def _bernoulli(rng, p=0.5, shape=(), dtype="float32"):
+    return jax.random.bernoulli(rng, p, _shape(shape)).astype(np_dtype(dtype))

@@ -1,0 +1,75 @@
+"""Reduction operators (reference: src/operator/tensor/broadcast_reduce_op.h).
+
+mxnet reduction semantics: ``axis=None`` reduces all; ``exclude=True``
+reduces over every axis *not* listed; ``keepdims`` keeps reduced dims as 1.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register_op, alias
+
+
+def _axes(x, axis, exclude):
+    if axis is None:
+        ax = tuple(range(x.ndim))
+    elif isinstance(axis, int):
+        ax = (axis,)
+    else:
+        ax = tuple(axis)
+    ax = tuple(a % x.ndim for a in ax)
+    if exclude:
+        ax = tuple(a for a in range(x.ndim) if a not in ax)
+    return ax
+
+
+def _make(jfn, name, **extra):
+    def f(x, axis=None, keepdims=False, exclude=False, **kw):
+        return jfn(x, axis=_axes(x, axis, exclude), keepdims=keepdims)
+    f.__name__ = name
+    register_op(name)(f)
+    return f
+
+
+_make(jnp.sum, "sum")
+alias("sum_axis", "sum")
+_make(jnp.mean, "mean")
+alias("mean_axis", "mean")
+_make(jnp.prod, "prod")
+_make(jnp.max, "max")
+alias("max_axis", "max")
+_make(jnp.min, "min")
+alias("min_axis", "min")
+
+
+@register_op("nansum")
+def _nansum(x, axis=None, keepdims=False, exclude=False):
+    return jnp.nansum(x, axis=_axes(x, axis, exclude), keepdims=keepdims)
+
+
+@register_op("nanprod")
+def _nanprod(x, axis=None, keepdims=False, exclude=False):
+    return jnp.nanprod(x, axis=_axes(x, axis, exclude), keepdims=keepdims)
+
+
+@register_op("logsumexp")
+def _logsumexp(x, axis=None, keepdims=False, exclude=False):
+    import jax
+    return jax.scipy.special.logsumexp(x, axis=_axes(x, axis, exclude),
+                                       keepdims=keepdims)
+
+
+@register_op("L2Normalization")
+def _l2_normalization(x, eps=1e-10, mode="instance"):
+    # reference: src/operator/l2_normalization-inl.h
+    if mode == "instance":
+        axes = tuple(range(1, x.ndim))
+    elif mode == "channel":
+        axes = (1,)
+    elif mode == "spatial":
+        axes = tuple(range(2, x.ndim))
+    else:
+        raise ValueError(mode)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=True) + eps)
+    return x / norm

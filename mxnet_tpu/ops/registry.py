@@ -1,0 +1,181 @@
+"""Operator registry and eager dispatch.
+
+TPU-native replacement for the reference's NNVM op registry + dependency
+engine (reference: ``include/mxnet/op_attr_types.h:115-281`` attrs,
+``src/imperative/imperative.cc:38-112`` Invoke/InvokeOp,
+``src/engine/threaded_engine_perdevice.cc`` worker queues).
+
+Design: an op is a *pure JAX function* ``fn(*arrays, **params)``.  Instead of
+pushing kernels to a hand-written scheduler, eager invocation compiles the op
+once per (param-set, input-aval) signature with ``jax.jit`` and reuses the
+executable — XLA's async dispatch replaces the threaded engine; dependency
+ordering comes for free from data flow; ``NDArray.asnumpy()`` is the sync
+point (the reference's ``WaitToRead``).
+
+Gradients are not registered per-op (the reference's ``FGradient``): autograd
+obtains per-op VJPs from ``jax.vjp`` of the same pure function, and the graph
+executor differentiates the whole fused program.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import numpy as _np
+
+__all__ = ["Op", "register_op", "get_op", "list_ops", "invoke", "alias"]
+
+_OPS: dict[str, "Op"] = {}
+
+
+class Op:
+    """A registered operator.
+
+    Attributes
+    ----------
+    name : canonical op name (e.g. ``dot``, ``Convolution``).
+    fn : pure function ``fn(*jax_arrays, **params) -> array | tuple``.
+    num_outputs : int or ``f(params) -> int``.
+    needs_rng : if True, ``fn``'s first positional arg is a PRNG key supplied
+        by the runtime (eager: ambient generator; executor: per-run key).
+    donate : tuple of input indices whose buffers may be donated to outputs
+        (optimizer update ops — gives true in-place HBM reuse under jit).
+    """
+
+    __slots__ = ("name", "fn", "num_outputs", "needs_rng", "donate", "doc",
+                 "input_names", "num_visible_outputs", "param_names")
+
+    def __init__(self, name, fn, num_outputs=1, needs_rng=False, donate=(),
+                 doc=None, input_names=None, num_visible_outputs=None):
+        self.name = name
+        self.fn = fn
+        self.num_outputs = num_outputs
+        self.needs_rng = needs_rng
+        self.donate = tuple(donate)
+        self.doc = doc or fn.__doc__
+        if input_names is None:
+            input_names = _infer_input_names(fn, needs_rng)
+        self.input_names = tuple(input_names)
+        self.num_visible_outputs = num_visible_outputs
+        self.param_names = _infer_param_names(fn)
+
+    def n_out(self, params):
+        if callable(self.num_outputs):
+            return self.num_outputs(params)
+        return self.num_outputs
+
+    def n_visible(self, params):
+        """Outputs surfaced to the user (the reference hides e.g. Dropout's
+        mask and BatchNorm's saved stats unless requested)."""
+        if self.num_visible_outputs is None:
+            return self.n_out(params)
+        if callable(self.num_visible_outputs):
+            return self.num_visible_outputs(params)
+        return self.num_visible_outputs
+
+    def __repr__(self):
+        return "Op(%s)" % self.name
+
+
+def _infer_input_names(fn, needs_rng):
+    """Array-input names from the fn signature: positional params without
+    defaults are inputs (the rng key, if any, is skipped)."""
+    import inspect
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return ()
+    names = []
+    for p in sig.parameters.values():
+        if p.kind in (inspect.Parameter.POSITIONAL_ONLY,
+                      inspect.Parameter.POSITIONAL_OR_KEYWORD) \
+                and p.default is inspect.Parameter.empty:
+            names.append(p.name)
+        elif p.kind == inspect.Parameter.VAR_POSITIONAL:
+            break
+    if needs_rng and names:
+        names = names[1:]
+    return tuple(names)
+
+
+def _infer_param_names(fn):
+    """Op parameter names in signature order (params have defaults)."""
+    import inspect
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return ()
+    return tuple(p.name for p in sig.parameters.values()
+                 if p.default is not inspect.Parameter.empty)
+
+
+def register_op(name, num_outputs=1, needs_rng=False, donate=(), aliases=(),
+                input_names=None, num_visible_outputs=None):
+    """Decorator registering a pure JAX function as an operator."""
+    def _reg(fn):
+        op = Op(name, fn, num_outputs, needs_rng, donate,
+                input_names=input_names,
+                num_visible_outputs=num_visible_outputs)
+        if name in _OPS:
+            raise ValueError("op %r registered twice" % name)
+        _OPS[name] = op
+        for a in aliases:
+            _OPS[a] = op
+        return fn
+    return _reg
+
+
+def alias(name, target):
+    _OPS[name] = _OPS[target]
+
+
+def get_op(name):
+    try:
+        return _OPS[name]
+    except KeyError:
+        raise KeyError("operator %r is not registered" % (name,))
+
+
+def list_ops():
+    return sorted(_OPS)
+
+
+def _freeze(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(_freeze(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+    if isinstance(v, _np.ndarray):
+        return ("__nparr__", v.dtype.str, v.shape, v.tobytes())
+    return v
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled(name, frozen_params, donate):
+    op = _OPS[name]
+    params = {k: v for k, v in frozen_params}
+    fn = functools.partial(op.fn, **params) if params else op.fn
+    return jax.jit(fn, donate_argnums=donate)
+
+
+def invoke(op, args, params, rng=None):
+    """Eagerly invoke *op* on raw jax arrays, via the per-signature
+    executable cache.  Returns a tuple of jax arrays."""
+    if isinstance(op, str):
+        op = get_op(op)
+    frozen = tuple(sorted((k, _freeze(v)) for k, v in params.items()
+                          if v is not None))
+    donate = tuple(i + 1 for i in op.donate) if (op.needs_rng and op.donate) \
+        else op.donate
+    fn = _compiled(op.name, frozen, donate)
+    if op.needs_rng:
+        if rng is None:
+            from ..runtime import rng as _rng
+            rng = _rng.next_key()
+        out = fn(rng, *args)
+    else:
+        out = fn(*args)
+    if not isinstance(out, tuple):
+        out = (out,)
+    return out

@@ -1,0 +1,605 @@
+"""Shape-manipulation, indexing, init, ordering and linalg ops.
+
+Covers the reference's ``src/operator/tensor/matrix_op*.cc`` (reshape,
+transpose, slice, concat, ...), ``indexing_op.h`` (take, embedding,
+gather_nd, one_hot), ``init_op.h`` (zeros/ones/arange), ``ordering_op``
+(topk/sort/argsort), ``dot-inl.h`` and ``la_op.cc``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from .registry import register_op, alias
+from ..base import np_dtype
+from ._precision import matmul_precision
+
+# ---------------------------------------------------------------------------
+# init ops (no array inputs; shape/ctx/dtype come as params)
+# ---------------------------------------------------------------------------
+
+
+@register_op("_zeros")
+def _zeros(shape=(), dtype="float32"):
+    return jnp.zeros(shape, np_dtype(dtype))
+
+
+@register_op("_ones")
+def _ones(shape=(), dtype="float32"):
+    return jnp.ones(shape, np_dtype(dtype))
+
+
+@register_op("_full")
+def _full(shape=(), dtype="float32", value=0.0):
+    return jnp.full(shape, value, np_dtype(dtype))
+
+
+@register_op("_arange")
+def _arange(start=0.0, stop=None, step=1.0, repeat=1, dtype="float32"):
+    out = jnp.arange(start, stop, step, np_dtype(dtype))
+    if repeat != 1:
+        out = jnp.repeat(out, repeat)
+    return out
+
+
+@register_op("_eye")
+def _eye(N=0, M=0, k=0, dtype="float32"):
+    return jnp.eye(int(N), int(M) or None, k=int(k), dtype=np_dtype(dtype))
+
+
+@register_op("_linspace")
+def _linspace(start=0.0, stop=1.0, num=50, endpoint=True, dtype="float32"):
+    return jnp.linspace(start, stop, int(num), endpoint=endpoint,
+                        dtype=np_dtype(dtype))
+
+
+# ---------------------------------------------------------------------------
+# shape manipulation
+# ---------------------------------------------------------------------------
+
+
+def _infer_reshape(src_shape, spec, reverse=False):
+    """Implements the reference's Reshape spec codes 0/-1/-2/-3/-4
+    (src/operator/tensor/matrix_op-inl.h InferReshapeShape)."""
+    spec = list(spec)
+    src = list(src_shape)
+    if reverse:
+        spec = spec[::-1]
+        src = src[::-1]
+    out = []
+    i = 0  # position in src
+    j = 0
+    while j < len(spec):
+        s = spec[j]
+        if s == 0:        # copy this dim
+            out.append(src[i]); i += 1
+        elif s == -1:     # infer
+            out.append(-1); i += 1
+        elif s == -2:     # copy all remaining
+            out.extend(src[i:]); i = len(src)
+        elif s == -3:     # merge two dims
+            out.append(src[i] * src[i + 1]); i += 2
+        elif s == -4:     # split dim into next two spec entries
+            d1, d2 = spec[j + 1], spec[j + 2]
+            if d1 == -1:
+                d1 = src[i] // d2
+            if d2 == -1:
+                d2 = src[i] // d1
+            out.extend([d1, d2]); i += 1; j += 2
+        else:
+            out.append(int(s))
+            if i < len(src):
+                i += 1
+        j += 1
+    if -1 in out:
+        known = 1
+        for d in out:
+            if d != -1:
+                known *= d
+        total = 1
+        for d in src_shape:
+            total *= d
+        out[out.index(-1)] = total // known
+    if reverse:
+        out = out[::-1]
+    return tuple(out)
+
+
+@register_op("Reshape", aliases=("reshape",))
+def _reshape(x, shape=(), reverse=False):
+    return jnp.reshape(x, _infer_reshape(x.shape, shape, reverse))
+
+
+@register_op("reshape_like")
+def _reshape_like(x, y):
+    return jnp.reshape(x, y.shape)
+
+
+@register_op("Flatten", aliases=("flatten",))
+def _flatten(x):
+    return jnp.reshape(x, (x.shape[0], -1))
+
+
+@register_op("transpose")
+def _transpose(x, axes=None):
+    if axes is not None and len(axes) == 0:
+        axes = None
+    return jnp.transpose(x, axes)
+
+
+@register_op("SwapAxis", aliases=("swapaxes",))
+def _swapaxes(x, dim1=0, dim2=0):
+    return jnp.swapaxes(x, dim1, dim2)
+
+
+@register_op("expand_dims")
+def _expand_dims(x, axis=0):
+    return jnp.expand_dims(x, axis)
+
+
+@register_op("squeeze")
+def _squeeze(x, axis=None):
+    return jnp.squeeze(x, axis)
+
+
+@register_op("broadcast_to")
+def _broadcast_to(x, shape=()):
+    tgt = tuple(s if t == 0 else t for s, t in zip(x.shape, shape))
+    return jnp.broadcast_to(x, tgt)
+
+
+@register_op("broadcast_like")
+def _broadcast_like(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+@register_op("broadcast_axis", aliases=("broadcast_axes",))
+def _broadcast_axis(x, axis=(), size=()):
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    sizes = (size,) if isinstance(size, int) else tuple(size)
+    tgt = list(x.shape)
+    for a, s in zip(axes, sizes):
+        tgt[a] = s
+    return jnp.broadcast_to(x, tuple(tgt))
+
+
+@register_op("Concat", aliases=("concat",))
+def _concat(*args, dim=1, num_args=None):
+    return jnp.concatenate(args, axis=dim)
+
+
+@register_op("stack")
+def _stack(*args, axis=0, num_args=None):
+    return jnp.stack(args, axis=axis)
+
+
+def _split_nout(params):
+    return int(params.get("num_outputs", 1))
+
+
+@register_op("SliceChannel", num_outputs=_split_nout, aliases=("split",))
+def _split(x, num_outputs=1, axis=1, squeeze_axis=False):
+    parts = jnp.split(x, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+@register_op("slice")
+def _slice(x, begin=(), end=(), step=()):
+    idx = []
+    step = step or (None,) * len(begin)
+    for b, e, s in zip(begin, end, step):
+        idx.append(builtins_slice(b, e, s))
+    return x[tuple(idx)]
+
+
+def builtins_slice(b, e, s):
+    return slice(b, e, s)
+
+
+@register_op("slice_axis")
+def _slice_axis(x, axis=0, begin=0, end=None):
+    idx = [slice(None)] * x.ndim
+    idx[axis] = slice(begin, end)
+    return x[tuple(idx)]
+
+
+@register_op("slice_like")
+def _slice_like(x, y, axes=()):
+    idx = [slice(None)] * x.ndim
+    axes = axes or range(x.ndim)
+    for a in axes:
+        idx[a] = slice(0, y.shape[a])
+    return x[tuple(idx)]
+
+
+@register_op("tile")
+def _tile(x, reps=()):
+    return jnp.tile(x, reps)
+
+
+@register_op("repeat")
+def _repeat(x, repeats=1, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@register_op("Pad", aliases=("pad",))
+def _pad(x, mode="constant", pad_width=(), constant_value=0.0):
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(x.ndim)]
+    if mode == "constant":
+        return jnp.pad(x, pw, constant_values=constant_value)
+    if mode == "edge":
+        return jnp.pad(x, pw, mode="edge")
+    if mode == "reflect":
+        return jnp.pad(x, pw, mode="reflect")
+    raise ValueError("unknown pad mode %r" % mode)
+
+
+@register_op("reverse", aliases=("flip",))
+def _reverse(x, axis=()):
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    return jnp.flip(x, axes)
+
+
+@register_op("space_to_depth")
+def _space_to_depth(x, block_size=1):
+    n, c, h, w = x.shape
+    b = block_size
+    x = x.reshape(n, c, h // b, b, w // b, b)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(n, c * b * b, h // b, w // b)
+
+
+@register_op("depth_to_space")
+def _depth_to_space(x, block_size=1):
+    n, c, h, w = x.shape
+    b = block_size
+    x = x.reshape(n, b, b, c // (b * b), h, w)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(n, c // (b * b), h * b, w * b)
+
+
+# ---------------------------------------------------------------------------
+# indexing
+# ---------------------------------------------------------------------------
+
+
+@register_op("take")
+def _take(a, indices, axis=0, mode="clip"):
+    return jnp.take(a, indices.astype(jnp.int32), axis=axis, mode=mode)
+
+
+@register_op("batch_take")
+def _batch_take(a, indices):
+    flat = a.reshape(-1)
+    offs = jnp.arange(a.shape[0]) * a.shape[1]
+    return flat[indices.astype(jnp.int32) + offs]
+
+
+@register_op("Embedding")
+def _embedding(data, weight, input_dim=0, output_dim=0, dtype="float32",
+               sparse_grad=False):
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+@register_op("one_hot")
+def _one_hot(indices, depth=0, on_value=1.0, off_value=0.0, dtype="float32"):
+    return jax.nn.one_hot(indices.astype(jnp.int32), int(depth),
+                          dtype=np_dtype(dtype)) * (on_value - off_value) \
+        + off_value
+
+
+@register_op("gather_nd")
+def _gather_nd(data, indices):
+    idx = tuple(indices.astype(jnp.int32))
+    return data[idx]
+
+
+@register_op("scatter_nd")
+def _scatter_nd(data, indices, shape=()):
+    out = jnp.zeros(shape, data.dtype)
+    idx = tuple(indices.astype(jnp.int32))
+    return out.at[idx].set(data)
+
+
+@register_op("_scatter_set_nd")
+def _scatter_set_nd(lhs, rhs, indices, shape=()):
+    idx = tuple(indices.astype(jnp.int32))
+    return lhs.at[idx].set(rhs)
+
+
+@register_op("where")
+def _where(cond, x, y):
+    return jnp.where(cond != 0, x, y)
+
+
+@register_op("SequenceMask", input_names=("data", "sequence_length"))
+def _sequence_mask(data, *rest, use_sequence_length=False, value=0.0, axis=0):
+    # data layout: (seq, batch, ...) when axis==0 (reference:
+    # src/operator/sequence_mask-inl.h)
+    if not use_sequence_length or not rest:
+        return data
+    seq_len = rest[0]
+    steps = jnp.arange(data.shape[axis])
+    bshape = [1] * data.ndim
+    bshape[axis] = data.shape[axis]
+    steps = steps.reshape(bshape)
+    lshape = [1] * data.ndim
+    lshape[1 - axis] = data.shape[1 - axis]
+    mask = steps < seq_len.reshape(lshape)
+    return jnp.where(mask, data, value)
+
+
+@register_op("SequenceLast", input_names=("data", "sequence_length"))
+def _sequence_last(data, *rest, use_sequence_length=False, axis=0):
+    if not use_sequence_length or not rest:
+        idx = data.shape[axis] - 1
+        return jnp.take(data, idx, axis=axis)
+    seq_len = rest[0].astype(jnp.int32)
+    idx = seq_len - 1
+    data_m = jnp.moveaxis(data, axis, 0)
+    batch = jnp.arange(data_m.shape[1])
+    return data_m[idx, batch]
+
+
+@register_op("SequenceReverse", input_names=("data", "sequence_length"))
+def _sequence_reverse(data, *rest, use_sequence_length=False, axis=0):
+    if not use_sequence_length or not rest:
+        return jnp.flip(data, axis)
+    seq_len = rest[0].astype(jnp.int32)
+    T = data.shape[axis]
+    data_m = jnp.moveaxis(data, axis, 0)
+    steps = jnp.arange(T)[:, None]
+    rev_idx = jnp.where(steps < seq_len[None, :], seq_len[None, :] - 1 - steps,
+                        steps)
+    batch = jnp.arange(data_m.shape[1])[None, :]
+    out = data_m[rev_idx, batch]
+    return jnp.moveaxis(out, 0, axis)
+
+
+# ---------------------------------------------------------------------------
+# ordering (reference: src/operator/tensor/ordering_op-inl.h)
+# ---------------------------------------------------------------------------
+
+
+@register_op("sort")
+def _sort(x, axis=-1, is_ascend=True):
+    out = jnp.sort(x, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out
+
+
+@register_op("argsort")
+def _argsort(x, axis=-1, is_ascend=True, dtype="float32"):
+    out = jnp.argsort(x, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out.astype(np_dtype(dtype))
+
+
+def _topk_nout(params):
+    ret = params.get("ret_typ", "indices")
+    return 2 if ret == "both" else 1
+
+
+@register_op("topk", num_outputs=_topk_nout)
+def _topk(x, axis=-1, k=1, ret_typ="indices", is_ascend=False,
+          dtype="float32"):
+    k = int(k)
+    if k <= 0:
+        k = x.shape[axis]
+    xm = jnp.moveaxis(x, axis, -1)
+    vals, idx = jax.lax.top_k(jnp.negative(xm) if is_ascend else xm, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis).astype(np_dtype(dtype))
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "indices":
+        return idx
+    if ret_typ == "both":
+        return vals, idx
+    if ret_typ == "mask":
+        mask = jnp.zeros(jnp.moveaxis(x, axis, -1).shape, x.dtype)
+        mask = mask.at[..., :].set(0)
+        onehot = jax.nn.one_hot(jnp.moveaxis(idx, axis, -1).astype(jnp.int32),
+                                x.shape[axis], dtype=x.dtype).sum(-2)
+        return jnp.moveaxis(onehot, -1, axis)
+    raise ValueError(ret_typ)
+
+
+@register_op("argmax")
+def _argmax(x, axis=None, keepdims=False):
+    return jnp.argmax(x, axis=axis, keepdims=keepdims).astype(jnp.float32)
+
+
+@register_op("argmin")
+def _argmin(x, axis=None, keepdims=False):
+    return jnp.argmin(x, axis=axis, keepdims=keepdims).astype(jnp.float32)
+
+
+@register_op("argmax_channel")
+def _argmax_channel(x):
+    return jnp.argmax(x, axis=1).astype(jnp.float32)
+
+
+@register_op("shuffle", needs_rng=True, aliases=("_shuffle",))
+def _shuffle(rng, x):
+    return jax.random.permutation(rng, x, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# dot / linalg (reference: dot-inl.h, la_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@register_op("dot")
+def _dot(a, b, transpose_a=False, transpose_b=False):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+    prec = matmul_precision(a.dtype, b.dtype)
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b, precision=prec)
+    # mxnet dot contracts last axis of a with first axis of b
+    return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]), precision=prec)
+
+
+@register_op("batch_dot")
+def _batch_dot(a, b, transpose_a=False, transpose_b=False):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b, precision=matmul_precision(a.dtype, b.dtype))
+
+
+@register_op("khatri_rao")
+def _khatri_rao(*mats):
+    out = mats[0]
+    for m in mats[1:]:
+        out = jnp.einsum("i...,j...->ij...", out, m).reshape(
+            out.shape[0] * m.shape[0], *out.shape[1:])
+    return out
+
+
+@register_op("_linalg_gemm2", aliases=("linalg_gemm2",))
+def _linalg_gemm2(a, b, transpose_a=False, transpose_b=False, alpha=1.0,
+                  axis=-2):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return alpha * jnp.matmul(a, b,
+                              precision=matmul_precision(a.dtype, b.dtype))
+
+
+@register_op("_linalg_gemm", aliases=("linalg_gemm",))
+def _linalg_gemm(a, b, c, transpose_a=False, transpose_b=False, alpha=1.0,
+                 beta=1.0, axis=-2):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return alpha * jnp.matmul(a, b,
+                              precision=matmul_precision(a.dtype, b.dtype)) + beta * c
+
+
+@register_op("_linalg_potrf", aliases=("linalg_potrf",))
+def _linalg_potrf(a):
+    return jnp.linalg.cholesky(a)
+
+
+@register_op("_linalg_potri", aliases=("linalg_potri",))
+def _linalg_potri(a):
+    l_inv = jax.scipy.linalg.solve_triangular(
+        a, jnp.broadcast_to(jnp.eye(a.shape[-1], dtype=a.dtype), a.shape),
+        lower=True)
+    return jnp.matmul(jnp.swapaxes(l_inv, -1, -2), l_inv)
+
+
+@register_op("_linalg_trmm", aliases=("linalg_trmm",))
+def _linalg_trmm(a, b, transpose=False, rightside=False, lower=True,
+                 alpha=1.0):
+    t = jnp.swapaxes(a, -1, -2) if transpose else a
+    out = jnp.matmul(b, t) if rightside else jnp.matmul(t, b)
+    return alpha * out
+
+
+@register_op("_linalg_trsm", aliases=("linalg_trsm",))
+def _linalg_trsm(a, b, transpose=False, rightside=False, lower=True,
+                 alpha=1.0):
+    if rightside:
+        # solve X A = alpha B  <=>  A^T X^T = alpha B^T
+        sol = jax.scipy.linalg.solve_triangular(
+            jnp.swapaxes(a, -1, -2), jnp.swapaxes(alpha * b, -1, -2),
+            lower=not lower, trans=1 if transpose else 0)
+        return jnp.swapaxes(sol, -1, -2)
+    return jax.scipy.linalg.solve_triangular(
+        a, alpha * b, lower=lower, trans=1 if transpose else 0)
+
+
+@register_op("_linalg_syrk", aliases=("linalg_syrk",))
+def _linalg_syrk(a, transpose=False, alpha=1.0):
+    at = jnp.swapaxes(a, -1, -2)
+    if transpose:
+        return alpha * jnp.matmul(at, a)
+    return alpha * jnp.matmul(a, at)
+
+
+@register_op("_linalg_sumlogdiag", aliases=("linalg_sumlogdiag",))
+def _linalg_sumlogdiag(a):
+    return jnp.sum(jnp.log(jnp.diagonal(a, axis1=-2, axis2=-1)), axis=-1)
+
+
+@register_op("_linalg_syevd", num_outputs=2, aliases=("linalg_syevd",))
+def _linalg_syevd(a):
+    w, v = jnp.linalg.eigh(a)
+    return jnp.swapaxes(v, -1, -2), w
+
+
+@register_op("_linalg_gelqf", num_outputs=2, aliases=("linalg_gelqf",))
+def _linalg_gelqf(a):
+    q, r = jnp.linalg.qr(jnp.swapaxes(a, -1, -2))
+    return jnp.swapaxes(q, -1, -2), jnp.swapaxes(r, -1, -2)
+
+
+@register_op("diag")
+def _diag(x, k=0, axis1=0, axis2=1):
+    if x.ndim == 1:
+        return jnp.diag(x, k)
+    return jnp.diagonal(x, offset=k, axis1=axis1, axis2=axis2)
+
+
+@register_op("norm")
+def _norm(x, ord=2, axis=None, keepdims=False):
+    if axis is None:
+        v = jnp.sqrt(jnp.sum(jnp.square(x))) if ord == 2 \
+            else jnp.sum(jnp.abs(x))
+        return v.reshape((1,) * 0 + ()) if not keepdims else v.reshape(
+            (1,) * x.ndim)
+    axis = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    if ord == 1:
+        return jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdims)
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdims))
+
+
+@register_op("ravel_multi_index", aliases=("_ravel_multi_index",))
+def _ravel_multi_index(data, shape=()):
+    idx = data.astype(jnp.int32)
+    out = jnp.zeros(data.shape[1:], jnp.int32)
+    for i, s in enumerate(shape):
+        out = out * s + idx[i]
+    return out.astype(jnp.float32)
+
+
+@register_op("unravel_index", aliases=("_unravel_index",))
+def _unravel_index(data, shape=()):
+    idx = data.astype(jnp.int32)
+    outs = []
+    for s in reversed(shape):
+        outs.append(idx % s)
+        idx = idx // s
+    return jnp.stack(outs[::-1], axis=0).astype(jnp.float32)
+
+
+@register_op("histogram", num_outputs=2, aliases=("_histogram",))
+def _histogram(data, bin_cnt=10, range=None):
+    if range is not None:
+        lo, hi = range
+        edges = jnp.linspace(lo, hi, int(bin_cnt) + 1)
+    else:
+        edges = jnp.linspace(data.min(), data.max(), int(bin_cnt) + 1)
+    idx = jnp.clip(jnp.searchsorted(edges, data.reshape(-1), side="right") - 1,
+                   0, int(bin_cnt) - 1)
+    in_range = ((data.reshape(-1) >= edges[0]) &
+                (data.reshape(-1) <= edges[-1]))
+    hist = jnp.zeros((int(bin_cnt),), jnp.float32).at[idx].add(
+        in_range.astype(jnp.float32))
+    return hist, edges.astype(jnp.float32)

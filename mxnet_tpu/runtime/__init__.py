@@ -1,0 +1,11 @@
+"""Runtime services: PRNG key stream, feature flags, engine shims.
+
+The reference's per-device resource manager (``src/resource.cc``) hands ops
+temp space and parallel PRNG states; on TPU the PRNG is functional, so the
+"resource" becomes a key-splitting stream (``rng.py``).  The dependency
+engine's user-facing control surface (``WaitForAll``, naive/bulk toggles,
+``src/engine/engine.cc``) is shimmed in ``engine.py`` on top of JAX's async
+dispatch.
+"""
+
+from . import rng, engine  # noqa: F401

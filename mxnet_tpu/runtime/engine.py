@@ -1,0 +1,47 @@
+"""Engine control surface.
+
+The reference exposes a handful of engine controls to Python
+(``MXNDArrayWaitAll``, ``MXEngineSetBulkSize``, engine type selection via
+``MXNET_ENGINE_TYPE`` — ``src/engine/engine.cc:32-48``).  On TPU the
+scheduler *is* XLA+PJRT async dispatch, so these become thin shims with the
+same observable semantics: ``wait_all`` blocks until every outstanding device
+computation is done; ``naive_mode`` forces synchronous execution after every
+op (the debugging escape hatch the NaiveEngine provides in the reference).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+_naive = False
+
+
+def wait_all():
+    """Block until all async device work has completed
+    (reference: Engine::WaitForAll / MXNDArrayWaitAll)."""
+    try:
+        jax.effects_barrier()
+    except Exception:
+        jax.block_until_ready(jax.numpy.zeros(()))
+
+
+def is_naive():
+    return _naive
+
+
+def set_naive(flag):
+    """Enable synchronous (NaiveEngine-style) execution for debugging."""
+    global _naive
+    _naive = bool(flag)
+
+
+@contextlib.contextmanager
+def naive_mode():
+    prev = _naive
+    set_naive(True)
+    try:
+        yield
+    finally:
+        set_naive(prev)

@@ -1,0 +1,41 @@
+"""Global PRNG key stream.
+
+Replaces the reference's per-device random resources
+(``include/mxnet/resource.h:104`` kParallelRandom, ``mx.random.seed``):
+a process-global key that is split once per random op invocation.  Eager
+random ops draw from this stream; traced programs (executor / hybridized
+blocks) receive an explicit key input instead, so compiled graphs stay pure.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+
+_state = threading.local()
+_DEFAULT_SEED = 0
+
+
+def _ensure():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.PRNGKey(_DEFAULT_SEED)
+
+
+def seed(seed_value):
+    """Seed the global generator (reference: mx.random.seed)."""
+    _state.key = jax.random.PRNGKey(int(seed_value))
+
+
+def next_key():
+    """Split one fresh key off the global stream."""
+    _ensure()
+    _state.key, sub = jax.random.split(_state.key)
+    return sub
+
+
+def next_keys(n):
+    _ensure()
+    keys = jax.random.split(_state.key, n + 1)
+    _state.key = keys[0]
+    return keys[1:]

@@ -1,0 +1,27 @@
+"""Test config: run on a virtual 8-device CPU mesh.
+
+Mirrors the reference's localhost multi-process distributed testing
+(SURVEY.md §4.4) — multi-chip sharding semantics are validated on
+XLA's host-platform device partitioning, no TPU pod required.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_rng():
+    """Reproducible per-test seeding (reference:
+    tests/python/unittest/common.py with_seed)."""
+    import mxnet_tpu as mx
+    mx.random.seed(0)
+    np.random.seed(0)
+    yield

@@ -1,0 +1,175 @@
+"""Autograd tape (reference: tests/python/unittest/test_autograd.py)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def test_simple_grad():
+    x = nd.array([[1., 2.], [3., 4.]])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy())
+
+
+def test_reuse_accumulates_within_pass():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [12.0])
+
+
+def test_grad_req_add():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = (2 * x).sum()
+        y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [6.0, 6.0])
+
+
+def test_grad_req_write_overwrites_across_passes():
+    x = nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = 3 * x
+    y.backward()
+    with autograd.record():
+        y = 5 * x
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [5.0])
+
+
+def test_head_grads():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+    y.backward(nd.array([10.0, 100.0]))
+    np.testing.assert_allclose(x.grad.asnumpy(), [20.0, 200.0])
+
+
+def test_multiple_heads():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y1 = x * 2
+        y2 = x * x
+    autograd.backward([y1, y2])
+    np.testing.assert_allclose(x.grad.asnumpy(), [2.0 + 6.0])
+
+
+def test_recording_state():
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+        assert autograd.is_recording()
+    with autograd.record(train_mode=False):
+        assert not autograd.is_training()
+
+
+def test_detach_blocks_grad():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+        z = y.detach() * x
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [6.0])  # only via x in z
+
+
+def test_stop_gradient_op():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.BlockGrad(x * 3) * x
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [6.0])
+
+
+def test_functional_grad():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 3).sum()
+    g = autograd.grad(y, x)
+    np.testing.assert_allclose(g.asnumpy(), 3 * x.asnumpy() ** 2,
+                               rtol=1e-5)
+    # x.grad untouched by functional grad
+    np.testing.assert_allclose(x.grad.asnumpy(), np.zeros(3))
+
+
+def test_grad_interior():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        u = x * x
+        y = (u * 5).sum()
+    gu = autograd.grad(y, u)
+    np.testing.assert_allclose(gu.asnumpy(), [5.0])
+
+
+def test_through_ops():
+    x = nd.random.normal(shape=(3, 4))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), np.exp(x.asnumpy()),
+                               rtol=1e-5)
+
+
+def test_softmax_output_ce_grad():
+    # SoftmaxOutput backward = softmax - onehot (reference semantics)
+    data = nd.array(np.random.randn(4, 5).astype(np.float32))
+    label = nd.array([0, 1, 2, 3], dtype="float32")
+    data.attach_grad()
+    with autograd.record():
+        out = nd.SoftmaxOutput(data, label)
+    out.backward()
+    sm = out.asnumpy()
+    oh = np.eye(5, dtype=np.float32)[[0, 1, 2, 3]]
+    np.testing.assert_allclose(data.grad.asnumpy(), sm - oh, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = nd.sigmoid(x)
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            y, = self.saved_tensors
+            return dy * y * (1 - y)
+
+    f = Sigmoid()
+    x = nd.array([0.5, -1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    s = 1 / (1 + np.exp(-x.asnumpy()))
+    np.testing.assert_allclose(x.grad.asnumpy(), s * (1 - s), rtol=1e-5)
+
+
+def test_dropout_respects_mode():
+    x = nd.ones((100, 100))
+    out = nd.Dropout(x, p=0.5)
+    # not training: identity
+    np.testing.assert_allclose(out.asnumpy(), x.asnumpy())
+    with autograd.record():
+        out = nd.Dropout(x, p=0.5)
+    frac = (out.asnumpy() == 0).mean()
+    assert 0.4 < frac < 0.6

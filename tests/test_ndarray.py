@@ -1,0 +1,192 @@
+"""NDArray basics (reference: tests/python/unittest/test_ndarray.py)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_creation():
+    a = nd.zeros((3, 4))
+    assert a.shape == (3, 4)
+    assert a.dtype == np.float32
+    assert a.asnumpy().sum() == 0
+
+    b = nd.ones((2,), dtype="int32")
+    assert b.dtype == np.int32
+
+    c = nd.full((2, 2), 7.0)
+    np.testing.assert_allclose(c.asnumpy(), np.full((2, 2), 7.0))
+
+    d = nd.array(np.arange(6).reshape(2, 3))
+    assert d.shape == (2, 3)
+
+    e = nd.arange(0, 10, 2)
+    np.testing.assert_allclose(e.asnumpy(), np.arange(0, 10, 2,
+                                                      dtype=np.float32))
+
+
+def test_float64_coerced_to_float32():
+    a = nd.array(np.random.rand(3, 3))
+    assert a.dtype == np.float32
+
+
+def test_arith():
+    a = nd.array([[1., 2.], [3., 4.]])
+    b = nd.array([[10., 20.], [30., 40.]])
+    np.testing.assert_allclose((a + b).asnumpy(), [[11, 22], [33, 44]])
+    np.testing.assert_allclose((b - a).asnumpy(), [[9, 18], [27, 36]])
+    np.testing.assert_allclose((a * b).asnumpy(), [[10, 40], [90, 160]])
+    np.testing.assert_allclose((b / a).asnumpy(), [[10, 10], [10, 10]])
+    np.testing.assert_allclose((a + 1).asnumpy(), [[2, 3], [4, 5]])
+    np.testing.assert_allclose((1 + a).asnumpy(), [[2, 3], [4, 5]])
+    np.testing.assert_allclose((2 - a).asnumpy(), [[1, 0], [-1, -2]])
+    np.testing.assert_allclose((a ** 2).asnumpy(), [[1, 4], [9, 16]])
+    np.testing.assert_allclose((-a).asnumpy(), [[-1, -2], [-3, -4]])
+    np.testing.assert_allclose((a == 1).asnumpy(), [[1, 0], [0, 0]])
+    np.testing.assert_allclose((a > 2).asnumpy(), [[0, 0], [1, 1]])
+
+
+def test_inplace():
+    a = nd.ones((2, 2))
+    a += 1
+    np.testing.assert_allclose(a.asnumpy(), np.full((2, 2), 2.0))
+    a *= 3
+    np.testing.assert_allclose(a.asnumpy(), np.full((2, 2), 6.0))
+    a /= 2
+    np.testing.assert_allclose(a.asnumpy(), np.full((2, 2), 3.0))
+
+
+def test_indexing():
+    a = nd.array(np.arange(12).reshape(3, 4))
+    np.testing.assert_allclose(a[1].asnumpy(), [4, 5, 6, 7])
+    np.testing.assert_allclose(a[1:3].asnumpy(), [[4, 5, 6, 7],
+                                                  [8, 9, 10, 11]])
+    np.testing.assert_allclose(a[1, 2].asnumpy(), 6)
+    a[0] = 100.0
+    assert a.asnumpy()[0].tolist() == [100] * 4
+    a[1, 1] = -1
+    assert a.asnumpy()[1, 1] == -1
+
+
+def test_shape_ops():
+    a = nd.array(np.arange(24).reshape(2, 3, 4))
+    assert a.reshape(6, 4).shape == (6, 4)
+    assert a.reshape((-1, 4)).shape == (6, 4)
+    assert a.reshape(0, -1).shape == (2, 12)  # mxnet special codes
+    assert a.reshape(-3, 4).shape == (6, 4)
+    assert a.transpose().shape == (4, 3, 2)
+    assert a.transpose((1, 0, 2)).shape == (3, 2, 4)
+    assert a.swapaxes(0, 2).shape == (4, 3, 2)
+    assert a.expand_dims(1).shape == (2, 1, 3, 4)
+    assert a.flatten().shape == (2, 12)
+    assert nd.concatenate([a, a], axis=0).shape == (4, 3, 4)
+    parts = a.split(3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (2, 1, 4)
+
+
+def test_reduce_ops():
+    x = np.random.rand(3, 4, 5).astype(np.float32)
+    a = nd.array(x)
+    np.testing.assert_allclose(a.sum().asnumpy(), x.sum(), rtol=1e-5)
+    np.testing.assert_allclose(a.sum(axis=1).asnumpy(), x.sum(1), rtol=1e-5)
+    np.testing.assert_allclose(a.mean(axis=(0, 2)).asnumpy(),
+                               x.mean((0, 2)), rtol=1e-5)
+    np.testing.assert_allclose(a.max(axis=0).asnumpy(), x.max(0), rtol=1e-5)
+    np.testing.assert_allclose(nd.sum(a, axis=1, keepdims=True).asnumpy(),
+                               x.sum(1, keepdims=True), rtol=1e-5)
+    np.testing.assert_allclose(
+        nd.sum(a, axis=1, exclude=True).asnumpy(),
+        x.sum(axis=(0, 2)), rtol=1e-5)
+
+
+def test_dot():
+    a = np.random.rand(4, 5).astype(np.float32)
+    b = np.random.rand(5, 3).astype(np.float32)
+    np.testing.assert_allclose(nd.dot(nd.array(a), nd.array(b)).asnumpy(),
+                               a.dot(b), rtol=1e-5)
+    np.testing.assert_allclose(
+        nd.dot(nd.array(a), nd.array(b.T), transpose_b=True).asnumpy(),
+        a.dot(b), rtol=1e-5)
+    x = np.random.rand(2, 4, 5).astype(np.float32)
+    y = np.random.rand(2, 5, 3).astype(np.float32)
+    np.testing.assert_allclose(
+        nd.batch_dot(nd.array(x), nd.array(y)).asnumpy(),
+        np.matmul(x, y), rtol=1e-5)
+
+
+def test_astype_copy():
+    a = nd.array([[1.5, 2.5]])
+    b = a.astype("int32")
+    assert b.dtype == np.int32
+    c = a.copy()
+    c += 1
+    assert a.asnumpy()[0, 0] == 1.5
+
+
+def test_context():
+    a = nd.zeros((2, 2), ctx=mx.cpu())
+    assert a.context.device_type == "cpu"
+    b = a.as_in_context(mx.cpu(0))
+    assert b.context == mx.cpu(0)
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "arrs")
+    d = {"w": nd.random.normal(shape=(3, 3)), "b": nd.ones((3,))}
+    nd.save(fname, d)
+    loaded = nd.load(fname)
+    assert set(loaded) == {"w", "b"}
+    np.testing.assert_allclose(loaded["w"].asnumpy(), d["w"].asnumpy())
+
+    lst = [nd.ones((2,)), nd.zeros((3,))]
+    nd.save(fname, lst)
+    loaded = nd.load(fname)
+    assert isinstance(loaded, list) and len(loaded) == 2
+    np.testing.assert_allclose(loaded[0].asnumpy(), [1, 1])
+
+
+def test_topk_sort():
+    x = np.array([[3., 1., 2.], [0., 5., 4.]], np.float32)
+    a = nd.array(x)
+    np.testing.assert_allclose(a.sort(axis=1).asnumpy(), np.sort(x, 1))
+    np.testing.assert_allclose(
+        a.topk(axis=1, k=2, ret_typ="value").asnumpy(),
+        [[3, 2], [5, 4]])
+    np.testing.assert_allclose(a.argmax(axis=1).asnumpy(), [0, 1])
+
+
+def test_take_onehot():
+    w = nd.array(np.arange(12).reshape(4, 3))
+    idx = nd.array([0, 2], dtype="int32")
+    np.testing.assert_allclose(nd.take(w, idx).asnumpy(),
+                               [[0, 1, 2], [6, 7, 8]])
+    oh = nd.one_hot(idx, 4)
+    np.testing.assert_allclose(oh.asnumpy(),
+                               [[1, 0, 0, 0], [0, 0, 1, 0]])
+
+
+def test_wait_to_read_sync():
+    a = nd.random.normal(shape=(100, 100))
+    b = nd.dot(a, a)
+    b.wait_to_read()  # must not raise
+    nd.waitall()
+
+
+def test_broadcast():
+    a = nd.array([[1.], [2.]])
+    out = nd.broadcast_to(a, (2, 3))
+    assert out.shape == (2, 3)
+    b = nd.broadcast_add(a, nd.array([[10., 20., 30.]]))
+    np.testing.assert_allclose(b.asnumpy(), [[11, 21, 31], [12, 22, 32]])
+
+
+def test_where_clip():
+    cond = nd.array([[1., 0.], [0., 1.]])
+    x = nd.ones((2, 2))
+    y = nd.zeros((2, 2)) - 1
+    np.testing.assert_allclose(nd.where(cond, x, y).asnumpy(),
+                               [[1, -1], [-1, 1]])
+    np.testing.assert_allclose(
+        nd.clip(nd.array([-2., 0.5, 9.]), 0.0, 1.0).asnumpy(), [0, 0.5, 1])
