@@ -13,6 +13,9 @@ from .context import Context, cpu, gpu, tpu, current_context, num_gpus, \
     num_tpus  # noqa: F401
 from . import ndarray  # noqa: F401
 from . import ndarray as nd  # noqa: F401
+from . import symbol  # noqa: F401
+from . import symbol as sym  # noqa: F401
+from .executor import Executor  # noqa: F401
 from . import random  # noqa: F401
 from . import autograd  # noqa: F401
 from .runtime import engine  # noqa: F401

@@ -1,0 +1,344 @@
+"""Executor — binds a Symbol to devices + buffers and runs it.
+
+Reference: ``python/mxnet/executor.py`` over ``src/executor/graph_executor.cc``
+(SimpleBind :1593, Bind :1624, Forward :64 -> RunOps :1318, Backward :77).
+
+TPU-native design: binding compiles the whole graph (forward, and
+forward+vjp for training) into single XLA executables via ``jax.jit``.  The
+reference's memory planning (PlanMemory pass), inplace-addto detection, op
+segments/bulking and cross-device copy scheduling all collapse into XLA's
+compiler — SURVEY.md §7 architecture stance.  Gradients come from one
+``jax.vjp`` over the traced graph rather than a constructed backward graph.
+``forward``/``backward``/``forward_backward`` mirror the reference's calling
+conventions, including grad_req write/add/null and auxiliary-state updates
+(BatchNorm moving stats).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError, np_dtype
+from .context import Context, current_context
+from .ndarray import NDArray, zeros as nd_zeros
+from .ndarray.ndarray import _as_nd
+from .symbol.symbol import Symbol, _infer_shapes
+
+__all__ = ["Executor"]
+
+
+def _build_eval(symbol, training):
+    """Build the pure graph-evaluation function:
+    fn(arg_map, aux_map, key) -> (outputs, aux_updates)."""
+    order = symbol._topo()
+    out_entries = list(symbol._outputs)
+
+    def fn(arg_map, aux_map, key):
+        vals = {}
+        aux_updates = {}
+        for pos, node in enumerate(order):
+            if node.is_var:
+                if node.name in arg_map:
+                    vals[(id(node), 0)] = arg_map[node.name]
+                elif node.name in aux_map:
+                    vals[(id(node), 0)] = aux_map[node.name]
+                else:
+                    raise MXNetError("unbound variable %r" % node.name)
+                continue
+            op = node.op
+            ins = [vals[(id(s), i)] for (s, i) in node.inputs]
+            params = node.params
+            if "training" in op.param_names:
+                params = dict(params, training=training)
+            if op.needs_rng:
+                sub = jax.random.fold_in(key, pos)
+                out = op.fn(sub, *ins, **params)
+            else:
+                out = op.fn(*ins, **params)
+            if not isinstance(out, tuple):
+                out = (out,)
+            for i, o in enumerate(out):
+                vals[(id(node), i)] = o
+            if training and op.aux_states:
+                for in_idx, out_idx in op.aux_states.items():
+                    src, _ = node.inputs[in_idx]
+                    if src.is_var and src.name in aux_map:
+                        aux_updates[src.name] = out[out_idx]
+        outputs = [vals[(id(n), i)] for (n, i) in out_entries]
+        return outputs, aux_updates
+
+    return fn
+
+
+class Executor:
+    """A bound computation graph."""
+
+    def __init__(self, symbol, ctx, arg_dict, grad_dict, aux_dict,
+                 grad_req):
+        self._symbol = symbol
+        self._ctx = ctx or current_context()
+        self.arg_dict = arg_dict
+        self.grad_dict = grad_dict
+        self.aux_dict = aux_dict
+        self._arg_names = symbol.list_arguments()
+        self._aux_names = symbol.list_auxiliary_states()
+        if isinstance(grad_req, str):
+            grad_req = {n: grad_req for n in self._arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            grad_req = dict(zip(self._arg_names, grad_req))
+        self._grad_req = {n: grad_req.get(n, "null")
+                          for n in self._arg_names}
+        self._grad_names = [n for n in self._arg_names
+                            if self._grad_req[n] != "null" and
+                            grad_dict.get(n) is not None]
+        self.outputs = []
+        self._key = jax.random.PRNGKey(0)
+        self._fwd_jit = {}
+        self._fused_jit = None
+        self._monitor = None
+
+        eval_train = _build_eval(symbol, True)
+        eval_infer = _build_eval(symbol, False)
+
+        def fwd(training, arg_map, aux_map, key):
+            f = eval_train if training else eval_infer
+            return f(arg_map, aux_map, key)
+
+        self._eval_train = eval_train
+        self._eval_infer = eval_infer
+        self._jit_infer = jax.jit(
+            lambda arg_map, aux_map, key: eval_infer(arg_map, aux_map, key))
+        self._jit_train = jax.jit(
+            lambda arg_map, aux_map, key: eval_train(arg_map, aux_map, key))
+
+        grad_names = self._grad_names
+
+        def train_step(arg_map, aux_map, key, out_cots):
+            diff = {n: arg_map[n] for n in grad_names}
+            rest = {n: v for n, v in arg_map.items() if n not in diff}
+
+            def run(d):
+                outs, auxu = eval_train(dict(rest, **d), aux_map, key)
+                return outs, auxu
+
+            (outs, auxu), vjp_fn = jax.vjp(lambda d: run(d), diff)
+            cots = [c if c is not None else jnp.ones_like(o)
+                    for c, o in zip(out_cots, outs)]
+            cots = [c.astype(o.dtype) if c.dtype != o.dtype else c
+                    for c, o in zip(cots, outs)]
+            zero_aux = jax.tree_util.tree_map(jnp.zeros_like, auxu)
+            grads = vjp_fn((cots, zero_aux))[0]
+            return outs, auxu, grads
+
+        self._jit_train_step = jax.jit(train_step)
+
+    # -- binding constructors ---------------------------------------------
+    @staticmethod
+    def _simple_bind(symbol, ctx, grad_req, type_dict, shape_kwargs,
+                     shared_exec=None):
+        shapes = {k: tuple(v) for k, v in shape_kwargs.items()}
+        _, var_sh = _infer_shapes(symbol, shapes)
+        type_dict = type_dict or {}
+        arg_dict = {}
+        for n in symbol.list_arguments():
+            dt = type_dict.get(n, "float32")
+            if shared_exec is not None and n in shared_exec.arg_dict and \
+                    tuple(shared_exec.arg_dict[n].shape) == var_sh[n]:
+                arg_dict[n] = shared_exec.arg_dict[n]
+            else:
+                arg_dict[n] = nd_zeros(var_sh[n], ctx=ctx, dtype=dt)
+        aux_dict = {}
+        for n in symbol.list_auxiliary_states():
+            if shared_exec is not None and n in shared_exec.aux_dict and \
+                    tuple(shared_exec.aux_dict[n].shape) == var_sh[n]:
+                aux_dict[n] = shared_exec.aux_dict[n]
+            else:
+                aux_dict[n] = nd_zeros(var_sh[n], ctx=ctx)
+        if isinstance(grad_req, str):
+            reqs = {n: grad_req for n in arg_dict}
+        elif isinstance(grad_req, (list, tuple)):
+            reqs = dict(zip(symbol.list_arguments(), grad_req))
+        else:
+            reqs = {n: grad_req.get(n, "null") for n in arg_dict}
+        grad_dict = {n: nd_zeros(var_sh[n], ctx=ctx,
+                                 dtype=type_dict.get(n, "float32"))
+                     for n in arg_dict if reqs.get(n, "null") != "null"}
+        return Executor(symbol, ctx, arg_dict, grad_dict, aux_dict, reqs)
+
+    @staticmethod
+    def _bind(symbol, ctx, args, args_grad, grad_req, aux_states):
+        arg_names = symbol.list_arguments()
+        if isinstance(args, (list, tuple)):
+            arg_dict = dict(zip(arg_names, [_as_nd(a) for a in args]))
+        else:
+            arg_dict = {k: _as_nd(v) for k, v in (args or {}).items()}
+        if args_grad is None:
+            grad_dict = {}
+        elif isinstance(args_grad, (list, tuple)):
+            grad_dict = dict(zip(arg_names, [_as_nd(g) if g is not None
+                                             else None for g in args_grad]))
+        else:
+            grad_dict = {k: _as_nd(v) for k, v in args_grad.items()}
+        grad_dict = {k: v for k, v in grad_dict.items() if v is not None}
+        aux_names = symbol.list_auxiliary_states()
+        if isinstance(aux_states, (list, tuple)):
+            aux_dict = dict(zip(aux_names, [_as_nd(a) for a in aux_states]))
+        else:
+            aux_dict = {k: _as_nd(v) for k, v in (aux_states or {}).items()}
+        for n in aux_names:
+            if n not in aux_dict:
+                raise MXNetError("missing auxiliary state %r" % n)
+        return Executor(symbol, ctx, arg_dict, grad_dict, aux_dict, grad_req)
+
+    # -- properties --------------------------------------------------------
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self._arg_names]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n) for n in self._arg_names]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n] for n in self._aux_names]
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._symbol.list_outputs(), self.outputs))
+
+    # -- execution ---------------------------------------------------------
+    def _arg_map(self):
+        return {n: a._data for n, a in self.arg_dict.items()}
+
+    def _aux_map(self):
+        return {n: a._data for n, a in self.aux_dict.items()}
+
+    def _next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def forward(self, is_train=False, **kwargs):
+        """Run the graph (reference: executor.py forward:114)."""
+        for k, v in kwargs.items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._data = _as_nd(v)._data.astype(
+                    self.arg_dict[k].dtype)
+            else:
+                raise MXNetError("unknown forward argument %r" % k)
+        fn = self._jit_train if is_train else self._jit_infer
+        outs, auxu = fn(self._arg_map(), self._aux_map(), self._next_key())
+        if is_train:
+            self._pending = (self._arg_map(), self._aux_map())
+        for n, v in auxu.items():
+            self.aux_dict[n]._data = v
+        self.outputs = [NDArray(o) for o in outs]
+        if self._monitor is not None:
+            for name, val in zip(self._symbol.list_outputs(), self.outputs):
+                self._monitor(name, val)
+        return self.outputs
+
+    def backward(self, out_grads=None, is_train=True):
+        """Gradients via whole-graph vjp (reference: backward:155 over the
+        constructed gradient graph)."""
+        self._run_train_step(out_grads, use_pending=True)
+
+    def forward_backward(self, out_grads=None, **kwargs):
+        """Fused forward+backward in one XLA program — the fast path the
+        Module training loop uses (no double forward)."""
+        for k, v in kwargs.items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._data = _as_nd(v)._data.astype(
+                    self.arg_dict[k].dtype)
+        self._run_train_step(out_grads, use_pending=False)
+        return self.outputs
+
+    def _run_train_step(self, out_grads, use_pending):
+        if out_grads is None:
+            cots = [None] * len(self._symbol._outputs)
+        elif isinstance(out_grads, NDArray):
+            cots = [out_grads._data]
+        else:
+            cots = [g._data if g is not None else None for g in out_grads]
+        if use_pending and getattr(self, "_pending", None) is not None:
+            arg_map, aux_map = self._pending
+            self._pending = None
+        else:
+            arg_map, aux_map = self._arg_map(), self._aux_map()
+        # None cotangents must be materialized as ones for jit
+        outs, auxu, grads = self._jit_train_step(
+            arg_map, aux_map, self._next_key(),
+            _materialize(cots, self, arg_map, aux_map))
+        for n, v in auxu.items():
+            self.aux_dict[n]._data = v
+        self.outputs = [NDArray(o) for o in outs]
+        for n in self._grad_names:
+            g = grads[n]
+            dst = self.grad_dict[n]
+            g = g.astype(dst.dtype) if g.dtype != dst.dtype else g
+            if self._grad_req[n] == "add":
+                dst._data = dst._data + g
+            else:
+                dst._data = g
+
+    # -- utilities ---------------------------------------------------------
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for k, v in (arg_params or {}).items():
+            if k in self.arg_dict:
+                v.copyto(self.arg_dict[k])
+            elif not allow_extra_params:
+                raise MXNetError("unknown argument %r" % k)
+        for k, v in (aux_params or {}).items():
+            if k in self.aux_dict:
+                v.copyto(self.aux_dict[k])
+            elif not allow_extra_params:
+                raise MXNetError("unknown aux state %r" % k)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False,
+                **kwargs):
+        """Re-bind with new shapes (reference: executor.py reshape:372);
+        recompilation is per-shape cached by jit."""
+        shapes = {}
+        for n, a in self.arg_dict.items():
+            shapes[n] = kwargs.get(n, a.shape)
+        ex = Executor._simple_bind(self._symbol, self._ctx, self._grad_req,
+                                   None, shapes)
+        for n, a in self.arg_dict.items():
+            if tuple(ex.arg_dict[n].shape) == tuple(a.shape):
+                ex.arg_dict[n] = a
+        for n, a in self.aux_dict.items():
+            if tuple(ex.aux_dict[n].shape) == tuple(a.shape):
+                ex.aux_dict[n] = a
+        return ex
+
+    def set_monitor_callback(self, callback, monitor_all=False):
+        self._monitor = callback
+
+    def debug_str(self):
+        lines = ["Symbol outputs: %s" % self._symbol.list_outputs()]
+        for node in self._symbol._topo():
+            kind = "var" if node.is_var else node.op.name
+            lines.append("%s %s <- %s" % (kind, node.name,
+                                          [s.name for s, _ in node.inputs]))
+        return "\n".join(lines)
+
+
+def _materialize(cots, ex, arg_map, aux_map):
+    """Replace None head-cotangents with ones of the right shape (the
+    reference allows backward() without out_grads for loss heads)."""
+    if all(c is not None for c in cots):
+        return cots
+    # cheap shape inference: run eval_shape on the infer function
+    try:
+        shapes = jax.eval_shape(ex._eval_infer, arg_map, aux_map,
+                                jax.random.PRNGKey(0))[0]
+    except Exception:
+        outs, _ = ex._jit_infer(arg_map, aux_map, jax.random.PRNGKey(0))
+        shapes = outs
+    return [c if c is not None else jnp.ones(s.shape, s.dtype)
+            for c, s in zip(cots, shapes)]

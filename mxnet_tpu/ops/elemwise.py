@@ -218,3 +218,14 @@ def _add_n(*args):
     for a in args[1:]:
         out = out + a
     return out
+
+
+# -- symbolic metadata -------------------------------------------------------
+from .registry import get_op as _get_op
+
+def _leaky_inputs(params):
+    if params.get("act_type", "leaky") == "prelu":
+        return ("data", "gamma")
+    return ("data",)
+
+_get_op("LeakyReLU").active_inputs = _leaky_inputs

@@ -595,3 +595,25 @@ def _ctc_loss(data, label, use_data_lengths=False, use_label_lengths=False,
     loss = optax.ctc_loss(jax.nn.log_softmax(logits, -1), logit_paddings,
                           labels, label_paddings)
     return loss
+
+
+# -- symbolic metadata -------------------------------------------------------
+from .registry import get_op as _get_op
+
+_bn = _get_op("BatchNorm")
+_bn.aux_states = {3: 3, 4: 4}   # moving_mean, moving_var -> outputs 3, 4
+
+def _conv_inputs(params):
+    if params.get("no_bias", False):
+        return ("data", "weight")
+    return ("data", "weight", "bias")
+
+_get_op("Convolution").active_inputs = _conv_inputs
+_get_op("FullyConnected").active_inputs = _conv_inputs
+
+def _deconv_inputs(params):
+    if params.get("no_bias", True):
+        return ("data", "weight")
+    return ("data", "weight", "bias")
+
+_get_op("Deconvolution").active_inputs = _deconv_inputs

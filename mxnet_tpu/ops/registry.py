@@ -44,7 +44,8 @@ class Op:
     """
 
     __slots__ = ("name", "fn", "num_outputs", "needs_rng", "donate", "doc",
-                 "input_names", "num_visible_outputs", "param_names")
+                 "input_names", "num_visible_outputs", "param_names",
+                 "aux_states", "active_inputs")
 
     def __init__(self, name, fn, num_outputs=1, needs_rng=False, donate=(),
                  doc=None, input_names=None, num_visible_outputs=None):
@@ -59,6 +60,18 @@ class Op:
         self.input_names = tuple(input_names)
         self.num_visible_outputs = num_visible_outputs
         self.param_names = _infer_param_names(fn)
+        # aux_states: {input_idx: output_idx} — inputs that are mutable
+        # auxiliary states (reference: BatchNorm moving stats); the output
+        # at output_idx is the updated value the executor writes back.
+        self.aux_states = {}
+        # active_inputs: optional fn(params) -> tuple of input names actually
+        # consumed (e.g. Convolution drops "bias" when no_bias=True)
+        self.active_inputs = None
+
+    def input_names_for(self, params):
+        if self.active_inputs is None:
+            return self.input_names
+        return tuple(self.active_inputs(params))
 
     def n_out(self, params):
         if callable(self.num_outputs):

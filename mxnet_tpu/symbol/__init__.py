@@ -1,0 +1,9 @@
+"""sym — symbolic graph API (reference: python/mxnet/symbol/)."""
+
+from .symbol import (Symbol, var, Variable, Group, load, load_json)  # noqa
+from . import register as _register
+
+_register.populate(globals())
+
+zeros = globals()["_zeros"]
+ones = globals()["_ones"]

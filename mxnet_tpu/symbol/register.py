@@ -1,0 +1,87 @@
+"""Generate the ``sym.*`` op functions (reference:
+python/mxnet/symbol/register.py)."""
+
+from __future__ import annotations
+
+from ..ops import registry as _reg
+from .symbol import Symbol, _sym_invoke
+
+
+def _make_fn(op):
+    def fn(*args, name=None, attr=None, **kwargs):
+        inputs = []
+        pos_params = []
+        for a in args:
+            if isinstance(a, Symbol):
+                inputs.append(a)
+            else:
+                pos_params.append(a)
+        params = {}
+        named = {}
+        for k, v in kwargs.items():
+            if isinstance(v, Symbol):
+                named[k] = v
+            else:
+                params[k] = v
+        if pos_params:
+            free = [p for p in op.param_names if p not in params]
+            if len(pos_params) > len(free):
+                raise TypeError("%s: too many positional arguments" %
+                                op.name)
+            for p, v in zip(free, pos_params):
+                params[p] = v
+        if named:
+            input_names = op.input_names_for(params)
+            by_name = {}
+            for i, s in enumerate(inputs):
+                by_name[i] = s
+            merged = list(inputs)
+            for nm in input_names[len(inputs):]:
+                if nm in named:
+                    merged.append(named.pop(nm))
+                else:
+                    merged.append(None)  # placeholder -> auto var
+            while merged and merged[-1] is None:
+                merged.pop()
+            if named:
+                raise TypeError("%s got unexpected Symbol kwargs %s "
+                                "(inputs: %s)" %
+                                (op.name, sorted(named), op.input_names))
+            inputs = merged
+        return _sym_invoke_padded(op, inputs, params, name, attr)
+
+    fn.__name__ = op.name
+    fn.__doc__ = op.doc
+    return fn
+
+
+def _sym_invoke_padded(op, inputs, params, name, attr):
+    # None placeholders (skipped named inputs) become auto-created vars
+    from .symbol import Node, _NameManager
+    params = {k: v for k, v in params.items() if v is not None}
+    if name is None:
+        name = _NameManager.get().fresh(op.name)
+    input_names = op.input_names_for(params)
+    entries = []
+    for i, s in enumerate(inputs):
+        if s is None:
+            nm = input_names[i] if i < len(input_names) else "in%d" % i
+            entries.append((Node(None, "%s_%s" % (name, nm)), 0))
+        else:
+            entries.append(s._outputs[0])
+    if input_names and len(entries) < len(input_names):
+        for nm in input_names[len(entries):]:
+            entries.append((Node(None, "%s_%s" % (name, nm)), 0))
+    node = Node(op, name, params=params, inputs=entries,
+                attrs=dict(attr or {}))
+    n_vis = op.n_visible(params)
+    return Symbol([(node, i) for i in range(n_vis)])
+
+
+def populate(namespace, filt=None):
+    for name in _reg.list_ops():
+        op = _reg.get_op(name)
+        if filt and not filt(name):
+            continue
+        namespace[name] = _make_fn(op)
+    return namespace

@@ -1,0 +1,806 @@
+"""Symbol — the symbolic graph API.
+
+Reference: ``python/mxnet/symbol/symbol.py`` (2,970 LoC) over the nnvm graph
+IR (``3rdparty/tvm/nnvm``).
+
+TPU-native design: a Symbol is a tiny DAG of (op, params, inputs) nodes.
+There are no graph passes for memory planning, inplace detection or op
+fusion — binding a Symbol compiles the *whole graph* into one XLA executable
+(the reference's bulk-exec concept taken to its limit, SURVEY.md §7 step 4),
+and XLA owns those optimizations.  Shape/type inference runs either through
+per-op rules (so parameter shapes can be inferred bottom-up like the
+reference's FInferShape) or ``jax.eval_shape`` over the traced graph.
+"""
+
+from __future__ import annotations
+
+import json
+import ast
+import threading
+
+import numpy as _np
+
+from ..base import np_dtype, dtype_name, MXNetError
+from ..ops import registry as _reg
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json"]
+
+
+class _NameManager:
+    _tls = threading.local()
+
+    @classmethod
+    def get(cls):
+        if not hasattr(cls._tls, "inst"):
+            cls._tls.inst = cls()
+        return cls._tls.inst
+
+    def __init__(self):
+        self.counts = {}
+
+    def fresh(self, hint):
+        hint = hint.lower().lstrip("_")
+        n = self.counts.get(hint, 0)
+        self.counts[hint] = n + 1
+        return "%s%d" % (hint, n)
+
+
+class Node:
+    """One graph node: a variable (op is None) or an op invocation."""
+
+    __slots__ = ("op", "name", "params", "inputs", "attrs")
+
+    def __init__(self, op, name, params=None, inputs=(), attrs=None):
+        self.op = op                  # ops.registry.Op or None for variables
+        self.name = name
+        self.params = dict(params or {})
+        self.inputs = list(inputs)    # [(Node, out_idx), ...]
+        self.attrs = dict(attrs or {})  # user attrs (ctx_group, lr_mult, ...)
+
+    @property
+    def is_var(self):
+        return self.op is None
+
+    def num_outputs(self):
+        return 1 if self.is_var else self.op.n_out(self.params)
+
+
+class Symbol:
+    """An ordered list of graph output entries."""
+
+    __slots__ = ("_outputs",)
+
+    def __init__(self, outputs):
+        self._outputs = list(outputs)  # [(Node, out_idx)]
+
+    # -- composition -------------------------------------------------------
+    def __getitem__(self, idx):
+        if isinstance(idx, str):
+            names = self.list_outputs()
+            idx = names.index(idx)
+        return Symbol([self._outputs[idx]])
+
+    def __len__(self):
+        return len(self._outputs)
+
+    def __iter__(self):
+        for i in range(len(self._outputs)):
+            yield self[i]
+
+    @property
+    def name(self):
+        node, idx = self._outputs[0]
+        return node.name
+
+    def attr(self, key):
+        return self._outputs[0][0].attrs.get(key)
+
+    def _set_attr(self, **kwargs):
+        self._outputs[0][0].attrs.update(kwargs)
+
+    # -- arithmetic (mirrors NDArray operator set) -------------------------
+    def __add__(self, other):
+        return _sym_binary("broadcast_add", "_plus_scalar", self, other)
+
+    def __radd__(self, other):
+        return self.__add__(other)
+
+    def __sub__(self, other):
+        return _sym_binary("broadcast_sub", "_minus_scalar", self, other)
+
+    def __rsub__(self, other):
+        return _sym_invoke("_rminus_scalar", [self], {"scalar": float(other)})
+
+    def __mul__(self, other):
+        return _sym_binary("broadcast_mul", "_mul_scalar", self, other)
+
+    def __rmul__(self, other):
+        return self.__mul__(other)
+
+    def __truediv__(self, other):
+        return _sym_binary("broadcast_div", "_div_scalar", self, other)
+
+    def __rtruediv__(self, other):
+        return _sym_invoke("_rdiv_scalar", [self], {"scalar": float(other)})
+
+    def __pow__(self, other):
+        return _sym_binary("broadcast_power", "_power_scalar", self, other)
+
+    def __neg__(self):
+        return _sym_invoke("negative", [self], {})
+
+    def __eq__(self, other):
+        return _sym_binary("broadcast_equal", "_equal_scalar", self, other)
+
+    def __ne__(self, other):
+        return _sym_binary("broadcast_not_equal", "_not_equal_scalar", self,
+                           other)
+
+    def __gt__(self, other):
+        return _sym_binary("broadcast_greater", "_greater_scalar", self,
+                           other)
+
+    def __ge__(self, other):
+        return _sym_binary("broadcast_greater_equal",
+                           "_greater_equal_scalar", self, other)
+
+    def __lt__(self, other):
+        return _sym_binary("broadcast_lesser", "_lesser_scalar", self, other)
+
+    def __le__(self, other):
+        return _sym_binary("broadcast_lesser_equal", "_lesser_equal_scalar",
+                           self, other)
+
+    __hash__ = object.__hash__
+
+    def __repr__(self):
+        return "<Symbol %s>" % ", ".join(
+            "%s[%d]" % (n.name, i) for n, i in self._outputs)
+
+    # -- op methods (mirror of NDArray's method set) -----------------------
+    def sum(self, axis=None, keepdims=False):
+        return _sym_invoke("sum", [self], {"axis": axis,
+                                           "keepdims": keepdims})
+
+    def mean(self, axis=None, keepdims=False):
+        return _sym_invoke("mean", [self], {"axis": axis,
+                                            "keepdims": keepdims})
+
+    def max(self, axis=None, keepdims=False):
+        return _sym_invoke("max", [self], {"axis": axis,
+                                           "keepdims": keepdims})
+
+    def min(self, axis=None, keepdims=False):
+        return _sym_invoke("min", [self], {"axis": axis,
+                                           "keepdims": keepdims})
+
+    def prod(self, axis=None, keepdims=False):
+        return _sym_invoke("prod", [self], {"axis": axis,
+                                            "keepdims": keepdims})
+
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        shape = kwargs.get("shape", shape)
+        return _sym_invoke("Reshape", [self],
+                           {"shape": tuple(shape),
+                            "reverse": kwargs.get("reverse", False)})
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return _sym_invoke("transpose", [self], {"axes": axes or None})
+
+    def flatten(self):
+        return _sym_invoke("Flatten", [self], {})
+
+    def expand_dims(self, axis):
+        return _sym_invoke("expand_dims", [self], {"axis": axis})
+
+    def squeeze(self, axis=None):
+        return _sym_invoke("squeeze", [self], {"axis": axis})
+
+    def swapaxes(self, dim1, dim2):
+        return _sym_invoke("SwapAxis", [self], {"dim1": dim1, "dim2": dim2})
+
+    def slice_axis(self, axis, begin, end):
+        return _sym_invoke("slice_axis", [self],
+                           {"axis": axis, "begin": begin, "end": end})
+
+    def clip(self, a_min=None, a_max=None):
+        return _sym_invoke("clip", [self], {"a_min": a_min, "a_max": a_max})
+
+    def dot(self, other, transpose_a=False, transpose_b=False):
+        return _sym_invoke("dot", [self, other],
+                           {"transpose_a": transpose_a,
+                            "transpose_b": transpose_b})
+
+    def exp(self):
+        return _sym_invoke("exp", [self], {})
+
+    def log(self):
+        return _sym_invoke("log", [self], {})
+
+    def sqrt(self):
+        return _sym_invoke("sqrt", [self], {})
+
+    def square(self):
+        return _sym_invoke("square", [self], {})
+
+    def abs(self):
+        return _sym_invoke("abs", [self], {})
+
+    def sign(self):
+        return _sym_invoke("sign", [self], {})
+
+    def relu(self):
+        return _sym_invoke("relu", [self], {})
+
+    def sigmoid(self):
+        return _sym_invoke("sigmoid", [self], {})
+
+    def tanh(self):
+        return _sym_invoke("tanh", [self], {})
+
+    def softmax(self, axis=-1):
+        return _sym_invoke("softmax", [self], {"axis": axis})
+
+    def log_softmax(self, axis=-1):
+        return _sym_invoke("log_softmax", [self], {"axis": axis})
+
+    def argmax(self, axis=None, keepdims=False):
+        return _sym_invoke("argmax", [self], {"axis": axis,
+                                              "keepdims": keepdims})
+
+    def argmin(self, axis=None, keepdims=False):
+        return _sym_invoke("argmin", [self], {"axis": axis,
+                                              "keepdims": keepdims})
+
+    def astype(self, dtype):
+        from ..base import dtype_name
+        return _sym_invoke("Cast", [self], {"dtype": dtype_name(dtype)})
+
+    def take(self, indices, axis=0, mode="clip"):
+        return _sym_invoke("take", [self, indices],
+                           {"axis": axis, "mode": mode})
+
+    def split(self, num_outputs, axis=1, squeeze_axis=False):
+        return _sym_invoke("SliceChannel", [self],
+                           {"num_outputs": num_outputs, "axis": axis,
+                            "squeeze_axis": squeeze_axis})
+
+    def norm(self, ord=2, axis=None, keepdims=False):
+        return _sym_invoke("norm", [self], {"ord": ord, "axis": axis,
+                                            "keepdims": keepdims})
+
+    # -- graph queries -----------------------------------------------------
+    def _topo(self):
+        """Post-order DFS (matches nnvm::Graph topo order)."""
+        seen = {}
+        order = []
+
+        def visit(node):
+            if id(node) in seen:
+                return
+            seen[id(node)] = node
+            for (src, _i) in node.inputs:
+                visit(src)
+            order.append(node)
+
+        for (n, _i) in self._outputs:
+            visit(n)
+        return order
+
+    def _aux_var_ids(self):
+        aux = set()
+        for node in self._topo():
+            if node.is_var:
+                continue
+            for in_idx, _out_idx in node.op.aux_states.items():
+                if in_idx < len(node.inputs):
+                    src, _ = node.inputs[in_idx]
+                    if src.is_var:
+                        aux.add(id(src))
+        return aux
+
+    def list_arguments(self):
+        aux = self._aux_var_ids()
+        return [n.name for n in self._topo() if n.is_var and id(n) not in aux]
+
+    def list_auxiliary_states(self):
+        aux = self._aux_var_ids()
+        return [n.name for n in self._topo() if n.is_var and id(n) in aux]
+
+    def list_inputs(self):
+        return [n.name for n in self._topo() if n.is_var]
+
+    def list_outputs(self):
+        names = []
+        for node, idx in self._outputs:
+            if node.is_var:
+                names.append(node.name)
+            elif node.num_outputs() == 1:
+                names.append(node.name + "_output")
+            else:
+                names.append("%s_output%d" % (node.name, idx))
+        return names
+
+    def get_internals(self):
+        outs = []
+        for node in self._topo():
+            for i in range(node.num_outputs()):
+                outs.append((node, i))
+        return Symbol(outs)
+
+    def get_children(self):
+        node = self._outputs[0][0]
+        if not node.inputs:
+            return None
+        return Symbol(list(node.inputs))
+
+    # -- shape/type inference ---------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        try:
+            return self._infer_shape_impl(False, *args, **kwargs)
+        except MXNetError:
+            raise
+
+    def infer_shape_partial(self, *args, **kwargs):
+        return self._infer_shape_impl(True, *args, **kwargs)
+
+    def _infer_shape_impl(self, partial, *args, **kwargs):
+        known = {}
+        if args:
+            for name, shp in zip(self.list_arguments(), args):
+                if shp is not None:
+                    known[name] = tuple(shp)
+        known.update({k: tuple(v) for k, v in kwargs.items()})
+        shapes = _infer_shapes(self, known, partial=partial)
+        if shapes is None:
+            return None, None, None
+        node_sh, var_sh = shapes
+        arg_shapes = [var_sh.get(n) for n in self.list_arguments()]
+        aux_shapes = [var_sh.get(n) for n in self.list_auxiliary_states()]
+        out_shapes = [node_sh.get((id(n), i)) for n, i in self._outputs]
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_type(self, *args, **kwargs):
+        known = {}
+        if args:
+            for name, dt in zip(self.list_arguments(), args):
+                if dt is not None:
+                    known[name] = np_dtype(dt)
+        known.update({k: np_dtype(v) for k, v in kwargs.items()})
+        # default everything unknown to float32 (reference behavior)
+        arg_types = [known.get(n, _np.dtype("float32"))
+                     for n in self.list_arguments()]
+        aux_types = [known.get(n, _np.dtype("float32"))
+                     for n in self.list_auxiliary_states()]
+        # outputs via eval_shape with inferred shapes unknown -> give up to
+        # float32; refined during bind
+        out_types = [_np.dtype("float32")] * len(self._outputs)
+        return arg_types, out_types, aux_types
+
+    # -- serialization -----------------------------------------------------
+    def tojson(self):
+        """Graph JSON in the reference's schema (nodes/arg_nodes/heads —
+        python/mxnet/symbol/symbol.py save; values stringified like dmlc
+        params)."""
+        order = self._topo()
+        nid = {id(n): i for i, n in enumerate(order)}
+        nodes = []
+        for n in order:
+            entry = {
+                "op": "null" if n.is_var else n.op.name,
+                "name": n.name,
+                "inputs": [[nid[id(s)], i, 0] for (s, i) in n.inputs],
+            }
+            attrs = {k: _stringify(v) for k, v in n.params.items()}
+            if n.attrs:
+                attrs.update({"__%s__" % k: _stringify(v)
+                              for k, v in n.attrs.items()})
+            if attrs:
+                entry["attrs"] = attrs
+            nodes.append(entry)
+        return json.dumps({
+            "nodes": nodes,
+            "arg_nodes": [nid[id(n)] for n in order if n.is_var],
+            "node_row_ptr": list(range(len(order) + 1)),
+            "heads": [[nid[id(n)], i, 0] for (n, i) in self._outputs],
+            "attrs": {"mxnet_version": ["int", 10301],
+                      "framework": ["str", "mxnet_tpu"]},
+        }, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # -- binding -----------------------------------------------------------
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
+                    stype_dict=None, group2ctx=None, shared_arg_names=None,
+                    shared_exec=None, shared_buffer=None, **kwargs):
+        from ..executor import Executor
+        return Executor._simple_bind(self, ctx, grad_req, type_dict,
+                                     kwargs, shared_exec=shared_exec)
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        from ..executor import Executor
+        return Executor._bind(self, ctx, args, args_grad, grad_req,
+                              aux_states)
+
+    def eval(self, ctx=None, **kwargs):
+        ex = self.bind(ctx, kwargs)
+        return ex.forward()
+
+    # -- misc --------------------------------------------------------------
+    def tojson_str(self):
+        return self.tojson()
+
+
+def _stringify(v):
+    if isinstance(v, str):
+        return v
+    if isinstance(v, (tuple, list)):
+        return "(" + ", ".join(str(x) for x in v) + ")"
+    return str(v)
+
+
+def _parse_attr(v):
+    if not isinstance(v, str):
+        return v
+    try:
+        return ast.literal_eval(v)
+    except (ValueError, SyntaxError):
+        if v in ("True", "False"):
+            return v == "True"
+        return v
+
+
+def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
+        init=None, stype=None, **kwargs):
+    """Create a variable symbol (reference: symbol.py var/Variable)."""
+    attrs = dict(attr or {})
+    if shape is not None:
+        attrs["__shape__"] = tuple(shape)
+    if dtype is not None:
+        attrs["__dtype__"] = dtype_name(dtype)
+    if lr_mult is not None:
+        attrs["__lr_mult__"] = lr_mult
+    if wd_mult is not None:
+        attrs["__wd_mult__"] = wd_mult
+    if init is not None:
+        attrs["__init__"] = init if isinstance(init, str) else \
+            init.__class__.__name__
+    if stype is not None:
+        attrs["__storage_type__"] = stype
+    attrs.update(kwargs)
+    return Symbol([(Node(None, name, attrs=attrs), 0)])
+
+
+Variable = var
+
+
+def Group(symbols):
+    outs = []
+    for s in symbols:
+        outs.extend(s._outputs)
+    return Symbol(outs)
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def load_json(json_str):
+    data = json.loads(json_str)
+    nodes = []
+    for entry in data["nodes"]:
+        attrs = entry.get("attrs", entry.get("param", {})) or {}
+        params = {}
+        uattrs = {}
+        for k, v in attrs.items():
+            if k.startswith("__") and k.endswith("__"):
+                uattrs[k[2:-2]] = _parse_attr(v)
+            else:
+                params[k] = _parse_attr(v)
+        if entry["op"] == "null":
+            node = Node(None, entry["name"], attrs=dict(params, **uattrs))
+        else:
+            op = _reg.get_op(entry["op"])
+            node = Node(op, entry["name"], params=params, attrs=uattrs)
+        node.inputs = [(nodes[i], j) for i, j, _ in entry["inputs"]]
+        nodes.append(node)
+    heads = [(nodes[i], j) for i, j, _ in data["heads"]]
+    return Symbol(heads)
+
+
+# ---------------------------------------------------------------------------
+# symbolic op invocation
+# ---------------------------------------------------------------------------
+
+
+def _sym_invoke(op_name, sym_inputs, params, name=None, attr=None):
+    op = _reg.get_op(op_name)
+    params = {k: v for k, v in params.items() if v is not None}
+    if name is None:
+        name = _NameManager.get().fresh(op.name)
+    input_names = op.input_names_for(params)
+    inputs = []
+    for i, s in enumerate(sym_inputs):
+        if s is None:
+            continue
+        if len(s._outputs) != 1:
+            raise ValueError("op inputs must be single-output symbols")
+        inputs.append(s._outputs[0])
+    # auto-create missing declared inputs as variables (reference behavior:
+    # sym.Convolution(data=d, ...) creates convN_weight / convN_bias)
+    if input_names and len(inputs) < len(input_names):
+        for nm in input_names[len(inputs):]:
+            inputs.append((Node(None, "%s_%s" % (name, nm)), 0))
+    node = Node(op, name, params=params, inputs=inputs,
+                attrs=dict(attr or {}))
+    n_vis = op.n_visible(params)
+    return Symbol([(node, i) for i in range(n_vis)])
+
+
+def _sym_binary(op_name, scalar_op, lhs, rhs):
+    if isinstance(rhs, Symbol):
+        return _sym_invoke(op_name, [lhs, rhs], {})
+    return _sym_invoke(scalar_op, [lhs], {"scalar": float(rhs)})
+
+
+# ---------------------------------------------------------------------------
+# shape inference
+# ---------------------------------------------------------------------------
+
+# per-op inference rules for ops whose parameter shapes must be deduced
+# bottom-up (reference: FInferShape attrs).  rule(params, in_shapes) ->
+# (in_shapes, out_shapes); in_shapes entries may start as None.
+
+_SHAPE_RULES = {}
+
+
+def shape_rule(name):
+    def _reg_rule(fn):
+        _SHAPE_RULES[name] = fn
+        return fn
+    return _reg_rule
+
+
+@shape_rule("FullyConnected")
+def _fc_shape(params, ins):
+    data, weight = ins[0], ins[1]
+    nh = int(params.get("num_hidden", 0))
+    flatten = params.get("flatten", True)
+    if data is not None:
+        in_units = 1
+        if flatten:
+            for d in data[1:]:
+                in_units *= d
+            out = (data[0], nh)
+        else:
+            in_units = data[-1]
+            out = tuple(data[:-1]) + (nh,)
+        ins = list(ins)
+        ins[1] = (nh, in_units)
+        if len(ins) > 2:
+            ins[2] = (nh,)
+        return ins, [out]
+    return ins, [None]
+
+
+@shape_rule("Convolution")
+def _conv_shape(params, ins):
+    data = ins[0]
+    kernel = tuple(params.get("kernel", ()))
+    nf = int(params.get("num_filter", 0))
+    ng = int(params.get("num_group", 1))
+    nd = len(kernel)
+    stride = params.get("stride") or (1,) * nd
+    dilate = params.get("dilate") or (1,) * nd
+    pad = params.get("pad") or (0,) * nd
+    if data is not None:
+        c = data[1]
+        ins = list(ins)
+        ins[1] = (nf, c // ng) + kernel
+        if len(ins) > 2:
+            ins[2] = (nf,)
+        spatial = []
+        for i in range(nd):
+            eff_k = (kernel[i] - 1) * dilate[i] + 1
+            spatial.append((data[2 + i] + 2 * pad[i] - eff_k) // stride[i]
+                           + 1)
+        return ins, [(data[0], nf) + tuple(spatial)]
+    return ins, [None]
+
+
+@shape_rule("Deconvolution")
+def _deconv_shape(params, ins):
+    data = ins[0]
+    kernel = tuple(params.get("kernel", ()))
+    nf = int(params.get("num_filter", 0))
+    ng = int(params.get("num_group", 1))
+    nd = len(kernel)
+    stride = params.get("stride") or (1,) * nd
+    dilate = params.get("dilate") or (1,) * nd
+    pad = params.get("pad") or (0,) * nd
+    adj = params.get("adj") or (0,) * nd
+    if data is not None:
+        c = data[1]
+        ins = list(ins)
+        ins[1] = (c, nf // ng) + kernel
+        if len(ins) > 2:
+            ins[2] = (nf,)
+        spatial = []
+        for i in range(nd):
+            eff_k = (kernel[i] - 1) * dilate[i] + 1
+            spatial.append((data[2 + i] - 1) * stride[i] - 2 * pad[i] +
+                           eff_k + adj[i])
+        return ins, [(data[0], nf) + tuple(spatial)]
+    return ins, [None]
+
+
+def _chan_param_shape(params, ins, n_extra):
+    data = ins[0]
+    axis = int(params.get("axis", 1))
+    if data is not None:
+        c = data[axis % len(data)]
+        ins = list(ins)
+        for i in range(1, 1 + n_extra):
+            if i < len(ins):
+                ins[i] = (c,)
+        return ins, [data]
+    return ins, [None]
+
+
+@shape_rule("BatchNorm")
+def _bn_shape(params, ins):
+    ins, outs = _chan_param_shape(params, ins, 4)
+    data = ins[0]
+    if data is not None:
+        axis = int(params.get("axis", 1))
+        c = (data[axis % len(data)],)
+        return ins, [data, c, c, c, c]
+    return ins, [None] * 5
+
+
+@shape_rule("LayerNorm")
+def _ln_shape(params, ins):
+    data = ins[0]
+    axis = int(params.get("axis", -1))
+    if data is not None:
+        c = (data[axis % len(data)],)
+        ins = list(ins)
+        ins[1] = c
+        ins[2] = c
+        red = tuple(d for i, d in enumerate(data)
+                    if i != axis % len(data))
+        return ins, [data, red, red]
+    return ins, [None] * 3
+
+
+@shape_rule("InstanceNorm")
+def _in_shape(params, ins):
+    return _chan_param_shape(params, ins, 2)
+
+
+@shape_rule("Embedding")
+def _emb_shape(params, ins):
+    data = ins[0]
+    ins = list(ins)
+    ins[1] = (int(params["input_dim"]), int(params["output_dim"]))
+    if data is not None:
+        return ins, [tuple(data) + (int(params["output_dim"]),)]
+    return ins, [None]
+
+
+@shape_rule("LeakyReLU")
+def _lrelu_shape(params, ins):
+    if params.get("act_type", "leaky") == "prelu":
+        return _chan_param_shape(params, ins, 1)
+    return ins, [ins[0]]
+
+
+_SAME_SHAPE_BIN = True
+
+
+def _infer_shapes(symbol, known_var_shapes, partial=False):
+    """Iteratively propagate shapes.  Returns ({(node_id, out_idx): shape},
+    {var_name: shape}) or raises MXNetError when not inferable (unless
+    partial)."""
+    import jax
+
+    order = symbol._topo()
+    var_sh = dict(known_var_shapes)
+    # seed from var attrs
+    for n in order:
+        if n.is_var and "__shape__" in n.attrs and n.name not in var_sh:
+            var_sh[n.name] = tuple(n.attrs["__shape__"])
+    node_sh = {}
+
+    def in_shape(node, i):
+        src, idx = node.inputs[i]
+        if src.is_var:
+            return var_sh.get(src.name)
+        return node_sh.get((id(src), idx))
+
+    def set_in_shape(node, i, shp):
+        if shp is None:
+            return
+        src, idx = node.inputs[i]
+        if src.is_var:
+            prev = var_sh.get(src.name)
+            if prev is not None and tuple(prev) != tuple(shp):
+                raise MXNetError(
+                    "inferred shape %s for %s conflicts with %s" %
+                    (shp, src.name, prev))
+            var_sh[src.name] = tuple(shp)
+
+    for _ in range(3):  # a few passes for bidirectional rules
+        progress = False
+        for node in order:
+            if node.is_var:
+                continue
+            key = id(node)
+            ins = [in_shape(node, i) for i in range(len(node.inputs))]
+            rule = _SHAPE_RULES.get(node.op.name)
+            if rule is not None:
+                new_ins, outs = rule(node.params, ins)
+                for i, shp in enumerate(new_ins):
+                    set_in_shape(node, i, shp)
+                ins = new_ins
+            elif all(s is not None for s in ins):
+                outs = _eval_shape_op(node, ins)
+            elif node.op.name.startswith(("broadcast_", "elemwise_")) and \
+                    any(s is not None for s in ins):
+                # bidirectional same-shape for elemwise (reference behavior)
+                shp = next(s for s in ins if s is not None)
+                for i in range(len(ins)):
+                    set_in_shape(node, i, shp)
+                ins = [shp] * len(ins)
+                outs = _eval_shape_op(node, ins)
+            else:
+                outs = [None] * node.num_outputs()
+            for i, o in enumerate(outs):
+                if o is not None and (key, i) not in node_sh:
+                    node_sh[(key, i)] = tuple(o)
+                    progress = True
+        if not progress:
+            break
+
+    if not partial:
+        missing = [n.name for n in order if n.is_var and
+                   n.name not in var_sh]
+        if missing:
+            raise MXNetError("cannot infer shapes for arguments: %s "
+                             "(provide them to infer_shape/simple_bind)" %
+                             missing)
+    return node_sh, var_sh
+
+
+def _eval_shape_op(node, in_shapes):
+    """Output shapes via jax.eval_shape on the op fn."""
+    import jax
+    import jax.numpy as jnp
+
+    specs = [jax.ShapeDtypeStruct(tuple(s), jnp.float32)
+             for s in in_shapes]
+    params = node.params
+
+    def call(*arrs):
+        if node.op.needs_rng:
+            key = jax.random.PRNGKey(0)
+            out = node.op.fn(key, *arrs, **params)
+        else:
+            out = node.op.fn(*arrs, **params)
+        return out
+
+    try:
+        out = jax.eval_shape(call, *specs)
+    except Exception:
+        return [None] * node.num_outputs()
+    if not isinstance(out, (tuple, list)):
+        out = (out,)
+    return [tuple(o.shape) for o in out]

@@ -1,0 +1,187 @@
+"""Symbol graph + executor (reference: tests/python/unittest/test_symbol.py,
+test_executor.py)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym, nd
+
+
+def _mlp():
+    data = sym.var("data")
+    fc1 = sym.FullyConnected(data=data, num_hidden=16, name="fc1")
+    act = sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = sym.FullyConnected(act, num_hidden=10, name="fc2")
+    return sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def test_list_arguments():
+    out = _mlp()
+    assert out.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+        "softmax_label"]
+    assert out.list_outputs() == ["softmax_output"]
+
+
+def test_compose_no_bias():
+    data = sym.var("data")
+    fc = sym.FullyConnected(data, num_hidden=4, no_bias=True, name="fc")
+    assert fc.list_arguments() == ["data", "fc_weight"]
+
+
+def test_infer_shape():
+    out = _mlp()
+    arg_shapes, out_shapes, aux_shapes = out.infer_shape(
+        data=(32, 784), softmax_label=(32,))
+    assert arg_shapes[1] == (16, 784)
+    assert arg_shapes[3] == (10, 16)
+    assert out_shapes == [(32, 10)]
+    assert aux_shapes == []
+
+
+def test_infer_shape_conv():
+    data = sym.var("data")
+    conv = sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                           name="conv")
+    pool = sym.Pooling(conv, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    args, outs, _ = pool.infer_shape(data=(2, 3, 8, 8))
+    assert args[1] == (8, 3, 3, 3)
+    assert outs == [(2, 8, 4, 4)]
+
+
+def test_batchnorm_aux():
+    data = sym.var("data")
+    bn = sym.BatchNorm(data, name="bn")
+    assert bn.list_arguments() == ["data", "bn_gamma", "bn_beta"]
+    assert bn.list_auxiliary_states() == ["bn_moving_mean", "bn_moving_var"]
+    args, outs, aux = bn.infer_shape(data=(4, 3, 8, 8))
+    assert aux == [(3,), (3,)]
+
+
+def test_json_roundtrip():
+    out = _mlp()
+    js = out.tojson()
+    back = sym.load_json(js)
+    assert back.list_arguments() == out.list_arguments()
+    assert back.list_outputs() == out.list_outputs()
+    a1, o1, _ = out.infer_shape(data=(4, 32), softmax_label=(4,))
+    a2, o2, _ = back.infer_shape(data=(4, 32), softmax_label=(4,))
+    assert a1 == a2 and o1 == o2
+
+
+def test_save_load(tmp_path):
+    f = str(tmp_path / "net.json")
+    out = _mlp()
+    out.save(f)
+    back = sym.load(f)
+    assert back.list_arguments() == out.list_arguments()
+
+
+def test_group_and_getitem():
+    a = sym.var("a")
+    b = sym.var("b")
+    c = a + b
+    g = sym.Group([c, a * b])
+    assert len(g) == 2
+    first = g[0]
+    assert len(first) == 1
+
+
+def test_get_internals():
+    out = _mlp()
+    internals = out.get_internals()
+    names = internals.list_outputs()
+    assert "fc1_output" in names
+
+
+def test_symbol_arith_forward():
+    a = sym.var("a")
+    b = sym.var("b")
+    c = 2 * a + b * b - 3
+    ex = c.bind(mx.cpu(), {"a": nd.array([1.0, 2.0]),
+                           "b": nd.array([3.0, 4.0])})
+    out = ex.forward()[0]
+    np.testing.assert_allclose(out.asnumpy(), [2 + 9 - 3, 4 + 16 - 3])
+
+
+def test_executor_forward_backward():
+    out = _mlp()
+    ex = out.simple_bind(ctx=mx.cpu(), data=(8, 20), softmax_label=(8,))
+    rng = np.random.RandomState(0)
+    ex.arg_dict["fc1_weight"][:] = rng.randn(16, 20).astype(np.float32) * .1
+    ex.arg_dict["fc2_weight"][:] = rng.randn(10, 16).astype(np.float32) * .1
+    x = rng.randn(8, 20).astype(np.float32)
+    y = rng.randint(0, 10, (8,)).astype(np.float32)
+    outs = ex.forward(is_train=True, data=x, softmax_label=y)
+    np.testing.assert_allclose(outs[0].asnumpy().sum(), 8.0, rtol=1e-5)
+    ex.backward()
+    # CE gradient w.r.t. logits sums to 0 per-row before scaling
+    g = ex.grad_dict["fc2_bias"].asnumpy()
+    assert np.abs(g).sum() > 0
+    # data grad not requested by default? grad_req=write for all args
+    assert ex.grad_dict["data"].shape == (8, 20)
+
+
+def test_executor_grad_req():
+    a = sym.var("a")
+    loss = sym.make_loss((a * a).sum())
+    av = nd.array([2.0])
+    ex = loss.bind(mx.cpu(), {"a": av}, args_grad={"a": nd.zeros((1,))},
+                   grad_req="add")
+    for _ in range(2):
+        ex.forward(is_train=True)
+        ex.backward()
+    np.testing.assert_allclose(ex.grad_dict["a"].asnumpy(), [8.0])
+
+
+def test_executor_forward_backward_fused():
+    out = _mlp()
+    ex = out.simple_bind(ctx=mx.cpu(), data=(4, 12), softmax_label=(4,))
+    rng = np.random.RandomState(1)
+    ex.arg_dict["fc1_weight"][:] = rng.randn(16, 12).astype(np.float32) * .1
+    ex.arg_dict["fc2_weight"][:] = rng.randn(10, 16).astype(np.float32) * .1
+    x = rng.randn(4, 12).astype(np.float32)
+    y = np.zeros((4,), np.float32)
+    outs = ex.forward_backward(data=x, softmax_label=y)
+    assert outs[0].shape == (4, 10)
+    g1 = ex.grad_dict["fc1_weight"].asnumpy().copy()
+    # matches forward + backward path
+    ex2 = out.simple_bind(ctx=mx.cpu(), data=(4, 12), softmax_label=(4,))
+    ex2.arg_dict["fc1_weight"][:] = ex.arg_dict["fc1_weight"].asnumpy()
+    ex2.arg_dict["fc2_weight"][:] = ex.arg_dict["fc2_weight"].asnumpy()
+    ex2.forward(is_train=True, data=x, softmax_label=y)
+    ex2.backward()
+    np.testing.assert_allclose(ex2.grad_dict["fc1_weight"].asnumpy(), g1,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_simple_bind_shared_exec():
+    out = _mlp()
+    ex = out.simple_bind(ctx=mx.cpu(), data=(4, 12), softmax_label=(4,))
+    ex.arg_dict["fc1_weight"][:] = 1.0
+    ex2 = out.simple_bind(ctx=mx.cpu(), data=(8, 12), softmax_label=(8,),
+                          shared_exec=ex)
+    # weights shared, data not (different shape)
+    assert ex2.arg_dict["fc1_weight"] is ex.arg_dict["fc1_weight"]
+    assert ex2.arg_dict["data"] is not ex.arg_dict["data"]
+
+
+def test_executor_dropout_train_vs_infer():
+    data = sym.var("data")
+    out = sym.Dropout(data, p=0.5, name="drop")
+    ex = out.simple_bind(ctx=mx.cpu(), data=(50, 50))
+    x = np.ones((50, 50), np.float32)
+    infer = ex.forward(is_train=False, data=x)[0].asnumpy()
+    np.testing.assert_allclose(infer, x)
+    train = ex.forward(is_train=True, data=x)[0].asnumpy()
+    assert (train == 0).mean() > 0.3
+
+
+def test_variable_shape_attr():
+    a = sym.var("a", shape=(3, 4))
+    b = sym.var("b")
+    c = sym.broadcast_add(a, b)
+    args, outs, _ = c.infer_shape()
+    assert args == [(3, 4), (3, 4)]
+    assert outs == [(3, 4)]
