@@ -18,6 +18,12 @@ from . import symbol as sym  # noqa: F401
 from .executor import Executor  # noqa: F401
 from . import random  # noqa: F401
 from . import autograd  # noqa: F401
+from . import initializer  # noqa: F401
+from .initializer import init  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import lr_scheduler  # noqa: F401
+from . import metric  # noqa: F401
+from . import gluon  # noqa: F401
 from .runtime import engine  # noqa: F401
 
 

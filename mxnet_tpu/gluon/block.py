@@ -1,0 +1,583 @@
+"""Gluon Block / HybridBlock / SymbolBlock.
+
+Reference: ``python/mxnet/gluon/block.py`` (1,162 LoC: Block:127,
+HybridBlock:673, hybridize -> CachedOp block.py:787,797, SymbolBlock:954)
+over ``src/imperative/cached_op.cc``.
+
+TPU-native CachedOp: hybridizing traces ``hybrid_forward`` once with Symbol
+proxies, then compiles the whole block into a single jitted XLA program
+(static_alloc/static_shape are implied — XLA plans memory at compile time;
+the reference's StaticAllocMemory/StaticRunOps machinery, cached_op.cc:469+,
+is the compiler's job here).  Under autograd the entire cached program is
+one tape node, so backward is one fused VJP program.
+"""
+
+from __future__ import annotations
+
+import copy
+import re
+import threading
+from collections import OrderedDict
+
+import jax
+
+from ..base import MXNetError, dtype_name
+from ..context import Context, current_context
+from .. import ndarray as nd
+from ..ndarray import NDArray
+from .. import symbol as sym_mod
+from .. import autograd
+from .parameter import (Parameter, ParameterDict,
+                        DeferredInitializationError)
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+
+
+class _BlockScope:
+    """Name scoping for Blocks (reference: block.py _BlockScope)."""
+
+    _current = threading.local()
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+        self._old_scope = None
+        self._name_scope = None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        current = getattr(_BlockScope._current, "value", None)
+        if current is None:
+            if prefix is None:
+                prefix = _name_unique(hint) + "_"
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            prefix = "%s%d_" % (hint, count)
+            current._counter[hint] = count + 1
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix, parent._shared)
+        else:
+            params = ParameterDict(params.prefix, params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        if self._block._empty_prefix:
+            return self
+        self._old_scope = getattr(_BlockScope._current, "value", None)
+        _BlockScope._current.value = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        if self._block._empty_prefix:
+            return
+        _BlockScope._current.value = self._old_scope
+
+
+_GLOBAL_NAME_COUNTER = {}
+
+
+def _name_unique(hint):
+    n = _GLOBAL_NAME_COUNTER.get(hint, 0)
+    _GLOBAL_NAME_COUNTER[hint] = n + 1
+    return "%s%d" % (hint, n)
+
+
+def _flatten(args, inout_str):
+    if isinstance(args, NDArray) or isinstance(args, sym_mod.Symbol):
+        return [args], int(0)
+    if args is None:
+        return [None], int(-1)
+    assert isinstance(args, (list, tuple)), \
+        "HybridBlock %s must be (nested) list of Symbol or NDArray, " \
+        "but got %s of type %s" % (inout_str, str(args), str(type(args)))
+    flat = []
+    fmts = []
+    for i in args:
+        arg, fmt = _flatten(i, inout_str)
+        flat.extend(arg)
+        fmts.append(fmt)
+    return flat, fmts
+
+
+def _regroup(args, fmt):
+    if isinstance(fmt, int):
+        if fmt == -1:
+            return None, args[1:]
+        if fmt == 0:
+            return args[0], args[1:]
+        return args[:fmt], args[fmt:]
+    ret = []
+    for i in fmt:
+        res, args = _regroup(args, i)
+        ret.append(res)
+    return ret, args
+
+
+class Block:
+    """Base class for all layers and models (reference: block.py Block:127).
+    """
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(
+            prefix, params, self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") \
+            else self._prefix
+        self._scope = _BlockScope(self)
+        self._children = OrderedDict()
+        self._reg_params = {}
+        self._forward_hooks = OrderedDict()
+        self._forward_pre_hooks = OrderedDict()
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    def __repr__(self):
+        s = "{name}(\n{modstr}\n)"
+        modstr = "\n".join("  ({key}): {block}".format(
+            key=key, block=_indent(str(block), 2))
+            for key, block in self._children.items())
+        return s.format(name=self.__class__.__name__, modstr=modstr) \
+            if modstr else "%s()" % self.__class__.__name__
+
+    def __setattr__(self, name, value):
+        existing = getattr(self, name, None)
+        if isinstance(existing, (Parameter, Block)) and \
+                not isinstance(value, type(existing)):
+            raise TypeError("Changing attribute type for %s from %s to %s"
+                            " is not allowed." % (
+                                name, type(existing), type(value)))
+        if isinstance(value, Block):
+            self.register_child(value, name)
+        elif isinstance(value, Parameter):
+            assert name not in self._reg_params or \
+                self._reg_params[name] is value, \
+                "Overriding Parameter attribute %s is not allowed." % name
+            self._reg_params[name] = value
+        super().__setattr__(name, value)
+
+    def _check_container_with_block(self):
+        pass
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    def name_scope(self):
+        return self._scope
+
+    @property
+    def params(self):
+        return self._params
+
+    def collect_params(self, select=None):
+        """All Parameters of this Block and its children
+        (reference: block.py collect_params)."""
+        self._check_container_with_block()
+        ret = ParameterDict(self._params.prefix)
+        if not select:
+            ret.update(self.params)
+        else:
+            pattern = re.compile(select)
+            ret.update({name: value for name, value in self.params.items()
+                        if pattern.match(name)})
+        for child in self._children.values():
+            ret.update(child.collect_params(select=select))
+        return ret
+
+    def register_child(self, block, name=None):
+        if name is None:
+            name = str(len(self._children))
+        self._children[name] = block
+
+    def register_forward_hook(self, hook):
+        self._forward_hooks[len(self._forward_hooks)] = hook
+
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks[len(self._forward_pre_hooks)] = hook
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        from .. import initializer
+        if init is None:
+            init = initializer.Uniform()
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def hybridize(self, active=True, **kwargs):
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for _, param in self.params.items():
+            param.cast(dtype)
+
+    # -- checkpointing -----------------------------------------------------
+    def save_parameters(self, filename):
+        """Save parameters (reference: block.py save_parameters:315).
+        Keys are stripped of the block prefix so files are
+        architecture-portable."""
+        params = self._collect_params_with_prefix()
+        arg_dict = {key: val.data() for key, val in params.items()}
+        nd.save(filename, arg_dict)
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False):
+        loaded = nd.load(filename)
+        params = self._collect_params_with_prefix()
+        if not loaded and not params:
+            return
+        for name in params if not allow_missing else []:
+            if name not in loaded:
+                raise AssertionError(
+                    "Parameter %r is missing in file %r" % (name, filename))
+        for name in loaded:
+            if name not in params:
+                if not ignore_extra:
+                    raise AssertionError(
+                        "Parameter %r loaded from file %r is not present "
+                        "in this block" % (name, filename))
+                continue
+            param = params[name]
+            if param._data is None and param._deferred_init is not None:
+                param.shape = loaded[name].shape
+                param._finish_deferred_init()
+            elif param._data is None:
+                param._shape = loaded[name].shape
+                param.initialize(ctx=ctx or current_context())
+            param.set_data(loaded[name])
+
+    def _collect_params_with_prefix(self, prefix=""):
+        if prefix:
+            prefix += "."
+        ret = {prefix + key: val for key, val in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
+    # legacy-name API
+    save_params = save_parameters
+
+    def load_params(self, filename, ctx=None, allow_missing=False,
+                    ignore_extra=False):
+        self.load_parameters(filename, ctx, allow_missing, ignore_extra)
+
+    # -- execution ---------------------------------------------------------
+    def __call__(self, *args):
+        for hook in self._forward_pre_hooks.values():
+            hook(self, args)
+        out = self.forward(*args)
+        for hook in self._forward_hooks.values():
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+    def summary(self, *inputs):
+        # minimal: run a forward and report parameter count
+        out = self(*inputs)
+        n = 0
+        for p in self.collect_params().values():
+            if p._data is not None:
+                n += p.data().size
+        print("Total params: %d" % n)
+        return out
+
+
+def _indent(s, num_spaces):
+    lines = s.split("\n")
+    if len(lines) == 1:
+        return s
+    first = lines.pop(0)
+    return first + "\n" + "\n".join(" " * num_spaces + line
+                                    for line in lines)
+
+
+class _CachedGraph:
+    """Compiled trace of a HybridBlock (the CachedOp equivalent)."""
+
+    def __init__(self, block, flat_inputs):
+        # trace with symbol proxies
+        data_syms = [sym_mod.var("data%d" % i)
+                     for i in range(len(flat_inputs))]
+        param_syms = {n: p.var() for n, p in block._reg_params.items()}
+        with block._trace_scope():
+            if len(data_syms) == 1:
+                out = block.hybrid_forward(sym_mod, data_syms[0],
+                                           **param_syms)
+            else:
+                out = block.hybrid_forward(sym_mod, *data_syms, **param_syms)
+        flat_out, self._out_fmt = _flatten(out, "output")
+        self.symbol = sym_mod.Group(flat_out) if len(flat_out) > 1 \
+            else flat_out[0]
+        self.input_names = ["data%d" % i for i in range(len(flat_inputs))]
+        args = self.symbol.list_arguments()
+        auxs = set(self.symbol.list_auxiliary_states())
+        self.param_names = [a for a in args if a not in self.input_names]
+        self.aux_names = list(self.symbol.list_auxiliary_states())
+        from ..executor import _build_eval
+        self._eval_train = _build_eval(self.symbol, True)
+        self._eval_infer = _build_eval(self.symbol, False)
+        self._jit_train = jax.jit(self._eval_train)
+        self._jit_infer = jax.jit(self._eval_infer)
+        del auxs
+
+    def run(self, block, flat_inputs):
+        params = {p.name: p for p in block.collect_params().values()}
+        arg_map = {n: x._data for n, x in zip(self.input_names, flat_inputs)}
+        diff_names = []
+        for n in self.param_names:
+            arr = params[n].data()
+            arg_map[n] = arr._data
+            diff_names.append(n)
+        aux_map = {n: params[n].data()._data for n in self.aux_names}
+        training = autograd.is_training()
+        key = _next_block_key()
+        fn = self._jit_train if training else self._jit_infer
+        outs, auxu = fn(arg_map, aux_map, key)
+        for n, v in auxu.items():
+            params[n].data()._data = v
+        out_nds = [NDArray(o) for o in outs]
+        if autograd.is_recording():
+            # one tape node for the whole cached graph
+            input_nds = list(flat_inputs) + [params[n].data()
+                                             for n in diff_names]
+            in_names = list(self.input_names) + diff_names
+            eval_train = self._eval_train
+            aux_snapshot = dict(aux_map)
+
+            def fused(*arrays):
+                amap = dict(zip(in_names, arrays))
+                o, _ = eval_train(amap, aux_snapshot, key)
+                return tuple(o)
+
+            autograd.record_op(fused, input_nds, out_nds)
+        out, _ = _regroup(out_nds, self._out_fmt)
+        return out
+
+
+_block_key_state = [jax.random.PRNGKey(17), 0]
+
+
+def _next_block_key():
+    _block_key_state[1] += 1
+    return jax.random.fold_in(_block_key_state[0], _block_key_state[1])
+
+
+class HybridBlock(Block):
+    """Block that can be traced and compiled (reference: HybridBlock:673)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._cached_graph = None
+        self._flags = {}
+
+    def _trace_scope(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def scope():
+            yield
+        return scope()
+
+    def hybridize(self, active=True, **kwargs):
+        self._active = active
+        self._flags = kwargs
+        self._cached_graph = None
+        super().hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        self._cached_graph = None
+        super().cast(dtype)
+
+    def register_child(self, block, name=None):
+        if not isinstance(block, HybridBlock):
+            if not isinstance(block, SymbolBlock):
+                pass
+        super().register_child(block, name)
+        self._cached_graph = None
+
+    def infer_shape(self, *args):
+        """Infer deferred parameter shapes from input shapes via the
+        symbolic trace (reference: block.py _deferred_infer_shape)."""
+        self._infer_attrs(*args)
+
+    def _infer_attrs(self, *args):
+        flat, _ = _flatten(args, "input")
+        data_shapes = {"data%d" % i: x.shape for i, x in enumerate(flat)}
+        data_syms = [sym_mod.var("data%d" % i) for i in range(len(flat))]
+        param_syms = {n: sym_mod.var(p.name)
+                      for n, p in self._reg_params.items()}
+        out = self.hybrid_forward(sym_mod, *data_syms, **param_syms)
+        flat_out, _ = _flatten(out, "output")
+        symbol = sym_mod.Group(flat_out) if len(flat_out) > 1 \
+            else flat_out[0]
+        from ..symbol.symbol import _infer_shapes
+        _, var_sh = _infer_shapes(symbol, data_shapes, partial=True)
+        params = {p.name: p for p in self.collect_params().values()}
+        for name, shape in var_sh.items():
+            if name in params and shape is not None:
+                params[name].shape = tuple(shape)
+        for p in params.values():
+            if p._deferred_init is not None:
+                p._finish_deferred_init()
+
+    def forward(self, x, *args):
+        """Dispatch: Symbol input -> symbolic trace (used when a parent is
+        being hybridized); hybridized -> cached XLA program; else imperative
+        hybrid_forward with F=nd."""
+        if isinstance(x, sym_mod.Symbol):
+            param_syms = {n: p.var() for n, p in self._reg_params.items()}
+            return self.hybrid_forward(sym_mod, x, *args, **param_syms)
+        if self._active:
+            if self._cached_graph is None:
+                flat, self._in_fmt = _flatten([x] + list(args), "input")
+                try:
+                    self._ensure_params(x, *args)
+                    self._cached_graph = _CachedGraph(self, flat)
+                except DeferredInitializationError:
+                    raise
+            flat, _ = _flatten([x] + list(args), "input")
+            return self._cached_graph.run(self, flat)
+        # imperative path
+        self._ensure_params(x, *args)
+        params = {n: p.data() for n, p in self._reg_params.items()}
+        return self.hybrid_forward(nd, x, *args, **params)
+
+    def _ensure_params(self, *args):
+        deferred = [p for p in self.collect_params().values()
+                    if p._deferred_init is not None]
+        if deferred:
+            self._infer_attrs(*args)
+        # trigger friendly error if not initialized at all
+        for p in self.collect_params().values():
+            p._check_initialized()
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    def export(self, path, epoch=0):
+        """Export to symbol JSON + params (reference: block.py export —
+        format: path-symbol.json + path-NNNN.params)."""
+        if self._cached_graph is None:
+            raise RuntimeError(
+                "Please call hybridize and run forward at least once before "
+                "calling export.")
+        sym_file = "%s-symbol.json" % path
+        self._cached_graph.symbol.save(sym_file)
+        arg_dict = {}
+        params = {p.name: p for p in self.collect_params().values()}
+        for name in self._cached_graph.param_names:
+            arg_dict["arg:%s" % name] = params[name].data()
+        for name in self._cached_graph.aux_names:
+            arg_dict["aux:%s" % name] = params[name].data()
+        nd.save("%s-%04d.params" % (path, epoch), arg_dict)
+        return sym_file
+
+
+class SymbolBlock(HybridBlock):
+    """Wrap a Symbol as a Block (reference: block.py SymbolBlock:954)."""
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        symbol = sym_mod.load(symbol_file)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        inputs = [sym_mod.var(n) for n in input_names]
+        ret = SymbolBlock(symbol, inputs)
+        if param_file is not None:
+            params = nd.load(param_file)
+            arg_params = {}
+            for k, v in params.items():
+                if k.startswith(("arg:", "aux:")):
+                    arg_params[k[4:]] = v
+                else:
+                    arg_params[k] = v
+            for name, param in ret.collect_params().items():
+                if name in arg_params:
+                    param._shape = arg_params[name].shape
+                    param.initialize(ctx=ctx or current_context())
+                    param.set_data(arg_params[name])
+        return ret
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix="", params=params)
+        if isinstance(outputs, (list, tuple)) and len(outputs) == 1:
+            outputs = outputs[0]
+        if isinstance(outputs, (list, tuple)):
+            outputs = sym_mod.Group(outputs)
+        if isinstance(inputs, sym_mod.Symbol):
+            inputs = [inputs]
+        self._symbol = outputs
+        self._input_names = [i.name for i in inputs]
+        arg_names = outputs.list_arguments()
+        aux_names = set(outputs.list_auxiliary_states())
+        for name in arg_names:
+            if name not in self._input_names:
+                self.params.get(name, allow_deferred_init=True,
+                                grad_req="write")
+        for name in outputs.list_auxiliary_states():
+            self.params.get(name, allow_deferred_init=True, grad_req="null")
+        self._jit_cache = {}
+
+    def forward(self, *args):
+        flat, _ = _flatten(list(args), "input")
+        arg_map = {n: x._data for n, x in zip(self._input_names, flat)}
+        params = dict(self.collect_params().items())
+        aux_names = set(self._symbol.list_auxiliary_states())
+        aux_map = {}
+        diff_names = []
+        for name in self._symbol.list_arguments():
+            if name in arg_map:
+                continue
+            arg_map[name] = params[name].data()._data
+            diff_names.append(name)
+        for name in aux_names:
+            aux_map[name] = params[name].data()._data
+        training = autograd.is_training()
+        key = ("train" if training else "infer")
+        if key not in self._jit_cache:
+            from ..executor import _build_eval
+            ev = _build_eval(self._symbol, training)
+            self._jit_cache[key] = (ev, jax.jit(ev))
+        ev, jfn = self._jit_cache[key]
+        outs, auxu = jfn(arg_map, aux_map, _next_block_key())
+        for n, v in auxu.items():
+            params[n].data()._data = v
+        out_nds = [NDArray(o) for o in outs]
+        if autograd.is_recording():
+            in_names = self._input_names + diff_names
+            input_nds = list(flat) + [params[n].data() for n in diff_names]
+            aux_snapshot = dict(aux_map)
+            k2 = _next_block_key()
+
+            def fused(*arrays):
+                amap = dict(zip(in_names, arrays))
+                o, _ = ev(amap, aux_snapshot, k2)
+                return tuple(o)
+            autograd.record_op(fused, input_nds, out_nds)
+        if len(out_nds) == 1:
+            return out_nds[0]
+        return out_nds
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError

@@ -1,0 +1,154 @@
+"""Gluon Trainer (reference: python/mxnet/gluon/trainer.py, 423 LoC:
+Trainer:27, _init_kvstore:158, step:254, allreduce_grads:282, update:314).
+
+TPU-native: with a single device (or one logical sharded copy) the trainer
+applies fused update ops directly; with multiple per-context replicas it
+reduces gradients across contexts (the reference's kvstore='device' path);
+with ``kvstore='tpu'`` gradient reduction happens in-graph over the mesh
+(see mxnet_tpu/kvstore.py) and the trainer only runs the update.
+"""
+
+from __future__ import annotations
+
+from .. import optimizer as opt
+from ..ndarray import NDArray
+from .parameter import ParameterDict, Parameter
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="device", compression_params=None,
+                 update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise ValueError(
+                "First argument must be a list or dict of Parameters, "
+                "got %s." % (type(params)))
+        self._params = []
+        self._param2idx = {}
+        for i, param in enumerate(params):
+            if not isinstance(param, Parameter):
+                raise ValueError(
+                    "First argument must be a list or dict of Parameters, "
+                    "got list of %s." % (type(param)))
+            self._params.append(param)
+            self._param2idx[param.name] = i
+        self._compression_params = compression_params
+        self._contexts = self._check_contexts()
+        optimizer_params = optimizer_params or {}
+        self._init_optimizer(optimizer, optimizer_params)
+        self._scale = self._optimizer.rescale_grad
+        self._kvstore_type = kvstore
+        self._kvstore = None
+        self._kv_initialized = False
+        self._update_on_kvstore = update_on_kvstore
+        self._params_to_init = list(self._params)
+
+    def _check_contexts(self):
+        contexts = None
+        for param in self._params:
+            ctx = param.list_ctx() if param._data is not None or \
+                param._deferred_init is not None else None
+            if ctx is None:
+                continue
+            assert contexts is None or contexts == ctx, \
+                "All Parameters must be initialized on the same set of " \
+                "contexts, but Parameter %s is initialized on %s while " \
+                "previous Parameters are initialized on %s." % (
+                    param.name, str(ctx), str(contexts))
+            contexts = ctx
+        return contexts or []
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            assert not optimizer_params, \
+                "optimizer_params must be None if optimizer is an " \
+                "Optimizer instance"
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt.create(optimizer,
+                                         param_dict=param_dict,
+                                         **optimizer_params)
+        self._updaters = [opt.get_updater(self._optimizer)
+                          for _ in self._contexts or [None]]
+
+    def _init_kvstore(self):
+        if self._kvstore_type and len(self._contexts) > 1 and \
+                self._kvstore_type not in ("device", "local"):
+            from .. import kvstore as kvs
+            self._kvstore = kvs.create(self._kvstore_type)
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def _row_sparse_pull(self, parameter, out, row_id,
+                         full_idx=False):
+        # single-copy path: weights are already local
+        pass
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """allreduce + optimizer update
+        (reference: trainer.py step:254)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self.allreduce_grads()
+        self.update(batch_size, ignore_stale_grad)
+
+    def allreduce_grads(self):
+        """Sum gradients across per-context replicas
+        (reference: _allreduce_grads:282 over kvstore push/pull)."""
+        for param in self._params:
+            if param.grad_req == "null" or param._data is None:
+                continue
+            grads = param.list_grad()
+            if len(grads) <= 1:
+                continue
+            total = grads[0]
+            for g in grads[1:]:
+                total = total + g.as_in_context(total.context)
+            for g in grads:
+                total.as_in_context(g.context).copyto(g)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null" or param._data is None:
+                continue
+            for upd, arr, grad in zip(self._updaters, param.list_data(),
+                                      param.list_grad()):
+                upd(i, grad, arr)
+            # re-mark so subsequent autograd passes see updated weights
+            if param._grad is not None:
+                from .. import autograd
+                for c, d in param._data.items():
+                    autograd.mark_variables([d], [param._grad[c]],
+                                            param._grad_req)
+
+    def save_states(self, fname):
+        assert self._optimizer is not None
+        with open(fname, "wb") as f:
+            f.write(self._updaters[0].get_states(dump_optimizer=False))
+
+    def load_states(self, fname):
+        with open(fname, "rb") as f:
+            states = f.read()
+        for updater in self._updaters:
+            updater.set_states(states)
+            updater.optimizer = self._optimizer

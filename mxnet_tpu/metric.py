@@ -1,0 +1,423 @@
+"""Evaluation metrics (reference: python/mxnet/metric.py, 1,424 LoC).
+
+Registry of EvalMetrics updated per batch; host-side numpy math (metrics are
+not on the training hot path — outputs are already device arrays, one
+``asnumpy`` sync per batch like the reference's update_metric)."""
+
+from __future__ import annotations
+
+import math
+
+import numpy as _np
+
+from .base import registry as _registry
+from .ndarray import NDArray
+
+_reg = _registry("metric")
+
+__all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
+           "F1", "MCC", "MAE", "MSE", "RMSE", "CrossEntropy",
+           "NegativeLogLikelihood", "PearsonCorrelation", "Perplexity",
+           "Loss", "Torch", "Caffe", "CustomMetric", "np", "create",
+           "register"]
+
+
+def _as_np(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return _np.asarray(x)
+
+
+def check_label_shapes(labels, preds, shape=False):
+    if not shape:
+        label_shape, pred_shape = len(labels), len(preds)
+    else:
+        label_shape, pred_shape = labels.shape, preds.shape
+    if label_shape != pred_shape:
+        raise ValueError("Shape of labels {} does not match shape of "
+                         "predictions {}".format(label_shape, pred_shape))
+
+
+class EvalMetric:
+    def __init__(self, name, output_names=None, label_names=None, **kwargs):
+        self.name = str(name)
+        self.output_names = output_names
+        self.label_names = label_names
+        self._kwargs = kwargs
+        self.reset()
+
+    def __str__(self):
+        return "EvalMetric: {}".format(dict(self.get_name_value()))
+
+    def get_config(self):
+        config = dict(self._kwargs)
+        config.update({"metric": type(self).__name__, "name": self.name,
+                       "output_names": self.output_names,
+                       "label_names": self.label_names})
+        return config
+
+    def update_dict(self, label, pred):
+        if self.output_names is not None:
+            pred = [pred[name] for name in self.output_names if name in pred]
+        else:
+            pred = list(pred.values())
+        if self.label_names is not None:
+            label = [label[name] for name in self.label_names
+                     if name in label]
+        else:
+            label = list(label.values())
+        self.update(label, pred)
+
+    def update(self, labels, preds):
+        raise NotImplementedError
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, self.sum_metric / self.num_inst)
+
+    def get_name_value(self):
+        name, value = self.get()
+        if not isinstance(name, list):
+            name = [name]
+        if not isinstance(value, list):
+            value = [value]
+        return list(zip(name, value))
+
+
+def register(klass=None, name=None, aliases=()):
+    if klass is None:
+        return lambda k: register(k, name, aliases)
+    _reg.register(klass, name=name, aliases=aliases)
+    return klass
+
+
+def create(metric, *args, **kwargs):
+    if callable(metric):
+        return CustomMetric(metric, *args, **kwargs)
+    if isinstance(metric, EvalMetric):
+        return metric
+    if isinstance(metric, list):
+        composite = CompositeEvalMetric()
+        for child in metric:
+            composite.add(create(child, *args, **kwargs))
+        return composite
+    return _reg.get(metric)(*args, **kwargs)
+
+
+@register
+class CompositeEvalMetric(EvalMetric):
+    def __init__(self, metrics=None, name="composite", output_names=None,
+                 label_names=None):
+        super().__init__(name, output_names, label_names)
+        self.metrics = [create(m) for m in (metrics or [])]
+
+    def add(self, metric):
+        self.metrics.append(create(metric))
+
+    def get_metric(self, index):
+        return self.metrics[index]
+
+    def update(self, labels, preds):
+        for metric in self.metrics:
+            metric.update(labels, preds)
+
+    def reset(self):
+        for metric in getattr(self, "metrics", []):
+            metric.reset()
+
+    def get(self):
+        names, values = [], []
+        for metric in self.metrics:
+            name, value = metric.get()
+            if isinstance(name, str):
+                name = [name]
+            if isinstance(value, (float, int)):
+                value = [value]
+            names.extend(name)
+            values.extend(value)
+        return (names, values)
+
+
+@register(aliases=("acc",))
+class Accuracy(EvalMetric):
+    def __init__(self, axis=1, name="accuracy", output_names=None,
+                 label_names=None):
+        super().__init__(name, output_names, label_names, axis=axis)
+        self.axis = axis
+
+    def update(self, labels, preds):
+        if isinstance(labels, NDArray):
+            labels = [labels]
+        if isinstance(preds, NDArray):
+            preds = [preds]
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            p = _as_np(pred)
+            l = _as_np(label).astype("int32")
+            if p.ndim > l.ndim:
+                p = p.argmax(axis=self.axis)
+            p = p.astype("int32").reshape(-1)
+            l = l.reshape(-1)
+            self.sum_metric += (p == l).sum()
+            self.num_inst += len(l)
+
+
+@register(aliases=("top_k_accuracy", "top_k_acc"))
+class TopKAccuracy(EvalMetric):
+    def __init__(self, top_k=1, name="top_k_accuracy", output_names=None,
+                 label_names=None):
+        super().__init__(name, output_names, label_names, top_k=top_k)
+        self.top_k = top_k
+        self.name += "_%d" % top_k
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            p = _as_np(pred)
+            l = _as_np(label).astype("int32")
+            num_samples = p.shape[0]
+            num_dims = p.ndim
+            if num_dims == 1:
+                self.sum_metric += (p.astype("int32") == l).sum()
+            else:
+                topk = _np.argpartition(p, -self.top_k,
+                                        axis=-1)[:, -self.top_k:]
+                for j in range(self.top_k):
+                    self.sum_metric += (topk[:, j] == l).sum()
+            self.num_inst += num_samples
+
+
+@register
+class F1(EvalMetric):
+    def __init__(self, name="f1", output_names=None, label_names=None,
+                 average="macro"):
+        super().__init__(name, output_names, label_names)
+        self.average = average
+        self._tp = self._fp = self._fn = 0.0
+
+    def reset(self):
+        super().reset()
+        self._tp = self._fp = self._fn = 0.0
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            p = _as_np(pred)
+            l = _as_np(label).astype("int32").reshape(-1)
+            if p.ndim > 1:
+                p = p.argmax(axis=1)
+            p = p.astype("int32").reshape(-1)
+            self._tp += ((p == 1) & (l == 1)).sum()
+            self._fp += ((p == 1) & (l == 0)).sum()
+            self._fn += ((p == 0) & (l == 1)).sum()
+            precision = self._tp / max(self._tp + self._fp, 1e-12)
+            recall = self._tp / max(self._tp + self._fn, 1e-12)
+            f1 = 2 * precision * recall / max(precision + recall, 1e-12)
+            self.sum_metric = f1
+            self.num_inst = 1
+
+
+@register
+class MCC(EvalMetric):
+    def __init__(self, name="mcc", output_names=None, label_names=None,
+                 average="macro"):
+        super().__init__(name, output_names, label_names)
+        self._tp = self._fp = self._tn = self._fn = 0.0
+
+    def reset(self):
+        super().reset()
+        self._tp = self._fp = self._tn = self._fn = 0.0
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            p = _as_np(pred)
+            l = _as_np(label).astype("int32").reshape(-1)
+            if p.ndim > 1:
+                p = p.argmax(axis=1)
+            p = p.astype("int32").reshape(-1)
+            self._tp += ((p == 1) & (l == 1)).sum()
+            self._fp += ((p == 1) & (l == 0)).sum()
+            self._tn += ((p == 0) & (l == 0)).sum()
+            self._fn += ((p == 0) & (l == 1)).sum()
+            terms = ((self._tp + self._fp) * (self._tp + self._fn) *
+                     (self._tn + self._fp) * (self._tn + self._fn))
+            denom = math.sqrt(terms) if terms > 0 else 1.0
+            self.sum_metric = (self._tp * self._tn -
+                               self._fp * self._fn) / denom
+            self.num_inst = 1
+
+
+@register
+class MAE(EvalMetric):
+    def __init__(self, name="mae", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            l = _as_np(label)
+            p = _as_np(pred)
+            if l.ndim == p.ndim - 1:
+                l = l.reshape(l.shape + (1,))
+            self.sum_metric += _np.abs(l - p).mean()
+            self.num_inst += 1
+
+
+@register
+class MSE(EvalMetric):
+    def __init__(self, name="mse", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            l = _as_np(label)
+            p = _as_np(pred)
+            if l.ndim == p.ndim - 1:
+                l = l.reshape(l.shape + (1,))
+            self.sum_metric += ((l - p) ** 2).mean()
+            self.num_inst += 1
+
+
+@register
+class RMSE(EvalMetric):
+    def __init__(self, name="rmse", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            l = _as_np(label)
+            p = _as_np(pred)
+            if l.ndim == p.ndim - 1:
+                l = l.reshape(l.shape + (1,))
+            self.sum_metric += math.sqrt(((l - p) ** 2).mean())
+            self.num_inst += 1
+
+
+@register(aliases=("ce",))
+class CrossEntropy(EvalMetric):
+    def __init__(self, eps=1e-12, name="cross-entropy", output_names=None,
+                 label_names=None):
+        super().__init__(name, output_names, label_names, eps=eps)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            l = _as_np(label).ravel().astype("int64")
+            p = _as_np(pred)
+            assert l.shape[0] == p.shape[0]
+            prob = p[_np.arange(l.shape[0]), l]
+            self.sum_metric += (-_np.log(prob + self.eps)).sum()
+            self.num_inst += l.shape[0]
+
+
+@register(aliases=("nll_loss",))
+class NegativeLogLikelihood(CrossEntropy):
+    def __init__(self, eps=1e-12, name="nll-loss", output_names=None,
+                 label_names=None):
+        super().__init__(eps, name, output_names, label_names)
+
+
+@register(aliases=("pearsonr",))
+class PearsonCorrelation(EvalMetric):
+    def __init__(self, name="pearsonr", output_names=None,
+                 label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, labels, preds):
+        for label, pred in zip(labels, preds):
+            l = _as_np(label).ravel()
+            p = _as_np(pred).ravel()
+            self.sum_metric += _np.corrcoef(p, l)[0, 1]
+            self.num_inst += 1
+
+
+@register
+class Perplexity(EvalMetric):
+    def __init__(self, ignore_label=None, axis=-1, name="perplexity",
+                 output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names,
+                         ignore_label=ignore_label)
+        self.ignore_label = ignore_label
+        self.axis = axis
+
+    def update(self, labels, preds):
+        loss = 0.0
+        num = 0
+        for label, pred in zip(labels, preds):
+            l = _as_np(label).reshape(-1).astype("int64")
+            p = _as_np(pred).reshape(-1, _as_np(pred).shape[-1])
+            prob = p[_np.arange(l.shape[0]), l]
+            if self.ignore_label is not None:
+                ignore = (l == self.ignore_label)
+                prob = prob * (1 - ignore) + ignore
+                num -= ignore.sum()
+            loss -= _np.log(_np.maximum(1e-10, prob)).sum()
+            num += l.shape[0]
+        self.sum_metric += loss
+        self.num_inst += num
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, math.exp(self.sum_metric / self.num_inst))
+
+
+@register
+class Loss(EvalMetric):
+    def __init__(self, name="loss", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, _, preds):
+        if isinstance(preds, NDArray):
+            preds = [preds]
+        for pred in preds:
+            loss = _as_np(pred).sum()
+            self.sum_metric += loss
+            self.num_inst += _as_np(pred).size
+
+
+class Torch(Loss):
+    def __init__(self, name="torch", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+
+class Caffe(Loss):
+    def __init__(self, name="caffe", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+
+@register
+class CustomMetric(EvalMetric):
+    def __init__(self, feval, name=None, allow_extra_outputs=False,
+                 output_names=None, label_names=None):
+        if name is None:
+            name = feval.__name__
+            if name.find("<") != -1:
+                name = "custom(%s)" % name
+        super().__init__(name, output_names, label_names)
+        self._feval = feval
+        self._allow_extra_outputs = allow_extra_outputs
+
+    def update(self, labels, preds):
+        if not self._allow_extra_outputs:
+            check_label_shapes(labels, preds)
+        for pred, label in zip(preds, labels):
+            l = _as_np(label)
+            p = _as_np(pred)
+            reval = self._feval(l, p)
+            if isinstance(reval, tuple):
+                sum_metric, num_inst = reval
+                self.sum_metric += sum_metric
+                self.num_inst += num_inst
+            else:
+                self.sum_metric += reval
+                self.num_inst += 1
+
+
+def np(numpy_feval, name=None, allow_extra_outputs=False):
+    def feval(label, pred):
+        return numpy_feval(label, pred)
+    feval.__name__ = numpy_feval.__name__
+    return CustomMetric(feval, name, allow_extra_outputs)

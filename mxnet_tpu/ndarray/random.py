@@ -14,28 +14,34 @@ def _shape(shape):
     return tuple(shape)
 
 
-def _maybe_sample(op_scalar, op_sample, arrs, shape, dtype, **scalars):
+def _maybe_sample(op_scalar, op_sample, arrs, shape, dtype, out=None,
+                  **scalars):
     nd_args = [a for a in arrs if isinstance(a, NDArray)]
     if nd_args:
         return imperative_invoke(op_sample, *nd_args, shape=_shape(shape),
-                                 dtype=dtype)
+                                 dtype=dtype, out=out)
     return imperative_invoke(op_scalar, shape=_shape(shape), dtype=dtype,
-                             **scalars)
+                             out=out, **scalars)
 
 
 def uniform(low=0.0, high=1.0, shape=(), dtype="float32", ctx=None, out=None,
             **kwargs):
+    if out is not None and not shape:
+        shape = out.shape
     return _maybe_sample("_random_uniform", "_sample_uniform", (low, high),
-                         shape, dtype, low=float(low) if not isinstance(
-                             low, NDArray) else low,
+                         shape, dtype, out=out,
+                         low=float(low) if not isinstance(low, NDArray)
+                         else low,
                          high=float(high) if not isinstance(high, NDArray)
                          else high)
 
 
 def normal(loc=0.0, scale=1.0, shape=(), dtype="float32", ctx=None, out=None,
            **kwargs):
+    if out is not None and not shape:
+        shape = out.shape
     return _maybe_sample("_random_normal", "_sample_normal", (loc, scale),
-                         shape, dtype, loc=loc, scale=scale)
+                         shape, dtype, out=out, loc=loc, scale=scale)
 
 
 randn = normal

@@ -169,6 +169,8 @@ def _compiled(name, frozen_params, donate):
     op = _OPS[name]
     params = {k: v for k, v in frozen_params}
     fn = functools.partial(op.fn, **params) if params else op.fn
+    if jax.default_backend() == "cpu":
+        donate = ()  # CPU PJRT has no donation; avoids per-call warnings
     return jax.jit(fn, donate_argnums=donate)
 
 

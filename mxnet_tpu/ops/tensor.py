@@ -272,6 +272,13 @@ def _take(a, indices, axis=0, mode="clip"):
     return jnp.take(a, indices.astype(jnp.int32), axis=axis, mode=mode)
 
 
+@register_op("pick")
+def _pick(data, index, axis=-1, keepdims=False, mode="clip"):
+    idx = jnp.expand_dims(index.astype(jnp.int32), axis)
+    out = jnp.take_along_axis(data, idx, axis=axis)
+    return out if keepdims else jnp.squeeze(out, axis)
+
+
 @register_op("batch_take")
 def _batch_take(a, indices):
     flat = a.reshape(-1)

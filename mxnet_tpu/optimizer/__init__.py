@@ -1,0 +1,4 @@
+"""Optimizers (reference: python/mxnet/optimizer/)."""
+
+from .optimizer import *  # noqa: F401,F403
+from .optimizer import Optimizer, Updater, create, register, get_updater  # noqa

@@ -1,0 +1,632 @@
+"""Optimizers (reference: python/mxnet/optimizer/optimizer.py, 1,571 LoC).
+
+Each ``update`` dispatches to the fused update ops in
+``mxnet_tpu/ops/optimizer_ops.py`` (one XLA computation per update, weight
+buffers donated), mirroring the reference's fused optimizer kernels
+(src/operator/optimizer_op.cc:43-651).  ``Updater`` reproduces the
+serializable per-index state store that KVStore servers run
+(optimizer.py:1504).
+"""
+
+from __future__ import annotations
+
+import math
+import pickle
+
+import numpy as _np
+
+from ..base import registry as _registry
+from .. import ndarray as nd
+from ..ndarray import NDArray
+
+_reg = _registry("optimizer")
+
+__all__ = ["Optimizer", "SGD", "Signum", "SignSGD", "FTML", "LBSGD",
+           "DCASGD", "NAG", "SGLD", "Adam", "AdaGrad", "RMSProp",
+           "AdaDelta", "Ftrl", "Adamax", "Nadam", "Test", "Updater",
+           "create", "register", "get_updater"]
+
+
+def register(klass):
+    _reg.register(klass)
+    return klass
+
+
+def create(name, **kwargs):
+    if isinstance(name, Optimizer):
+        return name
+    return _reg.get(name)(**kwargs)
+
+
+class Optimizer:
+    """Base optimizer (reference: optimizer.py Optimizer:46)."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01,
+                 lr_scheduler=None, sym=None, begin_num_update=0,
+                 multi_precision=False, param_dict=None):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.multi_precision = multi_precision
+        self.idx2name = dict(param_idx2name or {})
+        self.param_dict = param_dict or {}
+        self.lr_mult = {}
+        self.wd_mult = {}
+
+    # -- lr/wd resolution --------------------------------------------------
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise UserWarning("LRScheduler of the optimizer has already "
+                              "been defined.")
+        self.lr = lr
+
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            is_weight = n.endswith("_weight")
+            if not is_weight:
+                self.wd_mult[n] = 0.0
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index],
+                              self.num_update)
+
+    def _get_lr(self, index):
+        lr = self.learning_rate
+        if index in self.param_dict:
+            lr *= self.param_dict[index].lr_mult
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.param_dict:
+            wd *= self.param_dict[index].wd_mult
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    # -- state -------------------------------------------------------------
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and weight.dtype in (_np.float16,
+                                                     "bfloat16") or \
+                (self.multi_precision and
+                 str(weight.dtype) in ("float16", "bfloat16")):
+            w32 = weight.astype("float32")
+            return (self.create_state(index, w32), w32)
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and isinstance(state, tuple) and \
+                isinstance(state[-1], NDArray) and \
+                state[-1].dtype == _np.float32 and \
+                weight.dtype != _np.float32:
+            self._update_mp(index, weight, grad, state)
+        else:
+            self.update(index, weight, grad, state)
+
+    def _update_mp(self, index, weight, grad, state):
+        # generic mp fallback: update the fp32 master then cast down
+        inner_state, w32 = state
+        g32 = grad.astype("float32")
+        self.update(index, w32, g32, inner_state)
+        weight._data = w32._data.astype(weight._data.dtype)
+
+    def _common_kwargs(self, index):
+        kw = {"rescale_grad": self.rescale_grad}
+        if self.clip_gradient is not None:
+            kw["clip_gradient"] = self.clip_gradient
+        return kw
+
+
+# ---------------------------------------------------------------------------
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum and optional multi-precision
+    (reference: optimizer.py SGD:451)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return nd.zeros(weight.shape, dtype=str(weight.dtype))
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and weight.dtype in (_np.float16,) or \
+                str(weight.dtype) == "bfloat16" and self.multi_precision:
+            w32 = weight.astype("float32")
+            return (self.create_state(index, w32), w32)
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        kw = self._common_kwargs(index)
+        if state is not None:
+            nd.sgd_mom_update(weight, grad, state, out=[weight, state],
+                              lr=lr, wd=wd, momentum=self.momentum, **kw)
+        else:
+            nd.sgd_update(weight, grad, out=weight, lr=lr, wd=wd, **kw)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if isinstance(state, tuple) and isinstance(state[1], NDArray) and \
+                state[1].dtype == _np.float32 and \
+                weight.dtype != _np.float32:
+            self._update_count(index)
+            lr, wd = self._get_lr(index), self._get_wd(index)
+            kw = self._common_kwargs(index)
+            mom, w32 = state
+            if mom is not None:
+                nd.mp_sgd_mom_update(weight, grad, mom, w32,
+                                     out=[weight, mom, w32], lr=lr, wd=wd,
+                                     momentum=self.momentum, **kw)
+            else:
+                nd.mp_sgd_update(weight, grad, w32, out=[weight, w32],
+                                 lr=lr, wd=wd, **kw)
+        else:
+            self.update(index, weight, grad, state)
+
+
+@register
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return nd.zeros(weight.shape, dtype=str(weight.dtype))
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        kw = self._common_kwargs(index)
+        if state is not None:
+            nd.signum_update(weight, grad, state, out=[weight, state],
+                             lr=lr, wd=wd, momentum=self.momentum,
+                             wd_lh=self.wd_lh, **kw)
+        else:
+            nd.signsgd_update(weight, grad, out=weight, lr=lr, wd=wd, **kw)
+
+
+@register
+class SignSGD(Signum):
+    def __init__(self, **kwargs):
+        kwargs.setdefault("momentum", 0.0)
+        super().__init__(**kwargs)
+
+
+@register
+class FTML(Optimizer):
+    def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape), nd.zeros(weight.shape),
+                nd.zeros(weight.shape))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        d, v, z = state
+        kw = {"rescale_grad": self.rescale_grad}
+        if self.clip_gradient is not None:
+            kw["clip_grad"] = self.clip_gradient
+        nd.ftml_update(weight, grad, d, v, z, out=[weight, d, v, z],
+                       lr=lr, wd=wd, beta1=self.beta1, beta2=self.beta2,
+                       epsilon=self.epsilon, t=t, **kw)
+
+
+@register
+class LBSGD(Optimizer):
+    """Large-batch SGD with LARS layer-wise adaptation
+    (reference: optimizer.py LBSGD:678)."""
+
+    def __init__(self, momentum=0.0, multi_precision=False,
+                 warmup_strategy="linear", warmup_epochs=5, batch_scale=1,
+                 updates_per_epoch=32, begin_epoch=0, num_epochs=60,
+                 **kwargs):
+        super().__init__(multi_precision=multi_precision, **kwargs)
+        self.momentum = momentum
+        self.warmup_strategy = warmup_strategy
+        self.warmup_epochs = warmup_epochs
+        self.batch_scale = batch_scale
+        self.updates_per_epoch = updates_per_epoch
+        self.init_updates = begin_epoch * updates_per_epoch
+        self.num_epochs = num_epochs
+        self.lbmult = 1.0
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return nd.zeros(weight.shape, dtype=str(weight.dtype))
+        return None
+
+    def _get_lbmult(self, nup):
+        nwup = self.warmup_epochs * self.updates_per_epoch
+        strategy = self.warmup_strategy
+        maxmult = float(self.batch_scale)
+        if nup >= nwup:
+            mult = maxmult
+        elif nwup <= 1:
+            mult = 1.0
+        else:
+            if strategy == "linear":
+                mult = 1.0 + (maxmult - 1) * nup / nwup
+            elif strategy == "power2":
+                mult = 1.0 + (maxmult - 1) * (nup * nup) / (nwup * nwup)
+            elif strategy == "sqrt":
+                mult = 1.0 + (maxmult - 1) * math.sqrt(float(nup) / nwup)
+            else:
+                mult = 1.0
+        return mult
+
+    def _get_lars(self, weight, g, wd):
+        """LARS trust ratio ||w|| / (||g|| + wd*||w||)."""
+        w2 = float((weight * weight).sum().asscalar())
+        g2 = float((g * g).sum().asscalar())
+        if w2 == 0 or g2 == 0:
+            return 1.0
+        return math.sqrt(w2 / (g2 + wd * w2 + 1e-18))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        if self.warmup_strategy == "lars":
+            lbmult = self._get_lars(weight, grad, wd)
+        else:
+            lbmult = self._get_lbmult(self.num_update + self.init_updates)
+        lr = self._get_lr(index) * lbmult
+        kw = self._common_kwargs(index)
+        if state is not None:
+            nd.sgd_mom_update(weight, grad, state, out=[weight, state],
+                              lr=lr, wd=wd, momentum=self.momentum, **kw)
+        else:
+            nd.sgd_update(weight, grad, out=weight, lr=lr, wd=wd, **kw)
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference: optimizer.py DCASGD:868)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (nd.zeros(weight.shape, dtype=str(weight.dtype)),
+                weight.copy())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, -self.clip_gradient, self.clip_gradient)
+        mom, previous_weight = state
+        comp = grad + self.lamda * grad * grad * (weight - previous_weight)
+        if mom is not None:
+            m = self.momentum * mom - lr * (comp + wd * weight)
+            mom._data = m._data
+            weight._data = (weight + m)._data
+        else:
+            weight._data = (weight - lr * (comp + wd * weight))._data
+        previous_weight._data = weight._data
+
+
+@register
+class NAG(Optimizer):
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return nd.zeros(weight.shape, dtype=str(weight.dtype))
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        kw = self._common_kwargs(index)
+        if state is not None:
+            nd.nag_mom_update(weight, grad, state, out=[weight, state],
+                              lr=lr, wd=wd, momentum=self.momentum, **kw)
+        else:
+            nd.sgd_update(weight, grad, out=weight, lr=lr, wd=wd, **kw)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics
+    (reference: optimizer.py SGLD:976)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = nd.clip(grad, -self.clip_gradient, self.clip_gradient)
+        noise = nd.random.normal(0, math.sqrt(lr), shape=weight.shape,
+                                 dtype=str(weight.dtype))
+        weight._data = (weight - lr / 2 * (grad + wd * weight) +
+                        noise)._data
+
+
+@register
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape, dtype=str(weight.dtype)),
+                nd.zeros(weight.shape, dtype=str(weight.dtype)))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        lr *= math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
+        mean, var = state
+        kw = self._common_kwargs(index)
+        nd.adam_update(weight, grad, mean, var, out=[weight, mean, var],
+                       lr=lr, wd=wd, beta1=self.beta1, beta2=self.beta2,
+                       epsilon=self.epsilon, **kw)
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return nd.zeros(weight.shape, dtype=str(weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        kw = self._common_kwargs(index)
+        nd._sparse_adagrad_update(weight, grad, state, out=[weight, state],
+                                  lr=lr, wd=wd,
+                                  epsilon=self.float_stable_eps, **kw)
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (nd.zeros(weight.shape), nd.zeros(weight.shape),
+                    nd.zeros(weight.shape))
+        return nd.zeros(weight.shape)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        kw = self._common_kwargs(index)
+        if self.clip_weights:
+            kw["clip_weights"] = self.clip_weights
+        if self.centered:
+            n, g, delta = state
+            nd.rmspropalex_update(weight, grad, n, g, delta,
+                                  out=[weight, n, g, delta], lr=lr, wd=wd,
+                                  gamma1=self.gamma1, gamma2=self.gamma2,
+                                  epsilon=self.epsilon, **kw)
+        else:
+            nd.rmsprop_update(weight, grad, state, out=[weight, state],
+                              lr=lr, wd=wd, gamma1=self.gamma1,
+                              epsilon=self.epsilon, **kw)
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape), nd.zeros(weight.shape))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        acc_g, acc_delta = state
+        kw = self._common_kwargs(index)
+        nd.adadelta_update(weight, grad, acc_g, acc_delta,
+                           out=[weight, acc_g, acc_delta], rho=self.rho,
+                           epsilon=self.epsilon, wd=wd, **kw)
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape), nd.zeros(weight.shape))  # z, n
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        z, n = state
+        kw = self._common_kwargs(index)
+        nd.ftrl_update(weight, grad, z, n, out=[weight, z, n], lr=lr,
+                       wd=wd, lamda1=self.lamda1, beta=self.beta, **kw)
+
+
+@register
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape), nd.zeros(weight.shape))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        mean, var = state
+        kw = self._common_kwargs(index)
+        nd.adamax_update(weight, grad, mean, var, out=[weight, mean, var],
+                         lr=lr, wd=wd, beta1=self.beta1, beta2=self.beta2,
+                         t=t, **kw)
+
+
+@register
+class Nadam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+
+    def create_state(self, index, weight):
+        return (nd.zeros(weight.shape), nd.zeros(weight.shape))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        mean, var = state
+        kw = self._common_kwargs(index)
+        nd.nadam_update(weight, grad, mean, var, out=[weight, mean, var],
+                        lr=lr, wd=wd, beta1=self.beta1, beta2=self.beta2,
+                        epsilon=self.epsilon, t=t,
+                        schedule_decay=self.schedule_decay, **kw)
+
+
+@register
+class Test(Optimizer):
+    """Reference's test optimizer: w -= lr * grad (pure python path)."""
+
+    def create_state(self, index, weight):
+        return nd.zeros(weight.shape, dtype=str(weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        weight._data = (weight - self.learning_rate *
+                        (grad * self.rescale_grad))._data
+
+
+# ---------------------------------------------------------------------------
+
+
+class Updater:
+    """Per-index state store applying an optimizer
+    (reference: optimizer.py Updater:1504 — runs on kvstore servers too)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = \
+                self.optimizer.create_state_multi_precision(index, weight)
+            self.states_synced[index] = True
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def get_states(self, dump_optimizer=False):
+        def to_np(s):
+            if isinstance(s, NDArray):
+                return ("nd", s.asnumpy())
+            if isinstance(s, (tuple, list)):
+                return ("tuple", [to_np(x) for x in s])
+            return ("raw", s)
+        payload = {k: to_np(v) for k, v in self.states.items()}
+        if dump_optimizer:
+            return pickle.dumps((payload, self.optimizer))
+        return pickle.dumps(payload)
+
+    def set_states(self, states):
+        data = pickle.loads(states)
+        if isinstance(data, tuple):
+            payload, self.optimizer = data
+        else:
+            payload = data
+
+        def from_np(s):
+            kind, v = s
+            if kind == "nd":
+                return nd.array(v)
+            if kind == "tuple":
+                return tuple(from_np(x) for x in v)
+            return v
+        self.states = {k: from_np(v) for k, v in payload.items()}
+        self.states_synced = {k: False for k in self.states}
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

@@ -1,0 +1,254 @@
+"""Gluon blocks/trainer (reference: tests/python/unittest/test_gluon.py)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd, autograd
+from mxnet_tpu.gluon import nn
+
+
+def test_parameter():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    p.initialize(init="xavier", ctx=mx.cpu())
+    assert len(p.list_data()) == 1
+    assert p.data().shape == (10, 10)
+    assert p.grad().shape == (10, 10)
+
+
+def test_parameter_sharing():
+    class Net(gluon.Block):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            with self.name_scope():
+                self.dense0 = nn.Dense(5, in_units=5)
+                self.dense1 = nn.Dense(5, in_units=5)
+
+        def forward(self, x):
+            return self.dense1(self.dense0(x))
+
+    net1 = Net(prefix="net1_")
+    net2 = Net(prefix="net2_", params=net1.collect_params())
+    net1.collect_params().initialize(ctx=mx.cpu())
+    net2(nd.zeros((3, 5)))
+    net1.save_parameters("/tmp/net1.params")
+    net3 = Net(prefix="net3_")
+    net3.load_parameters("/tmp/net1.params", mx.cpu())
+
+
+def test_dense_shape_inference():
+    net = nn.Dense(8)
+    net.initialize(ctx=mx.cpu())
+    out = net(nd.ones((4, 7)))
+    assert out.shape == (4, 8)
+    assert net.weight.shape == (8, 7)
+
+
+def test_sequential_training_converges():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(32, activation="relu"), nn.Dense(2))
+    net.initialize(mx.init.Xavier(), ctx=mx.cpu())
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5})
+    # separable toy data
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randn(64, 10))
+    y = nd.array((rng.randn(64) > 0).astype(np.float32))
+    xs = x.asnumpy()
+    ys = (xs[:, 0] > 0).astype(np.float32)
+    y = nd.array(ys)
+    first = None
+    for i in range(30):
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(64)
+        cur = float(loss.mean().asscalar())
+        if first is None:
+            first = cur
+    assert cur < first * 0.5
+
+
+def test_hybridize_consistency():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="tanh"), nn.Dense(4))
+    net.initialize(ctx=mx.cpu())
+    x = nd.random.normal(shape=(5, 6))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    np.testing.assert_allclose(eager, hybrid, rtol=1e-5, atol=1e-6)
+
+
+def test_hybridize_grad_consistency():
+    def build():
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(16, activation="relu"), nn.Dense(1))
+        return net
+
+    net = build()
+    net.initialize(ctx=mx.cpu())
+    x = nd.random.normal(shape=(4, 6))
+    with autograd.record():
+        y = net(x).sum()
+    y.backward()
+    g_eager = {k: v.grad().asnumpy().copy()
+               for k, v in net.collect_params().items()}
+    net.hybridize()
+    with autograd.record():
+        y = net(x).sum()
+    y.backward()
+    for k, v in net.collect_params().items():
+        np.testing.assert_allclose(v.grad().asnumpy(), g_eager[k],
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_batchnorm_moving_stats_update():
+    net = nn.BatchNorm()
+    net.initialize(ctx=mx.cpu())
+    x = nd.random.normal(3.0, 2.0, shape=(16, 4, 8, 8))
+    net(x)  # first forward resolves deferred init (inference: no update)
+    before = net.running_mean.data().asnumpy().copy()
+    with autograd.record():
+        net(x)
+    after = net.running_mean.data().asnumpy()
+    assert np.abs(after - before).sum() > 0
+    # inference does not touch stats
+    before = after.copy()
+    net(x)
+    np.testing.assert_allclose(net.running_mean.data().asnumpy(), before)
+
+
+def test_conv2d_layers():
+    x = nd.random.normal(shape=(2, 3, 10, 10))
+    layer = nn.Conv2D(6, (3, 3), padding=(1, 1))
+    layer.initialize(ctx=mx.cpu())
+    assert layer(x).shape == (2, 6, 10, 10)
+    tlayer = nn.Conv2DTranspose(3, (2, 2), strides=(2, 2))
+    tlayer.initialize(ctx=mx.cpu())
+    assert tlayer(x).shape == (2, 3, 20, 20)
+    pool = nn.MaxPool2D((2, 2))
+    assert pool(x).shape == (2, 3, 5, 5)
+    gpool = nn.GlobalAvgPool2D()
+    assert gpool(x).shape == (2, 3, 1, 1)
+
+
+def test_embedding_layer():
+    emb = nn.Embedding(10, 4)
+    emb.initialize(ctx=mx.cpu())
+    idx = nd.array([1, 2, 3])
+    out = emb(idx)
+    assert out.shape == (3, 4)
+    with autograd.record():
+        loss = (emb(idx) ** 2).sum()
+    loss.backward()
+    g = emb.weight.grad().asnumpy()
+    assert np.abs(g[1:4]).sum() > 0
+    assert np.abs(g[5:]).sum() == 0
+
+
+def test_losses():
+    pred = nd.array([[1.0, -1.0], [-1.0, 1.0]])
+    label = nd.array([0, 1])
+    l = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label)
+    expected = -np.log(np.exp(1) / (np.exp(1) + np.exp(-1)))
+    np.testing.assert_allclose(l.asnumpy(), [expected] * 2, rtol=1e-5)
+
+    l2 = gluon.loss.L2Loss()(nd.array([1.0, 2.0]), nd.array([0.0, 0.0]))
+    np.testing.assert_allclose(l2.asnumpy(), [0.5, 2.0], rtol=1e-5)
+
+    l1 = gluon.loss.L1Loss()(nd.array([1.0, -2.0]), nd.array([0.0, 0.0]))
+    np.testing.assert_allclose(l1.asnumpy(), [1.0, 2.0], rtol=1e-5)
+
+    bce = gluon.loss.SigmoidBinaryCrossEntropyLoss()(
+        nd.array([0.0]), nd.array([1.0]))
+    np.testing.assert_allclose(bce.asnumpy(), [np.log(2)], rtol=1e-5)
+
+    h = gluon.loss.HuberLoss()(nd.array([2.0]), nd.array([0.0]))
+    np.testing.assert_allclose(h.asnumpy(), [1.5], rtol=1e-5)
+
+
+def test_trainer_save_load_states(tmp_path):
+    net = nn.Dense(4, in_units=3)
+    net.initialize(ctx=mx.cpu())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    x = nd.ones((2, 3))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(2)
+    f = str(tmp_path / "trainer.states")
+    trainer.save_states(f)
+    trainer.load_states(f)
+
+
+def test_zero_grad():
+    net = nn.Dense(4, in_units=3)
+    net.initialize(ctx=mx.cpu())
+    with autograd.record():
+        loss = net(nd.ones((2, 3))).sum()
+    loss.backward()
+    assert np.abs(net.weight.grad().asnumpy()).sum() > 0
+    net.collect_params().zero_grad()
+    assert np.abs(net.weight.grad().asnumpy()).sum() == 0
+
+
+def test_export_symbolblock_imports(tmp_path):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8, activation="relu"), nn.Dense(3))
+    net.initialize(ctx=mx.cpu())
+    net.hybridize()
+    x = nd.random.normal(shape=(2, 5))
+    ref = net(x).asnumpy()
+    path = str(tmp_path / "model")
+    net.export(path)
+    net2 = gluon.SymbolBlock.imports(path + "-symbol.json", ["data0"],
+                                     path + "-0000.params", ctx=mx.cpu())
+    out = net2(x).asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_block_repr_and_children():
+    net = nn.Sequential()
+    net.add(nn.Dense(3))
+    assert "Dense" in repr(net)
+    assert len(net) == 1
+    assert isinstance(net[0], nn.Dense)
+
+
+def test_constant_param():
+    class Net(gluon.HybridBlock):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            with self.name_scope():
+                self.const = self.params.get_constant(
+                    "const", nd.array([[1.0, 2.0]]))
+
+        def hybrid_forward(self, F, x, const):
+            return x + const
+
+    net = Net()
+    net.initialize(ctx=mx.cpu())
+    out = net(nd.zeros((1, 2)))
+    np.testing.assert_allclose(out.asnumpy(), [[1.0, 2.0]])
+
+
+def test_split_and_load():
+    data = nd.arange(0, 16).reshape(8, 2)
+    parts = gluon.split_data(data, 4)
+    assert len(parts) == 4 and parts[0].shape == (2, 2)
+    loaded = gluon.split_and_load(data, [mx.cpu(), mx.cpu()])
+    assert len(loaded) == 2
+
+
+def test_clip_global_norm():
+    arrays = [nd.ones((2, 2)) * 3, nd.ones((2,)) * 4]
+    norm = gluon.clip_global_norm(arrays, 1.0)
+    total = sum(float((a * a).sum().asscalar()) for a in arrays)
+    assert abs(total - 1.0) < 1e-3

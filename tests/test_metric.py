@@ -1,0 +1,77 @@
+"""Metrics (reference: tests/python/unittest/test_metric.py)."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, metric
+
+
+def test_accuracy():
+    m = metric.create("acc")
+    pred = nd.array([[0.3, 0.7], [0.9, 0.1], [0.4, 0.6]])
+    label = nd.array([1, 0, 0])
+    m.update([label], [pred])
+    name, value = m.get()
+    assert name == "accuracy"
+    np.testing.assert_allclose(value, 2.0 / 3)
+
+
+def test_topk():
+    m = metric.create("top_k_accuracy", top_k=2)
+    pred = nd.array([[0.1, 0.2, 0.7], [0.8, 0.05, 0.15]])
+    label = nd.array([1, 1])  # row0 top2={2,1}: hit; row1 top2={0,2}: miss
+    m.update([label], [pred])
+    _, value = m.get()
+    np.testing.assert_allclose(value, 0.5)
+
+
+def test_mse_mae_rmse():
+    pred = nd.array([[1.0], [2.0]])
+    label = nd.array([1.5, 1.0])
+    m = metric.create("mse")
+    m.update([label], [pred])
+    np.testing.assert_allclose(m.get()[1], (0.25 + 1.0) / 2)
+    m = metric.create("mae")
+    m.update([label], [pred])
+    np.testing.assert_allclose(m.get()[1], (0.5 + 1.0) / 2)
+
+
+def test_perplexity():
+    m = metric.create("Perplexity", ignore_label=None)
+    pred = nd.array([[0.5, 0.5], [0.9, 0.1]])
+    label = nd.array([0, 0])
+    m.update([label], [pred])
+    expected = np.exp(-(np.log(0.5) + np.log(0.9)) / 2)
+    np.testing.assert_allclose(m.get()[1], expected, rtol=1e-5)
+
+
+def test_composite():
+    m = metric.create(["acc", "mse"])
+    assert isinstance(m, metric.CompositeEvalMetric)
+    names, values = m.get()
+    assert len(names) == 2
+
+
+def test_custom_metric():
+    def my_metric(label, pred):
+        return float(np.abs(label - pred).sum())
+
+    m = metric.np(my_metric)
+    m.update([nd.array([1.0])], [nd.array([0.0])])
+    assert m.get()[1] == 1.0
+
+
+def test_cross_entropy():
+    m = metric.create("ce")
+    pred = nd.array([[0.25, 0.75]])
+    label = nd.array([1])
+    m.update([label], [pred])
+    np.testing.assert_allclose(m.get()[1], -np.log(0.75), rtol=1e-5)
+
+
+def test_f1():
+    m = metric.F1()
+    pred = nd.array([[0.2, 0.8], [0.8, 0.2], [0.3, 0.7]])
+    label = nd.array([1, 0, 0])
+    m.update([label], [pred])
+    assert 0 < m.get()[1] <= 1.0

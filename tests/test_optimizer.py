@@ -1,0 +1,167 @@
+"""Optimizers vs numpy reference implementations
+(reference: tests/python/unittest/test_optimizer.py compares fused update
+ops against pure-Python optimizers)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import optimizer as opt
+
+
+def _run_steps(optimizer, w0, grads, n=3):
+    w = nd.array(w0.copy())
+    state = optimizer.create_state(0, w)
+    for i in range(n):
+        g = nd.array(grads[i])
+        optimizer.update(0, w, g, state)
+    return w.asnumpy()
+
+
+def test_sgd_matches_numpy():
+    rng = np.random.RandomState(0)
+    w0 = rng.randn(5, 4).astype(np.float32)
+    grads = [rng.randn(5, 4).astype(np.float32) for _ in range(3)]
+    lr, wd = 0.1, 0.01
+    got = _run_steps(opt.create("sgd", learning_rate=lr, wd=wd), w0, grads)
+    w = w0.copy()
+    for g in grads:
+        w = w - lr * (g + wd * w)
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_sgd_momentum_matches_numpy():
+    rng = np.random.RandomState(1)
+    w0 = rng.randn(6).astype(np.float32)
+    grads = [rng.randn(6).astype(np.float32) for _ in range(4)]
+    lr, mom, wd = 0.05, 0.9, 0.001
+    got = _run_steps(opt.create("sgd", learning_rate=lr, momentum=mom,
+                                wd=wd), w0, grads, n=4)
+    w = w0.copy()
+    m = np.zeros_like(w)
+    for g in grads:
+        g = g + wd * w
+        m = mom * m - lr * g
+        w = w + m
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_adam_matches_numpy():
+    rng = np.random.RandomState(2)
+    w0 = rng.randn(8).astype(np.float32)
+    grads = [rng.randn(8).astype(np.float32) for _ in range(5)]
+    lr, b1, b2, eps = 0.01, 0.9, 0.999, 1e-8
+    got = _run_steps(opt.create("adam", learning_rate=lr, beta1=b1,
+                                beta2=b2, epsilon=eps), w0, grads, n=5)
+    w = w0.copy()
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    for t, g in enumerate(grads, 1):
+        lr_t = lr * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        w = w - lr_t * m / (np.sqrt(v) + eps)
+    np.testing.assert_allclose(got, w, rtol=1e-4, atol=1e-5)
+
+
+def test_rmsprop_matches_numpy():
+    rng = np.random.RandomState(3)
+    w0 = rng.randn(8).astype(np.float32)
+    grads = [rng.randn(8).astype(np.float32) for _ in range(3)]
+    lr, gamma1, eps = 0.01, 0.95, 1e-8
+    got = _run_steps(opt.create("rmsprop", learning_rate=lr, gamma1=gamma1,
+                                epsilon=eps), w0, grads)
+    w = w0.copy()
+    n = np.zeros_like(w)
+    for g in grads:
+        n = gamma1 * n + (1 - gamma1) * g * g
+        w = w - lr * g / np.sqrt(n + eps)
+    np.testing.assert_allclose(got, w, rtol=1e-4, atol=1e-5)
+
+
+def test_adagrad_matches_numpy():
+    rng = np.random.RandomState(4)
+    w0 = rng.randn(8).astype(np.float32)
+    grads = [rng.randn(8).astype(np.float32) for _ in range(3)]
+    lr, eps = 0.1, 1e-7
+    got = _run_steps(opt.create("adagrad", learning_rate=lr, eps=eps), w0,
+                     grads)
+    w = w0.copy()
+    h = np.zeros_like(w)
+    for g in grads:
+        h += g * g
+        w = w - lr * g / (np.sqrt(h) + eps)
+    np.testing.assert_allclose(got, w, rtol=1e-4, atol=1e-5)
+
+
+def test_signsgd():
+    w0 = np.array([1.0, -1.0, 0.5], np.float32)
+    grads = [np.array([0.3, -0.2, 0.0], np.float32)]
+    got = _run_steps(opt.create("signsgd", learning_rate=0.1), w0, grads,
+                     n=1)
+    np.testing.assert_allclose(got, w0 - 0.1 * np.sign(grads[0]),
+                               rtol=1e-6)
+
+
+def test_clip_gradient():
+    w0 = np.zeros(3, np.float32)
+    grads = [np.array([10.0, -10.0, 0.1], np.float32)]
+    got = _run_steps(opt.create("sgd", learning_rate=1.0,
+                                clip_gradient=1.0), w0, grads, n=1)
+    np.testing.assert_allclose(got, [-1.0, 1.0, -0.1], rtol=1e-5)
+
+
+def test_lr_scheduler_factor():
+    from mxnet_tpu.lr_scheduler import FactorScheduler
+    sched = FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert sched(5) == 1.0
+    assert sched(11) == 0.5
+    assert sched(21) == 0.25
+
+
+def test_lr_scheduler_in_optimizer():
+    from mxnet_tpu.lr_scheduler import MultiFactorScheduler
+    sched = MultiFactorScheduler(step=[2, 4], factor=0.1)
+    sgd = opt.create("sgd", learning_rate=1.0, lr_scheduler=sched)
+    w = nd.ones((2,))
+    g = nd.ones((2,))
+    for _ in range(6):
+        sgd.update(0, w, g, None)
+    assert sgd.learning_rate < 1.0
+
+
+def test_updater_states_roundtrip():
+    sgd = opt.create("sgd", learning_rate=0.1, momentum=0.9)
+    upd = opt.get_updater(sgd)
+    w = nd.ones((4,))
+    g = nd.ones((4,))
+    upd(0, g, w)
+    blob = upd.get_states()
+    upd2 = opt.get_updater(opt.create("sgd", learning_rate=0.1,
+                                      momentum=0.9))
+    upd2.set_states(blob)
+    assert 0 in upd2.states
+
+
+def test_multi_precision_sgd():
+    w = nd.ones((4,)).astype("bfloat16")
+    sgd = opt.create("sgd", learning_rate=0.5, momentum=0.9,
+                     multi_precision=True)
+    state = sgd.create_state_multi_precision(0, w)
+    assert isinstance(state, tuple)
+    g = nd.ones((4,)).astype("bfloat16")
+    sgd.update_multi_precision(0, w, g, state)
+    assert str(w.dtype) == "bfloat16"
+    np.testing.assert_allclose(state[1].asnumpy(), np.full(4, 0.5),
+                               rtol=1e-2)
+
+
+def test_lbsgd_lars():
+    lb = opt.create("lbsgd", learning_rate=0.1, momentum=0.9,
+                    warmup_strategy="lars")
+    w = nd.ones((4,))
+    g = nd.ones((4,)) * 0.1
+    state = lb.create_state(0, w)
+    lb.update(0, w, g, state)
+    assert not np.allclose(w.asnumpy(), np.ones(4))
