@@ -97,9 +97,10 @@ class TapeNode:
     """One recorded eager op invocation."""
 
     __slots__ = ("fn", "inputs", "in_entries", "out_arrays", "n_out", "seq",
-                 "rng")
+                 "rng", "op_ref", "dyn")
 
-    def __init__(self, fn, inputs, in_entries, out_arrays, seq, rng=None):
+    def __init__(self, fn, inputs, in_entries, out_arrays, seq, rng=None,
+                 op_ref=None, dyn=None):
         self.fn = fn                # pure fn(*arrays) -> tuple(arrays)
         self.inputs = inputs        # raw input jax arrays (forward snapshot)
         self.in_entries = in_entries  # per-input: (TapeNode, out_idx) | leaf | None
@@ -107,6 +108,12 @@ class TapeNode:
         self.n_out = len(out_arrays)
         self.seq = seq
         self.rng = rng
+        # op_ref: (op_name, frozen_static_params, dyn_names) enabling the
+        # cached jitted VJP path (ops.registry.vjp_jit) — without it the
+        # node falls back to re-tracing jax.vjp, which is correct but slow
+        # on TPU (per-step retrace)
+        self.op_ref = op_ref
+        self.dyn = dyn or {}
 
 
 class Leaf:
@@ -123,7 +130,7 @@ class Leaf:
 _seq_counter = [0]
 
 
-def record_op(fn, nd_inputs, nd_outputs, rng=None):
+def record_op(fn, nd_inputs, nd_outputs, rng=None, op_ref=None, dyn=None):
     """Called by the NDArray dispatcher for every op executed while
     recording.  Attaches a tape entry to each output NDArray."""
     in_entries = [getattr(x, "_tape_entry", None) for x in nd_inputs]
@@ -131,7 +138,8 @@ def record_op(fn, nd_inputs, nd_outputs, rng=None):
         return
     _seq_counter[0] += 1
     node = TapeNode(fn, [x._data for x in nd_inputs], in_entries,
-                    [o._data for o in nd_outputs], _seq_counter[0], rng)
+                    [o._data for o in nd_outputs], _seq_counter[0], rng,
+                    op_ref=op_ref, dyn=dyn)
     for i, o in enumerate(nd_outputs):
         o._tape_entry = (node, i)
 
@@ -247,7 +255,18 @@ def _leaf_accumulate(leaf, g):
 
 
 def _node_vjp(node, out_cots):
-    """VJP of one tape node: re-linearize the same pure fn."""
+    """VJP of one tape node: cached jitted VJP when the node carries an
+    op_ref, else re-linearize the pure fn."""
+    if node.op_ref is not None:
+        from .ops import registry as _reg
+        op_name, frozen, dyn_names = node.op_ref
+        fn = _reg.vjp_jit(op_name, frozen, dyn_names, node.rng is not None)
+        cots = []
+        for c, o in zip(out_cots, node.out_arrays):
+            cots.append(c.astype(o.dtype) if c.dtype != o.dtype else c)
+        return fn(tuple(node.inputs), node.dyn, node.rng,
+                  tuple(cots))
+
     def fwd(*arrays):
         if node.rng is not None:
             out = node.fn(node.rng, *arrays)

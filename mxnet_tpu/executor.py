@@ -222,12 +222,22 @@ class Executor:
         self._key, sub = jax.random.split(self._key)
         return sub
 
+    def _place(self, arr):
+        """Move an incoming array onto this executor's device (the
+        reference's executor_group copies batch slices per ctx,
+        executor_group.py:436)."""
+        import jax as _jax
+        dev = self._ctx.jax_device
+        if dev not in arr.devices():
+            return _jax.device_put(arr, dev)
+        return arr
+
     def forward(self, is_train=False, **kwargs):
         """Run the graph (reference: executor.py forward:114)."""
         for k, v in kwargs.items():
             if k in self.arg_dict:
-                self.arg_dict[k]._data = _as_nd(v)._data.astype(
-                    self.arg_dict[k].dtype)
+                self.arg_dict[k]._data = self._place(_as_nd(v)._data.astype(
+                    self.arg_dict[k].dtype))
             else:
                 raise MXNetError("unknown forward argument %r" % k)
         fn = self._jit_train if is_train else self._jit_infer
@@ -252,8 +262,8 @@ class Executor:
         Module training loop uses (no double forward)."""
         for k, v in kwargs.items():
             if k in self.arg_dict:
-                self.arg_dict[k]._data = _as_nd(v)._data.astype(
-                    self.arg_dict[k].dtype)
+                self.arg_dict[k]._data = self._place(_as_nd(v)._data.astype(
+                    self.arg_dict[k].dtype))
         self._run_train_step(out_grads, use_pending=False)
         return self.outputs
 

@@ -338,6 +338,7 @@ class _CachedGraph:
         self._eval_infer = _build_eval(self.symbol, False)
         self._jit_train = jax.jit(self._eval_train)
         self._jit_infer = jax.jit(self._eval_infer)
+        self._vjp_jit = {}  # per training-mode compiled vjp
         del auxs
 
     def run(self, block, flat_inputs):
@@ -357,19 +358,38 @@ class _CachedGraph:
             params[n].data()._data = v
         out_nds = [NDArray(o) for o in outs]
         if autograd.is_recording():
-            # one tape node for the whole cached graph
+            # one tape node for the whole cached graph, with a per-graph
+            # COMPILED vjp (one XLA program, reused every step — the
+            # CachedOp::Backward static path, cached_op.cc:961)
             input_nds = list(flat_inputs) + [params[n].data()
                                              for n in diff_names]
-            in_names = list(self.input_names) + diff_names
-            eval_train = self._eval_train
+            in_names = tuple(self.input_names) + tuple(diff_names)
+            if training not in self._vjp_jit:
+                # differentiate the SAME mode's graph that ran forward
+                eval_fn = self._eval_train if training else self._eval_infer
+
+                def vjp_run(arrays, aux, k, cots):
+                    def f(arrs):
+                        amap = dict(zip(in_names, arrs))
+                        o, _ = eval_fn(amap, aux, k)
+                        return tuple(o)
+                    _, vjp = jax.vjp(f, tuple(arrays))
+                    return vjp(tuple(cots))[0]
+
+                self._vjp_jit[training] = jax.jit(vjp_run)
+            arrays = tuple(x._data for x in input_nds)
             aux_snapshot = dict(aux_map)
+            vjp_jit = self._vjp_jit[training]
+            raw_outs = list(outs)
 
-            def fused(*arrays):
-                amap = dict(zip(in_names, arrays))
-                o, _ = eval_train(amap, aux_snapshot, key)
-                return tuple(o)
+            def custom_vjp(out_cots):
+                cots = tuple(
+                    c.astype(o.dtype) if c.dtype != o.dtype else c
+                    for c, o in zip(out_cots, raw_outs))
+                return list(vjp_jit(arrays, aux_snapshot, key, cots))
 
-            autograd.record_op(fused, input_nds, out_nds)
+            autograd.record_op(("__custom__", custom_vjp), input_nds,
+                               out_nds)
         out, _ = _regroup(out_nds, self._out_fmt)
         return out
 
@@ -560,21 +580,37 @@ class SymbolBlock(HybridBlock):
             ev = _build_eval(self._symbol, training)
             self._jit_cache[key] = (ev, jax.jit(ev))
         ev, jfn = self._jit_cache[key]
-        outs, auxu = jfn(arg_map, aux_map, _next_block_key())
+        key2 = _next_block_key()
+        outs, auxu = jfn(arg_map, aux_map, key2)
         for n, v in auxu.items():
             params[n].data()._data = v
         out_nds = [NDArray(o) for o in outs]
         if autograd.is_recording():
-            in_names = self._input_names + diff_names
+            in_names = tuple(self._input_names) + tuple(diff_names)
             input_nds = list(flat) + [params[n].data() for n in diff_names]
             aux_snapshot = dict(aux_map)
-            k2 = _next_block_key()
+            vkey = "vjp_" + ("train" if training else "infer")
+            if vkey not in self._jit_cache:
+                def vjp_run(arrays, aux, k, cots):
+                    def f(arrs):
+                        amap = dict(zip(in_names, arrs))
+                        o, _ = ev(amap, aux, k)
+                        return tuple(o)
+                    _, vjp = jax.vjp(f, tuple(arrays))
+                    return vjp(tuple(cots))[0]
+                self._jit_cache[vkey] = jax.jit(vjp_run)
+            vjp_jit = self._jit_cache[vkey]
+            arrays = tuple(x._data for x in input_nds)
+            raw_outs = list(outs)
 
-            def fused(*arrays):
-                amap = dict(zip(in_names, arrays))
-                o, _ = ev(amap, aux_snapshot, k2)
-                return tuple(o)
-            autograd.record_op(fused, input_nds, out_nds)
+            def custom_vjp(out_cots):
+                cots = tuple(
+                    c.astype(o.dtype) if c.dtype != o.dtype else c
+                    for c, o in zip(out_cots, raw_outs))
+                return list(vjp_jit(arrays, aux_snapshot, key2, cots))
+
+            autograd.record_op(("__custom__", custom_vjp), input_nds,
+                               out_nds)
         if len(out_nds) == 1:
             return out_nds[0]
         return out_nds

@@ -1,0 +1,99 @@
+"""Gluon DataLoader (reference: python/mxnet/gluon/data/dataloader.py).
+
+The reference forks worker processes and ships NDArrays through POSIX shared
+memory (cpu_shared context, dataloader.py:26-110).  Here workers are a
+thread pool: batch assembly is numpy (releases the GIL in practice) and
+device transfer is XLA-async, so threads keep a TPU fed without the
+shared-memory machinery; num_workers>0 enables threaded prefetch of whole
+batches.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as _np
+
+from ... import ndarray as nd
+from ...ndarray import NDArray
+from .sampler import SequentialSampler, RandomSampler, BatchSampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference: dataloader.py
+    default_batchify_fn)."""
+    if isinstance(data[0], NDArray):
+        import jax.numpy as jnp
+        return NDArray(jnp.stack([d._data for d in data]))
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    data = _np.asarray(data)
+    return nd.array(data, dtype=str(data.dtype)
+                    if data.dtype != _np.float64 else "float32")
+
+
+class DataLoader:
+    def __init__(self, dataset, batch_size=None, shuffle=False,
+                 sampler=None, last_batch=None, batch_sampler=None,
+                 batchify_fn=None, num_workers=0, pin_memory=False,
+                 prefetch=None):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size must be specified unless "
+                                 "batch_sampler is specified")
+            if sampler is None:
+                if shuffle:
+                    sampler = RandomSampler(len(dataset))
+                else:
+                    sampler = SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle must not be specified if sampler "
+                                 "is specified")
+            batch_sampler = BatchSampler(
+                sampler, batch_size, last_batch if last_batch else "keep")
+        elif batch_size is not None or shuffle or sampler is not None or \
+                last_batch is not None:
+            raise ValueError("batch_size, shuffle, sampler and last_batch "
+                             "must not be specified if batch_sampler is "
+                             "specified.")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(0, prefetch if prefetch is not None
+                             else 2 * self._num_workers)
+
+    def _make_batch(self, indices):
+        return self._batchify_fn([self._dataset[i] for i in indices])
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for indices in self._batch_sampler:
+                yield self._make_batch(indices)
+            return
+        # threaded prefetch: submit up to `prefetch` batch jobs ahead
+        with ThreadPoolExecutor(max_workers=self._num_workers) as pool:
+            batches = iter(self._batch_sampler)
+            futures = []
+            try:
+                for _ in range(self._prefetch or self._num_workers * 2):
+                    futures.append(pool.submit(self._make_batch,
+                                               next(batches)))
+            except StopIteration:
+                pass
+            while futures:
+                fut = futures.pop(0)
+                try:
+                    futures.append(pool.submit(self._make_batch,
+                                               next(batches)))
+                except StopIteration:
+                    pass
+                yield fut.result()
+
+    def __len__(self):
+        return len(self._batch_sampler)

@@ -1,0 +1,7 @@
+"""mx.io — data iterators (reference: python/mxnet/io/)."""
+
+from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
+                 PrefetchingIter, MNISTIter, CSVIter, LibSVMIter)  # noqa
+
+class ImageRecordIter(DataIter):  # placeholder replaced in image.py wiring
+    pass

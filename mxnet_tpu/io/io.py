@@ -1,0 +1,549 @@
+"""Data iterators.
+
+Reference: ``python/mxnet/io/io.py`` (DataDesc:41, DataBatch:114,
+DataIter:178, ResizeIter:280, PrefetchingIter:345, NDArrayIter) and the C++
+iterators in ``src/io/`` (iter_mnist.cc, iter_csv.cc, iter_libsvm.cc).
+
+TPU note: the pipeline's job is to keep the chip fed — iterators produce
+host numpy batches and a background-thread prefetcher overlaps host decode
+with device compute (the reference uses dmlc::ThreadedIter the same way,
+iter_prefetcher.h).  Conversion to device arrays happens at consumption so
+XLA's async transfer overlaps too.
+"""
+
+from __future__ import annotations
+
+import collections
+import gzip
+import os
+import queue
+import struct
+import threading
+
+import numpy as _np
+
+from ..base import np_dtype
+from .. import ndarray as nd
+from ..ndarray import NDArray
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter", "MNISTIter", "CSVIter", "LibSVMIter"]
+
+
+class DataDesc(collections.namedtuple("DataDesc", ["name", "shape"])):
+    """Name + shape (+dtype/layout) of a data slot
+    (reference: io.py DataDesc:41)."""
+
+    def __new__(cls, name, shape, dtype=_np.float32, layout="NCHW"):
+        ret = super().__new__(cls, name, shape)
+        ret.dtype = dtype
+        ret.layout = layout
+        return ret
+
+    @staticmethod
+    def get_batch_axis(layout):
+        if layout is None:
+            return 0
+        return layout.find("N")
+
+
+class DataBatch:
+    """One mini-batch (reference: io.py DataBatch:114)."""
+
+    def __init__(self, data, label=None, pad=None, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        if data is not None and not isinstance(data, (list, tuple)):
+            data = [data]
+        if label is not None and not isinstance(label, (list, tuple)):
+            label = [label]
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+    def __str__(self):
+        data_shapes = [d.shape for d in self.data] if self.data else None
+        label_shapes = [l.shape for l in self.label] if self.label else None
+        return "{}: data shapes: {} label shapes: {}".format(
+            type(self).__name__, data_shapes, label_shapes)
+
+
+class DataIter:
+    """Iterator protocol (reference: io.py DataIter:178)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        raise NotImplementedError
+
+
+def _init_data(data, allow_empty, default_name):
+    """Normalize input data to list of (name, numpy array)."""
+    assert data is not None or allow_empty
+    if data is None:
+        data = []
+    if isinstance(data, (_np.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, list):
+        if not allow_empty:
+            assert len(data) > 0
+        if len(data) == 1:
+            data = collections.OrderedDict([(default_name, data[0])])
+        else:
+            data = collections.OrderedDict(
+                [("_%d_%s" % (i, default_name), d)
+                 for i, d in enumerate(data)])
+    if not isinstance(data, dict):
+        raise TypeError("Input must be NDArray, numpy.ndarray, a list of "
+                        "them or dict with them as values")
+    out = collections.OrderedDict()
+    for k, v in data.items():
+        if isinstance(v, NDArray):
+            v = v.asnumpy()
+        out[k] = _np.asarray(v)
+    return list(out.items())
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays (reference: io.py NDArrayIter)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, allow_empty=False,
+                               default_name=data_name)
+        self.label = _init_data(label, allow_empty=True,
+                                default_name=label_name)
+        self.idx = _np.arange(self.data[0][1].shape[0])
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.num_data = self.idx.shape[0]
+        assert self.num_data >= batch_size, \
+            "batch_size needs to be smaller than data size."
+        self.cursor = -batch_size
+        self.num_source = len(self.data)
+        self._cache_data = None
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, tuple([self.batch_size] + list(v.shape[1:])),
+                         v.dtype) for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, tuple([self.batch_size] + list(v.shape[1:])),
+                         v.dtype) for k, v in self.label]
+
+    def hard_reset(self):
+        if self.shuffle:
+            _np.random.shuffle(self.idx)
+        self.cursor = -self.batch_size
+
+    def reset(self):
+        if self.shuffle:
+            _np.random.shuffle(self.idx)
+        if self.last_batch_handle == "roll_over" and \
+                self.num_data - self.batch_size < self.cursor < \
+                self.num_data:
+            self.cursor = -self.batch_size + \
+                (self.cursor - self.num_data)
+        else:
+            self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self.num_data
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        if self.last_batch_handle == "discard" and \
+                self.cursor + self.batch_size > self.num_data:
+            raise StopIteration
+        return DataBatch(data=self.getdata(), label=self.getlabel(),
+                         pad=self.getpad(), index=None)
+
+    def _getdata(self, data_source):
+        end = self.cursor + self.batch_size
+        if end <= self.num_data:
+            sel = self.idx[self.cursor:end]
+            return [nd.array(v[sel], dtype=str(v[sel].dtype)
+                             if v.dtype != _np.float64 else "float32")
+                    for _, v in data_source]
+        # pad by wrapping
+        pad = end - self.num_data
+        sel = _np.concatenate([self.idx[self.cursor:], self.idx[:pad]])
+        return [nd.array(v[sel], dtype=str(v[sel].dtype)
+                         if v.dtype != _np.float64 else "float32")
+                for _, v in data_source]
+
+    def getdata(self):
+        return self._getdata(self.data)
+
+    def getlabel(self):
+        return self._getdata(self.label) if self.label else []
+
+    def getpad(self):
+        if self.last_batch_handle == "pad" and \
+                self.cursor + self.batch_size > self.num_data:
+            return self.cursor + self.batch_size - self.num_data
+        return 0
+
+
+class ResizeIter(DataIter):
+    """Resize an iterator to a fixed number of batches
+    (reference: io.py ResizeIter:280)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__()
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+        self.provide_data = data_iter.provide_data
+        self.provide_label = data_iter.provide_label
+        self.batch_size = data_iter.batch_size
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetch (reference: io.py PrefetchingIter:345,
+    C++ iter_prefetcher.h)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 prefetch_depth=2):
+        super().__init__()
+        if not isinstance(iters, list):
+            iters = [iters]
+        self.n_iter = len(iters)
+        assert self.n_iter == 1, "PrefetchingIter wraps one iterator"
+        self.iters = iters
+        self.rename_data = rename_data
+        self.rename_label = rename_label
+        self.batch_size = iters[0].batch_size
+        self._depth = prefetch_depth
+        self._queue = queue.Queue(maxsize=prefetch_depth)
+        self._stop = threading.Event()
+        self._thread = None
+        self._peek = None
+        self.current_batch = None
+        self._start()
+
+    @property
+    def provide_data(self):
+        return self.iters[0].provide_data
+
+    @property
+    def provide_label(self):
+        return self.iters[0].provide_label
+
+    def _producer(self):
+        try:
+            while not self._stop.is_set():
+                try:
+                    batch = self.iters[0].next()
+                except StopIteration:
+                    self._queue.put(None)
+                    return
+                except Exception as e:  # exception travels to consumer
+                    self._queue.put(e)
+                    return
+                self._queue.put(batch)
+        finally:
+            pass
+
+    def _start(self):
+        self._thread = threading.Thread(target=self._producer, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        self._stop.set()
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self.iters[0].reset()
+        self._stop.clear()
+        self._queue = queue.Queue(maxsize=self._depth)
+        self._peek = None
+        self._start()
+
+    def next(self):
+        if self._peek is not None:
+            batch, self._peek = self._peek, None
+            self.current_batch = batch
+            return batch
+        item = self._queue.get()
+        if item is None:
+            raise StopIteration
+        if isinstance(item, Exception):
+            raise item
+        self.current_batch = item
+        return item
+
+    def iter_next(self):
+        """Peek semantics: a True return makes the batch available via
+        getdata/getlabel AND the next next() call (no batch is dropped)."""
+        if self._peek is not None:
+            return True
+        try:
+            batch = self.next()  # sets current_batch
+        except StopIteration:
+            return False
+        self._peek = batch  # next() will return this same batch
+        return True
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class MNISTIter(DataIter):
+    """idx-ubyte MNIST reader (reference: src/io/iter_mnist.cc:260)."""
+
+    def __init__(self, image, label, batch_size=128, shuffle=True,
+                 flat=False, silent=False, seed=0, input_shape=None,
+                 **kwargs):
+        data, labels = _read_idx_images(image), _read_idx_labels(label)
+        if flat:
+            data = data.reshape(data.shape[0], -1)
+        else:
+            data = data.reshape(data.shape[0], 1, data.shape[1],
+                                data.shape[2])
+        if input_shape is not None:
+            data = data.reshape((data.shape[0],) + tuple(input_shape))
+        data = data.astype(_np.float32) / 255.0
+        self._inner = NDArrayIter(data, labels.astype(_np.float32),
+                                  batch_size=batch_size, shuffle=shuffle,
+                                  last_batch_handle="discard")
+        super().__init__(batch_size)
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+    def iter_next(self):
+        return self._inner.iter_next()
+
+
+def _open_maybe_gz(path):
+    if path.endswith(".gz"):
+        return gzip.open(path, "rb")
+    return open(path, "rb")
+
+
+def _read_idx_images(path):
+    with _open_maybe_gz(path) as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        assert magic == 2051, "bad idx image magic in %s" % path
+        buf = f.read(n * rows * cols)
+        return _np.frombuffer(buf, dtype=_np.uint8).reshape(n, rows, cols)
+
+
+def _read_idx_labels(path):
+    with _open_maybe_gz(path) as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        assert magic == 2049, "bad idx label magic in %s" % path
+        return _np.frombuffer(f.read(n), dtype=_np.uint8)
+
+
+class CSVIter(DataIter):
+    """Dense CSV reader (reference: src/io/iter_csv.cc)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None,
+                 label_shape=(1,), batch_size=1, round_batch=True,
+                 **kwargs):
+        data = _np.loadtxt(data_csv, delimiter=",",
+                           dtype=_np.float32, ndmin=2)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = _np.loadtxt(label_csv, delimiter=",",
+                                dtype=_np.float32, ndmin=1)
+        else:
+            label = _np.zeros((data.shape[0],), _np.float32)
+        self._inner = NDArrayIter(
+            data, label, batch_size=batch_size,
+            last_batch_handle="pad" if round_batch else "discard")
+        super().__init__(batch_size)
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+    def iter_next(self):
+        return self._inner.iter_next()
+
+
+class LibSVMIter(DataIter):
+    """Sparse LibSVM reader producing CSR batches
+    (reference: src/io/iter_libsvm.cc — feeds example/sparse)."""
+
+    def __init__(self, data_libsvm, data_shape, label_libsvm=None,
+                 batch_size=1, round_batch=True, **kwargs):
+        num_features = data_shape[0] if isinstance(data_shape,
+                                                   (tuple, list)) \
+            else data_shape
+        labels = []
+        indptr = [0]
+        indices = []
+        values = []
+        with open(data_libsvm) as f:
+            for line in f:
+                parts = line.strip().split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                for tok in parts[1:]:
+                    i, v = tok.split(":")
+                    indices.append(int(i))
+                    values.append(float(v))
+                indptr.append(len(indices))
+        self._values = _np.asarray(values, _np.float32)
+        self._indices = _np.asarray(indices, _np.int32)
+        self._indptr = _np.asarray(indptr, _np.int32)
+        self._labels = _np.asarray(labels, _np.float32)
+        self._num_features = num_features
+        self.batch_size = batch_size
+        self._num = len(labels)
+        self._cursor = 0
+        self._round = round_batch
+        super().__init__(batch_size)
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size, self._num_features))]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("softmax_label", (self.batch_size,))]
+
+    def reset(self):
+        self._cursor = 0
+
+    def next(self):
+        from ..ndarray import sparse as _sp
+        if self._cursor >= self._num:
+            raise StopIteration
+        lo = self._cursor
+        hi = lo + self.batch_size
+        pad = 0
+        if hi > self._num:
+            if not self._round:
+                raise StopIteration
+            pad = hi - self._num  # wrap the final batch (reference
+            # round_batch semantics, iter_libsvm.cc)
+        self._cursor = hi
+        rows = [(r % self._num) for r in range(lo, hi)]
+        values, indices, indptr = [], [], [0]
+        for r in rows:
+            s, e = self._indptr[r], self._indptr[r + 1]
+            values.append(self._values[s:e])
+            indices.append(self._indices[s:e])
+            indptr.append(indptr[-1] + (e - s))
+        batch = _sp.csr_matrix(
+            (_np.concatenate(values) if values else
+             _np.zeros(0, _np.float32),
+             _np.concatenate(indices) if indices else
+             _np.zeros(0, _np.int32),
+             _np.asarray(indptr, _np.int32)),
+            shape=(self.batch_size, self._num_features))
+        label = nd.array(self._labels[[r for r in rows]])
+        return DataBatch(data=[batch], label=[label], pad=pad)
+
+    def iter_next(self):
+        if self._round:
+            return self._cursor < self._num
+        return self._cursor + self.batch_size <= self._num

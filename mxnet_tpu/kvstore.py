@@ -1,0 +1,10 @@
+"""KVStore (reference: python/mxnet/kvstore.py over src/kvstore/).
+
+Implemented in the parallel milestone; see create()."""
+
+from __future__ import annotations
+
+
+def create(name="local"):
+    from ._kvstore_impl import create as _create
+    return _create(name)

@@ -1,0 +1,48 @@
+"""Checkpoint format + BatchEndParam (reference: python/mxnet/model.py,
+1,012 LoC — save_checkpoint:383 / load_checkpoint:413; the deprecated
+FeedForward API is subsumed by mxnet_tpu.module).
+
+Checkpoint format matches the reference's convention:
+``prefix-symbol.json`` (graph) + ``prefix-NNNN.params`` (tensors keyed
+``arg:<name>`` / ``aux:<name>``) so Module/Gluon/SymbolBlock all share it.
+"""
+
+from __future__ import annotations
+
+from collections import namedtuple
+
+from . import ndarray as nd
+from . import symbol as sym_mod
+
+__all__ = ["BatchEndParam", "save_checkpoint", "load_checkpoint"]
+
+BatchEndParam = namedtuple("BatchEndParams",
+                           ["epoch", "nbatch", "eval_metric", "locals"])
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
+    """Save (reference: model.py save_checkpoint:383)."""
+    if symbol is not None:
+        symbol.save("%s-symbol.json" % prefix)
+    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
+    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    nd.save(param_name, save_dict)
+
+
+def load_checkpoint(prefix, epoch):
+    """Load (reference: model.py load_checkpoint:413).  Returns
+    (symbol, arg_params, aux_params)."""
+    symbol = sym_mod.load("%s-symbol.json" % prefix)
+    save_dict = nd.load("%s-%04d.params" % (prefix, epoch))
+    arg_params = {}
+    aux_params = {}
+    for k, v in save_dict.items():
+        tp, _, name = k.partition(":")
+        if tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+        else:
+            arg_params[k] = v
+    return symbol, arg_params, aux_params

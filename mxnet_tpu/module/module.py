@@ -1,0 +1,413 @@
+"""Module — symbolic training on one or more devices.
+
+Reference: ``python/mxnet/module/module.py`` (868 LoC) +
+``executor_group.py`` (DataParallelExecutorGroup:143 — per-device executor
+shards with gradient slicing).
+
+TPU-native: each context gets one whole-graph XLA executor (see
+mxnet_tpu/executor.py); the batch is sliced across contexts
+(data-parallel), gradients are reduced to the update device, and the fused
+``forward_backward`` path keeps each step a single compiled program per
+device.  With ``kvstore='tpu'`` (mxnet_tpu/kvstore.py) the reduction runs
+in-graph over the mesh instead of through this group.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as _np
+
+from .base_module import BaseModule, _as_list
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from .. import ndarray as nd
+from ..ndarray import NDArray
+from .. import optimizer as opt
+from ..initializer import InitDesc
+from ..model import load_checkpoint, save_checkpoint
+
+__all__ = ["Module"]
+
+
+class _ExecGroup:
+    """Minimal DataParallelExecutorGroup (reference:
+    executor_group.py:143)."""
+
+    def __init__(self, symbol, contexts, data_names, label_names,
+                 data_shapes, label_shapes, grad_req, fixed_param_names,
+                 inputs_need_grad, shared_group=None):
+        self.symbol = symbol
+        self.contexts = contexts
+        self.data_names = list(data_names)
+        self.label_names = list(label_names or [])
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.param_names = [n for n in self.arg_names
+                            if n not in self.data_names and
+                            n not in self.label_names]
+        n_dev = len(contexts)
+        self.batch_size = data_shapes[0][1][0]
+        assert self.batch_size % n_dev == 0, \
+            "batch size %d cannot be evenly split across %d devices" % (
+                self.batch_size, n_dev)
+        self.slice_size = self.batch_size // n_dev
+
+        reqs = {}
+        for name in self.arg_names:
+            if name in self.data_names:
+                reqs[name] = "write" if inputs_need_grad else "null"
+            elif name in self.label_names:
+                reqs[name] = "null"
+            elif fixed_param_names and name in fixed_param_names:
+                reqs[name] = "null"
+            else:
+                reqs[name] = grad_req
+        self.grad_req = reqs
+
+        self.execs = []
+        for i, ctx in enumerate(contexts):
+            shapes = {}
+            for name, shape in data_shapes:
+                shapes[name] = (self.slice_size,) + tuple(shape[1:])
+            for name, shape in (label_shapes or []):
+                shapes[name] = (self.slice_size,) + tuple(shape[1:])
+            shared = shared_group.execs[i] if shared_group else None
+            ex = symbol.simple_bind(ctx=ctx, grad_req=reqs,
+                                    shared_exec=shared, **shapes)
+            self.execs.append(ex)
+
+    def _slices(self, arrs):
+        out = []
+        for i in range(len(self.contexts)):
+            lo = i * self.slice_size
+            hi = lo + self.slice_size
+            out.append([a[lo:hi] if a.shape[0] == self.batch_size else a
+                        for a in arrs])
+        return out
+
+    def forward(self, data_batch, is_train=False):
+        data = _as_list(data_batch.data)
+        labels = _as_list(data_batch.label) if data_batch.label else []
+        data_slices = self._slices(data)
+        label_slices = self._slices(labels) if labels else \
+            [[] for _ in self.contexts]
+        for ex, dslc, lslc in zip(self.execs, data_slices, label_slices):
+            kwargs = {}
+            for name, arr in zip(self.data_names, dslc):
+                kwargs[name] = arr
+            for name, arr in zip(self.label_names, lslc):
+                if name in ex.arg_dict:
+                    kwargs[name] = arr
+            ex.forward(is_train=is_train, **kwargs)
+
+    def forward_backward(self, data_batch):
+        data = _as_list(data_batch.data)
+        labels = _as_list(data_batch.label) if data_batch.label else []
+        data_slices = self._slices(data)
+        label_slices = self._slices(labels) if labels else \
+            [[] for _ in self.contexts]
+        for ex, dslc, lslc in zip(self.execs, data_slices, label_slices):
+            kwargs = {}
+            for name, arr in zip(self.data_names, dslc):
+                kwargs[name] = arr
+            for name, arr in zip(self.label_names, lslc):
+                if name in ex.arg_dict:
+                    kwargs[name] = arr
+            ex.forward_backward(**kwargs)
+
+    def backward(self, out_grads=None):
+        for ex in self.execs:
+            ex.backward(out_grads)
+
+    def get_outputs(self, merge_multi_context=True):
+        if len(self.execs) == 1:
+            return list(self.execs[0].outputs)
+        if not merge_multi_context:
+            return [list(ex.outputs) for ex in self.execs]
+        merged = []
+        for i in range(len(self.execs[0].outputs)):
+            merged.append(nd.concatenate(
+                [ex.outputs[i].as_in_context(self.contexts[0])
+                 for ex in self.execs], axis=0))
+        return merged
+
+    def reduce_grads(self):
+        """Sum gradients across device replicas into exec 0
+        (reference: kvstore local push/pull)."""
+        if len(self.execs) == 1:
+            return
+        for name in self.param_names:
+            if self.grad_req[name] == "null":
+                continue
+            total = self.execs[0].grad_dict[name]
+            for ex in self.execs[1:]:
+                total._data = (total + ex.grad_dict[name].as_in_context(
+                    self.contexts[0]))._data
+            for ex in self.execs[1:]:
+                total.as_in_context(
+                    ex.grad_dict[name].context).copyto(ex.grad_dict[name])
+
+    def broadcast_params(self):
+        for name in self.param_names:
+            src = self.execs[0].arg_dict[name]
+            for ex in self.execs[1:]:
+                src.copyto(ex.arg_dict[name])
+        for name in self.aux_names:
+            src = self.execs[0].aux_dict[name]
+            for ex in self.execs[1:]:
+                src.copyto(ex.aux_dict[name])
+
+
+class Module(BaseModule):
+    """(reference: module.py Module:60)"""
+
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 context=None, work_load_list=None,
+                 fixed_param_names=None, state_names=None,
+                 group2ctxs=None, compression_params=None):
+        super().__init__(logger=logger)
+        if context is None:
+            context = current_context()
+        if isinstance(context, Context):
+            context = [context]
+        self._context = context
+        self._symbol = symbol
+        self._data_names = list(data_names or [])
+        self._label_names = list(label_names or [])
+        self._fixed_param_names = list(fixed_param_names or [])
+        self._exec_group = None
+        self._arg_params = None
+        self._aux_params = None
+        self._params_dirty = False
+        self._optimizer = None
+        self._updater = None
+        self._kvstore = None
+        self._grad_req = "write"
+        self._monitor = None
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        sym, args, auxs = load_checkpoint(prefix, epoch)
+        mod = Module(symbol=sym, **kwargs)
+        mod._arg_params = args
+        mod._aux_params = auxs
+        mod.params_initialized = True
+        if load_optimizer_states:
+            mod._preload_opt_states = "%s-%04d.states" % (prefix, epoch)
+        return mod
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        self._symbol.save("%s-symbol.json" % prefix)
+        arg_params, aux_params = self.get_params()
+        save_checkpoint(prefix, epoch, None, arg_params, aux_params)
+        if save_optimizer_states and self._updater is not None:
+            with open("%s-%04d.states" % (prefix, epoch), "wb") as f:
+                f.write(self._updater.get_states())
+
+    # -- properties --------------------------------------------------------
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._symbol.list_outputs()
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        outs = self._exec_group.get_outputs()
+        return list(zip(self.output_names, [o.shape for o in outs]))
+
+    # -- binding -----------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False,
+             shared_module=None, grad_req="write"):
+        if self.binded and not force_rebind:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self._grad_req = grad_req
+
+        def _norm(shapes):
+            if shapes is None:
+                return None
+            out = []
+            for s in shapes:
+                if hasattr(s, "name"):
+                    out.append((s.name, tuple(s.shape)))
+                else:
+                    out.append((s[0], tuple(s[1])))
+            return out
+
+        self._data_shapes = _norm(data_shapes)
+        self._label_shapes = _norm(label_shapes)
+        self._exec_group = _ExecGroup(
+            self._symbol, self._context, self._data_names,
+            self._label_names, self._data_shapes, self._label_shapes,
+            grad_req if for_training else "null",
+            self._fixed_param_names, inputs_need_grad)
+        self.binded = True
+        if self._arg_params is not None:
+            self._set_exec_params(self._arg_params, self._aux_params)
+
+    # -- parameters --------------------------------------------------------
+    def init_params(self, initializer=None, arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded, "call bind before initializing the parameters"
+        from .. import initializer as init_mod
+        if initializer is None:
+            initializer = init_mod.Uniform(0.01)
+
+        ex0 = self._exec_group.execs[0]
+        for name in self._exec_group.param_names:
+            arr = ex0.arg_dict[name]
+            if arg_params is not None and name in arg_params:
+                arg_params[name].copyto(arr)
+            elif arg_params is not None and not allow_missing:
+                raise RuntimeError(
+                    "Parameter %r is missing from arg_params and "
+                    "allow_missing is False" % name)
+            else:
+                initializer(InitDesc(name), arr)
+        for name in self._exec_group.aux_names:
+            arr = ex0.aux_dict[name]
+            if aux_params is not None and name in aux_params:
+                aux_params[name].copyto(arr)
+            else:
+                initializer(InitDesc(name), arr)
+        self._exec_group.broadcast_params()
+        self.params_initialized = True
+        self._params_dirty = False
+
+    def _set_exec_params(self, arg_params, aux_params):
+        ex0 = self._exec_group.execs[0]
+        for name, arr in (arg_params or {}).items():
+            if name in ex0.arg_dict:
+                arr.copyto(ex0.arg_dict[name])
+        for name, arr in (aux_params or {}).items():
+            if name in ex0.aux_dict:
+                arr.copyto(ex0.aux_dict[name])
+        self._exec_group.broadcast_params()
+        self.params_initialized = True
+
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        ex0 = self._exec_group.execs[0]
+        arg_params = {n: ex0.arg_dict[n].copy()
+                      for n in self._exec_group.param_names}
+        aux_params = {n: ex0.aux_dict[n].copy()
+                      for n in self._exec_group.aux_names}
+        return arg_params, aux_params
+
+    # -- optimizer ---------------------------------------------------------
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            return
+        if isinstance(optimizer, str):
+            batch_size = self._exec_group.batch_size
+            idx2name = {i: n for i, n in
+                        enumerate(self._exec_group.param_names)}
+            optimizer_params = dict(optimizer_params)
+            # reference module.py init_optimizer: grads are rescaled by
+            # 1/batch_size unless the caller overrides
+            optimizer_params.setdefault("rescale_grad", 1.0 / batch_size)
+            optimizer = opt.create(optimizer, param_idx2name=idx2name,
+                                   **optimizer_params)
+        self._optimizer = optimizer
+        self._updater = opt.get_updater(optimizer)
+        if getattr(self, "_preload_opt_states", None):
+            with open(self._preload_opt_states, "rb") as f:
+                self._updater.set_states(f.read())
+            self._preload_opt_states = None
+        self.optimizer_initialized = True
+
+    # -- execution ---------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        self._exec_group.forward(data_batch, is_train)
+
+    def forward_backward(self, data_batch):
+        """Fused per-device forward+backward (single XLA program each)."""
+        assert self.binded and self.params_initialized
+        self._exec_group.forward_backward(data_batch)
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        self._exec_group.backward(out_grads)
+
+    def update(self):
+        """(reference: module.py update:644 — kvstore push/pull + updater)"""
+        assert self.binded and self.params_initialized and \
+            self.optimizer_initialized
+        self._params_dirty = True
+        self._exec_group.reduce_grads()
+        ex0 = self._exec_group.execs[0]
+        for i, name in enumerate(self._exec_group.param_names):
+            if self._exec_group.grad_req[name] == "null":
+                continue
+            # grads were summed across device slices, so with
+            # rescale_grad=1/batch_size this is already the batch mean
+            self._updater(i, ex0.grad_dict[name], ex0.arg_dict[name])
+        self._exec_group.broadcast_params()
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized
+        return self._exec_group.get_outputs(merge_multi_context)
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized and \
+            self.inputs_need_grad
+        grads = []
+        for name in self._data_names:
+            per_dev = [ex.grad_dict[name] for ex in
+                       self._exec_group.execs]
+            if len(per_dev) == 1 or not merge_multi_context:
+                grads.append(per_dev[0] if merge_multi_context else per_dev)
+            else:
+                grads.append(nd.concatenate(
+                    [g.as_in_context(self._context[0]) for g in per_dev],
+                    axis=0))
+        return grads
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        outputs = self.get_outputs()
+        eval_metric.update(labels, outputs[:len(labels)]
+                           if labels else outputs)
+
+    def install_monitor(self, mon):
+        assert self.binded
+        for ex in self._exec_group.execs:
+            ex.set_monitor_callback(mon)
+
+    def reshape(self, data_shapes, label_shapes=None):
+        assert self.binded
+        arg_params, aux_params = self.get_params()
+        self.bind(data_shapes, label_shapes, self.for_training,
+                  self.inputs_need_grad, force_rebind=True)
+        self._set_exec_params(arg_params, aux_params)

@@ -523,13 +523,10 @@ def _invoke(op_name, nd_inputs, params, out=None):
     raw_out = _reg.invoke(op, arrays, params, rng=rng)
     outputs = [NDArray(o) for o in raw_out]
     if _ag.is_recording():
-        import functools
-        pf = functools.partial(op.fn, **{k: v for k, v in params.items()
-                                         if v is not None or k in
-                                         ("a_min", "a_max")})
-        node_fn = pf
-        _ag.record_op(node_fn if rng is None else node_fn, nd_inputs,
-                      outputs, rng=rng)
+        _static, dyn, frozen = _reg.split_params(op, params)
+        _ag.record_op(None, nd_inputs, outputs, rng=rng,
+                      op_ref=(op.name, frozen, tuple(sorted(dyn))),
+                      dyn=dyn)
     from ..runtime import engine as _eng
     if _eng.is_naive():
         for o in outputs:

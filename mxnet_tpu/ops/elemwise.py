@@ -229,3 +229,10 @@ def _leaky_inputs(params):
     return ("data",)
 
 _get_op("LeakyReLU").active_inputs = _leaky_inputs
+
+
+# scalar-arith ops take the scalar as a traced arg so varying Python
+# scalars in a loop do not trigger one compilation per distinct value
+for _name in _SCALAR:
+    _get_op(_name).dynamic_params = ("scalar",)
+_get_op("smooth_l1").dynamic_params = ("scalar",)

@@ -18,11 +18,14 @@ import jax.numpy as jnp
 from .registry import register_op
 
 
-def _rescale_clip(grad, rescale_grad, clip_gradient, wd=0.0, weight=None):
+def _rescale_clip(grad, rescale_grad, clip_gradient, wd=None, weight=None):
+    # wd/rescale_grad may be traced scalars (dynamic params) — no Python
+    # branching on their values; clip_gradient stays a static param.
     grad = grad * rescale_grad
-    if clip_gradient is not None and clip_gradient >= 0:
+    if clip_gradient is not None and not hasattr(clip_gradient, "dtype") \
+            and clip_gradient >= 0:
         grad = jnp.clip(grad, -clip_gradient, clip_gradient)
-    if wd and weight is not None:
+    if wd is not None and weight is not None:
         grad = grad + wd * weight
     return grad
 
@@ -198,3 +201,17 @@ def _nadam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
     m_bar = (1 - m_t) * g_hat + m_t1 * m_hat
     w = weight - lr * m_bar / (jnp.sqrt(var / (1 - beta2 ** t)) + epsilon)
     return w, mean, var
+
+
+# -- dynamic scalar params (avoid per-step recompiles; see registry) --------
+from .registry import get_op as _get_op
+
+_DYN = ("lr", "wd", "rescale_grad", "momentum", "t", "wd_lh", "beta1",
+        "beta2", "gamma1", "gamma2", "rho", "lamda1", "beta")
+for _name in ("sgd_update", "sgd_mom_update", "nag_mom_update",
+              "mp_sgd_update", "mp_sgd_mom_update", "adam_update",
+              "rmsprop_update", "rmspropalex_update", "ftrl_update",
+              "ftml_update", "signsgd_update", "signum_update",
+              "_sparse_adagrad_update", "adadelta_update", "adamax_update",
+              "nadam_update"):
+    _get_op(_name).dynamic_params = _DYN

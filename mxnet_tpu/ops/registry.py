@@ -45,7 +45,7 @@ class Op:
 
     __slots__ = ("name", "fn", "num_outputs", "needs_rng", "donate", "doc",
                  "input_names", "num_visible_outputs", "param_names",
-                 "aux_states", "active_inputs")
+                 "aux_states", "active_inputs", "dynamic_params")
 
     def __init__(self, name, fn, num_outputs=1, needs_rng=False, donate=(),
                  doc=None, input_names=None, num_visible_outputs=None):
@@ -67,6 +67,12 @@ class Op:
         # active_inputs: optional fn(params) -> tuple of input names actually
         # consumed (e.g. Convolution drops "bias" when no_bias=True)
         self.active_inputs = None
+        # dynamic_params: scalar params passed as traced array args instead
+        # of compile-time constants, so per-step values (lr, t, ...) do NOT
+        # recompile the executable.  Critical on TPU where a compile is
+        # O(10s) — an optimizer whose lr changes per step would otherwise
+        # recompile every update.
+        self.dynamic_params = ()
 
     def input_names_for(self, params):
         if self.active_inputs is None:
@@ -165,13 +171,62 @@ def _freeze(v):
 
 
 @functools.lru_cache(maxsize=None)
-def _compiled(name, frozen_params, donate):
+def _compiled(name, frozen_params, dyn_names, donate):
     op = _OPS[name]
     params = {k: v for k, v in frozen_params}
-    fn = functools.partial(op.fn, **params) if params else op.fn
+
+    def fn(*arrays, **dyn):
+        return op.fn(*arrays, **params, **dyn)
+
     if jax.default_backend() == "cpu":
         donate = ()  # CPU PJRT has no donation; avoids per-call warnings
     return jax.jit(fn, donate_argnums=donate)
+
+
+def _dyn_value(v):
+    # Pass Python scalars through untouched: jit abstracts them as
+    # WEAKLY-typed arrays, so bf16/fp16 arrays keep their dtype under
+    # promotion (a strong f32 scalar would silently upcast fp16 weights
+    # to f32 on the first optimizer step).
+    return v
+
+
+def split_params(op, params):
+    """Split op params into (static, dyn, frozen_static) — dyn values are
+    traced scalars (see Op.dynamic_params)."""
+    dyn = {}
+    static = {}
+    for k, v in params.items():
+        if v is None:
+            continue
+        if k in op.dynamic_params and isinstance(v, (int, float)) and \
+                not isinstance(v, bool):
+            dyn[k] = _dyn_value(v)
+        else:
+            static[k] = v
+    frozen = tuple(sorted((k, _freeze(v)) for k, v in static.items()))
+    return static, dyn, frozen
+
+
+@functools.lru_cache(maxsize=None)
+def vjp_jit(op_name, frozen_params, dyn_names, has_rng):
+    """Cached jitted VJP for one op signature: (inputs, dyn, rng, cots) ->
+    input cotangents.  The eager tape uses this so backward never
+    re-traces/re-compiles an op it has differentiated before."""
+    op = _OPS[op_name]
+    params = {k: v for k, v in frozen_params}
+
+    def run(inputs, dyn, rng, cots):
+        def f(*arrs):
+            if has_rng:
+                out = op.fn(rng, *arrs, **params, **dyn)
+            else:
+                out = op.fn(*arrs, **params, **dyn)
+            return out if isinstance(out, tuple) else (out,)
+        _, vjp = jax.vjp(f, *inputs)
+        return vjp(tuple(cots))
+
+    return jax.jit(run)
 
 
 def invoke(op, args, params, rng=None):
@@ -179,18 +234,17 @@ def invoke(op, args, params, rng=None):
     executable cache.  Returns a tuple of jax arrays."""
     if isinstance(op, str):
         op = get_op(op)
-    frozen = tuple(sorted((k, _freeze(v)) for k, v in params.items()
-                          if v is not None))
+    static, dyn, frozen = split_params(op, params)
     donate = tuple(i + 1 for i in op.donate) if (op.needs_rng and op.donate) \
         else op.donate
-    fn = _compiled(op.name, frozen, donate)
+    fn = _compiled(op.name, frozen, tuple(sorted(dyn)), donate)
     if op.needs_rng:
         if rng is None:
             from ..runtime import rng as _rng
             rng = _rng.next_key()
-        out = fn(rng, *args)
+        out = fn(rng, *args, **dyn)
     else:
-        out = fn(*args)
+        out = fn(*args, **dyn)
     if not isinstance(out, tuple):
         out = (out,)
     return out

@@ -1,0 +1,216 @@
+"""Data IO (reference: tests/python/unittest/test_io.py)."""
+
+import gzip
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.io import (NDArrayIter, MNISTIter, CSVIter, LibSVMIter,
+                          ResizeIter, PrefetchingIter, DataBatch)
+
+
+def _write_idx(tmp_path, n=50, rows=8, cols=8, seed=0):
+    rng = np.random.RandomState(seed)
+    images = rng.randint(0, 256, (n, rows, cols), dtype=np.uint8)
+    labels = rng.randint(0, 10, (n,), dtype=np.uint8)
+    img_path = str(tmp_path / "train-images-idx3-ubyte")
+    lbl_path = str(tmp_path / "train-labels-idx1-ubyte")
+    with open(img_path, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, rows, cols))
+        f.write(images.tobytes())
+    with open(lbl_path, "wb") as f:
+        f.write(struct.pack(">II", 2049, n))
+        f.write(labels.tobytes())
+    return img_path, lbl_path, images, labels
+
+
+def test_ndarray_iter():
+    data = np.arange(40).reshape(10, 4).astype(np.float32)
+    label = np.arange(10).astype(np.float32)
+    it = NDArrayIter(data, label, batch_size=3, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[0].data[0].shape == (3, 4)
+    assert batches[-1].pad == 2
+    it.reset()
+    assert len(list(it)) == 4
+
+    it = NDArrayIter(data, label, batch_size=3,
+                     last_batch_handle="discard")
+    assert len(list(it)) == 3
+
+
+def test_ndarray_iter_shuffle():
+    data = np.arange(20).reshape(20, 1).astype(np.float32)
+    it = NDArrayIter(data, data[:, 0], batch_size=5, shuffle=True)
+    seen = np.concatenate([b.data[0].asnumpy()[:, 0] for b in it])
+    assert sorted(seen.tolist()) == list(range(20))
+
+
+def test_provide_data_label():
+    it = NDArrayIter(np.zeros((8, 3)), np.zeros(8), batch_size=4)
+    assert it.provide_data[0].name == "data"
+    assert it.provide_data[0].shape == (4, 3)
+    assert it.provide_label[0].name == "softmax_label"
+
+
+def test_mnist_iter(tmp_path):
+    img, lbl, images, labels = _write_idx(tmp_path)
+    it = MNISTIter(image=img, label=lbl, batch_size=10, shuffle=False)
+    batch = next(iter(it))
+    assert batch.data[0].shape == (10, 1, 8, 8)
+    np.testing.assert_allclose(batch.data[0].asnumpy()[0, 0],
+                               images[0] / 255.0, rtol=1e-6)
+    np.testing.assert_allclose(batch.label[0].asnumpy(), labels[:10])
+    # flat mode
+    it = MNISTIter(image=img, label=lbl, batch_size=10, flat=True,
+                   shuffle=False)
+    assert next(iter(it)).data[0].shape == (10, 64)
+
+
+def test_mnist_iter_gz(tmp_path):
+    img, lbl, images, labels = _write_idx(tmp_path)
+    for p in (img, lbl):
+        with open(p, "rb") as fin, gzip.open(p + ".gz", "wb") as fout:
+            fout.write(fin.read())
+        os.remove(p)
+    it = MNISTIter(image=img + ".gz", label=lbl + ".gz", batch_size=5,
+                   shuffle=False)
+    assert next(iter(it)).data[0].shape == (5, 1, 8, 8)
+
+
+def test_csv_iter(tmp_path):
+    data = np.random.rand(12, 3).astype(np.float32)
+    label = np.arange(12).astype(np.float32)
+    dpath = str(tmp_path / "data.csv")
+    lpath = str(tmp_path / "label.csv")
+    np.savetxt(dpath, data, delimiter=",")
+    np.savetxt(lpath, label, delimiter=",")
+    it = CSVIter(data_csv=dpath, data_shape=(3,), label_csv=lpath,
+                 batch_size=4)
+    batch = next(iter(it))
+    assert batch.data[0].shape == (4, 3)
+    np.testing.assert_allclose(batch.data[0].asnumpy(), data[:4],
+                               rtol=1e-5)
+
+
+def test_libsvm_iter(tmp_path):
+    path = str(tmp_path / "data.libsvm")
+    with open(path, "w") as f:
+        f.write("1 0:1.5 3:2.0\n")
+        f.write("0 1:3.0\n")
+        f.write("1 2:4.0 3:1.0\n")
+        f.write("0 0:2.5\n")
+    it = LibSVMIter(data_libsvm=path, data_shape=(4,), batch_size=2)
+    batch = next(iter(it))
+    assert batch.data[0].stype == "csr"
+    dense = batch.data[0].asnumpy()
+    np.testing.assert_allclose(dense, [[1.5, 0, 0, 2.0], [0, 3.0, 0, 0]])
+    np.testing.assert_allclose(batch.label[0].asnumpy(), [1, 0])
+
+
+def test_resize_iter():
+    it = NDArrayIter(np.zeros((10, 2)), np.zeros(10), batch_size=5)
+    resized = ResizeIter(it, 5)
+    assert len(list(resized)) == 5
+
+
+def test_prefetching_iter():
+    it = NDArrayIter(np.arange(40).reshape(20, 2).astype(np.float32),
+                     np.zeros(20), batch_size=5)
+    pre = PrefetchingIter(it)
+    batches = []
+    while True:
+        try:
+            batches.append(pre.next())
+        except StopIteration:
+            break
+    assert len(batches) == 4
+    pre.reset()
+    count = 0
+    while True:
+        try:
+            pre.next()
+            count += 1
+        except StopIteration:
+            break
+    assert count == 4
+
+
+def test_recordio(tmp_path):
+    from mxnet_tpu import recordio
+    path = str(tmp_path / "test.rec")
+    writer = recordio.MXRecordIO(path, "w")
+    for i in range(5):
+        writer.write(b"record%d" % i)
+    writer.close()
+    reader = recordio.MXRecordIO(path, "r")
+    for i in range(5):
+        assert reader.read() == b"record%d" % i
+    assert reader.read() is None
+    reader.close()
+
+
+def test_indexed_recordio(tmp_path):
+    from mxnet_tpu import recordio
+    rec = str(tmp_path / "test.rec")
+    idx = str(tmp_path / "test.idx")
+    writer = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(5):
+        writer.write_idx(i, b"payload%d" % i)
+    writer.close()
+    reader = recordio.MXIndexedRecordIO(idx, rec, "r")
+    assert reader.read_idx(3) == b"payload3"
+    assert reader.read_idx(0) == b"payload0"
+    reader.close()
+
+
+def test_recordio_pack_unpack():
+    from mxnet_tpu import recordio
+    header = recordio.IRHeader(0, 7.0, 42, 0)
+    s = recordio.pack(header, b"imagebytes")
+    h2, payload = recordio.unpack(s)
+    assert h2.label == 7.0
+    assert h2.id == 42
+    assert payload == b"imagebytes"
+    # vector label
+    header = recordio.IRHeader(0, [1.0, 2.0, 3.0], 1, 0)
+    s = recordio.pack(header, b"x")
+    h2, payload = recordio.unpack(s)
+    np.testing.assert_allclose(h2.label, [1, 2, 3])
+
+
+def test_gluon_dataloader():
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    x = np.random.rand(20, 3).astype(np.float32)
+    y = np.arange(20).astype(np.float32)
+    ds = ArrayDataset(x, y)
+    assert len(ds) == 20
+    loader = DataLoader(ds, batch_size=4, shuffle=False)
+    batches = list(loader)
+    assert len(batches) == 5
+    data, label = batches[0]
+    assert data.shape == (4, 3)
+    np.testing.assert_allclose(data.asnumpy(), x[:4], rtol=1e-6)
+    # threaded
+    loader = DataLoader(ds, batch_size=4, num_workers=2)
+    assert len(list(loader)) == 5
+
+
+def test_gluon_dataset_transform():
+    from mxnet_tpu.gluon.data import ArrayDataset
+    ds = ArrayDataset(np.arange(10).astype(np.float32))
+    ds2 = ds.transform(lambda x: x * 2)
+    assert ds2[3] == 6.0
+
+
+def test_batch_sampler():
+    from mxnet_tpu.gluon.data import BatchSampler, SequentialSampler
+    bs = BatchSampler(SequentialSampler(10), 3, "keep")
+    assert len(list(bs)) == 4
+    bs = BatchSampler(SequentialSampler(10), 3, "discard")
+    assert len(list(bs)) == 3

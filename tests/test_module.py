@@ -1,0 +1,149 @@
+"""Module API + the end-to-end MNIST slice
+(reference: tests/python/unittest/test_module.py, tests/python/train/)."""
+
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym, nd
+from mxnet_tpu.io import NDArrayIter, MNISTIter
+
+
+def _mlp_sym(num_hidden=32, num_classes=4):
+    data = sym.var("data")
+    net = sym.FullyConnected(data, num_hidden=num_hidden, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=num_classes, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def _lenet_sym():
+    data = sym.var("data")
+    c1 = sym.Convolution(data, kernel=(3, 3), num_filter=8, name="conv1")
+    a1 = sym.Activation(c1, act_type="tanh")
+    p1 = sym.Pooling(a1, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    f = sym.Flatten(p1)
+    fc1 = sym.FullyConnected(f, num_hidden=32, name="fc1")
+    a2 = sym.Activation(fc1, act_type="tanh")
+    fc2 = sym.FullyConnected(a2, num_hidden=10, name="fc2")
+    return sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _toy_data(n=256, dim=16, classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(classes, dim) * 3
+    labels = rng.randint(0, classes, n)
+    data = centers[labels] + rng.randn(n, dim)
+    return data.astype(np.float32), labels.astype(np.float32)
+
+
+def test_module_fit_toy():
+    data, labels = _toy_data()
+    train = NDArrayIter(data, labels, batch_size=32, shuffle=True)
+    mod = mx.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, num_epoch=5, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1})
+    score = mod.score(NDArrayIter(data, labels, batch_size=32), "acc")
+    assert score[0][1] > 0.9, "toy problem should be learnable: %s" % score
+
+
+def test_module_predict():
+    data, labels = _toy_data(n=64)
+    train = NDArrayIter(data, labels, batch_size=16)
+    mod = mx.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, num_epoch=2, optimizer="sgd")
+    preds = mod.predict(NDArrayIter(data, labels, batch_size=16))
+    assert preds.shape == (64, 4)
+
+
+def test_module_checkpoint(tmp_path):
+    data, labels = _toy_data(n=64)
+    train = NDArrayIter(data, labels, batch_size=16)
+    mod = mx.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, num_epoch=1, optimizer="sgd")
+    prefix = str(tmp_path / "model")
+    mod.save_checkpoint(prefix, 1)
+
+    mod2 = mx.Module.load(prefix, 1, context=mx.cpu())
+    mod2.bind(train.provide_data, train.provide_label, for_training=False)
+    p1 = mod.predict(NDArrayIter(data, labels, batch_size=16)).asnumpy()
+    p2 = mod2.predict(NDArrayIter(data, labels, batch_size=16)).asnumpy()
+    np.testing.assert_allclose(p1, p2, rtol=1e-5, atol=1e-6)
+
+
+def test_module_epoch_end_checkpoint(tmp_path):
+    data, labels = _toy_data(n=64)
+    train = NDArrayIter(data, labels, batch_size=16)
+    mod = mx.Module(_mlp_sym(), context=mx.cpu())
+    prefix = str(tmp_path / "cb")
+    mod.fit(train, num_epoch=2,
+            epoch_end_callback=mx.callback.do_checkpoint(prefix))
+    s, a, x = mx.model.load_checkpoint(prefix, 2)
+    assert "fc1_weight" in a
+
+
+def test_module_input_grads():
+    data, labels = _toy_data(n=32)
+    mod = mx.Module(_mlp_sym(), context=mx.cpu())
+    train = NDArrayIter(data, labels, batch_size=8)
+    mod.bind(train.provide_data, train.provide_label,
+             inputs_need_grad=True)
+    mod.init_params()
+    mod.init_optimizer()
+    batch = next(iter(train))
+    mod.forward_backward(batch)
+    g = mod.get_input_grads()[0]
+    assert g.shape == (8, 16)
+    assert np.abs(g.asnumpy()).sum() > 0
+
+
+def test_module_multi_device():
+    """Data-parallel across 2 virtual devices (reference:
+    DataParallelExecutorGroup semantics)."""
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    data, labels = _toy_data(n=128)
+    train = NDArrayIter(data, labels, batch_size=32, shuffle=True)
+    mod = mx.Module(_mlp_sym(), context=[mx.cpu(0), mx.cpu(1)])
+    mod.fit(train, num_epoch=3, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1})
+    score = mod.score(NDArrayIter(data, labels, batch_size=32), "acc")
+    assert score[0][1] > 0.8
+
+
+def _write_synth_mnist(tmp_path, n=512, seed=0):
+    """Synthetic 'MNIST': each class k is a bright square in region k."""
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, n).astype(np.uint8)
+    images = (rng.rand(n, 12, 12) * 40).astype(np.uint8)
+    for i, k in enumerate(labels):
+        r, c = divmod(int(k), 4)
+        images[i, 3 * r:3 * r + 4, 3 * c:3 * c + 4] = 220
+    img = str(tmp_path / "train-images-idx3-ubyte")
+    lbl = str(tmp_path / "train-labels-idx1-ubyte")
+    with open(img, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, 12, 12))
+        f.write(images.tobytes())
+    with open(lbl, "wb") as f:
+        f.write(struct.pack(">II", 2049, n))
+        f.write(labels.tobytes())
+    return img, lbl
+
+
+def test_lenet_mnist_end_to_end(tmp_path):
+    """The SURVEY.md §7 step-3 milestone: MNISTIter -> LeNet -> Module.fit
+    -> accuracy, exercising iterator, executor, optimizer, metric and
+    checkpointing in one pass (reference: train_mnist.py)."""
+    img, lbl = _write_synth_mnist(tmp_path)
+    train = MNISTIter(image=img, label=lbl, batch_size=32, shuffle=True)
+    val = MNISTIter(image=img, label=lbl, batch_size=32, shuffle=False)
+    mod = mx.Module(_lenet_sym(), context=mx.cpu())
+    mod.fit(train, eval_data=val, num_epoch=6, optimizer="sgd",
+            initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.5, "momentum": 0.9})
+    score = mod.score(val, "acc")
+    assert score[0][1] > 0.9, "LeNet should learn synthetic MNIST: %s" % \
+        score
