@@ -30,6 +30,8 @@ from . import model  # noqa: F401
 from . import callback  # noqa: F401
 from .module import Module  # noqa: F401
 from . import kvstore  # noqa: F401
+from . import kvstore as kv  # noqa: F401
+from . import parallel  # noqa: F401
 from . import recordio  # noqa: F401
 from .runtime import engine  # noqa: F401
 

@@ -394,10 +394,14 @@ class _CachedGraph:
         return out
 
 
-_block_key_state = [jax.random.PRNGKey(17), 0]
+# lazily initialized: creating a PRNG key eagerly would force jax backend
+# initialization at `import mxnet_tpu`
+_block_key_state = [None, 0]
 
 
 def _next_block_key():
+    if _block_key_state[0] is None:
+        _block_key_state[0] = jax.random.PRNGKey(17)
     _block_key_state[1] += 1
     return jax.random.fold_in(_block_key_state[0], _block_key_state[1])
 

@@ -78,10 +78,25 @@ class Trainer:
                           for _ in self._contexts or [None]]
 
     def _init_kvstore(self):
-        if self._kvstore_type and len(self._contexts) > 1 and \
-                self._kvstore_type not in ("device", "local"):
+        kv_type = self._kvstore_type
+        if isinstance(kv_type, str) and "dist" in kv_type:
             from .. import kvstore as kvs
-            self._kvstore = kvs.create(self._kvstore_type)
+            self._kvstore = kvs.create(kv_type)
+            # distributed: weights live on the server; optimizer runs
+            # server-side (reference: trainer.py _init_kvstore:158 with
+            # update_on_kvstore)
+            if self._update_on_kvstore is None:
+                self._update_on_kvstore = True
+            for i, param in enumerate(self._params):
+                if param._data is None:
+                    continue
+                self._kvstore.init(i, param.data())
+            if self._update_on_kvstore:
+                self._kvstore.set_optimizer(self._optimizer)
+        elif kv_type and len(self._contexts) > 1 and \
+                kv_type not in ("device", "local"):
+            from .. import kvstore as kvs
+            self._kvstore = kvs.create(kv_type)
         self._kv_initialized = True
 
     @property
@@ -128,6 +143,19 @@ class Trainer:
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
+        if self._kvstore is not None and self._update_on_kvstore:
+            # distributed: push grads, pull updated weights (reference:
+            # trainer.py _update with update_on_kvstore)
+            for i, param in enumerate(self._params):
+                if param.grad_req == "null" or param._data is None:
+                    continue
+                self._kvstore.push(i, param.list_grad())
+            self._kvstore.barrier()
+            for i, param in enumerate(self._params):
+                if param.grad_req == "null" or param._data is None:
+                    continue
+                self._kvstore.pull(i, out=param.list_data())
+            return
         for i, param in enumerate(self._params):
             if param.grad_req == "null" or param._data is None:
                 continue

@@ -1,10 +1,6 @@
-"""KVStore (reference: python/mxnet/kvstore.py over src/kvstore/).
+"""mx.kv — key-value store (reference: python/mxnet/kvstore.py over
+src/kvstore/; see _kvstore_impl.py for the TPU-native backends)."""
 
-Implemented in the parallel milestone; see create()."""
-
-from __future__ import annotations
-
-
-def create(name="local"):
-    from ._kvstore_impl import create as _create
-    return _create(name)
+from ._kvstore_impl import create, KVStoreBase  # noqa: F401
+from ._kvstore_impl import (KVStoreLocal, KVStoreTPU, KVStoreDist,  # noqa
+                            KVStoreServer)

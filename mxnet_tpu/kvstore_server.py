@@ -1,0 +1,38 @@
+"""Server-role bootstrap (reference: python/mxnet/kvstore_server.py:85 —
+if DMLC_ROLE=server the process blocks in RunServer).
+
+Launch:  DMLC_ROLE=server DMLC_PS_ROOT_PORT=9091 DMLC_NUM_WORKER=2 \
+         python -m mxnet_tpu.kvstore_server dist_sync
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+from ._kvstore_impl import KVStoreServer
+
+
+def run_server(kv_type="dist_sync", host=None, port=None, num_workers=None):
+    # The parameter server is a host-side service: aggregation and the
+    # server-side optimizer run on CPU (the reference's ps-lite servers
+    # are CPU processes), never on the accelerator.
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    sync = "async" not in kv_type
+    server = KVStoreServer(
+        sync_mode=sync,
+        num_workers=num_workers or
+        int(os.environ.get("DMLC_NUM_WORKER", "1")),
+        host=host or os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1"),
+        port=port or int(os.environ.get("DMLC_PS_ROOT_PORT", "9091")))
+    server.run()
+    return server
+
+
+if __name__ == "__main__":
+    kv_type = sys.argv[1] if len(sys.argv) > 1 else "dist_sync"
+    run_server(kv_type)

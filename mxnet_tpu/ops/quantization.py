@@ -1,0 +1,103 @@
+"""Quantization operators.
+
+Covers the reference's INT8 path (src/operator/quantization/: quantize,
+dequantize, requantize) and KVStore's 2-bit gradient compression with
+error-feedback residual (src/kvstore/gradient_compression.cc:60,101-113).
+All pure jnp — the 2-bit pack runs as one fused XLA kernel per tensor.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+from ..base import np_dtype
+
+
+@register_op("_contrib_quantize", num_outputs=3, aliases=("quantize",))
+def _quantize(data, min_range, max_range, out_type="uint8"):
+    """Affine-quantize to int8/uint8 (reference: quantize-inl.h)."""
+    if out_type == "uint8":
+        qmin, qmax = 0.0, 255.0
+        dt = jnp.uint8
+    else:
+        qmin, qmax = -127.0, 127.0
+        dt = jnp.int8
+    scale = (qmax - qmin) / jnp.maximum(max_range - min_range, 1e-20)
+    q = jnp.clip(jnp.round((data - min_range) * scale + qmin), qmin, qmax)
+    return q.astype(dt), min_range, max_range
+
+
+@register_op("_contrib_dequantize", aliases=("dequantize",))
+def _dequantize(data, min_range, max_range, out_type="float32"):
+    if data.dtype == jnp.uint8:
+        qmin, qmax = 0.0, 255.0
+    else:
+        qmin, qmax = -127.0, 127.0
+    scale = (max_range - min_range) / (qmax - qmin)
+    return (data.astype(jnp.float32) - qmin) * scale + min_range
+
+
+@register_op("_contrib_requantize", num_outputs=3)
+def _requantize(data, min_range, max_range, min_calib_range=None,
+                max_calib_range=None):
+    # int32 -> int8 with (possibly calibrated) range
+    real = data.astype(jnp.float32) * (max_range - min_range) / \
+        (2.0 ** 31 - 1)
+    lo = min_calib_range if min_calib_range is not None else min_range
+    hi = max_calib_range if max_calib_range is not None else max_range
+    scale = 127.0 / jnp.maximum(jnp.maximum(jnp.abs(lo), jnp.abs(hi)),
+                                1e-20)
+    q = jnp.clip(jnp.round(real * scale), -127, 127).astype(jnp.int8)
+    return q, -jnp.abs(hi), jnp.abs(hi)
+
+
+# ---------------------------------------------------------------------------
+# 2-bit gradient compression (error feedback)
+# ---------------------------------------------------------------------------
+
+
+@register_op("_contrib_quantize_2bit", num_outputs=2)
+def _quantize_2bit(grad, residual, threshold=0.5):
+    """Ternarize grad+residual to {-t, 0, +t}; returns (codes, residual').
+
+    codes: int8 in {-1, 0, 1} (the reference packs 16 values/word —
+    src/kvstore/gradient_compression.cc Quantize2BitKernel; we keep int8
+    lanes, the wire format packs separately).
+    """
+    acc = grad + residual
+    pos = (acc >= threshold)
+    neg = (acc <= -threshold)
+    code = pos.astype(jnp.int8) - neg.astype(jnp.int8)
+    decoded = code.astype(grad.dtype) * threshold
+    new_residual = acc - decoded
+    return code, new_residual
+
+
+@register_op("_contrib_dequantize_2bit")
+def _dequantize_2bit(codes, threshold=0.5, dtype="float32"):
+    return codes.astype(np_dtype(dtype)) * threshold
+
+
+def pack_2bit(codes):
+    """Host-side: pack int8 {-1,0,1} lanes into a uint8 array, 4 values
+    per byte (wire format for the dist kvstore)."""
+    import numpy as np
+    flat = np.asarray(codes).ravel()
+    pad = (-len(flat)) % 4
+    if pad:
+        flat = np.concatenate([flat, np.zeros(pad, flat.dtype)])
+    two_bit = (flat + 1).astype(np.uint8)      # {-1,0,1} -> {0,1,2}
+    packed = (two_bit[0::4] | (two_bit[1::4] << 2) |
+              (two_bit[2::4] << 4) | (two_bit[3::4] << 6))
+    return packed, len(np.asarray(codes).ravel())
+
+
+def unpack_2bit(packed, n):
+    import numpy as np
+    packed = np.asarray(packed)
+    vals = np.empty(len(packed) * 4, np.int8)
+    for i in range(4):
+        vals[i::4] = ((packed >> (2 * i)) & 0x3).astype(np.int8) - 1
+    return vals[:n]

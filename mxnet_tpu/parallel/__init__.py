@@ -1,0 +1,8 @@
+"""Parallelism package: device mesh, collectives, in-graph data/tensor
+parallelism, ring attention (reference counterpart: src/kvstore/ comm
+machinery + the parallel training orchestration in python/mxnet/module)."""
+
+from .mesh import (make_mesh, current_mesh, use_mesh, data_parallel_mesh,
+                   PartitionSpec, NamedSharding, named_sharding)  # noqa
+from . import collectives  # noqa: F401
+from .data_parallel import ParallelTrainer  # noqa: F401

@@ -1,0 +1,197 @@
+"""In-graph data-parallel training — the ``kvstore='tpu'`` execution path.
+
+The reference's data parallelism is host-orchestrated: per-GPU executors,
+then KVStore push/pull moves gradients through NCCL/ps-lite
+(SURVEY.md §2.3).  The TPU-native equivalent inverts this: the WHOLE
+training step — forward, backward, gradient all-reduce, fused optimizer
+update — is one pjit-compiled SPMD program over a `jax.sharding.Mesh`.
+Parameters/optimizer state are replicated (or dp-sharded, ZeRO-style, with
+``shard_params=True``); the batch is sharded over the ``dp`` axis; XLA's
+SPMD partitioner inserts the psum over ICI where the gradients meet the
+replicated parameters.  Buffer donation makes updates in-place in HBM.
+
+This is what `bench.py` and `__graft_entry__.dryrun_multichip` run, and what
+Gluon's Trainer uses when constructed with ``kvstore='tpu'``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .mesh import make_mesh
+from .. import autograd
+from ..ndarray import NDArray
+
+__all__ = ["ParallelTrainer"]
+
+
+_OPT_OPS = {
+    # optimizer name -> (update op name, state factory)
+    "sgd": ("sgd_update", lambda w: ()),
+    "sgd_mom": ("sgd_mom_update", lambda w: (jnp.zeros_like(w),)),
+    "adam": ("adam_update", lambda w: (jnp.zeros_like(w),
+                                       jnp.zeros_like(w))),
+}
+
+
+class ParallelTrainer:
+    """Compile a Gluon HybridBlock + loss + optimizer into one sharded
+    train step.
+
+    Parameters
+    ----------
+    net : HybridBlock (will be traced symbolically, like hybridize)
+    loss : gluon loss HybridBlock
+    optimizer : 'sgd' | 'adam' (+ hyperparams via optimizer_params);
+        momentum>0 selects the momentum kernel
+    mesh : jax Mesh (default: all devices on one 'dp' axis)
+    shard_params : if True, parameters and optimizer state are sharded
+        over dp on their leading axis when divisible (ZeRO-1-style);
+        else replicated
+    """
+
+    def __init__(self, net, loss, optimizer="sgd", optimizer_params=None,
+                 mesh=None, shard_params=False, grad_clip=None):
+        self.net = net
+        self.loss = loss
+        self.mesh = mesh or make_mesh()
+        self.opt_name = optimizer
+        self.opt_params = dict(optimizer_params or {})
+        self.shard_params = shard_params
+        self.grad_clip = grad_clip
+        self._step_fn = None
+        self._params = None          # name -> jax array (device, sharded)
+        self._opt_state = None
+        self._aux = None
+        self._graph = None
+        self._num_update = 0
+
+    # -- tracing -----------------------------------------------------------
+    def _trace(self, x, y):
+        from .. import symbol as sym_mod
+        from ..executor import _build_eval
+        data = sym_mod.var("data0")
+        label = sym_mod.var("label0")
+        out = self.net(data)
+        loss_sym = self.loss(out, label)
+        self._graph = loss_sym
+        self._eval = _build_eval(loss_sym, True)
+        args = loss_sym.list_arguments()
+        self.param_names = [a for a in args if a not in ("data0", "label0")]
+        self.aux_names = loss_sym.list_auxiliary_states()
+
+    def _gather_state(self):
+        params = {p.name: p for p in self.net.collect_params().values()}
+        repl = NamedSharding(self.mesh, P())
+        self._params = {}
+        for n in self.param_names:
+            arr = params[n].data()._data
+            self._params[n] = jax.device_put(arr, self._shard_for(arr))
+        self._aux = {n: jax.device_put(params[n].data()._data, repl)
+                     for n in self.aux_names}
+        opt_key = self.opt_name
+        if opt_key == "sgd" and self.opt_params.get("momentum", 0):
+            opt_key = "sgd_mom"
+        self._opt_op, state_fn = _OPT_OPS[opt_key]
+        self._opt_state = {n: tuple(
+            jax.device_put(s, self._shard_for(s))
+            for s in state_fn(self._params[n]))
+            for n in self.param_names}
+
+    def _shard_for(self, arr):
+        ndp = self.mesh.shape.get("dp", 1)
+        if self.shard_params and arr.ndim >= 1 and \
+                arr.shape[0] % ndp == 0 and arr.shape[0] >= ndp:
+            return NamedSharding(self.mesh, P("dp"))
+        return NamedSharding(self.mesh, P())
+
+    # -- compiled step -----------------------------------------------------
+    def _build_step(self):
+        from ..ops.registry import get_op
+        eval_fn = self._eval
+        opt_op = get_op(self._opt_op)
+        opt_hp = {k: v for k, v in self.opt_params.items()
+                  if k in opt_op.param_names}
+        grad_clip = self.grad_clip
+
+        def train_step(params, opt_state, aux, x, y, key, lr):
+            def loss_of(p):
+                amap = dict(p)
+                amap["data0"] = x
+                amap["label0"] = y
+                outs, auxu = eval_fn(amap, aux, key)
+                return jnp.mean(outs[0]), auxu
+
+            (loss_val, auxu), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(params)
+            if grad_clip is not None:
+                gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g))
+                                     for g in grads.values()))
+                scale = jnp.minimum(1.0, grad_clip / (gnorm + 1e-8))
+                grads = {k: g * scale for k, g in grads.items()}
+            new_params = {}
+            new_state = {}
+            hp = dict(opt_hp)
+            hp["lr"] = lr
+            for n, w in params.items():
+                out = opt_op.fn(w, grads[n], *opt_state[n], **hp)
+                if not isinstance(out, tuple):
+                    out = (out,)
+                new_params[n] = out[0]
+                new_state[n] = tuple(out[1:])
+            new_aux = dict(aux)
+            new_aux.update(auxu)
+            return new_params, new_state, new_aux, loss_val
+
+        repl = NamedSharding(self.mesh, P())
+        batch_sh = NamedSharding(self.mesh, P("dp"))
+        self._step_fn = jax.jit(
+            train_step,
+            in_shardings=(
+                {n: self._shard_for(self._params[n])
+                 for n in self._params},
+                {n: tuple(self._shard_for(s) for s in self._opt_state[n])
+                 for n in self._opt_state},
+                {n: repl for n in self._aux},
+                batch_sh, batch_sh, repl, None),
+            donate_argnums=(0, 1, 2))
+        self._key = jax.random.PRNGKey(0)
+
+    def fit_batch(self, x, y):
+        """Run one training step; returns the (replicated) mean loss."""
+        if isinstance(x, NDArray):
+            x = x._data
+        if isinstance(y, NDArray):
+            y = y._data
+        if self._step_fn is None:
+            self.net._ensure_params(NDArray(x))
+            self._trace(x, y)
+            self._gather_state()
+            self._build_step()
+        batch_sh = NamedSharding(self.mesh, P("dp"))
+        x = jax.device_put(x, batch_sh)
+        y = jax.device_put(y, batch_sh)
+        self._key, sub = jax.random.split(self._key)
+        lr = jnp.asarray(self.opt_params.get("learning_rate", 0.01),
+                         jnp.float32)
+        self._params, self._opt_state, self._aux, loss = self._step_fn(
+            self._params, self._opt_state, self._aux, x, y, sub, lr)
+        self._num_update += 1
+        return loss
+
+    # -- sync back to gluon parameters --------------------------------------
+    def sync_params(self):
+        """Write the trained values back into the Block's Parameters
+        (gathered to a single device so eager ops can consume them)."""
+        import numpy as _np
+        params = {p.name: p for p in self.net.collect_params().values()}
+        for n, arr in self._params.items():
+            params[n].data()._data = jnp.asarray(_np.asarray(arr))
+        for n, arr in self._aux.items():
+            params[n].data()._data = jnp.asarray(_np.asarray(arr))
+
+    @property
+    def params(self):
+        return self._params

@@ -1,0 +1,274 @@
+"""KVStore semantics (reference: tests/python/unittest/test_kvstore.py +
+tests/nightly/dist_sync_kvstore.py run as localhost multi-process)."""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_local_push_pull():
+    kv = mx.kv.create("local")
+    kv.init(3, nd.ones((2, 3)))
+    out = nd.zeros((2, 3))
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.ones((2, 3)))
+    # push accumulates by default (no updater -> +=)
+    kv.push(3, nd.ones((2, 3)) * 4)
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full((2, 3), 5.0))
+
+
+def test_local_push_multiple_values():
+    kv = mx.kv.create("local")
+    kv.init("w", nd.zeros((4,)))
+    kv.push("w", [nd.ones((4,)), nd.ones((4,)) * 2, nd.ones((4,)) * 3])
+    out = nd.zeros((4,))
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full(4, 6.0))
+
+
+def test_local_updater():
+    kv = mx.kv.create("local")
+    kv.init(9, nd.ones((2,)))
+    updates = []
+
+    def updater(key, grad, weight):
+        updates.append(key)
+        weight -= 0.1 * grad
+
+    kv.set_updater(updater)
+    kv.push(9, nd.ones((2,)))
+    out = nd.zeros((2,))
+    kv.pull(9, out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full(2, 0.9), rtol=1e-6)
+    assert updates == [9]
+
+
+def test_list_key_value():
+    kv = mx.kv.create("local")
+    keys = [5, 7, 11]
+    kv.init(keys, [nd.ones((2,))] * 3)
+    outs = [nd.zeros((2,)) for _ in keys]
+    kv.pull(keys, out=outs)
+    for o in outs:
+        np.testing.assert_allclose(o.asnumpy(), np.ones(2))
+
+
+def test_row_sparse_pull():
+    from mxnet_tpu.ndarray import sparse
+    kv = mx.kv.create("local")
+    w = nd.array(np.arange(12).reshape(4, 3).astype(np.float32))
+    kv.init("emb", w)
+    out = sparse.zeros("row_sparse", (4, 3))
+    kv.row_sparse_pull("emb", out=out, row_ids=nd.array([1, 3]))
+    dense = out.asnumpy()
+    np.testing.assert_allclose(dense[1], [3, 4, 5])
+    np.testing.assert_allclose(dense[3], [9, 10, 11])
+    np.testing.assert_allclose(dense[0], 0)
+
+
+def test_tpu_kvstore_allreduce_mesh():
+    """push with one value per mesh device -> in-graph psum over the
+    8-device mesh (the kvstore='tpu' reduction path)."""
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multiple devices")
+    kv = mx.kv.create("tpu")
+    ndev = len(jax.devices())
+    kv.init("g", nd.zeros((6,)))
+    vals = [nd.ones((6,)) * (i + 1) for i in range(ndev)]
+    kv.push("g", vals)
+    out = nd.zeros((6,))
+    kv.pull("g", out=out)
+    expected = sum(range(1, ndev + 1))
+    np.testing.assert_allclose(out.asnumpy(), np.full(6, float(expected)))
+
+
+def test_gradient_compression_ops():
+    from mxnet_tpu.ops.quantization import pack_2bit, unpack_2bit
+    g = nd.array([0.6, -0.7, 0.1, 0.0, 1.2])
+    r = nd.zeros((5,))
+    codes, new_r = nd.imperative_invoke("_contrib_quantize_2bit", g, r,
+                                        threshold=0.5)
+    np.testing.assert_allclose(codes.asnumpy(), [1, -1, 0, 0, 1])
+    np.testing.assert_allclose(new_r.asnumpy(),
+                               [0.1, -0.2, 0.1, 0.0, 0.7], rtol=1e-5)
+    packed, n = pack_2bit(codes.asnumpy())
+    np.testing.assert_allclose(unpack_2bit(packed, n), codes.asnumpy())
+
+
+def test_quantize_dequantize_int8():
+    data = nd.array(np.linspace(-1, 1, 16).astype(np.float32))
+    q, mn, mx_ = nd.imperative_invoke(
+        "_contrib_quantize", data, nd.array([-1.0]), nd.array([1.0]),
+        out_type="int8")
+    back = nd.imperative_invoke("_contrib_dequantize", q, mn, mx_)
+    np.testing.assert_allclose(back.asnumpy(), data.asnumpy(), atol=0.02)
+
+
+_WORKER_SCRIPT = r"""
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+rank = int(os.environ["DMLC_WORKER_RANK"])
+kv = mx.kv.create(os.environ["KV_TYPE"])
+kv.init("w", nd.zeros((4,)))
+kv.push("w", nd.ones((4,)) * (rank + 1))
+kv.barrier()
+out = nd.zeros((4,))
+kv.pull("w", out=out)
+print("RESULT", rank, out.asnumpy().tolist(), flush=True)
+kv.barrier()
+if rank == 0:
+    kv.stop_server()
+"""
+
+
+def _run_dist(kv_type, n_workers, port):
+    """Spawn server + N workers on localhost (reference:
+    tools/launch.py --launcher local)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env_common = dict(os.environ)
+    env_common.update({
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(port),
+        "DMLC_NUM_WORKER": str(n_workers),
+        "KV_TYPE": kv_type,
+        "JAX_PLATFORMS": "cpu",
+    })
+    server_env = dict(env_common, DMLC_ROLE="server")
+    server = subprocess.Popen(
+        [sys.executable, "-c",
+         "import sys; sys.path.insert(0, %r);"
+         "from mxnet_tpu.kvstore_server import run_server;"
+         "run_server(%r)" % (repo, kv_type)],
+        env=server_env, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    workers = []
+    for rank in range(n_workers):
+        wenv = dict(env_common, DMLC_ROLE="worker",
+                    DMLC_WORKER_RANK=str(rank))
+        workers.append(subprocess.Popen(
+            [sys.executable, "-c", _WORKER_SCRIPT.format(repo=repo)],
+            env=wenv, stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+    outs = []
+    for w in workers:
+        stdout, stderr = w.communicate(timeout=120)
+        assert w.returncode == 0, stderr.decode()[-2000:]
+        outs.append(stdout.decode())
+    server.wait(timeout=30)
+    return outs
+
+
+def test_dist_sync_kvstore():
+    """Aggregated values bit-exact across workers (reference:
+    tests/nightly/dist_sync_kvstore.py)."""
+    outs = _run_dist("dist_sync", 2, 9157)
+    for out in outs:
+        line = [l for l in out.splitlines() if l.startswith("RESULT")][0]
+        vals = eval(line.split(" ", 2)[2])
+        # sync: both workers' pushes aggregated before apply: 1+2=3
+        np.testing.assert_allclose(vals, [3.0] * 4)
+
+
+def test_dist_async_kvstore():
+    outs = _run_dist("dist_async", 2, 9159)
+    total = None
+    for out in outs:
+        line = [l for l in out.splitlines() if l.startswith("RESULT")][0]
+        vals = eval(line.split(" ", 2)[2])
+        total = vals
+    # async: updates applied immediately; after barrier both saw sum=3
+    np.testing.assert_allclose(total, [3.0] * 4)
+
+
+def test_parallel_trainer_dp():
+    """The kvstore='tpu' north-star path: one pjit'd train step over the
+    mesh, batch sharded on dp."""
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multiple devices")
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import ParallelTrainer, make_mesh
+
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(32, activation="relu"), nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+
+    rng = np.random.RandomState(0)
+    centers = rng.randn(4, 16) * 3
+    labels = rng.randint(0, 4, 64)
+    data = (centers[labels] + rng.randn(64, 16)).astype(np.float32)
+
+    trainer = ParallelTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                              optimizer="sgd",
+                              optimizer_params={"learning_rate": 0.3,
+                                                "momentum": 0.9},
+                              mesh=make_mesh({"dp": -1}))
+    x = nd.array(data)
+    y = nd.array(labels.astype(np.float32))
+    losses = [float(trainer.fit_batch(x, y)) for _ in range(25)]
+    assert losses[-1] < losses[0] * 0.5, losses
+    trainer.sync_params()
+    pred = net(x).argmax(axis=1).asnumpy()
+    assert (pred == labels).mean() > 0.9
+
+
+def test_parallel_trainer_sharded_params():
+    """ZeRO-style dp-sharded parameters compile and train."""
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multiple devices")
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import ParallelTrainer, make_mesh
+
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(64, activation="relu"), nn.Dense(8))
+    net.initialize(mx.init.Xavier())
+    rng = np.random.RandomState(1)
+    x = nd.array(rng.randn(32, 16).astype(np.float32))
+    y = nd.array(rng.randint(0, 8, 32).astype(np.float32))
+    trainer = ParallelTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                              optimizer="adam",
+                              optimizer_params={"learning_rate": 1e-2},
+                              shard_params=True)
+    l0 = float(trainer.fit_batch(x, y))
+    for _ in range(10):
+        l1 = float(trainer.fit_batch(x, y))
+    assert l1 < l0
+
+
+def test_collectives_on_mesh():
+    import jax
+    import jax.numpy as jnp
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multiple devices")
+    from mxnet_tpu.parallel import make_mesh, collectives
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = make_mesh({"dp": -1})
+    n = len(jax.devices())
+    x = jnp.arange(n * 4, dtype=jnp.float32).reshape(n, 4)
+    xs = jax.device_put(x, NamedSharding(mesh, P("dp")))
+    summed = collectives.allreduce(xs, mesh, "dp")
+    np.testing.assert_allclose(np.asarray(summed),
+                               np.asarray(x).sum(axis=0))
+    # psum over dp of dp-sharded rows == full array replicated (identity
+    # on values, but now replicated); all_gather roundtrip:
+    gathered = collectives.allgather(xs, mesh, "dp")
+    np.testing.assert_allclose(np.asarray(gathered), np.asarray(x))
