@@ -1,0 +1,130 @@
+"""Round benchmark: ResNet-50 synthetic-data training throughput + MFU.
+
+Mirrors the reference harness
+(`example/image-classification/benchmark_score.py`, methodology of
+`docs/faq/perf.md:42-219`): synthetic NCHW batch, warmup, timed steps.
+Prints ONE JSON line:
+  {"metric": ..., "value": img/s, "unit": "images/sec", "vs_baseline": x}
+vs_baseline is against the reference's strongest published ResNet-50
+training number (V100 bs=128, 363.69 img/s, docs/faq/perf.md:219).
+
+Extra diagnostic fields (mfu, device, batch_size, flops_per_step) ride in
+the same JSON object.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# honor JAX_PLATFORMS before backend init — in this image the TPU plugin
+# registers regardless of the env var and a broken tunnel would hang
+# device discovery on a CPU-only run
+if os.environ.get("JAX_PLATFORMS"):
+    import jax
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+BASELINE_IMG_S = 363.69  # V100 bs=128 training, docs/faq/perf.md:219
+
+# bf16 peak FLOP/s per chip by device kind (MXU peak; fp32 runs as
+# multi-pass bf16 on TPU so bf16 peak is the honest denominator)
+_PEAK = {
+    "TPU v2": 45e12,
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def _peak_flops(dev):
+    kind = getattr(dev, "device_kind", "")
+    for k, v in _PEAK.items():
+        if kind.startswith(k):
+            return v
+    return None
+
+
+def main():
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.parallel.mesh import make_mesh
+    from mxnet_tpu.parallel.data_parallel import ParallelTrainer
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    batch = 128 if on_tpu else 16
+    image = 224 if on_tpu else 32
+    warmup, iters = 3, 10
+
+    net = vision.get_model("resnet50_v1", classes=1000)
+    net.initialize()
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    mesh = make_mesh({"dp": 1}, [dev])
+    trainer = ParallelTrainer(
+        net, loss, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+        mesh=mesh)
+
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(batch, 3, image, image).astype(np.float32))
+    y = mx.nd.array(rng.randint(0, 1000, (batch,)).astype(np.float32))
+
+    for _ in range(warmup):
+        l = trainer.fit_batch(x, y)
+    jax.block_until_ready(l)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        l = trainer.fit_batch(x, y)
+    jax.block_until_ready(l)
+    dt = time.perf_counter() - t0
+
+    img_s = batch * iters / dt
+
+    # exact per-step FLOPs from the compiled program when available
+    flops = None
+    try:
+        ca = trainer._step_fn.lower(
+            trainer._params, trainer._opt_state, trainer._aux,
+            x._data, y._data, jax.random.PRNGKey(0),
+            np.float32(0.1)).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        if ca and "flops" in ca:
+            flops = float(ca["flops"])
+    except Exception:
+        pass
+    if not flops:
+        flops = 3 * 4.089e9 * batch  # analytic fwd+bwd ResNet-50/224
+
+    peak = _peak_flops(dev)
+    mfu = (flops * iters / dt / peak) if peak else None
+
+    out = {
+        "metric": "resnet50_train_throughput",
+        "value": round(img_s, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "batch_size": batch,
+        "image_size": image,
+        "device": getattr(dev, "device_kind", str(dev)),
+        "flops_per_step": flops,
+        "final_loss": float(np.asarray(l)),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

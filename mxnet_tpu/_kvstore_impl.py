@@ -101,6 +101,18 @@ def _key_list(key, value):
     return list(key), [_as_list(v) for v in value]
 
 
+def _str_key_index(table, key):
+    """Deterministic insertion-order index for string keys (the reference
+    maps str keys to ints the same way; Python's hash() is randomized per
+    process and would break optimizer-state save/load and idx2name
+    lookups).  Int keys pass through."""
+    if isinstance(key, int):
+        return key
+    if key not in table:
+        table[key] = len(table)
+    return table[key]
+
+
 class KVStoreLocal(KVStoreBase):
     """Single-process store with device reduction
     (reference: kvstore_local.h; comm.h Reduce/Broadcast)."""
@@ -109,6 +121,10 @@ class KVStoreLocal(KVStoreBase):
         super().__init__()
         self.name = name
         self._store = {}
+        self._str_idx = {}
+
+    def _key_index(self, k):
+        return _str_key_index(self._str_idx, k)
 
     @property
     def type(self):
@@ -146,8 +162,7 @@ class KVStoreLocal(KVStoreBase):
             if isinstance(merged, _sp.BaseSparseNDArray):
                 merged = merged.todense()
             if self._updater is not None:
-                idx = k if isinstance(k, int) else abs(hash(k)) % (2 ** 31)
-                self._updater(idx, merged, self._store[k])
+                self._updater(self._key_index(k), merged, self._store[k])
             else:
                 stored = self._store[k]
                 if isinstance(stored, _sp.BaseSparseNDArray):
@@ -258,6 +273,7 @@ class KVStoreServer:
         self.num_workers = num_workers
         self.store = {}
         self.pending = {}       # key -> [accum numpy, count]
+        self._str_idx = {}      # deterministic string-key -> int index
         self.updater = None
         self.barrier_count = 0
         self.cv = threading.Condition()
@@ -295,9 +311,8 @@ class KVStoreServer:
                 self.store[key] = grad.copy()
                 return
             if self.updater is not None:
-                idx = key if isinstance(key, int) else \
-                    abs(hash(key)) % (2 ** 31)
-                self.updater(idx, grad, self.store[key])
+                self.updater(_str_key_index(self._str_idx, key), grad,
+                             self.store[key])
             else:
                 self.store[key] += grad
 
@@ -319,19 +334,27 @@ class KVStoreServer:
                         codes = unpack_2bit(val, meta["n"]).astype(
                             _np.float32) * meta["threshold"]
                         val = codes.reshape(meta["shape"])
-                    if self.sync:
-                        self._push_sync(key, val)
-                    else:
-                        self._apply(key, val)
-                    _send_msg(conn, ("ok",))
+                    try:
+                        if self.sync:
+                            self._push_sync(key, val)
+                        else:
+                            self._apply(key, val)
+                        _send_msg(conn, ("ok",))
+                    except MXNetError as e:
+                        # timeout/desync: report to the worker instead of
+                        # killing this handler thread silently
+                        _send_msg(conn, ("err", str(e)))
                 elif kind == _MSG_PULL:
                     _, key = msg
                     with self.lock:
                         arr = self.store[key].asnumpy()
                     _send_msg(conn, ("ok", arr))
                 elif kind == _MSG_BARRIER:
-                    self._barrier()
-                    _send_msg(conn, ("ok",))
+                    try:
+                        self._barrier()
+                        _send_msg(conn, ("ok",))
+                    except MXNetError as e:
+                        _send_msg(conn, ("err", str(e)))
                 elif kind == _MSG_SET_OPT:
                     _, blob = msg
                     from . import optimizer as opt
@@ -364,6 +387,15 @@ class KVStoreServer:
             deadline = time.time() + 120
             while key in self.pending and time.time() < deadline:
                 self.cv.wait(timeout=0.1)
+            if key in self.pending:
+                # drop the stale accumulator so a late worker cannot mix
+                # gradients across rounds after the failure
+                got = self.pending.pop(key)[1]
+                self.cv.notify_all()
+                raise MXNetError(
+                    "dist_sync push for key %r timed out waiting for "
+                    "%d workers (got %d) — worker desync or crash"
+                    % (key, self.num_workers, got))
 
     def _barrier(self):
         with self.cv:
@@ -378,6 +410,13 @@ class KVStoreServer:
                     self.barrier_count % self.num_workers != 0 and \
                     time.time() < deadline:
                 self.cv.wait(timeout=0.1)
+            if (self.barrier_count - 1) // self.num_workers == \
+                    current_round and \
+                    self.barrier_count % self.num_workers != 0:
+                raise MXNetError(
+                    "kvstore barrier timed out: %d/%d workers arrived"
+                    % (self.barrier_count % self.num_workers,
+                       self.num_workers))
 
 
 class KVStoreDist(KVStoreBase):
@@ -420,7 +459,10 @@ class KVStoreDist(KVStoreBase):
     def _rpc(self, msg):
         with self._lock:
             _send_msg(self.sock, msg)
-            return _recv_msg(self.sock)
+            reply = _recv_msg(self.sock)
+        if reply and reply[0] == "err":
+            raise MXNetError("kvstore server error: %s" % reply[1])
+        return reply
 
     def init(self, key, value):
         keys, values = _key_list(key, value)

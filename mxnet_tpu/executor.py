@@ -241,9 +241,12 @@ class Executor:
             else:
                 raise MXNetError("unknown forward argument %r" % k)
         fn = self._jit_train if is_train else self._jit_infer
-        outs, auxu = fn(self._arg_map(), self._aux_map(), self._next_key())
+        key = self._next_key()
+        outs, auxu = fn(self._arg_map(), self._aux_map(), key)
         if is_train:
-            self._pending = (self._arg_map(), self._aux_map())
+            # keep the key: backward() must replay the same stochastic
+            # masks (Dropout etc.) that produced these outputs
+            self._pending = (self._arg_map(), self._aux_map(), key)
         for n, v in auxu.items():
             self.aux_dict[n]._data = v
         self.outputs = [NDArray(o) for o in outs]
@@ -275,13 +278,14 @@ class Executor:
         else:
             cots = [g._data if g is not None else None for g in out_grads]
         if use_pending and getattr(self, "_pending", None) is not None:
-            arg_map, aux_map = self._pending
+            arg_map, aux_map, key = self._pending
             self._pending = None
         else:
             arg_map, aux_map = self._arg_map(), self._aux_map()
+            key = self._next_key()
         # None cotangents must be materialized as ones for jit
         outs, auxu, grads = self._jit_train_step(
-            arg_map, aux_map, self._next_key(),
+            arg_map, aux_map, key,
             _materialize(cots, self, arg_map, aux_map))
         for n, v in auxu.items():
             self.aux_dict[n]._data = v

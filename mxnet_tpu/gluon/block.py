@@ -462,7 +462,8 @@ class HybridBlock(Block):
             if name in params and shape is not None:
                 params[name].shape = tuple(shape)
         for p in params.values():
-            if p._deferred_init is not None:
+            if p._deferred_init is not None and p.shape is not None and \
+                    all(s > 0 for s in p.shape):
                 p._finish_deferred_init()
 
     def forward(self, x, *args):
@@ -492,6 +493,19 @@ class HybridBlock(Block):
                     if p._deferred_init is not None]
         if deferred:
             self._infer_attrs(*args)
+            still = [p for p in self.collect_params().values()
+                     if p._deferred_init is not None]
+            if still and args and all(isinstance(a, NDArray)
+                                      for a in args):
+                # graph shape inference couldn't resolve everything
+                # (e.g. an RNN layer's packed weights); one imperative
+                # pass lets each child resolve its own shapes eagerly
+                try:
+                    params = {n: p.data()
+                              for n, p in self._reg_params.items()}
+                    self.hybrid_forward(nd, *args, **params)
+                except DeferredInitializationError:
+                    pass
         # trigger friendly error if not initialized at all
         for p in self.collect_params().values():
             p._check_initialized()

@@ -1,0 +1,5 @@
+"""Model zoo (reference capability: python/mxnet/gluon/model_zoo/)."""
+
+from . import vision
+
+__all__ = ["vision"]

@@ -1,0 +1,42 @@
+"""Vision model zoo with a name registry.
+
+Reference capability: python/mxnet/gluon/model_zoo/vision/__init__.py:91
+(`get_model`) plus model_store.py pretrained downloads.  This build has no
+hosted weight store (zero egress); ``pretrained=True`` therefore raises
+with a pointer to ``load_parameters`` for locally saved weights.
+"""
+
+from . import alexnet as _m_alexnet
+from . import densenet as _m_densenet
+from . import inception as _m_inception
+from . import mobilenet as _m_mobilenet
+from . import resnet as _m_resnet
+from . import squeezenet as _m_squeezenet
+from . import vgg as _m_vgg
+
+_MODULES = (_m_alexnet, _m_densenet, _m_inception, _m_mobilenet, _m_resnet,
+            _m_squeezenet, _m_vgg)
+
+_factories = {}
+for _mod in _MODULES:
+    for _name in _mod.__all__:
+        _obj = getattr(_mod, _name)
+        globals()[_name] = _obj
+        if callable(_obj) and _name[0].islower():
+            _factories[_name] = _obj
+
+# reference naming aliases (resnet18_v1 <-> resnet18-ish lookups keep the
+# canonical underscore form; get_model lowercases and strips dashes)
+
+
+def get_model(name, **kwargs):
+    """Return a model by name (reference: vision/__init__.py:91)."""
+    name = name.lower().replace("-", "_")
+    if name not in _factories:
+        raise ValueError(
+            "Model %r not found. Available: %s"
+            % (name, ", ".join(sorted(_factories))))
+    return _factories[name](**kwargs)
+
+
+__all__ = [n for m in _MODULES for n in m.__all__] + ["get_model"]

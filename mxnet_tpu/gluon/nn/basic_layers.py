@@ -104,9 +104,14 @@ class Dense(HybridBlock):
                 self.act = None
 
     def hybrid_forward(self, F, x, weight, bias=None):
-        out = F.FullyConnected(x, weight, bias, no_bias=bias is None,
-                               num_hidden=self._units,
-                               flatten=self._flatten)
+        if bias is None:
+            out = F.FullyConnected(x, weight, no_bias=True,
+                                   num_hidden=self._units,
+                                   flatten=self._flatten)
+        else:
+            out = F.FullyConnected(x, weight, bias, no_bias=False,
+                                   num_hidden=self._units,
+                                   flatten=self._flatten)
         if self.act is not None:
             out = self.act(out)
         return out

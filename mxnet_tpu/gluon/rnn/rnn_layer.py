@@ -1,0 +1,164 @@
+"""Fused recurrent layers over the RNN op.
+
+Reference capability: python/mxnet/gluon/rnn/rnn_layer.py (RNN/LSTM/GRU
+wrapping the fused cuDNN RNN op).  Here the fused op is a `lax.scan`
+program (ops/rnn.py); each layer owns per-(layer, direction) parameters
+named like the reference ({l|r}{i}_{i2h|h2h}_{weight|bias}) and packs
+them into the op's flat vector at forward time — the pack is pure
+reshapes/concat, which XLA folds away.
+"""
+
+from __future__ import annotations
+
+from ..block import HybridBlock
+from ... import ndarray as nd
+from ...base import MXNetError
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, mode, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 dtype="float32", **kwargs):
+        super().__init__(**kwargs)
+        if layout not in ("TNC", "NTC"):
+            raise ValueError("layout must be TNC or NTC, got %r" % layout)
+        self._mode = mode
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._dtype = dtype
+        self._gates = _GATES[mode]
+        ng, nh = self._gates, hidden_size
+        with self.name_scope():
+            for i in range(num_layers):
+                for d in ("l", "r")[:self._dir]:
+                    in_sz = input_size if i == 0 else hidden_size * self._dir
+                    for conn, wshape, bshape in (
+                            ("i2h", (ng * nh, in_sz), (ng * nh,)),
+                            ("h2h", (ng * nh, nh), (ng * nh,))):
+                        wname = "%s%d_%s_weight" % (d, i, conn)
+                        bname = "%s%d_%s_bias" % (d, i, conn)
+                        winit = i2h_weight_initializer if conn == "i2h" \
+                            else h2h_weight_initializer
+                        binit = i2h_bias_initializer if conn == "i2h" \
+                            else h2h_bias_initializer
+                        setattr(self, wname, self.params.get(
+                            wname, shape=wshape, init=winit, dtype=dtype,
+                            allow_deferred_init=True))
+                        setattr(self, bname, self.params.get(
+                            bname, shape=bshape, init=binit, dtype=dtype))
+
+    def state_info(self, batch_size=0):
+        shape = (self._num_layers * self._dir, batch_size,
+                 self._hidden_size)
+        if self._mode == "lstm":
+            return [{"shape": shape, "__layout__": "LNC"},
+                    {"shape": shape, "__layout__": "LNC"}]
+        return [{"shape": shape, "__layout__": "LNC"}]
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        func = func or nd.zeros
+        if kwargs.get("ctx") is None:
+            kwargs.pop("ctx", None)
+        return [func(shape=info["shape"], **kwargs)
+                for info in self.state_info(batch_size)]
+
+    def _finish_deferred(self, x):
+        """Resolve layer-0 input size from the first real input (the
+        reference's infer-shape does this inside the C++ op)."""
+        if self._input_size:
+            return
+        axis = 2 if self._layout == "TNC" else 2  # feature dim is last
+        in_sz = x.shape[axis]
+        self._input_size = in_sz
+        ng, nh = self._gates, self._hidden_size
+        for d in ("l", "r")[:self._dir]:
+            p = getattr(self, "%s0_i2h_weight" % d)
+            p.shape = (ng * nh, in_sz)
+        for p in self.collect_params().values():
+            if p._deferred_init is not None:
+                p._finish_deferred_init()
+
+    def __call__(self, inputs, states=None, **kwargs):
+        if isinstance(inputs, nd.NDArray):
+            self._finish_deferred(inputs)
+        if states is None:
+            # stateless call: the fused op starts from zeros in-graph, so
+            # this path works both eagerly and under symbolic tracing
+            return super().__call__(inputs)
+        if isinstance(states, nd.NDArray) or not isinstance(
+                states, (list, tuple)):
+            states = [states]
+        out = super().__call__(inputs, *states)
+        sep = out if isinstance(out, (list, tuple)) else [out]
+        return sep[0], list(sep[1:])
+
+    def hybrid_forward(self, F, inputs, *states, **params):
+        if self._layout == "NTC":
+            inputs = F.swapaxes(inputs, dim1=0, dim2=1)
+        parts = []
+        for conn in ("weight", "bias"):
+            for i in range(self._num_layers):
+                for d in ("l", "r")[:self._dir]:
+                    for loc in ("i2h", "h2h"):
+                        p = params["%s%d_%s_%s" % (d, i, loc, conn)]
+                        parts.append(F.reshape(p, shape=(-1,)))
+        flat = F.concat(*parts, dim=0) if len(parts) > 1 else parts[0]
+        rnn_out = F.RNN(inputs, flat, *states,
+                        state_size=self._hidden_size,
+                        num_layers=self._num_layers,
+                        bidirectional=self._dir == 2,
+                        p=self._dropout, state_outputs=bool(states),
+                        mode=self._mode)
+        if not states:
+            outputs = rnn_out
+            states_out = []
+        else:
+            outputs = rnn_out[0]
+            states_out = list(rnn_out[1:])
+        if self._layout == "NTC":
+            outputs = F.swapaxes(outputs, dim1=0, dim2=1)
+        if not states_out:
+            return outputs
+        return [outputs] + states_out
+
+    def __repr__(self):
+        return "%s(%s, %d, layers=%d%s)" % (
+            type(self).__name__, self._mode, self._hidden_size,
+            self._num_layers, ", bidirectional" if self._dir == 2 else "")
+
+
+class RNN(_RNNLayer):
+    """Multi-layer Elman RNN (relu or tanh) layer."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False,
+                 input_size=0, **kwargs):
+        super().__init__("rnn_" + activation, hidden_size, num_layers,
+                         layout, dropout, bidirectional,
+                         input_size=input_size, **kwargs)
+
+
+class LSTM(_RNNLayer):
+    """Multi-layer LSTM layer (gate order i,f,g,o)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__("lstm", hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size=input_size, **kwargs)
+
+
+class GRU(_RNNLayer):
+    """Multi-layer GRU layer (gate order r,z,n; linear-before-reset)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__("gru", hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size=input_size, **kwargs)

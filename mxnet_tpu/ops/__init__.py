@@ -14,3 +14,4 @@ from . import reduce        # noqa: F401
 from . import nn            # noqa: F401
 from . import random_ops    # noqa: F401
 from . import optimizer_ops  # noqa: F401
+from . import rnn           # noqa: F401

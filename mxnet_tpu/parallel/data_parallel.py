@@ -147,15 +147,19 @@ class ParallelTrainer:
 
         repl = NamedSharding(self.mesh, P())
         batch_sh = NamedSharding(self.mesh, P("dp"))
+        param_sh = {n: self._shard_for(self._params[n])
+                    for n in self._params}
+        state_sh = {n: tuple(self._shard_for(s) for s in self._opt_state[n])
+                    for n in self._opt_state}
+        aux_sh = {n: repl for n in self._aux}
         self._step_fn = jax.jit(
             train_step,
-            in_shardings=(
-                {n: self._shard_for(self._params[n])
-                 for n in self._params},
-                {n: tuple(self._shard_for(s) for s in self._opt_state[n])
-                 for n in self._opt_state},
-                {n: repl for n in self._aux},
-                batch_sh, batch_sh, repl, None),
+            in_shardings=(param_sh, state_sh, aux_sh,
+                          batch_sh, batch_sh, repl, None),
+            # pin outputs to the input layout so the params/state returned
+            # by step N are valid inputs for step N+1 (otherwise XLA's
+            # sharding propagation may choose a different layout)
+            out_shardings=(param_sh, state_sh, aux_sh, repl),
             donate_argnums=(0, 1, 2))
         self._key = jax.random.PRNGKey(0)
 

@@ -1,0 +1,172 @@
+"""Per-op numeric + gradient sweep via the test_utils oracle.
+
+Reference strategy: tests/python/unittest/test_operator.py (7,213 LoC)
+with check_numeric_gradient / check_symbolic_forward / check_consistency
+from python/mxnet/test_utils.py.
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import test_utils as tu
+
+sym = mx.sym
+
+
+def _v(name="x"):
+    return sym.var(name)
+
+
+# --- gradient checks over the core op families ---------------------------
+
+UNARY_GRAD_OPS = [
+    ("relu", lambda x: sym.relu(x)),
+    ("sigmoid", lambda x: sym.sigmoid(x)),
+    ("tanh", lambda x: sym.tanh(x)),
+    ("exp", lambda x: sym.exp(x)),
+    ("log", lambda x: sym.log(sym.abs(x) + 1.2)),
+    ("sqrt", lambda x: sym.sqrt(sym.abs(x) + 1.0)),
+    ("square", lambda x: sym.square(x)),
+    ("softmax", lambda x: sym.softmax(x)),
+    ("log_softmax", lambda x: sym.log_softmax(x)),
+]
+
+
+@pytest.mark.parametrize("name,f", UNARY_GRAD_OPS,
+                         ids=[n for n, _ in UNARY_GRAD_OPS])
+def test_unary_gradients(name, f):
+    x = np.random.randn(3, 4).astype(np.float64)
+    tu.check_numeric_gradient(f(_v()), {"x": x})
+
+
+BINARY_GRAD_OPS = [
+    ("add", lambda a, b: a + b),
+    ("sub", lambda a, b: a - b),
+    ("mul", lambda a, b: a * b),
+    ("div", lambda a, b: a / (b + 2.5)),
+    ("dot", lambda a, b: sym.dot(a, b)),
+    ("broadcast_add", lambda a, b: sym.broadcast_add(a, b)),
+]
+
+
+@pytest.mark.parametrize("name,f", BINARY_GRAD_OPS,
+                         ids=[n for n, _ in BINARY_GRAD_OPS])
+def test_binary_gradients(name, f):
+    a = np.random.randn(3, 3).astype(np.float64)
+    b = np.random.randn(3, 3).astype(np.float64)
+    tu.check_numeric_gradient(f(sym.var("a"), sym.var("b")),
+                              {"a": a, "b": b})
+
+
+def test_fully_connected_gradient():
+    out = sym.FullyConnected(_v(), sym.var("w"), sym.var("b"),
+                             num_hidden=4)
+    tu.check_numeric_gradient(out, {
+        "x": np.random.randn(2, 3),
+        "w": np.random.randn(4, 3),
+        "b": np.random.randn(4)})
+
+
+def test_convolution_gradient():
+    out = sym.Convolution(_v(), sym.var("w"), sym.var("b"),
+                          kernel=(3, 3), num_filter=2, pad=(1, 1))
+    tu.check_numeric_gradient(out, {
+        "x": np.random.randn(1, 2, 5, 5),
+        "w": np.random.randn(2, 2, 3, 3),
+        "b": np.random.randn(2)}, rtol=2e-2, atol=1e-3)
+
+
+def test_pooling_gradient():
+    out = sym.Pooling(_v(), kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    tu.check_numeric_gradient(out, {"x": np.random.randn(1, 2, 4, 4)})
+
+
+def test_layernorm_gradient():
+    out = sym.LayerNorm(_v(), sym.var("g"), sym.var("b"))
+    tu.check_numeric_gradient(out, {
+        "x": np.random.randn(3, 5),
+        "g": np.random.randn(5),
+        "b": np.random.randn(5)}, rtol=2e-2, atol=1e-3)
+
+
+def test_batchnorm_inference_forward():
+    x = np.random.randn(2, 3, 4, 4).astype(np.float32)
+    gamma = np.random.rand(3).astype(np.float32) + 0.5
+    beta = np.random.randn(3).astype(np.float32)
+    mean = np.random.randn(3).astype(np.float32)
+    var = np.random.rand(3).astype(np.float32) + 0.5
+    out = sym.BatchNorm(_v(), sym.var("gamma"), sym.var("beta"),
+                        sym.var("mm"), sym.var("mv"), fix_gamma=False,
+                        use_global_stats=True)
+    expected = (x - mean[None, :, None, None]) / np.sqrt(
+        var[None, :, None, None] + 1e-3) * gamma[None, :, None, None] + \
+        beta[None, :, None, None]
+    tu.check_symbolic_forward(
+        out, {"x": x, "gamma": gamma, "beta": beta},
+        [expected], aux_states={"mm": mean, "mv": var},
+        rtol=1e-3, atol=1e-4)
+
+
+def test_reduce_gradients():
+    for f in (lambda x: sym.sum(x, axis=1),
+              lambda x: sym.mean(x, axis=0),
+              lambda x: sym.max(x, axis=1),
+              lambda x: sym.prod(x, axis=1)):
+        x = np.random.rand(3, 4) + 0.5
+        tu.check_numeric_gradient(f(_v()), {"x": x})
+
+
+def test_transform_gradients():
+    x = np.random.randn(2, 3, 4)
+    for f in (lambda s: sym.transpose(s, axes=(2, 0, 1)),
+              lambda s: sym.reshape(s, shape=(6, 4)),
+              lambda s: sym.flip(s, axis=1),
+              lambda s: sym.slice(s, begin=(0, 1, 0), end=(2, 3, 3))):
+        tu.check_numeric_gradient(f(_v()), {"x": x})
+
+
+def test_check_symbolic_backward():
+    x = np.array([[1.0, 2.0], [3.0, 4.0]])
+    out_grad = np.ones((2, 2))
+    tu.check_symbolic_backward(sym.square(_v()), {"x": x}, [out_grad],
+                               {"x": 2 * x})
+
+
+def test_consistency_mlp():
+    """Cross-backend (or determinism) oracle on a small MLP."""
+    net = sym.FullyConnected(
+        sym.Activation(
+            sym.FullyConnected(_v(), sym.var("w0"), sym.var("b0"),
+                               num_hidden=8),
+            act_type="relu"),
+        sym.var("w1"), sym.var("b1"), num_hidden=3)
+    tu.check_consistency(net, shapes={
+        "x": (4, 6), "w0": (8, 6), "b0": (8,),
+        "w1": (3, 8), "b1": (3,)})
+
+
+def test_consistency_conv():
+    net = sym.Pooling(
+        sym.Convolution(_v(), sym.var("w"), sym.var("b"), kernel=(3, 3),
+                        num_filter=4, pad=(1, 1)),
+        kernel=(2, 2), stride=(2, 2), pool_type="max")
+    tu.check_consistency(net, shapes={
+        "x": (2, 3, 8, 8), "w": (4, 3, 3, 3), "b": (4,)})
+
+
+def test_rand_ndarray_and_assert():
+    a = tu.rand_ndarray((4, 5))
+    assert a.shape == (4, 5)
+    tu.assert_almost_equal(a, a.asnumpy())
+    r = tu.rand_ndarray((6, 4), stype="row_sparse", density=0.5)
+    assert r.stype == "row_sparse"
+
+
+def test_embedding_take_gradients():
+    w = np.random.randn(7, 4)
+    idx = np.array([0.0, 2.0, 5.0])
+    out = sym.Embedding(sym.var("idx"), sym.var("w"), input_dim=7,
+                        output_dim=4)
+    tu.check_numeric_gradient(out, {"idx": idx, "w": w},
+                              grad_nodes=["w"])
