@@ -25,6 +25,7 @@ from . import lr_scheduler  # noqa: F401
 from . import metric  # noqa: F401
 from . import gluon  # noqa: F401
 from . import io  # noqa: F401
+from . import image  # noqa: F401
 from . import module  # noqa: F401
 from . import module as mod  # noqa: F401  (reference alias: mx.mod)
 from . import model  # noqa: F401

@@ -1,0 +1,13 @@
+"""mx.image — host-side image decode + augmentation
+(reference capability: python/mxnet/image/, 2,321 LoC)."""
+
+from .image import (imdecode, imread, imresize, resize_short,  # noqa
+                    fixed_crop, center_crop, random_crop,
+                    random_size_crop, color_normalize, scale_down,
+                    Augmenter, SequentialAug, ResizeAug, ForceResizeAug,
+                    RandomCropAug, RandomSizedCropAug, CenterCropAug,
+                    BrightnessJitterAug, ContrastJitterAug,
+                    SaturationJitterAug, HueJitterAug, ColorJitterAug,
+                    LightingAug, ColorNormalizeAug, RandomGrayAug,
+                    HorizontalFlipAug, CastAug, CreateAugmenter,
+                    ImageIter)

@@ -2,6 +2,4 @@
 
 from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
                  PrefetchingIter, MNISTIter, CSVIter, LibSVMIter)  # noqa
-
-class ImageRecordIter(DataIter):  # placeholder replaced in image.py wiring
-    pass
+from .image_record import ImageRecordIter  # noqa: F401

@@ -11,3 +11,6 @@ from . import register as _register
 _register.populate(globals())
 
 from . import sparse  # noqa: F401  (after op functions exist)
+
+from . import contrib  # noqa: F401,E402  (control flow: foreach/while/cond)
+from . import image  # noqa: F401,E402

@@ -1,0 +1,77 @@
+"""Imperative control flow (reference: python/mxnet/ndarray/contrib.py
+foreach:134, while_loop:230, cond:398).
+
+Like the reference, the imperative versions are plain Python loops —
+every op inside is taped, so autograd works; data-dependent trip counts
+are allowed because nothing is being compiled.  For the compiled
+(`lax.scan`) path use the symbolic API or hybridize.
+"""
+
+from __future__ import annotations
+
+from .ndarray import NDArray
+
+
+def _stack(*arrs, axis=0):
+    import mxnet_tpu.ndarray as nd_pkg
+    return nd_pkg.stack(*arrs, axis=axis)
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def foreach(body, data, init_states):
+    """Loop body(data_t, states) -> (outputs, new_states) over axis 0."""
+    data_l = _as_list(data)
+    states = init_states
+    T = data_l[0].shape[0]
+    data_scalar = not isinstance(data, (list, tuple))
+    outputs = None
+    outs_scalar = True
+    for t in range(T):
+        slices = [d[t] for d in data_l]
+        outs, states = body(slices[0] if data_scalar else slices, states)
+        outs_scalar = not isinstance(outs, (list, tuple))
+        outs_l = _as_list(outs)
+        if outputs is None:
+            outputs = [[] for _ in outs_l]
+        for acc, o in zip(outputs, outs_l):
+            acc.append(o)
+    stacked = [_stack(*acc, axis=0) for acc in (outputs or [])]
+    result = stacked[0] if outs_scalar and len(stacked) == 1 else stacked
+    return result, states
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """Run func while cond holds (dynamic trip count, eager only).
+    Returns (stacked outputs of executed steps, final loop_vars)."""
+    lvars = _as_list(loop_vars)
+    lscalar = not isinstance(loop_vars, (list, tuple))
+    outputs = None
+    steps = 0
+    while bool(cond(*lvars).asnumpy().reshape(())):
+        if max_iterations is not None and steps >= max_iterations:
+            break
+        outs, new_vars = func(*lvars)
+        lvars = _as_list(new_vars)
+        outs_l = _as_list(outs)
+        if outputs is None:
+            outputs = [[] for _ in outs_l]
+        for acc, o in zip(outputs, outs_l):
+            acc.append(o)
+        steps += 1
+    stacked = [_stack(*acc, axis=0) for acc in (outputs or [])]
+    result = stacked[0] if len(stacked) == 1 else stacked
+    return result, (lvars[0] if lscalar and len(lvars) == 1 else lvars)
+
+
+def cond(pred, then_func, else_func):
+    """Eager branch on a scalar NDArray predicate."""
+    if bool(pred.asnumpy().reshape(())):
+        return then_func()
+    return else_func()

@@ -15,3 +15,7 @@ from . import nn            # noqa: F401
 from . import random_ops    # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import rnn           # noqa: F401
+from . import control_flow  # noqa: F401
+from . import quantization  # noqa: F401
+from . import image         # noqa: F401
+from . import detection     # noqa: F401

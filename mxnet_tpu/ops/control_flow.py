@@ -1,0 +1,140 @@
+"""Control-flow operators — subgraphs compiled to XLA structured control
+flow.
+
+Reference capability: `src/operator/control_flow.cc` `_foreach` (:1255),
+`_while_loop` (:1316), `_cond` (:1378) — subgraph-as-attribute ops run by
+nested CachedOp loops on the engine.  The TPU-native design maps them
+directly onto `lax.scan` / masked scan / `lax.cond`: the subgraph (a
+Symbol) is a static op parameter, its evaluation function is built once
+at trace time, and XLA compiles the whole loop into the surrounding
+program — no per-iteration dispatch, differentiable by construction.
+
+`_while_loop` uses a masked `lax.scan` over ``max_iterations`` rather than
+`lax.while_loop`: reverse-mode autodiff through a dynamic while is not
+defined, and the reference's symbolic while_loop is bounded by
+``max_iterations`` anyway (outputs are padded; unexecuted steps are
+zeros here).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _subgraph_eval(subgraph, training):
+    from ..executor import _build_eval
+    return _build_eval(subgraph, training)
+
+
+@register_op("_foreach", needs_rng=True, input_names=(),
+             num_outputs=lambda p: int(p["n_outputs"]) + int(p["n_states"]))
+def _foreach_op(rng, *arrays, subgraph=None, n_data=1, n_states=0,
+                n_outputs=1, data_names=(), state_names=(),
+                closure_names=(), training=True):
+    """arrays = data (scanned on axis 0) + init states + closure values.
+
+    subgraph outputs: [outputs..., new_states...] with names bound via
+    data_names (per-step slices), state_names, closure_names.
+    Returns (*stacked_outputs, *final_states).
+    """
+    n_data, n_states, n_outputs = int(n_data), int(n_states), int(n_outputs)
+    data = arrays[:n_data]
+    states = tuple(arrays[n_data:n_data + n_states])
+    closure = arrays[n_data + n_states:]
+    closure_map = dict(zip(closure_names, closure))
+    eval_fn = _subgraph_eval(subgraph, training)
+
+    def step(carry, xs):
+        states, key = carry
+        key, sub = jax.random.split(key)
+        amap = dict(zip(data_names, xs))
+        amap.update(zip(state_names, states))
+        amap.update(closure_map)
+        outs, _ = eval_fn(amap, {}, sub)
+        return (tuple(outs[n_outputs:]), key), tuple(outs[:n_outputs])
+
+    (final_states, _), ys = jax.lax.scan(step, (states, rng), tuple(data))
+    return tuple(ys) + tuple(final_states)
+
+
+@register_op("_while_loop", needs_rng=True, input_names=(),
+             num_outputs=lambda p: int(p["n_outputs"]) +
+                 int(p["n_loop_vars"]))
+def _while_loop_op(rng, *arrays, cond_graph=None, func_graph=None,
+                   max_iterations=0, n_loop_vars=1, n_outputs=1,
+                   loop_var_names=(), cond_closure_names=(),
+                   func_closure_names=(), training=True):
+    """arrays = loop vars + cond closure + func closure.
+
+    Runs ``func`` while ``cond`` is true, bounded by max_iterations
+    (masked scan).  Returns (*stacked_outputs, *final_loop_vars);
+    output rows beyond the executed step count are zeros.
+    """
+    n_loop_vars, n_outputs = int(n_loop_vars), int(n_outputs)
+    max_iterations = int(max_iterations)
+    lvars = tuple(arrays[:n_loop_vars])
+    ncc = len(cond_closure_names)
+    cond_clo = dict(zip(cond_closure_names,
+                        arrays[n_loop_vars:n_loop_vars + ncc]))
+    func_clo = dict(zip(func_closure_names, arrays[n_loop_vars + ncc:]))
+    cond_fn = _subgraph_eval(cond_graph, training)
+    func_fn = _subgraph_eval(func_graph, training)
+
+    def pred(states, key):
+        amap = dict(zip(loop_var_names, states))
+        amap.update(cond_clo)
+        outs, _ = cond_fn(amap, {}, key)
+        return jnp.reshape(outs[0] != 0, ())
+
+    def step(carry, _):
+        states, done, key = carry
+        key, k1, k2 = jax.random.split(key, 3)
+        active = jnp.logical_and(jnp.logical_not(done), pred(states, k1))
+        amap = dict(zip(loop_var_names, states))
+        amap.update(func_clo)
+        outs, _ = func_fn(amap, {}, k2)
+        new_states = tuple(
+            jnp.where(active, n, s)
+            for n, s in zip(outs[n_outputs:], states))
+        ys = tuple(jnp.where(active, o, jnp.zeros_like(o))
+                   for o in outs[:n_outputs])
+        return (new_states, jnp.logical_not(active), key), ys
+
+    (final, _, _), ys = jax.lax.scan(
+        step, (lvars, jnp.asarray(False), rng), None,
+        length=max_iterations)
+    return tuple(ys) + tuple(final)
+
+
+@register_op("_cond", needs_rng=True, input_names=(),
+             num_outputs=lambda p: int(p["n_outputs"]))
+def _cond_op(rng, *arrays, pred_graph=None, then_graph=None,
+             else_graph=None, n_outputs=1, pred_names=(), then_names=(),
+             else_names=(), training=True):
+    """arrays = pred inputs + then inputs + else inputs (by name lists).
+
+    Evaluates pred_graph; selects then/else branch via lax.cond (only the
+    taken branch executes at runtime).  Branches must produce the same
+    output spec (reference requirement as well).
+    """
+    n_outputs = int(n_outputs)
+    np_, nt = len(pred_names), len(then_names)
+    pred_in = dict(zip(pred_names, arrays[:np_]))
+    then_in = dict(zip(then_names, arrays[np_:np_ + nt]))
+    else_in = dict(zip(else_names, arrays[np_ + nt:]))
+    pred_fn = _subgraph_eval(pred_graph, training)
+    then_fn = _subgraph_eval(then_graph, training)
+    else_fn = _subgraph_eval(else_graph, training)
+    k0, k1, k2 = jax.random.split(rng, 3)
+    pred = jnp.reshape(pred_fn(pred_in, {}, k0)[0][0] != 0, ())
+
+    def run_then(_):
+        return tuple(then_fn(then_in, {}, k1)[0][:n_outputs])
+
+    def run_else(_):
+        return tuple(else_fn(else_in, {}, k2)[0][:n_outputs])
+
+    return jax.lax.cond(pred, run_then, run_else, None)

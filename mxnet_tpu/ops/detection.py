@@ -1,0 +1,459 @@
+"""Detection ops — SSD / RCNN family.
+
+Reference capability: `src/operator/contrib/multibox_prior.cc`,
+`multibox_target.cc`, `multibox_detection.cc`, `bounding_box.cc`
+(box_nms/box_iou), `roi_align.cc`, `proposal.cc`.
+
+TPU-first design: everything is fixed-shape, mask-based jnp.  The
+reference's sequential kernels (bipartite matching, NMS suppression
+loops) become `lax.fori_loop`s over static trip counts with boolean
+masks — no dynamic shapes, so XLA compiles them into the surrounding
+program; "removed" boxes are masked, not filtered.  Exact reference
+tie-break semantics are kept where they are observable (stable score
+ordering in NMS, first-match-wins bipartite matching).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _box_iou_corner(a, b):
+    """IoU of (..., 4) corner boxes a[N,4] vs b[M,4] -> [N,M]."""
+    al, at, ar, ab = a[:, 0], a[:, 1], a[:, 2], a[:, 3]
+    bl, bt, br, bb = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+    iw = jnp.maximum(
+        0.0, jnp.minimum(ar[:, None], br[None, :]) -
+        jnp.maximum(al[:, None], bl[None, :]))
+    ih = jnp.maximum(
+        0.0, jnp.minimum(ab[:, None], bb[None, :]) -
+        jnp.maximum(at[:, None], bt[None, :]))
+    inter = iw * ih
+    area_a = jnp.maximum(0.0, ar - al) * jnp.maximum(0.0, ab - at)
+    area_b = jnp.maximum(0.0, br - bl) * jnp.maximum(0.0, bb - bt)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _to_corner(boxes):
+    x, y, w, h = (boxes[..., 0], boxes[..., 1], boxes[..., 2],
+                  boxes[..., 3])
+    return jnp.stack([x - w / 2, y - h / 2, x + w / 2, y + h / 2],
+                     axis=-1)
+
+
+def _to_center(boxes):
+    l, t, r, b = (boxes[..., 0], boxes[..., 1], boxes[..., 2],
+                  boxes[..., 3])
+    return jnp.stack([(l + r) / 2, (t + b) / 2, r - l, b - t], axis=-1)
+
+
+# --------------------------------------------------------------------------
+# MultiBoxPrior
+# --------------------------------------------------------------------------
+
+@register_op("_contrib_MultiBoxPrior", aliases=("MultiBoxPrior",))
+def _multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                    steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Anchor boxes from a feature map (reference:
+    multibox_prior.cc MultiBoxPriorForward — first size with all ratios
+    collapsed to [sizes... with ratio 1] + [ratios[1:] with sizes[0]]).
+    data: (N, C, H, W); returns (1, H*W*A, 4) corner boxes."""
+    in_h, in_w = data.shape[2], data.shape[3]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / in_h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / in_w
+    cy = (jnp.arange(in_h) + offsets[0]) * step_y
+    cx = (jnp.arange(in_w) + offsets[1]) * step_x
+    ws, hs = [], []
+    for s in sizes:
+        ws.append(s * in_h / in_w / 2)
+        hs.append(s / 2)
+    for r in ratios[1:]:
+        sr = r ** 0.5
+        ws.append(sizes[0] * in_h / in_w * sr / 2)
+        hs.append(sizes[0] / sr / 2)
+    ws = jnp.asarray(ws, data.dtype)
+    hs = jnp.asarray(hs, data.dtype)
+    cxg = jnp.broadcast_to(cx[None, :, None], (in_h, in_w, ws.size))
+    cyg = jnp.broadcast_to(cy[:, None, None], (in_h, in_w, ws.size))
+    out = jnp.stack([cxg - ws, cyg - hs, cxg + ws, cyg + hs], axis=-1)
+    out = out.reshape(1, -1, 4)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out.astype(data.dtype)
+
+
+# --------------------------------------------------------------------------
+# MultiBoxTarget
+# --------------------------------------------------------------------------
+
+def _encode_loc(anchors, gt, variances):
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    ax = (anchors[:, 0] + anchors[:, 2]) * 0.5
+    ay = (anchors[:, 1] + anchors[:, 3]) * 0.5
+    gw = gt[:, 2] - gt[:, 0]
+    gh = gt[:, 3] - gt[:, 1]
+    gx = (gt[:, 0] + gt[:, 2]) * 0.5
+    gy = (gt[:, 1] + gt[:, 3]) * 0.5
+    return jnp.stack([
+        (gx - ax) / aw / variances[0],
+        (gy - ay) / ah / variances[1],
+        jnp.log(jnp.maximum(gw / aw, 1e-12)) / variances[2],
+        jnp.log(jnp.maximum(gh / ah, 1e-12)) / variances[3]], axis=-1)
+
+
+@register_op("_contrib_MultiBoxTarget", num_outputs=3,
+             aliases=("MultiBoxTarget",),
+             input_names=("anchor", "label", "cls_pred"))
+def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                     ignore_label=-1.0, negative_mining_ratio=-1.0,
+                     negative_mining_thresh=0.5,
+                     minimum_negative_samples=0,
+                     variances=(0.1, 0.1, 0.2, 0.2)):
+    """SSD training targets (reference: multibox_target.cc
+    MultiBoxTargetForward — greedy bipartite matching, then IoU-threshold
+    matching, then hard-negative mining by background prob).
+
+    anchor: (1, A, 4); label: (N, G, 5+) [cls, l, t, r, b]; cls_pred:
+    (N, C, A).  Returns (loc_target (N, A*4), loc_mask (N, A*4),
+    cls_target (N, A))."""
+    anchors = anchor.reshape(-1, 4)
+    A = anchors.shape[0]
+    G = label.shape[1]
+
+    def one_batch(lbl, cpred):
+        valid = lbl[:, 0] != -1.0
+        iou = _box_iou_corner(anchors, lbl[:, 1:5])     # (A, G)
+        iou = jnp.where(valid[None, :], iou, -1.0)
+
+        # greedy bipartite matching: G rounds of global argmax
+        def bip_step(_, st):
+            m_iou, m_gt, a_used, g_used = st
+            masked = jnp.where(a_used[:, None] | g_used[None, :], -1.0,
+                               iou)
+            flat = jnp.argmax(masked)
+            bi, bj = flat // G, flat % G
+            best = masked[bi, bj]
+            ok = best > 1e-6
+            m_iou = m_iou.at[bi].set(jnp.where(ok, best, m_iou[bi]))
+            m_gt = m_gt.at[bi].set(jnp.where(ok, bj, m_gt[bi]))
+            a_used = a_used.at[bi].set(a_used[bi] | ok)
+            g_used = g_used.at[bj].set(g_used[bj] | ok)
+            return m_iou, m_gt, a_used, g_used
+
+        m_iou = jnp.full((A,), -1.0, anchors.dtype)
+        m_gt = jnp.full((A,), -1, jnp.int32)
+        a_used = jnp.zeros((A,), bool)
+        g_used = jnp.zeros((G,), bool)
+        m_iou, m_gt, a_used, g_used = jax.lax.fori_loop(
+            0, G, bip_step, (m_iou, m_gt, a_used, g_used))
+
+        # threshold matching for remaining anchors
+        best_gt = jnp.argmax(iou, axis=1)
+        best_iou = jnp.max(iou, axis=1)
+        thr_pos = (~a_used) & (best_iou > overlap_threshold) \
+            if overlap_threshold > 0 else jnp.zeros((A,), bool)
+        m_gt = jnp.where(thr_pos, best_gt, m_gt)
+        m_iou = jnp.where(a_used, m_iou, best_iou)
+        positive = a_used | thr_pos
+        num_pos = jnp.sum(positive)
+
+        # negative selection
+        if negative_mining_ratio > 0:
+            num_neg = jnp.minimum(
+                (num_pos * negative_mining_ratio).astype(jnp.int32),
+                A - num_pos)
+            num_neg = jnp.maximum(num_neg, minimum_negative_samples)
+            # background prob of each anchor (class 0 row of cls_pred)
+            logits = cpred                     # (C, A)
+            mx = jnp.max(logits, axis=0)
+            prob0 = jnp.exp(logits[0] - mx) / \
+                jnp.sum(jnp.exp(logits - mx[None, :]), axis=0)
+            cand = (~positive) & (m_iou < negative_mining_thresh)
+            score = jnp.where(cand, prob0, jnp.inf)
+            order = jnp.argsort(score, stable=True)   # hardest first
+            rank = jnp.zeros((A,), jnp.int32).at[order].set(
+                jnp.arange(A, dtype=jnp.int32))
+            negative = cand & (rank < num_neg)
+        else:
+            negative = ~positive
+
+        gt_boxes = lbl[jnp.maximum(m_gt, 0), 1:5]
+        loc_t = _encode_loc(anchors, gt_boxes, variances)
+        loc_t = jnp.where(positive[:, None], loc_t, 0.0).reshape(-1)
+        loc_m = jnp.where(positive[:, None],
+                          jnp.ones((A, 4), anchors.dtype),
+                          0.0).reshape(-1)
+        cls_t = jnp.where(
+            positive, lbl[jnp.maximum(m_gt, 0), 0] + 1.0,
+            jnp.where(negative, 0.0, ignore_label))
+        return loc_t, loc_m, cls_t
+
+    loc_t, loc_m, cls_t = jax.vmap(one_batch)(label, cls_pred)
+    return loc_t, loc_m, cls_t
+
+
+# --------------------------------------------------------------------------
+# NMS (shared masked kernel)
+# --------------------------------------------------------------------------
+
+def _nms_mask(boxes, scores, valid, thresh, ids=None,
+              force_suppress=True, topk=-1):
+    """Greedy NMS keep-mask.  boxes (N,4) corner, scores desc-sortable.
+    Returns keep mask in ORIGINAL order."""
+    N = boxes.shape[0]
+    order = jnp.argsort(-scores, stable=True)
+    if topk > 0:
+        in_topk = jnp.arange(N) < topk
+    else:
+        in_topk = jnp.ones((N,), bool)
+    b = boxes[order]
+    v = valid[order] & in_topk
+    iou = _box_iou_corner(b, b)
+    if ids is not None and not force_suppress:
+        same = ids[order][:, None] == ids[order][None, :]
+        iou = jnp.where(same, iou, 0.0)
+
+    def step(i, keep):
+        sup = jnp.any((iou[i] > thresh) & keep &
+                      (jnp.arange(N) < i))
+        return keep.at[i].set(v[i] & ~sup)
+
+    keep_sorted = jax.lax.fori_loop(0, N, step, v)
+    keep = jnp.zeros((N,), bool).at[order].set(keep_sorted)
+    return keep
+
+
+@register_op("_contrib_box_iou", aliases=("box_iou",),
+             input_names=("lhs", "rhs"))
+def _box_iou(lhs, rhs, format="corner"):
+    if format == "center":
+        lhs, rhs = _to_corner(lhs), _to_corner(rhs)
+    lshape, rshape = lhs.shape[:-1], rhs.shape[:-1]
+    out = _box_iou_corner(lhs.reshape(-1, 4), rhs.reshape(-1, 4))
+    return out.reshape(lshape + rshape)
+
+
+@register_op("_contrib_box_nms", num_outputs=2, num_visible_outputs=1,
+             aliases=("box_nms",))
+def _box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+             coord_start=2, score_index=1, id_index=-1,
+             force_suppress=False, in_format="corner",
+             out_format="corner"):
+    """Greedy NMS (reference: bounding_box.cc box_nms).  Suppressed and
+    invalid entries become all -1 rows; survivors keep descending-score
+    order.  data: (..., N, K)."""
+    shape = data.shape
+    flat = data.reshape((-1,) + shape[-2:])
+
+    def one(batch):
+        boxes = jax.lax.dynamic_slice_in_dim(batch, coord_start, 4,
+                                             axis=1)
+        if in_format == "center":
+            boxes = _to_corner(boxes)
+        scores = batch[:, score_index]
+        valid = scores > valid_thresh
+        ids = batch[:, id_index] if id_index >= 0 else None
+        keep = _nms_mask(boxes, scores, valid, overlap_thresh, ids,
+                         force_suppress or id_index < 0, topk)
+        # survivors sorted by descending score, dead rows -1
+        order = jnp.argsort(-jnp.where(keep, scores, -jnp.inf),
+                            stable=True)
+        rows = batch[order]
+        if out_format != in_format:
+            b = jax.lax.dynamic_slice_in_dim(rows, coord_start, 4,
+                                             axis=1)
+            b = _to_corner(b) if in_format == "center" else _to_center(b)
+            rows = jax.lax.dynamic_update_slice_in_dim(
+                rows, b, coord_start, axis=1)
+        kept_sorted = keep[order]
+        return jnp.where(kept_sorted[:, None], rows, -1.0)
+
+    out = jax.vmap(one)(flat).reshape(shape)
+    return out, out
+
+
+# --------------------------------------------------------------------------
+# MultiBoxDetection
+# --------------------------------------------------------------------------
+
+@register_op("_contrib_MultiBoxDetection", aliases=("MultiBoxDetection",),
+             input_names=("cls_prob", "loc_pred", "anchor"))
+def _multibox_detection(cls_prob, loc_pred, anchor, clip=True,
+                        threshold=0.01, background_id=0,
+                        nms_threshold=0.5, force_suppress=False,
+                        variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """Decode SSD predictions + per-class NMS (reference:
+    multibox_detection.cc).  cls_prob (N, C, A), loc_pred (N, A*4),
+    anchor (1, A, 4) -> (N, A, 6) rows [cls_id, score, l, t, r, b],
+    suppressed rows -1."""
+    anchors = anchor.reshape(-1, 4)
+    A = anchors.shape[0]
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    ax = (anchors[:, 0] + anchors[:, 2]) * 0.5
+    ay = (anchors[:, 1] + anchors[:, 3]) * 0.5
+
+    def one(cprob, lpred):
+        loc = lpred.reshape(A, 4)
+        px = loc[:, 0] * variances[0] * aw + ax
+        py = loc[:, 1] * variances[1] * ah + ay
+        pw = jnp.exp(loc[:, 2] * variances[2]) * aw * 0.5
+        ph = jnp.exp(loc[:, 3] * variances[3]) * ah * 0.5
+        boxes = jnp.stack([px - pw, py - ph, px + pw, py + ph], axis=-1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        # best non-background class per anchor
+        scores = jnp.where(
+            (jnp.arange(cprob.shape[0]) == background_id)[:, None],
+            -1.0, cprob)
+        cls_id = jnp.argmax(scores, axis=0)
+        score = jnp.max(scores, axis=0)
+        valid = score > threshold
+        out_id = jnp.where(valid, cls_id.astype(cprob.dtype) -
+                           (cls_id > background_id), -1.0)
+        # reference maps class index skipping background: id-1 when
+        # background_id==0
+        keep = _nms_mask(boxes, score, valid, nms_threshold,
+                         out_id, force_suppress, nms_topk)
+        rows = jnp.concatenate(
+            [out_id[:, None], score[:, None], boxes], axis=1)
+        order = jnp.argsort(-jnp.where(keep, score, -jnp.inf),
+                            stable=True)
+        rows = rows[order]
+        return jnp.where(keep[order][:, None], rows, -1.0)
+
+    return jax.vmap(one)(cls_prob, loc_pred)
+
+
+# --------------------------------------------------------------------------
+# ROIAlign / ROIPooling-family + proposal
+# --------------------------------------------------------------------------
+
+@register_op("_contrib_ROIAlign", aliases=("ROIAlign",),
+             input_names=("data", "rois"))
+def _roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
+               sample_ratio=-1):
+    """ROI Align with bilinear sampling (reference: roi_align.cc,
+    sampling grid per He et al. Mask R-CNN).  data (N,C,H,W), rois
+    (R,5) [batch_idx, x1, y1, x2, y2] in image coords."""
+    N, C, H, W = data.shape
+    ph, pw = pooled_size
+    sr = sample_ratio if sample_ratio > 0 else 2
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = roi[1] * spatial_scale, roi[2] * spatial_scale, \
+            roi[3] * spatial_scale, roi[4] * spatial_scale
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        # sample grid: (ph*sr, pw*sr) bilinear points
+        ys = y1 + (jnp.arange(ph * sr) + 0.5) * rh / (ph * sr)
+        xs = x1 + (jnp.arange(pw * sr) + 0.5) * rw / (pw * sr)
+        y0 = jnp.clip(jnp.floor(ys), 0, H - 1)
+        x0 = jnp.clip(jnp.floor(xs), 0, W - 1)
+        y1i = jnp.clip(y0 + 1, 0, H - 1).astype(jnp.int32)
+        x1i = jnp.clip(x0 + 1, 0, W - 1).astype(jnp.int32)
+        y0i = y0.astype(jnp.int32)
+        x0i = x0.astype(jnp.int32)
+        wy1 = jnp.clip(ys - y0, 0.0, 1.0)
+        wx1 = jnp.clip(xs - x0, 0.0, 1.0)
+        img = data[bidx]                       # (C, H, W)
+        v00 = img[:, y0i[:, None], x0i[None, :]]
+        v01 = img[:, y0i[:, None], x1i[None, :]]
+        v10 = img[:, y1i[:, None], x0i[None, :]]
+        v11 = img[:, y1i[:, None], x1i[None, :]]
+        val = (v00 * (1 - wy1)[None, :, None] * (1 - wx1)[None, None, :]
+               + v01 * (1 - wy1)[None, :, None] * wx1[None, None, :]
+               + v10 * wy1[None, :, None] * (1 - wx1)[None, None, :]
+               + v11 * wy1[None, :, None] * wx1[None, None, :])
+        # average the sr x sr samples per bin
+        val = val.reshape(C, ph, sr, pw, sr).mean(axis=(2, 4))
+        return val
+
+    return jax.vmap(one_roi)(rois)
+
+
+@register_op("_contrib_Proposal", aliases=("Proposal",),
+             input_names=("cls_prob", "bbox_pred", "im_info"),
+             num_outputs=lambda p: 2 if p.get("output_score") else 1)
+def _proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+              rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+              scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+              feature_stride=16, output_score=False, iou_loss=False):
+    """RPN proposals (reference: proposal.cc): anchor decode + clip +
+    min-size filter + NMS + top-k, masked fixed-shape output
+    (rpn_post_nms_top_n rows per image)."""
+    N, num_anchors2, H, W = cls_prob.shape
+    A = num_anchors2 // 2
+    base = feature_stride
+    # base anchors at (0,0): all (scale, ratio) combos, centered
+    ws, hs = [], []
+    for r in ratios:
+        size = base * base
+        size_r = size / r
+        w0 = round(size_r ** 0.5)
+        h0 = round(w0 * r)
+        for s in scales:
+            ws.append(w0 * s)
+            hs.append(h0 * s)
+    ws = jnp.asarray(ws, cls_prob.dtype)
+    hs = jnp.asarray(hs, cls_prob.dtype)
+    cx = (base - 1) / 2.0
+    shift_x = jnp.arange(W) * feature_stride
+    shift_y = jnp.arange(H) * feature_stride
+    acx = cx + shift_x[None, :, None]          # (1, W, A)
+    acy = cx + shift_y[:, None, None]
+    anchors = jnp.stack([
+        jnp.broadcast_to(acx - (ws - 1) / 2, (H, W, A)),
+        jnp.broadcast_to(acy - (hs - 1) / 2, (H, W, A)),
+        jnp.broadcast_to(acx + (ws - 1) / 2, (H, W, A)),
+        jnp.broadcast_to(acy + (hs - 1) / 2, (H, W, A))],
+        axis=-1).reshape(-1, 4)                 # (H*W*A, 4)
+
+    def one(cp, bp, info):
+        scores = cp[A:].transpose(1, 2, 0).reshape(-1)   # fg scores
+        deltas = bp.reshape(A, 4, H, W).transpose(2, 3, 0, 1) \
+            .reshape(-1, 4)
+        aw = anchors[:, 2] - anchors[:, 0] + 1
+        ah = anchors[:, 3] - anchors[:, 1] + 1
+        ax = anchors[:, 0] + 0.5 * (aw - 1)
+        ay = anchors[:, 1] + 0.5 * (ah - 1)
+        px = deltas[:, 0] * aw + ax
+        py = deltas[:, 1] * ah + ay
+        pw = jnp.exp(deltas[:, 2]) * aw
+        ph = jnp.exp(deltas[:, 3]) * ah
+        boxes = jnp.stack([px - 0.5 * (pw - 1), py - 0.5 * (ph - 1),
+                           px + 0.5 * (pw - 1), py + 0.5 * (ph - 1)],
+                          axis=-1)
+        boxes = jnp.stack([
+            jnp.clip(boxes[:, 0], 0, info[1] - 1),
+            jnp.clip(boxes[:, 1], 0, info[0] - 1),
+            jnp.clip(boxes[:, 2], 0, info[1] - 1),
+            jnp.clip(boxes[:, 3], 0, info[0] - 1)], axis=-1)
+        min_size = rpn_min_size * info[2]
+        keep_sz = ((boxes[:, 2] - boxes[:, 0] + 1) >= min_size) & \
+            ((boxes[:, 3] - boxes[:, 1] + 1) >= min_size)
+        scores = jnp.where(keep_sz, scores, -jnp.inf)
+        keep = _nms_mask(boxes, scores, keep_sz, threshold,
+                         topk=rpn_pre_nms_top_n)
+        order = jnp.argsort(-jnp.where(keep, scores, -jnp.inf),
+                            stable=True)
+        top = order[:rpn_post_nms_top_n]
+        ok = keep[top]
+        rois = jnp.where(ok[:, None], boxes[top], 0.0)
+        sc = jnp.where(ok, scores[top], 0.0)
+        return rois, sc
+
+    rois, scores = jax.vmap(one)(cls_prob, bbox_pred, im_info)
+    batch_idx = jnp.repeat(jnp.arange(N, dtype=cls_prob.dtype),
+                           rpn_post_nms_top_n)
+    rois5 = jnp.concatenate(
+        [batch_idx[:, None], rois.reshape(-1, 4)], axis=1)
+    if output_score:
+        return rois5, scores.reshape(-1, 1)
+    return rois5

@@ -1,0 +1,89 @@
+"""Device-side image ops (reference capability: src/operator/image/ —
+to_tensor, normalize, flip, color jitter family).
+
+These are the in-graph counterparts of mx.image's host augmenters: they
+run on device as part of the compiled program (e.g. normalize fused into
+the first conv by XLA), for pipelines that ship uint8 batches to HBM and
+do the float conversion there — the bandwidth-optimal TPU layout.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+@register_op("_image_to_tensor", aliases=("to_tensor",))
+def _to_tensor(data):
+    """HWC (or NHWC) uint8 [0,255] -> CHW (NCHW) float32 [0,1]."""
+    x = data.astype(jnp.float32) / 255.0
+    if x.ndim == 3:
+        return jnp.transpose(x, (2, 0, 1))
+    return jnp.transpose(x, (0, 3, 1, 2))
+
+
+@register_op("_image_normalize", aliases=("image_normalize",))
+def _normalize(data, mean=(0.0,), std=(1.0,)):
+    """Channel-wise normalize CHW/NCHW float input."""
+    mean = jnp.asarray(mean, data.dtype)
+    std = jnp.asarray(std, data.dtype)
+    shape = (-1, 1, 1) if data.ndim == 3 else (1, -1, 1, 1)
+    return (data - mean.reshape(shape)) / std.reshape(shape)
+
+
+@register_op("_image_flip_left_right", aliases=("flip_left_right",))
+def _flip_lr(data):
+    return jnp.flip(data, axis=-1)
+
+
+@register_op("_image_flip_top_bottom", aliases=("flip_top_bottom",))
+def _flip_tb(data):
+    return jnp.flip(data, axis=-2)
+
+
+@register_op("_image_random_flip_left_right", needs_rng=True,
+             aliases=("random_flip_left_right",))
+def _random_flip_lr(rng, data, p=0.5):
+    flip = jax.random.bernoulli(rng, p)
+    return jnp.where(flip, jnp.flip(data, axis=-1), data)
+
+
+@register_op("_image_random_flip_top_bottom", needs_rng=True,
+             aliases=("random_flip_top_bottom",))
+def _random_flip_tb(rng, data, p=0.5):
+    flip = jax.random.bernoulli(rng, p)
+    return jnp.where(flip, jnp.flip(data, axis=-2), data)
+
+
+@register_op("_image_random_brightness", needs_rng=True,
+             aliases=("random_brightness",))
+def _random_brightness(rng, data, min_factor=0.5, max_factor=1.5):
+    alpha = jax.random.uniform(rng, (), minval=min_factor,
+                               maxval=max_factor)
+    return data * alpha
+
+
+@register_op("_image_random_contrast", needs_rng=True,
+             aliases=("random_contrast",))
+def _random_contrast(rng, data, min_factor=0.5, max_factor=1.5):
+    alpha = jax.random.uniform(rng, (), minval=min_factor,
+                               maxval=max_factor)
+    coef = jnp.asarray([0.299, 0.587, 0.114], data.dtype)
+    axis = 0 if data.ndim == 3 else 1
+    gray = jnp.mean(
+        jnp.tensordot(coef, jnp.moveaxis(data, axis, 0), axes=1))
+    return data * alpha + gray * (1.0 - alpha)
+
+
+@register_op("_image_random_saturation", needs_rng=True,
+             aliases=("random_saturation",))
+def _random_saturation(rng, data, min_factor=0.5, max_factor=1.5):
+    alpha = jax.random.uniform(rng, (), minval=min_factor,
+                               maxval=max_factor)
+    coef = jnp.asarray([0.299, 0.587, 0.114], data.dtype)
+    axis = 0 if data.ndim == 3 else 1
+    gray = jnp.tensordot(coef, jnp.moveaxis(data, axis, 0), axes=1)
+    gray = jnp.expand_dims(gray, axis)
+    return data * alpha + gray * (1.0 - alpha)

@@ -554,7 +554,7 @@ def _block_grad(x):
     return jax.lax.stop_gradient(x)
 
 
-@register_op("make_loss")
+@register_op("make_loss", aliases=("MakeLoss",))
 def _make_loss(x):
     return x * 1.0
 

@@ -7,3 +7,6 @@ _register.populate(globals())
 
 zeros = globals()["_zeros"]
 ones = globals()["_ones"]
+
+from . import contrib  # noqa: F401,E402  (control flow: foreach/while/cond)
+from . import image  # noqa: F401,E402

@@ -1,0 +1,142 @@
+"""Control-flow op tests (reference strategy:
+tests/python/unittest/test_contrib_control_flow.py basic cases)."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import test_utils as tu
+
+sym = mx.sym
+nd = mx.nd
+
+
+def test_sym_foreach_cumsum():
+    data = sym.var("data")
+
+    def body(x, states):
+        out = x + states[0]
+        return out, [out]
+
+    outs, finals = sym.contrib.foreach(body, data, [sym.var("s0")])
+    x = np.arange(12).reshape(4, 3).astype(np.float32)
+    exe = outs.bind(ctx=mx.cpu(), args={
+        "data": nd.array(x), "s0": nd.array(np.zeros(3, np.float32))})
+    got = exe.forward()[0].asnumpy()
+    np.testing.assert_allclose(got, np.cumsum(x, axis=0))
+
+
+def test_sym_foreach_closure_gradient():
+    """Weights captured by the body get correct gradients through the
+    scan."""
+    data = sym.var("data")
+    w = sym.var("w")
+
+    def body(x, states):
+        out = x * w + states[0]
+        return out, [out]
+
+    outs, _ = sym.contrib.foreach(body, data, [sym.var("s0")])
+    loss = sym.sum(outs)
+    T = 3
+    x = np.random.randn(T, 2).astype(np.float64)
+    wv = np.random.randn(2).astype(np.float64)
+    tu.check_numeric_gradient(loss, {
+        "data": x, "w": wv, "s0": np.zeros(2, np.float64)},
+        grad_nodes=["w", "data"])
+
+
+def test_sym_while_loop():
+    def cond_f(i, s):
+        return i < 5
+
+    def func_f(i, s):
+        return s, [i + 1, s + i]
+
+    outs, finals = sym.contrib.while_loop(
+        cond_f, func_f, [sym.var("i"), sym.var("s")], max_iterations=8)
+    g = sym.Group([outs, finals[0], finals[1]])
+    exe = g.bind(ctx=mx.cpu(), args={
+        "i": nd.array(np.zeros(1, np.float32)),
+        "s": nd.array(np.zeros(1, np.float32))})
+    res = exe.forward()
+    np.testing.assert_allclose(res[0].asnumpy().ravel(),
+                               [0, 0, 1, 3, 6, 0, 0, 0])
+    assert float(res[1].asnumpy()) == 5
+    assert float(res[2].asnumpy()) == 10
+
+
+def test_sym_cond_both_branches():
+    x = sym.var("x")
+    out = sym.contrib.cond(sym.sum(x) > 0, lambda: x * 2, lambda: x - 1)
+    for val, expect in ((np.ones(3), 2 * np.ones(3)),
+                        (-np.ones(3), -2 * np.ones(3))):
+        exe = out.bind(ctx=mx.cpu(),
+                       args={"x": nd.array(val.astype(np.float32))})
+        np.testing.assert_allclose(exe.forward()[0].asnumpy(),
+                                   expect.astype(np.float32))
+
+
+def test_nd_foreach_matches_sym():
+    x = np.random.randn(4, 3).astype(np.float32)
+
+    def body(xt, states):
+        out = xt + states[0]
+        return out, [out]
+
+    o, st = nd.contrib.foreach(body, nd.array(x),
+                               [nd.array(np.zeros(3, np.float32))])
+    np.testing.assert_allclose(o.asnumpy(), np.cumsum(x, axis=0),
+                               rtol=1e-6)
+    np.testing.assert_allclose(st[0].asnumpy(), x.sum(0), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_nd_foreach_autograd():
+    x = nd.array(np.random.randn(3, 2).astype(np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        o, _ = nd.contrib.foreach(
+            lambda xt, s: (xt * xt + s[0], [s[0]]), x,
+            [nd.array(np.zeros(2, np.float32))])
+        loss = o.sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy(),
+                               rtol=1e-5)
+
+
+def test_nd_while_loop_dynamic():
+    o, fv = nd.contrib.while_loop(
+        lambda i: i < 3, lambda i: (i * 2, [i + 1]),
+        [nd.array(np.zeros(1, np.float32))], max_iterations=10)
+    np.testing.assert_allclose(o.asnumpy().ravel(), [0, 2, 4])
+    np.testing.assert_allclose(fv[0].asnumpy(), [3])
+
+
+def test_nd_cond():
+    a = nd.array(np.array([1.0], np.float32))
+    b = nd.array(np.array([2.0], np.float32))
+    assert float(nd.contrib.cond(a > 0, lambda: a, lambda: b)
+                 .asnumpy()) == 1.0
+    assert float(nd.contrib.cond(a < 0, lambda: a, lambda: b)
+                 .asnumpy()) == 2.0
+
+
+def test_sym_foreach_multiple_outputs_and_states():
+    data = sym.var("data")
+
+    def body(x, states):
+        s1, s2 = states
+        return [x + s1, x * s2], [s1 + x, s2 * 1.0]
+
+    outs, finals = sym.contrib.foreach(
+        body, data, [sym.var("a"), sym.var("b")])
+    g = sym.Group(list(outs) + list(finals))
+    x = np.ones((3, 2), np.float32)
+    exe = g.bind(ctx=mx.cpu(), args={
+        "data": nd.array(x),
+        "a": nd.array(np.zeros(2, np.float32)),
+        "b": nd.array(np.full((2,), 2.0, np.float32))})
+    res = exe.forward()
+    np.testing.assert_allclose(res[0].asnumpy()[:, 0], [1, 2, 3])
+    np.testing.assert_allclose(res[1].asnumpy()[:, 0], [2, 2, 2])
+    np.testing.assert_allclose(res[2].asnumpy(), [3, 3])

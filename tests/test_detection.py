@@ -1,0 +1,175 @@
+"""Detection op tests — numpy oracles for NMS/prior/target
+(reference strategy: tests/python/unittest/test_operator.py multibox +
+bounding_box cases)."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+nd = mx.nd
+
+
+def _np_iou(a, b):
+    iw = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
+    ih = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+    inter = iw * ih
+    ua = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1]) \
+        - inter
+    return inter / ua if ua > 0 else 0.0
+
+
+def _np_nms(rows, thresh, coord_start=2, score_index=1, valid_thresh=0.0):
+    """Greedy NMS oracle: returns surviving rows desc-by-score, rest -1."""
+    order = sorted(range(len(rows)),
+                   key=lambda i: -rows[i][score_index])
+    keep = []
+    for i in order:
+        if rows[i][score_index] <= valid_thresh:
+            continue
+        box = rows[i][coord_start:coord_start + 4]
+        if any(_np_iou(box, rows[j][coord_start:coord_start + 4]) >
+               thresh for j in keep):
+            continue
+        keep.append(i)
+    out = np.full_like(rows, -1.0)
+    for k, i in enumerate(keep):
+        out[k] = rows[i]
+    return out
+
+
+def test_multibox_prior_matches_reference_math():
+    data = nd.array(np.zeros((1, 3, 2, 3), np.float32))
+    out = nd.contrib_box = mx.nd.MultiBoxPrior(
+        data, sizes=(0.5, 0.3), ratios=(1.0, 2.0))
+    out = out.asnumpy()
+    assert out.shape == (1, 2 * 3 * 3, 4)
+    # first anchor at cell (0,0): center (0.5/3, 0.5/2), size 0.5
+    cx, cy = 0.5 / 3, 0.5 / 2
+    w = 0.5 * 2 / 3 / 2  # size * in_h/in_w / 2
+    h = 0.5 / 2
+    np.testing.assert_allclose(out[0, 0], [cx - w, cy - h, cx + w,
+                                           cy + h], rtol=1e-5)
+    # third anchor: ratio 2, size 0.5: w=size*inh/inw*sqrt(2)/2
+    sr = np.sqrt(2.0)
+    w2 = 0.5 * 2 / 3 * sr / 2
+    h2 = 0.5 / sr / 2
+    np.testing.assert_allclose(
+        out[0, 2], [cx - w2, cy - h2, cx + w2, cy + h2], rtol=1e-5)
+
+
+def test_box_nms_matches_numpy():
+    rs = np.random.RandomState(0)
+    N = 20
+    rows = np.zeros((N, 6), np.float32)
+    ctr = rs.uniform(0.2, 0.8, (N, 2))
+    wh = rs.uniform(0.05, 0.3, (N, 2))
+    rows[:, 2] = ctr[:, 0] - wh[:, 0]
+    rows[:, 3] = ctr[:, 1] - wh[:, 1]
+    rows[:, 4] = ctr[:, 0] + wh[:, 0]
+    rows[:, 5] = ctr[:, 1] + wh[:, 1]
+    rows[:, 1] = rs.uniform(0.1, 1.0, N)
+    rows[:, 0] = 0
+    got = mx.nd.box_nms(nd.array(rows[None]), overlap_thresh=0.5,
+                        force_suppress=True).asnumpy()[0]
+    want = _np_nms(rows, 0.5)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_box_nms_per_class():
+    rows = np.array([
+        # two same-position boxes, different classes: both survive
+        [0, 0.9, 0.1, 0.1, 0.5, 0.5],
+        [1, 0.8, 0.1, 0.1, 0.5, 0.5],
+        # same class as row 0, overlapping: suppressed
+        [0, 0.7, 0.12, 0.12, 0.5, 0.5],
+    ], np.float32)
+    got = mx.nd.box_nms(nd.array(rows[None]), overlap_thresh=0.5,
+                        id_index=0, force_suppress=False).asnumpy()[0]
+    assert (got[0] == rows[0]).all()
+    assert (got[1] == rows[1]).all()
+    assert (got[2] == -1).all()
+
+
+def test_box_iou():
+    a = np.array([[0, 0, 2, 2]], np.float32)
+    b = np.array([[1, 1, 3, 3], [0, 0, 2, 2], [5, 5, 6, 6]], np.float32)
+    got = mx.nd.box_iou(nd.array(a), nd.array(b)).asnumpy()
+    np.testing.assert_allclose(got[0], [1.0 / 7, 1.0, 0.0], rtol=1e-5)
+
+
+def test_multibox_target_basic():
+    """Single gt box perfectly matching anchor 1 -> positive with
+    encoded zero offsets; others negative."""
+    anchors = np.array([[[0.0, 0.0, 0.3, 0.3],
+                         [0.4, 0.4, 0.8, 0.8],
+                         [0.0, 0.6, 0.3, 0.9]]], np.float32)
+    labels = np.array([[[2.0, 0.4, 0.4, 0.8, 0.8]]], np.float32)
+    cls_preds = np.zeros((1, 4, 3), np.float32)
+    loc_t, loc_m, cls_t = mx.nd.MultiBoxTarget(
+        nd.array(anchors), nd.array(labels), nd.array(cls_preds))
+    cls_t = cls_t.asnumpy()[0]
+    np.testing.assert_allclose(cls_t, [0.0, 3.0, 0.0])  # cls 2 -> 3
+    loc_m = loc_m.asnumpy()[0].reshape(3, 4)
+    np.testing.assert_allclose(loc_m, [[0] * 4, [1] * 4, [0] * 4])
+    loc_t = loc_t.asnumpy()[0].reshape(3, 4)
+    np.testing.assert_allclose(loc_t[1], np.zeros(4), atol=1e-5)
+
+
+def test_multibox_target_hard_negative_mining():
+    anchors = np.array([[[0.0, 0.0, 0.3, 0.3],
+                         [0.4, 0.4, 0.8, 0.8],
+                         [0.0, 0.6, 0.3, 0.9],
+                         [0.6, 0.0, 0.9, 0.3]]], np.float32)
+    labels = np.array([[[1.0, 0.4, 0.4, 0.8, 0.8]]], np.float32)
+    cls_preds = np.zeros((1, 3, 4), np.float32)
+    # anchor 3 has LOW background score -> hardest negative
+    cls_preds[0, 0] = [5.0, 5.0, 5.0, -5.0]
+    loc_t, loc_m, cls_t = mx.nd.MultiBoxTarget(
+        nd.array(anchors), nd.array(labels), nd.array(cls_preds),
+        negative_mining_ratio=1.0, negative_mining_thresh=0.5)
+    cls_t = cls_t.asnumpy()[0]
+    assert cls_t[1] == 2.0          # positive
+    assert cls_t[3] == 0.0          # hardest negative selected
+    assert cls_t[0] == -1.0 and cls_t[2] == -1.0  # ignored
+
+
+def test_multibox_detection_decode_and_nms():
+    anchors = np.array([[[0.1, 0.1, 0.3, 0.3],
+                         [0.5, 0.5, 0.9, 0.9]]], np.float32)
+    # zero offsets -> boxes == anchors
+    loc = np.zeros((1, 8), np.float32)
+    cls_prob = np.array([[[0.1, 0.2],     # background
+                          [0.8, 0.1],     # class 0
+                          [0.1, 0.7]]], np.float32)  # class 1
+    out = mx.nd.MultiBoxDetection(
+        nd.array(cls_prob), nd.array(loc), nd.array(anchors),
+        nms_threshold=0.5).asnumpy()[0]
+    got = {tuple(round(float(v), 3) for v in r[2:]):
+           (float(r[0]), round(float(r[1]), 3)) for r in out
+           if r[0] >= 0}
+    assert got[(0.1, 0.1, 0.3, 0.3)] == (0.0, 0.8)
+    assert got[(0.5, 0.5, 0.9, 0.9)] == (1.0, 0.7)
+
+
+def test_roi_align_shapes_and_constant():
+    data = np.ones((1, 2, 8, 8), np.float32) * 3.0
+    rois = np.array([[0, 0, 0, 4, 4]], np.float32)
+    out = mx.nd.ROIAlign(nd.array(data), nd.array(rois),
+                         pooled_size=(2, 2), spatial_scale=1.0)
+    assert out.shape == (1, 2, 2, 2)
+    np.testing.assert_allclose(out.asnumpy(), 3.0, rtol=1e-5)
+
+
+def test_proposal_shapes():
+    N, A, H, W = 1, 3, 4, 4
+    rs = np.random.RandomState(0)
+    cls_prob = rs.rand(N, 2 * A, H, W).astype(np.float32)
+    bbox_pred = rs.randn(N, 4 * A, H, W).astype(np.float32) * 0.1
+    im_info = np.array([[64, 64, 1.0]], np.float32)
+    rois = mx.nd.Proposal(nd.array(cls_prob), nd.array(bbox_pred),
+                          nd.array(im_info), rpn_post_nms_top_n=10,
+                          scales=(2,), ratios=(0.5, 1, 2),
+                          feature_stride=16, rpn_min_size=4)
+    assert rois.shape == (10, 5)
+    r = rois.asnumpy()
+    assert (r[:, 1:] >= 0).all() and (r[:, 1:] <= 63).all()

@@ -1,0 +1,170 @@
+"""Image pipeline tests: mx.image, ImageRecordIter, device image ops
+(reference strategy: tests/python/unittest/test_image.py + test_io.py
+ImageRecordIter cases, on synthetic generated .rec files)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image as mimg
+from mxnet_tpu import recordio
+
+cv2 = pytest.importorskip("cv2")
+
+
+def _make_img(h, w, seed=0):
+    rs = np.random.RandomState(seed)
+    return rs.randint(0, 255, (h, w, 3), dtype=np.uint8)
+
+
+def _encode(img):
+    ok, buf = cv2.imencode(".jpg", cv2.cvtColor(img, cv2.COLOR_RGB2BGR))
+    assert ok
+    return bytes(buf)
+
+
+@pytest.fixture
+def rec_file(tmp_path):
+    """Synthetic 24-image .rec/.idx pair, labels 0..3."""
+    prefix = str(tmp_path / "data")
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec",
+                                     "w")
+    for i in range(24):
+        img = _make_img(40 + i % 3, 36 + i % 5, seed=i)
+        header = recordio.IRHeader(0, float(i % 4), i, 0)
+        rec.write_idx(i, recordio.pack(header, _encode(img)))
+    rec.close()
+    return prefix
+
+
+def test_imdecode_roundtrip():
+    # smooth gradient — random noise is a JPEG worst case
+    yy, xx = np.mgrid[0:32, 0:48]
+    img = np.stack([yy * 8, xx * 5, (yy + xx) * 3],
+                   axis=-1).astype(np.uint8)
+    got = mimg.imdecode(_encode(img))
+    assert got.shape == (32, 48, 3)
+    assert np.abs(got.astype(int) - img.astype(int)).mean() < 4
+
+
+def test_resize_and_crops():
+    img = _make_img(40, 60)
+    assert mimg.resize_short(img, 20).shape[0] == 20
+    assert mimg.imresize(img, 10, 14).shape == (14, 10, 3)
+    c, _ = mimg.center_crop(img, (30, 30))
+    assert c.shape == (30, 30, 3)
+    r, _ = mimg.random_crop(img, (20, 20))
+    assert r.shape == (20, 20, 3)
+    rs, _ = mimg.random_size_crop(img, (16, 16), (0.3, 1.0), (0.75, 1.33))
+    assert rs.shape == (16, 16, 3)
+
+
+def test_augmenter_list():
+    augs = mimg.CreateAugmenter((3, 24, 24), resize=30, rand_crop=True,
+                                rand_mirror=True, brightness=0.1,
+                                contrast=0.1, saturation=0.1, hue=0.1,
+                                pca_noise=0.05, rand_gray=0.1,
+                                mean=True, std=True)
+    img = _make_img(40, 50)
+    out = img
+    for a in augs:
+        out = a(out)
+    assert out.shape == (24, 24, 3)
+    assert out.dtype == np.float32
+
+
+def test_image_iter_list(tmp_path):
+    paths = []
+    for i in range(6):
+        p = tmp_path / ("img%d.jpg" % i)
+        cv2.imwrite(str(p), cv2.cvtColor(_make_img(30, 30, i),
+                                         cv2.COLOR_RGB2BGR))
+        paths.append(([float(i % 2)], str(p)))
+    it = mimg.ImageIter(batch_size=3, data_shape=(3, 24, 24),
+                        imglist=paths, path_root="")
+    batch = next(it)
+    assert batch.data[0].shape == (3, 3, 24, 24)
+    assert batch.label[0].shape == (3,)
+
+
+def test_image_record_iter(rec_file):
+    it = mx.io.ImageRecordIter(
+        path_imgrec=rec_file + ".rec", path_imgidx=rec_file + ".idx",
+        data_shape=(3, 24, 24), batch_size=8, shuffle=True,
+        rand_crop=True, rand_mirror=True,
+        mean_r=123.68, mean_g=116.28, mean_b=103.53,
+        preprocess_threads=2)
+    n = 0
+    labels = []
+    for batch in it:
+        assert batch.data[0].shape == (8, 3, 24, 24)
+        labels.append(batch.label[0].asnumpy())
+        n += 1
+    assert n == 3
+    it.reset()
+    assert sum(1 for _ in it) == 3
+    assert set(np.concatenate(labels)) == {0.0, 1.0, 2.0, 3.0}
+
+
+def test_image_record_iter_feeds_module(rec_file):
+    """End-to-end: rec file -> ImageRecordIter -> conv net fit."""
+    it = mx.io.ImageRecordIter(
+        path_imgrec=rec_file + ".rec", data_shape=(3, 16, 16),
+        batch_size=8, std_r=58.4, std_g=57.1, std_b=57.4)
+    data = mx.sym.var("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=4,
+                             pad=(1, 1), name="c1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, global_pool=True, pool_type="avg",
+                         kernel=(1, 1))
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu(),
+                        label_names=["softmax_label"])
+    mod.fit(it, num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05})
+    score = mod.score(it, mx.metric.Accuracy())
+    assert score[0][1] >= 0.0  # ran end to end
+
+
+def test_device_image_ops():
+    img = _make_img(8, 6)
+    x = mx.nd.array(img.astype(np.float32))
+    t = mx.nd.image.to_tensor(mx.nd.array(img))
+    assert t.shape == (3, 8, 6)
+    np.testing.assert_allclose(t.asnumpy().max(), img.max() / 255.0,
+                               rtol=1e-6)
+    nrm = mx.nd.image.normalize(t, mean=(0.5, 0.5, 0.5),
+                                std=(0.2, 0.2, 0.2))
+    np.testing.assert_allclose(
+        nrm.asnumpy(), (t.asnumpy() - 0.5) / 0.2, rtol=1e-5)
+    f = mx.nd.image.flip_left_right(t)
+    np.testing.assert_allclose(f.asnumpy(), t.asnumpy()[:, :, ::-1])
+
+
+def test_im2rec_tool(tmp_path):
+    import subprocess
+    import sys
+    root = tmp_path / "cls"
+    for cls in ("a", "b"):
+        d = root / cls
+        d.mkdir(parents=True)
+        for i in range(3):
+            cv2.imwrite(str(d / ("%d.jpg" % i)),
+                        cv2.cvtColor(_make_img(20, 20, i),
+                                     cv2.COLOR_RGB2BGR))
+    prefix = str(tmp_path / "out")
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "..", "tools",
+                      "im2rec.py"), prefix, str(root)],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stderr
+    it = mx.io.ImageRecordIter(path_imgrec=prefix + ".rec",
+                               path_imgidx=prefix + ".idx",
+                               data_shape=(3, 16, 16), batch_size=2)
+    batch = next(it)
+    assert batch.data[0].shape == (2, 3, 16, 16)

@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""im2rec — pack an image folder / .lst file into RecordIO
+(reference capability: tools/im2rec.py + im2rec.cc).
+
+Usage:
+  python tools/im2rec.py PREFIX ROOT --list        # write PREFIX.lst
+  python tools/im2rec.py PREFIX ROOT               # pack PREFIX.rec/.idx
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+EXTS = (".jpg", ".jpeg", ".png")
+
+
+def make_list(prefix, root, shuffle=True):
+    classes = sorted(
+        d for d in os.listdir(root)
+        if os.path.isdir(os.path.join(root, d)))
+    entries = []
+    if classes:
+        for label, cls in enumerate(classes):
+            for fn in sorted(os.listdir(os.path.join(root, cls))):
+                if fn.lower().endswith(EXTS):
+                    entries.append((os.path.join(cls, fn), float(label)))
+    else:
+        for i, fn in enumerate(sorted(os.listdir(root))):
+            if fn.lower().endswith(EXTS):
+                entries.append((fn, 0.0))
+    if shuffle:
+        random.shuffle(entries)
+    with open(prefix + ".lst", "w") as f:
+        for i, (path, label) in enumerate(entries):
+            f.write("%d\t%f\t%s\n" % (i, label, path))
+    return prefix + ".lst"
+
+
+def make_record(prefix, root, resize=0, quality=95, color=1):
+    from mxnet_tpu import recordio
+    from mxnet_tpu.image import imread, resize_short
+
+    lst = prefix + ".lst"
+    if not os.path.exists(lst):
+        make_list(prefix, root)
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec",
+                                     "w")
+    with open(lst) as f:
+        for line in f:
+            idx_s, label_s, path = line.strip().split("\t")
+            img = imread(os.path.join(root, path), flag=color)
+            if resize:
+                img = resize_short(img, resize)
+            header = recordio.IRHeader(0, float(label_s), int(idx_s), 0)
+            rec.write_idx(int(idx_s),
+                          recordio.pack_img(header, img,
+                                            quality=quality))
+    rec.close()
+    return prefix + ".rec"
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("prefix")
+    p.add_argument("root")
+    p.add_argument("--list", action="store_true",
+                   help="only generate the .lst file")
+    p.add_argument("--resize", type=int, default=0)
+    p.add_argument("--quality", type=int, default=95)
+    p.add_argument("--no-shuffle", action="store_true")
+    args = p.parse_args()
+    if args.list:
+        print(make_list(args.prefix, args.root,
+                        shuffle=not args.no_shuffle))
+    else:
+        print(make_record(args.prefix, args.root, resize=args.resize,
+                          quality=args.quality))
+
+
+if __name__ == "__main__":
+    main()
