@@ -71,10 +71,13 @@ def main():
     net.initialize()
     loss = gluon.loss.SoftmaxCrossEntropyLoss()
     mesh = make_mesh({"dp": 1}, [dev])
+    # north-star config: bf16 compute weights + f32 masters + LARS
+    # (docs/faq/perf.md fp16 ≈ 2x fp32 sanity ratio applies to bf16 here)
     trainer = ParallelTrainer(
-        net, loss, optimizer="sgd",
-        optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
-        mesh=mesh)
+        net, loss, optimizer="lbsgd" if on_tpu else "sgd",
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                          "eta": 0.001},
+        mesh=mesh, multi_precision=on_tpu)
 
     rng = np.random.RandomState(0)
     x = mx.nd.array(rng.randn(batch, 3, image, image).astype(np.float32))
@@ -97,8 +100,9 @@ def main():
     try:
         ca = trainer._step_fn.lower(
             trainer._params, trainer._opt_state, trainer._aux,
-            x._data, y._data, jax.random.PRNGKey(0),
-            np.float32(0.1)).compile().cost_analysis()
+            trainer._device_batch(x._data), y._data,
+            jax.random.PRNGKey(0), np.float32(0.1),
+            np.int32(1)).compile().cost_analysis()
         if isinstance(ca, (list, tuple)):
             ca = ca[0]
         if ca and "flops" in ca:

@@ -14,6 +14,7 @@ from .context import Context, cpu, gpu, tpu, current_context, num_gpus, \
 from . import ndarray  # noqa: F401
 from . import ndarray as nd  # noqa: F401
 from . import symbol  # noqa: F401
+from .symbol import AttrScope  # noqa: F401
 from . import symbol as sym  # noqa: F401
 from .executor import Executor  # noqa: F401
 from . import random  # noqa: F401
@@ -30,6 +31,10 @@ from . import module  # noqa: F401
 from . import module as mod  # noqa: F401  (reference alias: mx.mod)
 from . import model  # noqa: F401
 from . import callback  # noqa: F401
+from . import profiler  # noqa: F401
+from . import monitor  # noqa: F401
+from . import operator  # noqa: F401
+from .monitor import Monitor  # noqa: F401
 from .module import Module  # noqa: F401
 from . import kvstore  # noqa: F401
 from . import kvstore as kv  # noqa: F401

@@ -45,45 +45,39 @@ def log_train_metric(period, auto_reset=False):
 
 
 class Speedometer:
-    """Throughput logger (reference: callback.py Speedometer)."""
+    """Throughput logger: every *frequent* batches, log samples/sec and
+    the current metric values (API-compatible with the reference's
+    Speedometer batch-end callback)."""
 
     def __init__(self, batch_size, frequent=50, auto_reset=True):
         self.batch_size = batch_size
         self.frequent = frequent
-        self.init = False
-        self.tic = 0
-        self.last_count = 0
         self.auto_reset = auto_reset
+        self._window_start = None   # perf_counter at window begin
+        self._prev_batch = -1
 
     def __call__(self, param):
-        count = param.nbatch
-        if self.last_count > count:
-            self.init = False
-        self.last_count = count
-
-        if self.init:
-            if count % self.frequent == 0:
-                try:
-                    speed = self.frequent * self.batch_size / \
-                        (time.time() - self.tic)
-                except ZeroDivisionError:
-                    speed = float("inf")
-                if param.eval_metric is not None:
-                    name_value = param.eval_metric.get_name_value()
-                    if self.auto_reset:
-                        param.eval_metric.reset()
-                    msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec"
-                    msg += "\t%s=%f" * len(name_value)
-                    logging.info(msg, param.epoch, count, speed,
-                                 *sum(name_value, ()))
-                else:
-                    logging.info(
-                        "Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                        param.epoch, count, speed)
-                self.tic = time.time()
-        else:
-            self.init = True
-            self.tic = time.time()
+        batch = param.nbatch
+        if batch < self._prev_batch or self._window_start is None:
+            # new epoch (batch counter reset) — restart the window
+            self._window_start = time.perf_counter()
+            self._prev_batch = batch
+            return
+        self._prev_batch = batch
+        if batch == 0 or batch % self.frequent:
+            return
+        elapsed = time.perf_counter() - self._window_start
+        rate = (self.frequent * self.batch_size / elapsed) if elapsed \
+            else float("inf")
+        parts = ["Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec"
+                 % (param.epoch, batch, rate)]
+        metric = param.eval_metric
+        if metric is not None:
+            parts += ["%s=%f" % kv for kv in metric.get_name_value()]
+            if self.auto_reset:
+                metric.reset()
+        logging.info("\t".join(parts))
+        self._window_start = time.perf_counter()
 
 
 class ProgressBar:

@@ -78,9 +78,10 @@ class Executor:
     """A bound computation graph."""
 
     def __init__(self, symbol, ctx, arg_dict, grad_dict, aux_dict,
-                 grad_req):
+                 grad_req, group2ctx=None):
         self._symbol = symbol
         self._ctx = ctx or current_context()
+        self._group2ctx = dict(group2ctx or {})
         self.arg_dict = arg_dict
         self.grad_dict = grad_dict
         self.aux_dict = aux_dict
@@ -136,10 +137,51 @@ class Executor:
 
         self._jit_train_step = jax.jit(train_step)
 
+        if self._group2ctx:
+            self._init_grouped()
+
+    def _init_grouped(self):
+        """Replace the whole-graph jits with the segment-chained
+        model-parallel path (see grouped_executor.py)."""
+        from .grouped_executor import build_grouped_eval
+        sym = self._symbol
+        aux_names = self._aux_names
+        run_t, back_t, segs = build_grouped_eval(
+            sym, self._group2ctx, self._ctx, True, aux_names)
+        run_i, _, _ = build_grouped_eval(
+            sym, self._group2ctx, self._ctx, False, aux_names)
+        self._segments = segs
+        grad_names = self._grad_names
+
+        def jit_infer(arg_map, aux_map, key):
+            outs, auxu, _ = run_i(arg_map, aux_map, key, False)
+            return outs, auxu
+
+        def jit_train(arg_map, aux_map, key):
+            outs, auxu, _ = run_t(arg_map, aux_map, key, False)
+            return outs, auxu
+
+        def train_step(arg_map, aux_map, key, out_cots):
+            outs, auxu, vjps = run_t(arg_map, aux_map, key, True)
+            cots = [c.astype(o.dtype) if c.dtype != o.dtype else c
+                    for c, o in zip(out_cots, outs)]
+            all_grads = back_t(vjps, cots)
+            grads = {}
+            for n in grad_names:
+                g = all_grads.get(n)
+                if g is None:
+                    g = jnp.zeros_like(arg_map[n])
+                grads[n] = g
+            return outs, auxu, grads
+
+        self._jit_infer = jit_infer
+        self._jit_train = jit_train
+        self._jit_train_step = train_step
+
     # -- binding constructors ---------------------------------------------
     @staticmethod
     def _simple_bind(symbol, ctx, grad_req, type_dict, shape_kwargs,
-                     shared_exec=None):
+                     shared_exec=None, group2ctx=None):
         shapes = {k: tuple(v) for k, v in shape_kwargs.items()}
         _, var_sh = _infer_shapes(symbol, shapes)
         type_dict = type_dict or {}
@@ -167,10 +209,12 @@ class Executor:
         grad_dict = {n: nd_zeros(var_sh[n], ctx=ctx,
                                  dtype=type_dict.get(n, "float32"))
                      for n in arg_dict if reqs.get(n, "null") != "null"}
-        return Executor(symbol, ctx, arg_dict, grad_dict, aux_dict, reqs)
+        return Executor(symbol, ctx, arg_dict, grad_dict, aux_dict, reqs,
+                        group2ctx=group2ctx)
 
     @staticmethod
-    def _bind(symbol, ctx, args, args_grad, grad_req, aux_states):
+    def _bind(symbol, ctx, args, args_grad, grad_req, aux_states,
+              group2ctx=None):
         arg_names = symbol.list_arguments()
         if isinstance(args, (list, tuple)):
             arg_dict = dict(zip(arg_names, [_as_nd(a) for a in args]))
@@ -192,7 +236,8 @@ class Executor:
         for n in aux_names:
             if n not in aux_dict:
                 raise MXNetError("missing auxiliary state %r" % n)
-        return Executor(symbol, ctx, arg_dict, grad_dict, aux_dict, grad_req)
+        return Executor(symbol, ctx, arg_dict, grad_dict, aux_dict, grad_req,
+                        group2ctx=group2ctx)
 
     # -- properties --------------------------------------------------------
     @property
@@ -251,8 +296,15 @@ class Executor:
             self.aux_dict[n]._data = v
         self.outputs = [NDArray(o) for o in outs]
         if self._monitor is not None:
-            for name, val in zip(self._symbol.list_outputs(), self.outputs):
-                self._monitor(name, val)
+            if getattr(self, "_monitor_all", False):
+                taps = self._monitor_taps(self._arg_map(),
+                                          self._aux_map(), key, is_train)
+                for name in sorted(taps):
+                    self._monitor(name, NDArray(taps[name]))
+            else:
+                for name, val in zip(self._symbol.list_outputs(),
+                                     self.outputs):
+                    self._monitor(name, val)
         return self.outputs
 
     def backward(self, out_grads=None, is_train=True):
@@ -331,7 +383,51 @@ class Executor:
         return ex
 
     def set_monitor_callback(self, callback, monitor_all=False):
+        """Install a per-op output tap (reference:
+        MXExecutorSetMonitorCallback / graph_executor.cc:104,1295).
+        With monitor_all, forward also reports every interior node's
+        outputs, not just the graph outputs."""
         self._monitor = callback
+        self._monitor_all = monitor_all
+        self._jit_monitor = {}
+
+    def _monitor_taps(self, arg_map, aux_map, key, is_train):
+        """Evaluate the graph returning {tap_name: value} for every op
+        node output (compiled once per training mode)."""
+        if self._jit_monitor.get(is_train) is None:
+            order = self._symbol._topo()
+
+            def tap_eval(arg_map, aux_map, key):
+                vals = {}
+                taps = {}
+                for pos, node in enumerate(order):
+                    if node.is_var:
+                        vals[(id(node), 0)] = arg_map.get(
+                            node.name, aux_map.get(node.name))
+                        continue
+                    op = node.op
+                    ins = [vals[(id(s), i)] for (s, i) in node.inputs]
+                    params = node.params
+                    if "training" in op.param_names:
+                        params = dict(params, training=is_train)
+                    if op.needs_rng:
+                        out = op.fn(jax.random.fold_in(key, pos), *ins,
+                                    **params)
+                    else:
+                        out = op.fn(*ins, **params)
+                    if not isinstance(out, tuple):
+                        out = (out,)
+                    for i, o in enumerate(out):
+                        vals[(id(node), i)] = o
+                    n_vis = op.n_visible(node.params)
+                    for i in range(n_vis):
+                        nm = node.name + ("_output" if n_vis == 1
+                                          else "_output%d" % i)
+                        taps[nm] = out[i]
+                return taps
+
+            self._jit_monitor[is_train] = jax.jit(tap_eval)
+        return self._jit_monitor[is_train](arg_map, aux_map, key)
 
     def debug_str(self):
         lines = ["Symbol outputs: %s" % self._symbol.list_outputs()]

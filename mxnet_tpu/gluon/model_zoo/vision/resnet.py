@@ -1,8 +1,10 @@
-"""ResNet v1/v2 (reference capability: gluon/model_zoo/vision/resnet.py —
-resnet18-152, the framework's north-star model; architecture from
-He et al. 2015/2016, implemented TPU-first: BN+ReLU chains fuse into the
-surrounding convs under XLA, and the whole network compiles to one
-program under hybridize/ParallelTrainer).
+"""ResNet v1/v2 (capability parity with the reference zoo's
+resnet18-152; architecture from He et al. 2015 "Deep Residual Learning"
+and 2016 "Identity Mappings").  The reference's four block classes
+collapse into one `ResidualUnit` parameterized by (bottleneck, pre_act):
+v1 is conv-BN-ReLU with post-addition activation, v2 is the pre-activation
+variant.  Under hybridize/ParallelTrainer the whole network compiles to a
+single XLA program; BN+ReLU chains fuse into the surrounding convs.
 """
 
 from __future__ import annotations
@@ -16,284 +18,186 @@ __all__ = ["ResNetV1", "ResNetV2", "BasicBlockV1", "BasicBlockV2",
            "resnet34_v2", "resnet50_v2", "resnet101_v2", "resnet152_v2",
            "get_resnet"]
 
-
-def _conv3x3(channels, stride, in_channels):
-    return nn.Conv2D(channels, kernel_size=3, strides=stride, padding=1,
-                     use_bias=False, in_channels=in_channels)
-
-
-class BasicBlockV1(HybridBlock):
-    def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
-        super().__init__(**kwargs)
-        self.body = nn.HybridSequential(prefix="")
-        self.body.add(_conv3x3(channels, stride, in_channels))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(_conv3x3(channels, 1, channels))
-        self.body.add(nn.BatchNorm())
-        if downsample:
-            self.downsample = nn.HybridSequential(prefix="")
-            self.downsample.add(nn.Conv2D(channels, kernel_size=1,
-                                          strides=stride, use_bias=False,
-                                          in_channels=in_channels))
-            self.downsample.add(nn.BatchNorm())
-        else:
-            self.downsample = None
-
-    def hybrid_forward(self, F, x):
-        residual = x
-        x = self.body(x)
-        if self.downsample is not None:
-            residual = self.downsample(residual)
-        return F.Activation(residual + x, act_type="relu")
-
-
-class BottleneckV1(HybridBlock):
-    def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
-        super().__init__(**kwargs)
-        self.body = nn.HybridSequential(prefix="")
-        self.body.add(nn.Conv2D(channels // 4, kernel_size=1,
-                                strides=stride, use_bias=False))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(_conv3x3(channels // 4, 1, channels // 4))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(channels, kernel_size=1, strides=1,
-                                use_bias=False))
-        self.body.add(nn.BatchNorm())
-        if downsample:
-            self.downsample = nn.HybridSequential(prefix="")
-            self.downsample.add(nn.Conv2D(channels, kernel_size=1,
-                                          strides=stride, use_bias=False,
-                                          in_channels=in_channels))
-            self.downsample.add(nn.BatchNorm())
-        else:
-            self.downsample = None
-
-    def hybrid_forward(self, F, x):
-        residual = x
-        x = self.body(x)
-        if self.downsample is not None:
-            residual = self.downsample(residual)
-        return F.Activation(x + residual, act_type="relu")
-
-
-class BasicBlockV2(HybridBlock):
-    def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
-        super().__init__(**kwargs)
-        self.bn1 = nn.BatchNorm()
-        self.conv1 = _conv3x3(channels, stride, in_channels)
-        self.bn2 = nn.BatchNorm()
-        self.conv2 = _conv3x3(channels, 1, channels)
-        if downsample:
-            self.downsample = nn.Conv2D(channels, 1, stride,
-                                        use_bias=False,
-                                        in_channels=in_channels)
-        else:
-            self.downsample = None
-
-    def hybrid_forward(self, F, x):
-        residual = x
-        x = self.bn1(x)
-        x = F.Activation(x, act_type="relu")
-        if self.downsample is not None:
-            residual = self.downsample(x)
-        x = self.conv1(x)
-        x = self.bn2(x)
-        x = F.Activation(x, act_type="relu")
-        x = self.conv2(x)
-        return x + residual
-
-
-class BottleneckV2(HybridBlock):
-    def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
-        super().__init__(**kwargs)
-        self.bn1 = nn.BatchNorm()
-        self.conv1 = nn.Conv2D(channels // 4, kernel_size=1, strides=1,
-                               use_bias=False)
-        self.bn2 = nn.BatchNorm()
-        self.conv2 = _conv3x3(channels // 4, stride, channels // 4)
-        self.bn3 = nn.BatchNorm()
-        self.conv3 = nn.Conv2D(channels, kernel_size=1, strides=1,
-                               use_bias=False)
-        if downsample:
-            self.downsample = nn.Conv2D(channels, 1, stride,
-                                        use_bias=False,
-                                        in_channels=in_channels)
-        else:
-            self.downsample = None
-
-    def hybrid_forward(self, F, x):
-        residual = x
-        x = self.bn1(x)
-        x = F.Activation(x, act_type="relu")
-        if self.downsample is not None:
-            residual = self.downsample(x)
-        x = self.conv1(x)
-        x = self.bn2(x)
-        x = F.Activation(x, act_type="relu")
-        x = self.conv2(x)
-        x = self.bn3(x)
-        x = F.Activation(x, act_type="relu")
-        x = self.conv3(x)
-        return x + residual
-
-
-class ResNetV1(HybridBlock):
-    def __init__(self, block, layers, channels, classes=1000,
-                 thumbnail=False, **kwargs):
-        super().__init__(**kwargs)
-        assert len(layers) == len(channels) - 1
-        with self.name_scope():
-            self.features = nn.HybridSequential(prefix="")
-            if thumbnail:
-                self.features.add(_conv3x3(channels[0], 1, 0))
-            else:
-                self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
-                                            use_bias=False))
-                self.features.add(nn.BatchNorm())
-                self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(3, 2, 1))
-            for i, num_layer in enumerate(layers):
-                stride = 1 if i == 0 else 2
-                self.features.add(self._make_layer(
-                    block, num_layer, channels[i + 1], stride, i + 1,
-                    in_channels=channels[i]))
-            self.features.add(nn.GlobalAvgPool2D())
-            self.output = nn.Dense(classes, in_units=channels[-1])
-
-    def _make_layer(self, block, layers, channels, stride, stage_index,
-                    in_channels=0):
-        layer = nn.HybridSequential(prefix="stage%d_" % stage_index)
-        with layer.name_scope():
-            layer.add(block(channels, stride, channels != in_channels,
-                            in_channels=in_channels, prefix=""))
-            for _ in range(layers - 1):
-                layer.add(block(channels, 1, False, in_channels=channels,
-                                prefix=""))
-        return layer
-
-    def hybrid_forward(self, F, x):
-        x = self.features(x)
-        return self.output(x)
-
-
-class ResNetV2(HybridBlock):
-    def __init__(self, block, layers, channels, classes=1000,
-                 thumbnail=False, **kwargs):
-        super().__init__(**kwargs)
-        assert len(layers) == len(channels) - 1
-        with self.name_scope():
-            self.features = nn.HybridSequential(prefix="")
-            self.features.add(nn.BatchNorm(scale=False, center=False))
-            if thumbnail:
-                self.features.add(_conv3x3(channels[0], 1, 0))
-            else:
-                self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
-                                            use_bias=False))
-                self.features.add(nn.BatchNorm())
-                self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(3, 2, 1))
-            in_channels = channels[0]
-            for i, num_layer in enumerate(layers):
-                stride = 1 if i == 0 else 2
-                self.features.add(self._make_layer(
-                    block, num_layer, channels[i + 1], stride, i + 1,
-                    in_channels=in_channels))
-                in_channels = channels[i + 1]
-            self.features.add(nn.BatchNorm())
-            self.features.add(nn.Activation("relu"))
-            self.features.add(nn.GlobalAvgPool2D())
-            self.features.add(nn.Flatten())
-            self.output = nn.Dense(classes, in_units=in_channels)
-
-    def _make_layer(self, block, layers, channels, stride, stage_index,
-                    in_channels=0):
-        layer = nn.HybridSequential(prefix="stage%d_" % stage_index)
-        with layer.name_scope():
-            layer.add(block(channels, stride, channels != in_channels,
-                            in_channels=in_channels, prefix=""))
-            for _ in range(layers - 1):
-                layer.add(block(channels, 1, False, in_channels=channels,
-                                prefix=""))
-        return layer
-
-    def hybrid_forward(self, F, x):
-        x = self.features(x)
-        return self.output(x)
-
-
-# block type, layer counts, channel plan per depth
-resnet_spec = {
-    18: ("basic_block", [2, 2, 2, 2], [64, 64, 128, 256, 512]),
-    34: ("basic_block", [3, 4, 6, 3], [64, 64, 128, 256, 512]),
-    50: ("bottle_neck", [3, 4, 6, 3], [64, 256, 512, 1024, 2048]),
-    101: ("bottle_neck", [3, 4, 23, 3], [64, 256, 512, 1024, 2048]),
-    152: ("bottle_neck", [3, 8, 36, 3], [64, 256, 512, 1024, 2048]),
+# depth -> (bottleneck?, units per stage, stage output channels)
+_SPECS = {
+    18: (False, (2, 2, 2, 2), (64, 128, 256, 512)),
+    34: (False, (3, 4, 6, 3), (64, 128, 256, 512)),
+    50: (True, (3, 4, 6, 3), (256, 512, 1024, 2048)),
+    101: (True, (3, 4, 23, 3), (256, 512, 1024, 2048)),
+    152: (True, (3, 8, 36, 3), (256, 512, 1024, 2048)),
 }
-
-resnet_net_versions = [ResNetV1, ResNetV2]
-resnet_block_versions = [
-    {"basic_block": BasicBlockV1, "bottle_neck": BottleneckV1},
-    {"basic_block": BasicBlockV2, "bottle_neck": BottleneckV2},
-]
+_STEM_CHANNELS = 64
 
 
-def get_resnet(version, num_layers, pretrained=False, ctx=None, **kwargs):
-    assert num_layers in resnet_spec, \
-        "Invalid number of layers: %d. Options are %s" % (
-            num_layers, str(resnet_spec.keys()))
-    block_type, layers, channels = resnet_spec[num_layers]
-    assert version in (1, 2), "Invalid resnet version %d" % version
-    resnet_class = resnet_net_versions[version - 1]
-    block_class = resnet_block_versions[version - 1][block_type]
-    net = resnet_class(block_class, layers, channels, **kwargs)
+def _conv(ch, k, s, p):
+    return nn.Conv2D(ch, kernel_size=k, strides=s, padding=p,
+                     use_bias=False)
+
+
+class ResidualUnit(HybridBlock):
+    """One residual unit.
+
+    bottleneck: 1x1 -> 3x3 -> 1x1 (channels//4 inner width) vs two 3x3.
+    pre_act (v2): BN-ReLU precedes the convs and the shortcut branches
+    off the activated tensor; otherwise (v1) the classic conv-BN-ReLU
+    order with ReLU after the addition.
+    """
+
+    def __init__(self, channels, stride, in_channels, bottleneck,
+                 pre_act, **kwargs):
+        super().__init__(**kwargs)
+        self._pre_act = pre_act
+        self._project = stride != 1 or in_channels != channels
+        inner = channels // 4 if bottleneck else channels
+        if bottleneck:
+            # v1 strides the leading 1x1; v2 strides the 3x3 (matching
+            # the two He et al. papers and the reference blocks)
+            if pre_act:
+                plan = [(inner, 1, 1, 0), (inner, 3, stride, 1),
+                        (channels, 1, 1, 0)]
+            else:
+                plan = [(inner, 1, stride, 0), (inner, 3, 1, 1),
+                        (channels, 1, 1, 0)]
+        else:
+            plan = [(channels, 3, stride, 1), (channels, 3, 1, 1)]
+        with self.name_scope():
+            self.convs = []
+            self.bns = []
+            for j, (ch, k, s, p) in enumerate(plan):
+                conv = _conv(ch, k, s, p)
+                bn = nn.BatchNorm()
+                setattr(self, "conv%d" % j, conv)   # registers the child
+                setattr(self, "bn%d" % j, bn)
+                self.convs.append(conv)
+                self.bns.append(bn)
+            if self._project:
+                self.proj = _conv(channels, 1, stride, 0)
+                if not pre_act:
+                    self.proj_bn = nn.BatchNorm()
+
+    def hybrid_forward(self, F, x):
+        if self._pre_act:
+            # v2: shared BN-ReLU, shortcut off the activated tensor
+            y = F.Activation(self.bns[0](x), act_type="relu")
+            shortcut = self.proj(y) if self._project else x
+            h = self.convs[0](y)
+            for conv, bn in zip(self.convs[1:], self.bns[1:]):
+                h = conv(F.Activation(bn(h), act_type="relu"))
+            return h + shortcut
+        # v1: conv-BN(-ReLU) chain, ReLU after the addition
+        h = x
+        last = len(self.convs) - 1
+        for j, (conv, bn) in enumerate(zip(self.convs, self.bns)):
+            h = bn(conv(h))
+            if j != last:
+                h = F.Activation(h, act_type="relu")
+        shortcut = self.proj_bn(self.proj(x)) if self._project else x
+        return F.Activation(h + shortcut, act_type="relu")
+
+
+class _ResNet(HybridBlock):
+    def __init__(self, depth, pre_act, classes=1000, thumbnail=False,
+                 **kwargs):
+        super().__init__(**kwargs)
+        bottleneck, units, widths = _SPECS[depth]
+        self._pre_act = pre_act
+        with self.name_scope():
+            body = nn.HybridSequential(prefix="")
+            if pre_act:
+                body.add(nn.BatchNorm(scale=False, center=False))
+            if thumbnail:      # CIFAR-style 32x32 stem
+                body.add(_conv(_STEM_CHANNELS, 3, 1, 1))
+            else:              # ImageNet stem
+                body.add(_conv(_STEM_CHANNELS, 7, 2, 3))
+                body.add(nn.BatchNorm())
+                body.add(nn.Activation("relu"))
+                body.add(nn.MaxPool2D(3, 2, 1))
+            in_ch = _STEM_CHANNELS
+            for s, (n_units, width) in enumerate(zip(units, widths)):
+                stage = nn.HybridSequential(prefix="stage%d_" % (s + 1))
+                with stage.name_scope():
+                    for u in range(n_units):
+                        stage.add(ResidualUnit(
+                            width, 2 if (s > 0 and u == 0) else 1,
+                            in_ch, bottleneck, pre_act, prefix=""))
+                        in_ch = width
+                body.add(stage)
+            if pre_act:
+                body.add(nn.BatchNorm())
+                body.add(nn.Activation("relu"))
+            body.add(nn.GlobalAvgPool2D())
+            body.add(nn.Flatten())
+            self.features = body
+            self.output = nn.Dense(classes, in_units=in_ch)
+
+    def hybrid_forward(self, F, x):
+        return self.output(self.features(x))
+
+
+class ResNetV1(_ResNet):
+    def __init__(self, depth=50, **kwargs):
+        super().__init__(depth, pre_act=False, **kwargs)
+
+
+class ResNetV2(_ResNet):
+    def __init__(self, depth=50, **kwargs):
+        super().__init__(depth, pre_act=True, **kwargs)
+
+
+# the reference's block classes, kept as aliases for API compatibility
+def BasicBlockV1(channels, stride, downsample=False, in_channels=0,
+                 **kwargs):
+    return ResidualUnit(channels, stride, in_channels, False, False,
+                        **kwargs)
+
+
+def BasicBlockV2(channels, stride, downsample=False, in_channels=0,
+                 **kwargs):
+    return ResidualUnit(channels, stride, in_channels, False, True,
+                        **kwargs)
+
+
+def BottleneckV1(channels, stride, downsample=False, in_channels=0,
+                 **kwargs):
+    return ResidualUnit(channels, stride, in_channels, True, False,
+                        **kwargs)
+
+
+def BottleneckV2(channels, stride, downsample=False, in_channels=0,
+                 **kwargs):
+    return ResidualUnit(channels, stride, in_channels, True, True,
+                        **kwargs)
+
+
+def get_resnet(version, num_layers, pretrained=False, ctx=None,
+               **kwargs):
+    if num_layers not in _SPECS:
+        raise ValueError("no resnet-%s; depths: %s"
+                         % (num_layers, sorted(_SPECS)))
+    if version not in (1, 2):
+        raise ValueError("resnet version must be 1 or 2")
     if pretrained:
         raise ValueError("pretrained weights are unavailable in this "
                          "zero-egress build; load_parameters() manually")
-    return net
+    cls = ResNetV1 if version == 1 else ResNetV2
+    return cls(num_layers, **kwargs)
 
 
-def resnet18_v1(**kwargs):
-    return get_resnet(1, 18, **kwargs)
+def _factory(version, depth):
+    def make(**kwargs):
+        return get_resnet(version, depth, **kwargs)
+    make.__name__ = "resnet%d_v%d" % (depth, version)
+    make.__doc__ = "ResNet-%d v%d" % (depth, version)
+    return make
 
 
-def resnet34_v1(**kwargs):
-    return get_resnet(1, 34, **kwargs)
-
-
-def resnet50_v1(**kwargs):
-    return get_resnet(1, 50, **kwargs)
-
-
-def resnet101_v1(**kwargs):
-    return get_resnet(1, 101, **kwargs)
-
-
-def resnet152_v1(**kwargs):
-    return get_resnet(1, 152, **kwargs)
-
-
-def resnet18_v2(**kwargs):
-    return get_resnet(2, 18, **kwargs)
-
-
-def resnet34_v2(**kwargs):
-    return get_resnet(2, 34, **kwargs)
-
-
-def resnet50_v2(**kwargs):
-    return get_resnet(2, 50, **kwargs)
-
-
-def resnet101_v2(**kwargs):
-    return get_resnet(2, 101, **kwargs)
-
-
-def resnet152_v2(**kwargs):
-    return get_resnet(2, 152, **kwargs)
+resnet18_v1 = _factory(1, 18)
+resnet34_v1 = _factory(1, 34)
+resnet50_v1 = _factory(1, 50)
+resnet101_v1 = _factory(1, 101)
+resnet152_v1 = _factory(1, 152)
+resnet18_v2 = _factory(2, 18)
+resnet34_v2 = _factory(2, 34)
+resnet50_v2 = _factory(2, 50)
+resnet101_v2 = _factory(2, 101)
+resnet152_v2 = _factory(2, 152)

@@ -1,5 +1,8 @@
-"""SqueezeNet 1.0/1.1 (reference capability: model_zoo/vision/
-squeezenet.py; Iandola et al. 2016)."""
+"""SqueezeNet 1.0/1.1 (capability parity with the reference zoo;
+Iandola et al. 2016).  Written plan-table-first: each version is a flat
+layer plan — "fire" entries expand to one Fire module (squeeze 1x1 +
+parallel 1x1/3x3 expands, concatenated on channels).
+"""
 
 from __future__ import annotations
 
@@ -8,77 +11,64 @@ from ... import nn
 
 __all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
 
-
-def _make_fire(squeeze_channels, expand1x1_channels, expand3x3_channels):
-    out = nn.HybridSequential(prefix="")
-    out.add(_make_fire_conv(squeeze_channels, 1))
-    paths = _FireExpand(expand1x1_channels, expand3x3_channels)
-    out.add(paths)
-    return out
-
-
-def _make_fire_conv(channels, kernel_size, padding=0):
-    out = nn.HybridSequential(prefix="")
-    out.add(nn.Conv2D(channels, kernel_size, padding=padding))
-    out.add(nn.Activation("relu"))
-    return out
+# fire s: squeeze width s, expands (4*s, 4*s) — the paper's e=4s ratio
+_PLANS = {
+    "1.0": [("conv", 96, 7), "pool",
+            ("fire", 16), ("fire", 16), ("fire", 32), "pool",
+            ("fire", 32), ("fire", 48), ("fire", 48), ("fire", 64),
+            "pool", ("fire", 64)],
+    "1.1": [("conv", 64, 3), "pool",
+            ("fire", 16), ("fire", 16), "pool",
+            ("fire", 32), ("fire", 32), "pool",
+            ("fire", 48), ("fire", 48), ("fire", 64), ("fire", 64)],
+}
 
 
-class _FireExpand(HybridBlock):
-    def __init__(self, e1, e3, **kwargs):
+class Fire(HybridBlock):
+    """squeeze(1x1) -> [expand1x1 | expand3x3] -> concat."""
+
+    def __init__(self, squeeze, **kwargs):
         super().__init__(**kwargs)
+        expand = 4 * squeeze
         with self.name_scope():
-            self.p1 = _make_fire_conv(e1, 1)
-            self.p3 = _make_fire_conv(e3, 3, 1)
+            self.squeeze = nn.Conv2D(squeeze, kernel_size=1)
+            self.left = nn.Conv2D(expand, kernel_size=1)
+            self.right = nn.Conv2D(expand, kernel_size=3, padding=1)
 
     def hybrid_forward(self, F, x):
-        return F.Concat(self.p1(x), self.p3(x), dim=1)
+        s = F.relu(self.squeeze(x))
+        return F.concat(F.relu(self.left(s)), F.relu(self.right(s)),
+                        dim=1)
 
 
 class SqueezeNet(HybridBlock):
     def __init__(self, version, classes=1000, **kwargs):
         super().__init__(**kwargs)
-        assert version in ("1.0", "1.1")
+        if version not in _PLANS:
+            raise ValueError("version must be one of %s"
+                             % sorted(_PLANS))
         with self.name_scope():
-            self.features = nn.HybridSequential(prefix="")
-            if version == "1.0":
-                self.features.add(nn.Conv2D(96, 7, 2))
-                self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
-                self.features.add(_make_fire(16, 64, 64))
-                self.features.add(_make_fire(16, 64, 64))
-                self.features.add(_make_fire(32, 128, 128))
-                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
-                self.features.add(_make_fire(32, 128, 128))
-                self.features.add(_make_fire(48, 192, 192))
-                self.features.add(_make_fire(48, 192, 192))
-                self.features.add(_make_fire(64, 256, 256))
-                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
-                self.features.add(_make_fire(64, 256, 256))
-            else:
-                self.features.add(nn.Conv2D(64, 3, 2))
-                self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
-                self.features.add(_make_fire(16, 64, 64))
-                self.features.add(_make_fire(16, 64, 64))
-                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
-                self.features.add(_make_fire(32, 128, 128))
-                self.features.add(_make_fire(32, 128, 128))
-                self.features.add(nn.MaxPool2D(3, 2, ceil_mode=True))
-                self.features.add(_make_fire(48, 192, 192))
-                self.features.add(_make_fire(48, 192, 192))
-                self.features.add(_make_fire(64, 256, 256))
-                self.features.add(_make_fire(64, 256, 256))
-            self.features.add(nn.Dropout(0.5))
-            self.output = nn.HybridSequential(prefix="")
-            self.output.add(nn.Conv2D(classes, kernel_size=1))
-            self.output.add(nn.Activation("relu"))
-            self.output.add(nn.GlobalAvgPool2D())
-            self.output.add(nn.Flatten())
+            f = nn.HybridSequential(prefix="")
+            for step in _PLANS[version]:
+                if step == "pool":
+                    f.add(nn.MaxPool2D(3, 2, ceil_mode=True))
+                elif step[0] == "conv":
+                    f.add(nn.Conv2D(step[1], kernel_size=step[2],
+                                    strides=2))
+                    f.add(nn.Activation("relu"))
+                else:
+                    f.add(Fire(step[1]))
+            f.add(nn.Dropout(0.5))
+            self.features = f
+            head = nn.HybridSequential(prefix="")
+            head.add(nn.Conv2D(classes, kernel_size=1))
+            head.add(nn.Activation("relu"))
+            head.add(nn.GlobalAvgPool2D())
+            head.add(nn.Flatten())
+            self.output = head
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        return self.output(x)
+        return self.output(self.features(x))
 
 
 def squeezenet1_0(**kwargs):

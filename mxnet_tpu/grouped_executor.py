@@ -1,0 +1,228 @@
+"""Manual model parallelism — honoring ``group2ctx`` / ``ctx_group``.
+
+Reference capability: `src/executor/graph_executor.cc:897-906`
+(`AssignContext` maps each node's ``ctx_group`` attr to a device;
+cross-device edges become `kCrossDeviceCopy` ops, `:1347-1351`) with the
+Python surface `symbol.simple_bind(group2ctx=...)`
+(`python/mxnet/symbol/symbol.py:1290-1439`).
+
+TPU-native design: the graph is partitioned into maximal same-device
+segments in topological order.  Each segment compiles to one jitted
+function pinned to its device (arrays are committed there, so XLA runs
+the program on that chip); boundary values are `jax.device_put`
+transfers — the explicit equivalent of kCrossDeviceCopy.  Backward
+chains the per-segment VJPs in reverse, transferring cotangents across
+the same boundaries.  Because JAX dispatch is async, consecutive
+segments on different devices overlap exactly like the reference's
+engine-scheduled cross-device pipeline.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .context import Context
+
+
+def assign_contexts(symbol, group2ctx, default_ctx):
+    """Per-node Context from ctx_group attrs (reference AssignContext).
+    Variables inherit the context of their first consumer."""
+    group2ctx = {k: Context(v) for k, v in (group2ctx or {}).items()}
+    order = symbol._topo()
+    node_ctx = {}
+    for node in order:
+        grp = node.attrs.get("ctx_group")
+        if grp is not None:
+            if grp not in group2ctx:
+                raise MXNetError(
+                    "ctx_group %r has no entry in group2ctx %s"
+                    % (grp, sorted(group2ctx)))
+            node_ctx[id(node)] = group2ctx[grp]
+        elif not node.is_var:
+            node_ctx[id(node)] = default_ctx
+    for node in order:
+        if node.is_var:
+            continue
+        for src, _ in node.inputs:
+            if src.is_var and id(src) not in node_ctx:
+                node_ctx[id(src)] = node_ctx[id(node)]
+    for node in order:
+        node_ctx.setdefault(id(node), default_ctx)
+    return node_ctx
+
+
+class _Segment:
+    __slots__ = ("nodes", "ctx", "in_entries", "out_entries", "fn",
+                 "index")
+
+    def __init__(self, nodes, ctx, index):
+        self.nodes = nodes
+        self.ctx = ctx
+        self.index = index
+
+
+def _partition(symbol, node_ctx):
+    """Maximal same-context runs of op nodes in topo order."""
+    order = [n for n in symbol._topo() if not n.is_var]
+    segments = []
+    for node in order:
+        ctx = node_ctx[id(node)]
+        if segments and segments[-1].ctx == ctx:
+            segments[-1].nodes.append(node)
+        else:
+            segments.append(_Segment([node], ctx, len(segments)))
+    return segments
+
+
+def build_grouped_eval(symbol, group2ctx, default_ctx, training,
+                       aux_names):
+    """Compile the segment chain.  Returns
+    run(arg_map, aux_map, key, want_vjp) ->
+        (outputs, aux_updates, vjp_chain_or_None)."""
+    node_ctx = assign_contexts(symbol, group2ctx, default_ctx)
+    segments = _partition(symbol, node_ctx)
+    out_entries = [(id(n), i) for n, i in symbol._outputs]
+    aux_set = set(aux_names)
+
+    # which entries cross segment boundaries
+    producer_seg = {}
+    for seg in segments:
+        for node in seg.nodes:
+            for i in range(node.num_outputs()):
+                producer_seg[(id(node), i)] = seg.index
+
+    var_nodes = {}
+    for node in symbol._topo():
+        if node.is_var:
+            var_nodes[(id(node), 0)] = node
+
+    aux_update_entries = {}   # aux name -> entry of updated value
+    for seg in segments:
+        needed = set()
+        produced = set()
+        for node in seg.nodes:
+            for (src, idx) in node.inputs:
+                e = (id(src), idx)
+                if e not in produced:
+                    needed.add(e)
+            for i in range(node.num_outputs()):
+                produced.add((id(node), i))
+            if training and node.op.aux_states:
+                for in_idx, out_idx in node.op.aux_states.items():
+                    src, _ = node.inputs[in_idx]
+                    if src.is_var and src.name in aux_set:
+                        aux_update_entries[src.name] = (id(node), out_idx)
+        seg.in_entries = sorted(needed)
+        exported = set(out_entries) | set(aux_update_entries.values())
+        for later in segments[seg.index + 1:]:
+            for node in later.nodes:
+                for (src, idx) in node.inputs:
+                    exported.add((id(src), idx))
+        seg.out_entries = sorted(e for e in produced if e in exported)
+
+        seg.fn = _make_segment_fn(seg, training)
+
+    def run(arg_map, aux_map, key, want_vjp):
+        env = {}
+        for e, node in var_nodes.items():
+            name = node.name
+            if name in arg_map:
+                v = arg_map[name]
+            elif name in aux_map:
+                v = aux_map[name]
+            else:
+                raise MXNetError("unbound variable %r" % name)
+            env[e] = jax.device_put(v, node_ctx[id(node)].jax_device)
+        vjps = []
+        for seg in segments:
+            dev = seg.ctx.jax_device
+            ins = tuple(jax.device_put(env[e], dev)
+                        for e in seg.in_entries)
+            sub = jax.random.fold_in(key, seg.index)
+            if want_vjp:
+                outs, vjp = jax.vjp(lambda *a: seg.fn(sub, *a), *ins)
+                vjps.append((seg, vjp,
+                             [(o.shape, o.dtype) for o in outs]))
+            else:
+                outs = seg.fn(sub, *ins)
+            env.update(zip(seg.out_entries, outs))
+        outputs = [env[e] for e in out_entries]
+        aux_updates = {n: env[e] for n, e in aux_update_entries.items()}
+        return outputs, aux_updates, (vjps if want_vjp else None)
+
+    def backward(env_run, out_cots):
+        """Chain per-segment VJPs in reverse.  env_run = (vjps from run);
+        out_cots aligned with symbol outputs.  Returns {var_name: grad}."""
+        vjps = env_run
+        cot = {}
+        for e, c in zip(out_entries, out_cots):
+            if c is not None:
+                cot[e] = cot.get(e, 0) + c
+        var_grads = {}
+        for seg, vjp, out_avals in reversed(vjps):
+            seg_cots = []
+            need = False
+            for e, (shape, dtype) in zip(seg.out_entries, out_avals):
+                c = cot.pop(e, None)
+                if c is None:
+                    seg_cots.append(None)
+                else:
+                    need = True
+                    seg_cots.append(c.astype(dtype))
+            if not need:
+                continue
+            # materialize Nones as zeros (vjp wants the full pytree)
+            seg_cots = tuple(
+                c if c is not None else jnp.zeros(shape, dtype)
+                for c, (shape, dtype) in zip(seg_cots, out_avals))
+            in_cots = vjp(seg_cots)
+            for e, c in zip(seg.in_entries, in_cots):
+                if e in var_nodes:
+                    name = var_nodes[e].name
+                    dev = node_ctx[id(var_nodes[e])].jax_device
+                    c = jax.device_put(c, dev)
+                    if name in var_grads:
+                        var_grads[name] = var_grads[name] + c
+                    else:
+                        var_grads[name] = c
+                else:
+                    prod_dev = segments[producer_seg[e]].ctx.jax_device
+                    c = jax.device_put(c, prod_dev)
+                    if e in cot:
+                        cot[e] = cot[e] + c
+                    else:
+                        cot[e] = c
+        return var_grads
+
+    return run, backward, segments
+
+
+def _make_segment_fn(seg, training):
+    """Jitted pure function for one segment:
+    fn(key, *in_values) -> out_values."""
+    nodes = seg.nodes
+    in_entries = seg.in_entries
+    out_entries = seg.out_entries
+
+    def fn(key, *ins):
+        vals = dict(zip(in_entries, ins))
+        for pos, node in enumerate(nodes):
+            op = node.op
+            arrs = [vals[(id(s), i)] for (s, i) in node.inputs]
+            params = node.params
+            if "training" in op.param_names:
+                params = dict(params, training=training)
+            if op.needs_rng:
+                sub = jax.random.fold_in(key, pos)
+                out = op.fn(sub, *arrs, **params)
+            else:
+                out = op.fn(*arrs, **params)
+            if not isinstance(out, tuple):
+                out = (out,)
+            for i, o in enumerate(out):
+                vals[(id(node), i)] = o
+        return tuple(vals[e] for e in out_entries)
+
+    return jax.jit(fn)

@@ -36,7 +36,7 @@ class _ExecGroup:
 
     def __init__(self, symbol, contexts, data_names, label_names,
                  data_shapes, label_shapes, grad_req, fixed_param_names,
-                 inputs_need_grad, shared_group=None):
+                 inputs_need_grad, shared_group=None, group2ctxs=None):
         self.symbol = symbol
         self.contexts = contexts
         self.data_names = list(data_names)
@@ -73,8 +73,15 @@ class _ExecGroup:
             for name, shape in (label_shapes or []):
                 shapes[name] = (self.slice_size,) + tuple(shape[1:])
             shared = shared_group.execs[i] if shared_group else None
+            g2c = None
+            if group2ctxs:
+                # per-device group maps (reference: group2ctxs is a list
+                # of dicts, one per data-parallel context)
+                g2c = group2ctxs[i] if isinstance(group2ctxs, list) \
+                    else group2ctxs
             ex = symbol.simple_bind(ctx=ctx, grad_req=reqs,
-                                    shared_exec=shared, **shapes)
+                                    shared_exec=shared, group2ctx=g2c,
+                                    **shapes)
             self.execs.append(ex)
 
     def _slices(self, arrs):
@@ -177,6 +184,7 @@ class Module(BaseModule):
         self._data_names = list(data_names or [])
         self._label_names = list(label_names or [])
         self._fixed_param_names = list(fixed_param_names or [])
+        self._group2ctxs = group2ctxs
         self._exec_group = None
         self._arg_params = None
         self._aux_params = None
@@ -263,7 +271,8 @@ class Module(BaseModule):
             self._symbol, self._context, self._data_names,
             self._label_names, self._data_shapes, self._label_shapes,
             grad_req if for_training else "null",
-            self._fixed_param_names, inputs_need_grad)
+            self._fixed_param_names, inputs_need_grad,
+            group2ctxs=self._group2ctxs)
         self.binded = True
         if self._arg_params is not None:
             self._set_exec_params(self._arg_params, self._aux_params)
@@ -403,7 +412,7 @@ class Module(BaseModule):
     def install_monitor(self, mon):
         assert self.binded
         for ex in self._exec_group.execs:
-            ex.set_monitor_callback(mon)
+            mon.install(ex)
 
     def reshape(self, data_shapes, label_shapes=None):
         assert self.binded

@@ -1,0 +1,86 @@
+"""Monitor — per-op output inspection during training.
+
+Reference capability: `python/mxnet/monitor.py:33` (Monitor installs an
+executor callback via MXExecutorSetMonitorCallback; tic/toc collect
+(step, op_name, stat) tuples each interval and toc_print logs them).
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+
+from .ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    """Collect statistics of every op's outputs each *interval* batches.
+
+    Parameters
+    ----------
+    interval : int — batches between collections
+    stat_func : NDArray -> NDArray/scalar (default: mean(abs(x)))
+    pattern : regex on tap names
+    sort : sort output by name
+    monitor_all : tap interior ops too, not just graph outputs
+    """
+
+    def __init__(self, interval, stat_func=None, pattern=".*",
+                 sort=False, monitor_all=True):
+        if stat_func is None:
+            def stat_func(x):
+                return x.abs().mean() if hasattr(x, "abs") else x
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+        self.monitor_all = monitor_all
+
+        def stat_helper(name, arr):
+            if not self.activated or not self.re_prog.match(name):
+                return
+            self.queue.append((self.step, name, self.stat_func(arr)))
+
+        self.stat_helper = stat_helper
+
+    def install(self, exe):
+        """Attach to an executor (reference: monitor.py install)."""
+        exe.set_monitor_callback(self.stat_helper,
+                                 monitor_all=self.monitor_all)
+        self.exes.append(exe)
+
+    def tic(self):
+        """Start collecting for this batch if the interval hits."""
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        """Stop collecting; return [(step, name, stat_string)]."""
+        if not self.activated:
+            return []
+        self.activated = False
+        res = []
+        for exe in self.exes:
+            for arr in exe.arg_dict.values():
+                if isinstance(arr, NDArray):
+                    arr.wait_to_read()
+        for step, name, stat in self.queue:
+            if isinstance(stat, NDArray):
+                stat = stat.asnumpy()
+            res.append((step, name, str(stat)))
+        if self.sort:
+            res.sort(key=lambda x: x[1])
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        for step, name, stat in self.toc():
+            logging.info("Batch: %7d %30s %s", step, name, stat)

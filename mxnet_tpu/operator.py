@@ -1,0 +1,163 @@
+"""Custom-op bridge — user Python operators inside compiled graphs.
+
+Reference capability: `python/mxnet/operator.py` (1,101 LoC: CustomOp /
+CustomOpProp / register + callback trampolines into
+`src/operator/custom/custom-inl.h`, which runs user Python on a
+dedicated worker thread so the engine never blocks on the GIL).
+
+TPU-native design: the user's `forward`/`backward` run on host via
+`jax.pure_callback`, which XLA schedules like any other op — the
+device-side program stalls only at the data dependency, the reference's
+dedicated-thread behavior falling out of XLA's async host callbacks.
+Gradients wire through `jax.custom_vjp`, so Custom ops compose with
+autograd, the whole-graph executor, and hybridize.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_prop"]
+
+_REGISTRY = {}
+
+
+class CustomOp:
+    """Base class for user operators (reference: operator.py CustomOp)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Write *src* into *dst* honoring the grad request
+        (reference: CustomOp.assign)."""
+        if req == "null":
+            return
+        if req in ("write", "inplace"):
+            dst[:] = src
+        elif req == "add":
+            dst[:] = dst + src
+
+
+class CustomOpProp:
+    """Operator properties: arity, shapes, types
+    (reference: operator.py CustomOpProp)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return (in_type, [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+
+def register(reg_name):
+    """Class decorator registering a CustomOpProp subclass under
+    *reg_name* (reference: operator.py register)."""
+    def do_register(prop_cls):
+        _REGISTRY[reg_name] = prop_cls
+        return prop_cls
+    return do_register
+
+
+def get_prop(op_type):
+    if op_type not in _REGISTRY:
+        raise MXNetError(
+            "custom op %r is not registered (use "
+            "@mxnet_tpu.operator.register(%r) on a CustomOpProp)"
+            % (op_type, op_type))
+    return _REGISTRY[op_type]
+
+
+def _np_wrap(arrs):
+    """Wrap numpy arrays as NDArrays for the user callback."""
+    from .ndarray import NDArray
+    return [NDArray(jnp.asarray(a)) for a in arrs]
+
+
+@functools.lru_cache(maxsize=None)
+def _build_custom(op_type, frozen_kwargs, in_shapes, in_dtypes):
+    """Compile-cached custom-vjp callable for one (op, signature)."""
+    kwargs = dict(frozen_kwargs)
+    prop = get_prop(op_type)(**kwargs)
+    n_out = len(prop.list_outputs())
+    shapes_in = [tuple(s) for s in in_shapes]
+    sh_in, sh_out, _ = prop.infer_shape([list(s) for s in shapes_in])
+    ty_in, ty_out, _ = prop.infer_type(list(in_dtypes))
+    out_spec = tuple(jax.ShapeDtypeStruct(tuple(s), t)
+                     for s, t in zip(sh_out, ty_out))
+    in_spec = tuple(jax.ShapeDtypeStruct(tuple(s), t)
+                    for s, t in zip(sh_in, ty_in))
+    op_inst = prop.create_operator(None, sh_in, ty_in)
+
+    def fwd_cb(*ins):
+        in_nd = _np_wrap(ins)
+        out_nd = _np_wrap([_np.zeros(s, t)
+                           for s, t in zip(sh_out, ty_out)])
+        op_inst.forward(True, ["write"] * n_out, in_nd, out_nd, [])
+        return tuple(o.asnumpy() for o in out_nd)
+
+    def bwd_cb(*flat):
+        n_in = len(in_spec)
+        ins = flat[:n_in]
+        outs = flat[n_in:n_in + n_out]
+        cots = flat[n_in + n_out:]
+        in_nd = _np_wrap(ins)
+        out_nd = _np_wrap(outs)
+        cot_nd = _np_wrap(cots)
+        grad_nd = _np_wrap([_np.zeros(s, t)
+                            for s, t in zip(sh_in, ty_in)])
+        op_inst.backward(["write"] * len(in_spec), cot_nd, in_nd,
+                         out_nd, grad_nd, [])
+        return tuple(g.asnumpy() for g in grad_nd)
+
+    @jax.custom_vjp
+    def run(*ins):
+        return jax.pure_callback(fwd_cb, out_spec, *ins)
+
+    def run_fwd(*ins):
+        outs = run(*ins)
+        return outs, (ins, outs)
+
+    def run_bwd(res, cots):
+        ins, outs = res
+        return jax.pure_callback(bwd_cb, in_spec, *ins, *outs, *cots)
+
+    run.defvjp(run_fwd, run_bwd)
+    return run
+
+
+def invoke_custom(inputs, op_type, **kwargs):
+    """Entry used by the registered 'Custom' op."""
+    shapes = tuple(tuple(x.shape) for x in inputs)
+    dtypes = tuple(_np.dtype(x.dtype) for x in inputs)
+    frozen = tuple(sorted(kwargs.items()))
+    fn = _build_custom(op_type, frozen, shapes, dtypes)
+    return fn(*inputs)

@@ -559,11 +559,19 @@ def _make_loss(x):
     return x * 1.0
 
 
-@register_op("Custom")
-def _custom_unsupported(*args, **kwargs):
-    raise NotImplementedError(
-        "Custom ops are registered via mxnet_tpu.operator.register "
-        "(python bridge), not invoked through the registry")
+def _custom_nout(params):
+    from ..operator import get_prop
+    prop = get_prop(params.get("op_type"))
+    extra = {k: v for k, v in params.items() if k != "op_type"}
+    return len(prop(**extra).list_outputs())
+
+
+@register_op("Custom", num_outputs=_custom_nout)
+def _custom(*inputs, op_type=None, **kwargs):
+    """User Python op via the pure_callback bridge
+    (see mxnet_tpu/operator.py; reference: src/operator/custom/)."""
+    from ..operator import invoke_custom
+    return invoke_custom(inputs, op_type, **kwargs)
 
 
 # ---------------------------------------------------------------------------

@@ -20,6 +20,7 @@ executor differentiates the whole fused program.
 from __future__ import annotations
 
 import functools
+import time as _time
 
 import jax
 import numpy as _np
@@ -235,9 +236,25 @@ def invoke(op, args, params, rng=None):
     if isinstance(op, str):
         op = get_op(op)
     static, dyn, frozen = split_params(op, params)
+    # inputs spanning devices (model-parallel grads vs weights): move all
+    # onto the first input's device — the reference's implicit
+    # CopyFromTo at op boundaries (ndarray.cc:1184)
+    devs = set()
+    for a in args:
+        if hasattr(a, "devices"):
+            devs.update(a.devices())
+    if len(devs) > 1:
+        target = next(iter(args[0].devices()))
+        args = [jax.device_put(a, target)
+                if hasattr(a, "devices") and target not in a.devices()
+                else a for a in args]
     donate = tuple(i + 1 for i in op.donate) if (op.needs_rng and op.donate) \
         else op.donate
     fn = _compiled(op.name, frozen, tuple(sorted(dyn)), donate)
+    from .. import profiler as _prof
+    profiling = _prof.is_running() and \
+        _prof._config["profile_imperative"]
+    t0 = _time.perf_counter() if profiling else 0.0
     if op.needs_rng:
         if rng is None:
             from ..runtime import rng as _rng
@@ -247,4 +264,9 @@ def invoke(op, args, params, rng=None):
         out = fn(*args, **dyn)
     if not isinstance(out, tuple):
         out = (out,)
+    if profiling:
+        # block so the span is real execution, not async dispatch
+        # (the reference profiles the engine worker for the same reason)
+        jax.block_until_ready(out)
+        _prof.record_span(op.name, "operator", t0, _time.perf_counter())
     return out

@@ -10,8 +10,14 @@ Parameters/optimizer state are replicated (or dp-sharded, ZeRO-style, with
 SPMD partitioner inserts the psum over ICI where the gradients meet the
 replicated parameters.  Buffer donation makes updates in-place in HBM.
 
-This is what `bench.py` and `__graft_entry__.dryrun_multichip` run, and what
-Gluon's Trainer uses when constructed with ``kvstore='tpu'``.
+Supports every fused update op in ops/optimizer_ops.py, bf16
+multi-precision training (bf16 compute weights + f32 master copies via
+the mp_sgd ops' scheme — reference optimizer_op.cc mp_sgd), and
+LARS/LBSGD layer-wise adaptive rates (reference optimizer.py:678) — the
+ResNet-50 north-star configuration.
+
+This is what `bench.py` and `__graft_entry__.dryrun_multichip` run, and
+what Gluon's Trainer uses when constructed with ``kvstore='tpu'``.
 """
 
 from __future__ import annotations
@@ -21,19 +27,32 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .mesh import make_mesh
-from .. import autograd
 from ..ndarray import NDArray
 
 __all__ = ["ParallelTrainer"]
 
 
+# optimizer name -> (update op, number of zero-init states).
+# State layout convention of the fused ops: fn(weight, grad, *states,
+# **hyper) -> (new_weight, *new_states).
 _OPT_OPS = {
-    # optimizer name -> (update op name, state factory)
-    "sgd": ("sgd_update", lambda w: ()),
-    "sgd_mom": ("sgd_mom_update", lambda w: (jnp.zeros_like(w),)),
-    "adam": ("adam_update", lambda w: (jnp.zeros_like(w),
-                                       jnp.zeros_like(w))),
+    "sgd": ("sgd_update", 0),
+    "sgd_mom": ("sgd_mom_update", 1),
+    "nag": ("nag_mom_update", 1),
+    "adam": ("adam_update", 2),
+    "rmsprop": ("rmsprop_update", 1),
+    "rmspropalex": ("rmspropalex_update", 3),
+    "ftrl": ("ftrl_update", 2),
+    "ftml": ("ftml_update", 3),
+    "signum": ("signum_update", 1),
+    "signsgd": ("signsgd_update", 0),
+    "adadelta": ("adadelta_update", 2),
+    "adamax": ("adamax_update", 2),
+    "nadam": ("nadam_update", 2),
 }
+
+# LARS-family: layer-wise trust ratio scaling wrapped around momentum sgd
+_LARS_NAMES = ("lars", "lbsgd")
 
 
 class ParallelTrainer:
@@ -42,18 +61,21 @@ class ParallelTrainer:
 
     Parameters
     ----------
-    net : HybridBlock (will be traced symbolically, like hybridize)
+    net : HybridBlock (traced symbolically, like hybridize)
     loss : gluon loss HybridBlock
-    optimizer : 'sgd' | 'adam' (+ hyperparams via optimizer_params);
-        momentum>0 selects the momentum kernel
+    optimizer : any name in ops/optimizer_ops.py ('sgd', 'adam',
+        'rmsprop', ...) or 'lars'/'lbsgd'; momentum>0 upgrades sgd to
+        the momentum kernel
     mesh : jax Mesh (default: all devices on one 'dp' axis)
-    shard_params : if True, parameters and optimizer state are sharded
-        over dp on their leading axis when divisible (ZeRO-1-style);
-        else replicated
+    shard_params : ZeRO-1-style dp-sharding of params + optimizer state
+    multi_precision : train with bf16 compute weights + f32 master
+        copies (bf16 batches, f32 loss/update math)
+    grad_clip : optional global-norm clip
     """
 
     def __init__(self, net, loss, optimizer="sgd", optimizer_params=None,
-                 mesh=None, shard_params=False, grad_clip=None):
+                 mesh=None, shard_params=False, grad_clip=None,
+                 multi_precision=False):
         self.net = net
         self.loss = loss
         self.mesh = mesh or make_mesh()
@@ -61,7 +83,9 @@ class ParallelTrainer:
         self.opt_params = dict(optimizer_params or {})
         self.shard_params = shard_params
         self.grad_clip = grad_clip
+        self.multi_precision = multi_precision
         self._step_fn = None
+        self._eval_fn = None
         self._params = None          # name -> jax array (device, sharded)
         self._opt_state = None
         self._aux = None
@@ -78,27 +102,63 @@ class ParallelTrainer:
         loss_sym = self.loss(out, label)
         self._graph = loss_sym
         self._eval = _build_eval(loss_sym, True)
+        self._eval_infer = _build_eval(loss_sym, False)
+        out_syms = out if isinstance(out, sym_mod.Symbol) else out[0]
+        self._fwd_eval = _build_eval(out_syms, False)
         args = loss_sym.list_arguments()
         self.param_names = [a for a in args if a not in ("data0", "label0")]
         self.aux_names = loss_sym.list_auxiliary_states()
 
+    def _resolve_opt(self):
+        from ..ops.registry import get_op
+        name = self.opt_name
+        self._lars = name in _LARS_NAMES
+        if self._lars:
+            name = "sgd"
+        if name == "sgd" and self.opt_params.get("momentum", 0):
+            name = "sgd_mom"
+        if name not in _OPT_OPS:
+            raise ValueError(
+                "optimizer %r not supported by ParallelTrainer; one of %s"
+                % (self.opt_name, sorted(_OPT_OPS) + list(_LARS_NAMES)))
+        base_op, n_states = _OPT_OPS[name]
+        if self.multi_precision:
+            if name not in ("sgd", "sgd_mom"):
+                raise ValueError(
+                    "multi_precision needs the mp_sgd update kernels; "
+                    "use optimizer='sgd'/'lars'/'lbsgd' (got %r)"
+                    % self.opt_name)
+            base_op = "mp_" + base_op
+        self._opt_op = get_op(base_op)
+        self._opt_n_states = n_states
+
     def _gather_state(self):
         params = {p.name: p for p in self.net.collect_params().values()}
         repl = NamedSharding(self.mesh, P())
+        self._resolve_opt()
+        cdtype = jnp.bfloat16 if self.multi_precision else None
         self._params = {}
+        self._opt_state = {}
         for n in self.param_names:
             arr = params[n].data()._data
+            if cdtype is not None:
+                master = arr.astype(jnp.float32)
+                arr = arr.astype(cdtype)
+                # f32 states + trailing f32 master copy (mp op
+                # signature: ..., mom, weight32)
+                states = [jnp.zeros_like(master)
+                          for _ in range(self._opt_n_states)]
+                states.append(master)
+            else:
+                # states match the stored weight dtype so fused updates
+                # neither promote nor retrace
+                states = [jnp.zeros_like(arr)
+                          for _ in range(self._opt_n_states)]
             self._params[n] = jax.device_put(arr, self._shard_for(arr))
+            self._opt_state[n] = tuple(
+                jax.device_put(s, self._shard_for(s)) for s in states)
         self._aux = {n: jax.device_put(params[n].data()._data, repl)
                      for n in self.aux_names}
-        opt_key = self.opt_name
-        if opt_key == "sgd" and self.opt_params.get("momentum", 0):
-            opt_key = "sgd_mom"
-        self._opt_op, state_fn = _OPT_OPS[opt_key]
-        self._opt_state = {n: tuple(
-            jax.device_put(s, self._shard_for(s))
-            for s in state_fn(self._params[n]))
-            for n in self.param_names}
 
     def _shard_for(self, arr):
         ndp = self.mesh.shape.get("dp", 1)
@@ -109,34 +169,57 @@ class ParallelTrainer:
 
     # -- compiled step -----------------------------------------------------
     def _build_step(self):
-        from ..ops.registry import get_op
         eval_fn = self._eval
-        opt_op = get_op(self._opt_op)
+        opt_op = self._opt_op
         opt_hp = {k: v for k, v in self.opt_params.items()
-                  if k in opt_op.param_names}
+                  if k in opt_op.param_names and k not in ("lr", "t")}
         grad_clip = self.grad_clip
+        lars = self._lars
+        lars_eta = float(self.opt_params.get("eta", 0.001))
+        lars_eps = float(self.opt_params.get("epsilon", 1e-9))
+        wd = float(self.opt_params.get("wd", 0.0))
+        mp = self.multi_precision
 
-        def train_step(params, opt_state, aux, x, y, key, lr):
+        def train_step(params, opt_state, aux, x, y, key, lr, t):
             def loss_of(p):
                 amap = dict(p)
                 amap["data0"] = x
                 amap["label0"] = y
                 outs, auxu = eval_fn(amap, aux, key)
-                return jnp.mean(outs[0]), auxu
+                return jnp.mean(outs[0].astype(jnp.float32)), auxu
 
             (loss_val, auxu), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(params)
             if grad_clip is not None:
-                gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g))
-                                     for g in grads.values()))
+                gnorm = jnp.sqrt(sum(
+                    jnp.sum(jnp.square(g.astype(jnp.float32)))
+                    for g in grads.values()))
                 scale = jnp.minimum(1.0, grad_clip / (gnorm + 1e-8))
-                grads = {k: g * scale for k, g in grads.items()}
+                grads = {k: (g.astype(jnp.float32) * scale).astype(g.dtype)
+                         for k, g in grads.items()}
             new_params = {}
             new_state = {}
             hp = dict(opt_hp)
-            hp["lr"] = lr
+            if "t" in opt_op.param_names:
+                hp["t"] = t
             for n, w in params.items():
-                out = opt_op.fn(w, grads[n], *opt_state[n], **hp)
+                g = grads[n]
+                lr_n = lr
+                if lars:
+                    # layer-wise trust ratio (reference LBSGD:678):
+                    # lr_layer = lr * eta * ||w|| / (||g|| + wd*||w||)
+                    w32 = opt_state[n][-1] if mp else \
+                        w.astype(jnp.float32)
+                    wnorm = jnp.sqrt(jnp.sum(jnp.square(w32)))
+                    gnorm = jnp.sqrt(jnp.sum(
+                        jnp.square(g.astype(jnp.float32))))
+                    trust = jnp.where(
+                        (wnorm > 0) & (gnorm > 0),
+                        lars_eta * wnorm / (gnorm + wd * wnorm +
+                                            lars_eps),
+                        1.0)
+                    lr_n = lr * trust
+                out = opt_op.fn(w, g, *opt_state[n], lr=lr_n, **hp)
                 if not isinstance(out, tuple):
                     out = (out,)
                 new_params[n] = out[0]
@@ -155,13 +238,51 @@ class ParallelTrainer:
         self._step_fn = jax.jit(
             train_step,
             in_shardings=(param_sh, state_sh, aux_sh,
-                          batch_sh, batch_sh, repl, None),
+                          batch_sh, batch_sh, repl, None, None),
             # pin outputs to the input layout so the params/state returned
             # by step N are valid inputs for step N+1 (otherwise XLA's
             # sharding propagation may choose a different layout)
             out_shardings=(param_sh, state_sh, aux_sh, repl),
             donate_argnums=(0, 1, 2))
+
+        eval_infer = self._eval_infer
+        fwd_eval = self._fwd_eval
+
+        def eval_step(params, aux, x, y, key):
+            amap = dict(params)
+            amap["data0"] = x
+            amap["label0"] = y
+            outs, _ = eval_infer(amap, aux, key)
+            return jnp.mean(outs[0].astype(jnp.float32))
+
+        def predict_step(params, aux, x, key):
+            amap = dict(params)
+            amap["data0"] = x
+            outs, _ = fwd_eval(amap, aux, key)
+            return outs[0]
+
+        self._eval_fn = jax.jit(
+            eval_step, in_shardings=(param_sh, aux_sh, batch_sh,
+                                     batch_sh, repl))
+        self._predict_fn = jax.jit(
+            predict_step, in_shardings=(param_sh, aux_sh, batch_sh, repl),
+            out_shardings=batch_sh)
         self._key = jax.random.PRNGKey(0)
+
+    def _ensure_built(self, x, y):
+        if self._step_fn is None:
+            self.net._ensure_params(NDArray(x))
+            self._trace(x, y)
+            self._gather_state()
+            self._build_step()
+
+    def _device_batch(self, x):
+        if isinstance(x, NDArray):
+            x = x._data
+        if self.multi_precision and jnp.issubdtype(x.dtype,
+                                                   jnp.floating):
+            x = x.astype(jnp.bfloat16)
+        return jax.device_put(x, NamedSharding(self.mesh, P("dp")))
 
     def fit_batch(self, x, y):
         """Run one training step; returns the (replicated) mean loss."""
@@ -169,21 +290,44 @@ class ParallelTrainer:
             x = x._data
         if isinstance(y, NDArray):
             y = y._data
-        if self._step_fn is None:
-            self.net._ensure_params(NDArray(x))
-            self._trace(x, y)
-            self._gather_state()
-            self._build_step()
-        batch_sh = NamedSharding(self.mesh, P("dp"))
-        x = jax.device_put(x, batch_sh)
-        y = jax.device_put(y, batch_sh)
+        self._ensure_built(x, y)
+        xd = self._device_batch(x)
+        yd = jax.device_put(y, NamedSharding(self.mesh, P("dp")))
         self._key, sub = jax.random.split(self._key)
-        lr = jnp.asarray(self.opt_params.get("learning_rate", 0.01),
-                         jnp.float32)
+        lr = jnp.asarray(self._current_lr(), jnp.float32)
+        t = jnp.asarray(self._num_update + 1, jnp.int32)
         self._params, self._opt_state, self._aux, loss = self._step_fn(
-            self._params, self._opt_state, self._aux, x, y, sub, lr)
+            self._params, self._opt_state, self._aux, xd, yd, sub, lr, t)
         self._num_update += 1
         return loss
+
+    def _current_lr(self):
+        sched = self.opt_params.get("lr_scheduler")
+        if sched is not None:
+            return float(sched(self._num_update))
+        return float(self.opt_params.get("learning_rate", 0.01))
+
+    def evaluate_batch(self, x, y):
+        """Mean loss over one batch, inference mode (no aux updates)."""
+        if isinstance(x, NDArray):
+            x = x._data
+        if isinstance(y, NDArray):
+            y = y._data
+        self._ensure_built(x, y)
+        xd = self._device_batch(x)
+        yd = jax.device_put(y, NamedSharding(self.mesh, P("dp")))
+        return self._eval_fn(self._params, self._aux, xd, yd,
+                             jax.random.PRNGKey(0))
+
+    def predict_batch(self, x):
+        """Network outputs for one batch, inference mode."""
+        if isinstance(x, NDArray):
+            x = x._data
+        if self._step_fn is None:
+            raise RuntimeError("run fit_batch or evaluate_batch first")
+        xd = self._device_batch(x)
+        return NDArray(self._predict_fn(self._params, self._aux, xd,
+                                        jax.random.PRNGKey(0)))
 
     # -- sync back to gluon parameters --------------------------------------
     def sync_params(self):
@@ -192,6 +336,8 @@ class ParallelTrainer:
         import numpy as _np
         params = {p.name: p for p in self.net.collect_params().values()}
         for n, arr in self._params.items():
+            if self.multi_precision:
+                arr = self._opt_state[n][-1]   # f32 master copy
             params[n].data()._data = jnp.asarray(_np.asarray(arr))
         for n, arr in self._aux.items():
             params[n].data()._data = jnp.asarray(_np.asarray(arr))

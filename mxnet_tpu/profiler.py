@@ -1,0 +1,266 @@
+"""Profiler — chrome://tracing output + aggregate op stats.
+
+Reference capability: `src/profiler/profiler.h:87-108,256` (chrome-trace
+JSON writer, mode bitmask, per-op stats) with the Python surface
+`python/mxnet/profiler.py:33-151` (set_config/set_state/dump/dumps +
+scriptable Task/Frame/Event/Counter/Marker objects).
+
+TPU-native design: host-side spans are collected in-process (op dispatch
+in `ops/registry.invoke`, executor forward/backward, API scopes); when
+profiling is on, op calls block on their results so spans measure real
+execution, not async dispatch (the reference's engine profiles the
+worker thread for the same reason).  Device-side timelines come from the
+XLA profiler: ``set_config(profile_device=True)`` starts a
+``jax.profiler`` trace whose TensorBoard-loadable output lands next to
+the chrome-trace file.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+__all__ = ["set_config", "set_state", "pause", "resume", "dump", "dumps",
+           "profiler_set_config", "profiler_set_state",
+           "Domain", "Task", "Frame", "Event", "Counter", "Marker",
+           "scope"]
+
+_lock = threading.RLock()
+_events = []            # chrome trace event dicts
+_agg = {}               # name -> [count, total_us, min_us, max_us]
+_config = {
+    "filename": "profile.json",
+    "profile_all": False,
+    "profile_symbolic": True,
+    "profile_imperative": True,
+    "profile_api": False,
+    "profile_memory": False,
+    "profile_device": False,
+    "aggregate_stats": False,
+}
+_state = {"running": False, "paused": False, "jax_trace": None}
+
+
+def is_running():
+    return _state["running"] and not _state["paused"]
+
+
+def set_config(**kwargs):
+    """Configure (reference: profiler.py set_config:33).  Accepts the
+    reference's kwargs; unknown keys are rejected."""
+    for k, v in kwargs.items():
+        if k not in _config:
+            raise ValueError("unknown profiler option %r (known: %s)"
+                             % (k, sorted(_config)))
+        _config[k] = v
+
+
+def set_state(state="stop"):
+    """'run' starts collection, 'stop' ends it
+    (reference: profiler.py set_state:89)."""
+    if state not in ("run", "stop"):
+        raise ValueError("state must be 'run' or 'stop'")
+    if state == "run" and not _state["running"]:
+        _state["running"] = True
+        _state["paused"] = False
+        if _config["profile_device"]:
+            import jax
+            trace_dir = os.path.splitext(_config["filename"])[0] + \
+                "_device"
+            try:
+                jax.profiler.start_trace(trace_dir)
+                _state["jax_trace"] = trace_dir
+            except Exception:
+                _state["jax_trace"] = None
+    elif state == "stop" and _state["running"]:
+        _state["running"] = False
+        if _state["jax_trace"]:
+            import jax
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            _state["jax_trace"] = None
+
+
+def pause():
+    _state["paused"] = True
+
+
+def resume():
+    _state["paused"] = False
+
+
+def record_span(name, cat, t0_s, t1_s, tid=0, args=None):
+    """Add one complete ('X') event; timestamps in seconds."""
+    if not is_running():
+        return
+    dur_us = (t1_s - t0_s) * 1e6
+    with _lock:
+        _events.append({
+            "name": name, "cat": cat, "ph": "X",
+            "ts": t0_s * 1e6, "dur": dur_us,
+            "pid": os.getpid(), "tid": tid,
+            **({"args": args} if args else {})})
+        st = _agg.setdefault(name, [0, 0.0, float("inf"), 0.0])
+        st[0] += 1
+        st[1] += dur_us
+        st[2] = min(st[2], dur_us)
+        st[3] = max(st[3], dur_us)
+
+
+def record_counter(name, value):
+    if not is_running():
+        return
+    with _lock:
+        _events.append({"name": name, "ph": "C", "ts": time.time() * 1e6,
+                       "pid": os.getpid(), "tid": 0,
+                        "args": {name: value}})
+
+
+def record_marker(name, cat="marker"):
+    if not is_running():
+        return
+    with _lock:
+        _events.append({"name": name, "cat": cat, "ph": "i",
+                        "ts": time.time() * 1e6, "pid": os.getpid(),
+                        "tid": 0, "s": "p"})
+
+
+def dump(finished=True):
+    """Write the chrome-trace JSON (reference: profiler.py dump:122);
+    load it at chrome://tracing or ui.perfetto.dev."""
+    if finished:
+        set_state("stop")
+    with _lock:
+        data = {"traceEvents": list(_events),
+                "displayTimeUnit": "ms"}
+        with open(_config["filename"], "w") as f:
+            json.dump(data, f)
+    return _config["filename"]
+
+
+def dumps(reset=False):
+    """Aggregate per-op stats table (reference: aggregate_stats.cc /
+    profiler.dumps)."""
+    with _lock:
+        lines = ["%-40s %8s %12s %12s %12s %12s" % (
+            "Name", "Calls", "Total(us)", "Avg(us)", "Min(us)",
+            "Max(us)")]
+        for name, (cnt, tot, mn, mx) in sorted(
+                _agg.items(), key=lambda kv: -kv[1][1]):
+            lines.append("%-40s %8d %12.1f %12.1f %12.1f %12.1f" % (
+                name[:40], cnt, tot, tot / max(cnt, 1), mn, mx))
+        if reset:
+            _agg.clear()
+    return "\n".join(lines)
+
+
+def reset():
+    with _lock:
+        _events.clear()
+        _agg.clear()
+
+
+# reference aliases
+profiler_set_config = set_config
+profiler_set_state = set_state
+
+
+class scope:
+    """Context manager timing a named host-side span."""
+
+    def __init__(self, name, cat="user"):
+        self.name = name
+        self.cat = cat
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        record_span(self.name, self.cat, self._t0, time.perf_counter())
+
+
+class Domain:
+    """Grouping namespace for user objects (reference: Domain)."""
+
+    def __init__(self, name):
+        self.name = name
+
+    def __repr__(self):
+        return "Domain(%s)" % self.name
+
+
+class _Span:
+    def __init__(self, name, domain=None):
+        self.name = name if domain is None else \
+            "%s::%s" % (domain.name, name)
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.perf_counter()
+
+    def stop(self):
+        if self._t0 is not None:
+            record_span(self.name, self._cat, self._t0,
+                        time.perf_counter())
+            self._t0 = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class Task(_Span):
+    _cat = "task"
+
+
+class Frame(_Span):
+    _cat = "frame"
+
+
+class Event(_Span):
+    _cat = "event"
+
+
+class Marker:
+    def __init__(self, name, domain=None):
+        self.name = name if domain is None else \
+            "%s::%s" % (domain.name, name)
+
+    def mark(self, scope="process"):
+        record_marker(self.name)
+
+
+class Counter:
+    """User counter (reference: ProfileCounter)."""
+
+    def __init__(self, name, domain=None, value=0):
+        self.name = name if domain is None else \
+            "%s::%s" % (domain.name, name)
+        self._value = value
+        record_counter(self.name, value)
+
+    def set_value(self, value):
+        self._value = value
+        record_counter(self.name, value)
+
+    def increment(self, delta=1):
+        self.set_value(self._value + delta)
+
+    def decrement(self, delta=1):
+        self.set_value(self._value - delta)
+
+    def __iadd__(self, delta):
+        self.increment(delta)
+        return self
+
+    def __isub__(self, delta):
+        self.decrement(delta)
+        return self

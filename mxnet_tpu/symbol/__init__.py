@@ -1,6 +1,7 @@
 """sym — symbolic graph API (reference: python/mxnet/symbol/)."""
 
-from .symbol import (Symbol, var, Variable, Group, load, load_json)  # noqa
+from .symbol import (Symbol, var, Variable, Group, load,  # noqa
+                     load_json, AttrScope)
 from . import register as _register
 
 _register.populate(globals())

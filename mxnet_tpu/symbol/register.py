@@ -57,23 +57,27 @@ def _make_fn(op):
 
 def _sym_invoke_padded(op, inputs, params, name, attr):
     # None placeholders (skipped named inputs) become auto-created vars
-    from .symbol import Node, _NameManager
+    from .symbol import Node, _NameManager, AttrScope
     params = {k: v for k, v in params.items() if v is not None}
     if name is None:
         name = _NameManager.get().fresh(op.name)
+    scope_attrs = AttrScope.current_attrs()
     input_names = op.input_names_for(params)
     entries = []
     for i, s in enumerate(inputs):
         if s is None:
             nm = input_names[i] if i < len(input_names) else "in%d" % i
-            entries.append((Node(None, "%s_%s" % (name, nm)), 0))
+            entries.append((Node(None, "%s_%s" % (name, nm),
+                                 attrs=dict(scope_attrs)), 0))
         else:
             entries.append(s._outputs[0])
     if input_names and len(entries) < len(input_names):
         for nm in input_names[len(entries):]:
-            entries.append((Node(None, "%s_%s" % (name, nm)), 0))
-    node = Node(op, name, params=params, inputs=entries,
-                attrs=dict(attr or {}))
+            entries.append((Node(None, "%s_%s" % (name, nm),
+                                 attrs=dict(scope_attrs)), 0))
+    node_attrs = dict(scope_attrs)
+    node_attrs.update(attr or {})
+    node = Node(op, name, params=params, inputs=entries, attrs=node_attrs)
     n_vis = op.n_visible(params)
     return Symbol([(node, i) for i in range(n_vis)])
 

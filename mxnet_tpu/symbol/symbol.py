@@ -45,6 +45,36 @@ class _NameManager:
         return "%s%d" % (hint, n)
 
 
+class AttrScope:
+    """Scoped symbol attributes (reference: python/mxnet/attribute.py) —
+    ops/vars created inside ``with AttrScope(ctx_group='dev1'):`` carry
+    the attrs; this is how manual model-parallel groups are declared."""
+
+    _tls = threading.local()
+
+    def __init__(self, **attrs):
+        self._attrs = attrs
+
+    @classmethod
+    def current_attrs(cls):
+        stack = getattr(cls._tls, "stack", None)
+        if not stack:
+            return {}
+        merged = {}
+        for scope in stack:
+            merged.update(scope._attrs)
+        return merged
+
+    def __enter__(self):
+        if not hasattr(self._tls, "stack"):
+            self._tls.stack = []
+        self._tls.stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        self._tls.stack.pop()
+
+
 class Node:
     """One graph node: a variable (op is None) or an op invocation."""
 
@@ -421,13 +451,14 @@ class Symbol:
                     shared_exec=None, shared_buffer=None, **kwargs):
         from ..executor import Executor
         return Executor._simple_bind(self, ctx, grad_req, type_dict,
-                                     kwargs, shared_exec=shared_exec)
+                                     kwargs, shared_exec=shared_exec,
+                                     group2ctx=group2ctx)
 
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
              aux_states=None, group2ctx=None, shared_exec=None):
         from ..executor import Executor
         return Executor._bind(self, ctx, args, args_grad, grad_req,
-                              aux_states)
+                              aux_states, group2ctx=group2ctx)
 
     def eval(self, ctx=None, **kwargs):
         ex = self.bind(ctx, kwargs)
@@ -460,7 +491,8 @@ def _parse_attr(v):
 def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
         init=None, stype=None, **kwargs):
     """Create a variable symbol (reference: symbol.py var/Variable)."""
-    attrs = dict(attr or {})
+    attrs = dict(AttrScope.current_attrs())
+    attrs.update(attr or {})
     if shape is not None:
         attrs["__shape__"] = tuple(shape)
     if dtype is not None:
@@ -537,10 +569,13 @@ def _sym_invoke(op_name, sym_inputs, params, name=None, attr=None):
     # auto-create missing declared inputs as variables (reference behavior:
     # sym.Convolution(data=d, ...) creates convN_weight / convN_bias)
     if input_names and len(inputs) < len(input_names):
+        scope_attrs = AttrScope.current_attrs()
         for nm in input_names[len(inputs):]:
-            inputs.append((Node(None, "%s_%s" % (name, nm)), 0))
-    node = Node(op, name, params=params, inputs=inputs,
-                attrs=dict(attr or {}))
+            inputs.append((Node(None, "%s_%s" % (name, nm),
+                                attrs=dict(scope_attrs)), 0))
+    node_attrs = dict(AttrScope.current_attrs())
+    node_attrs.update(attr or {})
+    node = Node(op, name, params=params, inputs=inputs, attrs=node_attrs)
     n_vis = op.n_visible(params)
     return Symbol([(node, i) for i in range(n_vis)])
 

@@ -1,0 +1,108 @@
+"""Manual model parallelism (group2ctx) tests
+(reference strategy: example/model-parallel/matrix_factorization +
+graph_executor.cc AssignContext semantics)."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+sym = mx.sym
+
+
+def _two_group_net():
+    with mx.AttrScope(ctx_group="dev1"):
+        x = sym.var("x")
+        h = sym.FullyConnected(x, num_hidden=8, name="fc1")
+        h = sym.Activation(h, act_type="relu")
+    with mx.AttrScope(ctx_group="dev2"):
+        out = sym.FullyConnected(h, num_hidden=3, name="fc2")
+        loss = sym.make_loss(sym.sum(sym.square(out)))
+    return loss
+
+
+def test_attr_scope_tags_nodes():
+    loss = _two_group_net()
+    groups = {n.name: n.attrs.get("ctx_group")
+              for n in loss._topo()}
+    assert groups["fc1"] == "dev1"
+    assert groups["fc1_weight"] == "dev1"
+    assert groups["fc2"] == "dev2"
+    assert groups["fc2_weight"] == "dev2"
+
+
+def test_group2ctx_partitions_and_places():
+    loss = _two_group_net()
+    g2c = {"dev1": mx.cpu(0), "dev2": mx.cpu(1)}
+    exe = loss.simple_bind(ctx=mx.cpu(0), group2ctx=g2c, x=(4, 6))
+    ctxs = [s.ctx for s in exe._segments]
+    assert len(exe._segments) == 2
+    assert ctxs[0] == mx.cpu(0) and ctxs[1] == mx.cpu(1)
+    rs = np.random.RandomState(0)
+    for n in exe.arg_dict:
+        exe.arg_dict[n][:] = rs.randn(
+            *exe.arg_dict[n].shape).astype(np.float32)
+    exe.forward(is_train=True)
+    exe.backward()
+    # fc2's gradient is produced on device 1 (true model parallelism)
+    devs = {d.id for d in exe.grad_dict["fc2_weight"]._data.devices()}
+    assert devs == {1}, devs
+
+
+def test_group2ctx_grads_match_single_device():
+    loss = _two_group_net()
+    g2c = {"dev1": mx.cpu(0), "dev2": mx.cpu(1)}
+    exe = loss.simple_bind(ctx=mx.cpu(0), group2ctx=g2c, x=(4, 6))
+    rs = np.random.RandomState(1)
+    vals = {n: rs.randn(*exe.arg_dict[n].shape).astype(np.float32)
+            for n in exe.arg_dict}
+    for n, v in vals.items():
+        exe.arg_dict[n][:] = v
+    out_g = exe.forward(is_train=True)[0].asnumpy()
+    exe.backward()
+
+    ref = loss.simple_bind(ctx=mx.cpu(0), x=(4, 6))
+    for n, v in vals.items():
+        ref.arg_dict[n][:] = v
+    out_r = ref.forward(is_train=True)[0].asnumpy()
+    ref.backward()
+    np.testing.assert_allclose(out_g, out_r, rtol=1e-5, atol=1e-6)
+    for n in exe.grad_dict:
+        np.testing.assert_allclose(
+            exe.grad_dict[n].asnumpy(), ref.grad_dict[n].asnumpy(),
+            rtol=1e-4, atol=1e-5, err_msg=n)
+
+
+def test_group2ctx_unknown_group_raises():
+    loss = _two_group_net()
+    try:
+        loss.simple_bind(ctx=mx.cpu(0), group2ctx={"dev1": mx.cpu(0)},
+                         x=(4, 6))
+    except mx.MXNetError as e:
+        assert "dev2" in str(e)
+    else:
+        raise AssertionError("expected MXNetError for missing group")
+
+
+def test_module_group2ctxs_trains():
+    """Matrix-factorization-style: embedding halves on different devices
+    via Module(group2ctxs=...)."""
+    with mx.AttrScope(ctx_group="dev1"):
+        data = sym.var("data")
+        h = sym.FullyConnected(data, num_hidden=16, name="fc1")
+        h = sym.Activation(h, act_type="relu")
+    with mx.AttrScope(ctx_group="dev2"):
+        out = sym.FullyConnected(h, num_hidden=2, name="fc2")
+        out = sym.SoftmaxOutput(out, name="softmax")
+    mod = mx.mod.Module(out, context=mx.cpu(0),
+                        label_names=["softmax_label"],
+                        group2ctxs={"dev1": mx.cpu(0),
+                                    "dev2": mx.cpu(1)})
+    rs = np.random.RandomState(0)
+    X = rs.randn(64, 10).astype(np.float32)
+    Y = (X.sum(1) > 0).astype(np.float32)
+    it = mx.io.NDArrayIter(X, Y, batch_size=16,
+                           label_name="softmax_label")
+    mod.fit(it, num_epoch=10, optimizer="adam",
+            optimizer_params={"learning_rate": 0.01})
+    acc = mod.score(it, mx.metric.Accuracy())[0][1]
+    assert acc > 0.7, acc

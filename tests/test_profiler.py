@@ -1,0 +1,104 @@
+"""Profiler + Monitor tests (reference strategy:
+tests/python/unittest/test_profiler.py, monitor usage in test_monitor)."""
+
+import json
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler
+
+
+def test_profiler_chrome_trace(tmp_path):
+    fn = str(tmp_path / "trace.json")
+    profiler.reset()
+    profiler.set_config(filename=fn, profile_imperative=True)
+    profiler.set_state("run")
+    a = mx.nd.array(np.random.randn(32, 32).astype(np.float32))
+    b = mx.nd.array(np.random.randn(32, 32).astype(np.float32))
+    for _ in range(3):
+        c = mx.nd.dot(a, b)
+        c = mx.nd.relu(c)
+    c.asnumpy()
+    with profiler.scope("user_block"):
+        (a + b).asnumpy()
+    path = profiler.dump()
+    assert path == fn and os.path.exists(fn)
+    data = json.load(open(fn))
+    names = {e["name"] for e in data["traceEvents"]}
+    assert "dot" in names
+    assert "relu" in names or "Activation" in names
+    assert "user_block" in names
+    for e in data["traceEvents"]:
+        assert "ts" in e and "ph" in e
+
+
+def test_profiler_aggregate_stats():
+    profiler.reset()
+    profiler.set_config(filename="/tmp/_p.json")
+    profiler.set_state("run")
+    a = mx.nd.array(np.ones((8, 8), np.float32))
+    for _ in range(5):
+        (a * 2).asnumpy()
+    profiler.set_state("stop")
+    table = profiler.dumps()
+    assert "_mul_scalar" in table
+    assert "Calls" in table
+
+
+def test_profiler_objects():
+    profiler.reset()
+    profiler.set_config(filename="/tmp/_p2.json")
+    profiler.set_state("run")
+    d = profiler.Domain("test")
+    with profiler.Task("work", domain=d):
+        pass
+    c = profiler.Counter("steps", domain=d, value=0)
+    c += 5
+    c.decrement(1)
+    m = profiler.Marker("here", domain=d)
+    m.mark()
+    profiler.set_state("stop")
+    profiler.dump(finished=True)
+    data = json.load(open("/tmp/_p2.json"))
+    names = {e["name"] for e in data["traceEvents"]}
+    assert "test::work" in names
+    assert "test::steps" in names
+    assert "test::here" in names
+
+
+def test_monitor_taps_interior_ops():
+    x = mx.sym.var("x")
+    h = mx.sym.FullyConnected(x, num_hidden=4, name="fc1")
+    out = mx.sym.Activation(h, act_type="relu", name="act1")
+    exe = out.simple_bind(ctx=mx.cpu(), x=(2, 3))
+    rs = np.random.RandomState(0)
+    for n in exe.arg_dict:
+        exe.arg_dict[n][:] = rs.randn(
+            *exe.arg_dict[n].shape).astype(np.float32)
+    mon = mx.Monitor(interval=1, pattern=".*", sort=True)
+    mon.install(exe)
+    mon.tic()
+    exe.forward()
+    res = mon.toc()
+    names = [n for _, n, _ in res]
+    assert "fc1_output" in names
+    assert "act1_output" in names
+    stats = {n: float(s) for _, n, s in res}
+    assert stats["act1_output"] >= 0
+
+
+def test_monitor_through_module():
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu(),
+                        label_names=["softmax_label"])
+    X = np.random.randn(8, 6).astype(np.float32)
+    Y = np.zeros(8, np.float32)
+    it = mx.io.NDArrayIter(X, Y, batch_size=4,
+                           label_name="softmax_label")
+    mon = mx.Monitor(interval=1)
+    mod.fit(it, num_epoch=1, optimizer="sgd", monitor=mon,
+            optimizer_params={"learning_rate": 0.01})
