@@ -1,0 +1,139 @@
+"""Shared fit harness for the example training scripts.
+
+Reference: ``example/image-classification/common/fit.py`` (add_fit_args +
+fit:148 — kvstore creation, lr schedule from epoch steps, Module.fit with
+checkpoint/speedometer callbacks).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+
+
+def add_fit_args(parser):
+    parser.add_argument("--network", type=str, default=None,
+                        help="the neural network to use")
+    parser.add_argument("--kv-store", type=str, default="local",
+                        help="key-value store type "
+                             "(local/device/tpu/dist_sync/dist_async)")
+    parser.add_argument("--num-epochs", type=int, default=2,
+                        help="max epochs to run")
+    parser.add_argument("--lr", type=float, default=0.05,
+                        help="initial learning rate")
+    parser.add_argument("--lr-factor", type=float, default=0.1,
+                        help="lr decay ratio")
+    parser.add_argument("--lr-step-epochs", type=str, default="",
+                        help="epochs at which lr decays, e.g. '30,60'")
+    parser.add_argument("--optimizer", type=str, default="sgd")
+    parser.add_argument("--mom", type=float, default=0.9,
+                        help="momentum")
+    parser.add_argument("--wd", type=float, default=1e-4,
+                        help="weight decay")
+    parser.add_argument("--batch-size", type=int, default=64,
+                        help="total batch size")
+    parser.add_argument("--disp-batches", type=int, default=20,
+                        help="show progress every N batches")
+    parser.add_argument("--model-prefix", type=str, default=None,
+                        help="checkpoint prefix")
+    parser.add_argument("--load-epoch", type=int, default=None,
+                        help="resume from this checkpoint epoch")
+    parser.add_argument("--top-k", type=int, default=0,
+                        help="also report top-k accuracy")
+    parser.add_argument("--monitor", type=int, default=0,
+                        help="install a Monitor every N batches")
+    return parser
+
+
+def _lr_scheduler(args, epoch_size, kv):
+    import mxnet_tpu as mx
+    begin_epoch = args.load_epoch or 0
+    if not args.lr_step_epochs:
+        return args.lr, None
+    step_epochs = [int(e) for e in args.lr_step_epochs.split(",") if e]
+    lr = args.lr
+    for e in step_epochs:
+        if begin_epoch >= e:
+            lr *= args.lr_factor
+    steps = [epoch_size * (e - begin_epoch) for e in step_epochs
+             if e > begin_epoch]
+    if not steps:
+        return lr, None
+    return lr, mx.lr_scheduler.MultiFactorScheduler(
+        step=steps, factor=args.lr_factor)
+
+
+def fit(args, network, data_loader, arg_params=None, aux_params=None,
+        **kwargs):
+    """Train *network* (a Symbol) with the reference fit flow:
+    kvstore → lr schedule → Module.fit with callbacks.
+
+    data_loader(args, kv) -> (train_iter, val_iter)
+    """
+    import mxnet_tpu as mx
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)-15s Node[" +
+               os.environ.get("DMLC_WORKER_RANK", "0") + "] %(message)s")
+
+    kv = None
+    if "dist" in args.kv_store:
+        kv = mx.kv.create(args.kv_store)
+    train, val = data_loader(args, kv)
+
+    epoch_size = getattr(args, "num_examples", 0) // args.batch_size \
+        if getattr(args, "num_examples", 0) else 100
+    if kv is not None:
+        epoch_size //= max(1, kv.num_workers)
+    lr, lr_sched = _lr_scheduler(args, epoch_size, kv)
+
+    optimizer_params = {
+        "learning_rate": lr,
+        "rescale_grad": 1.0 / args.batch_size /
+        (kv.num_workers if kv is not None else 1),
+    }
+    if lr_sched is not None:
+        optimizer_params["lr_scheduler"] = lr_sched
+    if args.optimizer in ("sgd", "nag", "signum", "lbsgd"):
+        optimizer_params["momentum"] = args.mom
+        optimizer_params["wd"] = args.wd
+
+    mod = mx.mod.Module(symbol=network,
+                        data_names=("data",),
+                        label_names=("softmax_label",))
+
+    begin_epoch = 0
+    if args.model_prefix and args.load_epoch is not None:
+        _, arg_params, aux_params = mx.model.load_checkpoint(
+            args.model_prefix, args.load_epoch)
+        begin_epoch = args.load_epoch
+
+    eval_metrics = [mx.metric.create("accuracy")]
+    if args.top_k > 0:
+        eval_metrics.append(
+            mx.metric.create("top_k_accuracy", top_k=args.top_k))
+
+    batch_end = [mx.callback.Speedometer(args.batch_size,
+                                         args.disp_batches)]
+    epoch_end = []
+    if args.model_prefix:
+        epoch_end.append(mx.callback.do_checkpoint(args.model_prefix))
+
+    mod.fit(train,
+            eval_data=val,
+            eval_metric=eval_metrics,
+            kvstore=kv if kv is not None else args.kv_store,
+            optimizer=args.optimizer,
+            optimizer_params=optimizer_params,
+            initializer=mx.init.Xavier(rnd_type="gaussian",
+                                       factor_type="in", magnitude=2),
+            arg_params=arg_params,
+            aux_params=aux_params,
+            begin_epoch=begin_epoch,
+            num_epoch=args.num_epochs,
+            batch_end_callback=batch_end,
+            epoch_end_callback=epoch_end,
+            **kwargs)
+    return mod

@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Train LeNet / MLP on MNIST.
+
+Reference: ``example/image-classification/train_mnist.py`` (symbol
+definitions + MNISTIter data path through common/fit.py).
+
+With no MNIST files on disk this falls back to a deterministic synthetic
+digit set (class-dependent blob patterns + noise) so the script — and the
+distributed convergence test that drives it — runs fully offline.
+
+Single process:   python examples/train_mnist.py --network lenet
+Distributed:      python tools/launch.py -n 2 python \
+                      examples/train_mnist.py --kv-store dist_sync
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _here)
+sys.path.insert(0, os.path.dirname(_here))  # repo root (mxnet_tpu pkg)
+import common  # noqa: E402
+
+
+def mlp(num_classes=10):
+    import mxnet_tpu as mx
+    data = mx.sym.var("data")
+    data = mx.sym.Flatten(data=data)
+    fc1 = mx.sym.FullyConnected(data=data, num_hidden=128, name="fc1")
+    act1 = mx.sym.Activation(data=fc1, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(data=act1, num_hidden=64, name="fc2")
+    act2 = mx.sym.Activation(data=fc2, act_type="relu", name="relu2")
+    fc3 = mx.sym.FullyConnected(data=act2, num_hidden=num_classes,
+                                name="fc3")
+    return mx.sym.SoftmaxOutput(data=fc3, name="softmax")
+
+
+def lenet(num_classes=10):
+    import mxnet_tpu as mx
+    data = mx.sym.var("data")
+    conv1 = mx.sym.Convolution(data=data, kernel=(5, 5), num_filter=20,
+                               name="conv1")
+    tanh1 = mx.sym.Activation(data=conv1, act_type="tanh")
+    pool1 = mx.sym.Pooling(data=tanh1, pool_type="max", kernel=(2, 2),
+                           stride=(2, 2))
+    conv2 = mx.sym.Convolution(data=pool1, kernel=(5, 5), num_filter=50,
+                               name="conv2")
+    tanh2 = mx.sym.Activation(data=conv2, act_type="tanh")
+    pool2 = mx.sym.Pooling(data=tanh2, pool_type="max", kernel=(2, 2),
+                           stride=(2, 2))
+    flat = mx.sym.Flatten(data=pool2)
+    fc1 = mx.sym.FullyConnected(data=flat, num_hidden=500, name="fc1")
+    tanh3 = mx.sym.Activation(data=fc1, act_type="tanh")
+    fc2 = mx.sym.FullyConnected(data=tanh3, num_hidden=num_classes,
+                                name="fc2")
+    return mx.sym.SoftmaxOutput(data=fc2, name="softmax")
+
+
+def synthetic_mnist(num_examples, seed=42):
+    """Learnable synthetic digits: each class lights a distinct 7x7 cell
+    grid region, plus noise.  Deterministic across workers."""
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, size=num_examples).astype(np.float32)
+    images = rng.uniform(0, 0.3, size=(num_examples, 1, 28, 28)) \
+        .astype(np.float32)
+    for i, lab in enumerate(labels.astype(int)):
+        r, c = divmod(lab, 4)
+        images[i, 0, 2 + r * 9:9 + r * 9, 2 + c * 6:8 + c * 6] += 0.7
+    return images, labels
+
+
+def get_iters(args, kv):
+    import mxnet_tpu as mx
+    data_dir = getattr(args, "data_dir", "data")
+    mnist_files = [os.path.join(data_dir, f) for f in
+                   ("train-images-idx3-ubyte", "train-labels-idx1-ubyte",
+                    "t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte")]
+    rank = kv.rank if kv is not None else 0
+    nworker = kv.num_workers if kv is not None else 1
+    if all(os.path.exists(f) for f in mnist_files):
+        train = mx.io.MNISTIter(
+            image=mnist_files[0], label=mnist_files[1],
+            batch_size=args.batch_size, shuffle=True,
+            num_parts=nworker, part_index=rank)
+        val = mx.io.MNISTIter(
+            image=mnist_files[2], label=mnist_files[3],
+            batch_size=args.batch_size, shuffle=False)
+        return train, val
+    # offline fallback: synthetic digits, sharded by worker rank
+    x, y = synthetic_mnist(args.num_examples)
+    xv, yv = synthetic_mnist(max(args.batch_size * 4, 512), seed=1234)
+    x, y = x[rank::nworker], y[rank::nworker]
+    train = mx.io.NDArrayIter(data=x, label=y,
+                              batch_size=args.batch_size, shuffle=True,
+                              label_name="softmax_label")
+    val = mx.io.NDArrayIter(data=xv, label=yv,
+                            batch_size=args.batch_size,
+                            label_name="softmax_label")
+    return train, val
+
+
+def main():
+    parser = argparse.ArgumentParser(description="train mnist")
+    parser.set_defaults(network="mlp", num_epochs=3, batch_size=64,
+                        lr=0.05, disp_batches=50)
+    common.add_fit_args(parser)
+    parser.add_argument("--data-dir", type=str, default="data",
+                        help="directory with the idx-ubyte MNIST files")
+    parser.add_argument("--num-examples", type=int, default=4096,
+                        help="synthetic-fallback training-set size")
+    parser.add_argument("--min-accuracy", type=float, default=None,
+                        help="exit nonzero unless final train accuracy "
+                             "reaches this (used by the dist tests)")
+    args = parser.parse_args()
+
+    net = lenet() if args.network == "lenet" else mlp()
+    mod = common.fit(args, net, get_iters)
+
+    if args.min_accuracy is not None:
+        import mxnet_tpu as mx
+        train, _ = get_iters(args, None)
+        acc = mod.score(train, mx.metric.create("accuracy"))
+        acc_val = dict(acc)["accuracy"]
+        print("final train accuracy: %.4f" % acc_val)
+        if acc_val < args.min_accuracy:
+            print("FAILED: accuracy %.4f < required %.4f"
+                  % (acc_val, args.min_accuracy))
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -164,11 +164,13 @@ class KVStoreLocal(KVStoreBase):
             if self._updater is not None:
                 self._updater(self._key_index(k), merged, self._store[k])
             else:
+                # no updater: the merged value REPLACES the stored one
+                # (reference kvstore_local.h PushImpl: ``local = merged``)
                 stored = self._store[k]
                 if isinstance(stored, _sp.BaseSparseNDArray):
                     self._store[k] = merged.tostype(stored.stype)
                 else:
-                    stored += merged.as_in_context(stored.context)
+                    merged.as_in_context(stored.context).copyto(stored)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         from .ndarray import sparse as _sp
@@ -284,6 +286,16 @@ class KVStoreServer:
         self.port = self.sock.getsockname()[1]
         self.sock.listen(64)
         self._stop = False
+        # Resolve handler-thread imports NOW, on the constructing thread.
+        # The server may be started from the tail of mxnet_tpu/__init__.py
+        # (DMLC_ROLE=server bootstrap) while the package is still marked
+        # initializing; a ``from . import x`` in a handler thread would
+        # deadlock on the package import lock.  The constructing thread
+        # holds that lock reentrantly, so importing here is safe.
+        from . import optimizer as _opt_mod
+        from .ops import quantization as _quant_mod
+        self._opt_mod = _opt_mod
+        self._quant_mod = _quant_mod
 
     def run(self):
         """Serve until a STOP message (reference: RunServer blocks the
@@ -313,8 +325,19 @@ class KVStoreServer:
             if self.updater is not None:
                 self.updater(_str_key_index(self._str_idx, key), grad,
                              self.store[key])
+            elif self.sync:
+                # sync, no updater: the fully aggregated value replaces
+                # the stored one (reference kvstore_dist_server.h: "if
+                # no updater, just copy" — CopyFromTo(merged, &stored))
+                grad.copyto(self.store[key])
             else:
-                self.store[key] += grad
+                # async applies per-push; without an updater concurrent
+                # workers would blindly overwrite each other (reference
+                # asserts CHECK(updater_) on this path)
+                raise MXNetError(
+                    "dist_async push for key %r before an optimizer was "
+                    "set — call kv.set_optimizer() first (async mode "
+                    "requires the server-side updater)" % (key,))
 
     def _serve_conn(self, conn):
         try:
@@ -330,8 +353,8 @@ class KVStoreServer:
                 elif kind == _MSG_PUSH:
                     _, key, val, meta = msg
                     if meta and meta.get("compressed"):
-                        from .ops.quantization import unpack_2bit
-                        codes = unpack_2bit(val, meta["n"]).astype(
+                        codes = self._quant_mod.unpack_2bit(
+                            val, meta["n"]).astype(
                             _np.float32) * meta["threshold"]
                         val = codes.reshape(meta["shape"])
                     try:
@@ -357,11 +380,16 @@ class KVStoreServer:
                         _send_msg(conn, ("err", str(e)))
                 elif kind == _MSG_SET_OPT:
                     _, blob = msg
-                    from . import optimizer as opt
                     optimizer = pickle.loads(blob)
-                    self.updater = opt.get_updater(optimizer)
+                    self.updater = self._opt_mod.get_updater(optimizer)
                     _send_msg(conn, ("ok",))
                 elif kind == _MSG_CMD:
+                    # rank-0 command channel (reference: kvstore.h
+                    # SendCommandToServers:377); "mode" declares the
+                    # consistency model so one server binary serves both
+                    # dist_sync and dist_async launches
+                    if len(msg) >= 3 and msg[1] == "mode":
+                        self.sync = "async" not in str(msg[2])
                     _send_msg(conn, ("ok",))
                 elif kind == _MSG_STOP:
                     self._stop = True
@@ -443,6 +471,9 @@ class KVStoreDist(KVStoreBase):
                 time.sleep(0.1)
         self._lock = threading.Lock()
         self._residual = {}
+        # declare the consistency mode to the server (every worker sends
+        # the same value; the server applies it idempotently)
+        self._rpc((_MSG_CMD, "mode", name))
 
     @property
     def type(self):

@@ -281,32 +281,161 @@ def _node_vjp(node, out_cots):
     return vjp_fn(tuple(cots))
 
 
+def _node_vjp_recorded(node, out_cot_nds):
+    """VJP of one tape node, executed as a *recorded* eager op so the
+    resulting cotangents are themselves differentiable (create_graph).
+
+    The node's VJP is re-expressed as a pure jax function of BOTH the
+    primals and the output cotangents — ``jax.vjp`` of that function is
+    the second-order rule, so grad-of-grad needs no per-op machinery."""
+    from .ndarray import NDArray
+    if isinstance(node.fn, tuple) and node.fn[0] == "__custom__":
+        raise NotImplementedError(
+            "create_graph=True through a custom autograd.Function: the "
+            "Python backward callback is opaque to the tape")
+    n_in = len(node.inputs)
+    out_dtypes = [o.dtype for o in node.out_arrays]
+    rng = node.rng
+    node_fn = node.fn
+    if node_fn is None:
+        # registry op: rebuild the pure fn from (op, static, dyn) params
+        from .ops import registry as _reg
+        op_name, frozen, _dyn_names = node.op_ref
+        _op = _reg.get_op(op_name)
+        _sparams = {k: v for k, v in frozen}
+        _dyn = dict(node.dyn)
+        if rng is not None:
+            def node_fn(r, *p):
+                return _op.fn(r, *p, **_sparams, **_dyn)
+        else:
+            def node_fn(*p):
+                return _op.fn(*p, **_sparams, **_dyn)
+
+    def vjp_pure(*arrays):
+        primals, cots = arrays[:n_in], arrays[n_in:]
+
+        def fwd(*p):
+            out = node_fn(rng, *p) if rng is not None else node_fn(*p)
+            return out if isinstance(out, tuple) else (out,)
+
+        _, fv = jax.vjp(fwd, *primals)
+        cots = tuple(c.astype(d) if c.dtype != d else c
+                     for c, d in zip(cots, out_dtypes))
+        res = fv(cots)
+        return tuple(r if r is not None else jnp.zeros_like(p)
+                     for r, p in zip(res, primals))
+
+    nd_inputs = []
+    for arr, e in zip(node.inputs, node.in_entries):
+        nd = NDArray(arr)
+        nd._tape_entry = e
+        nd_inputs.append(nd)
+    nd_inputs.extend(out_cot_nds)
+    raw = vjp_pure(*[x._data for x in nd_inputs])
+    nd_outs = [NDArray(r) for r in raw]
+    record_op(vjp_pure, nd_inputs, nd_outs)
+    return nd_outs
+
+
+def _backward_recorded(heads, head_grads, entry_slots, leaf_slots, n_slots):
+    """Tape walk mirroring :func:`backward` but carried out on NDArrays
+    with every VJP recorded, so returned cotangents stay on the tape.
+
+    ``entry_slots``: {(id(node), out_idx): slot}; ``leaf_slots``:
+    {id(leaf): slot}.  Returns a list of NDArray (or None) per slot."""
+    from .ndarray import NDArray
+    nodes = _collect(heads)
+    cots = {}       # (id(node), out_idx) -> NDArray
+    leaf_cots = {}  # id(leaf) -> NDArray
+    results = [None] * n_slots
+
+    def acc(d, k, g):
+        d[k] = d[k] + g if d.get(k) is not None else g
+
+    with record():
+        for h, hg in zip(heads, head_grads):
+            e = getattr(h, "_tape_entry", None)
+            if e is None:
+                continue
+            g = hg if hg is not None else NDArray(jnp.ones_like(h._data))
+            if isinstance(e, Leaf):
+                acc(leaf_cots, id(e), g)
+            else:
+                acc(cots, (id(e[0]), e[1]), g)
+
+        for node in nodes:
+            outs = [cots.pop((id(node), i), None)
+                    for i in range(node.n_out)]
+            for i, o in enumerate(outs):
+                k = (id(node), i)
+                if o is not None and k in entry_slots:
+                    s = entry_slots[k]
+                    results[s] = o if results[s] is None else results[s] + o
+            if all(o is None for o in outs):
+                continue
+            outs = [o if o is not None else NDArray(jnp.zeros_like(a))
+                    for o, a in zip(outs, node.out_arrays)]
+            in_cots = _node_vjp_recorded(node, outs)
+            for e, g in zip(node.in_entries, in_cots):
+                if e is None or g is None:
+                    continue
+                if isinstance(e, Leaf):
+                    acc(leaf_cots, id(e), g)
+                else:
+                    acc(cots, (id(e[0]), e[1]), g)
+
+    for lid, slot in leaf_slots.items():
+        if leaf_cots.get(lid) is not None:
+            results[slot] = leaf_cots[lid]
+    return results
+
+
 def grad(heads, variables, head_grads=None, retain_graph=None,
          create_graph=False, train_mode=True):
     """Functional-style gradient (reference: autograd.py:270).
 
-    Note: ``create_graph=True`` (higher-order eager grad) is not supported on
-    the tape; use hybridized blocks + ``nd.grad_of`` / jax transforms for
-    higher-order derivatives.
-    """
+    With ``create_graph=True`` the backward pass itself is recorded on
+    the tape, so the returned gradients can be differentiated again
+    (grad-of-grad) — each tape node's VJP runs as a recorded pure-jax op
+    (see :func:`_node_vjp_recorded`)."""
     from .ndarray import NDArray, zeros_like
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True: take higher-order grads through a "
-            "hybridized block (whole-graph jax.grad) instead")
     single = isinstance(variables, NDArray)
     if single:
         variables = [variables]
-    cap_keys = {}
-    results = [None] * len(variables)
-    leaf_bufs = {}
-    saved_leaf_grads = {}
-    for i, v in enumerate(variables):
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif isinstance(head_grads, NDArray):
+        head_grads = [head_grads]
+
+    def _entry_of(v):
         e = getattr(v, "_tape_entry", None)
         if e is None:
             raise ValueError(
                 "cannot take gradient w.r.t. an array that is not on the "
                 "tape (call attach_grad() / use it under record())")
+        return e
+
+    if create_graph:
+        entry_slots, leaf_slots = {}, {}
+        for i, v in enumerate(variables):
+            e = _entry_of(v)
+            if isinstance(e, Leaf):
+                leaf_slots[id(e)] = i
+            else:
+                entry_slots[(id(e[0]), e[1])] = i
+        results = _backward_recorded(heads, head_grads, entry_slots,
+                                     leaf_slots, len(variables))
+        out = [r if r is not None else zeros_like(v)
+               for r, v in zip(results, variables)]
+        return out[0] if single else out
+    cap_keys = {}
+    results = [None] * len(variables)
+    leaf_bufs = {}
+    saved_leaf_grads = {}
+    for i, v in enumerate(variables):
+        e = _entry_of(v)
         if isinstance(e, Leaf):
             saved_leaf_grads[i] = (e, e.grad_nd, e.grad_req)
             buf = zeros_like(v)

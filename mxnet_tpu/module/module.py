@@ -210,9 +210,15 @@ class Module(BaseModule):
         self._symbol.save("%s-symbol.json" % prefix)
         arg_params, aux_params = self.get_params()
         save_checkpoint(prefix, epoch, None, arg_params, aux_params)
-        if save_optimizer_states and self._updater is not None:
-            with open("%s-%04d.states" % (prefix, epoch), "wb") as f:
-                f.write(self._updater.get_states())
+        if save_optimizer_states:
+            fname = "%s-%04d.states" % (prefix, epoch)
+            if self._updater is not None:
+                with open(fname, "wb") as f:
+                    f.write(self._updater.get_states())
+            elif self._kvstore is not None and self._update_on_kvstore:
+                # updater state lives in the kvstore (reference:
+                # module.py save_optimizer_states via kvstore)
+                self._kvstore.save_optimizer_states(fname)
 
     # -- properties --------------------------------------------------------
     @property
@@ -330,9 +336,33 @@ class Module(BaseModule):
         return arg_params, aux_params
 
     # -- optimizer ---------------------------------------------------------
+    @staticmethod
+    def _create_kvstore(kvstore, num_device):
+        """(reference: python/mxnet/model.py _create_kvstore) — returns
+        (kv, update_on_kvstore).  A plain local/device store on a single
+        device is pointless overhead, so it collapses to None."""
+        import os
+        from .._kvstore_impl import KVStoreBase
+        from .. import kvstore as kvs
+        if kvstore is None or kvstore == "":
+            return None, False
+        if isinstance(kvstore, KVStoreBase):
+            kv = kvstore
+        else:
+            if num_device == 1 and "dist" not in kvstore:
+                return None, False
+            kv = kvs.create(kvstore)
+        update_on_kvstore = bool(int(
+            os.environ.get("MXNET_UPDATE_ON_KVSTORE", "1")))
+        if "async" in getattr(kv, "type", ""):
+            update_on_kvstore = True
+        return kv, update_on_kvstore
+
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
                        force_init=False):
+        """(reference: module.py init_optimizer:333 — creates the kvstore,
+        registers weights, and places the updater locally or server-side)"""
         assert self.binded and self.params_initialized
         if self.optimizer_initialized and not force_init:
             return
@@ -347,10 +377,28 @@ class Module(BaseModule):
             optimizer = opt.create(optimizer, param_idx2name=idx2name,
                                    **optimizer_params)
         self._optimizer = optimizer
-        self._updater = opt.get_updater(optimizer)
+        self._kvstore, self._update_on_kvstore = self._create_kvstore(
+            kvstore, len(self._context))
+        if self._kvstore is not None:
+            ex0 = self._exec_group.execs[0]
+            for i, name in enumerate(self._exec_group.param_names):
+                self._kvstore.init(i, ex0.arg_dict[name])
+            if self._update_on_kvstore:
+                self._kvstore.set_optimizer(self._optimizer)
+        if self._kvstore is not None and self._update_on_kvstore:
+            self._updater = None
+        else:
+            self._updater = opt.get_updater(optimizer)
         if getattr(self, "_preload_opt_states", None):
-            with open(self._preload_opt_states, "rb") as f:
-                self._updater.set_states(f.read())
+            if self._updater is not None:
+                with open(self._preload_opt_states, "rb") as f:
+                    self._updater.set_states(f.read())
+            else:
+                # updater lives in the kvstore (update_on_kvstore);
+                # reference routes this through
+                # kvstore.load_optimizer_states (module.py:373)
+                self._kvstore.load_optimizer_states(
+                    self._preload_opt_states)
             self._preload_opt_states = None
         self.optimizer_initialized = True
 
@@ -375,10 +423,36 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized and \
             self.optimizer_initialized
         self._params_dirty = True
-        self._exec_group.reduce_grads()
-        ex0 = self._exec_group.execs[0]
-        for i, name in enumerate(self._exec_group.param_names):
-            if self._exec_group.grad_req[name] == "null":
+        group = self._exec_group
+        ex0 = group.execs[0]
+        if self._kvstore is not None and self._update_on_kvstore:
+            # push grads -> (server/store applies updater) -> pull weights
+            for i, name in enumerate(group.param_names):
+                if group.grad_req[name] == "null":
+                    continue
+                self._kvstore.push(
+                    i, [ex.grad_dict[name] for ex in group.execs])
+            if "dist" in getattr(self._kvstore, "type", ""):
+                self._kvstore.barrier()
+            for i, name in enumerate(group.param_names):
+                if group.grad_req[name] == "null":
+                    continue
+                self._kvstore.pull(
+                    i, out=[ex.arg_dict[name] for ex in group.execs])
+            return
+        if self._kvstore is not None:
+            # aggregate grads through the store, update locally
+            for i, name in enumerate(group.param_names):
+                if group.grad_req[name] == "null":
+                    continue
+                self._kvstore.push(
+                    i, [ex.grad_dict[name] for ex in group.execs])
+                self._kvstore.pull(
+                    i, out=[ex.grad_dict[name] for ex in group.execs])
+        else:
+            group.reduce_grads()
+        for i, name in enumerate(group.param_names):
+            if group.grad_req[name] == "null":
                 continue
             # grads were summed across device slices, so with
             # rescale_grad=1/batch_size this is already the batch mean

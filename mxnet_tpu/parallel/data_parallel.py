@@ -282,7 +282,12 @@ class ParallelTrainer:
         if self.multi_precision and jnp.issubdtype(x.dtype,
                                                    jnp.floating):
             x = x.astype(jnp.bfloat16)
-        return jax.device_put(x, NamedSharding(self.mesh, P("dp")))
+        sh = NamedSharding(self.mesh, P("dp"))
+        # already resident with the right layout (e.g. the caller reuses
+        # the batch array a previous step produced) — skip the transfer
+        if isinstance(x, jax.Array) and getattr(x, "sharding", None) == sh:
+            return x
+        return jax.device_put(x, sh)
 
     def fit_batch(self, x, y):
         """Run one training step; returns the (replicated) mean loss."""

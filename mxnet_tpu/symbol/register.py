@@ -32,6 +32,12 @@ def _make_fn(op):
                 params[p] = v
         if named:
             input_names = op.input_names_for(params)
+            # reference convention: every op's first input is addressable
+            # as ``data=`` (e.g. sym.Flatten(data=x) where the op's own
+            # input name is 'x')
+            if "data" in named and "data" not in input_names \
+                    and input_names and input_names[0] not in named:
+                named[input_names[0]] = named.pop("data")
             by_name = {}
             for i, s in enumerate(inputs):
                 by_name[i] = s

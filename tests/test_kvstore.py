@@ -19,10 +19,11 @@ def test_local_push_pull():
     out = nd.zeros((2, 3))
     kv.pull(3, out=out)
     np.testing.assert_allclose(out.asnumpy(), np.ones((2, 3)))
-    # push accumulates by default (no updater -> +=)
+    # push REPLACES by default (reference kvstore_local.h PushImpl:
+    # ``local = merged`` when no updater is set)
     kv.push(3, nd.ones((2, 3)) * 4)
     kv.pull(3, out=out)
-    np.testing.assert_allclose(out.asnumpy(), np.full((2, 3), 5.0))
+    np.testing.assert_allclose(out.asnumpy(), np.full((2, 3), 4.0))
 
 
 def test_local_push_multiple_values():
@@ -126,7 +127,16 @@ from mxnet_tpu import nd
 rank = int(os.environ["DMLC_WORKER_RANK"])
 kv = mx.kv.create(os.environ["KV_TYPE"])
 kv.init("w", nd.zeros((4,)))
-kv.push("w", nd.ones((4,)) * (rank + 1))
+if "async" in os.environ["KV_TYPE"]:
+    # async mode applies updates through the server-side optimizer as
+    # pushes arrive (reference kvstore_dist_server.h asserts an updater
+    # exists in async mode); push grads of -(rank+1) so SGD with lr=1
+    # accumulates w = 1 + 2 = 3 regardless of arrival order
+    kv.set_optimizer(mx.optimizer.create(
+        "sgd", learning_rate=1.0, rescale_grad=1.0, wd=0.0))
+    kv.push("w", nd.ones((4,)) * -(rank + 1))
+else:
+    kv.push("w", nd.ones((4,)) * (rank + 1))
 kv.barrier()
 out = nd.zeros((4,))
 kv.pull("w", out=out)
