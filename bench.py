@@ -95,7 +95,6 @@ def _probe_peak_flops(iters=40, n=8192):
 
 def main():
     import jax
-    import jax.numpy as jnp
     import mxnet_tpu as mx
     from mxnet_tpu import gluon
     from mxnet_tpu.gluon.model_zoo import vision

@@ -366,8 +366,16 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized
         if self.optimizer_initialized and not force_init:
             return
+        self._kvstore, self._update_on_kvstore = self._create_kvstore(
+            kvstore, len(self._context))
         if isinstance(optimizer, str):
             batch_size = self._exec_group.batch_size
+            if self._kvstore is not None and \
+                    "dist" in getattr(self._kvstore, "type", ""):
+                # reference module.py init_optimizer: dist servers sum
+                # all workers' gradient sums, so the mean is over the
+                # GLOBAL batch
+                batch_size *= self._kvstore.num_workers
             idx2name = {i: n for i, n in
                         enumerate(self._exec_group.param_names)}
             optimizer_params = dict(optimizer_params)
@@ -377,12 +385,15 @@ class Module(BaseModule):
             optimizer = opt.create(optimizer, param_idx2name=idx2name,
                                    **optimizer_params)
         self._optimizer = optimizer
-        self._kvstore, self._update_on_kvstore = self._create_kvstore(
-            kvstore, len(self._context))
         if self._kvstore is not None:
-            ex0 = self._exec_group.execs[0]
-            for i, name in enumerate(self._exec_group.param_names):
-                self._kvstore.init(i, ex0.arg_dict[name])
+            group = self._exec_group
+            for i, name in enumerate(group.param_names):
+                self._kvstore.init(i, group.execs[0].arg_dict[name])
+                # all workers/devices start from the stored copy (rank
+                # 0's weights) — reference model.py _initialize_kvstore
+                # pulls right after init when update_on_kvstore
+                self._kvstore.pull(
+                    i, out=[ex.arg_dict[name] for ex in group.execs])
             if self._update_on_kvstore:
                 self._kvstore.set_optimizer(self._optimizer)
         if self._kvstore is not None and self._update_on_kvstore:

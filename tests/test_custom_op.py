@@ -1,0 +1,136 @@
+"""Custom-op bridge tests (mxnet_tpu/operator.py).
+
+Reference: python/mxnet/operator.py (CustomOp/CustomOpProp/register) and
+its coverage in tests/python/unittest/test_operator.py test_custom_op —
+imperative call, symbolic graph, gradient flow, hybridized block.
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu import operator as op_mod
+
+
+@op_mod.register("sqr")
+class SqrProp(op_mod.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        class Sqr(op_mod.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                self.assign(out_data[0], req[0], in_data[0] * in_data[0])
+
+            def backward(self, req, out_grad, in_data, out_data,
+                         in_grad, aux):
+                self.assign(in_grad[0], req[0],
+                            2 * in_data[0] * out_grad[0])
+        return Sqr()
+
+
+@op_mod.register("twoout")
+class TwoOutProp(op_mod.CustomOpProp):
+    """Two inputs, two outputs: (a+b, a*b)."""
+
+    def list_arguments(self):
+        return ["a", "b"]
+
+    def list_outputs(self):
+        return ["s", "p"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0], in_shape[0]], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        class TwoOut(op_mod.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                a, b = in_data
+                self.assign(out_data[0], req[0], a + b)
+                self.assign(out_data[1], req[1], a * b)
+
+            def backward(self, req, out_grad, in_data, out_data,
+                         in_grad, aux):
+                a, b = in_data
+                gs, gp = out_grad
+                self.assign(in_grad[0], req[0], gs + gp * b)
+                self.assign(in_grad[1], req[1], gs + gp * a)
+        return TwoOut()
+
+
+def test_custom_imperative_forward():
+    x = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    out = nd.Custom(nd.array(x), op_type="sqr")
+    np.testing.assert_allclose(out.asnumpy(), x * x, rtol=1e-6)
+
+
+def test_custom_imperative_gradient():
+    x = nd.array(np.array([1.0, -2.0, 3.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type="sqr")
+        loss = nd.sum(y)
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy(),
+                               rtol=1e-6)
+
+
+def test_custom_symbolic_executor():
+    data = mx.sym.var("data")
+    sym = mx.sym.Custom(data, op_type="sqr", name="csq")
+    x = np.array([[0.5, -1.5]], np.float32)
+    exe = sym.simple_bind(data=x.shape, grad_req="write")
+    outs = exe.forward(is_train=True, data=nd.array(x))
+    np.testing.assert_allclose(outs[0].asnumpy(), x * x, rtol=1e-6)
+    exe.backward(out_grads=[nd.ones(x.shape)])
+    np.testing.assert_allclose(exe.grad_dict["data"].asnumpy(), 2 * x,
+                               rtol=1e-6)
+
+
+def test_custom_multi_output():
+    a = np.array([1.0, 2.0], np.float32)
+    b = np.array([3.0, 5.0], np.float32)
+    s, p = nd.Custom(nd.array(a), nd.array(b), op_type="twoout")
+    np.testing.assert_allclose(s.asnumpy(), a + b, rtol=1e-6)
+    np.testing.assert_allclose(p.asnumpy(), a * b, rtol=1e-6)
+
+
+def test_custom_multi_output_gradient():
+    a = nd.array(np.array([1.0, 2.0], np.float32))
+    b = nd.array(np.array([3.0, 5.0], np.float32))
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        s, p = nd.Custom(a, b, op_type="twoout")
+        loss = nd.sum(s) + nd.sum(p * p)
+    loss.backward()
+    # dL/da = 1 + 2*p*b ; dL/db = 1 + 2*p*a
+    pv = a.asnumpy() * b.asnumpy()
+    np.testing.assert_allclose(a.grad.asnumpy(), 1 + 2 * pv * b.asnumpy(),
+                               rtol=1e-5)
+    np.testing.assert_allclose(b.grad.asnumpy(), 1 + 2 * pv * a.asnumpy(),
+                               rtol=1e-5)
+
+
+def test_custom_inside_jitted_graph():
+    """Custom op composes with the whole-graph compiled executor between
+    native ops (pure_callback is scheduled by XLA like any other op)."""
+    data = mx.sym.var("data")
+    h = mx.sym.tanh(data)
+    c = mx.sym.Custom(h, op_type="sqr")
+    out = mx.sym.sum(c)
+    x = np.array([[0.3, -0.7]], np.float32)
+    exe = out.simple_bind(data=x.shape, grad_req="write")
+    o = exe.forward(is_train=True, data=nd.array(x))
+    np.testing.assert_allclose(o[0].asnumpy(), np.sum(np.tanh(x) ** 2),
+                               rtol=1e-5)
+    exe.backward(out_grads=[nd.ones(())])
+    expected = 2 * np.tanh(x) * (1 - np.tanh(x) ** 2)
+    np.testing.assert_allclose(exe.grad_dict["data"].asnumpy(), expected,
+                               rtol=1e-4)
+
+
+def test_custom_unregistered_raises():
+    with pytest.raises(mx.MXNetError):
+        nd.Custom(nd.ones((2,)), op_type="nope_not_registered")

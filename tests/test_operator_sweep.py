@@ -1,0 +1,745 @@
+"""Registry-wide operator sweep.
+
+Reference: tests/python/unittest/test_operator.py (7,213 LoC of per-op
+numeric checks).  This sweep is table-driven instead: every case is
+(op, config, oracle) and runs through the same three oracles the
+reference uses — forward vs numpy, central-finite-difference gradients
+(mxnet_tpu.test_utils.check_numeric_gradient), and low-precision dtype
+consistency.
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+RS = np.random.RandomState
+
+
+# ---------------------------------------------------------------------------
+# 1. elementwise unary: forward vs numpy (+ FD grad for the smooth ones)
+# ---------------------------------------------------------------------------
+
+def _np_rcbrt(x):
+    return 1.0 / np.cbrt(x)
+
+
+def _np_softrelu(x):
+    return np.log1p(np.exp(x))
+
+
+def _np_softsign(x):
+    return x / (1 + np.abs(x))
+
+
+def _np_sigmoid(x):
+    return 1 / (1 + np.exp(-x))
+
+
+# (op, numpy fn, (lo, hi) sample range, smooth-for-FD)
+UNARY = [
+    ("abs", np.abs, (-2, 2), False),
+    ("sign", np.sign, (-2, 2), False),
+    ("negative", lambda x: -x, (-2, 2), True),
+    ("reciprocal", lambda x: 1 / x, (0.5, 2), True),
+    ("square", np.square, (-2, 2), True),
+    ("sqrt", np.sqrt, (0.1, 4), True),
+    ("rsqrt", lambda x: 1 / np.sqrt(x), (0.5, 4), True),
+    ("cbrt", np.cbrt, (0.1, 4), True),
+    ("rcbrt", _np_rcbrt, (0.5, 4), True),
+    ("exp", np.exp, (-2, 2), True),
+    ("expm1", np.expm1, (-1, 1), True),
+    ("log", np.log, (0.2, 4), True),
+    ("log2", np.log2, (0.2, 4), True),
+    ("log10", np.log10, (0.2, 4), True),
+    ("log1p", np.log1p, (-0.5, 2), True),
+    ("sin", np.sin, (-2, 2), True),
+    ("cos", np.cos, (-2, 2), True),
+    ("tan", np.tan, (-1, 1), True),
+    ("arcsin", np.arcsin, (-0.9, 0.9), True),
+    ("arccos", np.arccos, (-0.9, 0.9), True),
+    ("arctan", np.arctan, (-2, 2), True),
+    ("sinh", np.sinh, (-2, 2), True),
+    ("cosh", np.cosh, (-2, 2), True),
+    ("tanh", np.tanh, (-2, 2), True),
+    ("arcsinh", np.arcsinh, (-2, 2), True),
+    ("arccosh", np.arccosh, (1.2, 4), True),
+    ("arctanh", np.arctanh, (-0.9, 0.9), True),
+    ("floor", np.floor, (-3, 3), False),
+    ("ceil", np.ceil, (-3, 3), False),
+    ("trunc", np.trunc, (-3, 3), False),
+    ("rint", np.rint, (-3, 3), False),
+    ("fix", np.trunc, (-3, 3), False),
+    ("round", lambda x: np.round(x), (-3, 3), False),
+    ("sigmoid", _np_sigmoid, (-3, 3), True),
+    ("relu", lambda x: np.maximum(x, 0), (-2, 2), False),
+    ("softsign", _np_softsign, (-2, 2), True),
+    ("softrelu", _np_softrelu, (-2, 2), True),
+    ("erf", None, (-2, 2), True),           # scipy-free: checked via grad
+    ("gamma", None, (0.5, 3), True),
+    ("gammaln", None, (0.5, 3), True),
+    ("degrees", np.degrees, (-2, 2), True),
+    ("radians", np.radians, (-90, 90), True),
+    ("logical_not", lambda x: (x == 0).astype(np.float32), (-1, 1), False),
+    ("isnan", np.isnan, (-1, 1), False),
+    ("isinf", np.isinf, (-1, 1), False),
+    ("isfinite", np.isfinite, (-1, 1), False),
+    ("identity", lambda x: x, (-2, 2), True),
+]
+
+
+@pytest.mark.parametrize("op,np_fn,rng,_smooth", UNARY,
+                         ids=[c[0] for c in UNARY])
+def test_unary_forward(op, np_fn, rng, _smooth):
+    x = RS(0).uniform(rng[0], rng[1], (3, 4)).astype(np.float32)
+    out = getattr(nd, op)(nd.array(x)).asnumpy()
+    if np_fn is None:
+        assert out.shape == x.shape and np.isfinite(out).all()
+        return
+    expected = np_fn(x)
+    np.testing.assert_allclose(out, expected.astype(out.dtype),
+                               rtol=1e-5, atol=1e-6)
+
+
+SMOOTH_UNARY = [c for c in UNARY if c[3] and c[0] not in ("identity",)]
+
+
+@pytest.mark.parametrize("op,_np,rng,_s", SMOOTH_UNARY,
+                         ids=[c[0] for c in SMOOTH_UNARY])
+def test_unary_gradient(op, _np, rng, _s):
+    x = RS(1).uniform(rng[0], rng[1], (2, 3)).astype(np.float64)
+    data = mx.sym.var("x")
+    sym = getattr(mx.sym, op)(data)
+    check_numeric_gradient(sym, {"x": x}, rtol=2e-2, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# 2. binary broadcast: forward vs numpy across broadcast shapes
+# ---------------------------------------------------------------------------
+
+BINARY = [
+    ("broadcast_add", np.add, (-2, 2)),
+    ("broadcast_sub", np.subtract, (-2, 2)),
+    ("broadcast_mul", np.multiply, (-2, 2)),
+    ("broadcast_div", np.divide, (0.5, 2)),
+    ("broadcast_mod", np.mod, (1, 5)),
+    ("broadcast_power", np.power, (0.5, 2)),
+    ("broadcast_maximum", np.maximum, (-2, 2)),
+    ("broadcast_minimum", np.minimum, (-2, 2)),
+    ("broadcast_hypot", np.hypot, (-2, 2)),
+    ("broadcast_equal", lambda a, b: (a == b).astype(np.float32), (0, 2)),
+    ("broadcast_not_equal",
+     lambda a, b: (a != b).astype(np.float32), (0, 2)),
+    ("broadcast_greater",
+     lambda a, b: (a > b).astype(np.float32), (-1, 1)),
+    ("broadcast_greater_equal",
+     lambda a, b: (a >= b).astype(np.float32), (-1, 1)),
+    ("broadcast_lesser",
+     lambda a, b: (a < b).astype(np.float32), (-1, 1)),
+    ("broadcast_lesser_equal",
+     lambda a, b: (a <= b).astype(np.float32), (-1, 1)),
+    ("broadcast_logical_and",
+     lambda a, b: ((a != 0) & (b != 0)).astype(np.float32), (0, 2)),
+    ("broadcast_logical_or",
+     lambda a, b: ((a != 0) | (b != 0)).astype(np.float32), (0, 2)),
+    ("broadcast_logical_xor",
+     lambda a, b: ((a != 0) ^ (b != 0)).astype(np.float32), (0, 2)),
+]
+
+BCAST_SHAPES = [((3, 4), (3, 4)), ((3, 4), (1, 4)), ((2, 3, 4), (3, 1)),
+                ((3, 1), (1, 4))]
+
+
+@pytest.mark.parametrize("op,np_fn,rng", BINARY, ids=[c[0] for c in BINARY])
+@pytest.mark.parametrize("shapes", BCAST_SHAPES,
+                         ids=["same", "row", "inner", "outer"])
+def test_binary_broadcast_forward(op, np_fn, rng, shapes):
+    sa, sb = shapes
+    rs = RS(2)
+    a = rs.uniform(rng[0], rng[1], sa).astype(np.float32)
+    b = rs.uniform(rng[0], rng[1], sb).astype(np.float32)
+    if "equal" in op:  # make ties actually occur
+        a = np.round(a)
+        b = np.round(b)
+    out = getattr(nd, op)(nd.array(a), nd.array(b)).asnumpy()
+    np.testing.assert_allclose(out, np_fn(a, b).astype(out.dtype),
+                               rtol=1e-5, atol=1e-6)
+
+
+SMOOTH_BINARY = ["broadcast_add", "broadcast_sub", "broadcast_mul",
+                 "broadcast_div", "broadcast_power", "broadcast_hypot"]
+
+
+@pytest.mark.parametrize("op", SMOOTH_BINARY)
+def test_binary_broadcast_gradient(op):
+    rs = RS(3)
+    a = rs.uniform(0.5, 2, (2, 3)).astype(np.float64)
+    b = rs.uniform(0.5, 2, (1, 3)).astype(np.float64)
+    sym = getattr(mx.sym, op)(mx.sym.var("a"), mx.sym.var("b"))
+    check_numeric_gradient(sym, {"a": a, "b": b}, rtol=2e-2, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# 3. scalar ops through the NDArray operator surface
+# ---------------------------------------------------------------------------
+
+SCALAR_CASES = [
+    (lambda x: x + 2.5, lambda x: x + 2.5),
+    (lambda x: 2.5 + x, lambda x: 2.5 + x),
+    (lambda x: x - 1.5, lambda x: x - 1.5),
+    (lambda x: 1.5 - x, lambda x: 1.5 - x),
+    (lambda x: x * 3.0, lambda x: x * 3.0),
+    (lambda x: x / 2.0, lambda x: x / 2.0),
+    (lambda x: 2.0 / x, lambda x: 2.0 / x),
+    (lambda x: x ** 2.0, lambda x: x ** 2.0),
+    (lambda x: x % 2.0, lambda x: x % 2.0),
+    (lambda x: x > 0.5, lambda x: (x > 0.5).astype(np.float32)),
+    (lambda x: x <= 0.5, lambda x: (x <= 0.5).astype(np.float32)),
+    (lambda x: x == 1.0, lambda x: (x == 1.0).astype(np.float32)),
+]
+
+
+@pytest.mark.parametrize("i", range(len(SCALAR_CASES)))
+def test_scalar_ops(i):
+    fn, np_fn = SCALAR_CASES[i]
+    x = RS(4).uniform(0.5, 2, (3, 4)).astype(np.float32)
+    out = fn(nd.array(x)).asnumpy()
+    np.testing.assert_allclose(out, np_fn(x).astype(out.dtype),
+                               rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# 4. reductions
+# ---------------------------------------------------------------------------
+
+RED_AXES = [None, 0, 1, (0, 1), -1]
+
+
+@pytest.mark.parametrize("op,np_fn", [
+    ("sum", np.sum), ("mean", np.mean), ("prod", np.prod),
+    ("max", np.max), ("min", np.min),
+    ("nansum", np.nansum), ("nanprod", np.nanprod),
+], ids=["sum", "mean", "prod", "max", "min", "nansum", "nanprod"])
+@pytest.mark.parametrize("axis", RED_AXES,
+                         ids=["all", "ax0", "ax1", "ax01", "axm1"])
+@pytest.mark.parametrize("keepdims", [False, True], ids=["nk", "kd"])
+def test_reductions(op, np_fn, axis, keepdims):
+    x = RS(5).uniform(0.5, 1.5, (2, 3, 4)).astype(np.float32)
+    if op.startswith("nan"):
+        x = x.copy()
+        x[0, 0, 0] = np.nan
+    out = getattr(nd, op)(nd.array(x), axis=axis,
+                          keepdims=keepdims).asnumpy()
+    expected = np_fn(x, axis=axis, keepdims=keepdims)
+    np.testing.assert_allclose(out, np.asarray(expected, out.dtype),
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("op,np_fn", [("argmax", np.argmax),
+                                      ("argmin", np.argmin)])
+@pytest.mark.parametrize("axis", [0, 1, -1])
+def test_arg_reductions(op, np_fn, axis):
+    x = RS(6).randn(3, 4, 5).astype(np.float32)
+    out = getattr(nd, op)(nd.array(x), axis=axis).asnumpy()
+    np.testing.assert_allclose(out, np_fn(x, axis=axis).astype(out.dtype))
+
+
+def test_logsumexp():
+    x = RS(7).randn(3, 4).astype(np.float32)
+    out = nd.logsumexp(nd.array(x), axis=1).asnumpy()
+    expected = np.log(np.exp(x).sum(axis=1))
+    np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("ord", [1, 2])
+def test_norm(ord):
+    x = RS(8).randn(3, 4).astype(np.float32)
+    out = nd.norm(nd.array(x), ord=ord, axis=1).asnumpy()
+    expected = np.linalg.norm(x, ord=ord, axis=1)
+    np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# 5. shape / indexing ops
+# ---------------------------------------------------------------------------
+
+def test_shape_ops_block():
+    rs = RS(9)
+    x = rs.randn(2, 3, 4).astype(np.float32)
+    a = nd.array(x)
+    np.testing.assert_allclose(nd.reshape(a, shape=(6, 4)).asnumpy(),
+                               x.reshape(6, 4))
+    np.testing.assert_allclose(nd.reshape(a, shape=(-1, 4)).asnumpy(),
+                               x.reshape(-1, 4))
+    np.testing.assert_allclose(nd.transpose(a).asnumpy(),
+                               x.transpose())
+    np.testing.assert_allclose(
+        nd.transpose(a, axes=(2, 0, 1)).asnumpy(), x.transpose(2, 0, 1))
+    np.testing.assert_allclose(nd.swapaxes(a, dim1=0, dim2=2).asnumpy(),
+                               x.swapaxes(0, 2))
+    np.testing.assert_allclose(nd.expand_dims(a, axis=1).asnumpy(),
+                               np.expand_dims(x, 1))
+    np.testing.assert_allclose(
+        nd.squeeze(nd.expand_dims(a, axis=0)).asnumpy(), x)
+    np.testing.assert_allclose(nd.flip(a, axis=1).asnumpy(),
+                               np.flip(x, 1))
+    np.testing.assert_allclose(nd.reverse(a, axis=2).asnumpy(),
+                               np.flip(x, 2))
+    np.testing.assert_allclose(nd.tile(a, reps=(2, 1, 2)).asnumpy(),
+                               np.tile(x, (2, 1, 2)))
+    np.testing.assert_allclose(nd.repeat(a, repeats=2, axis=1).asnumpy(),
+                               np.repeat(x, 2, 1))
+    np.testing.assert_allclose(
+        nd.slice(a, begin=(0, 1, 1), end=(2, 3, 3)).asnumpy(),
+        x[0:2, 1:3, 1:3])
+    np.testing.assert_allclose(
+        nd.slice_axis(a, axis=2, begin=1, end=3).asnumpy(), x[:, :, 1:3])
+    np.testing.assert_allclose(nd.clip(a, a_min=-0.5, a_max=0.5).asnumpy(),
+                               np.clip(x, -0.5, 0.5))
+    np.testing.assert_allclose(nd.flatten(a).asnumpy(), x.reshape(2, -1))
+
+
+def test_concat_stack_split():
+    rs = RS(10)
+    x = rs.randn(2, 3).astype(np.float32)
+    y = rs.randn(2, 3).astype(np.float32)
+    np.testing.assert_allclose(
+        nd.concat(nd.array(x), nd.array(y), dim=1).asnumpy(),
+        np.concatenate([x, y], 1))
+    np.testing.assert_allclose(
+        nd.stack(nd.array(x), nd.array(y), axis=0).asnumpy(),
+        np.stack([x, y], 0))
+    z = rs.randn(4, 6).astype(np.float32)
+    parts = nd.split(nd.array(z), num_outputs=3, axis=1)
+    for p, e in zip(parts, np.split(z, 3, 1)):
+        np.testing.assert_allclose(p.asnumpy(), e)
+
+
+def test_take_pick_gather():
+    rs = RS(11)
+    x = rs.randn(5, 4).astype(np.float32)
+    idx = np.array([0, 3, 2], np.float32)
+    np.testing.assert_allclose(
+        nd.take(nd.array(x), nd.array(idx)).asnumpy(), x[[0, 3, 2]])
+    picks = np.array([1, 0, 3, 2, 1], np.float32)
+    np.testing.assert_allclose(
+        nd.pick(nd.array(x), nd.array(picks), axis=1).asnumpy(),
+        x[np.arange(5), picks.astype(int)])
+    gidx = np.array([[0, 1, 2], [1, 2, 3]], np.float32)  # (2, N) indices
+    np.testing.assert_allclose(
+        nd.gather_nd(nd.array(x), nd.array(gidx)).asnumpy(),
+        x[[0, 1, 2], [1, 2, 3]])
+    bt = nd.batch_take(nd.array(x), nd.array([1, 2, 0, 3, 1],
+                                             dtype=np.int32)).asnumpy()
+    np.testing.assert_allclose(
+        bt, x[np.arange(5), [1, 2, 0, 3, 1]])
+
+
+def test_one_hot_where_diag():
+    idx = np.array([0, 2, 1], np.float32)
+    np.testing.assert_allclose(
+        nd.one_hot(nd.array(idx), depth=4).asnumpy(),
+        np.eye(4, dtype=np.float32)[idx.astype(int)])
+    rs = RS(12)
+    c = (rs.rand(3, 4) > 0.5).astype(np.float32)
+    a = rs.randn(3, 4).astype(np.float32)
+    b = rs.randn(3, 4).astype(np.float32)
+    np.testing.assert_allclose(
+        nd.where(nd.array(c), nd.array(a), nd.array(b)).asnumpy(),
+        np.where(c != 0, a, b))
+    d = rs.randn(4, 4).astype(np.float32)
+    np.testing.assert_allclose(nd.diag(nd.array(d)).asnumpy(), np.diag(d))
+
+
+def test_space_depth_roundtrip():
+    rs = RS(13)
+    x = rs.randn(1, 4, 2, 2).astype(np.float32)
+    d2s = nd.depth_to_space(nd.array(x), block_size=2)
+    assert d2s.shape == (1, 1, 4, 4)
+    back = nd.space_to_depth(d2s, block_size=2)
+    np.testing.assert_allclose(back.asnumpy(), x, rtol=1e-6)
+
+
+def test_ravel_unravel():
+    idx = np.array([[0, 1, 2], [3, 2, 1]], np.float32)  # (ndim, N)
+    shape = (4, 5)
+    rav = nd.ravel_multi_index(nd.array(idx), shape=shape).asnumpy()
+    expected = np.ravel_multi_index(idx.astype(int), shape)
+    np.testing.assert_allclose(rav, expected)
+    unr = nd.unravel_index(nd.array(expected.astype(np.float32)),
+                           shape=shape).asnumpy()
+    np.testing.assert_allclose(unr, np.array(
+        np.unravel_index(expected, shape)))
+
+
+# ---------------------------------------------------------------------------
+# 6. ordering ops
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("axis", [0, 1, -1])
+@pytest.mark.parametrize("is_ascend", [True, False])
+def test_sort(axis, is_ascend):
+    x = RS(14).randn(4, 5).astype(np.float32)
+    out = nd.sort(nd.array(x), axis=axis, is_ascend=is_ascend).asnumpy()
+    expected = np.sort(x, axis=axis)
+    if not is_ascend:
+        expected = np.flip(expected, axis=axis)
+    np.testing.assert_allclose(out, expected)
+
+
+def test_argsort_topk():
+    x = RS(15).randn(3, 6).astype(np.float32)
+    out = nd.argsort(nd.array(x), axis=1).asnumpy()
+    np.testing.assert_allclose(out, np.argsort(x, 1, kind="stable"))
+    # topk returns indices of the k largest by default
+    topk = nd.topk(nd.array(x), axis=1, k=2).asnumpy()
+    expected = np.argsort(-x, 1, kind="stable")[:, :2]
+    np.testing.assert_allclose(topk, expected)
+    vals = nd.topk(nd.array(x), axis=1, k=2, ret_typ="value").asnumpy()
+    np.testing.assert_allclose(vals, -np.sort(-x, 1)[:, :2])
+
+
+# ---------------------------------------------------------------------------
+# 7. linalg vs numpy
+# ---------------------------------------------------------------------------
+
+def _spd(n, seed):
+    a = RS(seed).randn(n, n)
+    return (a @ a.T + n * np.eye(n)).astype(np.float32)
+
+
+def test_linalg_gemm2():
+    rs = RS(16)
+    a = rs.randn(2, 3, 4).astype(np.float32)
+    b = rs.randn(2, 4, 5).astype(np.float32)
+    out = nd.linalg_gemm2(nd.array(a), nd.array(b)).asnumpy()
+    np.testing.assert_allclose(out, a @ b, rtol=1e-4, atol=1e-5)
+    outT = nd.linalg_gemm2(nd.array(a), nd.array(b.swapaxes(1, 2)),
+                           transpose_b=True).asnumpy()
+    np.testing.assert_allclose(outT, a @ b, rtol=1e-4, atol=1e-5)
+
+
+def test_linalg_potrf_potri():
+    a = _spd(4, 17)
+    l = nd.linalg_potrf(nd.array(a)).asnumpy()
+    np.testing.assert_allclose(l, np.linalg.cholesky(a), rtol=1e-4,
+                               atol=1e-4)
+    ainv = nd.linalg_potri(nd.array(np.linalg.cholesky(a).astype(
+        np.float32))).asnumpy()
+    np.testing.assert_allclose(ainv, np.linalg.inv(a), rtol=1e-3,
+                               atol=1e-3)
+
+
+def test_linalg_trmm_trsm():
+    a = np.tril(RS(18).randn(3, 3)).astype(np.float32)
+    a += 3 * np.eye(3, dtype=np.float32)
+    b = RS(19).randn(3, 4).astype(np.float32)
+    out = nd.linalg_trmm(nd.array(a), nd.array(b)).asnumpy()
+    np.testing.assert_allclose(out, a @ b, rtol=1e-4, atol=1e-5)
+    sol = nd.linalg_trsm(nd.array(a), nd.array(a @ b)).asnumpy()
+    np.testing.assert_allclose(sol, b, rtol=1e-3, atol=1e-3)
+
+
+def test_linalg_syrk_sumlogdiag():
+    a = RS(20).randn(3, 4).astype(np.float32)
+    out = nd.linalg_syrk(nd.array(a)).asnumpy()
+    np.testing.assert_allclose(out, a @ a.T, rtol=1e-4, atol=1e-5)
+    spd = _spd(4, 21)
+    l = np.linalg.cholesky(spd).astype(np.float32)
+    sld = nd.linalg_sumlogdiag(nd.array(l)).asnumpy()
+    np.testing.assert_allclose(sld, np.log(np.diag(l)).sum(), rtol=1e-5)
+
+
+def test_linalg_syevd_gelqf():
+    spd = _spd(4, 22)
+    u, lam = nd.linalg_syevd(nd.array(spd))
+    lam_np = np.linalg.eigvalsh(spd)
+    np.testing.assert_allclose(np.sort(lam.asnumpy()), np.sort(lam_np),
+                               rtol=1e-3, atol=1e-3)
+    # reconstruction: U^T diag(lam) U  (rows of U are eigenvectors)
+    rec = u.asnumpy().T @ np.diag(lam.asnumpy()) @ u.asnumpy()
+    np.testing.assert_allclose(rec, spd, rtol=1e-2, atol=1e-2)
+    a = RS(23).randn(3, 5).astype(np.float32)
+    q, l = nd.linalg_gelqf(nd.array(a))
+    np.testing.assert_allclose(l.asnumpy() @ q.asnumpy(), a, rtol=1e-3,
+                               atol=1e-3)
+    np.testing.assert_allclose(q.asnumpy() @ q.asnumpy().T, np.eye(3),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_dot_variants():
+    rs = RS(24)
+    a = rs.randn(3, 4).astype(np.float32)
+    b = rs.randn(4, 5).astype(np.float32)
+    np.testing.assert_allclose(nd.dot(nd.array(a), nd.array(b)).asnumpy(),
+                               a @ b, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        nd.dot(nd.array(a.T), nd.array(b), transpose_a=True).asnumpy(),
+        a @ b, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        nd.dot(nd.array(a), nd.array(b.T), transpose_b=True).asnumpy(),
+        a @ b, rtol=1e-4, atol=1e-5)
+    ab = rs.randn(2, 3, 4).astype(np.float32)
+    bb = rs.randn(2, 4, 5).astype(np.float32)
+    np.testing.assert_allclose(
+        nd.batch_dot(nd.array(ab), nd.array(bb)).asnumpy(), ab @ bb,
+        rtol=1e-4, atol=1e-5)
+
+
+def test_khatri_rao():
+    a = RS(25).randn(2, 3).astype(np.float32)
+    b = RS(26).randn(4, 3).astype(np.float32)
+    out = nd.khatri_rao(nd.array(a), nd.array(b)).asnumpy()
+    expected = np.einsum("ik,jk->ijk", a, b).reshape(-1, 3)
+    np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# 8. NN op gradients (FD) — tiny shapes, float64
+# ---------------------------------------------------------------------------
+
+def _fd(sym, loc, aux=None, rtol=3e-2, atol=1e-3):
+    check_numeric_gradient(sym, loc, aux_states=aux, rtol=rtol, atol=atol)
+
+
+def test_fc_gradient():
+    rs = RS(30)
+    _fd(mx.sym.FullyConnected(data=mx.sym.var("x"), num_hidden=3,
+                              name="fc"),
+        {"x": rs.randn(2, 4), "fc_weight": rs.randn(3, 4) * 0.5,
+         "fc_bias": rs.randn(3) * 0.1})
+
+
+@pytest.mark.parametrize("stride,pad,dilate", [
+    ((1, 1), (0, 0), (1, 1)),
+    ((2, 2), (1, 1), (1, 1)),
+    ((1, 1), (1, 1), (2, 2)),
+], ids=["s1", "s2p1", "d2"])
+def test_conv_gradient(stride, pad, dilate):
+    rs = RS(31)
+    sym = mx.sym.Convolution(data=mx.sym.var("x"), kernel=(3, 3),
+                             num_filter=2, stride=stride, pad=pad,
+                             dilate=dilate, name="cv")
+    _fd(sym, {"x": rs.randn(1, 2, 7, 7) * 0.5,
+              "cv_weight": rs.randn(2, 2, 3, 3) * 0.3,
+              "cv_bias": rs.randn(2) * 0.1})
+
+
+def test_conv_grouped_gradient():
+    rs = RS(32)
+    sym = mx.sym.Convolution(data=mx.sym.var("x"), kernel=(3, 3),
+                             num_filter=4, num_group=2, name="cv")
+    _fd(sym, {"x": rs.randn(1, 4, 5, 5) * 0.5,
+              "cv_weight": rs.randn(4, 2, 3, 3) * 0.3,
+              "cv_bias": rs.randn(4) * 0.1})
+
+
+def test_deconv_gradient():
+    rs = RS(33)
+    sym = mx.sym.Deconvolution(data=mx.sym.var("x"), kernel=(3, 3),
+                               num_filter=2, stride=(2, 2), name="dc")
+    _fd(sym, {"x": rs.randn(1, 2, 4, 4) * 0.5,
+              "dc_weight": rs.randn(2, 2, 3, 3) * 0.3})
+
+
+@pytest.mark.parametrize("pool_type", ["max", "avg"])
+@pytest.mark.parametrize("global_pool", [False, True], ids=["loc", "glob"])
+def test_pooling_gradient(pool_type, global_pool):
+    rs = RS(34)
+    sym = mx.sym.Pooling(data=mx.sym.var("x"), kernel=(2, 2),
+                         stride=(2, 2), pool_type=pool_type,
+                         global_pool=global_pool)
+    _fd(sym, {"x": rs.randn(1, 2, 4, 4)})
+
+
+def test_batchnorm_gradient():
+    rs = RS(35)
+    sym = mx.sym.BatchNorm(data=mx.sym.var("x"), fix_gamma=False,
+                           use_global_stats=False, name="bn")
+    loc = {"x": rs.randn(4, 3, 2, 2), "bn_gamma": np.abs(rs.randn(3)) + 0.5,
+           "bn_beta": rs.randn(3) * 0.1}
+    aux = {"bn_moving_mean": np.zeros(3), "bn_moving_var": np.ones(3)}
+    check_numeric_gradient(sym, loc, aux_states=aux,
+                           grad_nodes=["x", "bn_gamma", "bn_beta"],
+                           rtol=5e-2, atol=2e-3)
+
+
+def test_layernorm_instancenorm_l2norm_gradient():
+    rs = RS(36)
+    _fd(mx.sym.LayerNorm(data=mx.sym.var("x"), name="ln"),
+        {"x": rs.randn(3, 5), "ln_gamma": np.abs(rs.randn(5)) + 0.5,
+         "ln_beta": rs.randn(5) * 0.1}, rtol=5e-2)
+    _fd(mx.sym.InstanceNorm(data=mx.sym.var("x"), name="in"),
+        {"x": rs.randn(2, 3, 4), "in_gamma": np.abs(rs.randn(3)) + 0.5,
+         "in_beta": rs.randn(3) * 0.1}, rtol=5e-2)
+    _fd(mx.sym.L2Normalization(data=mx.sym.var("x")),
+        {"x": rs.randn(3, 4) + 0.5}, rtol=5e-2)
+
+
+@pytest.mark.parametrize("act", ["relu", "sigmoid", "tanh", "softrelu",
+                                 "softsign"])
+def test_activation_gradient(act):
+    rs = RS(37)
+    _fd(mx.sym.Activation(data=mx.sym.var("x"), act_type=act),
+        {"x": rs.randn(3, 4) + 0.1})
+
+
+@pytest.mark.parametrize("act", ["leaky", "elu", "prelu", "selu", "gelu"])
+def test_leakyrelu_gradient(act):
+    rs = RS(38)
+    loc = {"x": rs.randn(3, 4) + 0.05}
+    sym = mx.sym.LeakyReLU(data=mx.sym.var("x"), act_type=act, name="lr")
+    if act == "prelu":
+        loc["lr_gamma"] = np.abs(rs.randn(4)) * 0.25
+    _fd(sym, loc)
+
+
+@pytest.mark.parametrize("op", ["softmax", "log_softmax", "softmin"])
+def test_softmax_family_gradient(op):
+    rs = RS(39)
+    _fd(getattr(mx.sym, op)(mx.sym.var("x"), axis=-1),
+        {"x": rs.randn(3, 5)})
+
+
+def test_embedding_gradient():
+    rs = RS(40)
+    sym = mx.sym.Embedding(data=mx.sym.var("idx"),
+                           weight=mx.sym.var("w"),
+                           input_dim=6, output_dim=3)
+    idx = np.array([[0, 2], [5, 1]], np.float64)
+    check_numeric_gradient(sym, {"idx": idx, "w": rs.randn(6, 3)},
+                           grad_nodes=["w"], rtol=2e-2, atol=1e-4)
+
+
+@pytest.mark.parametrize("mode", ["constant", "edge", "reflect"])
+def test_pad_gradient(mode):
+    rs = RS(41)
+    sym = mx.sym.Pad(data=mx.sym.var("x"), mode=mode,
+                     pad_width=(0, 0, 0, 0, 1, 1, 1, 1))
+    _fd(sym, {"x": rs.randn(1, 2, 3, 3)})
+
+
+def test_upsampling_forward_and_gradient():
+    rs = RS(42)
+    x = rs.randn(1, 2, 3, 3)
+    sym = mx.sym.UpSampling(mx.sym.var("x"), scale=2,
+                            sample_type="nearest")
+    out = nd.UpSampling(nd.array(x.astype(np.float32)), scale=2,
+                        sample_type="nearest").asnumpy()
+    np.testing.assert_allclose(out, x.repeat(2, 2).repeat(2, 3), rtol=1e-6)
+    _fd(sym, {"x": x})
+
+
+def test_sequence_ops():
+    rs = RS(43)
+    x = rs.randn(4, 2, 3).astype(np.float32)  # (seq, batch, feat)
+    slen = np.array([2, 4], np.float32)
+    masked = nd.SequenceMask(nd.array(x), nd.array(slen),
+                             use_sequence_length=True).asnumpy()
+    assert np.all(masked[2:, 0] == 0) and np.all(masked[:, 1] == x[:, 1])
+    last = nd.SequenceLast(nd.array(x), nd.array(slen),
+                           use_sequence_length=True).asnumpy()
+    np.testing.assert_allclose(last[0], x[1, 0], rtol=1e-6)
+    np.testing.assert_allclose(last[1], x[3, 1], rtol=1e-6)
+    rev = nd.SequenceReverse(nd.array(x), nd.array(slen),
+                             use_sequence_length=True).asnumpy()
+    np.testing.assert_allclose(rev[0, 0], x[1, 0], rtol=1e-6)
+    np.testing.assert_allclose(rev[:, 1], x[::-1, 1], rtol=1e-6)
+
+
+def test_smooth_l1_and_losses():
+    rs = RS(44)
+    x = rs.randn(3, 4).astype(np.float32)
+    out = nd.smooth_l1(nd.array(x), scalar=1.0).asnumpy()
+    expected = np.where(np.abs(x) < 1, 0.5 * x * x, np.abs(x) - 0.5)
+    np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-6)
+    _fd(mx.sym.smooth_l1(mx.sym.var("x"), scalar=1.0),
+        {"x": rs.randn(2, 3) + 0.1})
+
+
+def test_softmax_cross_entropy():
+    rs = RS(45)
+    logits = rs.randn(3, 5).astype(np.float32)
+    labels = np.array([1, 0, 4], np.float32)
+    out = nd.softmax_cross_entropy(nd.array(logits),
+                                   nd.array(labels)).asnumpy()
+    p = np.exp(logits - logits.max(1, keepdims=True))
+    p /= p.sum(1, keepdims=True)
+    expected = -np.log(p[np.arange(3), labels.astype(int)]).sum()
+    np.testing.assert_allclose(out, expected, rtol=1e-4)
+
+
+def test_dropout_modes():
+    x = nd.array(np.ones((100, 100), np.float32))
+    out = nd.Dropout(x, p=0.5, training=False).asnumpy()
+    np.testing.assert_allclose(out, 1.0)
+    out_t = nd.Dropout(x, p=0.5, training=True).asnumpy()
+    kept = out_t != 0
+    assert 0.3 < kept.mean() < 0.7
+    np.testing.assert_allclose(out_t[kept], 2.0, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# 9. dtype sweeps: bf16 / f16 forward consistency vs f32
+# ---------------------------------------------------------------------------
+
+LOWP_UNARY = ["exp", "sigmoid", "tanh", "relu", "sqrt", "square", "log"]
+
+
+@pytest.mark.parametrize("op", LOWP_UNARY)
+@pytest.mark.parametrize("dtype,tol", [("float16", 2e-3),
+                                       ("bfloat16", 2e-2)],
+                         ids=["f16", "bf16"])
+def test_unary_low_precision(op, dtype, tol):
+    x = RS(50).uniform(0.3, 2.0, (4, 8)).astype(np.float32)
+    ref = getattr(nd, op)(nd.array(x)).asnumpy()
+    xl = nd.cast(nd.array(x), dtype=dtype)
+    out = getattr(nd, op)(xl)
+    assert str(out.dtype) == dtype, (op, out.dtype)
+    np.testing.assert_allclose(
+        nd.cast(out, dtype="float32").asnumpy(), ref, rtol=tol, atol=tol)
+
+
+@pytest.mark.parametrize("dtype,tol", [("float16", 4e-3),
+                                       ("bfloat16", 3e-2)],
+                         ids=["f16", "bf16"])
+def test_matmul_low_precision(dtype, tol):
+    rs = RS(51)
+    a = rs.randn(8, 16).astype(np.float32) * 0.25
+    b = rs.randn(16, 8).astype(np.float32) * 0.25
+    ref = a @ b
+    out = nd.dot(nd.cast(nd.array(a), dtype=dtype),
+                 nd.cast(nd.array(b), dtype=dtype))
+    assert str(out.dtype) == dtype
+    np.testing.assert_allclose(nd.cast(out, dtype="float32").asnumpy(),
+                               ref, rtol=tol, atol=tol)
+
+
+@pytest.mark.parametrize("dtype", ["float16", "bfloat16", "float32",
+                                   "int32", "uint8"])
+def test_cast_roundtrip(dtype):
+    x = RS(52).randint(0, 100, (3, 4)).astype(np.float32)
+    out = nd.cast(nd.cast(nd.array(x), dtype=dtype), dtype="float32")
+    np.testing.assert_allclose(out.asnumpy(), x)
+
+
+# ---------------------------------------------------------------------------
+# 10. init / creation ops
+# ---------------------------------------------------------------------------
+
+def test_creation_ops():
+    np.testing.assert_allclose(nd.zeros((2, 3)).asnumpy(), 0)
+    np.testing.assert_allclose(nd.ones((2, 3)).asnumpy(), 1)
+    np.testing.assert_allclose(nd.arange(1, 7, 2).asnumpy(), [1, 3, 5])
+    x = nd.array(RS(53).randn(2, 3).astype(np.float32))
+    np.testing.assert_allclose(nd.zeros_like(x).asnumpy(), 0)
+    np.testing.assert_allclose(nd.ones_like(x).asnumpy(), 1)
+
+
+def test_histogram():
+    x = np.array([0.1, 0.4, 0.6, 0.9, 0.2], np.float32)
+    cnt, edges = nd.histogram(nd.array(x), bin_cnt=2, range=(0.0, 1.0))
+    np.testing.assert_allclose(cnt.asnumpy(), [3, 2])
+    np.testing.assert_allclose(edges.asnumpy(), [0, 0.5, 1.0])

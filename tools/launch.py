@@ -41,8 +41,10 @@ def main(argv=None):
     parser.add_argument("-n", "--num-workers", type=int, required=True,
                         help="number of worker processes")
     parser.add_argument("-s", "--num-servers", type=int, default=1,
-                        help="number of server processes (key sharding "
-                             "uses one server today)")
+                        help="number of server processes (only 1 is "
+                             "supported: keys are not sharded across "
+                             "servers yet and all roles share one root "
+                             "port)")
     parser.add_argument("--launcher", default="local",
                         choices=["local"],
                         help="only the local (single-host multi-process) "
@@ -56,6 +58,10 @@ def main(argv=None):
     args = parser.parse_args(argv)
     if not args.command:
         parser.error("no command given")
+    if args.num_servers != 1:
+        parser.error("-s/--num-servers must be 1: multi-server key "
+                     "sharding is not implemented, and a second server "
+                     "on the same root port would die at bind")
     command = args.command
     if command[0] == "--":
         command = command[1:]
@@ -74,12 +80,14 @@ def main(argv=None):
 
     procs = []
     try:
+        servers = []
         for i in range(args.num_servers):
             env = dict(base_env)
             env["DMLC_ROLE"] = "server"
             env["DMLC_SERVER_ID"] = str(i)
-            procs.append(("server%d" % i, subprocess.Popen(
+            servers.append(("server%d" % i, subprocess.Popen(
                 command, env=env)))
+        procs.extend(servers)
         time.sleep(0.3)  # let the root server bind before workers connect
         workers = []
         for i in range(args.num_workers):
@@ -91,12 +99,25 @@ def main(argv=None):
         procs.extend(workers)
 
         rc = 0
-        for name, p in workers:
-            r = p.wait()
-            if r != 0:
-                print("launch.py: %s exited with code %d" % (name, r),
-                      file=sys.stderr)
-                rc = rc or r
+        pending = dict(workers)
+        while pending:
+            for name, p in list(pending.items()):
+                r = p.poll()
+                if r is None:
+                    continue
+                del pending[name]
+                if r != 0:
+                    print("launch.py: %s exited with code %d" % (name, r),
+                          file=sys.stderr)
+                    rc = rc or r
+            for name, p in servers:
+                r = p.poll()
+                if r is not None and r != 0:
+                    # a dead server deadlocks every worker; fail fast
+                    print("launch.py: %s died with code %d — aborting"
+                          % (name, r), file=sys.stderr)
+                    return r
+            time.sleep(0.2)
         return rc
     finally:
         for name, p in procs:
