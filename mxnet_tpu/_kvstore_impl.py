@@ -183,7 +183,10 @@ class KVStoreLocal(KVStoreBase):
                 src.copyto(o)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        """Pull only requested rows (reference: kvstore_local.h:244)."""
+        """Pull only requested rows (reference: kvstore_local.h:244;
+        row ids are deduplicated first like the reference's Unique pass —
+        duplicate ids in a RowSparseNDArray would double-count under the
+        gradient-sum todense semantics)."""
         from .ndarray import sparse as _sp
         keys, outs = _key_list(key, out)
         rids = _as_list(row_ids)
@@ -192,7 +195,10 @@ class KVStoreLocal(KVStoreBase):
             if not isinstance(src, _sp.RowSparseNDArray):
                 src = _sp.cast_storage(src, "row_sparse")
             for o, rid in zip(os_, rids * len(os_)):
-                retained = _sp.retain(src, rid)
+                rid_np = _np.unique(_np.asarray(
+                    rid.asnumpy() if isinstance(rid, NDArray) else rid,
+                    _np.int64))
+                retained = _sp.retain(src, nd.array(rid_np))
                 o._data = retained._data
                 o._aux = retained._aux
                 o._shape = retained._shape
@@ -242,6 +248,7 @@ _MSG_BARRIER = 3
 _MSG_CMD = 4
 _MSG_STOP = 5
 _MSG_SET_OPT = 6
+_MSG_ROWPULL = 7
 
 
 def _send_msg(sock, obj):
@@ -357,6 +364,15 @@ class KVStoreServer:
                             val, meta["n"]).astype(
                             _np.float32) * meta["threshold"]
                         val = codes.reshape(meta["shape"])
+                    elif meta and meta.get("rsp"):
+                        # row-sparse wire format: (row_ids, row values);
+                        # reconstruct dense for aggregation/updater
+                        # (reference: kvstore_dist_server.h
+                        # DataHandleRowSparse)
+                        idx, vals = val
+                        dense = _np.zeros(meta["shape"], vals.dtype)
+                        _np.add.at(dense, idx, vals)
+                        val = dense
                     try:
                         if self.sync:
                             self._push_sync(key, val)
@@ -372,6 +388,20 @@ class KVStoreServer:
                     with self.lock:
                         arr = self.store[key].asnumpy()
                     _send_msg(conn, ("ok", arr))
+                elif kind == _MSG_ROWPULL:
+                    # server-side row retain: only the requested rows go
+                    # on the wire (reference: kvstore_dist_server.h
+                    # row-sparse pull path).  Out-of-range/negative ids
+                    # return zero rows (retain semantics) instead of
+                    # wrapping or killing the handler thread.
+                    _, key, row_ids = msg
+                    with self.lock:
+                        full = self.store[key].asnumpy()
+                    ids = _np.asarray(row_ids, _np.int64)
+                    valid = (ids >= 0) & (ids < full.shape[0])
+                    rows = full[_np.clip(ids, 0, full.shape[0] - 1)]
+                    rows[~valid] = 0
+                    _send_msg(conn, ("ok", rows))
                 elif kind == _MSG_BARRIER:
                     try:
                         self._barrier()
@@ -509,6 +539,16 @@ class KVStoreDist(KVStoreBase):
             for v in vs[1:]:
                 total = total + v
             from .ndarray import sparse as _sp
+            if isinstance(total, _sp.RowSparseNDArray) and \
+                    not self._compression:
+                # compact wire format: only touched rows travel
+                # (reference: kvstore_dist.h PushRowSparse)
+                meta = {"rsp": True,
+                        "shape": tuple(int(s) for s in total.shape)}
+                arr = (_np.asarray(total._aux[0]),
+                       _np.asarray(total._data))
+                self._rpc((_MSG_PUSH, k, arr, meta))
+                continue
             if isinstance(total, _sp.BaseSparseNDArray):
                 total = total.todense()
             arr = total.asnumpy()
@@ -539,18 +579,34 @@ class KVStoreDist(KVStoreBase):
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         from .ndarray import sparse as _sp
+        import jax.numpy as _jnp
         keys, outs = _key_list(key, out)
         rids = _as_list(row_ids)
         for k, os_ in zip(keys, outs):
-            status = self._rpc((_MSG_PULL, k))
-            full = nd.array(status[1])
-            src = _sp.cast_storage(full, "row_sparse")
+            fetched = {}  # unique rid tuple -> rows, one RPC per set
             for o, rid in zip(os_, rids * len(os_)):
-                retained = _sp.retain(src, rid)
-                o._data = retained._data
-                o._aux = retained._aux
-                o._shape = retained._shape
-                o._stype = "row_sparse"
+                rid_np = _np.unique(_np.asarray(
+                    rid.asnumpy() if isinstance(rid, NDArray) else rid,
+                    _np.int64))
+                cache_key = rid_np.tobytes()
+                if cache_key not in fetched:
+                    # server-side retain: only requested rows come back
+                    fetched[cache_key] = self._rpc(
+                        (_MSG_ROWPULL, k, rid_np))[1]
+                vals = fetched[cache_key]
+                if isinstance(o, _sp.RowSparseNDArray):
+                    o._data = _jnp.asarray(vals)
+                    o._aux = [_jnp.asarray(rid_np.astype(_np.int32))]
+                else:
+                    full_shape = (o.shape if o.shape else None)
+                    rsp = _sp.RowSparseNDArray(
+                        nd.array(vals),
+                        nd.array(rid_np.astype(_np.int32)),
+                        full_shape)
+                    o._data = rsp._data
+                    o._aux = rsp._aux
+                    o._shape = rsp._shape
+                    o._stype = "row_sparse"
 
     def set_optimizer(self, optimizer):
         """Ship the optimizer to the server (reference: kvstore.py

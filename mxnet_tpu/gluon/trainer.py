@@ -143,13 +143,34 @@ class Trainer:
         if not self._kv_initialized:
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
+        from ..ndarray import sparse as _sp
+
+        # Only optimizers with a lazy row-wise kernel may see row-sparse
+        # grads locally (others' fused dense ops would misbroadcast the
+        # (nnz, dim) values array); the dist wire is always safe — the
+        # server reconstructs dense before its updater runs.
+        _lazy_ok = isinstance(self._optimizer, (opt.SGD, opt.AdaGrad)) \
+            and not getattr(self._optimizer, "multi_precision", False)
+
+        def _maybe_sparse(param, grad, for_wire):
+            # Embedding(sparse_grad=True)-style params: the tape computes
+            # the gradient dense (XLA scatter-add); compress to
+            # row_sparse at the framework boundary so the kvstore wire
+            # and the optimizer's lazy row update see only touched rows.
+            if param._grad_stype == "row_sparse" and \
+                    (for_wire or _lazy_ok) and \
+                    not isinstance(grad, _sp.BaseSparseNDArray):
+                return _sp.compress_rowsparse(grad)
+            return grad
+
         if self._kvstore is not None and self._update_on_kvstore:
             # distributed: push grads, pull updated weights (reference:
             # trainer.py _update with update_on_kvstore)
             for i, param in enumerate(self._params):
                 if param.grad_req == "null" or param._data is None:
                     continue
-                self._kvstore.push(i, param.list_grad())
+                self._kvstore.push(i, [_maybe_sparse(param, g, True)
+                                       for g in param.list_grad()])
             self._kvstore.barrier()
             for i, param in enumerate(self._params):
                 if param.grad_req == "null" or param._data is None:
@@ -161,7 +182,7 @@ class Trainer:
                 continue
             for upd, arr, grad in zip(self._updaters, param.list_data(),
                                       param.list_grad()):
-                upd(i, grad, arr)
+                upd(i, _maybe_sparse(param, grad, False), arr)
             # re-mark so subsequent autograd passes see updated weights
             if param._grad is not None:
                 from .. import autograd

@@ -87,7 +87,9 @@ class RowSparseNDArray(BaseSparseNDArray):
     def todense(self):
         out = jnp.zeros(self._shape, self._data.dtype)
         idx = self._aux[0].astype(jnp.int32)
-        return NDArray(out.at[idx].set(self._data))
+        # scatter-ADD, not set: sparse_add may leave duplicate row ids
+        # (kvstore reduce concatenates shards) and their values must sum
+        return NDArray(out.at[idx].add(self._data))
 
     def copy(self):
         return RowSparseNDArray(NDArray(self._data), NDArray(self._aux[0]),
@@ -284,3 +286,75 @@ def sparse_add(a, b):
 def elemwise_mul(a, b):
     return NDArray(a.todense()._data * (b.todense()._data if isinstance(
         b, BaseSparseNDArray) else b._data))
+
+
+def compress_rowsparse(dense_grad, rtol=0.0):
+    """Dense gradient -> RowSparseNDArray keeping only rows with any
+    nonzero entry.  The TPU-native sparse-gradient stance: gradients are
+    COMPUTED dense (XLA scatter-add on the MXU/VPU is the fast path);
+    sparsity is recovered at the framework boundary where it pays —
+    kvstore wire transfer and lazy row-wise optimizer updates
+    (reference: sparse_grad=True Embedding gradients,
+    src/operator/tensor/indexing_op.cc EmbeddingOpBackwardEx)."""
+    import numpy as __np
+    d = dense_grad._data if isinstance(dense_grad, NDArray) else \
+        jnp.asarray(dense_grad)
+    flat = __np.asarray(jnp.abs(d).max(
+        axis=tuple(range(1, d.ndim)))) if d.ndim > 1 else __np.abs(
+        __np.asarray(d))
+    rows = __np.where(flat > rtol)[0].astype(__np.int32)
+    return RowSparseNDArray(NDArray(d[jnp.asarray(rows)]),
+                            NDArray(jnp.asarray(rows)),
+                            tuple(int(s) for s in d.shape))
+
+
+def _prep_row_grad(weight, rsp_grad, rescale_grad, clip_gradient, wd):
+    """Shared row-update preamble: gather touched rows, rescale/clip the
+    sparse gradient, add weight decay on those rows only (the reference's
+    lazy_update semantics: untouched rows see no wd either)."""
+    rows = rsp_grad._aux[0].astype(jnp.int32)
+    g = rsp_grad._data * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    if wd:
+        g = g + wd * weight._data[rows]
+    return rows, g
+
+
+def sgd_row_update(weight, rsp_grad, lr, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0):
+    """Lazy row-wise SGD: touches only the gradient's rows (reference:
+    sgd_update row_sparse path, optimizer_op.cc lazy_update)."""
+    rows, g = _prep_row_grad(weight, rsp_grad, rescale_grad,
+                             clip_gradient, wd)
+    weight._data = weight._data.at[rows].add(
+        (-lr * g).astype(weight._data.dtype))
+    return weight
+
+
+def sgd_mom_row_update(weight, rsp_grad, mom, lr, momentum=0.9, wd=0.0,
+                       rescale_grad=1.0, clip_gradient=-1.0):
+    """Lazy momentum SGD: momentum decays only on touched rows
+    (reference: sgd_mom_update row_sparse semantics)."""
+    rows, g = _prep_row_grad(weight, rsp_grad, rescale_grad,
+                             clip_gradient, wd)
+    m_rows = momentum * mom._data[rows] - lr * g
+    mom._data = mom._data.at[rows].set(m_rows.astype(mom._data.dtype))
+    weight._data = weight._data.at[rows].add(
+        m_rows.astype(weight._data.dtype))
+    return weight, mom
+
+
+def adagrad_row_update(weight, rsp_grad, history, lr, epsilon=1e-7,
+                       wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    """Lazy row-wise AdaGrad (reference: _sparse_adagrad_update,
+    optimizer_op.cc AdagradUpdateEx row_sparse path)."""
+    rows, g = _prep_row_grad(weight, rsp_grad, rescale_grad,
+                             clip_gradient, wd)
+    h_rows = history._data[rows] + jnp.square(g)
+    history._data = history._data.at[rows].set(
+        h_rows.astype(history._data.dtype))
+    weight._data = weight._data.at[rows].add(
+        (-lr * g / (jnp.sqrt(h_rows) + epsilon)).astype(
+            weight._data.dtype))
+    return weight, history

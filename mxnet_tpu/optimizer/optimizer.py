@@ -180,6 +180,19 @@ class SGD(Optimizer):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
         kw = self._common_kwargs(index)
+        from ..ndarray import sparse as _sp
+        if isinstance(grad, _sp.RowSparseNDArray) and self.lazy_update:
+            # lazy row-wise path: only the gradient's rows are touched
+            # (reference: optimizer_op.cc sgd row_sparse lazy_update)
+            if state is not None:
+                _sp.sgd_mom_row_update(weight, grad, state, lr=lr,
+                                       momentum=self.momentum, wd=wd,
+                                       **kw)
+            else:
+                _sp.sgd_row_update(weight, grad, lr=lr, wd=wd, **kw)
+            return
+        if isinstance(grad, _sp.BaseSparseNDArray):
+            grad = grad.todense()
         if state is not None:
             nd.sgd_mom_update(weight, grad, state, out=[weight, state],
                               lr=lr, wd=wd, momentum=self.momentum, **kw)
@@ -190,6 +203,11 @@ class SGD(Optimizer):
         if isinstance(state, tuple) and isinstance(state[1], NDArray) and \
                 state[1].dtype == _np.float32 and \
                 weight.dtype != _np.float32:
+            from ..ndarray import sparse as _sp
+            if isinstance(grad, _sp.BaseSparseNDArray):
+                # the fused mp kernels are dense-only; correctness over
+                # laziness for the fp32-master path
+                grad = grad.todense()
             self._update_count(index)
             lr, wd = self._get_lr(index), self._get_wd(index)
             kw = self._common_kwargs(index)
@@ -440,6 +458,13 @@ class AdaGrad(Optimizer):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
         kw = self._common_kwargs(index)
+        from ..ndarray import sparse as _sp
+        if isinstance(grad, _sp.RowSparseNDArray):
+            _sp.adagrad_row_update(weight, grad, state, lr=lr, wd=wd,
+                                   epsilon=self.float_stable_eps, **kw)
+            return
+        if isinstance(grad, _sp.BaseSparseNDArray):
+            grad = grad.todense()
         nd._sparse_adagrad_update(weight, grad, state, out=[weight, state],
                                   lr=lr, wd=wd,
                                   epsilon=self.float_stable_eps, **kw)

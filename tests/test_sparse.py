@@ -1,0 +1,225 @@
+"""Sparse NDArray + sparse training tests.
+
+Reference: tests/python/unittest/test_sparse_operator.py /
+test_sparse_ndarray.py (2,311 LoC) and
+example/sparse/linear_classification (end-to-end convergence).
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.ndarray import sparse as sp
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rand_csr(rs, rows, cols, density=0.2):
+    dense = rs.randn(rows, cols).astype(np.float32)
+    dense[rs.rand(rows, cols) > density] = 0
+    return sp.csr_matrix(dense, shape=(rows, cols)), dense
+
+
+def test_csr_dot_forward_matches_dense():
+    rs = np.random.RandomState(0)
+    csr, dense = _rand_csr(rs, 6, 8)
+    rhs = rs.randn(8, 3).astype(np.float32)
+    out = sp.dot(csr, nd.array(rhs)).asnumpy()
+    np.testing.assert_allclose(out, dense @ rhs, rtol=1e-5, atol=1e-5)
+
+
+def test_csr_dot_transpose_matches_dense():
+    rs = np.random.RandomState(1)
+    csr, dense = _rand_csr(rs, 6, 8)
+    rhs = rs.randn(6, 3).astype(np.float32)
+    out = sp.dot(csr, nd.array(rhs), transpose_a=True).asnumpy()
+    np.testing.assert_allclose(out, dense.T @ rhs, rtol=1e-5, atol=1e-5)
+
+
+def test_rowsparse_todense_duplicate_rows_sum():
+    # sparse_add concatenates shards; duplicate row ids must SUM
+    a = sp.row_sparse_array((np.ones((2, 3), np.float32), [1, 4]),
+                            shape=(6, 3))
+    b = sp.row_sparse_array((2 * np.ones((2, 3), np.float32), [1, 2]),
+                            shape=(6, 3))
+    summed = sp.sparse_add(a, b).todense().asnumpy()
+    expected = np.zeros((6, 3), np.float32)
+    expected[1] = 3
+    expected[4] = 1
+    expected[2] = 2
+    np.testing.assert_allclose(summed, expected)
+
+
+def test_retain():
+    rsp = sp.row_sparse_array((np.arange(6, dtype=np.float32)
+                               .reshape(3, 2), [1, 3, 5]), shape=(7, 2))
+    kept = sp.retain(rsp, nd.array([3, 4]))
+    dense = kept.todense().asnumpy()
+    np.testing.assert_allclose(dense[3], [2, 3])
+    np.testing.assert_allclose(dense[4], 0)
+
+
+def test_compress_rowsparse():
+    g = np.zeros((5, 3), np.float32)
+    g[1] = 1.5
+    g[4] = -2.0
+    rsp = sp.compress_rowsparse(nd.array(g))
+    np.testing.assert_allclose(rsp.indices.asnumpy(), [1, 4])
+    np.testing.assert_allclose(rsp.todense().asnumpy(), g)
+
+
+@pytest.mark.parametrize("optimizer", ["sgd", "adagrad"])
+def test_lazy_row_update_matches_dense(optimizer):
+    """Row-sparse update == dense update on touched rows; untouched rows
+    unchanged (the lazy_update semantics)."""
+    rs = np.random.RandomState(2)
+    w0 = rs.randn(6, 4).astype(np.float32)
+    g = np.zeros((6, 4), np.float32)
+    g[[1, 3]] = rs.randn(2, 4)
+
+    opt_a = mx.optimizer.create(optimizer, learning_rate=0.1)
+    upd_a = mx.optimizer.get_updater(opt_a)
+    w_dense = nd.array(w0.copy())
+    upd_a(0, nd.array(g), w_dense)
+
+    opt_b = mx.optimizer.create(optimizer, learning_rate=0.1)
+    upd_b = mx.optimizer.get_updater(opt_b)
+    w_sparse = nd.array(w0.copy())
+    upd_b(0, sp.compress_rowsparse(nd.array(g)), w_sparse)
+
+    np.testing.assert_allclose(w_sparse.asnumpy()[[1, 3]],
+                               w_dense.asnumpy()[[1, 3]], rtol=1e-5,
+                               atol=1e-6)
+    # untouched rows: bit-identical to the originals
+    np.testing.assert_allclose(w_sparse.asnumpy()[[0, 2, 4, 5]],
+                               w0[[0, 2, 4, 5]])
+
+
+def test_embedding_sparse_grad_trains():
+    """Embedding(sparse_grad=True) + Trainer: training works and only
+    touched embedding rows move."""
+    vocab, dim = 20, 4
+    emb = nn.Embedding(vocab, dim, sparse_grad=True)
+    emb.initialize()
+    assert emb.weight._grad_stype == "row_sparse"
+    trainer = gluon.Trainer(emb.collect_params(), "sgd",
+                            {"learning_rate": 0.5})
+    idx = nd.array(np.array([1, 3, 3, 7], np.float32))
+    w_before = emb.weight.data().asnumpy().copy()
+    target = nd.array(np.ones((4, dim), np.float32))
+    for _ in range(3):
+        with autograd.record():
+            out = emb(idx)
+            loss = nd.sum(nd.square(out - target))
+        loss.backward()
+        trainer.step(4)
+    w_after = emb.weight.data().asnumpy()
+    touched = sorted({1, 3, 7})
+    untouched = [i for i in range(vocab) if i not in touched]
+    assert not np.allclose(w_after[touched], w_before[touched])
+    np.testing.assert_allclose(w_after[untouched], w_before[untouched])
+    # and it actually learned: rows moved toward the target
+    out = emb(idx).asnumpy()
+    assert np.abs(out - 1.0).mean() < np.abs(
+        w_before[[1, 3, 3, 7]] - 1.0).mean()
+
+
+def test_embedding_sparse_grad_with_dense_only_optimizer():
+    """Optimizers without a lazy row kernel (adam) still work with
+    sparse_grad params — the Trainer keeps their grads dense locally."""
+    emb = nn.Embedding(12, 3, sparse_grad=True)
+    emb.initialize()
+    trainer = gluon.Trainer(emb.collect_params(), "adam",
+                            {"learning_rate": 0.1})
+    idx = nd.array(np.array([0, 4, 7], np.float32))
+    w0 = emb.weight.data().asnumpy().copy()
+    for _ in range(2):
+        with autograd.record():
+            loss = nd.sum(nd.square(emb(idx)))
+        loss.backward()
+        trainer.step(3)
+    w1 = emb.weight.data().asnumpy()
+    assert not np.allclose(w0[[0, 4, 7]], w1[[0, 4, 7]])
+
+
+def test_rowsparse_pull_duplicate_ids_no_double_count():
+    kv = mx.kv.create("local")
+    w = np.arange(12, dtype=np.float32).reshape(6, 2)
+    kv.init("w", nd.array(w))
+    out = sp.zeros("row_sparse", (6, 2))
+    kv.row_sparse_pull("w", out=out, row_ids=nd.array([2, 2, 4]))
+    dense = out.todense().asnumpy()
+    np.testing.assert_allclose(dense[2], w[2])
+    np.testing.assert_allclose(dense[4], w[4])
+
+
+def test_sparse_embedding_matches_dense_embedding():
+    """sparse_grad path produces the same training trajectory as the
+    dense path (single replica, SGD)."""
+    vocab, dim = 10, 3
+    rs = np.random.RandomState(3)
+    w0 = rs.randn(vocab, dim).astype(np.float32)
+    results = []
+    for sparse_grad in (False, True):
+        emb = nn.Embedding(vocab, dim, sparse_grad=sparse_grad)
+        emb.initialize()
+        emb.weight.set_data(nd.array(w0.copy()))
+        trainer = gluon.Trainer(emb.collect_params(), "sgd",
+                                {"learning_rate": 0.3})
+        idx = nd.array(np.array([0, 2, 5], np.float32))
+        for step in range(4):
+            with autograd.record():
+                loss = nd.sum(nd.square(emb(idx)))
+            loss.backward()
+            trainer.step(3)
+        results.append(emb.weight.data().asnumpy())
+    np.testing.assert_allclose(results[0], results[1], rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_kvstore_local_rowsparse_push_pull():
+    kv = mx.kv.create("local")
+    kv.init("emb", nd.zeros((8, 2)))
+    g = np.zeros((8, 2), np.float32)
+    g[2] = 1.0
+    g[5] = 2.0
+    kv.push("emb", sp.compress_rowsparse(nd.array(g)))
+    out = sp.zeros("row_sparse", (8, 2))
+    kv.row_sparse_pull("emb", out=out, row_ids=nd.array([2, 5, 6]))
+    dense = out.todense().asnumpy()
+    np.testing.assert_allclose(dense[2], 1.0)
+    np.testing.assert_allclose(dense[5], 2.0)
+    np.testing.assert_allclose(dense[6], 0.0)
+
+
+def test_sparse_linear_example_converges():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run(
+        [sys.executable, "examples/train_sparse_linear.py",
+         "--num-epochs", "5", "--num-examples", "1200",
+         "--min-accuracy", "0.9"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+
+
+def test_sparse_linear_example_dist_converges():
+    """row-sparse gradients + server-side optimizer + row_sparse_pull
+    across 2 workers (reference: dist sparse linear_classification)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run(
+        [sys.executable, "tools/launch.py", "-n", "2", "--",
+         sys.executable, "examples/train_sparse_linear.py",
+         "--num-epochs", "5", "--num-examples", "1200",
+         "--kv-store", "dist_sync", "--min-accuracy", "0.9"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
