@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Train an LSTM language model with bucketing.
+
+Reference: ``example/rnn/bucketing/lstm_bucketing.py`` — variable-length
+sentences grouped into buckets, one executor per bucket sharing weights
+(BucketingModule), perplexity metric.
+
+With no corpus on disk a synthetic language is generated: a first-order
+Markov chain with a strongly-peaked transition table, so an LSTM that
+learns bigram statistics drives perplexity far below the uniform
+baseline.  Runs fully offline:
+
+    python examples/train_lm.py --num-epochs 5
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _here)
+sys.path.insert(0, os.path.dirname(_here))
+
+
+def synthetic_corpus(vocab=50, num_sentences=800, seed=11):
+    """Markov-chain sentences with random lengths (deterministic).
+    Token id 0 is reserved for padding — real tokens are 1..vocab-1."""
+    rng = np.random.RandomState(seed)
+    # peaked transitions: each token has ~3 likely successors
+    real = vocab - 1
+    trans = np.full((real, real), 1e-3)
+    for v in range(real):
+        nxt = rng.choice(real, 3, replace=False)
+        trans[v, nxt] = 1.0
+    trans /= trans.sum(1, keepdims=True)
+    sentences = []
+    for _ in range(num_sentences):
+        length = rng.choice([8, 12, 16, 20])
+        s = [int(rng.randint(real))]
+        for _ in range(length - 1):
+            s.append(int(rng.choice(real, p=trans[s[-1]])))
+        sentences.append([t + 1 for t in s])  # shift: 0 stays padding
+    return sentences
+
+
+def main():
+    parser = argparse.ArgumentParser(description="bucketing LSTM LM")
+    parser.add_argument("--vocab", type=int, default=50)
+    parser.add_argument("--num-hidden", type=int, default=64)
+    parser.add_argument("--num-embed", type=int, default=32)
+    parser.add_argument("--num-layers", type=int, default=1)
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--num-epochs", type=int, default=5)
+    parser.add_argument("--lr", type=float, default=0.02)
+    parser.add_argument("--num-sentences", type=int, default=800)
+    parser.add_argument("--max-perplexity", type=float, default=None,
+                        help="exit nonzero unless final train perplexity "
+                             "is below this")
+    args = parser.parse_args()
+
+    import mxnet_tpu as mx
+
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)-15s %(message)s")
+
+    sentences = synthetic_corpus(args.vocab, args.num_sentences)
+    buckets = [8, 12, 16, 20]
+    invalid_label = 0
+    train = mx.rnn.BucketSentenceIter(sentences, args.batch_size,
+                                      buckets=buckets,
+                                      invalid_label=invalid_label)
+
+    # one parameter set shared by every bucket's executor
+    stack = mx.rnn.SequentialRNNCell()
+    for i in range(args.num_layers):
+        stack.add(mx.rnn.LSTMCell(num_hidden=args.num_hidden,
+                                  prefix="lstm_l%d_" % i))
+
+    def sym_gen(seq_len):
+        data = mx.sym.var("data")
+        label = mx.sym.var("softmax_label")
+        embed = mx.sym.Embedding(data=data, input_dim=args.vocab,
+                                 output_dim=args.num_embed, name="embed")
+        outputs, _ = stack.unroll(seq_len, inputs=embed,
+                                  merge_outputs=True)
+        pred = mx.sym.reshape(outputs, shape=(-1, args.num_hidden))
+        pred = mx.sym.FullyConnected(data=pred, num_hidden=args.vocab,
+                                     name="pred")
+        label = mx.sym.reshape(label, shape=(-1,))
+        # padding positions (label 0) contribute no loss
+        pred = mx.sym.SoftmaxOutput(data=pred, label=label,
+                                    use_ignore=True,
+                                    ignore_label=invalid_label,
+                                    name="softmax")
+        return pred, ("data",), ("softmax_label",)
+
+    model = mx.mod.BucketingModule(
+        sym_gen=sym_gen,
+        default_bucket_key=train.default_bucket_key)
+
+    perplexity = mx.metric.Perplexity(ignore_label=invalid_label)
+    model.fit(train,
+              eval_metric=perplexity,
+              optimizer="adam",
+              optimizer_params={"learning_rate": args.lr},
+              initializer=mx.init.Xavier(),
+              num_epoch=args.num_epochs,
+              batch_end_callback=mx.callback.Speedometer(
+                  args.batch_size, 20))
+
+    # final perplexity over the training data
+    train.reset()
+    perplexity.reset()
+    for batch in train:
+        model.forward(batch, is_train=False)
+        model.update_metric(perplexity, batch.label)
+    final = perplexity.get()[1]
+    print("final train perplexity: %.3f (uniform baseline %.1f)"
+          % (final, args.vocab))
+    if args.max_perplexity is not None and final > args.max_perplexity:
+        print("FAILED: perplexity %.3f > %.3f" % (final,
+                                                  args.max_perplexity))
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

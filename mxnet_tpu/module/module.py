@@ -273,12 +273,37 @@ class Module(BaseModule):
 
         self._data_shapes = _norm(data_shapes)
         self._label_shapes = _norm(label_shapes)
+        shared_group = shared_module._exec_group if shared_module is not \
+            None else None
         self._exec_group = _ExecGroup(
             self._symbol, self._context, self._data_names,
             self._label_names, self._data_shapes, self._label_shapes,
             grad_req if for_training else "null",
             self._fixed_param_names, inputs_need_grad,
+            shared_group=shared_group,
             group2ctxs=self._group2ctxs)
+        if shared_module is not None and shared_module.params_initialized:
+            # only inherit initialization when EVERY parameter was
+            # actually aliased from the shared executors (a shape
+            # mismatch leaves a fresh zero array that must not be
+            # mistaken for an initialized weight)
+            all_shared = all(
+                ex.arg_dict[n] is sx.arg_dict[n]
+                for ex, sx in zip(self._exec_group.execs,
+                                  shared_group.execs)
+                for n in self._exec_group.param_names
+                if n in sx.arg_dict)
+            # every param must also exist in the shared module
+            all_present = all(
+                n in shared_group.execs[0].arg_dict
+                for n in self._exec_group.param_names)
+            if all_shared and all_present:
+                self.params_initialized = True
+            else:
+                self.logger.warning(
+                    "shared_module bind: not all parameters could be "
+                    "aliased (shape mismatch or missing) — call "
+                    "init_params on this module")
         self.binded = True
         if self._arg_params is not None:
             self._set_exec_params(self._arg_params, self._aux_params)

@@ -115,6 +115,12 @@ def _scan_direction(mode, x_proj, w_h, b_h, h0, c0):
     raise ValueError("unknown RNN mode %r" % mode)
 
 
+def _rnn_inputs(params):
+    if params.get("mode", "lstm") == "lstm":
+        return ("data", "parameters", "state", "state_cell")
+    return ("data", "parameters", "state")
+
+
 @register_op("RNN", needs_rng=True,
              input_names=("data", "parameters", "state", "state_cell"),
              num_outputs=lambda p: 3 if p.get("mode", "lstm") == "lstm"
@@ -184,3 +190,12 @@ def _rnn(rng, data, parameters, *rest, state_size=0, num_layers=1,
             cy = jnp.clip(cy, lstm_state_clip_min, lstm_state_clip_max)
         return x, hy, cy
     return x, hy
+
+
+from .registry import get_op as _get_op  # noqa: E402
+
+# non-LSTM modes consume no cell state; without this a symbolic
+# sym.RNN(...) with 3 inputs would auto-create a phantom trainable
+# "state_cell" variable (batch-size-dependent shape, saved to
+# checkpoints) — same pattern as Convolution dropping "bias"
+_get_op("RNN").active_inputs = _rnn_inputs

@@ -1,0 +1,11 @@
+"""Symbolic RNN cells + bucketing I/O for BucketingModule workflows.
+
+Reference: ``python/mxnet/rnn/`` (1,797 LoC — rnn_cell.py symbolic cells,
+io.py BucketSentenceIter).
+"""
+
+from .rnn_cell import (BaseRNNCell, RNNParams, RNNCell, LSTMCell,  # noqa
+                       GRUCell, FusedRNNCell, SequentialRNNCell,
+                       BidirectionalCell, DropoutCell, ResidualCell,
+                       ModifierCell)
+from .io import BucketSentenceIter  # noqa: F401

@@ -738,6 +738,37 @@ def _lrelu_shape(params, ins):
     return ins, [ins[0]]
 
 
+@shape_rule("RNN")
+def _rnn_shape(params, ins):
+    """Fused RNN: infers the packed parameter-vector length and state
+    shapes from the (T, B, F) data shape (reference: rnn-inl.h
+    GetRnnParamSize)."""
+    from ..ops.rnn import rnn_param_size
+    mode = params.get("mode", "lstm")
+    data = ins[0]
+    if data is None:
+        n_out = 1
+        if params.get("state_outputs", False):
+            n_out += 2 if mode == "lstm" else 1
+        return ins, [None] * n_out
+    h = int(params.get("state_size", 0))
+    layers = int(params.get("num_layers", 1))
+    bidir = bool(params.get("bidirectional", False))
+    dirs = 2 if bidir else 1
+    t, b, f = data
+    ins = list(ins)
+    ins[1] = (rnn_param_size(mode, f, h, layers, bidir),)
+    state_shape = (layers * dirs, b, h)
+    for i in range(2, len(ins)):
+        ins[i] = state_shape
+    outs = [(t, b, h * dirs)]
+    if params.get("state_outputs", False):
+        outs.append(state_shape)
+        if mode == "lstm":
+            outs.append(state_shape)
+    return ins, outs
+
+
 _SAME_SHAPE_BIN = True
 
 

@@ -1,0 +1,186 @@
+"""BucketingModule + symbolic RNN cell tests.
+
+Reference: tests/python/unittest/test_module.py (bucketing cases),
+python/mxnet/rnn/rnn_cell.py behavior, example/rnn/bucketing.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _np_sigmoid(x):
+    return 1 / (1 + np.exp(-x))
+
+
+def test_rnncell_unroll_matches_numpy():
+    cell = mx.rnn.RNNCell(num_hidden=4, activation="tanh", prefix="r_")
+    outputs, states = cell.unroll(3, inputs=mx.sym.var("x"),
+                                  layout="NTC", merge_outputs=True)
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 3, 5).astype(np.float32)
+    iw = rs.randn(4, 5).astype(np.float32)
+    ib = rs.randn(4).astype(np.float32)
+    hw = rs.randn(4, 4).astype(np.float32)
+    hb = rs.randn(4).astype(np.float32)
+    exe = outputs.bind(args={"x": nd.array(x),
+                             "r_i2h_weight": nd.array(iw),
+                             "r_i2h_bias": nd.array(ib),
+                             "r_h2h_weight": nd.array(hw),
+                             "r_h2h_bias": nd.array(hb)})
+    out = exe.forward()[0].asnumpy()
+    h = np.zeros((2, 4), np.float32)
+    expect = []
+    for t in range(3):
+        h = np.tanh(x[:, t] @ iw.T + ib + h @ hw.T + hb)
+        expect.append(h)
+    np.testing.assert_allclose(out, np.stack(expect, 1), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_lstmcell_gru_shapes_and_gradients_flow():
+    for cell in (mx.rnn.LSTMCell(num_hidden=6, prefix="l_"),
+                 mx.rnn.GRUCell(num_hidden=6, prefix="g_")):
+        outputs, states = cell.unroll(4, inputs=mx.sym.var("x"),
+                                      layout="NTC", merge_outputs=True)
+        loss = mx.sym.sum(outputs)
+        exe = loss.simple_bind(x=(2, 4, 3), grad_req="write")
+        rs = np.random.RandomState(1)
+        for name, arr in exe.arg_dict.items():
+            if name != "x":
+                arr[:] = nd.array(rs.randn(*arr.shape).astype(
+                    np.float32) * 0.2)
+        exe.forward(is_train=True, x=nd.array(
+            rs.randn(2, 4, 3).astype(np.float32)))
+        exe.backward(out_grads=[nd.ones(())])
+        gsum = sum(float(np.abs(g.asnumpy()).sum())
+                   for n, g in exe.grad_dict.items() if n != "x")
+        assert np.isfinite(gsum) and gsum > 0
+
+
+def test_sequential_and_modifier_cells():
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.LSTMCell(num_hidden=4, prefix="s0_"))
+    stack.add(mx.rnn.ResidualCell(
+        mx.rnn.LSTMCell(num_hidden=4, prefix="s1_")))
+    outputs, states = stack.unroll(3, inputs=mx.sym.var("x"),
+                                   layout="NTC", merge_outputs=True)
+    exe = outputs.simple_bind(x=(2, 3, 4))
+    assert exe.forward()[0].shape == (2, 3, 4)
+    assert len(states) == 4  # 2 cells x (h, c)
+
+
+def test_bidirectional_cell():
+    bi = mx.rnn.BidirectionalCell(
+        mx.rnn.RNNCell(num_hidden=3, prefix="fw_"),
+        mx.rnn.RNNCell(num_hidden=3, prefix="bw_"))
+    outputs, states = bi.unroll(4, inputs=mx.sym.var("x"),
+                                layout="NTC", merge_outputs=True)
+    exe = outputs.simple_bind(x=(2, 4, 5))
+    assert exe.forward()[0].shape == (2, 4, 6)
+
+
+def test_fused_cell_unroll():
+    fused = mx.rnn.FusedRNNCell(num_hidden=5, num_layers=2, mode="lstm",
+                                prefix="f_")
+    outputs, states = fused.unroll(6, inputs=mx.sym.var("x"),
+                                   layout="NTC")
+    exe = outputs.simple_bind(x=(3, 6, 4))
+    assert exe.forward()[0].shape == (3, 6, 5)
+    assert states[0].infer_shape(x=(3, 6, 4))[1]
+
+
+def test_bucket_sentence_iter():
+    sentences = [[1, 2, 3], [4, 5, 6, 7, 8], [1, 1], [2, 2, 2, 2, 2],
+                 [3, 3, 3]] * 4
+    it = mx.rnn.BucketSentenceIter(sentences, batch_size=4,
+                                   buckets=[3, 5], invalid_label=0,
+                                   shuffle=False)
+    seen = set()
+    for batch in it:
+        seen.add(batch.bucket_key)
+        data = batch.data[0].asnumpy()
+        label = batch.label[0].asnumpy()
+        assert data.shape == (4, batch.bucket_key)
+        # label is input shifted left, padded with invalid
+        np.testing.assert_allclose(label[:, :-1], data[:, 1:])
+        np.testing.assert_allclose(label[:, -1], 0)
+    assert seen == {3, 5}
+
+
+def _lm_module(vocab=20, hidden=16):
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.LSTMCell(num_hidden=hidden, prefix="lstm_"))
+
+    def sym_gen(seq_len):
+        data = mx.sym.var("data")
+        label = mx.sym.var("softmax_label")
+        embed = mx.sym.Embedding(data=data, input_dim=vocab,
+                                 output_dim=8, name="embed")
+        outputs, _ = stack.unroll(seq_len, inputs=embed,
+                                  merge_outputs=True)
+        pred = mx.sym.reshape(outputs, shape=(-1, hidden))
+        pred = mx.sym.FullyConnected(data=pred, num_hidden=vocab,
+                                     name="pred")
+        label = mx.sym.reshape(label, shape=(-1,))
+        return mx.sym.SoftmaxOutput(data=pred, label=label,
+                                    name="softmax"), ("data",), \
+            ("softmax_label",)
+
+    return mx.mod.BucketingModule(sym_gen=sym_gen, default_bucket_key=8)
+
+
+def test_bucketing_module_shares_params_across_buckets():
+    from mxnet_tpu.io.io import DataBatch, DataDesc
+    mod = _lm_module()
+    mod.bind(data_shapes=[("data", (2, 8))],
+             label_shapes=[("softmax_label", (2, 8))])
+    mod.init_params()
+    mod.init_optimizer(optimizer_params={"learning_rate": 0.1})
+
+    def batch(seq_len):
+        rs = np.random.RandomState(seq_len)
+        d = rs.randint(0, 20, (2, seq_len)).astype(np.float32)
+        return DataBatch(
+            data=[nd.array(d)], label=[nd.array(d)], bucket_key=seq_len,
+            provide_data=[DataDesc("data", (2, seq_len))],
+            provide_label=[DataDesc("softmax_label", (2, seq_len))])
+
+    mod.forward_backward(batch(4))
+    mod.update()
+    m4 = mod._buckets[4]
+    m8 = mod._buckets[8]
+    # same NDArray objects: an update through bucket 4 IS visible in 8
+    for name in m4._exec_group.param_names:
+        assert m4._exec_group.execs[0].arg_dict[name] is \
+            m8._exec_group.execs[0].arg_dict[name]
+    # one shared updater (borrowed optimizer)
+    assert m4._updater is m8._updater
+    # training through alternating buckets moves the shared weights
+    w0 = m8._exec_group.execs[0].arg_dict["pred_weight"].asnumpy().copy()
+    mod.forward_backward(batch(8))
+    mod.update()
+    w1 = m8._exec_group.execs[0].arg_dict["pred_weight"].asnumpy()
+    assert not np.allclose(w0, w1)
+    # executor cache: no rebind for an already-seen bucket
+    before = dict(mod._buckets)
+    mod.forward_backward(batch(4))
+    assert mod._buckets[4] is before[4]
+
+
+def test_lm_example_perplexity_drops():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run(
+        [sys.executable, "examples/train_lm.py", "--num-epochs", "4",
+         "--num-sentences", "400", "--max-perplexity", "12"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=500)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
