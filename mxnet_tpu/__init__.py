@@ -55,6 +55,7 @@ from .module import Module  # noqa: F401
 from . import kvstore  # noqa: F401
 from . import kvstore as kv  # noqa: F401
 from . import rnn  # noqa: F401
+from . import contrib  # noqa: F401
 from . import parallel  # noqa: F401
 from . import recordio  # noqa: F401
 from .runtime import engine  # noqa: F401

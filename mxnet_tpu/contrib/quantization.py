@@ -1,0 +1,220 @@
+"""INT8 model quantization: graph rewrite + calibration driver.
+
+Reference: ``src/operator/quantization/quantize_graph_pass.cc:119``
+(QuantizeGraph inserts quantize/dequantize pairs around ops carrying the
+FQuantizedOp attr, ``:92-96``) and the Python driver
+``python/mxnet/contrib/quantization.py`` (quantize_model with
+calib_mode none/naive).
+
+TPU-native mapping: quantized Convolution/FullyConnected run int8 x int8
+-> int32 on the MXU (``ops/quantization.py``); the rewrite inserts
+``_contrib_quantize`` on activations (either with calibrated min/max
+parameters — calib_mode='naive' — or with in-graph dynamic min/max —
+calib_mode='none') and a ``_contrib_dequantize`` on the int32
+accumulator; weights are quantized OFFLINE to int8 parameters, so the
+serialized quantized model carries int8 weights exactly like the
+reference's.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as _np
+
+from .. import ndarray as nd
+from .. import symbol as S
+from ..symbol.symbol import Node, Symbol
+
+__all__ = ["quantize_symbol", "quantize_model"]
+
+_QUANTIZABLE = ("Convolution", "FullyConnected")
+
+
+def _entry_symbol(entry):
+    return Symbol([entry])
+
+
+def quantize_symbol(sym, excluded_sym_names=(), quantized_dtype="int8",
+                    calib_mode="naive"):
+    """Rewrite *sym*, quantizing every Convolution/FullyConnected not in
+    *excluded_sym_names*.
+
+    Returns (qsym, calib_points) where calib_points maps
+    ``<node name>_data`` -> the ORIGINAL graph entry feeding that node
+    (for offline range collection) — empty for calib_mode='none', where
+    ranges are computed in-graph per batch (dynamic quantization).
+    """
+    assert quantized_dtype == "int8", "int8 is the TPU MXU path"
+    excluded = set(excluded_sym_names)
+    order = sym._topo()
+    entry_map = {}       # (id(orig_node), out_idx) -> new entry
+    calib_points = {}
+
+    def mapped(entry):
+        node, idx = entry
+        if node.is_var:
+            return (node, idx)
+        return entry_map[(id(node), idx)]
+
+    for node in order:
+        if node.is_var:
+            continue
+        new_inputs = [mapped(e) for e in node.inputs]
+        if node.op.name in _QUANTIZABLE and node.name not in excluded:
+            data = _entry_symbol(new_inputs[0])
+            worig = node.inputs[1][0]           # weight var node
+            has_bias = not node.params.get("no_bias", False) and \
+                len(node.inputs) > 2
+            # activation ranges are SYMMETRIC (-M, M): the int32
+            # accumulator's real value is then exactly
+            # q_d * q_w * (Md/127) * (Mw/127) with no zero-point
+            # correction term (the reference's MKLDNN path carries a
+            # compensation tensor instead; symmetric is the clean MXU
+            # mapping)
+            if calib_mode == "none":
+                m = S.max(S.abs(data))
+                dmin = 0.0 - m
+                dmax = m
+            else:
+                dmin = S.var("%s_data_min" % node.name)
+                dmax = S.var("%s_data_max" % node.name)
+                calib_points["%s_data" % node.name] = node.inputs[0]
+            dq = S._contrib_quantize(data, dmin, dmax, out_type="int8",
+                                     name="%s_quantize" % node.name)
+            wq = S.var("%s_quantized" % worig.name)
+            wmin = S.var("%s_min" % worig.name)
+            wmax = S.var("%s_max" % worig.name)
+            if node.op.name == "Convolution":
+                p = node.params
+                q = S._contrib_quantized_conv(
+                    dq[0], wq, dq[1], dq[2], wmin, wmax,
+                    kernel=p.get("kernel"), stride=p.get("stride"),
+                    pad=p.get("pad"), dilate=p.get("dilate"),
+                    num_filter=p.get("num_filter"),
+                    num_group=p.get("num_group", 1),
+                    name="%s_quantized" % node.name)
+                out = S._contrib_dequantize(
+                    q[0], q[1], q[2], name="%s_dequantize" % node.name)
+                if has_bias:
+                    bias = _entry_symbol(new_inputs[2])
+                    out = S.broadcast_add(
+                        out, S.reshape(bias, shape=(1, -1, 1, 1)))
+            else:
+                p = node.params
+                q = S._contrib_quantized_fully_connected(
+                    dq[0], wq, dq[1], dq[2], wmin, wmax,
+                    num_hidden=p.get("num_hidden"),
+                    flatten=p.get("flatten", True),
+                    name="%s_quantized" % node.name)
+                out = S._contrib_dequantize(
+                    q[0], q[1], q[2], name="%s_dequantize" % node.name)
+                if has_bias:
+                    bias = _entry_symbol(new_inputs[2])
+                    out = S.broadcast_add(out,
+                                          S.reshape(bias, shape=(1, -1)))
+            entry_map[(id(node), 0)] = out._outputs[0]
+        else:
+            new_node = Node(node.op, node.name, params=node.params,
+                            inputs=new_inputs, attrs=node.attrs)
+            for i in range(node.num_outputs()):
+                entry_map[(id(node), i)] = (new_node, i)
+
+    qsym = Symbol([mapped(e) for e in sym._outputs])
+    return qsym, calib_points
+
+
+def _collect_naive_ranges(sym, calib_points, arg_params, aux_params,
+                          calib_data, data_names, num_calib_examples,
+                          label_names=()):
+    """Global min/max per calibration point over the calib batches
+    (reference: quantization.py _LayerOutputMinMaxCollector,
+    calib_mode='naive')."""
+    group = S.Group([_entry_symbol(e) for e in calib_points.values()])
+    names = list(calib_points)
+    th = {n: (_np.inf, -_np.inf) for n in names}
+    seen = 0
+    exe = None
+    calib_data.reset()
+    for batch in calib_data:
+        feeds = {}
+        for dn, arr in zip(data_names, batch.data):
+            feeds[dn] = arr
+        if batch.label:
+            for ln, arr in zip(label_names, batch.label):
+                feeds[ln] = arr
+        if exe is None:
+            # bind ONCE: each bind creates fresh jitted closures, so a
+            # per-batch bind would recompile the collection graph every
+            # batch
+            exe = group.bind(args={**dict(arg_params), **feeds},
+                             aux_states=dict(aux_params or {}))
+        outs = exe.forward(is_train=False, **feeds)
+        for n, o in zip(names, outs):
+            v = o.asnumpy()
+            lo, hi = th[n]
+            th[n] = (min(lo, float(v.min())), max(hi, float(v.max())))
+        seen += batch.data[0].shape[0]
+        if num_calib_examples is not None and seen >= num_calib_examples:
+            break
+    return th
+
+
+def _quantize_weights(sym, arg_params):
+    """Offline symmetric int8 weight quantization for every
+    '*_quantized' weight var the rewrite introduced."""
+    qargs = dict(arg_params)
+    still_needed = set(sym.list_arguments())
+    for name in still_needed:
+        if name.endswith("_quantized") and name[:-10] in arg_params:
+            w = arg_params[name[:-10]].asnumpy()
+            m = float(_np.abs(w).max()) or 1e-8
+            q = _np.clip(_np.round(w * 127.0 / m), -127, 127) \
+                .astype(_np.int8)
+            qargs[name] = nd.array(q)
+            qargs[name[:-10] + "_min"] = nd.array(
+                _np.asarray(-m, _np.float32))
+            qargs[name[:-10] + "_max"] = nd.array(
+                _np.asarray(m, _np.float32))
+            if name[:-10] not in still_needed:
+                # the fp32 weight may still be consumed by an excluded
+                # layer (tied weights) — only drop it when unused
+                del qargs[name[:-10]]
+    return qargs
+
+
+def quantize_model(sym, arg_params, aux_params=None, data_names=("data",),
+                   label_names=(), excluded_sym_names=(),
+                   calib_mode="naive", calib_data=None,
+                   num_calib_examples=None, quantized_dtype="int8",
+                   logger=logging):
+    """(reference: python/mxnet/contrib/quantization.py quantize_model)
+
+    calib_mode:
+      'none'  — dynamic: activation min/max computed in-graph per batch
+      'naive' — offline: global min/max over *calib_data* baked in as
+                parameters (requires calib_data)
+    Returns (qsym, qarg_params, aux_params).
+    """
+    qsym, calib_points = quantize_symbol(
+        sym, excluded_sym_names=excluded_sym_names,
+        quantized_dtype=quantized_dtype, calib_mode=calib_mode)
+    qargs = _quantize_weights(qsym, arg_params)
+    if calib_mode == "naive":
+        assert calib_data is not None, \
+            "calib_mode='naive' needs calib_data"
+        th = _collect_naive_ranges(sym, calib_points, arg_params,
+                                   aux_params, calib_data, data_names,
+                                   num_calib_examples, label_names)
+        for point, (lo, hi) in th.items():
+            m = max(abs(lo), abs(hi))  # symmetric (see quantize_symbol)
+            logger.info("calibrated %s: [%g, %g] -> +-%g", point, lo,
+                        hi, m)
+            qargs["%s_min" % point] = nd.array(
+                _np.asarray(-m, _np.float32))
+            qargs["%s_max" % point] = nd.array(
+                _np.asarray(m, _np.float32))
+    elif calib_mode != "none":
+        raise ValueError("calib_mode must be 'none' or 'naive', got %r"
+                         % (calib_mode,))
+    return qsym, qargs, dict(aux_params or {})

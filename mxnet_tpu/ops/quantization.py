@@ -33,6 +33,9 @@ def _quantize(data, min_range, max_range, out_type="uint8"):
 def _dequantize(data, min_range, max_range, out_type="float32"):
     if data.dtype == jnp.uint8:
         qmin, qmax = 0.0, 255.0
+    elif data.dtype == jnp.int32:
+        # int32 accumulator out of the quantized conv/fc ops
+        qmin, qmax = -(2.0 ** 31 - 1), 2.0 ** 31 - 1
     else:
         qmin, qmax = -127.0, 127.0
     scale = (max_range - min_range) / (qmax - qmin)
@@ -51,6 +54,100 @@ def _requantize(data, min_range, max_range, min_calib_range=None,
                                 1e-20)
     q = jnp.clip(jnp.round(real * scale), -127, 127).astype(jnp.int8)
     return q, -jnp.abs(hi), jnp.abs(hi)
+
+
+# ---------------------------------------------------------------------------
+# INT8 compute ops — int8 x int8 -> int32 on the MXU
+# (reference: src/operator/quantization/quantized_conv.cc,
+# quantized_fully_connected.cc, quantized_pooling.cc,
+# quantized_flatten.cc).  Convention: a quantized tensor carries a
+# symmetric real range (min, max); real = q * M / 127 with
+# M = max(|min|, |max|).  The int32 accumulator's range is therefore
+# (2^31-1) * Md * Mw / 127^2, which is what dequantize below assumes.
+# ---------------------------------------------------------------------------
+
+
+def _sym_scale(mn, mx):
+    return jnp.maximum(jnp.abs(mn), jnp.abs(mx)) / 127.0
+
+
+def _int32_range(dmin, dmax, wmin, wmax):
+    m = _sym_scale(dmin, dmax) * _sym_scale(wmin, wmax) * (2.0 ** 31 - 1)
+    return -m, m
+
+
+@register_op("_contrib_quantized_conv", num_outputs=3,
+             aliases=("quantized_conv",))
+def _quantized_conv(data, weight, dmin, dmax, wmin, wmax, kernel=(1, 1),
+                    stride=(1, 1), pad=(0, 0), dilate=(1, 1),
+                    num_filter=0, num_group=1, no_bias=True,
+                    layout="NCHW"):
+    """int8 NCHW convolution with int32 accumulation (the MXU int8
+    path; XLA lowers preferred_element_type=int32 onto the systolic
+    array)."""
+    nd_ = len(kernel)
+    pads = [(int(p), int(p)) for p in pad] if pad else [(0, 0)] * nd_
+    out = jax.lax.conv_general_dilated(
+        data.astype(jnp.int8), weight.astype(jnp.int8),
+        window_strides=tuple(int(s) for s in stride),
+        padding=pads,
+        rhs_dilation=tuple(int(d) for d in dilate),
+        feature_group_count=int(num_group),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=jnp.int32)
+    omin, omax = _int32_range(dmin, dmax, wmin, wmax)
+    return out, omin, omax
+
+
+@register_op("_contrib_quantized_fully_connected", num_outputs=3,
+             aliases=("quantized_fc",))
+def _quantized_fc(data, weight, dmin, dmax, wmin, wmax, num_hidden=0,
+                  no_bias=True, flatten=True):
+    d = data.astype(jnp.int8)
+    if flatten and d.ndim > 2:
+        d = d.reshape(d.shape[0], -1)
+    out = jax.lax.dot_general(
+        d, weight.astype(jnp.int8),
+        (((d.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    omin, omax = _int32_range(dmin, dmax, wmin, wmax)
+    return out, omin, omax
+
+
+@register_op("_contrib_quantized_pooling", num_outputs=3,
+             aliases=("quantized_pooling",))
+def _quantized_pooling(data, dmin, dmax, kernel=(2, 2), stride=None,
+                       pad=None, pool_type="max", global_pool=False):
+    """int8 pooling: max stays exact in int8; avg accumulates in int32
+    then rounds back (range is unchanged either way)."""
+    d = data
+    nd_ = len(kernel)
+    if global_pool:
+        kernel = d.shape[2:]
+        stride = (1,) * nd_
+        pad = (0,) * nd_
+    stride = stride or kernel
+    pad = pad or (0,) * nd_
+    dims = (1, 1) + tuple(int(k) for k in kernel)
+    strides = (1, 1) + tuple(int(s) for s in stride)
+    pads = ((0, 0), (0, 0)) + tuple((int(p), int(p)) for p in pad)
+    if pool_type == "max":
+        out = jax.lax.reduce_window(d, jnp.int8(jnp.iinfo(jnp.int8).min),
+                                    jax.lax.max, dims, strides, pads)
+    else:
+        s = jax.lax.reduce_window(d.astype(jnp.int32), 0, jax.lax.add,
+                                  dims, strides, pads)
+        n = 1
+        for k in kernel:
+            n *= int(k)
+        out = jnp.clip(jnp.round(s / n), -127, 127).astype(jnp.int8)
+    return out, dmin, dmax
+
+
+@register_op("_contrib_quantized_flatten", num_outputs=3,
+             aliases=("quantized_flatten",))
+def _quantized_flatten(data, dmin, dmax):
+    return data.reshape(data.shape[0], -1), dmin, dmax
 
 
 # ---------------------------------------------------------------------------

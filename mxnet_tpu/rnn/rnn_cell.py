@@ -419,7 +419,10 @@ class BidirectionalCell(BaseRNNCell):
     def unroll(self, length, inputs=None, begin_state=None,
                layout="NTC", merge_outputs=None, input_prefix=""):
         self.reset()
-        if isinstance(inputs, sym.Symbol):
+        if inputs is None:
+            inputs = [sym.var("%st%d_data" % (input_prefix, i))
+                      for i in range(length)]
+        elif isinstance(inputs, sym.Symbol):
             axis = 1 if layout == "NTC" else 0
             inputs = list(sym.split(inputs, num_outputs=length,
                                     axis=axis, squeeze_axis=True))

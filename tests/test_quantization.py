@@ -1,0 +1,177 @@
+"""INT8 quantization tests.
+
+Reference: tests/python/quantization/test_quantization.py (quantized op
+checks + quantize_model flow over quantize_graph_pass.cc).
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.contrib.quantization import quantize_model, quantize_symbol
+from mxnet_tpu.io.io import DataBatch
+
+
+def test_quantize_dequantize_roundtrip_int8():
+    rs = np.random.RandomState(0)
+    x = rs.uniform(-3, 3, (4, 5)).astype(np.float32)
+    m = float(np.abs(x).max())
+    q, lo, hi = nd.quantize(nd.array(x), nd.array(-m), nd.array(m),
+                            out_type="int8")
+    assert str(q.dtype) == "int8"
+    back = nd.dequantize(q, lo, hi).asnumpy()
+    np.testing.assert_allclose(back, x, atol=2 * m / 254)
+
+
+def test_quantized_fc_matches_int_math():
+    rs = np.random.RandomState(1)
+    d = rs.randint(-127, 128, (2, 6)).astype(np.int8)
+    w = rs.randint(-127, 128, (3, 6)).astype(np.int8)
+    out, omin, omax = nd.quantized_fc(
+        nd.array(d), nd.array(w), nd.array(-1.0), nd.array(1.0),
+        nd.array(-1.0), nd.array(1.0), num_hidden=3)
+    assert str(out.dtype) == "int32"
+    expected = d.astype(np.int64) @ w.T.astype(np.int64)
+    np.testing.assert_allclose(out.asnumpy(), expected)
+
+
+def test_quantized_conv_matches_fp32():
+    rs = np.random.RandomState(2)
+    x = rs.randn(2, 3, 8, 8).astype(np.float32)
+    w = rs.randn(4, 3, 3, 3).astype(np.float32) * 0.3
+    mx_, mw = float(np.abs(x).max()), float(np.abs(w).max())
+    qx = np.round(x * 127 / mx_).astype(np.int8)
+    qw = np.round(w * 127 / mw).astype(np.int8)
+    out, omin, omax = nd.quantized_conv(
+        nd.array(qx), nd.array(qw), nd.array(-mx_), nd.array(mx_),
+        nd.array(-mw), nd.array(mw), kernel=(3, 3), num_filter=4)
+    deq = nd.dequantize(out, omin, omax).asnumpy()
+    ref = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                         num_filter=4, no_bias=True).asnumpy()
+    err = np.abs(deq - ref).max() / np.abs(ref).max()
+    assert err < 0.03, err
+
+
+def test_quantized_pooling_int8():
+    rs = np.random.RandomState(3)
+    x = rs.randint(-127, 128, (1, 2, 4, 4)).astype(np.int8)
+    out, _, _ = nd.quantized_pooling(
+        nd.array(x), nd.array(-1.0), nd.array(1.0), kernel=(2, 2),
+        stride=(2, 2), pool_type="max")
+    assert str(out.dtype) == "int8"
+    ref = x.reshape(1, 2, 2, 2, 2, 2).max((3, 5))
+    np.testing.assert_allclose(out.asnumpy(), ref)
+
+
+def _convnet():
+    data = mx.sym.var("data")
+    c1 = mx.sym.Convolution(data=data, kernel=(3, 3), num_filter=8,
+                            name="c1")
+    a1 = mx.sym.Activation(data=c1, act_type="relu")
+    p1 = mx.sym.Pooling(data=a1, kernel=(2, 2), stride=(2, 2),
+                        pool_type="max")
+    f1 = mx.sym.FullyConnected(data=p1, num_hidden=10, name="f1")
+    return f1
+
+
+def _convnet_params(rs):
+    return {
+        "c1_weight": nd.array(rs.randn(8, 3, 3, 3).astype(np.float32)
+                              * 0.2),
+        "c1_bias": nd.array(rs.randn(8).astype(np.float32) * 0.1),
+        "f1_weight": nd.array(rs.randn(10, 8 * 5 * 5).astype(np.float32)
+                              * 0.1),
+        "f1_bias": nd.array(rs.randn(10).astype(np.float32) * 0.1),
+    }
+
+
+class _OneBatch:
+    def __init__(self, x):
+        self._x = x
+        self._done = False
+
+    def reset(self):
+        self._done = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        self._done = True
+        return DataBatch(data=[nd.array(self._x)])
+
+
+@pytest.mark.parametrize("mode", ["none", "naive"])
+def test_quantize_model_close_to_fp32(mode):
+    rs = np.random.RandomState(4)
+    x = rs.randn(4, 3, 12, 12).astype(np.float32)
+    sym = _convnet()
+    arg_params = _convnet_params(rs)
+    ref = sym.bind(args={**arg_params, "data": nd.array(x)}) \
+        .forward()[0].asnumpy()
+    qsym, qargs, _ = quantize_model(
+        sym, arg_params, {}, calib_mode=mode,
+        calib_data=_OneBatch(x) if mode == "naive" else None)
+    out = qsym.bind(args={**qargs, "data": nd.array(x)}) \
+        .forward()[0].asnumpy()
+    err = np.abs(out - ref).max() / np.abs(ref).max()
+    assert err < 0.05, err
+    # int8 weights actually replaced the fp32 ones
+    args = qsym.list_arguments()
+    assert "c1_weight_quantized" in args and "c1_weight" not in args
+    assert str(qargs["c1_weight_quantized"].dtype) == "int8"
+
+
+def test_quantize_symbol_excluded_layers_stay_fp32():
+    sym = _convnet()
+    qsym, points = quantize_symbol(sym, excluded_sym_names=("c1",))
+    args = qsym.list_arguments()
+    assert "c1_weight" in args            # untouched
+    assert "f1_weight_quantized" in args  # quantized
+
+
+def test_quantized_lenet_accuracy_close_to_fp32():
+    """End-to-end: train fp32 LeNet on synthetic digits, quantize with
+    naive calibration, accuracy within 2% of fp32 (reference:
+    test_quantization.py quantized model accuracy checks)."""
+    import sys
+    sys.path.insert(0, os.path.join(REPO, "examples"))
+    from train_mnist import synthetic_mnist
+
+    x, y = synthetic_mnist(1024)
+    it = mx.io.NDArrayIter(data=x, label=y, batch_size=64,
+                           label_name="softmax_label")
+    data = mx.sym.var("data")
+    c1 = mx.sym.Convolution(data=data, kernel=(5, 5), num_filter=8,
+                            name="c1")
+    t1 = mx.sym.Activation(data=c1, act_type="tanh")
+    p1 = mx.sym.Pooling(data=t1, kernel=(2, 2), stride=(2, 2),
+                        pool_type="max")
+    fl = mx.sym.Flatten(data=p1)
+    f1 = mx.sym.FullyConnected(data=fl, num_hidden=10, name="f1")
+    net = mx.sym.SoftmaxOutput(data=f1, name="softmax")
+    mod = mx.mod.Module(net)
+    mod.fit(it, num_epoch=2, initializer=mx.init.Xavier(),
+            optimizer_params={"learning_rate": 0.1,
+                              "rescale_grad": 1.0 / 64})
+    acc_fp32 = dict(mod.score(it, mx.metric.create("accuracy")))[
+        "accuracy"]
+    arg_params, aux_params = mod.get_params()
+
+    # quantize the feature extractor (symbol up to logits)
+    qsym, qargs, _ = quantize_model(
+        f1, arg_params, aux_params, calib_mode="naive",
+        calib_data=_OneBatch(x[:256]), num_calib_examples=256)
+    qexe = qsym.bind(args={**qargs, "data": nd.array(x)})
+    logits = qexe.forward()[0].asnumpy()
+    acc_int8 = float((logits.argmax(1) == y).mean())
+    assert acc_fp32 > 0.9
+    assert acc_int8 >= acc_fp32 - 0.02, (acc_int8, acc_fp32)
+
+
+import os  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
