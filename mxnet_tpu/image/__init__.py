@@ -11,3 +11,7 @@ from .image import (imdecode, imread, imresize, resize_short,  # noqa
                     LightingAug, ColorNormalizeAug, RandomGrayAug,
                     HorizontalFlipAug, CastAug, CreateAugmenter,
                     ImageIter)
+from .detection import (DetAugmenter, DetBorrowAug,  # noqa
+                        DetRandomSelectAug, DetHorizontalFlipAug,
+                        DetRandomCropAug, DetRandomPadAug,
+                        CreateDetAugmenter, ImageDetIter)

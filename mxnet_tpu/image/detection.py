@@ -1,0 +1,368 @@
+"""Detection-aware image augmenters + ImageDetIter.
+
+Reference: ``python/mxnet/image/detection.py`` and the C++ augmenter
+``src/io/image_det_aug_default.cc`` (686 LoC) — geometric augmentations
+keep the bbox labels consistent with the pixels.
+
+Label format (the reference's "detection list" layout): per image, a
+flat float vector ``[header_width, object_width, extra..., obj0...,
+obj1...]`` where each object is ``[class_id, xmin, ymin, xmax, ymax]``
+with coordinates normalized to [0, 1]; batches pad objects with
+class_id = -1 rows.
+"""
+
+from __future__ import annotations
+
+import random as pyrandom
+
+import numpy as _np
+
+from .image import (Augmenter, ImageIter, fixed_crop, imresize)
+from ..io.io import DataBatch, DataDesc
+from ..base import MXNetError
+from .. import ndarray as nd
+
+__all__ = ["DetAugmenter", "DetBorrowAug", "DetRandomSelectAug",
+           "DetHorizontalFlipAug", "DetRandomCropAug", "DetRandomPadAug",
+           "CreateDetAugmenter", "ImageDetIter"]
+
+
+class DetAugmenter:
+    """Detection augmenter: __call__(src, label) -> (src, label)
+    (reference: detection.py DetAugmenter)."""
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Wrap a plain image Augmenter that does not change geometry
+    (color jitter, normalize, cast — reference: DetBorrowAug)."""
+
+    def __init__(self, augmenter):
+        assert isinstance(augmenter, Augmenter)
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetRandomSelectAug(DetAugmenter):
+    """Randomly select one of the given augmenters (or skip)
+    (reference: DetRandomSelectAug)."""
+
+    def __init__(self, aug_list, skip_prob=0.0):
+        self.aug_list = list(aug_list)
+        self.skip_prob = skip_prob
+
+    def __call__(self, src, label):
+        if pyrandom.random() < self.skip_prob or not self.aug_list:
+            return src, label
+        return pyrandom.choice(self.aug_list)(src, label)
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Flip image and mirror the x coordinates
+    (reference: DetHorizontalFlipAug)."""
+
+    def __init__(self, p=0.5):
+        self.p = p
+
+    def __call__(self, src, label):
+        if pyrandom.random() < self.p:
+            src = src[:, ::-1]
+            label = label.copy()
+            valid = label[:, 0] >= 0
+            x1 = label[valid, 1].copy()
+            x2 = label[valid, 3].copy()
+            label[valid, 1] = 1.0 - x2
+            label[valid, 3] = 1.0 - x1
+        return src, label
+
+
+def _box_iob(boxes, crop):
+    """Intersection-over-box-area of each box with the crop window."""
+    x1 = _np.maximum(boxes[:, 0], crop[0])
+    y1 = _np.maximum(boxes[:, 1], crop[1])
+    x2 = _np.minimum(boxes[:, 2], crop[2])
+    y2 = _np.minimum(boxes[:, 3], crop[3])
+    inter = _np.maximum(x2 - x1, 0) * _np.maximum(y2 - y1, 0)
+    area = _np.maximum((boxes[:, 2] - boxes[:, 0]) *
+                       (boxes[:, 3] - boxes[:, 1]), 1e-12)
+    return inter / area
+
+
+class DetRandomCropAug(DetAugmenter):
+    """Random crop constrained by object coverage (reference:
+    DetRandomCropAug / image_det_aug_default.cc RandomCrop): sample a
+    crop whose IoB with at least one object exceeds min_object_covered;
+    objects whose remaining coverage is below min_eject_coverage are
+    dropped; surviving boxes are clipped and renormalized."""
+
+    def __init__(self, min_object_covered=0.1,
+                 aspect_ratio_range=(0.75, 1.33),
+                 area_range=(0.05, 1.0), min_eject_coverage=0.3,
+                 max_attempts=50):
+        self.min_object_covered = min_object_covered
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.min_eject_coverage = min_eject_coverage
+        self.max_attempts = max_attempts
+
+    def _sample_crop(self, label):
+        for _ in range(self.max_attempts):
+            area = pyrandom.uniform(*self.area_range)
+            ratio = pyrandom.uniform(*self.aspect_ratio_range)
+            w = min((area * ratio) ** 0.5, 1.0)
+            h = min((area / ratio) ** 0.5, 1.0)
+            x0 = pyrandom.uniform(0, 1 - w)
+            y0 = pyrandom.uniform(0, 1 - h)
+            crop = (x0, y0, x0 + w, y0 + h)
+            valid = label[:, 0] >= 0
+            if not valid.any():
+                return crop
+            cov = _box_iob(label[valid, 1:5], crop)
+            if cov.max() >= self.min_object_covered:
+                return crop
+        return None
+
+    def __call__(self, src, label):
+        crop = self._sample_crop(label)
+        if crop is None:
+            return src, label
+        h, w = src.shape[0], src.shape[1]
+        x0, y0, x1, y1 = crop
+        xi, yi = int(x0 * w), int(y0 * h)
+        wi = max(int((x1 - x0) * w), 1)
+        hi = max(int((y1 - y0) * h), 1)
+        src = fixed_crop(src, xi, yi, wi, hi)
+        out = label.copy()
+        valid = out[:, 0] >= 0
+        boxes = out[valid, 1:5]
+        cov = _box_iob(boxes, crop)
+        cw = x1 - x0
+        ch = y1 - y0
+        nb = _np.empty_like(boxes)
+        nb[:, 0] = _np.clip((boxes[:, 0] - x0) / cw, 0, 1)
+        nb[:, 1] = _np.clip((boxes[:, 1] - y0) / ch, 0, 1)
+        nb[:, 2] = _np.clip((boxes[:, 2] - x0) / cw, 0, 1)
+        nb[:, 3] = _np.clip((boxes[:, 3] - y0) / ch, 0, 1)
+        keep = cov >= self.min_eject_coverage
+        ids = _np.where(valid)[0]
+        out[ids, 1:5] = nb
+        out[ids[~keep], 0] = -1          # ejected objects become padding
+        return src, out
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Pad to a larger canvas (zoom out) and rescale boxes
+    (reference: DetRandomPadAug)."""
+
+    def __init__(self, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(1.0, 3.0), max_attempts=50,
+                 pad_val=(127, 127, 127)):
+        self.area_range = area_range
+        self.aspect_ratio_range = aspect_ratio_range
+        self.pad_val = pad_val
+
+    def __call__(self, src, label):
+        h, w = src.shape[0], src.shape[1]
+        area = pyrandom.uniform(*self.area_range)
+        if area <= 1.0:
+            return src, label
+        ratio = pyrandom.uniform(*self.aspect_ratio_range)
+        nw = int(w * (area * ratio) ** 0.5)
+        nh = int(h * (area / ratio) ** 0.5)
+        nw, nh = max(nw, w), max(nh, h)
+        x0 = pyrandom.randint(0, nw - w)
+        y0 = pyrandom.randint(0, nh - h)
+        canvas = _np.empty((nh, nw, src.shape[2]), src.dtype)
+        canvas[:] = _np.asarray(self.pad_val, src.dtype)
+        canvas[y0:y0 + h, x0:x0 + w] = src
+        out = label.copy()
+        valid = out[:, 0] >= 0
+        out[valid, 1] = (out[valid, 1] * w + x0) / nw
+        out[valid, 3] = (out[valid, 3] * w + x0) / nw
+        out[valid, 2] = (out[valid, 2] * h + y0) / nh
+        out[valid, 4] = (out[valid, 4] * h + y0) / nh
+        return canvas, out
+
+
+class _DetResizeAug(DetAugmenter):
+    """Force resize (boxes are normalized, so labels are unchanged)."""
+
+    def __init__(self, size, interp=2):
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src, label):
+        return imresize(src, self.size[0], self.size[1],
+                        self.interp), label
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_mirror=False, mean=None, std=None,
+                       brightness=0, contrast=0, saturation=0,
+                       pca_noise=0, hue=0, inter_method=2,
+                       min_object_covered=0.1,
+                       aspect_ratio_range=(0.75, 1.33),
+                       area_range=(0.05, 3.0), min_eject_coverage=0.3,
+                       max_attempts=50, pad_val=(127, 127, 127)):
+    """Standard detection augmenter list (reference:
+    detection.py CreateDetAugmenter)."""
+    from .image import (BrightnessJitterAug, ContrastJitterAug,
+                        SaturationJitterAug, HueJitterAug, LightingAug,
+                        ColorNormalizeAug, CastAug)
+    auglist = []
+    if rand_crop > 0:
+        crop = DetRandomCropAug(min_object_covered, aspect_ratio_range,
+                                (area_range[0], min(area_range[1], 1.0)),
+                                min_eject_coverage, max_attempts)
+        auglist.append(DetRandomSelectAug([crop], 1 - rand_crop))
+    if rand_pad > 0:
+        pad = DetRandomPadAug(aspect_ratio_range,
+                              (max(area_range[0], 1.0), area_range[1]),
+                              max_attempts, pad_val)
+        auglist.append(DetRandomSelectAug([pad], 1 - rand_pad))
+    auglist.append(_DetResizeAug((data_shape[2], data_shape[1]),
+                                 inter_method))
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    if brightness or contrast or saturation:
+        if brightness:
+            auglist.append(DetBorrowAug(BrightnessJitterAug(brightness)))
+        if contrast:
+            auglist.append(DetBorrowAug(ContrastJitterAug(contrast)))
+        if saturation:
+            auglist.append(DetBorrowAug(SaturationJitterAug(saturation)))
+    if hue:
+        auglist.append(DetBorrowAug(HueJitterAug(hue)))
+    if pca_noise > 0:
+        eigval = _np.array([55.46, 4.794, 1.148])
+        eigvec = _np.array([[-0.5675, 0.7192, 0.4009],
+                            [-0.5808, -0.0045, -0.8140],
+                            [-0.5836, -0.6948, 0.4203]])
+        auglist.append(DetBorrowAug(LightingAug(pca_noise, eigval,
+                                                eigvec)))
+    # same semantics as image.py CreateAugmenter: True -> ImageNet
+    # constants; None -> skip that component
+    if mean is True:
+        mean = _np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = _np.array([58.395, 57.12, 57.375])
+    if mean is not None or std is not None:
+        auglist.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
+    auglist.append(DetBorrowAug(CastAug()))
+    return auglist
+
+
+class ImageDetIter(ImageIter):
+    """Detection iterator: ImageIter with detection labels + detection
+    augmenters (reference: detection.py ImageDetIter).
+
+    Raw labels may be either the header format
+    [header_width, object_width, extra..., objects...] or a flat
+    [id, x1, y1, x2, y2] * N vector.
+    """
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root="", shuffle=False,
+                 aug_list=None, imglist=None, data_name="data",
+                 label_name="label", label_shape=None, **kwargs):
+        if aug_list is None:
+            aug_list = CreateDetAugmenter(data_shape)
+        super().__init__(batch_size, data_shape, label_width=-1,
+                         path_imgrec=path_imgrec,
+                         path_imglist=path_imglist, path_root=path_root,
+                         shuffle=shuffle, aug_list=[], imglist=imglist,
+                         data_name=data_name, label_name=label_name,
+                         **kwargs)
+        self.det_aug_list = aug_list
+        if label_shape is not None:
+            # (max_objects, 5) given explicitly (reference: ImageDetIter
+            # label_shape) — skips the dataset scan
+            self._max_objects = int(label_shape[0])
+        elif self._list is not None:
+            # labels are already in memory; no image I/O needed
+            self._max_objects = max(
+                (self._parse_label(lab).shape[0]
+                 for _, lab in self._list), default=1)
+        else:
+            self._max_objects = self._scan_max_objects()
+
+    @staticmethod
+    def _parse_label(raw):
+        """Raw flat vector -> (n_obj, 5) [id, x1, y1, x2, y2].
+
+        Header form requires an INTEGRAL header width >= 2 and object
+        width >= 5 that exactly tile the remainder — otherwise the
+        vector is treated as flat [id, x1, y1, x2, y2] * N (a flat
+        label whose first class id happens to be >= 2 must not be
+        mistaken for a header)."""
+        raw = _np.asarray(raw, _np.float32).ravel()
+        # flat first: a size divisible by 5 can never be the common
+        # header=2/obj_w=5 layout (2 + 5n is never a multiple of 5)
+        if raw.size % 5 == 0 and raw.size > 0:
+            return raw.reshape(-1, 5).astype(_np.float32)
+        if raw.size >= 2:
+            header, obj_w = float(raw[0]), float(raw[1])
+            if (header.is_integer() and obj_w.is_integer() and
+                    header >= 2 and obj_w >= 5 and raw.size > header and
+                    (raw.size - int(header)) % int(obj_w) == 0):
+                body = raw[int(header):]
+                n = body.size // int(obj_w)
+                return body[:n * int(obj_w)].reshape(n, int(obj_w))[:, :5] \
+                    .astype(_np.float32)
+        raise MXNetError(
+            "label length %d is not a multiple of 5 and has no valid "
+            "header" % raw.size)
+
+    def _scan_max_objects(self):
+        self.reset()
+        mx_obj = 1
+        while True:
+            raw = self._read_raw()
+            if raw is None:
+                break
+            mx_obj = max(mx_obj, self._parse_label(raw[0]).shape[0])
+        self.reset()
+        return mx_obj
+
+    @property
+    def provide_label(self):
+        return [DataDesc(self.label_name,
+                         (self.batch_size, self._max_objects, 5))]
+
+    def next(self):
+        from .image import imdecode
+        data = _np.zeros((self.batch_size,) + self.data_shape,
+                         _np.float32)
+        labels = _np.full((self.batch_size, self._max_objects, 5),
+                          -1.0, _np.float32)
+        n = 0
+        while n < self.batch_size:
+            raw = self._read_raw()
+            if raw is None:
+                break
+            lab, buf = raw
+            img = imdecode(buf) if isinstance(buf, (bytes, bytearray)) \
+                else buf
+            objs = self._parse_label(lab)
+            padded = _np.full((self._max_objects, 5), -1.0, _np.float32)
+            padded[:objs.shape[0]] = objs[:self._max_objects]
+            for aug in self.det_aug_list:
+                img, padded = aug(img, padded)
+            arr = _np.asarray(img, _np.float32)
+            if arr.shape[:2] != (self.data_shape[1], self.data_shape[2]):
+                arr = _np.asarray(imresize(arr, self.data_shape[2],
+                                           self.data_shape[1]),
+                                  _np.float32)
+            data[n] = arr.transpose(2, 0, 1)
+            labels[n] = padded
+            n += 1
+        if n == 0:
+            raise StopIteration
+        return DataBatch(data=[nd.array(data)], label=[nd.array(labels)],
+                         pad=self.batch_size - n,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)

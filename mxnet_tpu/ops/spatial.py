@@ -1,0 +1,332 @@
+"""Spatial-transform, correlation, deformable, and signal ops.
+
+Reference coverage:
+- SpatialTransformer / GridGenerator / BilinearSampler
+  (``src/operator/spatial_transformer.cc``, ``grid_generator.cc``,
+  ``bilinear_sampler.cc``)
+- Correlation (``src/operator/correlation.cc``)
+- Deformable convolution + PSROIPooling
+  (``src/operator/contrib/deformable_convolution.cc``,
+  ``psroi_pooling.cc``)
+- SyncBatchNorm (``src/operator/contrib/sync_batch_norm.cc``)
+- fft/ifft (``src/operator/contrib/fft.cc``), count_sketch
+  (``count_sketch.cc``)
+
+TPU-native notes: every sampler lowers to gathers + fused elementwise
+math; Correlation and deformable conv unroll their (static, small)
+displacement/kernel grids into shifted views XLA fuses into one kernel —
+no scalar loops reach the device.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+# ---------------------------------------------------------------------------
+# bilinear sampling machinery
+# ---------------------------------------------------------------------------
+
+
+def _bilinear_sample_nchw(img, xs, ys):
+    """Sample img (C, H, W) at float pixel coords xs/ys (...); zero
+    padding outside (the reference BilinearSampler border behavior)."""
+    C, H, W = img.shape
+    x0 = jnp.floor(xs)
+    y0 = jnp.floor(ys)
+    x1 = x0 + 1
+    y1 = y0 + 1
+    wx1 = xs - x0
+    wy1 = ys - y0
+
+    def tap(xi, yi):
+        valid = (xi >= 0) & (xi <= W - 1) & (yi >= 0) & (yi <= H - 1)
+        xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+        yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+        v = img[:, yc, xc]                    # (C, ...)
+        return v * valid.astype(img.dtype)
+
+    return (tap(x0, y0) * ((1 - wy1) * (1 - wx1)).astype(img.dtype)
+            + tap(x1, y0) * ((1 - wy1) * wx1).astype(img.dtype)
+            + tap(x0, y1) * (wy1 * (1 - wx1)).astype(img.dtype)
+            + tap(x1, y1) * (wy1 * wx1).astype(img.dtype))
+
+
+@register_op("BilinearSampler", input_names=("data", "grid"))
+def _bilinear_sampler(data, grid):
+    """data (N,C,H,W); grid (N,2,Ho,Wo) with normalized coords in
+    [-1,1], grid[:,0]=x, grid[:,1]=y (reference: bilinear_sampler.cc)."""
+    N, C, H, W = data.shape
+    xs = (grid[:, 0] + 1.0) * (W - 1) / 2.0
+    ys = (grid[:, 1] + 1.0) * (H - 1) / 2.0
+    return jax.vmap(_bilinear_sample_nchw)(data, xs, ys)
+
+
+@register_op("GridGenerator", input_names=("data",))
+def _grid_generator(data, transform_type="affine", target_shape=(0, 0)):
+    """affine: data (N,6) row-major 2x3 -> grid (N,2,H,W); warp: data is
+    a flow field (N,2,H,W) added to the identity grid
+    (reference: grid_generator.cc)."""
+    th, tw = int(target_shape[0]), int(target_shape[1])
+    if transform_type == "affine":
+        N = data.shape[0]
+        ys, xs = jnp.meshgrid(
+            jnp.linspace(-1.0, 1.0, th), jnp.linspace(-1.0, 1.0, tw),
+            indexing="ij")
+        ones = jnp.ones_like(xs)
+        base = jnp.stack([xs, ys, ones], 0).reshape(3, -1)  # (3, H*W)
+        theta = data.reshape(N, 2, 3)
+        grid = theta @ base                                 # (N, 2, H*W)
+        return grid.reshape(N, 2, th, tw)
+    # warp: flow in pixels added to identity, then normalized
+    N, _, H, W = data.shape
+    ys, xs = jnp.meshgrid(jnp.arange(H, dtype=data.dtype),
+                          jnp.arange(W, dtype=data.dtype), indexing="ij")
+    x = xs[None] + data[:, 0]
+    y = ys[None] + data[:, 1]
+    xn = 2.0 * x / jnp.maximum(W - 1, 1) - 1.0
+    yn = 2.0 * y / jnp.maximum(H - 1, 1) - 1.0
+    return jnp.stack([xn, yn], 1)
+
+
+@register_op("SpatialTransformer", input_names=("data", "loc"))
+def _spatial_transformer(data, loc, target_shape=(0, 0),
+                         transform_type="affine",
+                         sampler_type="bilinear"):
+    """Affine spatial transformer network op = GridGenerator +
+    BilinearSampler (reference: spatial_transformer.cc)."""
+    grid = _grid_generator(loc, "affine", target_shape)
+    return _bilinear_sampler(data, grid)
+
+
+# ---------------------------------------------------------------------------
+# Correlation (FlowNet-style cost volume)
+# ---------------------------------------------------------------------------
+
+
+@register_op("Correlation", input_names=("data1", "data2"))
+def _correlation(data1, data2, kernel_size=1, max_displacement=1,
+                 stride1=1, stride2=1, pad_size=0, is_multiply=True):
+    """Cost volume between two feature maps (reference:
+    correlation.cc).  Output (N, D*D, Ho, Wo) where D =
+    2*(max_displacement//stride2)+1; each channel is the mean
+    correlation at one displacement — the displacement grid is a static
+    unrolled loop of shifted views, fused by XLA."""
+    N, C, H, W = data1.shape
+    pad = int(pad_size)
+    d1 = jnp.pad(data1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    d2 = jnp.pad(data2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    k = int(kernel_size)
+    bk = k // 2
+    md = int(max_displacement)
+    s1, s2 = int(stride1), int(stride2)
+    nd = md // s2
+    D = 2 * nd + 1
+    border = bk + md
+    Hp, Wp = H + 2 * pad, W + 2 * pad
+    ys = jnp.arange(border, Hp - border, s1)
+    xs = jnp.arange(border, Wp - border, s1)
+    outs = []
+    norm = C * k * k
+    for dy in range(-nd, nd + 1):
+        for dx in range(-nd, nd + 1):
+            acc = 0.0
+            for ky in range(-bk, bk + 1):
+                for kx in range(-bk, bk + 1):
+                    p1 = d1[:, :, ys[:, None] + ky, xs[None, :] + kx]
+                    p2 = d2[:, :, ys[:, None] + ky + dy * s2,
+                            xs[None, :] + kx + dx * s2]
+                    if is_multiply:
+                        acc = acc + (p1 * p2).sum(axis=1)
+                    else:
+                        acc = acc + jnp.abs(p1 - p2).sum(axis=1)
+            outs.append(acc / norm)
+    return jnp.stack(outs, axis=1)
+
+
+# ---------------------------------------------------------------------------
+# deformable convolution + PSROIPooling
+# ---------------------------------------------------------------------------
+
+
+@register_op("_contrib_DeformableConvolution",
+             input_names=("data", "offset", "weight", "bias"),
+             aliases=("DeformableConvolution",))
+def _deformable_conv(data, offset, weight, bias=None, kernel=(3, 3),
+                     stride=(1, 1), pad=(0, 0), dilate=(1, 1),
+                     num_filter=0, num_group=1,
+                     num_deformable_group=1, no_bias=False):
+    """Deformable convolution v1 (reference:
+    deformable_convolution.cc): each kernel tap samples the input at
+    its base position plus a learned (dy, dx) offset via bilinear
+    interpolation, then a 1x1-style contraction applies the weights.
+    The kernel grid is static, so the tap loop unrolls into fused
+    gathers."""
+    N, C, H, W = data.shape
+    kh, kw = int(kernel[0]), int(kernel[1])
+    sh, sw = int(stride[0]), int(stride[1])
+    ph, pw = int(pad[0]), int(pad[1])
+    dh, dw = int(dilate[0]), int(dilate[1])
+    Ho = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    Wo = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    ndg = int(num_deformable_group)
+    # base sampling positions per output pixel
+    ys = jnp.arange(Ho) * sh - ph
+    xs = jnp.arange(Wo) * sw - pw
+    cols = []        # one (N, C, Ho, Wo) sampled plane per kernel tap
+    off = offset.reshape(N, ndg, kh * kw, 2, Ho, Wo)
+    ch_per_dg = C // ndg
+    for ki in range(kh):
+        for kj in range(kw):
+            tap = ki * kw + kj
+            planes = []
+            for g in range(ndg):
+                dy = off[:, g, tap, 0]        # (N, Ho, Wo)
+                dx = off[:, g, tap, 1]
+                py = ys[None, :, None] + ki * dh + dy
+                px = xs[None, None, :] + kj * dw + dx
+                sub = data[:, g * ch_per_dg:(g + 1) * ch_per_dg]
+                planes.append(jax.vmap(_bilinear_sample_nchw)(
+                    sub, px, py))
+            cols.append(jnp.concatenate(planes, axis=1))
+    col = jnp.stack(cols, axis=2)   # (N, C, K, Ho, Wo)
+    w = weight.reshape(int(num_filter), -1)   # (F, C/g * kh * kw)
+    G = int(num_group)
+    cpg = C // G
+    fpg = int(num_filter) // G
+    outs = []
+    for g in range(G):
+        colg = col[:, g * cpg:(g + 1) * cpg].reshape(
+            N, cpg * kh * kw, Ho * Wo)
+        wg = w[g * fpg:(g + 1) * fpg]
+        outs.append(jnp.einsum("fk,nkp->nfp", wg, colg))
+    out = jnp.concatenate(outs, axis=1).reshape(N, int(num_filter),
+                                                Ho, Wo)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+@register_op("_contrib_PSROIPooling", input_names=("data", "rois"),
+             aliases=("PSROIPooling",))
+def _psroi_pooling(data, rois, spatial_scale=1.0, output_dim=0,
+                   pooled_size=0, group_size=0):
+    """Position-sensitive ROI pooling (reference: psroi_pooling.cc —
+    R-FCN): output channel c's bin (i, j) average-pools input channel
+    c * g^2 + i * g + j inside that bin."""
+    g = int(group_size) if group_size else int(pooled_size)
+    k = int(pooled_size)
+    od = int(output_dim)
+    N, C, H, W = data.shape
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1]) * spatial_scale
+        y1 = jnp.round(roi[2]) * spatial_scale
+        x2 = (jnp.round(roi[3]) + 1.0) * spatial_scale
+        y2 = (jnp.round(roi[4]) + 1.0) * spatial_scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_h = rh / k
+        bin_w = rw / k
+        img = data[bidx]
+        # average via masked sum over the full map (static shapes)
+        ys = jnp.arange(H, dtype=data.dtype) + 0.5
+        xs = jnp.arange(W, dtype=data.dtype) + 0.5
+        out = jnp.zeros((od, k, k), data.dtype)
+        for i in range(k):
+            for j in range(k):
+                y_lo = y1 + i * bin_h
+                y_hi = y1 + (i + 1) * bin_h
+                x_lo = x1 + j * bin_w
+                x_hi = x1 + (j + 1) * bin_w
+                mask = ((ys[:, None] >= jnp.floor(y_lo)) &
+                        (ys[:, None] < jnp.ceil(y_hi)) &
+                        (xs[None, :] >= jnp.floor(x_lo)) &
+                        (xs[None, :] < jnp.ceil(x_hi)))
+                cnt = jnp.maximum(mask.sum(), 1)
+                gi = i * g // k
+                gj = j * g // k
+                chans = img[(jnp.arange(od) * g + gi) * g + gj]
+                val = (chans * mask[None]).sum((1, 2)) / cnt
+                out = out.at[:, i, j].set(val)
+        return out
+
+    return jax.vmap(one_roi)(rois)
+
+
+# ---------------------------------------------------------------------------
+# SyncBatchNorm
+# ---------------------------------------------------------------------------
+
+
+@register_op("_contrib_SyncBatchNorm", num_outputs=5,
+             num_visible_outputs=1,
+             input_names=("data", "gamma", "beta", "moving_mean",
+                          "moving_var"),
+             aliases=("SyncBatchNorm",))
+def _sync_batch_norm(data, gamma, beta, moving_mean, moving_var,
+                     eps=1e-3, momentum=0.9, fix_gamma=True,
+                     use_global_stats=False, output_mean_var=False,
+                     ndev=1, key="", training=True):
+    """Cross-device BatchNorm (reference: sync_batch_norm.cc, which
+    runs a key-based global barrier + allreduce of the batch moments).
+
+    TPU-native: under pjit the whole (global) batch is visible to one
+    XLA program, so plain batch statistics ARE the synchronized
+    statistics — XLA inserts the psum over the dp mesh axis when the
+    batch dim is sharded.  The op therefore shares the BatchNorm math;
+    ``ndev``/``key`` exist for API parity and are not needed."""
+    from .nn import _batch_norm
+    return _batch_norm(data, gamma, beta, moving_mean, moving_var,
+                       eps=eps, momentum=momentum, fix_gamma=fix_gamma,
+                       use_global_stats=use_global_stats,
+                       output_mean_var=output_mean_var,
+                       axis=1, training=training)
+
+
+from .registry import get_op as _get_op  # noqa: E402
+
+# moving stats are mutable aux states mapped to the trailing outputs,
+# exactly like BatchNorm
+_get_op("_contrib_SyncBatchNorm").aux_states = {3: 3, 4: 4}
+
+
+# ---------------------------------------------------------------------------
+# fft / ifft / count_sketch
+# ---------------------------------------------------------------------------
+
+
+@register_op("_contrib_fft", aliases=("fft",))
+def _fft(data, compute_size=128):
+    """FFT over the last axis, output interleaved [re, im] pairs making
+    the last dim 2x (reference: fft.cc output layout)."""
+    f = jnp.fft.fft(data.astype(jnp.complex64), axis=-1)
+    out = jnp.stack([f.real, f.imag], axis=-1)
+    return out.reshape(data.shape[:-1] + (2 * data.shape[-1],)) \
+        .astype(jnp.float32)
+
+
+@register_op("_contrib_ifft", aliases=("ifft",))
+def _ifft(data, compute_size=128):
+    """Inverse of _contrib_fft: interleaved (..., 2n) -> real (..., n).
+    Matches the reference's unnormalized cuFFT inverse (scaled by n)."""
+    n = data.shape[-1] // 2
+    pairs = data.reshape(data.shape[:-1] + (n, 2))
+    comp = pairs[..., 0] + 1j * pairs[..., 1]
+    return jnp.fft.ifft(comp, axis=-1).real.astype(jnp.float32) * n
+
+
+@register_op("_contrib_count_sketch", input_names=("data", "h", "s"),
+             aliases=("count_sketch",))
+def _count_sketch(data, h, s, out_dim=0, processing_batch_size=32):
+    """Count sketch projection (reference: count_sketch.cc): out[:, h[j]]
+    += s[j] * data[:, j] — one scatter-add."""
+    od = int(out_dim)
+    idx = h.reshape(-1).astype(jnp.int32)
+    sign = s.reshape(-1).astype(data.dtype)
+    out = jnp.zeros(data.shape[:-1] + (od,), data.dtype)
+    return out.at[..., idx].add(data * sign)

@@ -1,0 +1,239 @@
+"""SpatialTransformer family, Correlation, deformable conv,
+PSROIPooling, SyncBatchNorm, fft/count_sketch + detection data path.
+
+Reference: src/operator/spatial_transformer.cc, bilinear_sampler.cc,
+grid_generator.cc, correlation.cc, contrib/{deformable_convolution,
+psroi_pooling, sync_batch_norm, fft, count_sketch}.cc,
+src/io/image_det_aug_default.cc, python/mxnet/image/detection.py.
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+RS = np.random.RandomState
+
+
+def _identity_grid(N, H, W):
+    ys, xs = np.meshgrid(np.linspace(-1, 1, H), np.linspace(-1, 1, W),
+                         indexing="ij")
+    return np.stack([np.broadcast_to(xs, (N, H, W)),
+                     np.broadcast_to(ys, (N, H, W))], 1) \
+        .astype(np.float32)
+
+
+def test_bilinear_sampler_identity_and_shift():
+    rs = RS(0)
+    x = rs.randn(2, 3, 5, 6).astype(np.float32)
+    grid = _identity_grid(2, 5, 6)
+    out = nd.BilinearSampler(nd.array(x), nd.array(grid)).asnumpy()
+    np.testing.assert_allclose(out, x, rtol=1e-4, atol=1e-5)
+    # shift one pixel right: out[..., j] = x[..., j-1], zeros at j=0
+    shift = grid.copy()
+    shift[:, 0] -= 2.0 / (6 - 1)
+    out = nd.BilinearSampler(nd.array(x), nd.array(shift)).asnumpy()
+    np.testing.assert_allclose(out[..., 1:], x[..., :-1], rtol=1e-3,
+                               atol=1e-4)
+
+
+def test_bilinear_sampler_gradient():
+    rs = RS(1)
+    sym = mx.sym.BilinearSampler(mx.sym.var("data"), mx.sym.var("grid"))
+    check_numeric_gradient(
+        sym, {"data": rs.randn(1, 2, 4, 4) * 0.5,
+              "grid": rs.uniform(-0.8, 0.8, (1, 2, 3, 3))},
+        rtol=5e-2, atol=1e-3)
+
+
+def test_grid_generator_warp():
+    # zero flow -> identity grid
+    flow = np.zeros((1, 2, 4, 5), np.float32)
+    g = nd.GridGenerator(nd.array(flow), transform_type="warp").asnumpy()
+    np.testing.assert_allclose(g, _identity_grid(1, 4, 5), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_spatial_transformer_zoom():
+    rs = RS(2)
+    x = rs.randn(1, 1, 8, 8).astype(np.float32)
+    # 0.5x zoom around center samples the middle of the image
+    theta = np.array([[0.5, 0, 0, 0, 0.5, 0]], np.float32)
+    out = nd.SpatialTransformer(nd.array(x), nd.array(theta),
+                                target_shape=(8, 8)).asnumpy()
+    assert out.shape == (1, 1, 8, 8)
+    # center pixel unchanged by any centered affine
+    np.testing.assert_allclose(out[0, 0, 4, 4],
+                               x[0, 0, 4, 4], rtol=0.2, atol=0.3)
+
+
+def test_correlation_zero_displacement_is_self_energy():
+    rs = RS(3)
+    x = rs.randn(2, 4, 5, 5).astype(np.float32)
+    c = nd.Correlation(nd.array(x), nd.array(x), kernel_size=1,
+                       max_displacement=1, pad_size=1).asnumpy()
+    assert c.shape == (2, 9, 5, 5)
+    np.testing.assert_allclose(c[:, 4], (x * x).mean(1), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_deformable_conv_zero_offset_equals_conv():
+    rs = RS(4)
+    x = rs.randn(2, 3, 6, 6).astype(np.float32)
+    w = rs.randn(4, 3, 3, 3).astype(np.float32) * 0.2
+    off = np.zeros((2, 18, 6, 6), np.float32)
+    dc = nd.DeformableConvolution(
+        nd.array(x), nd.array(off), nd.array(w), None, kernel=(3, 3),
+        pad=(1, 1), num_filter=4, no_bias=True).asnumpy()
+    ref = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                         pad=(1, 1), num_filter=4, no_bias=True) \
+        .asnumpy()
+    np.testing.assert_allclose(dc, ref, rtol=1e-3, atol=1e-4)
+
+
+def test_deformable_conv_integer_offset_shifts():
+    """Offset (0, +1) on every tap == convolving the left-shifted
+    image."""
+    rs = RS(5)
+    x = rs.randn(1, 1, 6, 6).astype(np.float32)
+    w = rs.randn(1, 1, 1, 1).astype(np.float32)
+    off = np.zeros((1, 2, 6, 6), np.float32)
+    off[:, 1] = 1.0  # dx = +1
+    dc = nd.DeformableConvolution(
+        nd.array(x), nd.array(off), nd.array(w), None, kernel=(1, 1),
+        num_filter=1, no_bias=True).asnumpy()
+    expected = np.zeros_like(x)
+    expected[..., :-1] = x[..., 1:] * w[0, 0, 0, 0]
+    np.testing.assert_allclose(dc, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_psroi_pooling():
+    rs = RS(6)
+    data = rs.randn(1, 8, 6, 6).astype(np.float32)  # od=2, g=2
+    rois = np.array([[0, 0, 0, 5, 5]], np.float32)
+    out = nd.PSROIPooling(nd.array(data), nd.array(rois),
+                          spatial_scale=1.0, output_dim=2,
+                          pooled_size=2, group_size=2).asnumpy()
+    assert out.shape == (1, 2, 2, 2)
+    # channel c, bin (i,j) pools data channel c*4 + i*2 + j
+    np.testing.assert_allclose(out[0, 0, 0, 0],
+                               data[0, 0, :3, :3].mean(), rtol=1e-4)
+    np.testing.assert_allclose(out[0, 1, 0, 1],
+                               data[0, 5, :3, 3:].mean(), rtol=1e-4)
+
+
+def test_sync_batch_norm_matches_batch_norm():
+    rs = RS(7)
+    x = rs.randn(4, 3, 2, 2).astype(np.float32)
+    g = (np.abs(rs.randn(3)) + 0.5).astype(np.float32)
+    b = rs.randn(3).astype(np.float32)
+    mm, mv = np.zeros(3, np.float32), np.ones(3, np.float32)
+    args = [nd.array(x), nd.array(g), nd.array(b), nd.array(mm),
+            nd.array(mv)]
+    sb = nd.SyncBatchNorm(*args, fix_gamma=False, training=True)
+    bn = nd.BatchNorm(*args, fix_gamma=False, training=True)
+    np.testing.assert_allclose(sb.asnumpy(), bn.asnumpy(), rtol=1e-5)
+
+
+def test_fft_ifft_roundtrip_and_values():
+    rs = RS(8)
+    d = rs.randn(3, 8).astype(np.float32)
+    f = nd.fft(nd.array(d)).asnumpy()
+    assert f.shape == (3, 16)
+    ref = np.fft.fft(d, axis=-1)
+    np.testing.assert_allclose(f[:, 0::2], ref.real, rtol=1e-3,
+                               atol=1e-4)
+    np.testing.assert_allclose(f[:, 1::2], ref.imag, rtol=1e-3,
+                               atol=1e-4)
+    back = nd.ifft(nd.array(f)).asnumpy() / 8.0
+    np.testing.assert_allclose(back, d, rtol=1e-4, atol=1e-5)
+
+
+def test_count_sketch():
+    rs = RS(9)
+    d = rs.randn(2, 6).astype(np.float32)
+    h = np.array([0, 2, 1, 2, 0, 1], np.float32)
+    s = np.array([1, -1, 1, 1, -1, 1], np.float32)
+    out = nd.count_sketch(nd.array(d), nd.array(h), nd.array(s),
+                          out_dim=3).asnumpy()
+    exp = np.zeros((2, 3), np.float32)
+    for j in range(6):
+        exp[:, int(h[j])] += s[j] * d[:, j]
+    np.testing.assert_allclose(out, exp, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# detection data path
+# ---------------------------------------------------------------------------
+
+
+def _toy_label():
+    # one object covering the center area
+    return np.array([[1, 0.25, 0.25, 0.75, 0.75],
+                     [-1, 0, 0, 0, 0]], np.float32)
+
+
+def test_det_horizontal_flip():
+    from mxnet_tpu.image.detection import DetHorizontalFlipAug
+    img = np.arange(2 * 4 * 3, dtype=np.uint8).reshape(2, 4, 3)
+    label = np.array([[0, 0.1, 0.2, 0.4, 0.8],
+                      [-1, 0, 0, 0, 0]], np.float32)
+    aug = DetHorizontalFlipAug(p=1.0)
+    out, lab = aug(img, label)
+    np.testing.assert_allclose(np.asarray(out), img[:, ::-1])
+    np.testing.assert_allclose(lab[0, [1, 3]], [0.6, 0.9], rtol=1e-6)
+    np.testing.assert_allclose(lab[1], label[1])  # padding untouched
+
+
+def test_det_random_crop_keeps_coverage():
+    from mxnet_tpu.image.detection import DetRandomCropAug
+    rs = RS(10)
+    img = rs.randint(0, 255, (32, 32, 3)).astype(np.uint8)
+    label = _toy_label()
+    aug = DetRandomCropAug(min_object_covered=0.5, max_attempts=50)
+    out, lab = aug(img, label)
+    kept = lab[lab[:, 0] >= 0]
+    if kept.size:  # surviving boxes stay inside [0,1]
+        assert (kept[:, 1:] >= 0).all() and (kept[:, 1:] <= 1).all()
+
+
+def test_det_random_pad_scales_boxes():
+    from mxnet_tpu.image.detection import DetRandomPadAug
+    rs = RS(11)
+    img = rs.randint(0, 255, (16, 16, 3)).astype(np.uint8)
+    label = _toy_label()
+    aug = DetRandomPadAug(area_range=(2.0, 2.0),
+                          aspect_ratio_range=(1.0, 1.0))
+    out, lab = aug(img, label)
+    assert out.shape[0] > 16 and out.shape[1] > 16
+    w = lab[0, 3] - lab[0, 1]
+    assert w < 0.5  # box shrank relative to the bigger canvas
+
+
+def test_image_det_iter_end_to_end(tmp_path):
+    import cv2
+    from mxnet_tpu.image.detection import ImageDetIter
+    rs = RS(12)
+    paths = []
+    labels = []
+    for i in range(4):
+        img = rs.randint(0, 255, (24, 30, 3)).astype(np.uint8)
+        p = str(tmp_path / ("img%d.jpg" % i))
+        cv2.imwrite(p, img)
+        paths.append(p)
+        n_obj = 1 + i % 2
+        lab = []
+        for j in range(n_obj):
+            lab += [j, 0.1, 0.1, 0.6, 0.7]
+        labels.append(np.array(lab, np.float32))
+    it = ImageDetIter(batch_size=2, data_shape=(3, 16, 16),
+                      imglist=list(zip(labels, paths)), path_root="")
+    batch = next(iter(it))
+    assert batch.data[0].shape == (2, 3, 16, 16)
+    assert batch.label[0].shape == (2, it._max_objects, 5)
+    assert it._max_objects == 2
+    lab = batch.label[0].asnumpy()
+    valid = lab[lab[:, :, 0] >= 0]
+    assert (valid[:, 1:] >= 0).all() and (valid[:, 1:] <= 1).all()
