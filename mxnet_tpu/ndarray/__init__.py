@@ -3,7 +3,7 @@
 from .ndarray import (NDArray, array, zeros, ones, full, empty, arange,  # noqa
                       zeros_like, ones_like, concatenate, waitall,
                       imperative_invoke, moveaxis, transpose)
-from .utils import save, load  # noqa: F401
+from .utils import save, load, save_bytes, load_bytes  # noqa: F401
 from . import random  # noqa: F401
 from . import register as _register
 
@@ -13,4 +13,5 @@ _register.populate(globals())
 from . import sparse  # noqa: F401  (after op functions exist)
 
 from . import contrib  # noqa: F401,E402  (control flow: foreach/while/cond)
+_register.populate_contrib(contrib.__dict__)
 from . import image  # noqa: F401,E402

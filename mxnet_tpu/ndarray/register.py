@@ -62,3 +62,17 @@ def populate(namespace, filt=None):
         namespace[name] = _make_fn(op)
         # also expose hidden ops without the underscore clash risk
     return namespace
+
+
+def populate_contrib(namespace):
+    """Install ``_contrib_*`` ops under their stripped names (the
+    reference exposes them as ``mx.nd.contrib.<name>``,
+    python/mxnet/base.py:578 _init_op_module with the contrib prefix)."""
+    for name in _reg.list_ops():
+        if not name.startswith("_contrib_"):
+            continue
+        short = name[len("_contrib_"):]
+        if short in namespace:
+            continue
+        namespace[short] = _make_fn(_reg.get_op(name))
+    return namespace

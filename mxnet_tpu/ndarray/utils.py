@@ -52,9 +52,32 @@ def save(fname, data):
     os.replace(tmp, fname)
 
 
+def save_bytes(data):
+    """Serialize NDArrays to bytes (reference: MXNDArraySaveRawBytes-style
+    in-memory form, used by the C predict ABI)."""
+    import io
+    entries = _flatten_for_save(data)
+    entries["__magic__"] = _np.array(_MAGIC)
+    buf = io.BytesIO()
+    _np.savez(buf, **entries)
+    return buf.getvalue()
+
+
+def load_bytes(raw):
+    """Load NDArrays from bytes produced by :func:`save_bytes` (or the
+    contents of a :func:`save` file)."""
+    import io
+    with _np.load(io.BytesIO(raw), allow_pickle=False) as z:
+        return _load_from(z)
+
+
 def load(fname):
     """Load NDArrays saved by :func:`save`."""
     with _np.load(fname, allow_pickle=False) as z:
+        return _load_from(z)
+
+
+def _load_from(z):
         keys = [k for k in z.files if k != "__magic__"]
         groups = {}
         for k in keys:

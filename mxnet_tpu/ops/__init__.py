@@ -20,3 +20,4 @@ from . import quantization  # noqa: F401
 from . import image         # noqa: F401
 from . import detection     # noqa: F401
 from . import spatial       # noqa: F401
+from . import attention     # noqa: F401

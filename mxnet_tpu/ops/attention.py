@@ -1,0 +1,275 @@
+"""Scaled-dot-product attention: the long-context stance of this framework.
+
+The reference predates Transformers — its only artifact is
+``_contrib_div_sqrt_dim`` (reference: src/operator/contrib/transformer.cc:33)
+and sequence scaling comes from bucketing + the fused RNN op (SURVEY §5.7).
+On TPU the idiomatic equivalent is one attention op with a flash (blockwise,
+online-softmax) kernel, plus a sequence-parallel ring variant over the ICI
+mesh (``mxnet_tpu.parallel.sequence``).  This module provides:
+
+- ``_chunked_attention``: lax.scan blockwise attention with online softmax —
+  O(S * chunk) activation memory, differentiable through the scan, runs on
+  every backend.  This is also the recompute path for the flash backward.
+- ``flash_attention``: Pallas TPU forward kernel (MXU-tiled, VMEM-resident
+  blocks, online softmax in f32 scratch) with a custom VJP whose backward
+  recomputes via the chunked path.
+- ``_contrib_DotProductAttention`` / ``_contrib_div_sqrt_dim`` registered
+  operators, so the op is reachable from mx.nd / mx.sym like any other.
+
+Layout is (batch, heads, seq, head_dim) throughout.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .registry import register_op
+
+__all__ = ["flash_attention", "attention_reference"]
+
+_NEG_INF = -1e30
+
+
+def attention_reference(q, k, v, causal=False, sm_scale=None):
+    """O(S^2)-memory einsum attention — the numeric oracle for tests."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    if causal:
+        qlen, klen = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((qlen, klen), bool), klen - qlen)
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)
+                      ).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Chunked (blockwise) attention: scan over K/V chunks with online softmax.
+# ---------------------------------------------------------------------------
+
+def _online_softmax_update(o, m, l, s, vb):
+    """One blockwise online-softmax accumulation step over masked scores
+    *s* against value block *vb*; shared by the chunked scan here and the
+    ring-attention scan (parallel/sequence.py) so the two paths cannot
+    drift numerically."""
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[..., None])
+    l = l * alpha + p.sum(axis=-1)
+    o = o * alpha[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, vb.astype(jnp.float32))
+    return o, m_new, l
+
+def _chunked_attention(q, k, v, causal=False, sm_scale=None, chunk=512):
+    """Blockwise attention with online softmax over K chunks.
+
+    Memory is O(S_q * chunk) instead of O(S_q * S_k); the scan body is
+    rematerialized on backward (jax.checkpoint), which is exactly the
+    flash-attention recompute strategy expressed at the XLA level.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    chunk = min(chunk, sk)
+    nchunk = -(-sk // chunk)
+    pad = nchunk * chunk - sk
+    if pad:
+        kp = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        vp = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    else:
+        kp, vp = k, v
+    kc = kp.reshape(b, h, nchunk, chunk, d).transpose(2, 0, 1, 3, 4)
+    vc = vp.reshape(b, h, nchunk, chunk, d).transpose(2, 0, 1, 3, 4)
+    qf = q.astype(jnp.float32)
+    q_pos = jnp.arange(sq) + (sk - sq)  # align ends for causal cross-length
+
+    @jax.checkpoint
+    def body(carry, xs):
+        o, m, l = carry
+        ci, kb, vb = xs
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf,
+                       kb.astype(jnp.float32)) * sm_scale
+        k_pos = ci * chunk + jnp.arange(chunk)
+        valid = k_pos < sk
+        if causal:
+            valid = valid[None, :] & (k_pos[None, :] <= q_pos[:, None])
+            s = jnp.where(valid[None, None], s, _NEG_INF)
+        else:
+            s = jnp.where(valid[None, None, None, :], s, _NEG_INF)
+        o, m, l = _online_softmax_update(o, m, l, s, vb)
+        return (o, m, l), None
+
+    o0 = jnp.zeros((b, h, sq, d), jnp.float32)
+    m0 = jnp.full((b, h, sq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    (o, m, l), _ = jax.lax.scan(
+        body, (o0, m0, l0), (jnp.arange(nchunk), kc, vc))
+    return (o / l[..., None]).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash forward kernel.
+# ---------------------------------------------------------------------------
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref,
+                      acc_ref, m_ref, l_ref, *, sm_scale, causal,
+                      blk_q, blk_k, seq_q, seq_k):
+    ik = pl.program_id(2)
+    nk = pl.num_programs(2)
+    iq = pl.program_id(1)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)           # (blk_q, d)
+        k = k_ref[0].astype(jnp.float32)           # (blk_k, d)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32
+                                ) * sm_scale
+
+        k_pos = ik * blk_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = k_pos < seq_k
+        if causal:
+            # sequence ends aligned (decode-style cross-length causal),
+            # same convention as attention_reference/_chunked_attention
+            q_pos = (iq * blk_q + (seq_k - seq_q)
+                     + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0))
+            mask = mask & (k_pos <= q_pos)
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_ref[...]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_ref[...] = l_ref[...] * alpha + p.sum(axis=-1)
+        m_ref[...] = m_new
+        acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        # skip K blocks entirely above the diagonal: their tiles are fully
+        # masked and would pay two MXU dots for nothing (~2x on sq == sk)
+        visible = ik * blk_k <= iq * blk_q + blk_q - 1 + (seq_k - seq_q)
+        pl.when(visible)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        o_ref[0] = (acc_ref[...] / l_ref[...][:, None]).astype(o_ref.dtype)
+
+
+def _flash_fwd_pallas(q, k, v, causal, sm_scale, blk_q=1024, blk_k=1024,
+                      interpret=False):
+    """Flash forward: grid (B*H, nq, nk); f32 accumulators in VMEM scratch."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    blk_q = min(blk_q, sq)
+    blk_k = min(blk_k, sk)
+    # pad seq dims to block multiples, head dim to the 128-lane tile
+    d_pad = -d % 128
+    sq_pad = -sq % blk_q
+    sk_pad = -sk % blk_k
+    qp = jnp.pad(q, ((0, 0), (0, 0), (0, sq_pad), (0, d_pad)))
+    kp = jnp.pad(k, ((0, 0), (0, 0), (0, sk_pad), (0, d_pad)))
+    vp = jnp.pad(v, ((0, 0), (0, 0), (0, sk_pad), (0, d_pad)))
+    bh = b * h
+    dp = d + d_pad
+    qp = qp.reshape(bh, sq + sq_pad, dp)
+    kp = kp.reshape(bh, sk + sk_pad, dp)
+    vp = vp.reshape(bh, sk + sk_pad, dp)
+    nq = (sq + sq_pad) // blk_q
+    nk = (sk + sk_pad) // blk_k
+
+    kernel = functools.partial(
+        _flash_fwd_kernel, sm_scale=sm_scale, causal=causal,
+        blk_q=blk_q, blk_k=blk_k, seq_q=sq, seq_k=sk)
+    out = pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, blk_q, dp), lambda bh_, iq, ik: (bh_, iq, 0)),
+            pl.BlockSpec((1, blk_k, dp), lambda bh_, iq, ik: (bh_, ik, 0)),
+            pl.BlockSpec((1, blk_k, dp), lambda bh_, iq, ik: (bh_, ik, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, blk_q, dp),
+                               lambda bh_, iq, ik: (bh_, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq + sq_pad, dp), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((blk_q, dp), jnp.float32),
+            pltpu.VMEM((blk_q,), jnp.float32),
+            pltpu.VMEM((blk_q,), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qp, kp, vp)
+    return out.reshape(b, h, sq + sq_pad, dp)[:, :, :sq, :d]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal, sm_scale, interpret):
+    return _flash_fwd_pallas(q, k, v, causal, sm_scale, interpret=interpret)
+
+
+def _flash_vjp_fwd(q, k, v, causal, sm_scale, interpret):
+    return _flash(q, k, v, causal, sm_scale, interpret), (q, k, v)
+
+
+def _flash_vjp_bwd(causal, sm_scale, interpret, res, g):
+    # flash backward = recompute; the chunked scan (itself rematerialized)
+    # is that recompute expressed at the XLA level.
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _chunked_attention(q_, k_, v_, causal, sm_scale),
+        q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, causal=False, sm_scale=None, interpret=False):
+    """Blockwise (flash) attention, (B, H, S, D) layout.
+
+    Pallas MXU kernel on TPU; chunked-scan XLA path elsewhere.  Both have
+    O(S * block) activation memory; grads flow through either.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if interpret or jax.default_backend() == "tpu":
+        return _flash(q, k, v, causal, float(sm_scale), interpret)
+    return _chunked_attention(q, k, v, causal, sm_scale)
+
+
+# ---------------------------------------------------------------------------
+# Operator registrations.
+# ---------------------------------------------------------------------------
+
+@register_op("_contrib_DotProductAttention",
+             input_names=("query", "key", "value"))
+def _dot_product_attention(query, key, value, causal=False, sm_scale=None,
+                           chunk=512):
+    """Fused scaled-dot-product attention (TPU-native; no reference
+    counterpart — the reference predates Transformers, SURVEY §5.7)."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(query.shape[-1])
+    if jax.default_backend() == "tpu":
+        return _flash(query, key, value, bool(causal), float(sm_scale), False)
+    return _chunked_attention(query, key, value, bool(causal),
+                              float(sm_scale), int(chunk))

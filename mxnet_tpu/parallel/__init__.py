@@ -6,3 +6,5 @@ from .mesh import (make_mesh, current_mesh, use_mesh, data_parallel_mesh,
                    PartitionSpec, NamedSharding, named_sharding)  # noqa
 from . import collectives  # noqa: F401
 from .data_parallel import ParallelTrainer  # noqa: F401
+from .sequence import (ring_attention_shard,  # noqa: F401
+                       sequence_parallel_attention)

@@ -10,4 +10,5 @@ zeros = globals()["_zeros"]
 ones = globals()["_ones"]
 
 from . import contrib  # noqa: F401,E402  (control flow: foreach/while/cond)
+_register.populate_contrib(contrib.__dict__)
 from . import image  # noqa: F401,E402

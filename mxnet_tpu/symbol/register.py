@@ -95,3 +95,16 @@ def populate(namespace, filt=None):
             continue
         namespace[name] = _make_fn(op)
     return namespace
+
+
+def populate_contrib(namespace):
+    """``_contrib_*`` ops under stripped names, as ``mx.sym.contrib.<name>``
+    (reference: python/mxnet/base.py:578 _init_op_module)."""
+    for name in _reg.list_ops():
+        if not name.startswith("_contrib_"):
+            continue
+        short = name[len("_contrib_"):]
+        if short in namespace:
+            continue
+        namespace[short] = _make_fn(_reg.get_op(name))
+    return namespace

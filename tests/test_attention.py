@@ -1,0 +1,170 @@
+"""Attention stack tests: chunked/flash attention vs the einsum oracle,
+ring attention on the virtual 8-device mesh (SURVEY §5.7 TPU stance)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops.attention import (attention_reference, _chunked_attention,
+                                     _flash_fwd_pallas, flash_attention)
+from mxnet_tpu.parallel import make_mesh, sequence_parallel_attention
+
+
+def _rand_qkv(b=2, h=3, sq=64, sk=64, d=16, seed=0):
+    rng = np.random.RandomState(seed)
+    q = jnp.asarray(rng.randn(b, h, sq, d).astype(np.float32))
+    k = jnp.asarray(rng.randn(b, h, sk, d).astype(np.float32))
+    v = jnp.asarray(rng.randn(b, h, sk, d).astype(np.float32))
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_chunked_matches_reference(causal):
+    q, k, v = _rand_qkv()
+    ref = attention_reference(q, k, v, causal=causal)
+    out = _chunked_attention(q, k, v, causal=causal, chunk=16)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_chunked_grads_match_reference(causal):
+    q, k, v = _rand_qkv(b=1, h=2, sq=32, sk=32, d=8)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=causal) ** 2)
+
+    def loss_chk(q, k, v):
+        return jnp.sum(
+            _chunked_attention(q, k, v, causal=causal, chunk=8) ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_chk = jax.grad(loss_chk, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_chk):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_chunked_cross_length_causal():
+    # decode-style: fewer queries than keys, causal ends aligned
+    q, k, v = _rand_qkv(sq=8, sk=64)
+    ref = attention_reference(q, k, v, causal=True)
+    out = _chunked_attention(q, k, v, causal=True, chunk=16)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_pallas_flash_forward_interpret(causal):
+    # interpret=True runs the TPU kernel logic on CPU
+    q, k, v = _rand_qkv(b=1, h=2, sq=48, sk=48, d=16)
+    ref = attention_reference(q, k, v, causal=causal)
+    out = _flash_fwd_pallas(q, k, v, causal, 1.0 / np.sqrt(16),
+                            blk_q=16, blk_k=16, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_pallas_flash_cross_length_causal_interpret():
+    q, k, v = _rand_qkv(b=1, h=1, sq=8, sk=64, d=16)
+    ref = attention_reference(q, k, v, causal=True)
+    out = _flash_fwd_pallas(q, k, v, True, 1.0 / np.sqrt(16),
+                            blk_q=8, blk_k=16, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_cross_length_causal():
+    mesh = make_mesh({"sp": 8})
+    q, k, v = _rand_qkv(b=1, h=2, sq=32, sk=64, d=8)
+    ref = attention_reference(q, k, v, causal=True)
+    out = sequence_parallel_attention(q, k, v, mesh, axis="sp", causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_attention_grad_interpret():
+    q, k, v = _rand_qkv(b=1, h=1, sq=32, sk=32, d=8)
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, interpret=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention_reference(q, k, v) ** 2)
+
+    g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_full(causal):
+    mesh = make_mesh({"sp": 8})
+    q, k, v = _rand_qkv(b=2, h=2, sq=64, sk=64, d=16)
+    ref = attention_reference(q, k, v, causal=causal)
+    out = sequence_parallel_attention(q, k, v, mesh, axis="sp",
+                                      causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_grads_match_full():
+    mesh = make_mesh({"sp": 8})
+    q, k, v = _rand_qkv(b=1, h=2, sq=32, sk=32, d=8)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(
+            sequence_parallel_attention(q, k, v, mesh, causal=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=True) ** 2)
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_ring):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_ndarray_op_and_div_sqrt_dim():
+    q, k, v = _rand_qkv(b=1, h=1, sq=16, sk=16, d=4)
+    out = mx.nd.contrib.DotProductAttention(
+        mx.nd.array(np.asarray(q)), mx.nd.array(np.asarray(k)),
+        mx.nd.array(np.asarray(v)))
+    ref = attention_reference(q, k, v)
+    np.testing.assert_allclose(out.asnumpy(), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    x = mx.nd.array(np.ones((2, 16), np.float32))
+    y = mx.nd.contrib.div_sqrt_dim(x)
+    np.testing.assert_allclose(y.asnumpy(), np.ones((2, 16)) / 4.0,
+                               rtol=1e-6)
+
+
+def test_symbolic_attention_with_grad():
+    import mxnet_tpu.symbol as sym
+    q = sym.var("q")
+    k = sym.var("k")
+    v = sym.var("v")
+    out = sym.contrib.DotProductAttention(q, k, v)
+    qn, kn, vn = _rand_qkv(b=1, h=1, sq=16, sk=16, d=4)
+    ex = out.bind(mx.cpu(), {"q": mx.nd.array(np.asarray(qn)),
+                             "k": mx.nd.array(np.asarray(kn)),
+                             "v": mx.nd.array(np.asarray(vn))},
+                  args_grad={"q": mx.nd.zeros(qn.shape),
+                             "k": mx.nd.zeros(kn.shape),
+                             "v": mx.nd.zeros(vn.shape)})
+    y = ex.forward(is_train=True)[0]
+    ref = attention_reference(qn, kn, vn)
+    np.testing.assert_allclose(y.asnumpy(), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    ex.backward(mx.nd.ones(y.shape))
+    g_ref = jax.grad(
+        lambda a, b, c: jnp.sum(attention_reference(a, b, c)),
+        argnums=(0, 1, 2))(qn, kn, vn)
+    np.testing.assert_allclose(ex.grad_dict["q"].asnumpy(),
+                               np.asarray(g_ref[0]), rtol=2e-4, atol=2e-4)
