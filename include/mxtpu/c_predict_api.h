@@ -1,0 +1,56 @@
+/*
+ * C predict ABI — standalone inference entry points.
+ *
+ * Mirrors the reference's include/mxnet/c_predict_api.h:78-207 surface.
+ * Link against libmxtpu_predict.so (built by src/capi/Makefile) or load
+ * it with dlopen/ctypes.  The library embeds the Python/XLA runtime; the
+ * ABI below is plain C.
+ */
+#ifndef MXTPU_C_PREDICT_API_H_
+#define MXTPU_C_PREDICT_API_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* PredictorHandle;
+typedef void* NDListHandle;
+typedef uint32_t mx_uint;
+typedef float mx_float;
+
+/* Returns the last error message from any failed call (thread-local). */
+const char* MXGetLastError(void);
+
+/* Create a predictor from symbol JSON + serialized params.
+ * dev_type: 1 = cpu, 2 = tpu.  Input shapes are given CSR-style:
+ * shape of input i is input_shape_data[indptr[i]..indptr[i+1]).  */
+int MXPredCreate(const char* symbol_json_str, const void* param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char** input_keys,
+                 const mx_uint* input_shape_indptr,
+                 const mx_uint* input_shape_data, PredictorHandle* out);
+
+int MXPredSetInput(PredictorHandle handle, const char* key,
+                   const mx_float* data, mx_uint size);
+int MXPredForward(PredictorHandle handle);
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                         mx_uint** shape_data, mx_uint* shape_ndim);
+int MXPredGetOutput(PredictorHandle handle, mx_uint index, mx_float* data,
+                    mx_uint size);
+int MXPredFree(PredictorHandle handle);
+
+/* NDArray-file list access (param inspection without a predictor). */
+int MXNDListCreate(const char* nd_file_bytes, int nd_file_size,
+                   NDListHandle* out, mx_uint* out_length);
+int MXNDListGet(NDListHandle handle, mx_uint index, const char** out_key,
+                const mx_float** out_data, const mx_uint** out_shape,
+                mx_uint* out_ndim);
+int MXNDListFree(NDListHandle handle);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* MXTPU_C_PREDICT_API_H_ */

@@ -1,0 +1,113 @@
+"""Python side of the C predict ABI (reference: src/c_api/c_predict_api.cc).
+
+``src/capi/mxtpu_predict.cc`` embeds CPython and calls into this module;
+each ``MXPred*`` C function maps onto one method here.  The C++ layer only
+marshals raw float buffers and shape tuples — all framework logic
+(symbol JSON parsing, param loading, executor bind, forward) stays on this
+side of the boundary, exactly like the reference routes its predict API
+through the graph executor (c_predict_api.cc:106 MXPredCreatePartialOut).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class Predictor(object):
+    """One MXPredCreate handle: a bound single-batch forward executor."""
+
+    def __init__(self, symbol_json, param_bytes, dev_type, dev_id,
+                 input_keys, input_shapes):
+        import mxnet_tpu as mx
+        from mxnet_tpu import symbol as sym_mod
+
+        sym = sym_mod.load_json(symbol_json)
+        # param files store "arg:name" / "aux:name" prefixed dicts
+        # (reference: c_predict_api.cc:153-170)
+        arg_params, aux_params = {}, {}
+        if param_bytes:
+            loaded = mx.nd.load_bytes(param_bytes)
+            if not isinstance(loaded, dict):
+                raise ValueError(
+                    "param file must be a named dict (arg:/aux: keys), "
+                    "got a positional list")
+            for k, v in loaded.items():
+                if k.startswith("arg:"):
+                    arg_params[k[4:]] = v
+                elif k.startswith("aux:"):
+                    aux_params[k[4:]] = v
+                else:
+                    arg_params[k] = v
+        ctx = mx.Context("tpu" if dev_type == 2 else "cpu", dev_id)
+        self._ctx = ctx
+        shapes = dict(zip(input_keys, [tuple(s) for s in input_shapes]))
+        arg_shapes, out_shapes, aux_shapes = sym.infer_shape(**shapes)
+        self._out_shapes = [tuple(s) for s in out_shapes]
+        self._inputs = {}
+        args = {}
+        for name, shp in zip(sym.list_arguments(), arg_shapes):
+            if name in shapes:
+                arr = mx.nd.zeros(shapes[name], ctx=ctx)
+                self._inputs[name] = arr
+                args[name] = arr
+            elif name in arg_params:
+                args[name] = arg_params[name].copyto(ctx)
+            else:
+                args[name] = mx.nd.zeros(shp, ctx=ctx)
+        aux = {}
+        for name, shp in zip(sym.list_auxiliary_states(), aux_shapes):
+            if name in aux_params:
+                aux[name] = aux_params[name].copyto(ctx)
+            else:
+                aux[name] = mx.nd.zeros(shp, ctx=ctx)
+        self._exec = sym.bind(ctx, args, aux_states=aux, grad_req="null")
+        self._outputs = []
+
+    def set_input(self, key, data_bytes, shape):
+        import mxnet_tpu as mx
+        arr = np.frombuffer(data_bytes, np.float32).reshape(shape)
+        self._inputs[key][:] = mx.nd.array(arr, ctx=self._ctx)
+
+    def set_input_flat(self, key, data_bytes):
+        """MXPredSetInput: flat float32 buffer, reshaped to the bound
+        input's shape (reference: c_predict_api.cc:287 MXPredSetInput)."""
+        self.set_input(key, data_bytes, tuple(self._inputs[key].shape))
+
+    def forward(self):
+        self._outputs = self._exec.forward(is_train=False)
+
+    def num_outputs(self):
+        return len(self._exec.outputs)
+
+    def get_output_shape(self, index):
+        if self._outputs:
+            return tuple(self._outputs[index].shape)
+        return self._out_shapes[index]
+
+    def get_output(self, index):
+        out = self._outputs[index].asnumpy().astype(np.float32)
+        return out.tobytes()
+
+
+def create(symbol_json, param_bytes, dev_type, dev_id, input_keys,
+           input_shapes):
+    return Predictor(symbol_json, param_bytes, dev_type, dev_id,
+                     list(input_keys), list(input_shapes))
+
+
+def ndlist_load(param_bytes):
+    """MXNDListCreate: load an ndarray dict file -> [(name, shape, bytes)].
+
+    Reference: c_predict_api.cc:404 MXNDListCreate."""
+    import mxnet_tpu as mx
+    loaded = mx.nd.load_bytes(param_bytes)
+    if isinstance(loaded, dict):
+        items = loaded.items()
+    else:
+        # unnamed list files get empty keys, like the reference
+        items = (("", v) for v in loaded)
+    out = []
+    for k, v in items:
+        a = v.asnumpy().astype(np.float32)
+        out.append((k, tuple(a.shape), a.tobytes()))
+    return out

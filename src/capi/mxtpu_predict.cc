@@ -1,0 +1,299 @@
+// C predict ABI for the TPU-native framework.
+//
+// Mirrors the reference's standalone inference surface
+// (include/mxnet/c_predict_api.h:78-207: MXPredCreate / MXPredSetInput /
+// MXPredForward / MXPredGetOutputShape / MXPredGetOutput / MXPredFree,
+// MXNDListCreate / MXNDListGet / MXNDListFree, MXGetLastError).
+//
+// Architecture: the reference links the whole engine+executor into
+// libmxnet.so and walks it from C (src/c_api/c_predict_api.cc).  Here the
+// compute path is XLA, reached through the Python runtime, so this library
+// embeds CPython and forwards each ABI call to
+// mxnet_tpu/capi_bridge.py; only raw float buffers, shapes, and error
+// strings cross the C boundary.  Consumers need no Python headers —
+// the ABI below is plain C, loadable via dlopen/ctypes/FFI from any
+// language, which is what the reference's L10 bindings (SURVEY §2.6)
+// actually require of L8.
+//
+// Thread-safety: every entry point acquires the GIL (the embedded
+// interpreter may be shared with a host application's Python).
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#define MXTPU_API extern "C" __attribute__((visibility("default")))
+
+typedef void* PredictorHandle;
+typedef void* NDListHandle;
+typedef uint32_t mx_uint;
+typedef float mx_float;
+
+namespace {
+
+thread_local std::string g_last_error;
+
+struct Predictor {
+  PyObject* obj;  // capi_bridge.Predictor
+  // cached output buffer + shape so pointers stay valid until next call
+  std::string out_bytes;
+  std::vector<mx_uint> out_shape;
+};
+
+struct NDList {
+  PyObject* list;  // [(name, shape_tuple, bytes)]
+  std::string cur_name;
+  std::vector<mx_uint> cur_shape;
+  std::string cur_bytes;
+};
+
+// Bring up the interpreter once (for pure-C hosts that never initialized
+// Python themselves); must run before any PyGILState_Ensure.
+void EnsureInterpreter() {
+  static std::once_flag once;
+  std::call_once(once, []() {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+#if PY_VERSION_HEX < 0x03090000
+      PyEval_InitThreads();
+#endif
+      // release the GIL taken by Py_Initialize so GILGuard can take it
+      PyEval_SaveThread();
+    }
+  });
+}
+
+class GILGuard {
+ public:
+  GILGuard() {
+    EnsureInterpreter();
+    state_ = PyGILState_Ensure();
+  }
+  ~GILGuard() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+void SetErrorFromPython() {
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  g_last_error = "unknown python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c) g_last_error = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+// Import the bridge module (caller holds the GIL via GILGuard).
+PyObject* GetBridge() {
+  PyObject* mod = PyImport_ImportModule("mxnet_tpu.capi_bridge");
+  return mod;  // may be nullptr with python error set
+}
+
+}  // namespace
+
+MXTPU_API const char* MXGetLastError() { return g_last_error.c_str(); }
+
+MXTPU_API int MXPredCreate(const char* symbol_json_str,
+                           const void* param_bytes, int param_size,
+                           int dev_type, int dev_id,
+                           mx_uint num_input_nodes,
+                           const char** input_keys,
+                           const mx_uint* input_shape_indptr,
+                           const mx_uint* input_shape_data,
+                           PredictorHandle* out) {
+  GILGuard gil;
+  PyObject* bridge = GetBridge();
+  if (!bridge) {
+    SetErrorFromPython();
+    return -1;
+  }
+  PyObject* keys = PyList_New(num_input_nodes);
+  PyObject* shapes = PyList_New(num_input_nodes);
+  for (mx_uint i = 0; i < num_input_nodes; ++i) {
+    PyList_SetItem(keys, i, PyUnicode_FromString(input_keys[i]));
+    mx_uint lo = input_shape_indptr[i], hi = input_shape_indptr[i + 1];
+    PyObject* shp = PyTuple_New(hi - lo);
+    for (mx_uint j = lo; j < hi; ++j)
+      PyTuple_SetItem(shp, j - lo, PyLong_FromUnsignedLong(
+          input_shape_data[j]));
+    PyList_SetItem(shapes, i, shp);
+  }
+  PyObject* params = PyBytes_FromStringAndSize(
+      static_cast<const char*>(param_bytes), param_size);
+  PyObject* pred = PyObject_CallMethod(
+      bridge, "create", "sOiiOO", symbol_json_str, params, dev_type,
+      dev_id, keys, shapes);
+  Py_DECREF(params);
+  Py_DECREF(keys);
+  Py_DECREF(shapes);
+  Py_DECREF(bridge);
+  if (!pred) {
+    SetErrorFromPython();
+    return -1;
+  }
+  Predictor* h = new Predictor();
+  h->obj = pred;
+  *out = h;
+  return 0;
+}
+
+MXTPU_API int MXPredSetInput(PredictorHandle handle, const char* key,
+                             const mx_float* data, mx_uint size) {
+  GILGuard gil;
+  Predictor* h = static_cast<Predictor*>(handle);
+  PyObject* bytes = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(data), size * sizeof(mx_float));
+  // shape: flat (the bridge reshapes to the bound input's shape)
+  PyObject* r = PyObject_CallMethod(h->obj, "set_input_flat", "sO", key,
+                                    bytes);
+  Py_DECREF(bytes);
+  if (!r) {
+    SetErrorFromPython();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXPredForward(PredictorHandle handle) {
+  GILGuard gil;
+  Predictor* h = static_cast<Predictor*>(handle);
+  PyObject* r = PyObject_CallMethod(h->obj, "forward", nullptr);
+  if (!r) {
+    SetErrorFromPython();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                                   mx_uint** shape_data,
+                                   mx_uint* shape_ndim) {
+  GILGuard gil;
+  Predictor* h = static_cast<Predictor*>(handle);
+  PyObject* shp = PyObject_CallMethod(h->obj, "get_output_shape", "I",
+                                      index);
+  if (!shp) {
+    SetErrorFromPython();
+    return -1;
+  }
+  Py_ssize_t n = PyTuple_Size(shp);
+  h->out_shape.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    h->out_shape[i] = static_cast<mx_uint>(
+        PyLong_AsUnsignedLong(PyTuple_GetItem(shp, i)));
+  Py_DECREF(shp);
+  *shape_data = h->out_shape.data();
+  *shape_ndim = static_cast<mx_uint>(n);
+  return 0;
+}
+
+MXTPU_API int MXPredGetOutput(PredictorHandle handle, mx_uint index,
+                              mx_float* data, mx_uint size) {
+  GILGuard gil;
+  Predictor* h = static_cast<Predictor*>(handle);
+  PyObject* bytes = PyObject_CallMethod(h->obj, "get_output", "I", index);
+  if (!bytes) {
+    SetErrorFromPython();
+    return -1;
+  }
+  char* buf;
+  Py_ssize_t len;
+  if (PyBytes_AsStringAndSize(bytes, &buf, &len) != 0) {
+    Py_DECREF(bytes);
+    SetErrorFromPython();
+    return -1;
+  }
+  if (static_cast<size_t>(len) != size * sizeof(mx_float)) {
+    Py_DECREF(bytes);
+    g_last_error = "MXPredGetOutput: size mismatch";
+    return -1;
+  }
+  std::memcpy(data, buf, len);
+  Py_DECREF(bytes);
+  return 0;
+}
+
+MXTPU_API int MXPredFree(PredictorHandle handle) {
+  GILGuard gil;
+  Predictor* h = static_cast<Predictor*>(handle);
+  Py_XDECREF(h->obj);
+  delete h;
+  return 0;
+}
+
+MXTPU_API int MXNDListCreate(const char* nd_file_bytes, int nd_file_size,
+                             NDListHandle* out, mx_uint* out_length) {
+  GILGuard gil;
+  PyObject* bridge = GetBridge();
+  if (!bridge) {
+    SetErrorFromPython();
+    return -1;
+  }
+  PyObject* raw = PyBytes_FromStringAndSize(nd_file_bytes, nd_file_size);
+  PyObject* list = PyObject_CallMethod(bridge, "ndlist_load", "O", raw);
+  Py_DECREF(raw);
+  Py_DECREF(bridge);
+  if (!list) {
+    SetErrorFromPython();
+    return -1;
+  }
+  NDList* h = new NDList();
+  h->list = list;
+  *out = h;
+  *out_length = static_cast<mx_uint>(PyList_Size(list));
+  return 0;
+}
+
+MXTPU_API int MXNDListGet(NDListHandle handle, mx_uint index,
+                          const char** out_key, const mx_float** out_data,
+                          const mx_uint** out_shape, mx_uint* out_ndim) {
+  GILGuard gil;
+  NDList* h = static_cast<NDList*>(handle);
+  PyObject* item = PyList_GetItem(h->list, index);  // borrowed
+  if (!item) {
+    SetErrorFromPython();
+    return -1;
+  }
+  PyObject* name = PyTuple_GetItem(item, 0);
+  PyObject* shape = PyTuple_GetItem(item, 1);
+  PyObject* bytes = PyTuple_GetItem(item, 2);
+  h->cur_name = PyUnicode_AsUTF8(name);
+  Py_ssize_t n = PyTuple_Size(shape);
+  h->cur_shape.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    h->cur_shape[i] = static_cast<mx_uint>(
+        PyLong_AsUnsignedLong(PyTuple_GetItem(shape, i)));
+  char* buf;
+  Py_ssize_t len;
+  PyBytes_AsStringAndSize(bytes, &buf, &len);
+  h->cur_bytes.assign(buf, len);
+  *out_key = h->cur_name.c_str();
+  *out_data = reinterpret_cast<const mx_float*>(h->cur_bytes.data());
+  *out_shape = h->cur_shape.data();
+  *out_ndim = static_cast<mx_uint>(n);
+  return 0;
+}
+
+MXTPU_API int MXNDListFree(NDListHandle handle) {
+  GILGuard gil;
+  NDList* h = static_cast<NDList*>(handle);
+  Py_XDECREF(h->list);
+  delete h;
+  return 0;
+}

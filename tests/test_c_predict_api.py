@@ -1,0 +1,146 @@
+"""C predict ABI tests (L8): build libmxtpu_predict.so, load it from a
+fresh process via ctypes, run a LeNet-style forward, compare to the
+Python-side executor (reference surface: include/mxnet/c_predict_api.h)."""
+
+import ctypes
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB = os.path.join(REPO, "build", "libmxtpu_predict.so")
+
+
+def _build_lib():
+    if not os.path.exists(LIB):
+        subprocess.run(["make", "-C", os.path.join(REPO, "src", "capi")],
+                       check=True, capture_output=True)
+    return LIB
+
+
+# The embedded interpreter must not collide with this pytest process's
+# interpreter state, so the ABI is driven from a fresh subprocess — the
+# same way a C consumer would use it.
+_DRIVER = textwrap.dedent("""
+    import ctypes, json, os, sys
+    import numpy as np
+
+    lib = ctypes.CDLL(sys.argv[1])
+    lib.MXGetLastError.restype = ctypes.c_char_p
+
+    model_dir = sys.argv[2]
+    sym_json = open(os.path.join(model_dir, "net-symbol.json")).read()
+    params = open(os.path.join(model_dir, "net-0000.params"), "rb").read()
+
+    keys = (ctypes.c_char_p * 1)(b"data")
+    indptr = (ctypes.c_uint * 2)(0, 4)
+    shape = (ctypes.c_uint * 4)(2, 1, 8, 8)
+    handle = ctypes.c_void_p()
+    rc = lib.MXPredCreate(sym_json.encode(), params, len(params),
+                          1, 0, 1, keys, indptr, shape,
+                          ctypes.byref(handle))
+    assert rc == 0, lib.MXGetLastError()
+
+    x = np.load(os.path.join(model_dir, "x.npy"))
+    buf = x.astype(np.float32).ravel()
+    rc = lib.MXPredSetInput(handle, b"data",
+                            buf.ctypes.data_as(
+                                ctypes.POINTER(ctypes.c_float)),
+                            buf.size)
+    assert rc == 0, lib.MXGetLastError()
+    rc = lib.MXPredForward(handle)
+    assert rc == 0, lib.MXGetLastError()
+
+    sdata = ctypes.POINTER(ctypes.c_uint)()
+    ndim = ctypes.c_uint()
+    rc = lib.MXPredGetOutputShape(handle, 0, ctypes.byref(sdata),
+                                  ctypes.byref(ndim))
+    assert rc == 0, lib.MXGetLastError()
+    oshape = [sdata[i] for i in range(ndim.value)]
+    n = int(np.prod(oshape))
+    out = np.zeros(n, np.float32)
+    rc = lib.MXPredGetOutput(handle, 0,
+                             out.ctypes.data_as(
+                                 ctypes.POINTER(ctypes.c_float)), n)
+    assert rc == 0, lib.MXGetLastError()
+    lib.MXPredFree(handle)
+
+    # NDList surface
+    nd_handle = ctypes.c_void_p()
+    length = ctypes.c_uint()
+    rc = lib.MXNDListCreate(params, len(params), ctypes.byref(nd_handle),
+                            ctypes.byref(length))
+    assert rc == 0, lib.MXGetLastError()
+    key = ctypes.c_char_p()
+    data = ctypes.POINTER(ctypes.c_float)()
+    shp = ctypes.POINTER(ctypes.c_uint)()
+    nd = ctypes.c_uint()
+    rc = lib.MXNDListGet(nd_handle, 0, ctypes.byref(key),
+                         ctypes.byref(data), ctypes.byref(shp),
+                         ctypes.byref(nd))
+    assert rc == 0, lib.MXGetLastError()
+    assert length.value > 0 and key.value
+    lib.MXNDListFree(nd_handle)
+
+    json.dump({"shape": oshape, "out": out.tolist()},
+              open(os.path.join(model_dir, "c_out.json"), "w"))
+    print("C-ABI-OK")
+""")
+
+
+@pytest.mark.skipif(not os.path.exists("/usr/bin/g++") and
+                    not os.path.exists("/usr/local/bin/g++"),
+                    reason="no C++ toolchain")
+def test_c_predict_roundtrip(tmp_path):
+    import mxnet_tpu as mx
+    from mxnet_tpu import symbol as sym
+
+    _build_lib()
+
+    # small conv net, checkpointed in the reference format
+    data = sym.var("data")
+    net = sym.Convolution(data, num_filter=4, kernel=(3, 3), name="conv")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.Flatten(net)
+    net = sym.FullyConnected(net, num_hidden=5, name="fc")
+    net = sym.softmax(net)
+
+    x = np.random.RandomState(0).randn(2, 1, 8, 8).astype(np.float32)
+    arg_shapes, _, _ = net.infer_shape(data=(2, 1, 8, 8))
+    rs = np.random.RandomState(1)
+    args = {"data": mx.nd.array(x)}
+    for name, shp in zip(net.list_arguments(), arg_shapes):
+        if name != "data":
+            args[name] = mx.nd.array(rs.randn(*shp).astype(np.float32) * .1)
+    ex = net.bind(mx.cpu(), args)
+    expect = ex.forward()[0].asnumpy()
+
+    model_dir = str(tmp_path)
+    with open(os.path.join(model_dir, "net-symbol.json"), "w") as f:
+        f.write(net.tojson())
+    save_dict = {"arg:%s" % k: v for k, v in args.items() if k != "data"}
+    mx.nd.save(os.path.join(model_dir, "net-0000.params"), save_dict)
+    np.save(os.path.join(model_dir, "x.npy"), x)
+
+    driver = os.path.join(model_dir, "driver.py")
+    with open(driver, "w") as f:
+        f.write(_DRIVER)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run([sys.executable, driver, LIB, model_dir],
+                          env=env, capture_output=True, text=True,
+                          timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "C-ABI-OK" in proc.stdout
+
+    import json
+    got = json.load(open(os.path.join(model_dir, "c_out.json")))
+    assert tuple(got["shape"]) == expect.shape
+    np.testing.assert_allclose(
+        np.array(got["out"], np.float32).reshape(expect.shape), expect,
+        rtol=1e-5, atol=1e-5)
