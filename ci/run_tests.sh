@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# CI entry point (reference: ci/docker/runtime_functions.sh sanity + unit
+# test functions).  Runs the full suite on the virtual 8-device CPU mesh,
+# byte-compiles the package as a lint floor, and builds the C predict ABI.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== sanity: byte-compile =="
+python -m compileall -q mxnet_tpu tools examples
+
+echo "== native: C predict ABI =="
+if command -v g++ >/dev/null; then
+    make -C src/capi
+else
+    echo "g++ not found — skipping native build"
+fi
+
+echo "== unit tests (virtual 8-device CPU mesh) =="
+python -m pytest tests/ -q "$@"

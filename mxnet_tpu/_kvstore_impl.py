@@ -249,6 +249,8 @@ _MSG_CMD = 4
 _MSG_STOP = 5
 _MSG_SET_OPT = 6
 _MSG_ROWPULL = 7
+_MSG_HEARTBEAT = 8
+_MSG_DEADQUERY = 9
 
 
 def _send_msg(sock, obj):
@@ -284,7 +286,15 @@ class KVStoreServer:
         self.pending = {}       # key -> [accum numpy, count]
         self._str_idx = {}      # deterministic string-key -> int index
         self.updater = None
-        self.barrier_count = 0
+        # barrier round-tracking by (round, worker rank) — robust to
+        # overlapping rounds under worker skew, unlike a modulo counter
+        self.barrier_rounds = {}   # round -> set of ranks arrived
+        self.barrier_done = set()  # completed rounds (pruned)
+        # heartbeat-based failure detection (reference: ps-lite
+        # Postoffice::GetDeadNodes, kvstore_dist.h:119-128)
+        self.heartbeats = {}       # node id -> last heartbeat walltime
+        self.sync_timeout = float(os.environ.get(
+            "MXNET_KVSTORE_SYNC_TIMEOUT", "120"))
         self.cv = threading.Condition()
         self.lock = threading.RLock()
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -403,11 +413,25 @@ class KVStoreServer:
                     rows[~valid] = 0
                     _send_msg(conn, ("ok", rows))
                 elif kind == _MSG_BARRIER:
+                    rank = msg[1] if len(msg) > 1 else 0
+                    rnd = msg[2] if len(msg) > 2 else 0
                     try:
-                        self._barrier()
+                        self._barrier(rank, rnd)
                         _send_msg(conn, ("ok",))
                     except MXNetError as e:
                         _send_msg(conn, ("err", str(e)))
+                elif kind == _MSG_HEARTBEAT:
+                    _, node_id = msg
+                    with self.lock:
+                        self.heartbeats[node_id] = time.time()
+                    _send_msg(conn, ("ok",))
+                elif kind == _MSG_DEADQUERY:
+                    _, timeout_s = msg
+                    now = time.time()
+                    with self.lock:
+                        dead = [n for n, ts in self.heartbeats.items()
+                                if now - ts > timeout_s]
+                    _send_msg(conn, ("ok", dead))
                 elif kind == _MSG_SET_OPT:
                     _, blob = msg
                     optimizer = pickle.loads(blob)
@@ -442,7 +466,7 @@ class KVStoreServer:
                 self._apply(key, acc)
                 self.cv.notify_all()
                 return
-            deadline = time.time() + 120
+            deadline = time.time() + self.sync_timeout
             while key in self.pending and time.time() < deadline:
                 self.cv.wait(timeout=0.1)
             if key in self.pending:
@@ -455,31 +479,47 @@ class KVStoreServer:
                     "%d workers (got %d) — worker desync or crash"
                     % (key, self.num_workers, got))
 
-    def _barrier(self):
+    def _barrier(self, rank, rnd):
+        """Round-aware barrier: each worker reports (rank, its own round
+        number); a round completes when every rank has arrived.  Immune
+        to overlapping rounds under skew (a fast worker in round r+1
+        cannot be miscounted into round r)."""
         with self.cv:
-            self.barrier_count += 1
-            if self.barrier_count % self.num_workers == 0:
+            if rnd in self.barrier_done:
+                return
+            arrived = self.barrier_rounds.setdefault(rnd, set())
+            arrived.add(rank)
+            if len(arrived) >= self.num_workers:
+                self.barrier_done.add(rnd)
+                del self.barrier_rounds[rnd]
+                # prune: done rounds older than any pending round
+                if len(self.barrier_done) > 1024:
+                    keep = max(self.barrier_done)
+                    self.barrier_done = {r for r in self.barrier_done
+                                         if r > keep - 1024}
                 self.cv.notify_all()
                 return
-            current_round = (self.barrier_count - 1) // self.num_workers
-            deadline = time.time() + 120
-            while (self.barrier_count - 1) // self.num_workers == \
-                    current_round and \
-                    self.barrier_count % self.num_workers != 0 and \
-                    time.time() < deadline:
+            deadline = time.time() + self.sync_timeout
+            while rnd not in self.barrier_done and time.time() < deadline:
                 self.cv.wait(timeout=0.1)
-            if (self.barrier_count - 1) // self.num_workers == \
-                    current_round and \
-                    self.barrier_count % self.num_workers != 0:
+            if rnd not in self.barrier_done:
+                got = len(self.barrier_rounds.get(rnd, ()))
                 raise MXNetError(
-                    "kvstore barrier timed out: %d/%d workers arrived"
-                    % (self.barrier_count % self.num_workers,
-                       self.num_workers))
+                    "kvstore barrier timed out: %d/%d workers arrived "
+                    "for round %d" % (got, self.num_workers, rnd))
 
 
 class KVStoreDist(KVStoreBase):
-    """Worker side (reference: kvstore_dist.h:44 — ZPush/ZPull with key
-    caching; multi-server key sharding is future work)."""
+    """Worker side (reference: kvstore_dist.h:44 — ZPush/ZPull).
+
+    Keys are sharded across ``DMLC_NUM_SERVER`` servers by stable hash,
+    and arrays larger than ``MXNET_KVSTORE_BIGARRAY_BOUND`` elements are
+    split into per-server chunks (reference: PSKV key/len caching,
+    kvstore_dist.h:161-169 and the big-array sharding at :58).  A
+    daemon heartbeat thread feeds server-side failure detection
+    (num_dead_node); a restarted worker with the same rank reconnects
+    statelessly (async-mode rejoin, reference is_recovery
+    kvstore_dist.h:52)."""
 
     def __init__(self, name="dist_sync"):
         super().__init__()
@@ -489,21 +529,72 @@ class KVStoreDist(KVStoreBase):
         self._rank = int(os.environ.get("DMLC_WORKER_RANK",
                                         os.environ.get("DMLC_RANK", "0")))
         self._num_workers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
-        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._num_servers = int(os.environ.get("DMLC_NUM_SERVER", "1"))
+        self._big_bound = int(os.environ.get(
+            "MXNET_KVSTORE_BIGARRAY_BOUND", "1000000"))
+        # server s listens on root port + s (tools/launch.py convention)
+        self._socks = []
+        self._locks = []
         deadline = time.time() + 30
-        while True:
-            try:
-                self.sock.connect((host, port))
-                break
-            except (ConnectionRefusedError, OSError):
-                if time.time() > deadline:
-                    raise
-                time.sleep(0.1)
-        self._lock = threading.Lock()
+        for s in range(self._num_servers):
+            sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            while True:
+                try:
+                    sock.connect((host, port + s))
+                    break
+                except (ConnectionRefusedError, OSError):
+                    if time.time() > deadline:
+                        raise
+                    time.sleep(0.1)
+            self._socks.append(sock)
+            self._locks.append(threading.Lock())
         self._residual = {}
-        # declare the consistency mode to the server (every worker sends
-        # the same value; the server applies it idempotently)
-        self._rpc((_MSG_CMD, "mode", name))
+        self._sharded_keys = set()
+        self._barrier_round = 0
+        # declare the consistency mode to every server (idempotent)
+        for s in range(self._num_servers):
+            self._rpc((_MSG_CMD, "mode", name), server=s)
+        self._start_heartbeat()
+
+    def _start_heartbeat(self):
+        interval = float(os.environ.get(
+            "MXNET_KVSTORE_HEARTBEAT_INTERVAL", "1.0"))
+        node = "worker%d" % self._rank
+        # dedicated sockets: heartbeats must not contend with bulk RPCs
+        host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+        port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+
+        def beat():
+            socks = {}
+            while not getattr(self, "_closed", False):
+                for s in range(self._num_servers):
+                    try:
+                        if s not in socks:
+                            hs = socket.socket(socket.AF_INET,
+                                               socket.SOCK_STREAM)
+                            hs.settimeout(5)
+                            hs.connect((host, port + s))
+                            socks[s] = hs
+                        _send_msg(socks[s], (_MSG_HEARTBEAT, node))
+                        _recv_msg(socks[s])
+                    except (ConnectionError, OSError):
+                        socks.pop(s, None)
+                time.sleep(interval)
+
+        self._hb_thread = threading.Thread(target=beat, daemon=True)
+        self._hb_thread.start()
+
+    def _server_for_key(self, k):
+        import zlib
+        return zlib.crc32(str(k).encode()) % self._num_servers
+
+    def num_dead_node(self, node_id="all", timeout=60):
+        """Count nodes whose heartbeat is older than *timeout* seconds
+        (reference: kvstore_dist.h:119-128 get_num_dead_node)."""
+        dead = self._rpc((_MSG_DEADQUERY, timeout), server=0)[1]
+        if node_id == "all":
+            return len(dead)
+        return int(("worker%d" % node_id) in dead)
 
     @property
     def type(self):
@@ -517,19 +608,42 @@ class KVStoreDist(KVStoreBase):
     def num_workers(self):
         return self._num_workers
 
-    def _rpc(self, msg):
-        with self._lock:
-            _send_msg(self.sock, msg)
-            reply = _recv_msg(self.sock)
+    def _rpc(self, msg, server=None, key=None):
+        s = (server if server is not None
+             else self._server_for_key(key) if key is not None else 0)
+        with self._locks[s]:
+            _send_msg(self._socks[s], msg)
+            reply = _recv_msg(self._socks[s])
         if reply and reply[0] == "err":
             raise MXNetError("kvstore server error: %s" % reply[1])
         return reply
 
+    def _shard_splits(self, n):
+        """Contiguous per-server chunk lengths for a flat size-n array."""
+        base, rem = divmod(n, self._num_servers)
+        return [base + (1 if i < rem else 0)
+                for i in range(self._num_servers)]
+
     def init(self, key, value):
         keys, values = _key_list(key, value)
         for k, vs in zip(keys, values):
+            arr = vs[0].asnumpy()
+            # the sharding decision is taken ONCE at init and recorded:
+            # later compression toggles must not change a key's layout
+            # (every worker runs init, so every worker records it)
+            if (self._num_servers > 1 and arr.size > self._big_bound
+                    and not self._compression):
+                self._sharded_keys.add(k)
             if self._rank == 0:
-                self._rpc((_MSG_INIT, k, vs[0].asnumpy()))
+                if k in self._sharded_keys:
+                    flat = arr.ravel()
+                    off = 0
+                    for s, ln in enumerate(self._shard_splits(arr.size)):
+                        self._rpc((_MSG_INIT, "%s#shard%d" % (k, s),
+                                   flat[off:off + ln]), server=s)
+                        off += ln
+                else:
+                    self._rpc((_MSG_INIT, k, arr), key=k)
         self.barrier()
 
     def push(self, key, value, priority=0):
@@ -547,11 +661,22 @@ class KVStoreDist(KVStoreBase):
                         "shape": tuple(int(s) for s in total.shape)}
                 arr = (_np.asarray(total._aux[0]),
                        _np.asarray(total._data))
-                self._rpc((_MSG_PUSH, k, arr, meta))
+                self._rpc((_MSG_PUSH, k, arr, meta), key=k)
                 continue
             if isinstance(total, _sp.BaseSparseNDArray):
                 total = total.todense()
             arr = total.asnumpy()
+            if k in self._sharded_keys:
+                # big-array sharding: contiguous chunks across servers
+                # travel in parallel rings (reference: kvstore_dist.h:58
+                # MXNET_KVSTORE_BIGARRAY_BOUND)
+                flat = arr.ravel()
+                off = 0
+                for s, ln in enumerate(self._shard_splits(arr.size)):
+                    self._rpc((_MSG_PUSH, "%s#shard%d" % (k, s),
+                               flat[off:off + ln], None), server=s)
+                    off += ln
+                continue
             meta = None
             if self._compression and \
                     self._compression.get("type") == "2bit":
@@ -567,13 +692,26 @@ class KVStoreDist(KVStoreBase):
                 meta = {"compressed": True, "threshold": threshold,
                         "n": n_, "shape": arr.shape}
                 arr = packed
-            self._rpc((_MSG_PUSH, k, arr, meta))
+            self._rpc((_MSG_PUSH, k, arr, meta), key=k)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys, outs = _key_list(key, out)
         for k, os_ in zip(keys, outs):
-            status = self._rpc((_MSG_PULL, k))
-            arr = nd.array(status[1])
+            shape = tuple(int(s) for s in os_[0].shape)
+            size = 1
+            for s in shape:
+                size *= s
+            if k in self._sharded_keys:
+                # reassemble the per-server chunks (same split rule as
+                # init/push)
+                parts = []
+                for s, _ln in enumerate(self._shard_splits(size)):
+                    parts.append(self._rpc(
+                        (_MSG_PULL, "%s#shard%d" % (k, s)), server=s)[1])
+                arr = nd.array(_np.concatenate(
+                    [p.ravel() for p in parts]).reshape(shape))
+            else:
+                arr = nd.array(self._rpc((_MSG_PULL, k), key=k)[1])
             for o in os_:
                 arr.copyto(o)
 
@@ -592,7 +730,7 @@ class KVStoreDist(KVStoreBase):
                 if cache_key not in fetched:
                     # server-side retain: only requested rows come back
                     fetched[cache_key] = self._rpc(
-                        (_MSG_ROWPULL, k, rid_np))[1]
+                        (_MSG_ROWPULL, k, rid_np), key=k)[1]
                 vals = fetched[cache_key]
                 if isinstance(o, _sp.RowSparseNDArray):
                     o._data = _jnp.asarray(vals)
@@ -609,23 +747,32 @@ class KVStoreDist(KVStoreBase):
                     o._stype = "row_sparse"
 
     def set_optimizer(self, optimizer):
-        """Ship the optimizer to the server (reference: kvstore.py
+        """Ship the optimizer to every server (reference: kvstore.py
         set_optimizer:450 pickles the optimizer to servers)."""
         if self._rank == 0:
-            self._rpc((_MSG_SET_OPT, pickle.dumps(optimizer)))
+            blob = pickle.dumps(optimizer)
+            for s in range(self._num_servers):
+                self._rpc((_MSG_SET_OPT, blob), server=s)
         self.barrier()
 
     def barrier(self):
-        self._rpc((_MSG_BARRIER,))
+        # server 0 coordinates; the round number makes overlapping
+        # barriers under worker skew unambiguous
+        self._barrier_round += 1
+        self._rpc((_MSG_BARRIER, self._rank, self._barrier_round),
+                  server=0)
 
     def _send_command_to_servers(self, head, body):
-        self._rpc((_MSG_CMD, head, body))
+        for s in range(self._num_servers):
+            self._rpc((_MSG_CMD, head, body), server=s)
 
     def stop_server(self):
-        try:
-            self._rpc((_MSG_STOP,))
-        except ConnectionError:
-            pass
+        self._closed = True
+        for s in range(self._num_servers):
+            try:
+                self._rpc((_MSG_STOP,), server=s)
+            except ConnectionError:
+                pass
 
 
 def create(name="local"):

@@ -23,12 +23,16 @@ def run_server(kv_type="dist_sync", host=None, port=None, num_workers=None):
     except Exception:
         pass
     sync = "async" not in kv_type
+    # server s of a multi-server group listens at root port + s
+    # (tools/launch.py sets DMLC_SERVER_ID; key sharding lives worker-side)
+    server_id = int(os.environ.get("DMLC_SERVER_ID", "0"))
     server = KVStoreServer(
         sync_mode=sync,
         num_workers=num_workers or
         int(os.environ.get("DMLC_NUM_WORKER", "1")),
         host=host or os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1"),
-        port=port or int(os.environ.get("DMLC_PS_ROOT_PORT", "9091")))
+        port=port if port is not None else
+        int(os.environ.get("DMLC_PS_ROOT_PORT", "9091")) + server_id)
     server.run()
     return server
 

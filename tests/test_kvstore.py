@@ -282,3 +282,167 @@ def test_collectives_on_mesh():
     # on values, but now replicated); all_gather roundtrip:
     gathered = collectives.allgather(xs, mesh, "dp")
     np.testing.assert_allclose(np.asarray(gathered), np.asarray(x))
+
+
+# --- dist robustness: multi-server sharding, dead-node detection, rejoin
+# (reference: PSKV kvstore_dist.h:161-169, GetDeadNodes :119-128,
+# is_recovery :52) --------------------------------------------------------
+
+_MULTISERVER_WORKER = r"""
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+rank = int(os.environ["DMLC_WORKER_RANK"])
+kv = mx.kv.create("dist_sync")
+big = nd.array(np.arange(20, dtype=np.float32).reshape(4, 5))
+kv.init("big", big)           # 20 elts > bound=10 -> sharded, 2 servers
+kv.init("small", nd.zeros((3,)))
+kv.push("big", nd.ones((4, 5)) * (rank + 1))
+kv.push("small", nd.ones((3,)) * (rank + 1))
+kv.barrier()
+out_b = nd.zeros((4, 5))
+out_s = nd.zeros((3,))
+kv.pull("big", out=out_b)
+kv.pull("small", out=out_s)
+print("RESULT", rank, (out_b.asnumpy().ravel().tolist(),
+                       out_s.asnumpy().tolist()), flush=True)
+kv.barrier()
+if rank == 0:
+    kv.stop_server()
+"""
+
+
+def test_dist_multi_server_sharding():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    port = 9163
+    n_workers, n_servers = 2, 2
+    env_common = dict(os.environ)
+    env_common.update({
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(port),
+        "DMLC_NUM_WORKER": str(n_workers),
+        "DMLC_NUM_SERVER": str(n_servers),
+        "MXNET_KVSTORE_BIGARRAY_BOUND": "10",
+        "JAX_PLATFORMS": "cpu",
+    })
+    servers = []
+    for sid in range(n_servers):
+        senv = dict(env_common, DMLC_ROLE="server",
+                    DMLC_SERVER_ID=str(sid))
+        servers.append(subprocess.Popen(
+            [sys.executable, "-c",
+             "import sys; sys.path.insert(0, %r);"
+             "from mxnet_tpu.kvstore_server import run_server;"
+             "run_server('dist_sync')" % repo],
+            env=senv, stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+    workers = []
+    for rank in range(n_workers):
+        wenv = dict(env_common, DMLC_ROLE="worker",
+                    DMLC_WORKER_RANK=str(rank))
+        workers.append(subprocess.Popen(
+            [sys.executable, "-c",
+             _MULTISERVER_WORKER.format(repo=repo)],
+            env=wenv, stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+    for w in workers:
+        stdout, stderr = w.communicate(timeout=120)
+        assert w.returncode == 0, stderr.decode()[-2000:]
+        line = [l for l in stdout.decode().splitlines()
+                if l.startswith("RESULT")][0]
+        parts = line.split(" ", 2)[2]
+        big_vals, small_vals = eval(parts)
+        # sync aggregate 1+2=3 on every element of both sharded and
+        # unsharded keys
+        np.testing.assert_allclose(big_vals, [3.0] * 20)
+        np.testing.assert_allclose(small_vals, [3.0] * 3)
+    for s in servers:
+        s.wait(timeout=30)
+
+
+_VICTIM_WORKER = r"""
+import os, sys, time
+sys.path.insert(0, {repo!r})
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+kv = mx.kv.create("dist_async")
+kv.push("w", nd.ones((4,)) * -1.0)   # server-side sgd lr=1: w += 1
+time.sleep(1.0)                      # heartbeats flow while alive
+# exit WITHOUT stop_server: simulates a crash (heartbeats cease)
+"""
+
+
+def test_dist_dead_node_detection_and_rejoin():
+    """Heartbeat failure detection + stateless async rejoin."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    port = 9165
+    env = dict(os.environ)
+    env.update({
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(port),
+        "DMLC_NUM_WORKER": "1",
+        "MXNET_KVSTORE_HEARTBEAT_INTERVAL": "0.2",
+        "JAX_PLATFORMS": "cpu",
+    })
+    server = subprocess.Popen(
+        [sys.executable, "-c",
+         "import sys; sys.path.insert(0, %r);"
+         "from mxnet_tpu.kvstore_server import run_server;"
+         "run_server('dist_async')" % repo],
+        env=dict(env, DMLC_ROLE="server"),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    old_env = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    kv = None
+    try:
+        import mxnet_tpu as mx
+        kv = mx.kv.create("dist_async")
+        kv.init("w", mx.nd.zeros((4,)))
+        kv.set_optimizer(mx.optimizer.create(
+            "sgd", learning_rate=1.0, rescale_grad=1.0, wd=0.0))
+
+        victim_env = dict(env, DMLC_ROLE="worker", DMLC_WORKER_RANK="1")
+        victim = subprocess.Popen(
+            [sys.executable, "-c", _VICTIM_WORKER.format(repo=repo)],
+            env=victim_env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE)
+        _, verr = victim.communicate(timeout=60)
+        assert victim.returncode == 0, verr.decode()[-2000:]
+        # victim registered (timeout=-1 counts every known node: us + it)
+        assert kv.num_dead_node(timeout=-1) >= 2
+        time.sleep(1.5)
+        # victim's heartbeats are stale; ours are fresh
+        assert kv.num_dead_node(timeout=1.0) >= 1
+        assert kv.num_dead_node(node_id=1, timeout=1.0) == 1
+
+        # rejoin: same rank reconnects statelessly and keeps training
+        rejoin = subprocess.Popen(
+            [sys.executable, "-c", _VICTIM_WORKER.format(repo=repo)],
+            env=victim_env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE)
+        _, rerr = rejoin.communicate(timeout=60)
+        assert rejoin.returncode == 0, rerr.decode()[-2000:]
+        out = mx.nd.zeros((4,))
+        kv.pull("w", out=out)
+        # two successful pushes of grad -1 through server sgd: w == 2
+        np.testing.assert_allclose(out.asnumpy(), [2.0] * 4)
+        # rejoined node heartbeats refreshed the same node id
+        assert kv.num_dead_node(node_id=1, timeout=1.0) == 0
+    finally:
+        if kv is not None:
+            kv.stop_server()
+        for k, v in old_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        server.wait(timeout=30)

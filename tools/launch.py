@@ -41,10 +41,10 @@ def main(argv=None):
     parser.add_argument("-n", "--num-workers", type=int, required=True,
                         help="number of worker processes")
     parser.add_argument("-s", "--num-servers", type=int, default=1,
-                        help="number of server processes (only 1 is "
-                             "supported: keys are not sharded across "
-                             "servers yet and all roles share one root "
-                             "port)")
+                        help="number of server processes; server i "
+                             "listens on root port + i and keys are "
+                             "sharded across servers by stable hash "
+                             "(reference: PSKV, kvstore_dist.h:161-169)")
     parser.add_argument("--launcher", default="local",
                         choices=["local"],
                         help="only the local (single-host multi-process) "
@@ -58,10 +58,8 @@ def main(argv=None):
     args = parser.parse_args(argv)
     if not args.command:
         parser.error("no command given")
-    if args.num_servers != 1:
-        parser.error("-s/--num-servers must be 1: multi-server key "
-                     "sharding is not implemented, and a second server "
-                     "on the same root port would die at bind")
+    if args.num_servers < 1:
+        parser.error("-s/--num-servers must be >= 1")
     command = args.command
     if command[0] == "--":
         command = command[1:]
