@@ -58,6 +58,8 @@ from . import rnn  # noqa: F401
 from . import contrib  # noqa: F401
 from . import parallel  # noqa: F401
 from . import recordio  # noqa: F401
+from . import visualization  # noqa: F401
+viz = visualization  # reference alias: mx.viz
 from .runtime import engine  # noqa: F401
 
 

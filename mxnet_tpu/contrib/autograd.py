@@ -1,0 +1,61 @@
+"""Legacy contrib autograd API (reference:
+python/mxnet/contrib/autograd.py — the pre-1.0 grad API kept for old
+scripts; thin aliases over mxnet_tpu.autograd)."""
+
+from __future__ import annotations
+
+import functools
+
+from .. import autograd as _ag
+
+__all__ = ["set_is_training", "train_section", "test_section",
+           "mark_variables", "backward", "compute_gradient", "grad",
+           "grad_and_loss"]
+
+
+def set_is_training(is_train):
+    prev = _ag.is_training()
+    _ag.set_training(is_train)
+    return prev
+
+
+train_section = _ag.record
+test_section = _ag.pause
+mark_variables = _ag.mark_variables
+backward = _ag.backward
+
+
+def compute_gradient(outputs):
+    """Deprecated alias: backward on head outputs, returning nothing
+    (gradients land in the marked variables)."""
+    _ag.backward(outputs)
+
+
+def grad_and_loss(func, argnum=None):
+    """Return a function computing both gradients and the loss
+    (reference: contrib/autograd.py grad_and_loss)."""
+    @functools.wraps(func)
+    def wrapped(*args):
+        variables = list(args)
+        if argnum is not None:
+            argnums = [argnum] if isinstance(argnum, int) else argnum
+            variables = [args[i] for i in argnums]
+        grads = [v.zeros_like() if hasattr(v, "zeros_like") else None
+                 for v in variables]
+        _ag.mark_variables(variables, grads)
+        with _ag.record():
+            outputs = func(*args)
+        _ag.backward([outputs] if not isinstance(outputs, list)
+                     else outputs)
+        return grads, outputs
+    return wrapped
+
+
+def grad(func, argnum=None):
+    """Return a function computing only gradients."""
+    fn = grad_and_loss(func, argnum)
+
+    @functools.wraps(func)
+    def wrapped(*args):
+        return fn(*args)[0]
+    return wrapped

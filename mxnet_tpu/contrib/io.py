@@ -1,0 +1,47 @@
+"""Contrib IO (reference: python/mxnet/contrib/io.py —
+DataLoaderIter: wrap a Gluon DataLoader as a DataIter for Module.fit)."""
+
+from __future__ import annotations
+
+from ..io.io import DataIter, DataBatch, DataDesc
+
+__all__ = ["DataLoaderIter"]
+
+
+class DataLoaderIter(DataIter):
+    """Present a gluon DataLoader as a Module-compatible DataIter."""
+
+    def __init__(self, loader, data_name="data", label_name="softmax_label"):
+        super().__init__()
+        self._loader = loader
+        self._iter = None
+        self._data_name = data_name
+        self._label_name = label_name
+        first = next(iter(loader))
+        data, label = self._split(first)
+        self.batch_size = data.shape[0]
+        self.provide_data = [DataDesc(data_name, data.shape, data.dtype)]
+        self.provide_label = (
+            [DataDesc(label_name, label.shape, label.dtype)]
+            if label is not None else [])
+        self.reset()
+
+    def _split(self, batch):
+        if isinstance(batch, (list, tuple)):
+            if len(batch) >= 2:
+                return batch[0], batch[1]
+            return batch[0], None
+        return batch, None
+
+    def reset(self):
+        self._iter = iter(self._loader)
+
+    def next(self):
+        try:
+            batch = next(self._iter)
+        except StopIteration:
+            raise StopIteration
+        data, label = self._split(batch)
+        return DataBatch(data=[data],
+                         label=[label] if label is not None else [],
+                         pad=0)

@@ -1,0 +1,131 @@
+"""SVRGModule — stochastic variance-reduced gradient training
+(reference: contrib/svrg_optimization/svrg_module.py:30 + the
+_SVRGOptimizer grad rewrite in svrg_optimizer.py).
+
+Every ``update_freq`` epochs the module snapshots the weights and
+computes the full-dataset gradient at the snapshot; each step then
+applies the variance-reduced gradient
+
+    g = g_i(w) - g_i(w_snap) + mu,     mu = full gradient at w_snap
+
+where g_i(w_snap) is recomputed on the current batch through an
+auxiliary module bound to the same symbol."""
+
+from __future__ import annotations
+
+from ... import ndarray as nd
+from ...module.module import Module
+
+__all__ = ["SVRGModule"]
+
+
+class SVRGModule(Module):
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), update_freq=2, **kwargs):
+        super().__init__(symbol, data_names=data_names,
+                         label_names=label_names, **kwargs)
+        self.update_freq = update_freq
+        self._mod_aux = Module(symbol, data_names=data_names,
+                               label_names=label_names, **kwargs)
+        self._param_dict = None   # mu: full grads at the snapshot
+        self._ctx_len = 1
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, **kwargs):
+        super().bind(data_shapes, label_shapes, for_training,
+                     inputs_need_grad, force_rebind, **kwargs)
+        self._mod_aux.bind(data_shapes, label_shapes, for_training,
+                           inputs_need_grad, force_rebind, **kwargs)
+
+    def init_params(self, initializer=None, arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
+        super().init_params(initializer, arg_params, aux_params,
+                            allow_missing, force_init, allow_extra)
+        arg, aux = self.get_params()
+        self._mod_aux.init_params(
+            initializer, arg_params={k: v.copy() for k, v in arg.items()},
+            aux_params={k: v.copy() for k, v in aux.items()},
+            allow_missing=False, force_init=True)
+
+    def update_full_grads(self, train_data):
+        """Snapshot current weights into the aux module and accumulate
+        the full-dataset gradient there (reference: svrg_module.py
+        update_full_grads)."""
+        arg, aux = self.get_params()
+        self._mod_aux.set_params({k: v.copy() for k, v in arg.items()},
+                                 {k: v.copy() for k, v in aux.items()})
+        group = self._mod_aux._exec_group
+        accum = {name: None for name in group.param_names
+                 if group.grad_req[name] != "null"}
+        nbatch = 0
+        train_data.reset()
+        for batch in train_data:
+            self._mod_aux.forward_backward(batch)
+            group.reduce_grads()
+            ex0 = group.execs[0]
+            for name in accum:
+                g = ex0.grad_dict[name].copy()
+                accum[name] = g if accum[name] is None else accum[name] + g
+            nbatch += 1
+        train_data.reset()
+        self._param_dict = {
+            name: (g / nbatch if g is not None else None)
+            for name, g in accum.items()}
+
+    def update(self):
+        """Apply the SVRG-adjusted gradient then the optimizer step."""
+        if self._param_dict is not None:
+            group = self._exec_group
+            aux_group = self._mod_aux._exec_group
+            n_exec = len(group.execs)
+            for ex, aux_ex in zip(group.execs, aux_group.execs):
+                for name, mu in self._param_dict.items():
+                    if mu is None:
+                        continue
+                    # g <- g - g_snap + mu  (variance reduction); execs
+                    # are summed downstream, so mu is spread across them
+                    ex.grad_dict[name][:] = (
+                        ex.grad_dict[name] - aux_ex.grad_dict[name]
+                        + mu / n_exec)
+        super().update()
+
+    def forward_backward(self, data_batch):
+        super().forward_backward(data_batch)
+        if self._param_dict is not None:
+            # batch gradient at the snapshot weights, same batch
+            self._mod_aux.forward_backward(data_batch)
+
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            validation_metric=None, initializer=None, arg_params=None,
+            aux_params=None, allow_missing=False, force_rebind=False,
+            force_init=False, epoch_end_callback=None, **kwargs):
+        """Module.fit with a full-gradient refresh before epoch 0 and
+        every update_freq epochs after (reference: svrg_module.py fit)."""
+        from ... import initializer as init_mod
+        # bind + init here so the epoch-0 snapshot can run before the
+        # first training epoch (the base fit re-binds idempotently)
+        self.bind(data_shapes=train_data.provide_data,
+                  label_shapes=train_data.provide_label,
+                  for_training=True, force_rebind=force_rebind)
+        self.init_params(
+            initializer=initializer or init_mod.Uniform(0.01),
+            arg_params=arg_params, aux_params=aux_params,
+            allow_missing=allow_missing, force_init=force_init)
+        self.update_full_grads(train_data)
+
+        svrg_self = self
+
+        def _refresh(epoch, *cb_args):
+            if (epoch + 1) % svrg_self.update_freq == 0:
+                svrg_self.update_full_grads(train_data)
+            if epoch_end_callback is not None:
+                cbs = (epoch_end_callback
+                       if isinstance(epoch_end_callback, (list, tuple))
+                       else [epoch_end_callback])
+                for cb in cbs:
+                    cb(epoch, *cb_args)
+
+        super().fit(train_data, eval_data, eval_metric,
+                    validation_metric=validation_metric,
+                    epoch_end_callback=_refresh, **kwargs)

@@ -1,0 +1,38 @@
+"""TensorBoard bridge (reference: python/mxnet/contrib/tensorboard.py).
+
+``LogMetricsCallback`` forwards eval-metric values to a SummaryWriter.
+Any writer object with an ``add_scalar(tag, value, global_step)`` method
+works (torch.utils.tensorboard, tensorboardX, or the reference's
+dmlc/tensorboard); the dependency stays optional exactly like the
+reference's."""
+
+from __future__ import annotations
+
+__all__ = ["LogMetricsCallback"]
+
+
+class LogMetricsCallback(object):
+    """Batch-end callback logging metrics as TensorBoard scalars."""
+
+    def __init__(self, summary_writer=None, logging_dir=None, prefix=None):
+        self.prefix = prefix
+        if summary_writer is not None:
+            self.summary_writer = summary_writer
+        else:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+            except ImportError:
+                raise ImportError(
+                    "LogMetricsCallback needs a SummaryWriter: pass one "
+                    "explicitly or install a tensorboard writer package")
+            self.summary_writer = SummaryWriter(logging_dir)
+        self.step = 0
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        self.step += 1
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = "%s-%s" % (self.prefix, name)
+            self.summary_writer.add_scalar(name, value, self.step)

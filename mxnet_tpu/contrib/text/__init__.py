@@ -1,0 +1,7 @@
+"""Text utilities: vocabulary + token embeddings (reference:
+python/mxnet/contrib/text/)."""
+
+from . import utils  # noqa: F401
+from . import vocab  # noqa: F401
+from . import embedding  # noqa: F401
+from .vocab import Vocabulary  # noqa: F401

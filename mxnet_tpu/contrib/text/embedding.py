@@ -1,0 +1,200 @@
+"""Token embeddings (reference: contrib/text/embedding.py).
+
+``CustomEmbedding`` loads any word-vector text file;  ``GloVe`` /
+``FastText`` are registered names over the same loader — this image has
+no network egress, so pass ``pretrained_file_path`` to a local file
+(the reference's auto-download is unavailable and raises a clear error).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+
+import numpy as _np
+
+from ... import ndarray as nd
+from .vocab import Vocabulary
+
+__all__ = ["register", "create", "get_pretrained_file_names",
+           "TokenEmbedding", "GloVe", "FastText", "CustomEmbedding",
+           "CompositeEmbedding"]
+
+_REGISTRY = {}
+
+
+def register(embedding_cls):
+    """Register an embedding class under its lowercase name
+    (reference: embedding.py:40)."""
+    _REGISTRY[embedding_cls.__name__.lower()] = embedding_cls
+    return embedding_cls
+
+
+def create(embedding_name, **kwargs):
+    """Instantiate a registered embedding (reference: embedding.py:63)."""
+    try:
+        cls = _REGISTRY[embedding_name.lower()]
+    except KeyError:
+        raise KeyError("unknown embedding %r; registered: %s"
+                       % (embedding_name, sorted(_REGISTRY)))
+    return cls(**kwargs)
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """Known pretrained archive names (reference: embedding.py:90).
+    Download is unavailable offline; the names document what the
+    reference would fetch."""
+    table = {
+        "glove": ["glove.6B.50d.txt", "glove.6B.100d.txt",
+                  "glove.6B.200d.txt", "glove.6B.300d.txt",
+                  "glove.42B.300d.txt", "glove.840B.300d.txt"],
+        "fasttext": ["wiki.en.vec", "wiki.simple.vec"],
+    }
+    if embedding_name is not None:
+        return table[embedding_name.lower()]
+    return table
+
+
+class TokenEmbedding(Vocabulary):
+    """Vocabulary + vector table (reference: _TokenEmbedding,
+    embedding.py:133)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._vec_len = 0
+        self._idx_to_vec = None
+
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    def _load_embedding(self, path, elem_delim, init_unknown_vec):
+        if not os.path.isfile(path):
+            raise FileNotFoundError(
+                "pretrained embedding file %r not found; this build has "
+                "no network egress — provide a local file via "
+                "pretrained_file_path" % path)
+        file_vecs = {}
+        with io.open(path, "r", encoding="utf-8", errors="ignore") as f:
+            for line in f:
+                parts = line.rstrip().split(elem_delim)
+                if len(parts) <= 2:
+                    continue  # header line (fastText) or malformed
+                token, elems = parts[0], parts[1:]
+                if self._vec_len == 0:
+                    self._vec_len = len(elems)
+                elif len(elems) != self._vec_len:
+                    continue  # skip malformed rows like the reference
+                if token not in file_vecs:
+                    file_vecs[token] = _np.asarray(elems,
+                                                   dtype=_np.float32)
+        # new tokens from the file extend the index; tokens already
+        # indexed (vocabulary merge) keep their slot and get their
+        # vector filled below
+        for t in file_vecs:
+            if t not in self._token_to_idx:
+                self._token_to_idx[t] = len(self._idx_to_token)
+                self._idx_to_token.append(t)
+        table = _np.zeros((len(self._idx_to_token), self._vec_len),
+                          _np.float32)
+        for t, v in file_vecs.items():
+            table[self._token_to_idx[t]] = v
+        table[0] = init_unknown_vec((self._vec_len,))
+        self._idx_to_vec = nd.array(table)
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        if lower_case_backup:
+            idx = [self._token_to_idx.get(
+                t, self._token_to_idx.get(t.lower(), 0)) for t in toks]
+        else:
+            idx = [self._token_to_idx.get(t, 0) for t in toks]
+        vecs = nd.Embedding(
+            nd.array(_np.asarray(idx, _np.float32)), self._idx_to_vec,
+            input_dim=self._idx_to_vec.shape[0],
+            output_dim=self._vec_len)
+        return vecs[0] if single else vecs
+
+    def update_token_vectors(self, tokens, new_vectors):
+        toks = [tokens] if isinstance(tokens, str) else tokens
+        arr = _np.array(self._idx_to_vec.asnumpy())  # asnumpy views are RO
+        newv = new_vectors.asnumpy().reshape(len(toks), self._vec_len)
+        for t, v in zip(toks, newv):
+            if t not in self._token_to_idx:
+                raise ValueError("token %r not in the embedding" % t)
+            arr[self._token_to_idx[t]] = v
+        self._idx_to_vec = nd.array(arr)
+
+
+@register
+class CustomEmbedding(TokenEmbedding):
+    """Load any ``token<delim>v1<delim>...`` text file
+    (reference: embedding.py:659)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ",
+                 init_unknown_vec=_np.zeros, vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        if vocabulary is not None:
+            self._merge_vocab(vocabulary)
+        self._load_embedding(pretrained_file_path, elem_delim,
+                             init_unknown_vec)
+
+    def _merge_vocab(self, vocabulary):
+        for t in vocabulary.idx_to_token[1:]:
+            if t not in self._token_to_idx:
+                self._token_to_idx[t] = len(self._idx_to_token)
+                self._idx_to_token.append(t)
+
+
+@register
+class GloVe(CustomEmbedding):
+    """GloVe vectors (reference: embedding.py:469).  Offline build:
+    requires a local ``pretrained_file_path``."""
+
+    def __init__(self, pretrained_file_name="glove.6B.50d.txt",
+                 pretrained_file_path=None, **kwargs):
+        if pretrained_file_path is None:
+            raise FileNotFoundError(
+                "GloVe auto-download is unavailable (no network egress); "
+                "download %s elsewhere and pass pretrained_file_path"
+                % pretrained_file_name)
+        super().__init__(pretrained_file_path, elem_delim=" ", **kwargs)
+
+
+@register
+class FastText(CustomEmbedding):
+    """fastText vectors (reference: embedding.py:559); offline build —
+    see GloVe."""
+
+    def __init__(self, pretrained_file_name="wiki.simple.vec",
+                 pretrained_file_path=None, **kwargs):
+        if pretrained_file_path is None:
+            raise FileNotFoundError(
+                "FastText auto-download is unavailable (no network "
+                "egress); download %s elsewhere and pass "
+                "pretrained_file_path" % pretrained_file_name)
+        super().__init__(pretrained_file_path, elem_delim=" ", **kwargs)
+
+
+class CompositeEmbedding(TokenEmbedding):
+    """Concatenate several embeddings over one vocabulary
+    (reference: embedding.py:720)."""
+
+    def __init__(self, vocabulary, token_embeddings):
+        super().__init__()
+        embs = (token_embeddings if isinstance(token_embeddings, list)
+                else [token_embeddings])
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        parts = []
+        for emb in embs:
+            vecs = emb.get_vecs_by_tokens(self._idx_to_token)
+            parts.append(vecs.asnumpy())
+        table = _np.concatenate(parts, axis=1)
+        self._vec_len = table.shape[1]
+        self._idx_to_vec = nd.array(table)

@@ -1,0 +1,76 @@
+"""Vocabulary (reference: contrib/text/vocab.py:30).
+
+Maps tokens <-> contiguous indices; index 0 is the unknown token, then
+reserved tokens, then corpus tokens by frequency (ties by insertion)."""
+
+from __future__ import annotations
+
+__all__ = ["Vocabulary"]
+
+
+class Vocabulary(object):
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        if min_freq < 1:
+            raise ValueError("min_freq must be >= 1")
+        if reserved_tokens is not None:
+            if unknown_token in reserved_tokens:
+                raise ValueError("unknown_token must not be reserved")
+            if len(set(reserved_tokens)) != len(reserved_tokens):
+                raise ValueError("reserved_tokens must be unique")
+        self._unknown_token = unknown_token
+        self._reserved_tokens = (list(reserved_tokens)
+                                 if reserved_tokens else None)
+        self._idx_to_token = [unknown_token] + (self._reserved_tokens or [])
+        self._token_to_idx = {t: i for i, t in
+                              enumerate(self._idx_to_token)}
+        if counter is not None:
+            self._index_counter(counter, most_freq_count, min_freq)
+
+    def _index_counter(self, counter, most_freq_count, min_freq):
+        # stable order: by frequency desc, then first-seen
+        pairs = sorted(counter.items(), key=lambda kv: -kv[1])
+        budget = (most_freq_count if most_freq_count is not None
+                  else len(pairs))
+        for token, freq in pairs:
+            if freq < min_freq or budget <= 0:
+                break
+            if token not in self._token_to_idx:
+                self._token_to_idx[token] = len(self._idx_to_token)
+                self._idx_to_token.append(token)
+                budget -= 1
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        """Token (or list of tokens) -> index/indices; unknown -> 0."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        idx = [self._token_to_idx.get(t, 0) for t in toks]
+        return idx[0] if single else idx
+
+    def to_tokens(self, indices):
+        single = isinstance(indices, int)
+        idxs = [indices] if single else indices
+        for i in idxs:
+            if not 0 <= i < len(self._idx_to_token):
+                raise ValueError("token index %d out of range" % i)
+        toks = [self._idx_to_token[i] for i in idxs]
+        return toks[0] if single else toks

@@ -7,4 +7,5 @@ from . import nn  # noqa: F401
 from . import rnn  # noqa: F401
 from . import data  # noqa: F401
 from . import loss  # noqa: F401
+from . import contrib  # noqa: F401
 from .utils import split_data, split_and_load, clip_global_norm  # noqa

@@ -1,0 +1,5 @@
+"""gluon.contrib — experimental layers and cells (reference:
+python/mxnet/gluon/contrib/)."""
+
+from . import nn  # noqa: F401
+from . import rnn  # noqa: F401

@@ -1,0 +1,84 @@
+"""Contrib layers (reference: gluon/contrib/nn/basic_layers.py:29-208 —
+Concurrent, HybridConcurrent, Identity, SparseEmbedding, SyncBatchNorm)."""
+
+from __future__ import annotations
+
+from ... import nn
+from ...block import Block, HybridBlock
+from .... import ndarray as nd
+
+
+class Concurrent(nn.Sequential):
+    """Run children on the same input, concat outputs along *axis*."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        out = [blk(x) for blk in self._children.values()]
+        return nd.concat(*out, dim=self.axis)
+
+
+class HybridConcurrent(nn.HybridSequential):
+    """Hybridizable :class:`Concurrent`."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def hybrid_forward(self, F, x):
+        out = [blk(x) for blk in self._children.values()]
+        return F.concat(*out, dim=self.axis)
+
+
+class Identity(HybridBlock):
+    """Pass-through (useful as a Concurrent branch)."""
+
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SparseEmbedding(Block):
+    """Embedding whose gradient is row_sparse (reference:
+    basic_layers.py:116 — sparse_grad Embedding for kvstore
+    row_sparse_pull training).  Forward is a row gather; the backward
+    tape records a RowSparseNDArray gradient holding only touched rows."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super().__init__(**kwargs)
+        self._kwargs = {"input_dim": input_dim, "output_dim": output_dim}
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight", shape=(input_dim, output_dim), dtype=dtype,
+                init=weight_initializer, stype="row_sparse",
+                grad_stype="row_sparse")
+
+    def forward(self, x):
+        weight = self.weight.row_sparse_data(x)
+        return nd.Embedding(x, weight, **self._kwargs,
+                            sparse_grad=True)
+
+    def __repr__(self):
+        return "SparseEmbedding({input_dim} -> {output_dim})".format(
+            **self._kwargs)
+
+
+class SyncBatchNorm(nn.BatchNorm):
+    """Cross-device BatchNorm (reference: basic_layers.py:163 +
+    src/operator/contrib/sync_batch_norm.cc).
+
+    The reference synchronizes moments with a key-based global barrier
+    across GPU workers.  On TPU the equivalent is a ``psum`` over the
+    data-parallel mesh axis *inside* the compiled step — which is what
+    the ``_contrib_SyncBatchNorm`` operator emits when an axis name is
+    bound (ops/spatial.py).  Outside a pjit/shard_map context it reduces
+    over the local batch only, which is identical semantics on one chip.
+    """
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, **kwargs):
+        super().__init__(axis=1, momentum=momentum, epsilon=epsilon,
+                         in_channels=in_channels, **kwargs)
+        self._num_devices = num_devices

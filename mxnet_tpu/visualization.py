@@ -1,0 +1,124 @@
+"""Network visualization (reference: python/mxnet/visualization.py —
+print_summary + plot_network).
+
+``print_summary`` walks the symbol graph and prints a layer table with
+output shapes and parameter counts.  ``plot_network`` renders a graphviz
+Digraph when the ``graphviz`` package is installed (it is optional, as in
+the reference)."""
+
+from __future__ import annotations
+
+import json
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def _param_names(nodes):
+    """Names of weight/bias variable nodes (op == null, not data/label)."""
+    out = set()
+    for node in nodes:
+        name = node["name"]
+        if node["op"] == "null" and not name.endswith(("data", "label")) \
+                and name != "data":
+            out.add(name)
+    return out
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=None):
+    """Print a per-layer summary table (reference: visualization.py
+    print_summary)."""
+    positions = positions or [0.44, 0.64, 0.74, 1.0]
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    head_ids = {h[0] for h in conf["heads"]}
+    params = _param_names(nodes)
+    shapes_by_name = {}
+    if shape is not None:
+        arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**shape)
+        for name, shp in zip(symbol.list_arguments(), arg_shapes):
+            shapes_by_name[name] = shp
+        for name, shp in zip(symbol.list_auxiliary_states(), aux_shapes):
+            shapes_by_name[name] = shp
+        internals = symbol.get_internals()
+        _, int_shapes, _ = internals.infer_shape(**shape)
+        for name, shp in zip(internals.list_outputs(), int_shapes):
+            if shp is not None:  # vars come back None; keep arg shapes
+                shapes_by_name[name] = shp
+
+    positions = [int(line_length * p) for p in positions]
+    fields = ["Layer (type)", "Output Shape", "Param #",
+              "Previous Layer"]
+
+    def print_row(vals):
+        line = ""
+        for v, p in zip(vals, positions):
+            line = (line + str(v))[:p - 1].ljust(p)
+        print(line)
+
+    print("=" * line_length)
+    print_row(fields)
+    print("=" * line_length)
+    total_params = 0
+    for node_id, node in enumerate(nodes):
+        op = node["op"]
+        name = node["name"]
+        if op == "null" and node_id not in head_ids:
+            continue
+        inputs = [nodes[i[0]]["name"] for i in node.get("inputs", [])]
+        cnt = 0
+        for pname in inputs:
+            if pname in params and pname in shapes_by_name:
+                n = 1
+                for s in shapes_by_name[pname]:
+                    n *= s
+                cnt += n
+        total_params += cnt
+        out_name = name + "_output" if op != "null" else name
+        out_shape = shapes_by_name.get(
+            out_name, shapes_by_name.get(name, ""))
+        prev = ",".join(n for n in inputs if n not in params)
+        print_row(["%s (%s)" % (name, op), out_shape, cnt, prev])
+    print("=" * line_length)
+    print("Total params: %d" % total_params)
+    print("=" * line_length)
+    return total_params
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Build a graphviz Digraph of the symbol (reference:
+    visualization.py plot_network).  Requires the optional ``graphviz``
+    package."""
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise ImportError(
+            "plot_network requires the optional 'graphviz' package "
+            "(the reference has the same optional dependency)")
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    params = _param_names(nodes)
+    dot = Digraph(name=title, format=save_format)
+    attrs = {"shape": "box", "fixedsize": "false"}
+    attrs.update(node_attrs or {})
+    drawn = set()
+    for node in nodes:
+        op = node["op"]
+        name = node["name"]
+        if op == "null":
+            if hide_weights and name in params:
+                continue
+            dot.node(name=name, label=name,
+                     **dict(attrs, fillcolor="#8dd3c7", style="filled"))
+        else:
+            dot.node(name=name, label="%s\n%s" % (name, op),
+                     **dict(attrs, fillcolor="#fb8072", style="filled"))
+        drawn.add(name)
+    for node in nodes:
+        if node["op"] == "null":
+            continue
+        for inp in node.get("inputs", []):
+            src = nodes[inp[0]]["name"]
+            if src in drawn:
+                dot.edge(src, node["name"])
+    return dot

@@ -1,0 +1,279 @@
+"""Contrib surfaces: text vocab/embeddings, visualization, svrg,
+DataLoaderIter, legacy autograd, gluon.contrib layers/cells,
+SequentialModule, PythonLossModule (reference: python/mxnet/contrib/,
+gluon/contrib/, module/)."""
+
+import io as _io
+import logging
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd, autograd
+
+
+# --- contrib.text ---------------------------------------------------------
+
+def test_vocabulary_indexing():
+    from mxnet_tpu.contrib.text import utils, vocab
+    counter = utils.count_tokens_from_str("a b b c c c\nd d d d")
+    v = vocab.Vocabulary(counter, min_freq=2,
+                         reserved_tokens=["<pad>"])
+    assert v.token_to_idx["<unk>"] == 0
+    assert v.token_to_idx["<pad>"] == 1
+    # frequency order: d(4), c(3), b(2); a dropped by min_freq
+    assert v.to_indices(["d", "c", "b"]) == [2, 3, 4]
+    assert v.to_indices("zzz") == 0
+    assert v.to_tokens([2, 0]) == ["d", "<unk>"]
+    assert len(v) == 5
+
+
+def test_custom_embedding_and_composite(tmp_path):
+    from mxnet_tpu.contrib import text
+    p = tmp_path / "vec.txt"
+    p.write_text("hello 1 2 3\nworld 4 5 6\n")
+    emb = text.embedding.CustomEmbedding(str(p))
+    assert emb.vec_len == 3
+    vecs = emb.get_vecs_by_tokens(["hello", "world", "nope"])
+    np.testing.assert_allclose(vecs.asnumpy(),
+                               [[1, 2, 3], [4, 5, 6], [0, 0, 0]])
+    emb.update_token_vectors("hello", nd.array([[9., 9., 9.]]))
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("hello").asnumpy(), [9, 9, 9])
+
+    vocab = text.Vocabulary({"hello": 2, "world": 1})
+    comp = text.embedding.CompositeEmbedding(vocab, [emb])
+    assert comp.idx_to_vec.shape == (len(vocab), 3)
+    # registry create() path
+    emb2 = text.embedding.create("customembedding",
+                                 pretrained_file_path=str(p))
+    assert emb2.vec_len == 3
+    with pytest.raises(FileNotFoundError):
+        text.embedding.create("glove")
+
+
+# --- visualization --------------------------------------------------------
+
+def test_print_summary_counts_params(capsys):
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+    total = mx.viz.print_summary(net, shape={"data": (2, 4)})
+    out = capsys.readouterr().out
+    assert "fc1" in out and "fc2" in out
+    # fc1: 4*8+8 = 40; fc2: 8*3+3 = 27
+    assert total == 67
+
+
+# --- SVRG -----------------------------------------------------------------
+
+def test_svrg_module_converges():
+    from mxnet_tpu.contrib.svrg_optimization import SVRGModule
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(5, 1).astype(np.float32)
+    X = rng.randn(128, 5).astype(np.float32)
+    y = (X @ w_true).ravel()
+
+    data = mx.sym.var("data")
+    label = mx.sym.var("lin_label")
+    pred = mx.sym.FullyConnected(data, num_hidden=1, name="fc")
+    out = mx.sym.LinearRegressionOutput(pred, label, name="lin")
+
+    it = mx.io.NDArrayIter({"data": X}, {"lin_label": y}, batch_size=32)
+    mod = SVRGModule(out, data_names=("data",),
+                     label_names=("lin_label",), update_freq=2,
+                     context=[mx.cpu()])
+    mod.fit(it, num_epoch=20, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.25},
+            eval_metric="mse")
+    it.reset()
+    score = mod.score(it, "mse")
+    assert dict(score)["mse"] < 0.01
+
+
+# --- contrib.io DataLoaderIter -------------------------------------------
+
+def test_dataloader_iter_with_module():
+    from mxnet_tpu.contrib.io import DataLoaderIter
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    X = np.random.RandomState(0).randn(64, 6).astype(np.float32)
+    y = (X.sum(axis=1) > 0).astype(np.float32)
+    loader = DataLoader(ArrayDataset(X, y), batch_size=16)
+    it = DataLoaderIter(loader)
+    assert it.batch_size == 16
+    n = sum(1 for _ in it)
+    assert n == 4
+    it.reset()
+    batch = it.next()
+    assert batch.data[0].shape == (16, 6)
+
+
+# --- legacy contrib.autograd ---------------------------------------------
+
+def test_contrib_autograd_grad_and_loss():
+    from mxnet_tpu.contrib import autograd as cag
+    x = nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+
+    def f(x):
+        return nd.sum(x * x)
+
+    grads, loss = cag.grad_and_loss(f)(x)
+    np.testing.assert_allclose(grads[0].asnumpy(), [2, 4, 6], rtol=1e-6)
+    assert float(loss.asnumpy()) == pytest.approx(14.0)
+
+
+# --- gluon.contrib --------------------------------------------------------
+
+def test_concurrent_and_identity():
+    from mxnet_tpu.gluon.contrib import nn as cnn
+    net = cnn.HybridConcurrent(axis=1)
+    net.add(cnn.Identity(), gluon.nn.Dense(4, flatten=False))
+    net.initialize()
+    x = nd.array(np.ones((2, 3), np.float32))
+    assert net(x).shape == (2, 7)
+    seq = cnn.Concurrent(axis=1)
+    seq.add(cnn.Identity(), cnn.Identity())
+    assert seq(x).shape == (2, 6)
+
+
+def test_conv_lstm_cell_unroll_and_grad():
+    from mxnet_tpu.gluon.contrib import rnn as crnn
+    cell = crnn.Conv2DLSTMCell(input_shape=(2, 6, 6), hidden_channels=3,
+                               i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    cell.initialize()
+    seq = nd.array(np.random.RandomState(0).randn(
+        2, 3, 2, 6, 6).astype(np.float32))  # NTCHW
+    outs, states = cell.unroll(3, seq, layout="NTC", merge_outputs=False)
+    assert outs[0].shape == (2, 3, 6, 6)
+    with autograd.record():
+        out, _ = cell(seq[:, 0], cell.begin_state(batch_size=2))
+        loss = nd.sum(out * out)
+    loss.backward()
+    g = cell.i2h_weight.grad()
+    assert np.isfinite(g.asnumpy()).all() and np.abs(g.asnumpy()).sum() > 0
+
+
+def test_variational_dropout_mask_locked_and_inference_identity():
+    from mxnet_tpu.gluon.contrib import rnn as crnn
+    base = gluon.rnn.RNNCell(4)
+    vd = crnn.VariationalDropoutCell(base, drop_inputs=0.5)
+    vd.initialize()
+    x = nd.array(np.ones((2, 4), np.float32))
+    # training mode: the mask is sampled once and locked across steps
+    with autograd.record():
+        vd.reset()
+        st = vd.begin_state(batch_size=2)
+        vd(x, st)
+        mask1 = vd._input_mask.asnumpy()
+        vd(x, st)
+        mask2 = vd._input_mask.asnumpy()
+    np.testing.assert_array_equal(mask1, mask2)
+    assert set(np.unique(mask1)).issubset({0.0, 2.0})  # scaled keep-mask
+    # inference: no dropout — mask is identity, outputs deterministic
+    vd.reset()
+    out1, _ = vd(x, vd.begin_state(batch_size=2))
+    np.testing.assert_array_equal(vd._input_mask.asnumpy(),
+                                  np.ones((2, 4), np.float32))
+    vd.reset()
+    out2, _ = vd(x, vd.begin_state(batch_size=2))
+    np.testing.assert_allclose(out1.asnumpy(), out2.asnumpy(), rtol=1e-6)
+    # valid_length passes through unroll
+    seq = nd.array(np.ones((1, 6, 4), np.float32))
+    outs, _ = vd.unroll(6, seq, layout="NTC", merge_outputs=True,
+                        valid_length=nd.array(np.array([4.0])))
+    assert outs.shape == (1, 6, 4)
+
+
+def test_custom_embedding_fills_vocab_tokens(tmp_path):
+    # vectors must be filled for tokens that came in via `vocabulary`
+    from mxnet_tpu.contrib import text
+    p = tmp_path / "v.txt"
+    p.write_text("hello 1 2 3\nworld 4 5 6\n")
+    emb = text.embedding.CustomEmbedding(
+        str(p), vocabulary=text.Vocabulary({"hello": 2, "absent": 1}))
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("hello").asnumpy(), [1, 2, 3])
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("absent").asnumpy(), [0, 0, 0])
+
+
+def test_print_summary_includes_head_variable(capsys):
+    total = mx.viz.print_summary(mx.sym.var("data"),
+                                 shape={"data": (2, 3)})
+    out = capsys.readouterr().out
+    assert "data" in out
+    assert total == 0
+
+
+def test_lstmp_cell_shapes():
+    from mxnet_tpu.gluon.contrib import rnn as crnn
+    cell = crnn.LSTMPCell(8, 3)
+    cell.initialize()
+    out, states = cell(nd.array(np.ones((2, 5), np.float32)),
+                       cell.begin_state(batch_size=2))
+    assert out.shape == (2, 3)
+    assert states[0].shape == (2, 3) and states[1].shape == (2, 8)
+
+
+def test_sparse_embedding_trains():
+    from mxnet_tpu.gluon.contrib import nn as cnn
+    emb = cnn.SparseEmbedding(30, 4)
+    emb.initialize()
+    assert emb.weight._grad_stype == "row_sparse"
+    trainer = gluon.Trainer(emb.collect_params(), "sgd",
+                            {"learning_rate": 0.5})
+    idx = nd.array(np.array([1, 2, 2], np.float32))
+    before = emb.weight.data().asnumpy().copy()
+    with autograd.record():
+        loss = nd.sum(emb(idx) ** 2)
+    loss.backward()
+    trainer.step(1)
+    after = emb.weight.data().asnumpy()
+    assert not np.allclose(before[1], after[1])  # touched row moved
+    np.testing.assert_allclose(before[5], after[5])  # untouched row
+
+
+# --- SequentialModule / PythonLossModule ---------------------------------
+
+def test_sequential_module_with_python_loss():
+    from mxnet_tpu.module import SequentialModule, PythonLossModule, Module
+
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=4, name="fc_seq")
+    mod1 = Module(net, data_names=("data",), label_names=None,
+                  context=[mx.cpu()])
+    loss_mod = PythonLossModule(data_names=("fc_seq_output",))
+
+    seq = SequentialModule(logger=logging)
+    seq.add(mod1).add(loss_mod, take_labels=True, auto_wiring=True)
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(40, 6).astype(np.float32)
+    y = rng.randint(0, 4, 40).astype(np.float32)
+    it = mx.io.NDArrayIter({"data": X}, {"softmax_label": y},
+                           batch_size=10)
+    seq.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    seq.init_params()
+    seq.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    first_loss, last_loss = None, None
+    for _epoch in range(12):
+        it.reset()
+        total, count = 0.0, 0
+        for batch in it:
+            seq.forward(batch, is_train=True)
+            scores = seq.get_outputs()[0].asnumpy()
+            labels = batch.label[0].asnumpy().astype(int)
+            p = np.exp(scores - scores.max(1, keepdims=True))
+            p /= p.sum(1, keepdims=True)
+            total += -np.log(p[np.arange(len(labels)), labels] + 1e-9).sum()
+            count += len(labels)
+            seq.backward()
+            seq.update()
+        if first_loss is None:
+            first_loss = total / count
+        last_loss = total / count
+    assert last_loss < first_loss  # the chain learns through the py loss
