@@ -293,8 +293,8 @@ class KVStoreServer:
         # heartbeat-based failure detection (reference: ps-lite
         # Postoffice::GetDeadNodes, kvstore_dist.h:119-128)
         self.heartbeats = {}       # node id -> last heartbeat walltime
-        self.sync_timeout = float(os.environ.get(
-            "MXNET_KVSTORE_SYNC_TIMEOUT", "120"))
+        from .config import get_env as _get_env
+        self.sync_timeout = _get_env("MXNET_KVSTORE_SYNC_TIMEOUT")
         self.cv = threading.Condition()
         self.lock = threading.RLock()
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -530,8 +530,8 @@ class KVStoreDist(KVStoreBase):
                                         os.environ.get("DMLC_RANK", "0")))
         self._num_workers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
         self._num_servers = int(os.environ.get("DMLC_NUM_SERVER", "1"))
-        self._big_bound = int(os.environ.get(
-            "MXNET_KVSTORE_BIGARRAY_BOUND", "1000000"))
+        from .config import get_env as _get_env
+        self._big_bound = _get_env("MXNET_KVSTORE_BIGARRAY_BOUND")
         # server s listens on root port + s (tools/launch.py convention)
         self._socks = []
         self._locks = []
@@ -557,8 +557,8 @@ class KVStoreDist(KVStoreBase):
         self._start_heartbeat()
 
     def _start_heartbeat(self):
-        interval = float(os.environ.get(
-            "MXNET_KVSTORE_HEARTBEAT_INTERVAL", "1.0"))
+        from .config import get_env as _get_env
+        interval = _get_env("MXNET_KVSTORE_HEARTBEAT_INTERVAL")
         node = "worker%d" % self._rank
         # dedicated sockets: heartbeats must not contend with bulk RPCs
         host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
@@ -578,7 +578,14 @@ class KVStoreDist(KVStoreBase):
                         _send_msg(socks[s], (_MSG_HEARTBEAT, node))
                         _recv_msg(socks[s])
                     except (ConnectionError, OSError):
+                        # transient: server restarting; retry next beat
                         socks.pop(s, None)
+                    except Exception as e:
+                        # unexpected: surface at the next engine sync
+                        # point (reference: exception chain rethrow)
+                        from .runtime import engine as _engine
+                        _engine.record_exception(e)
+                        return
                 time.sleep(interval)
 
         self._hb_thread = threading.Thread(target=beat, daemon=True)

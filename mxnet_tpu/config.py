@@ -1,0 +1,105 @@
+"""Environment-knob registry (reference: §5.6 config system —
+~32 documented ``MXNET_*`` vars in docs/faq/env_var.md read through
+``dmlc::GetEnv`` at singleton init).
+
+One typed, documented registry instead of scattered ``os.environ`` reads:
+every knob this framework consults is declared here with type, default,
+and doc; ``describe()`` prints the env-var reference table the way
+docs/faq/env_var.md documents the reference's.  Values are read at call
+time (not import time) so tests can monkeypatch the environment.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["register_env", "get_env", "list_env", "describe"]
+
+_REGISTRY = {}
+
+
+class _Knob(object):
+    __slots__ = ("name", "type", "default", "doc")
+
+    def __init__(self, name, typ, default, doc):
+        self.name = name
+        self.type = typ
+        self.default = default
+        self.doc = doc
+
+
+def register_env(name, typ, default, doc):
+    """Declare an environment knob (type in {int, float, str, bool})."""
+    _REGISTRY[name] = _Knob(name, typ, default, doc)
+    return _REGISTRY[name]
+
+
+def get_env(name):
+    """Read a registered knob from the environment (typed, defaulted)."""
+    knob = _REGISTRY[name]
+    raw = os.environ.get(name)
+    if raw is None:
+        return knob.default
+    if knob.type is bool:
+        return raw.lower() not in ("0", "false", "off", "")
+    try:
+        return knob.type(raw)
+    except (TypeError, ValueError):
+        raise ValueError("env %s=%r is not a valid %s"
+                         % (name, raw, knob.type.__name__))
+
+
+def list_env():
+    return sorted(_REGISTRY)
+
+
+def describe():
+    """The env-var reference table (reference: docs/faq/env_var.md)."""
+    lines = []
+    for name in list_env():
+        k = _REGISTRY[name]
+        lines.append("%-40s %-6s default=%-12r %s"
+                     % (name, k.type.__name__, k.default, k.doc))
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Knob declarations — every env var the framework consults.
+# ---------------------------------------------------------------------------
+
+register_env("MXNET_ENGINE_TYPE", str, "XLAAsync",
+             "Engine selection; 'NaiveEngine' forces synchronous "
+             "execution after every op (reference: engine.cc:32-48)")
+register_env("MXNET_EXEC_BULK_EXEC_TRAIN", bool, True,
+             "Compile the whole training graph as one XLA program; off "
+             "= per-node execution for debugging/monitoring "
+             "(reference: graph_executor.cc:1187 bulk segments)")
+register_env("MXNET_EXEC_BULK_EXEC_INFERENCE", bool, True,
+             "Same as MXNET_EXEC_BULK_EXEC_TRAIN for inference graphs")
+register_env("MXNET_KVSTORE_SYNC_TIMEOUT", float, 120.0,
+             "Seconds a dist_sync server waits for all workers' pushes "
+             "or barrier arrivals before raising")
+register_env("MXNET_KVSTORE_HEARTBEAT_INTERVAL", float, 1.0,
+             "Seconds between worker heartbeats feeding dead-node "
+             "detection (reference: ps-lite heartbeats)")
+register_env("MXNET_KVSTORE_BIGARRAY_BOUND", int, 1000000,
+             "Arrays above this many elements shard across all servers "
+             "(reference: kvstore_dist.h:58)")
+register_env("MXNET_KVSTORE_TYPE", str, "local",
+             "Default kvstore type for examples/launchers")
+register_env("MXNET_SUBGRAPH_BACKEND", str, "",
+             "Subgraph property applied at bind time "
+             "(reference: partition_graph.cc; see mxnet_tpu.subgraph)")
+register_env("MXNET_TPU_MATMUL_PRECISION", str, "",
+             "Override jax matmul precision: bfloat16 | float32 | "
+             "tensorfloat32 (TPU-native knob)")
+register_env("MXNET_UPDATE_ON_KVSTORE", bool, True,
+             "Run the optimizer on the kvstore server (dist) / store "
+             "(local) instead of locally (reference: module/trainer)")
+register_env("MXNET_CPU_WORKER_NTHREADS", int, 0,
+             "Host-side worker threads for the data pipeline; 0 = "
+             "library default (reference: "
+             "threaded_engine_perdevice.cc:79)")
+register_env("MXNET_ENGINE_INFO", bool, False,
+             "Verbose engine scheduling debug output "
+             "(reference: threaded_engine.h:302)")

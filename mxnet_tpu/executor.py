@@ -285,9 +285,18 @@ class Executor:
                     self.arg_dict[k].dtype))
             else:
                 raise MXNetError("unknown forward argument %r" % k)
-        fn = self._jit_train if is_train else self._jit_infer
+        from .runtime import engine as _engine
         key = self._next_key()
-        outs, auxu = fn(self._arg_map(), self._aux_map(), key)
+        if not _engine.bulk_enabled(is_train):
+            # bulking disabled: per-node eager dispatch (the reference's
+            # non-bulk engine path, graph_executor.cc:1187) — every op
+            # runs as its own dispatch, fully debuggable
+            outs, auxu = self._eval_per_node(self._arg_map(),
+                                             self._aux_map(), key,
+                                             is_train)
+        else:
+            fn = self._jit_train if is_train else self._jit_infer
+            outs, auxu = fn(self._arg_map(), self._aux_map(), key)
         if is_train:
             # keep the key: backward() must replay the same stochastic
             # masks (Dropout etc.) that produced these outputs
@@ -381,6 +390,13 @@ class Executor:
             if tuple(ex.aux_dict[n].shape) == tuple(a.shape):
                 ex.aux_dict[n] = a
         return ex
+
+    def _eval_per_node(self, arg_map, aux_map, key, is_train):
+        """Non-bulk execution: the same walk _build_eval traces, but
+        dispatched eagerly op by op (reference: non-bulk engine ops,
+        graph_executor.cc:1187-1215 / MXEngineSetBulkSize(0))."""
+        fn = self._eval_train if is_train else self._eval_infer
+        return fn(arg_map, aux_map, key)
 
     def set_monitor_callback(self, callback, monitor_all=False):
         """Install a per-op output tap (reference:

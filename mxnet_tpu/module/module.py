@@ -377,8 +377,8 @@ class Module(BaseModule):
             if num_device == 1 and "dist" not in kvstore:
                 return None, False
             kv = kvs.create(kvstore)
-        update_on_kvstore = bool(int(
-            os.environ.get("MXNET_UPDATE_ON_KVSTORE", "1")))
+        from ..config import get_env
+        update_on_kvstore = get_env("MXNET_UPDATE_ON_KVSTORE")
         if "async" in getattr(kv, "type", ""):
             update_on_kvstore = True
         return kv, update_on_kvstore

@@ -138,3 +138,17 @@ def _cond_op(rng, *arrays, pred_graph=None, then_graph=None,
         return tuple(else_fn(else_in, {}, k2)[0][:n_outputs])
 
     return jax.lax.cond(pred, run_then, run_else, None)
+
+
+@register_op("_subgraph_exec", needs_rng=True, input_names=(),
+             num_outputs=lambda p: int(p["n_outputs"]))
+def _subgraph_exec_op(rng, *inputs, subgraph=None, input_names=(),
+                      n_outputs=1, training=False):
+    """Execute a captured sub-Symbol as one unit (the replacement node
+    the subgraph partitioner emits — reference counterpart: the
+    subgraph op built by CreateSubgraphNode, subgraph_property.h:105).
+    Inputs are bound to the subgraph's placeholder variables by name."""
+    eval_fn = _subgraph_eval(subgraph, training)
+    amap = dict(zip(input_names, inputs))
+    outs, _aux = eval_fn(amap, {}, rng)
+    return tuple(outs)

@@ -2,33 +2,76 @@
 
 The reference exposes a handful of engine controls to Python
 (``MXNDArrayWaitAll``, ``MXEngineSetBulkSize``, engine type selection via
-``MXNET_ENGINE_TYPE`` — ``src/engine/engine.cc:32-48``).  On TPU the
-scheduler *is* XLA+PJRT async dispatch, so these become thin shims with the
-same observable semantics: ``wait_all`` blocks until every outstanding device
-computation is done; ``naive_mode`` forces synchronous execution after every
-op (the debugging escape hatch the NaiveEngine provides in the reference).
+``MXNET_ENGINE_TYPE`` — ``src/engine/engine.cc:32-48``) and propagates op
+exceptions along the dependency chain to the next sync point
+(``threaded_engine.h:179-180,256-257``; docs/architecture/
+exception_handling.md).  On TPU the device scheduler *is* XLA+PJRT async
+dispatch, so these become shims with the same observable semantics:
+
+- ``wait_all`` blocks until outstanding device work is done AND rethrows
+  any exception recorded by host-side async components (prefetch threads,
+  kvstore heartbeats, dataloader workers) — the dependency-chain
+  rethrow-at-sync contract.
+- ``naive_mode`` forces synchronous execution after every op (the
+  NaiveEngine debugging escape hatch), selectable via
+  ``MXNET_ENGINE_TYPE=NaiveEngine``.
+- ``set_bulk_size(0)`` disables whole-graph bulking: executors evaluate
+  per node (the monitor path) instead of one fused XLA program
+  (reference: bulk segments, graph_executor.cc:1187-1215).
 """
 
 from __future__ import annotations
 
 import contextlib
+import threading
 
 import jax
 
-_naive = False
+from ..config import get_env
+
+_naive = None   # None = consult MXNET_ENGINE_TYPE; bool = explicit
+_bulk_size = None  # None = consult MXNET_EXEC_BULK_EXEC_*; int override
+_exc_lock = threading.Lock()
+_pending_exceptions = []
 
 
 def wait_all():
-    """Block until all async device work has completed
-    (reference: Engine::WaitForAll / MXNDArrayWaitAll)."""
+    """Block until all async device work has completed, then rethrow
+    the first exception recorded by async host components (reference:
+    Engine::WaitForAll / MXNDArrayWaitAll + exception chain rethrow)."""
     try:
         jax.effects_barrier()
     except Exception:
         jax.block_until_ready(jax.numpy.zeros(()))
+    rethrow_pending()
+
+
+def record_exception(exc):
+    """Register an exception from an async host component (prefetch
+    thread, kvstore heartbeat, dataloader worker); it rethrows at the
+    next sync point — the reference's var-exception propagation
+    (threaded_engine.h:256)."""
+    with _exc_lock:
+        _pending_exceptions.append(exc)
+
+
+def rethrow_pending():
+    with _exc_lock:
+        if not _pending_exceptions:
+            return
+        exc = _pending_exceptions.pop(0)
+    raise exc
+
+
+def clear_exceptions():
+    with _exc_lock:
+        _pending_exceptions.clear()
 
 
 def is_naive():
-    return _naive
+    if _naive is not None:
+        return _naive
+    return get_env("MXNET_ENGINE_TYPE") == "NaiveEngine"
 
 
 def set_naive(flag):
@@ -39,9 +82,42 @@ def set_naive(flag):
 
 @contextlib.contextmanager
 def naive_mode():
+    global _naive
     prev = _naive
     set_naive(True)
     try:
         yield
     finally:
-        set_naive(prev)
+        _naive = prev
+
+
+def set_bulk_size(size):
+    """0 disables graph bulking (per-node execution); >0 restores the
+    whole-graph program (reference: MXEngineSetBulkSize).  Returns the
+    previous override (None = env-driven default)."""
+    global _bulk_size
+    prev = _bulk_size
+    _bulk_size = int(size)
+    return prev
+
+
+def bulk_enabled(is_train=True):
+    """Should executors compile the whole graph as one program?"""
+    if _bulk_size is not None:
+        return _bulk_size > 0
+    return get_env("MXNET_EXEC_BULK_EXEC_TRAIN" if is_train
+                   else "MXNET_EXEC_BULK_EXEC_INFERENCE")
+
+
+@contextlib.contextmanager
+def bulk(size):
+    """Scoped bulk-size override (reference: mx.engine bulk context)."""
+    global _bulk_size
+    prev = _bulk_size
+    _bulk_size = int(size)
+    try:
+        yield
+    finally:
+        # restore the raw previous state, including the env-driven
+        # None sentinel — a scoped override must not become permanent
+        _bulk_size = prev

@@ -446,18 +446,38 @@ class Symbol:
             f.write(self.tojson())
 
     # -- binding -----------------------------------------------------------
+    def _maybe_partition(self):
+        """Apply the env-selected subgraph backend at bind time
+        (reference: MXNET_SUBGRAPH_BACKEND consulted by the executor's
+        PartitionGraph pass)."""
+        from ..config import get_env
+        backend = get_env("MXNET_SUBGRAPH_BACKEND")
+        if not backend:
+            return self
+        from ..subgraph import partition_graph, list_subgraph_backends
+        if backend not in list_subgraph_backends():
+            import warnings
+            warnings.warn(
+                "MXNET_SUBGRAPH_BACKEND=%r is not a registered backend "
+                "(known: %s); partitioning skipped"
+                % (backend, list_subgraph_backends()))
+            return self
+        return partition_graph(self, backend)
+
     def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
                     stype_dict=None, group2ctx=None, shared_arg_names=None,
                     shared_exec=None, shared_buffer=None, **kwargs):
         from ..executor import Executor
-        return Executor._simple_bind(self, ctx, grad_req, type_dict,
+        return Executor._simple_bind(self._maybe_partition(), ctx,
+                                     grad_req, type_dict,
                                      kwargs, shared_exec=shared_exec,
                                      group2ctx=group2ctx)
 
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
              aux_states=None, group2ctx=None, shared_exec=None):
         from ..executor import Executor
-        return Executor._bind(self, ctx, args, args_grad, grad_req,
+        return Executor._bind(self._maybe_partition(), ctx, args,
+                              args_grad, grad_req,
                               aux_states, group2ctx=group2ctx)
 
     def eval(self, ctx=None, **kwargs):

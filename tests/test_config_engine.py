@@ -1,0 +1,78 @@
+"""Config registry + engine semantics tests (reference: §5.6 env-knob
+system, engine exception chain + bulk control)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import config
+from mxnet_tpu.runtime import engine
+
+
+def test_env_registry_typed_reads(monkeypatch):
+    assert config.get_env("MXNET_KVSTORE_SYNC_TIMEOUT") == 120.0
+    monkeypatch.setenv("MXNET_KVSTORE_SYNC_TIMEOUT", "7.5")
+    assert config.get_env("MXNET_KVSTORE_SYNC_TIMEOUT") == 7.5
+    monkeypatch.setenv("MXNET_EXEC_BULK_EXEC_TRAIN", "0")
+    assert config.get_env("MXNET_EXEC_BULK_EXEC_TRAIN") is False
+    monkeypatch.setenv("MXNET_EXEC_BULK_EXEC_TRAIN", "true")
+    assert config.get_env("MXNET_EXEC_BULK_EXEC_TRAIN") is True
+    monkeypatch.setenv("MXNET_KVSTORE_BIGARRAY_BOUND", "zzz")
+    with pytest.raises(ValueError, match="not a valid int"):
+        config.get_env("MXNET_KVSTORE_BIGARRAY_BOUND")
+
+
+def test_env_registry_describe_covers_all():
+    text = config.describe()
+    for name in config.list_env():
+        assert name in text
+    assert len(config.list_env()) >= 10
+
+
+def test_engine_exception_chain():
+    engine.clear_exceptions()
+    engine.record_exception(RuntimeError("async component died"))
+    with pytest.raises(RuntimeError, match="async component died"):
+        engine.wait_all()
+    engine.wait_all()  # chain drained; second sync is clean
+
+
+def test_engine_naive_env_selection(monkeypatch):
+    monkeypatch.setenv("MXNET_ENGINE_TYPE", "NaiveEngine")
+    assert engine.is_naive()
+    monkeypatch.setenv("MXNET_ENGINE_TYPE", "XLAAsync")
+    assert not engine.is_naive()
+    with engine.naive_mode():
+        assert engine.is_naive()
+    assert not engine.is_naive()
+
+
+def test_bulk_disabled_per_node_execution_matches(monkeypatch):
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=4, name="fcb")
+    net = mx.sym.Activation(net, act_type="relu")
+    x = np.random.RandomState(0).randn(3, 5).astype(np.float32)
+    rs = np.random.RandomState(1)
+    args = {"data": mx.nd.array(x)}
+    for name, shp in zip(net.list_arguments(),
+                         net.infer_shape(data=(3, 5))[0]):
+        if name != "data":
+            args[name] = mx.nd.array(rs.randn(*shp).astype(np.float32))
+    ex = net.bind(mx.cpu(), args)
+    ref = ex.forward()[0].asnumpy()
+    with engine.bulk(0):
+        got = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+    # env knob path
+    monkeypatch.setenv("MXNET_EXEC_BULK_EXEC_INFERENCE", "0")
+    got2 = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(got2, ref, rtol=1e-6)
+
+
+def test_bulk_context_restores_env_driven_state(monkeypatch):
+    monkeypatch.setenv("MXNET_EXEC_BULK_EXEC_INFERENCE", "0")
+    assert engine.bulk_enabled(False) is False
+    with engine.bulk(4):
+        assert engine.bulk_enabled(False) is True
+    # the scoped override must not shadow the env knob afterwards
+    assert engine.bulk_enabled(False) is False

@@ -1,0 +1,208 @@
+"""Subgraph framework tests (reference: tests/python/unittest/
+test_subgraph_op.py + src/operator/subgraph/partition_graph.cc)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.subgraph import (SubgraphSelector, SubgraphProperty,
+                                partition_graph, register_subgraph_property,
+                                list_subgraph_backends)
+
+
+def _count_ops(sym, op_name):
+    return sum(1 for n in sym._topo()
+               if not n.is_var and n.op.name == op_name)
+
+
+def _net():
+    data = mx.sym.var("data")
+    w = mx.sym.var("w")
+    fc = mx.sym.FullyConnected(data, w, num_hidden=8, no_bias=True,
+                               name="fc")
+    a = mx.sym.Activation(fc, act_type="relu")
+    b = a + 1.0
+    c = b * 2.0
+    out = mx.sym.FullyConnected(c, num_hidden=3, name="fc2")
+    return out
+
+
+def test_partition_fuses_elemwise_chain_and_preserves_outputs():
+    net = _net()
+    part = partition_graph(net, "MXTPU_FUSE")
+    # relu/+1/*2 collapse into one _subgraph_exec
+    assert _count_ops(part, "_subgraph_exec") == 1
+    assert _count_ops(part, "Activation") == 0
+    assert _count_ops(part, "_plus_scalar") == 0
+    # same arguments visible (partitioning must not change the API)
+    assert sorted(part.list_arguments()) == sorted(net.list_arguments())
+
+    x = np.random.RandomState(0).randn(4, 6).astype(np.float32)
+    args = {"data": mx.nd.array(x)}
+    rs = np.random.RandomState(1)
+    for name, shp in zip(net.list_arguments(),
+                         net.infer_shape(data=(4, 6))[0]):
+        if name != "data":
+            args[name] = mx.nd.array(rs.randn(*shp).astype(np.float32))
+    ref = net.bind(mx.cpu(), dict(args)).forward()[0].asnumpy()
+    got = part.bind(mx.cpu(), dict(args)).forward()[0].asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_partition_gradients_flow_through_subgraph():
+    import jax  # noqa: F401
+    net = _net()
+    part = partition_graph(net, "MXTPU_FUSE")
+    x = np.random.RandomState(0).randn(4, 6).astype(np.float32)
+    shapes = dict(zip(net.list_arguments(),
+                      net.infer_shape(data=(4, 6))[0]))
+    rs = np.random.RandomState(1)
+    vals = {n: (x if n == "data" else
+                rs.randn(*s).astype(np.float32))
+            for n, s in shapes.items()}
+
+    def run(sym):
+        args = {n: mx.nd.array(v) for n, v in vals.items()}
+        grads = {n: mx.nd.zeros(shapes[n]) for n in shapes}
+        ex = sym.bind(mx.cpu(), args, args_grad=grads)
+        y = ex.forward(is_train=True)[0]
+        ex.backward(mx.nd.ones(y.shape))
+        return {n: g.asnumpy() for n, g in ex.grad_dict.items()}
+
+    g_ref = run(net)
+    g_part = run(part)
+    for n in g_ref:
+        np.testing.assert_allclose(g_part[n], g_ref[n], rtol=1e-4,
+                                   atol=1e-5)
+
+
+def test_partition_respects_convexity():
+    # y = relu(x) ; z = FC(y) ; w = relu(y) + z  — the two relus must
+    # not merge into one component because FC (external) sits on the
+    # path relu1 -> z -> add
+    data = mx.sym.var("data")
+    y = mx.sym.Activation(data, act_type="relu", name="r1")
+    z = mx.sym.FullyConnected(y, num_hidden=4, no_bias=True, name="fcm")
+    w = mx.sym.Activation(y, act_type="relu", name="r2") + z
+    part = partition_graph(w, "MXTPU_FUSE")
+    x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+    args = {"data": mx.nd.array(x),
+            "fcm_weight": mx.nd.array(
+                np.random.RandomState(1).randn(4, 4).astype(np.float32))}
+    ref = w.bind(mx.cpu(), dict(args)).forward()[0].asnumpy()
+    got = part.bind(mx.cpu(), dict(args)).forward()[0].asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_env_var_backend_applies_at_bind(monkeypatch):
+    net = _net()
+    monkeypatch.setenv("MXNET_SUBGRAPH_BACKEND", "MXTPU_FUSE")
+    x = np.random.RandomState(0).randn(4, 6).astype(np.float32)
+    args = {"data": mx.nd.array(x)}
+    rs = np.random.RandomState(1)
+    for name, shp in zip(net.list_arguments(),
+                         net.infer_shape(data=(4, 6))[0]):
+        if name != "data":
+            args[name] = mx.nd.array(rs.randn(*shp).astype(np.float32))
+    got = net.bind(mx.cpu(), dict(args)).forward()[0].asnumpy()
+    monkeypatch.delenv("MXNET_SUBGRAPH_BACKEND")
+    ref = net.bind(mx.cpu(), dict(args)).forward()[0].asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_custom_property_rewrite_hook():
+    calls = []
+
+    class _Prop(SubgraphProperty):
+        def create_subgraph_selector(self):
+            class _S(SubgraphSelector):
+                def select(self, node):
+                    return (not node.is_var) and \
+                        node.op.name == "Activation"
+            return _S()
+
+        def rewrite_subgraph(self, sub, sid):
+            calls.append(len(sub._outputs))
+            return sub
+
+    register_subgraph_property("TEST_PROP", _Prop)
+    assert "TEST_PROP" in list_subgraph_backends()
+    data = mx.sym.var("data")
+    net = mx.sym.Activation(
+        mx.sym.Activation(data, act_type="relu"), act_type="tanh")
+    part = partition_graph(net, "TEST_PROP")
+    assert _count_ops(part, "_subgraph_exec") == 1
+    assert calls == [1]
+    x = np.random.RandomState(0).randn(3, 5).astype(np.float32)
+    ref = np.tanh(np.maximum(x, 0))
+    got = part.bind(mx.cpu(),
+                    {"data": mx.nd.array(x)}).forward()[0].asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_batchnorm_aux_nodes_not_absorbed():
+    class _All(SubgraphProperty):
+        def create_subgraph_selector(self):
+            class _S(SubgraphSelector):
+                def select(self, node):
+                    return True
+            return _S()
+
+    data = mx.sym.var("data")
+    bn = mx.sym.BatchNorm(data, name="bn")
+    out = mx.sym.Activation(bn, act_type="relu") + 1.0
+    part = partition_graph(out, _All())
+    # BatchNorm stays outside any subgraph (aux states)
+    assert _count_ops(part, "BatchNorm") == 1
+
+
+def test_partition_no_duplicate_computation_across_components():
+    # two components where the later-finalized one feeds the earlier:
+    # Group([relu(relu(x)->FC->add->relu), sigmoid(relu(x))]) — the
+    # shared relu chain must appear exactly once in the rewritten graph
+    data = mx.sym.var("data")
+    n1 = mx.sym.Activation(data, act_type="relu", name="n1")
+    fc = mx.sym.FullyConnected(n1, num_hidden=4, no_bias=True, name="fc")
+    a = mx.sym.Activation(fc + 1.0, act_type="relu", name="n2")
+    b = mx.sym.Activation(n1, act_type="sigmoid", name="n3")
+    g = mx.sym.Group([a, b])
+    part = partition_graph(g, "MXTPU_FUSE")
+    # n1 must not survive as a standalone top-level op AND inside a
+    # subgraph clone (it would run twice)
+    top_ops = [n.op.name for n in part._topo() if not n.is_var]
+    n_exec = top_ops.count("_subgraph_exec")
+    assert top_ops.count("Activation") == 0, top_ops
+    x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+    args = {"data": mx.nd.array(x),
+            "fc_weight": mx.nd.array(
+                np.random.RandomState(1).randn(4, 4).astype(np.float32))}
+    ref0, ref1 = [o.asnumpy() for o in g.bind(mx.cpu(),
+                                              dict(args)).forward()]
+    got0, got1 = [o.asnumpy() for o in part.bind(mx.cpu(),
+                                                 dict(args)).forward()]
+    np.testing.assert_allclose(got0, ref0, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(got1, ref1, rtol=1e-5, atol=1e-6)
+    assert n_exec >= 1
+
+
+def test_select_input_vetoes_growth():
+    class _Prop(SubgraphProperty):
+        def create_subgraph_selector(self):
+            class _S(SubgraphSelector):
+                def select(self, node):
+                    return (not node.is_var) and \
+                        node.op.name == "Activation"
+
+                def select_input(self, node, input_node):
+                    return False  # never grow toward producers
+            return _S()
+
+    data = mx.sym.var("data")
+    net = mx.sym.Activation(
+        mx.sym.Activation(data, act_type="relu"), act_type="tanh")
+    part = partition_graph(net, _Prop())
+    # with producer growth vetoed, no >=2-node component forms
+    assert _count_ops(part, "_subgraph_exec") == 0
+    assert _count_ops(part, "Activation") == 2
