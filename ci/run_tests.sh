@@ -8,9 +8,10 @@ cd "$(dirname "$0")/.."
 echo "== sanity: byte-compile =="
 python -m compileall -q mxnet_tpu tools examples
 
-echo "== native: C predict ABI =="
+echo "== native: C predict ABI + RecordIO reader =="
 if command -v g++ >/dev/null; then
     make -C src/capi
+    make -C src/io
 else
     echo "g++ not found — skipping native build"
 fi

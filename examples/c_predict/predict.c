@@ -1,0 +1,90 @@
+/*
+ * Standalone C consumer of the predict ABI (reference:
+ * example/image-classification/predict-cpp — a C++ program driving
+ * c_predict_api.h).  Demonstrates that a non-Python host can load
+ * libmxtpu_predict.so and run inference.
+ *
+ * Build + run (after `make -C src/capi`):
+ *   gcc -o predict predict.c -I../../include -L../../build \
+ *       -lmxtpu_predict -Wl,-rpath,../../build
+ *   ./predict model-symbol.json model-0000.params 1,3,8,8
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include <mxtpu/c_predict_api.h>
+
+static char* read_file(const char* path, long* size) {
+  FILE* f = fopen(path, "rb");
+  if (!f) { fprintf(stderr, "cannot open %s\n", path); exit(1); }
+  fseek(f, 0, SEEK_END);
+  *size = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  char* buf = (char*)malloc(*size + 1);
+  if (fread(buf, 1, *size, f) != (size_t)*size) { exit(1); }
+  buf[*size] = 0;
+  fclose(f);
+  return buf;
+}
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    fprintf(stderr,
+            "usage: %s symbol.json params.file N,C,H,W\n", argv[0]);
+    return 2;
+  }
+  long sym_size, param_size;
+  char* sym_json = read_file(argv[1], &sym_size);
+  char* params = read_file(argv[2], &param_size);
+
+  mx_uint shape[8];
+  mx_uint ndim = 0;
+  char* tok = strtok(argv[3], ",");
+  while (tok && ndim < 8) { shape[ndim++] = (mx_uint)atoi(tok);
+                            tok = strtok(NULL, ","); }
+  mx_uint indptr[2] = {0, ndim};
+  const char* keys[1] = {"data"};
+
+  PredictorHandle h = NULL;
+  if (MXPredCreate(sym_json, params, (int)param_size, 1, 0, 1, keys,
+                   indptr, shape, &h) != 0) {
+    fprintf(stderr, "MXPredCreate: %s\n", MXGetLastError());
+    return 1;
+  }
+
+  mx_uint n = 1;
+  for (mx_uint i = 0; i < ndim; ++i) n *= shape[i];
+  mx_float* input = (mx_float*)calloc(n, sizeof(mx_float));
+  for (mx_uint i = 0; i < n; ++i) input[i] = (mx_float)(i % 7) * 0.1f;
+  if (MXPredSetInput(h, "data", input, n) != 0 ||
+      MXPredForward(h) != 0) {
+    fprintf(stderr, "forward: %s\n", MXGetLastError());
+    return 1;
+  }
+
+  mx_uint* oshape;
+  mx_uint ondim;
+  if (MXPredGetOutputShape(h, 0, &oshape, &ondim) != 0) {
+    fprintf(stderr, "shape: %s\n", MXGetLastError());
+    return 1;
+  }
+  mx_uint osize = 1;
+  printf("output shape: ");
+  for (mx_uint i = 0; i < ondim; ++i) {
+    printf("%u ", oshape[i]);
+    osize *= oshape[i];
+  }
+  printf("\n");
+  mx_float* out = (mx_float*)malloc(osize * sizeof(mx_float));
+  if (MXPredGetOutput(h, 0, out, osize) != 0) {
+    fprintf(stderr, "output: %s\n", MXGetLastError());
+    return 1;
+  }
+  printf("output[0..4]:");
+  for (mx_uint i = 0; i < osize && i < 5; ++i) printf(" %f", out[i]);
+  printf("\nC-PREDICT-OK\n");
+  MXPredFree(h);
+  free(out); free(input); free(sym_json); free(params);
+  return 0;
+}

@@ -100,6 +100,9 @@ register_env("MXNET_CPU_WORKER_NTHREADS", int, 0,
              "Host-side worker threads for the data pipeline; 0 = "
              "library default (reference: "
              "threaded_engine_perdevice.cc:79)")
+register_env("MXNET_USE_NATIVE_RECORDIO", bool, True,
+             "Read .rec files through the native C++ reader "
+             "(src/io/recordio_reader.cc) when built; off = pure Python")
 register_env("MXNET_ENGINE_INFO", bool, False,
              "Verbose engine scheduling debug output "
              "(reference: threaded_engine.h:302)")

@@ -39,16 +39,31 @@ class MXRecordIO:
         self.open()
 
     def open(self):
+        self._native = None
         if self.flag == "w":
             self.fid = open(self.uri, "wb")
             self.writable = True
         elif self.flag == "r":
-            self.fid = open(self.uri, "rb")
+            # read path goes through the native C++ reader when built
+            # (src/io/recordio_reader.cc — the reference reads records
+            # natively too, iter_image_recordio_2.cc); gated by
+            # MXNET_USE_NATIVE_RECORDIO
+            from .config import get_env
+            from . import recordio_native
+            if get_env("MXNET_USE_NATIVE_RECORDIO") and \
+                    recordio_native.available():
+                self._native = recordio_native.NativeRecordReader(self.uri)
+                self.fid = None
+            else:
+                self.fid = open(self.uri, "rb")
             self.writable = False
         else:
             raise ValueError("Invalid flag %s" % self.flag)
 
     def close(self):
+        if self._native is not None:
+            self._native.close()
+            self._native = None
         if self.fid is not None:
             self.fid.close()
             self.fid = None
@@ -67,6 +82,8 @@ class MXRecordIO:
         self.close()
 
     def tell(self):
+        if self._native is not None:
+            return self._native.tell()
         return self.fid.tell()
 
     def write(self, buf):
@@ -79,6 +96,8 @@ class MXRecordIO:
 
     def read(self):
         assert not self.writable
+        if self._native is not None:
+            return self._native.read()
         header = self.fid.read(8)
         if len(header) < 8:
             return None
@@ -126,6 +145,9 @@ class MXIndexedRecordIO(MXRecordIO):
 
     def seek(self, idx):
         assert not self.writable
+        if self._native is not None:
+            self._native.seek(self.idx[idx])
+            return
         self.fid.seek(self.idx[idx])
 
     def read_idx(self, idx):

@@ -1,0 +1,161 @@
+// Native RecordIO reader — the C++ half of the data pipeline
+// (reference: src/io/iter_image_recordio_2.cc chunk reading +
+// src/io/image_recordio.h framing; dmlc-core recordio streams).
+//
+// Framing (identical to mxnet_tpu/recordio.py and the reference):
+//   [magic u32][lrecord u32][data][pad to 4B]
+//   lrecord = cflag(3 bits) << 29 | length(29 bits)
+// Multi-part records (cflag 1=begin, 2=middle, 3=end) are reassembled.
+//
+// Pure C ABI, no Python dependency: the Python side drives it via
+// ctypes (mxnet_tpu/recordio_native.py) and keeps the cv2 decode pool;
+// this layer does file IO, framing, and index lookup natively — the
+// part the reference implements in C++ too.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#define RIO_API extern "C" __attribute__((visibility("default")))
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+
+struct Reader {
+  FILE* f = nullptr;
+  long file_size = 0;
+  std::vector<uint8_t> buf;     // last record (reassembled)
+  std::string err;
+};
+
+thread_local std::string g_err;
+
+bool read_u32(FILE* f, uint32_t* out) {
+  return std::fread(out, sizeof(uint32_t), 1, f) == 1;
+}
+
+// Read one framed record part; returns: 1 ok, 0 eof, -1 error.
+int read_part(Reader* r, uint32_t* cflag, std::vector<uint8_t>* data) {
+  FILE* f = r->f;
+  uint32_t magic;
+  if (!read_u32(f, &magic)) return 0;  // clean EOF
+  if (magic != kMagic) {
+    g_err = "bad magic — corrupt or not a RecordIO file";
+    return -1;
+  }
+  uint32_t lrec;
+  if (!read_u32(f, &lrec)) {
+    g_err = "truncated record header";
+    return -1;
+  }
+  *cflag = lrec >> 29;
+  uint32_t len = lrec & ((1u << 29) - 1);
+  // validate against remaining bytes BEFORE allocating: a corrupt
+  // length field must not trigger a ~512MB resize (bad_alloc crossing
+  // the C ABI would be UB)
+  long pos = std::ftell(f);
+  if (pos < 0 || static_cast<long>(len) > r->file_size - pos) {
+    g_err = "record length exceeds file size — corrupt file";
+    return -1;
+  }
+  size_t off = data->size();
+  data->resize(off + len);
+  if (len && std::fread(data->data() + off, 1, len, f) != len) {
+    g_err = "truncated record payload";
+    return -1;
+  }
+  uint32_t pad = (4 - (len & 3)) & 3;
+  if (pad) std::fseek(f, pad, SEEK_CUR);
+  return 1;
+}
+
+}  // namespace
+
+RIO_API const char* RIOGetLastError() { return g_err.c_str(); }
+
+RIO_API void* RIOOpen(const char* path) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) {
+    g_err = std::string("cannot open ") + path;
+    return nullptr;
+  }
+  Reader* r = new Reader();
+  r->f = f;
+  std::fseek(f, 0, SEEK_END);
+  r->file_size = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  return r;
+}
+
+RIO_API void RIOClose(void* handle) {
+  Reader* r = static_cast<Reader*>(handle);
+  if (r->f) std::fclose(r->f);
+  delete r;
+}
+
+RIO_API void RIOReset(void* handle) {
+  Reader* r = static_cast<Reader*>(handle);
+  std::fseek(r->f, 0, SEEK_SET);
+}
+
+RIO_API int RIOSeek(void* handle, long offset) {
+  Reader* r = static_cast<Reader*>(handle);
+  return std::fseek(r->f, offset, SEEK_SET) == 0 ? 0 : -1;
+}
+
+RIO_API long RIOTell(void* handle) {
+  Reader* r = static_cast<Reader*>(handle);
+  return std::ftell(r->f);
+}
+
+// Read the next logical record (reassembling multi-part records).
+// Returns 1 with *data/*size set (valid until the next call), 0 at EOF,
+// -1 on error.
+RIO_API int RIONext(void* handle, const uint8_t** data, uint64_t* size) {
+  Reader* r = static_cast<Reader*>(handle);
+  r->buf.clear();
+  uint32_t cflag = 0;
+  int rc = read_part(r, &cflag, &r->buf);
+  if (rc <= 0) return rc;
+  if (cflag == 1) {  // multi-part: keep reading until the end part
+    while (true) {
+      rc = read_part(r, &cflag, &r->buf);
+      if (rc < 0) return -1;  // keep read_part's specific error
+      if (rc == 0) {
+        g_err = "EOF inside a multi-part record";
+        return -1;
+      }
+      if (cflag == 3) break;
+      if (cflag != 2) {
+        g_err = "unexpected cflag inside multi-part record";
+        return -1;
+      }
+    }
+  }
+  *data = r->buf.data();
+  *size = r->buf.size();
+  return 1;
+}
+
+// Scan forward FROM THE CURRENT POSITION, appending record start
+// offsets (for building the .idx the reference's im2rec produces).
+// Returns the count written (< max_n means EOF reached), or -1 on
+// error.  Callers reset first (RIOReset) and may call repeatedly with a
+// bounded buffer to index arbitrarily large files.
+RIO_API long RIOBuildIndex(void* handle, uint64_t* offsets, long max_n) {
+  Reader* r = static_cast<Reader*>(handle);
+  long n = 0;
+  while (n < max_n) {
+    long pos = std::ftell(r->f);
+    const uint8_t* d;
+    uint64_t sz;
+    int rc = RIONext(r, &d, &sz);
+    if (rc == 0) break;
+    if (rc < 0) return -1;
+    offsets[n++] = static_cast<uint64_t>(pos);
+  }
+  return n;
+}

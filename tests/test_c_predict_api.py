@@ -4,6 +4,7 @@ Python-side executor (reference surface: include/mxnet/c_predict_api.h)."""
 
 import ctypes
 import os
+import shutil
 import subprocess
 import sys
 import textwrap
@@ -92,8 +93,7 @@ _DRIVER = textwrap.dedent("""
 """)
 
 
-@pytest.mark.skipif(not os.path.exists("/usr/bin/g++") and
-                    not os.path.exists("/usr/local/bin/g++"),
+@pytest.mark.skipif(shutil.which("g++") is None,
                     reason="no C++ toolchain")
 def test_c_predict_roundtrip(tmp_path):
     import mxnet_tpu as mx
@@ -144,3 +144,45 @@ def test_c_predict_roundtrip(tmp_path):
     np.testing.assert_allclose(
         np.array(got["out"], np.float32).reshape(expect.shape), expect,
         rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.skipif(shutil.which("gcc") is None,
+                    reason="no C toolchain")
+def test_pure_c_consumer_binary(tmp_path):
+    """Compile examples/c_predict/predict.c and run it as a real
+    non-Python host against a checkpoint (L10: other-language consumers
+    via the C ABI)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import symbol as sym
+
+    _build_lib()
+    data = sym.var("data")
+    net = sym.FullyConnected(data, num_hidden=4, name="cfc")
+    net = sym.softmax(net)
+    rs = np.random.RandomState(3)
+    args = {}
+    for name, shp in zip(net.list_arguments(),
+                         net.infer_shape(data=(1, 6))[0]):
+        if name != "data":
+            args[name] = mx.nd.array(rs.randn(*shp).astype(np.float32))
+    with open(os.path.join(str(tmp_path), "m-symbol.json"), "w") as f:
+        f.write(net.tojson())
+    mx.nd.save(os.path.join(str(tmp_path), "m-0000.params"),
+               {"arg:%s" % k: v for k, v in args.items()})
+
+    binary = os.path.join(str(tmp_path), "predict")
+    src = os.path.join(REPO, "examples", "c_predict", "predict.c")
+    subprocess.run(
+        ["gcc", "-o", binary, src, "-I", os.path.join(REPO, "include"),
+         "-L", os.path.join(REPO, "build"), "-lmxtpu_predict",
+         "-Wl,-rpath," + os.path.join(REPO, "build")],
+        check=True, capture_output=True)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [binary, os.path.join(str(tmp_path), "m-symbol.json"),
+         os.path.join(str(tmp_path), "m-0000.params"), "1,6"],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "C-PREDICT-OK" in proc.stdout

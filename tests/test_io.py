@@ -266,3 +266,117 @@ def test_dataloader_unpicklable_falls_back_to_threads():
         out = list(DataLoader(ds, batch_size=3, num_workers=2))
     assert len(out) == 4
     np.testing.assert_allclose(out[0].asnumpy(), [1, 2, 3], rtol=1e-6)
+
+
+# --- native C++ RecordIO reader (src/io/recordio_reader.cc) ---------------
+
+def _native_built():
+    from mxnet_tpu import recordio_native
+    if not recordio_native.available():
+        import subprocess as sp
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        try:
+            sp.run(["make", "-C", os.path.join(repo, "src", "io")],
+                   check=True, capture_output=True)
+        except Exception:
+            return False
+        recordio_native._LIB = None
+    return recordio_native.available()
+
+
+def test_native_recordio_roundtrip(tmp_path):
+    from mxnet_tpu import recordio, recordio_native
+    if not _native_built():
+        pytest.skip("no C++ toolchain")
+    p = str(tmp_path / "t.rec")
+    recs = [b"hello", b"x" * 7, b"", b"payload" * 1000]
+    w = recordio.MXRecordIO(p, "w")
+    for r in recs:
+        w.write(r)
+    w.close()
+    r = recordio_native.NativeRecordReader(p)
+    got = []
+    while True:
+        b = r.read()
+        if b is None:
+            break
+        got.append(b)
+    assert got == recs
+    offs = recordio_native.build_index(p)
+    assert len(offs) == len(recs)
+    assert r.read_idx(offs[2]) == recs[2]
+    r.close()
+
+
+def test_native_recordio_multipart_reassembly(tmp_path):
+    # hand-craft a multi-part record (cflag 1/2/3 framing) — the python
+    # writer never emits these but the reference reader handles them
+    import struct
+    if not _native_built():
+        pytest.skip("no C++ toolchain")
+    from mxnet_tpu import recordio_native
+    p = str(tmp_path / "mp.rec")
+    magic = 0xced7230a
+    parts = [(1, b"abcd"), (2, b"efgh"), (3, b"ij")]
+    with open(p, "wb") as f:
+        for cflag, data in parts:
+            f.write(struct.pack("<II", magic, (cflag << 29) | len(data)))
+            f.write(data)
+            pad = (4 - len(data) % 4) % 4
+            f.write(b"\x00" * pad)
+    r = recordio_native.NativeRecordReader(p)
+    assert r.read() == b"abcdefghij"
+    assert r.read() is None
+    r.close()
+
+
+def test_mxrecordio_uses_native_reader(tmp_path, monkeypatch):
+    from mxnet_tpu import recordio
+    if not _native_built():
+        pytest.skip("no C++ toolchain")
+    p = str(tmp_path / "n.rec")
+    w = recordio.MXRecordIO(p, "w")
+    w.write(b"one")
+    w.write(b"two")
+    w.close()
+    r = recordio.MXRecordIO(p, "r")
+    assert r._native is not None  # native path active by default
+    assert r.read() == b"one" and r.read() == b"two"
+    r.close()
+    monkeypatch.setenv("MXNET_USE_NATIVE_RECORDIO", "0")
+    r = recordio.MXRecordIO(p, "r")
+    assert r._native is None
+    assert r.read() == b"one"
+    r.close()
+
+
+def test_native_recordio_closed_handle_raises(tmp_path):
+    from mxnet_tpu import recordio, recordio_native
+    if not _native_built():
+        pytest.skip("no C++ toolchain")
+    p = str(tmp_path / "c.rec")
+    w = recordio.MXRecordIO(p, "w")
+    w.write(b"x")
+    w.close()
+    r = recordio_native.NativeRecordReader(p)
+    r.close()
+    with pytest.raises(IOError, match="closed"):
+        r.read()
+    with pytest.raises(IOError, match="closed"):
+        r.tell()
+
+
+def test_native_recordio_corrupt_length_rejected(tmp_path):
+    import struct
+    from mxnet_tpu import recordio_native
+    if not _native_built():
+        pytest.skip("no C++ toolchain")
+    p = str(tmp_path / "bad.rec")
+    with open(p, "wb") as f:
+        # header claims a ~512MB record in a 16-byte file
+        f.write(struct.pack("<II", 0xced7230a, (1 << 29) - 1))
+        f.write(b"tiny")
+    r = recordio_native.NativeRecordReader(p)
+    with pytest.raises(IOError, match="exceeds file size"):
+        r.read()
+    r.close()
