@@ -79,11 +79,30 @@ def sequence_parallel_attention(q, k, v, mesh, axis="sp", causal=False,
                                 sm_scale=None):
     """Host-level ring attention: (B, H, S, D) arrays sharded (or to be
     sharded) on the sequence dim over mesh axis *axis*."""
+    from jax.sharding import NamedSharding
     spec = P(None, None, axis, None)
+    sh = NamedSharding(mesh, spec)
+    # inputs may be committed to a single device (e.g. outputs of an
+    # earlier jitted op) — place them onto the mesh first; remember the
+    # original placement so imperative callers get the result back where
+    # the rest of their ops run (inside pjit this wrapper isn't used —
+    # ring_attention_shard composes directly)
+    orig_dev = None
+    if not isinstance(q, jax.core.Tracer) and hasattr(q, "devices"):
+        try:
+            devs = list(q.devices())
+        except Exception:  # abstract/uncommitted values have no devices
+            devs = []
+        if len(devs) == 1:
+            orig_dev = devs[0]
+    q, k, v = (jax.device_put(a, sh) for a in (q, k, v))
 
     def fn(qs, ks, vs):
         return ring_attention_shard(qs, ks, vs, axis, causal=causal,
                                     sm_scale=sm_scale)
 
-    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec, check_rep=False)(q, k, v)
+    out = shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                    out_specs=spec, check_rep=False)(q, k, v)
+    if orig_dev is not None:
+        out = jax.device_put(out, orig_dev)
+    return out

@@ -13,10 +13,11 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run(cmd, timeout=300):
+def _run(cmd, timeout=300, extra_env=None):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)  # examples don't need the 8-device mesh
+    env.update(extra_env or {})
     return subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
                           text=True, timeout=timeout)
 
@@ -97,3 +98,32 @@ def test_module_kvstore_none_still_trains():
     mod.forward_backward(batch)
     mod.update()
     assert not np.allclose(w0, mod.get_params()[0]["fc_weight"].asnumpy())
+
+
+def test_train_ssd_converges():
+    """The SSD BASELINE config end to end (MultiBox ops + decode)."""
+    r = _run([sys.executable, "examples/train_ssd.py",
+              "--num-epochs", "4", "--num-examples", "96",
+              "--batch-size", "16"], timeout=600)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert "detections:" in r.stdout
+
+
+def test_train_transformer_lm_converges():
+    """Long-context stance (§5.7): attention-backed LM learns the
+    copy task offline."""
+    r = _run([sys.executable, "examples/train_transformer_lm.py",
+              "--num-steps", "120"], timeout=600)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert "TRANSFORMER-LM-OK" in r.stdout
+
+
+def test_train_transformer_lm_sequence_parallel():
+    """Same model with ring attention over the 8-device sp mesh."""
+    r = _run([sys.executable, "examples/train_transformer_lm.py",
+              "--num-steps", "60", "--sequence-parallel"],
+             timeout=900,
+             extra_env={"XLA_FLAGS":
+                        "--xla_force_host_platform_device_count=8"})
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert "TRANSFORMER-LM-OK" in r.stdout
