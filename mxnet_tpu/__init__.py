@@ -62,6 +62,7 @@ from . import visualization  # noqa: F401
 viz = visualization  # reference alias: mx.viz
 from . import subgraph  # noqa: F401
 from . import config  # noqa: F401
+from . import rtc  # noqa: F401
 from .runtime import engine  # noqa: F401
 
 

@@ -743,3 +743,125 @@ def test_histogram():
     cnt, edges = nd.histogram(nd.array(x), bin_cnt=2, range=(0.0, 1.0))
     np.testing.assert_allclose(cnt.asnumpy(), [3, 2])
     np.testing.assert_allclose(edges.asnumpy(), [0, 0.5, 1.0])
+
+
+# ---------------------------------------------------------------------------
+# comparisons / hypot / histogram / eye / arange
+# ---------------------------------------------------------------------------
+
+CMP = [("_equal", np.equal), ("_not_equal", np.not_equal),
+       ("_greater", np.greater), ("_greater_equal", np.greater_equal),
+       ("_lesser", np.less), ("_lesser_equal", np.less_equal)]
+
+
+@pytest.mark.parametrize("op,np_fn", CMP)
+def test_comparison_ops(op, np_fn):
+    rng = RS(0)
+    a = rng.randint(-2, 3, (4, 5)).astype(np.float32)
+    b = rng.randint(-2, 3, (4, 5)).astype(np.float32)
+    out = getattr(nd, op)(nd.array(a), nd.array(b))
+    np.testing.assert_allclose(out.asnumpy(),
+                               np_fn(a, b).astype(np.float32))
+    # scalar variants
+    outs = getattr(nd, op + "_scalar")(nd.array(a), scalar=0.0)
+    np.testing.assert_allclose(outs.asnumpy(),
+                               np_fn(a, 0.0).astype(np.float32))
+
+
+def test_hypot_histogram_eye_arange():
+    rng = RS(0)
+    a = np.abs(rng.randn(3, 4)).astype(np.float32)
+    b = np.abs(rng.randn(3, 4)).astype(np.float32)
+    np.testing.assert_allclose(
+        nd._hypot(nd.array(a), nd.array(b)).asnumpy(),
+        np.hypot(a, b), rtol=1e-5)
+    data = rng.rand(100).astype(np.float32) * 10
+    cnt = nd._histogram(nd.array(data), bin_cnt=5, range=(0, 10))
+    if isinstance(cnt, (list, tuple)):
+        cnt = cnt[0]
+    ref, _ = np.histogram(data, bins=5, range=(0, 10))
+    np.testing.assert_allclose(cnt.asnumpy(), ref)
+    np.testing.assert_allclose(nd._eye(N=3, M=4, k=1).asnumpy(),
+                               np.eye(3, 4, 1, dtype=np.float32))
+    np.testing.assert_allclose(
+        nd._arange(start=2, stop=10, step=2).asnumpy(),
+        np.arange(2, 10, 2, dtype=np.float32))
+
+
+# ---------------------------------------------------------------------------
+# regression output heads + MakeLoss/BlockGrad semantics through the
+# executor (reference: test_operator.py test_regression)
+# ---------------------------------------------------------------------------
+
+def _head_grad(head_op, pred_np, label_np, **params):
+    pred = mx.sym.var("pred")
+    label = mx.sym.var("label")
+    out = getattr(mx.sym, head_op)(pred, label, **params)
+    args = {"pred": nd.array(pred_np), "label": nd.array(label_np)}
+    grads = {"pred": nd.zeros(pred_np.shape)}
+    ex = out.bind(mx.cpu(), args, args_grad=grads)
+    fwd = ex.forward(is_train=True)[0].asnumpy()
+    ex.backward(nd.ones(fwd.shape))
+    return fwd, ex.grad_dict["pred"].asnumpy()
+
+
+def test_regression_output_heads():
+    rng = RS(0)
+    pred = rng.randn(4, 3).astype(np.float32)
+    label = rng.randn(4, 3).astype(np.float32)
+    # Linear: out = pred, grad = pred - label (grad_scale=1)
+    fwd, g = _head_grad("LinearRegressionOutput", pred, label)
+    np.testing.assert_allclose(fwd, pred, rtol=1e-6)
+    np.testing.assert_allclose(g, pred - label, rtol=1e-5, atol=1e-6)
+    # MAE: grad = sign(pred - label)
+    fwd, g = _head_grad("MAERegressionOutput", pred, label)
+    np.testing.assert_allclose(g, np.sign(pred - label), rtol=1e-5)
+    # Logistic: out = sigmoid(pred); grad = sigmoid(pred) - label
+    fwd, g = _head_grad("LogisticRegressionOutput", pred, label)
+    np.testing.assert_allclose(fwd, _np_sigmoid(pred), rtol=1e-5)
+    np.testing.assert_allclose(g, _np_sigmoid(pred) - label,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_makeloss_and_blockgrad():
+    x_np = np.array([[1.0, -2.0], [3.0, 0.5]], np.float32)
+    x = mx.sym.var("x")
+    loss = mx.sym.MakeLoss(mx.sym.square(x))
+    args = {"x": nd.array(x_np)}
+    grads = {"x": nd.zeros(x_np.shape)}
+    ex = loss.bind(mx.cpu(), args, args_grad=grads)
+    ex.forward(is_train=True)
+    ex.backward()
+    np.testing.assert_allclose(ex.grad_dict["x"].asnumpy(), 2 * x_np,
+                               rtol=1e-5)
+    # BlockGrad: forward identity, zero gradient upstream
+    blocked = mx.sym.sum(mx.sym.square(mx.sym.BlockGrad(x)))
+    grads = {"x": nd.zeros(x_np.shape)}
+    ex = blocked.bind(mx.cpu(), {"x": nd.array(x_np)}, args_grad=grads)
+    ex.forward(is_train=True)
+    ex.backward(nd.ones(()))
+    np.testing.assert_allclose(ex.grad_dict["x"].asnumpy(),
+                               np.zeros_like(x_np))
+
+
+# ---------------------------------------------------------------------------
+# Cast / SwapAxis / SliceChannel / ElementWiseSum / Concat basics
+# ---------------------------------------------------------------------------
+
+def test_structural_op_basics():
+    rng = RS(0)
+    a = rng.randn(2, 3, 4).astype(np.float32)
+    assert nd.Cast(nd.array(a), dtype="int32").asnumpy().dtype == np.int32
+    np.testing.assert_allclose(
+        nd.SwapAxis(nd.array(a), dim1=0, dim2=2).asnumpy(),
+        np.swapaxes(a, 0, 2))
+    parts = nd.SliceChannel(nd.array(a), num_outputs=3, axis=1)
+    assert len(parts) == 3
+    np.testing.assert_allclose(parts[1].asnumpy(), a[:, 1:2])
+    parts_sq = nd.SliceChannel(nd.array(a), num_outputs=3, axis=1,
+                               squeeze_axis=True)
+    np.testing.assert_allclose(parts_sq[2].asnumpy(), a[:, 2])
+    s = nd.ElementWiseSum(nd.array(a), nd.array(a), nd.array(a))
+    np.testing.assert_allclose(s.asnumpy(), 3 * a, rtol=1e-6)
+    c = nd.Concat(nd.array(a), nd.array(a), dim=2)
+    np.testing.assert_allclose(c.asnumpy(), np.concatenate([a, a], 2))
