@@ -9,10 +9,12 @@ mesh (``mxnet_tpu.parallel.sequence``).  This module provides:
 
 - ``_chunked_attention``: lax.scan blockwise attention with online softmax —
   O(S * chunk) activation memory, differentiable through the scan, runs on
-  every backend.  This is also the recompute path for the flash backward.
-- ``flash_attention``: Pallas TPU forward kernel (MXU-tiled, VMEM-resident
-  blocks, online softmax in f32 scratch) with a custom VJP whose backward
-  recomputes via the chunked path.
+  every backend (the non-TPU dispatch target).
+- ``flash_attention``: Pallas TPU kernels — MXU-tiled forward with online
+  softmax in f32 scratch (saving the per-row logsumexp), and a custom VJP
+  running the standard flash backward as two Pallas kernels
+  (``_flash_bwd_dkdv_kernel`` / ``_flash_bwd_dq_kernel``) that recompute
+  p from the saved logsumexp and accumulate blockwise.
 - ``_contrib_DotProductAttention`` / ``_contrib_div_sqrt_dim`` registered
   operators, so the op is reachable from mx.nd / mx.sym like any other.
 
@@ -120,9 +122,13 @@ def _chunked_attention(q, k, v, causal=False, sm_scale=None, chunk=512):
 # Pallas flash forward kernel.
 # ---------------------------------------------------------------------------
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref,
-                      acc_ref, m_ref, l_ref, *, sm_scale, causal,
-                      blk_q, blk_k, seq_q, seq_k):
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_and_scratch,
+                      sm_scale, causal, blk_q, blk_k, seq_q, seq_k):
+    if len(maybe_lse_and_scratch) == 4:
+        lse_ref, acc_ref, m_ref, l_ref = maybe_lse_and_scratch
+    else:  # inference path: no logsumexp output allocated
+        lse_ref = None
+        acc_ref, m_ref, l_ref = maybe_lse_and_scratch
     ik = pl.program_id(2)
     nk = pl.num_programs(2)
     iq = pl.program_id(1)
@@ -172,11 +178,22 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref,
     @pl.when(ik == nk - 1)
     def _finish():
         o_ref[0] = (acc_ref[...] / l_ref[...][:, None]).astype(o_ref.dtype)
+        if lse_ref is not None:
+            # logsumexp residual for the flash backward
+            lse_ref[0] = m_ref[...] + jnp.log(l_ref[...])
+
+
+def _pad_bh(x, s_pad, d_pad):
+    b, h, s, d = x.shape
+    xp = jnp.pad(x, ((0, 0), (0, 0), (0, s_pad), (0, d_pad)))
+    return xp.reshape(b * h, s + s_pad, d + d_pad)
 
 
 def _flash_fwd_pallas(q, k, v, causal, sm_scale, blk_q=1024, blk_k=1024,
-                      interpret=False):
-    """Flash forward: grid (B*H, nq, nk); f32 accumulators in VMEM scratch."""
+                      interpret=False, with_lse=False):
+    """Flash forward: grid (B*H, nq, nk); f32 accumulators in VMEM
+    scratch.  ``with_lse`` also returns the per-row logsumexp residual
+    (the flash backward's recompute anchor)."""
     b, h, sq, d = q.shape
     sk = k.shape[2]
     blk_q = min(blk_q, sq)
@@ -185,21 +202,26 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, blk_q=1024, blk_k=1024,
     d_pad = -d % 128
     sq_pad = -sq % blk_q
     sk_pad = -sk % blk_k
-    qp = jnp.pad(q, ((0, 0), (0, 0), (0, sq_pad), (0, d_pad)))
-    kp = jnp.pad(k, ((0, 0), (0, 0), (0, sk_pad), (0, d_pad)))
-    vp = jnp.pad(v, ((0, 0), (0, 0), (0, sk_pad), (0, d_pad)))
+    qp = _pad_bh(q, sq_pad, d_pad)
+    kp = _pad_bh(k, sk_pad, d_pad)
+    vp = _pad_bh(v, sk_pad, d_pad)
     bh = b * h
     dp = d + d_pad
-    qp = qp.reshape(bh, sq + sq_pad, dp)
-    kp = kp.reshape(bh, sk + sk_pad, dp)
-    vp = vp.reshape(bh, sk + sk_pad, dp)
     nq = (sq + sq_pad) // blk_q
     nk = (sk + sk_pad) // blk_k
 
     kernel = functools.partial(
         _flash_fwd_kernel, sm_scale=sm_scale, causal=causal,
         blk_q=blk_q, blk_k=blk_k, seq_q=sq, seq_k=sk)
-    out = pl.pallas_call(
+    out_specs = [pl.BlockSpec((1, blk_q, dp),
+                              lambda bh_, iq, ik: (bh_, iq, 0))]
+    out_shape = [jax.ShapeDtypeStruct((bh, sq + sq_pad, dp), q.dtype)]
+    if with_lse:  # training: also emit the logsumexp residual
+        out_specs.append(pl.BlockSpec((1, blk_q),
+                                      lambda bh_, iq, ik: (bh_, iq)))
+        out_shape.append(jax.ShapeDtypeStruct((bh, sq + sq_pad),
+                                              jnp.float32))
+    res = pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
         in_specs=[
@@ -207,9 +229,8 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, blk_q=1024, blk_k=1024,
             pl.BlockSpec((1, blk_k, dp), lambda bh_, iq, ik: (bh_, ik, 0)),
             pl.BlockSpec((1, blk_k, dp), lambda bh_, iq, ik: (bh_, ik, 0)),
         ],
-        out_specs=pl.BlockSpec((1, blk_q, dp),
-                               lambda bh_, iq, ik: (bh_, iq, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, sq + sq_pad, dp), q.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((blk_q, dp), jnp.float32),
             pltpu.VMEM((blk_q,), jnp.float32),
@@ -219,7 +240,179 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, blk_q=1024, blk_k=1024,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qp, kp, vp)
-    return out.reshape(b, h, sq + sq_pad, dp)[:, :, :sq, :d]
+    out = res[0].reshape(b, h, sq + sq_pad, dp)[:, :, :sq, :d]
+    if with_lse:
+        return out, res[1]  # lse stays padded (bh, sqp) for the bwd
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash backward kernels (standard flash-attention backward:
+# recompute p from the saved logsumexp, accumulate dq / dk / dv blockwise;
+# delta_i = rowsum(dO_i * O_i) precomputed at the XLA level).
+# ---------------------------------------------------------------------------
+
+def _bwd_p_block(q_ref, k_ref, lse_ref, iq, ik, *, sm_scale, causal,
+                 blk_q, blk_k, seq_q, seq_k):
+    """Recomputed softmax block p = exp(q k^T * scale - lse)."""
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * sm_scale
+    k_pos = ik * blk_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    mask = k_pos < seq_k
+    if causal:
+        q_pos = (iq * blk_q + (seq_k - seq_q)
+                 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0))
+        mask = mask & (k_pos <= q_pos)
+    s = jnp.where(mask, s, _NEG_INF)
+    return jnp.exp(s - lse_ref[0][:, None])
+
+
+def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                           delta_ref, dk_ref, dv_ref,
+                           dk_acc, dv_acc, *, sm_scale, causal,
+                           blk_q, blk_k, seq_q, seq_k):
+    ik = pl.program_id(1)
+    iq = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    def _compute():
+        p = _bwd_p_block(q_ref, k_ref, lse_ref, iq, ik,
+                         sm_scale=sm_scale, causal=causal, blk_q=blk_q,
+                         blk_k=blk_k, seq_q=seq_q, seq_k=seq_k)
+        do = do_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32)
+        # dv += p^T dO
+        dv_acc[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # ds = p * (dO v^T - delta) * scale;  dk += ds^T q
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None]) * sm_scale
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        visible = ik * blk_k <= iq * blk_q + blk_q - 1 + (seq_k - seq_q)
+        pl.when(visible)(_compute)
+    else:
+        _compute()
+
+    @pl.when(iq == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                         delta_ref, dq_ref, dq_acc, *, sm_scale, causal,
+                         blk_q, blk_k, seq_q, seq_k):
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    def _compute():
+        p = _bwd_p_block(q_ref, k_ref, lse_ref, iq, ik,
+                         sm_scale=sm_scale, causal=causal, blk_q=blk_q,
+                         blk_k=blk_k, seq_q=seq_q, seq_k=seq_k)
+        do = do_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None]) * sm_scale
+        dq_acc[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        visible = ik * blk_k <= iq * blk_q + blk_q - 1 + (seq_k - seq_q)
+        pl.when(visible)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, out, lse, dout, causal, sm_scale,
+                      blk_q=1024, blk_k=1024, interpret=False):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    blk_q = min(blk_q, sq)
+    blk_k = min(blk_k, sk)
+    d_pad = -d % 128
+    sq_pad = -sq % blk_q
+    sk_pad = -sk % blk_k
+    qp = _pad_bh(q, sq_pad, d_pad)
+    kp = _pad_bh(k, sk_pad, d_pad)
+    vp = _pad_bh(v, sk_pad, d_pad)
+    dop = _pad_bh(dout, sq_pad, d_pad)
+    outp = _pad_bh(out, sq_pad, d_pad)
+    bh, dp = b * h, d + d_pad
+    nq = (sq + sq_pad) // blk_q
+    nk = (sk + sk_pad) // blk_k
+    # delta_i = rowsum(dO_i * O_i) — zero on padded rows since dO is 0
+    delta = jnp.sum(dop.astype(jnp.float32) * outp.astype(jnp.float32),
+                    axis=-1)
+
+    common = dict(sm_scale=sm_scale, causal=causal, blk_q=blk_q,
+                  blk_k=blk_k, seq_q=sq, seq_k=sk)
+    q_spec_q = pl.BlockSpec((1, blk_q, dp), lambda bh_, a, b_: (bh_, a, 0))
+    q_spec_k = pl.BlockSpec((1, blk_q, dp), lambda bh_, a, b_: (bh_, b_, 0))
+    k_spec_q = pl.BlockSpec((1, blk_k, dp), lambda bh_, a, b_: (bh_, b_, 0))
+    k_spec_k = pl.BlockSpec((1, blk_k, dp), lambda bh_, a, b_: (bh_, a, 0))
+    r_spec_q = pl.BlockSpec((1, blk_q), lambda bh_, a, b_: (bh_, a))
+    r_spec_k = pl.BlockSpec((1, blk_q), lambda bh_, a, b_: (bh_, b_))
+
+    # dk/dv: grid (bh, nk, nq) — k-block resident, q streamed
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkdv_kernel, **common),
+        grid=(bh, nk, nq),
+        in_specs=[q_spec_k, k_spec_k, k_spec_k, q_spec_k, r_spec_k,
+                  r_spec_k],
+        out_specs=[k_spec_k, k_spec_k],
+        out_shape=[jax.ShapeDtypeStruct((bh, sk + sk_pad, dp), k.dtype),
+                   jax.ShapeDtypeStruct((bh, sk + sk_pad, dp), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((blk_k, dp), jnp.float32),
+                        pltpu.VMEM((blk_k, dp), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qp, kp, vp, dop, lse, delta)
+
+    # dq: grid (bh, nq, nk) — q-block resident, k streamed
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, **common),
+        grid=(bh, nq, nk),
+        in_specs=[q_spec_q, k_spec_q, k_spec_q, q_spec_q, r_spec_q,
+                  r_spec_q],
+        out_specs=q_spec_q,
+        out_shape=jax.ShapeDtypeStruct((bh, sq + sq_pad, dp), q.dtype),
+        scratch_shapes=[pltpu.VMEM((blk_q, dp), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(qp, kp, vp, dop, lse, delta)
+
+    dq = dq.reshape(b, h, sq + sq_pad, dp)[:, :, :sq, :d]
+    dk = dk.reshape(b, h, sk + sk_pad, dp)[:, :, :sk, :d]
+    dv = dv.reshape(b, h, sk + sk_pad, dp)[:, :, :sk, :d]
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
@@ -228,17 +421,15 @@ def _flash(q, k, v, causal, sm_scale, interpret):
 
 
 def _flash_vjp_fwd(q, k, v, causal, sm_scale, interpret):
-    return _flash(q, k, v, causal, sm_scale, interpret), (q, k, v)
+    out, lse = _flash_fwd_pallas(q, k, v, causal, sm_scale,
+                                 interpret=interpret, with_lse=True)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_vjp_bwd(causal, sm_scale, interpret, res, g):
-    # flash backward = recompute; the chunked scan (itself rematerialized)
-    # is that recompute expressed at the XLA level.
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _chunked_attention(q_, k_, v_, causal, sm_scale),
-        q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_bwd_pallas(q, k, v, out, lse, g, causal, sm_scale,
+                             interpret=interpret)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)

@@ -168,3 +168,33 @@ def test_symbolic_attention_with_grad():
         argnums=(0, 1, 2))(qn, kn, vn)
     np.testing.assert_allclose(ex.grad_dict["q"].asnumpy(),
                                np.asarray(g_ref[0]), rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("causal,sq,sk,d,blk", [
+    (False, 48, 48, 16, 16),
+    (True, 48, 48, 16, 16),
+    (True, 24, 72, 8, 24),    # cross-length causal, uneven blocks
+    (False, 40, 56, 24, 16),  # seq not divisible by block, d not 128
+])
+def test_pallas_flash_backward_interpret(causal, sq, sk, d, blk):
+    from mxnet_tpu.ops.attention import _flash_fwd_pallas, _flash_bwd_pallas
+    q, k, v = _rand_qkv(b=1, h=2, sq=sq, sk=sk, d=d)
+    scale = 1.0 / np.sqrt(d)
+    out, lse = _flash_fwd_pallas(q, k, v, causal, scale, blk_q=blk,
+                                 blk_k=blk, interpret=True, with_lse=True)
+    g = jnp.asarray(np.random.RandomState(9).randn(
+        *out.shape).astype(np.float32))
+    dq, dk, dv = _flash_bwd_pallas(q, k, v, out, lse, g, causal, scale,
+                                   blk_q=blk, blk_k=blk, interpret=True)
+    ref, vjp = jax.vjp(
+        lambda a, b, c: attention_reference(a, b, c, causal=causal,
+                                            sm_scale=scale), q, k, v)
+    rq, rk, rv = vjp(g)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(dq), np.asarray(rq),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(dk), np.asarray(rk),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(dv), np.asarray(rv),
+                               rtol=2e-4, atol=2e-4)

@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Cross-backend consistency sweep on real hardware (reference:
+tests/python/gpu/test_operator_gpu.py reusing the CPU suite through
+check_consistency, test_utils.py:1207 — "the single most important
+harness to reproduce", SURVEY §4.1).
+
+Runs a library of small symbols through ``test_utils.check_consistency``
+comparing the TPU backend against CPU — outputs AND gradients must agree
+within per-dtype tolerance.  Requires a healthy TPU; run:
+
+    PYTHONPATH=/root/repo:/root/.axon_site python tools/tpu_consistency.py
+
+Exits nonzero listing any mismatching case.
+"""
+
+from __future__ import annotations
+
+import sys
+import traceback
+
+
+def _cases(mx):
+    """(name, symbol, shapes, tolerances) — one per op family."""
+    s = mx.sym
+    d = s.var("data")
+    w = s.var("w")
+    cases = []
+
+    def add(name, sym, shapes, rtol=2e-3, atol=2e-3):
+        cases.append((name, sym, shapes, rtol, atol))
+
+    add("fc_relu", s.Activation(s.FullyConnected(
+        d, num_hidden=16, name="fc"), act_type="relu"),
+        {"data": (4, 8)})
+    add("conv_bn_pool", s.Pooling(s.Activation(s.Convolution(
+        d, num_filter=8, kernel=(3, 3), pad=(1, 1), name="c"),
+        act_type="relu"), kernel=(2, 2), stride=(2, 2),
+        pool_type="max"), {"data": (2, 3, 8, 8)})
+    add("softmax_ce", s.SoftmaxOutput(s.FullyConnected(
+        d, num_hidden=5, name="f2"), s.var("lbl")),
+        {"data": (6, 10), "lbl": (6,)})
+    add("layernorm", s.LayerNorm(d, s.var("g"), s.var("b")),
+        {"data": (4, 12), "g": (12,), "b": (12,)})
+    add("batch_dot", s.batch_dot(d, w),
+        {"data": (3, 4, 5), "w": (3, 5, 6)})
+    add("broadcast_chain", s.broadcast_mul(
+        s.broadcast_add(d, w), s.exp(-d)),
+        {"data": (4, 6), "w": (1, 6)})
+    add("reduce_stack", s.sum(s.square(d), axis=1),
+        {"data": (5, 7)})
+    add("transpose_reshape", s.Reshape(s.transpose(d, (0, 2, 1)),
+                                       (0, -1)),
+        {"data": (2, 3, 4)})
+    add("take_embed", s.Embedding(s.var("idx"), w, input_dim=20,
+                                  output_dim=6),
+        {"idx": (3, 4), "w": (20, 6)})
+    add("rnn_tanh", s.RNN(d, s.var("p"), s.var("st"),
+                          state_size=8, num_layers=1, mode="rnn_tanh",
+                          name="r"),
+        {"data": (5, 2, 4), "p": (8 * (4 + 8 + 2),), "st": (1, 2, 8)})
+    add("attention", s.contrib.DotProductAttention(
+        s.var("q"), s.var("k"), s.var("v")),
+        {"q": (1, 2, 16, 8), "k": (1, 2, 16, 8), "v": (1, 2, 16, 8)})
+    return cases
+
+
+def main():
+    import mxnet_tpu as mx
+    from mxnet_tpu import test_utils
+
+    backends = test_utils.list_backends()
+    print("backends:", backends)
+    if "tpu" not in backends:
+        print("no TPU backend available — nothing to compare")
+        return 2
+
+    failures = []
+    cases = _cases(mx)
+    for name, sym, shapes, rtol, atol in cases:
+        try:
+            # complete the shape dict (weights etc.) via inference
+            arg_shapes, _, _ = sym.infer_shape(**shapes)
+            full = dict(zip(sym.list_arguments(), arg_shapes))
+            test_utils.check_consistency(
+                sym, shapes=full, backends=["cpu", "tpu"],
+                rtol=rtol, atol=atol)
+            print("OK   %s" % name, flush=True)
+        except Exception:
+            failures.append(name)
+            print("FAIL %s\n%s" % (name, traceback.format_exc()),
+                  flush=True)
+    print("%d/%d consistent" % (len(cases) - len(failures), len(cases)))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
