@@ -279,9 +279,11 @@ class KVStoreServer:
     """Server process body (reference: kvstore_dist_server.h:155 —
     DataHandleEx:325, sync-mode ApplyUpdates:346, async immediate apply)."""
 
-    def __init__(self, sync_mode, num_workers, host="127.0.0.1", port=None):
+    def __init__(self, sync_mode, num_workers, host="127.0.0.1",
+                 port=None, server_id=0):
         self.sync = sync_mode
         self.num_workers = num_workers
+        self.server_id = int(server_id)
         self.store = {}
         self.pending = {}       # key -> [accum numpy, count]
         self._str_idx = {}      # deterministic string-key -> int index
@@ -311,8 +313,10 @@ class KVStoreServer:
         # holds that lock reentrantly, so importing here is safe.
         from . import optimizer as _opt_mod
         from .ops import quantization as _quant_mod
+        from . import profiler as _prof_mod
         self._opt_mod = _opt_mod
         self._quant_mod = _quant_mod
+        self._prof_mod = _prof_mod
 
     def run(self):
         """Serve until a STOP message (reference: RunServer blocks the
@@ -441,10 +445,32 @@ class KVStoreServer:
                     # rank-0 command channel (reference: kvstore.h
                     # SendCommandToServers:377); "mode" declares the
                     # consistency model so one server binary serves both
-                    # dist_sync and dist_async launches
-                    if len(msg) >= 3 and msg[1] == "mode":
-                        self.sync = "async" not in str(msg[2])
-                    _send_msg(conn, ("ok",))
+                    # dist_sync and dist_async launches; "profiler:*"
+                    # drives this server process's profiler (reference:
+                    # kvstore.h:43-56, test_server_profiling.py)
+                    head = msg[1] if len(msg) >= 2 else ""
+                    body = msg[2] if len(msg) >= 3 else None
+                    try:
+                        if head == "mode":
+                            self.sync = "async" not in str(body)
+                        elif head == "profiler:set_config":
+                            cfg = dict(body)
+                            if "filename" in cfg and self.server_id:
+                                # each server of a group writes its own
+                                # trace (multi-server dumps must not
+                                # clobber one file)
+                                base, ext = os.path.splitext(
+                                    cfg["filename"])
+                                cfg["filename"] = "%s.server%d%s" % (
+                                    base, self.server_id, ext)
+                            self._prof_mod.set_config(**cfg)
+                        elif head == "profiler:set_state":
+                            self._prof_mod.set_state(str(body))
+                        elif head == "profiler:dump":
+                            self._prof_mod.dump(finished=bool(body))
+                        _send_msg(conn, ("ok",))
+                    except Exception as e:
+                        _send_msg(conn, ("err", str(e)))
                 elif kind == _MSG_STOP:
                     self._stop = True
                     _send_msg(conn, ("ok",))
@@ -555,6 +581,10 @@ class KVStoreDist(KVStoreBase):
         for s in range(self._num_servers):
             self._rpc((_MSG_CMD, "mode", name), server=s)
         self._start_heartbeat()
+        # register for profiler server-command routing (reference:
+        # profiler.py set_kvstore_handle)
+        from . import profiler as _prof
+        _prof.set_kvstore_handle(self)
 
     def _start_heartbeat(self):
         from .config import get_env as _get_env
@@ -775,6 +805,9 @@ class KVStoreDist(KVStoreBase):
 
     def stop_server(self):
         self._closed = True
+        from . import profiler as _prof
+        if _prof._kvstore_handle is self:
+            _prof.set_kvstore_handle(None)
         for s in range(self._num_servers):
             try:
                 self._rpc((_MSG_STOP,), server=s)

@@ -32,7 +32,8 @@ def run_server(kv_type="dist_sync", host=None, port=None, num_workers=None):
         int(os.environ.get("DMLC_NUM_WORKER", "1")),
         host=host or os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1"),
         port=port if port is not None else
-        int(os.environ.get("DMLC_PS_ROOT_PORT", "9091")) + server_id)
+        int(os.environ.get("DMLC_PS_ROOT_PORT", "9091")) + server_id,
+        server_id=server_id)
     server.run()
     return server
 

@@ -47,9 +47,43 @@ def is_running():
     return _state["running"] and not _state["paused"]
 
 
-def set_config(**kwargs):
+# rank-0 worker can drive the profiler running inside kvstore SERVER
+# processes (reference: include/mxnet/kvstore.h:43-56 profiler commands,
+# python/mxnet/profiler.py profile_process='server',
+# tests/nightly/test_server_profiling.py)
+_kvstore_handle = None
+
+
+def set_kvstore_handle(kv):
+    """Register the dist kvstore used to route 'server' profiler
+    commands (reference: profiler.py set_kvstore_handle)."""
+    global _kvstore_handle
+    _kvstore_handle = kv
+
+
+def _to_server(head, body):
+    if _kvstore_handle is None:
+        raise ValueError(
+            "profile_process='server' needs a dist kvstore (create one "
+            "first; it registers itself)")
+    _kvstore_handle._send_command_to_servers(head, body)
+
+
+def _check_process(profile_process):
+    if profile_process not in ("worker", "server"):
+        raise ValueError("profile_process must be 'worker' or 'server', "
+                         "got %r" % (profile_process,))
+    return profile_process == "server"
+
+
+def set_config(profile_process="worker", **kwargs):
     """Configure (reference: profiler.py set_config:33).  Accepts the
-    reference's kwargs; unknown keys are rejected."""
+    reference's kwargs; unknown keys are rejected.
+    ``profile_process='server'`` configures the profiler inside every
+    kvstore server process instead."""
+    if _check_process(profile_process):
+        _to_server("profiler:set_config", kwargs)
+        return
     for k, v in kwargs.items():
         if k not in _config:
             raise ValueError("unknown profiler option %r (known: %s)"
@@ -57,11 +91,14 @@ def set_config(**kwargs):
         _config[k] = v
 
 
-def set_state(state="stop"):
+def set_state(state="stop", profile_process="worker"):
     """'run' starts collection, 'stop' ends it
     (reference: profiler.py set_state:89)."""
     if state not in ("run", "stop"):
         raise ValueError("state must be 'run' or 'stop'")
+    if _check_process(profile_process):
+        _to_server("profiler:set_state", state)
+        return
     if state == "run" and not _state["running"]:
         _state["running"] = True
         _state["paused"] = False
@@ -129,9 +166,12 @@ def record_marker(name, cat="marker"):
                         "tid": 0, "s": "p"})
 
 
-def dump(finished=True):
+def dump(finished=True, profile_process="worker"):
     """Write the chrome-trace JSON (reference: profiler.py dump:122);
     load it at chrome://tracing or ui.perfetto.dev."""
+    if _check_process(profile_process):
+        _to_server("profiler:dump", bool(finished))
+        return None
     if finished:
         set_state("stop")
     with _lock:

@@ -446,3 +446,58 @@ def test_dist_dead_node_detection_and_rejoin():
             else:
                 os.environ[k] = v
         server.wait(timeout=30)
+
+
+def test_server_side_profiling():
+    """rank-0 drives the profiler inside the server process
+    (reference: tests/nightly/test_server_profiling.py,
+    include/mxnet/kvstore.h:43-56)."""
+    import tempfile
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    port = 9171
+    prof_path = os.path.join(tempfile.mkdtemp(), "server_profile.json")
+    env = dict(os.environ)
+    env.update({
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(port),
+        "DMLC_NUM_WORKER": "1",
+        "JAX_PLATFORMS": "cpu",
+    })
+    server = subprocess.Popen(
+        [sys.executable, "-c",
+         "import sys; sys.path.insert(0, %r);"
+         "from mxnet_tpu.kvstore_server import run_server;"
+         "run_server('dist_sync')" % repo],
+        env=dict(env, DMLC_ROLE="server"),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    old_env = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    kv = None
+    try:
+        import mxnet_tpu as mx
+        from mxnet_tpu import profiler
+        kv = mx.kv.create("dist_sync")
+        profiler.set_config(profile_process="server",
+                            filename=prof_path, aggregate_stats=True)
+        profiler.set_state("run", profile_process="server")
+        kv.init("pw", mx.nd.zeros((8,)))
+        kv.push("pw", mx.nd.ones((8,)))
+        out = mx.nd.zeros((8,))
+        kv.pull("pw", out=out)
+        profiler.set_state("stop", profile_process="server")
+        profiler.dump(profile_process="server")
+        # the dump RPC is synchronous: the file exists on return
+        assert os.path.exists(prof_path), "server never wrote its dump"
+        import json as _json
+        with open(prof_path) as f:
+            trace = _json.load(f)
+        assert "traceEvents" in trace
+    finally:
+        if kv is not None:
+            kv.stop_server()
+        for k, v in old_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        server.wait(timeout=30)
