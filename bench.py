@@ -30,12 +30,54 @@ import time
 
 import numpy as np
 
-# honor JAX_PLATFORMS before backend init — in this image the TPU plugin
-# registers regardless of the env var and a broken tunnel would hang
-# device discovery on a CPU-only run
-if os.environ.get("JAX_PLATFORMS"):
+# Platform selection + tunnel-health guard.  An explicitly-CPU
+# JAX_PLATFORMS is honored directly; for ANY TPU-capable target
+# (including the environment's default JAX_PLATFORMS=axon) probe tunnel
+# health first: a wedged axon tunnel hangs jax compute FOREVER (observed
+# after killing in-flight TPU work), and a half-recovered tunnel answers
+# device discovery while compute still hangs — so the probe runs an
+# actual computation with a host readback, in a child process.
+_target = os.environ.get("JAX_PLATFORMS", "")
+if _target.strip().lower() == "cpu":
     import jax
-    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    jax.config.update("jax_platforms", "cpu")
+else:
+    import subprocess
+    import time as _time
+    # NOTE: subprocess.run(timeout=...) is NOT safe here — a child stuck
+    # in the wedged TPU driver call sits in uninterruptible sleep, and
+    # run() blocks forever trying to reap it after SIGKILL (observed:
+    # 18 min of wall with 3 s of user time).  Poll and ABANDON instead.
+    _probe = subprocess.Popen(
+        [sys.executable, "-c",
+         "import jax, jax.numpy as jnp; "
+         "print(int(jnp.sum(jnp.ones((256, 256)))))"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+    _deadline = _time.time() + 150
+    healthy = False
+    while _time.time() < _deadline:
+        if _probe.poll() is not None:
+            out = _probe.stdout.read() or ""
+            lines = out.strip().splitlines()
+            # last stdout line is the value (earlier lines may be banners)
+            healthy = (_probe.returncode == 0 and lines
+                       and lines[-1].isdigit())
+            break
+        _time.sleep(1)
+    else:
+        try:
+            _probe.kill()  # may not die (D state); do NOT wait on it
+        except Exception:
+            pass
+    import jax
+    if healthy:
+        if _target:
+            jax.config.update("jax_platforms", _target)
+    else:
+        print("bench: TPU tunnel unhealthy — falling back to CPU",
+              file=sys.stderr)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        jax.config.update("jax_platforms", "cpu")
 
 BASELINE_IMG_S = 363.69  # V100 bs=128 training, docs/faq/perf.md:219
 
@@ -103,9 +145,9 @@ def main():
 
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
-    batch = 128 if on_tpu else 16
+    batch = 128 if on_tpu else 8
     image = 224 if on_tpu else 32
-    warmup, iters = 4, 20
+    warmup, iters = (4, 20) if on_tpu else (2, 10)
 
     net = vision.get_model("resnet50_v1", classes=1000)
     net.initialize()
@@ -132,7 +174,7 @@ def main():
     # which also keeps per-call tunnel latency out of the device number
     import jax.numpy as jnp
     step = trainer._step_fn
-    scan_n = 5
+    scan_n = 5 if on_tpu else 2  # scan length multiplies CPU compile time
 
     def multi(params, opt_state, aux, xb, yb, key, lr, t):
         def body(carry, i):

@@ -1,0 +1,49 @@
+"""Pretrained-weight store (reference:
+python/mxnet/gluon/model_zoo/model_store.py — sha1-indexed download of
+pretrained .params from the MXNet S3 bucket).
+
+Offline stance: this build has no network egress, so there is no
+download path.  ``get_model_file`` resolves weights from the local model
+directory only (``$MXNET_HOME/models`` or ``~/.mxnet/models`` — the same
+location the reference caches into), so checkpoints placed there by the
+user (or exported by ``Block.save_parameters``) load exactly like the
+reference's pretrained flow; a missing file raises with instructions
+instead of attempting a download."""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_model_file", "purge"]
+
+
+def _model_dir():
+    return os.path.join(
+        os.environ.get("MXNET_HOME",
+                       os.path.join(os.path.expanduser("~"), ".mxnet")),
+        "models")
+
+
+def get_model_file(name, root=None):
+    """Path to ``<root>/<name>.params``; raises FileNotFoundError with
+    the offline explanation when absent (reference: model_store.py
+    get_model_file — which would download on miss)."""
+    root = root or _model_dir()
+    path = os.path.join(root, "%s.params" % name)
+    if os.path.exists(path):
+        return path
+    raise FileNotFoundError(
+        "pretrained weights %r not found at %s. This build has no "
+        "network egress: place the .params file there yourself (any "
+        "checkpoint saved with save_parameters works), then retry."
+        % (name, path))
+
+
+def purge(root=None):
+    """Remove cached model files (reference: model_store.py purge)."""
+    root = root or _model_dir()
+    if not os.path.isdir(root):
+        return
+    for f in os.listdir(root):
+        if f.endswith(".params"):
+            os.unlink(os.path.join(root, f))

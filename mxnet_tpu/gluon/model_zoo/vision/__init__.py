@@ -29,14 +29,21 @@ for _mod in _MODULES:
 # canonical underscore form; get_model lowercases and strips dashes)
 
 
-def get_model(name, **kwargs):
-    """Return a model by name (reference: vision/__init__.py:91)."""
+def get_model(name, pretrained=False, root=None, **kwargs):
+    """Return a model by name (reference: vision/__init__.py:91).
+
+    ``pretrained=True`` loads weights from the local model directory
+    (see model_store.py — no download path in this offline build)."""
     name = name.lower().replace("-", "_")
     if name not in _factories:
         raise ValueError(
             "Model %r not found. Available: %s"
             % (name, ", ".join(sorted(_factories))))
-    return _factories[name](**kwargs)
+    net = _factories[name](**kwargs)
+    if pretrained:
+        from ..model_store import get_model_file
+        net.load_parameters(get_model_file(name, root=root))
+    return net
 
 
 __all__ = [n for m in _MODULES for n in m.__all__] + ["get_model"]

@@ -252,3 +252,22 @@ def test_clip_global_norm():
     norm = gluon.clip_global_norm(arrays, 1.0)
     total = sum(float((a * a).sum().asscalar()) for a in arrays)
     assert abs(total - 1.0) < 1e-3
+
+
+def test_model_zoo_pretrained_local_store(tmp_path, monkeypatch):
+    """pretrained=True loads from the local model dir (model_store.py
+    offline stance; reference: gluon/model_zoo/model_store.py)."""
+    from mxnet_tpu.gluon.model_zoo import vision
+    monkeypatch.setenv("MXNET_HOME", str(tmp_path))
+    net = vision.get_model("squeezenet1_0", classes=10)
+    net.initialize()
+    x = mx.nd.array(np.random.RandomState(0).randn(
+        1, 3, 64, 64).astype(np.float32))
+    ref = net(x).asnumpy()
+    mdir = tmp_path / "models"
+    mdir.mkdir()
+    net.save_parameters(str(mdir / "squeezenet1_0.params"))
+    net2 = vision.get_model("squeezenet1_0", classes=10, pretrained=True)
+    np.testing.assert_allclose(net2(x).asnumpy(), ref, rtol=1e-5)
+    with pytest.raises(FileNotFoundError, match="no network egress"):
+        vision.get_model("alexnet", pretrained=True)
