@@ -127,3 +127,11 @@ def test_train_transformer_lm_sequence_parallel():
                         "--xla_force_host_platform_device_count=8"})
     assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
     assert "TRANSFORMER-LM-OK" in r.stdout
+
+
+def test_bandwidth_tool_local():
+    """tools/bandwidth.py (reference: tools/bandwidth/measure.py)."""
+    r = _run([sys.executable, "tools/bandwidth.py", "--kv-store",
+              "local", "--sizes", "1e5", "--repeat", "2"])
+    assert r.returncode == 0, r.stdout[-1500:] + r.stderr[-1500:]
+    assert "GB/s" in r.stdout

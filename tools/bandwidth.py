@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""KVStore bandwidth harness (reference: tools/bandwidth/measure.py —
+push/pull throughput over the comm backend).
+
+Measures aggregate push+pull bandwidth for a list of tensor sizes over
+any kvstore type: `local`, `tpu` (in-graph ICI collectives), or
+`dist_sync` (TCP parameter server; run under tools/launch.py).
+
+Usage:
+    python tools/bandwidth.py --kv-store local --sizes 1e5,1e6,1e7
+    python tools/launch.py -n 2 -- python tools/bandwidth.py \
+        --kv-store dist_sync
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_here))
+
+
+def measure(kv, size, repeat, n_parts):
+    """Aggregate push+pull GB/s for one tensor size (float32)."""
+    import mxnet_tpu as mx
+    shape = (int(size),)
+    key = "bw_%d_%d" % (size, measure._seq)
+    measure._seq += 1  # unique key even for duplicate --sizes entries
+    kv.init(key, mx.nd.zeros(shape))
+    vals = [mx.nd.ones(shape) for _ in range(n_parts)]
+    out = mx.nd.zeros(shape)
+    # warm (a 1-element list and a scalar push are equivalent)
+    kv.push(key, vals)
+    kv.pull(key, out=out)
+    float(np.asarray(out.asnumpy()[0]))
+    t0 = time.perf_counter()
+    for _ in range(repeat):
+        kv.push(key, vals)
+        kv.pull(key, out=out)
+    float(np.asarray(out.asnumpy()[0]))  # sync
+    dt = time.perf_counter() - t0
+    nbytes = 4 * size * repeat * (n_parts + 1)  # pushes + one pull
+    return nbytes / dt / 1e9
+
+
+measure._seq = 0
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--kv-store", default="local")
+    parser.add_argument("--sizes", default="1e5,1e6,1e7",
+                        help="comma list of element counts")
+    parser.add_argument("--repeat", type=int, default=10)
+    parser.add_argument("--num-parts", type=int, default=0,
+                        help="values per push (0 = one per device for "
+                             "local/tpu, 1 for dist)")
+    args = parser.parse_args(argv)
+
+    import jax
+    import mxnet_tpu as mx
+
+    kv = mx.kv.create(args.kv_store)
+    n_parts = args.num_parts
+    if n_parts <= 0:
+        # device-resident stores push one value per device; dist stores
+        # push one per worker process
+        n_parts = 1 if "dist" in args.kv_store else len(jax.devices())
+
+    print("kvstore=%s rank=%d/%d parts=%d"
+          % (args.kv_store, kv.rank, kv.num_workers, n_parts),
+          flush=True)
+    for tok in args.sizes.split(","):
+        size = int(float(tok))
+        gbs = measure(kv, size, args.repeat, n_parts)
+        print("size %12d elems  %8.2f MB   %7.3f GB/s (push+pull)"
+              % (size, 4 * size / 1e6, gbs), flush=True)
+    if "dist" in args.kv_store:
+        kv.barrier()
+        if kv.rank == 0:
+            kv.stop_server()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
