@@ -8,3 +8,5 @@ from . import collectives  # noqa: F401
 from .data_parallel import ParallelTrainer  # noqa: F401
 from .sequence import (ring_attention_shard,  # noqa: F401
                        sequence_parallel_attention)
+from .pipeline import pipeline_apply  # noqa: F401
+from .moe import moe_apply  # noqa: F401

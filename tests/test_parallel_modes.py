@@ -1,0 +1,157 @@
+"""Pipeline (pp) and expert (ep) parallelism on the 8-device CPU mesh
+(completing the tp/pp/dp/sp/ep mode set; reference has DP + manual
+placement only, SURVEY §2.3)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mxnet_tpu.parallel import make_mesh, pipeline_apply, moe_apply
+
+
+def _stage_fn(w, x):
+    return jnp.tanh(x @ w)
+
+
+def test_pipeline_matches_sequential():
+    mesh = make_mesh({"pp": 8})
+    rng = np.random.RandomState(0)
+    S, M, B, D = 8, 4, 2, 16
+    ws = jnp.asarray(rng.randn(S, D, D).astype(np.float32) * 0.3)
+    xm = jnp.asarray(rng.randn(M, B, D).astype(np.float32))
+    out = pipeline_apply(_stage_fn, ws, xm, axis_name="pp", mesh=mesh)
+    # sequential reference: stages applied in order per microbatch
+    ref = xm
+    for s in range(S):
+        ref = jnp.tanh(ref @ ws[s])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_gradients_flow():
+    mesh = make_mesh({"pp": 8})
+    rng = np.random.RandomState(1)
+    S, M, B, D = 8, 3, 2, 8
+    ws = jnp.asarray(rng.randn(S, D, D).astype(np.float32) * 0.3)
+    xm = jnp.asarray(rng.randn(M, B, D).astype(np.float32))
+
+    def loss_pp(ws):
+        return jnp.sum(pipeline_apply(_stage_fn, ws, xm, mesh=mesh) ** 2)
+
+    def loss_ref(ws):
+        ref = xm
+        for s in range(S):
+            ref = jnp.tanh(ref @ ws[s])
+        return jnp.sum(ref ** 2)
+
+    g_pp = jax.grad(loss_pp)(ws)
+    g_ref = jax.grad(loss_ref)(ws)
+    np.testing.assert_allclose(np.asarray(g_pp), np.asarray(g_ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def _expert_fn(w, x):
+    return jnp.tanh(x @ w)
+
+
+def test_moe_top1_dispatch_matches_dense_routing():
+    mesh = make_mesh({"ep": 8})
+    rng = np.random.RandomState(0)
+    E, B, D = 8, 64, 16          # B tokens total, sharded 8 ways
+    ew = jnp.asarray(rng.randn(E, D, D).astype(np.float32) * 0.3)
+    x = jnp.asarray(rng.randn(B, D).astype(np.float32))
+    gw = jnp.asarray(rng.randn(D, E).astype(np.float32) * 0.1)
+    out = moe_apply(_expert_fn, ew, x, gw, axis_name="ep", mesh=mesh,
+                    capacity_factor=8.0)  # big capacity: nothing drops
+    # dense reference: every token through its argmax expert
+    probs = jax.nn.softmax(x @ gw, axis=-1)
+    idx = jnp.argmax(probs, axis=-1)
+    gate = jnp.take_along_axis(probs, idx[:, None], axis=1)[:, 0]
+    ref = jnp.stack([jnp.tanh(x[i] @ ew[idx[i]]) * gate[i]
+                     for i in range(B)])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_moe_capacity_drops_overflow():
+    mesh = make_mesh({"ep": 8})
+    rng = np.random.RandomState(2)
+    E, B, D = 8, 64, 8
+    ew = jnp.asarray(rng.randn(E, D, D).astype(np.float32) * 0.3)
+    # gate forces every token to expert 0 -> heavy overflow at cap=1
+    gw = jnp.zeros((D, E), jnp.float32).at[:, 0].set(1.0)
+    x = jnp.asarray(np.abs(rng.randn(B, D)).astype(np.float32))
+    out = np.asarray(moe_apply(_expert_fn, ew, x, gw, mesh=mesh,
+                               capacity_factor=1.0))
+    # per device: 8 local tokens, cap = 8/8 = 1 -> exactly 1 kept each
+    kept_rows = (np.abs(out).sum(axis=1) > 0).reshape(8, 8).sum(axis=1)
+    np.testing.assert_array_equal(kept_rows, np.ones(8))
+
+
+def test_moe_gradients_flow_to_gate_and_experts():
+    mesh = make_mesh({"ep": 8})
+    rng = np.random.RandomState(3)
+    E, B, D = 8, 32, 8
+    ew = jnp.asarray(rng.randn(E, D, D).astype(np.float32) * 0.3)
+    gw = jnp.asarray(rng.randn(D, E).astype(np.float32) * 0.1)
+    x = jnp.asarray(rng.randn(B, D).astype(np.float32))
+
+    def loss(ew, gw):
+        return jnp.sum(moe_apply(_expert_fn, ew, x, gw, mesh=mesh,
+                                 capacity_factor=8.0) ** 2)
+
+    ge, gg = jax.grad(loss, argnums=(0, 1))(ew, gw)
+    assert np.isfinite(np.asarray(ge)).all()
+    assert np.abs(np.asarray(ge)).sum() > 0
+    assert np.abs(np.asarray(gg)).sum() > 0  # gate learns via the prob
+
+
+def _norm_fn(w, x):
+    # normalization-style fn: non-finite value/Jacobian at zero input —
+    # the NaN-leak repro for bubble/padding slots
+    h = x @ w
+    return h / jnp.linalg.norm(h, axis=-1, keepdims=True)
+
+
+def test_pipeline_norm_stage_gradients_finite():
+    mesh = make_mesh({"pp": 8})
+    rng = np.random.RandomState(5)
+    S, M, B, D = 8, 3, 2, 8
+    ws = jnp.asarray(rng.randn(S, D, D).astype(np.float32) * 0.5)
+    xm = jnp.asarray(rng.randn(M, B, D).astype(np.float32))
+
+    def loss(ws):
+        return jnp.sum(pipeline_apply(_norm_fn, ws, xm, mesh=mesh) ** 2)
+
+    g = jax.grad(loss)(ws)
+    assert np.isfinite(np.asarray(g)).all()
+    # and the forward matches sequential
+    ref = xm
+    for s in range(S):
+        ref = _norm_fn(ws[s], ref)
+    out = pipeline_apply(_norm_fn, ws, xm, mesh=mesh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_moe_norm_expert_gradients_finite():
+    mesh = make_mesh({"ep": 8})
+    rng = np.random.RandomState(6)
+    E, B, D = 8, 32, 8
+    ew = jnp.asarray(rng.randn(E, D, D).astype(np.float32) * 0.5)
+    gw = jnp.asarray(rng.randn(D, E).astype(np.float32) * 0.1)
+    x = jnp.asarray(rng.randn(B, D).astype(np.float32))
+
+    def loss(ew, gw):
+        return jnp.sum(moe_apply(_norm_fn, ew, x, gw, mesh=mesh,
+                                 capacity_factor=8.0) ** 2)
+
+    ge, gg = jax.grad(loss, argnums=(0, 1))(ew, gw)
+    assert np.isfinite(np.asarray(ge)).all()
+    assert np.isfinite(np.asarray(gg)).all()
+    # forward stays finite even with heavy overflow dropping
+    gw0 = jnp.zeros((D, E), jnp.float32).at[:, 0].set(1.0)
+    out = np.asarray(moe_apply(_norm_fn, ew, x, gw0, mesh=mesh,
+                               capacity_factor=1.0))
+    assert np.isfinite(out).all()
