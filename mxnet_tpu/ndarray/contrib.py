@@ -16,7 +16,7 @@ def _stack(*arrs, axis=0):
     import mxnet_tpu.ndarray as nd_pkg
     return nd_pkg.stack(*arrs, axis=axis)
 
-__all__ = ["foreach", "while_loop", "cond"]
+__all__ = ["foreach", "while_loop", "cond", "rand_zipfian"]
 
 
 def _as_list(x):
@@ -75,3 +75,31 @@ def cond(pred, then_func, else_func):
     if bool(pred.asnumpy().reshape(())):
         return then_func()
     return else_func()
+
+
+def rand_zipfian(true_classes, num_sampled, range_max):
+    """Log-uniform (Zipfian) candidate sampler (reference:
+    python/mxnet/ndarray/contrib.py rand_zipfian): draw num_sampled
+    candidates WITH replacement from
+    P(c) = (log(c+2) - log(c+1)) / log(range_max + 1) and return
+    (samples int64, expected_count_true, expected_count_sampled) where
+    expected_count = P(c) * num_sampled — the sampled-softmax/NCE logit
+    correction term.
+    """
+    import numpy as _np
+    import mxnet_tpu.ndarray as nd_pkg
+
+    log_range = _np.log(range_max + 1)
+    u = nd_pkg.random.uniform(0, 1, (int(num_sampled),)).asnumpy()
+    sampled = (_np.exp(u.astype(_np.float64) * log_range) - 1)         .astype(_np.int64) % range_max
+    sampled_nd = nd_pkg.array(sampled)   # int64 ids, like the reference
+
+    def expected(cls):
+        cls = _np.asarray(cls, _np.float64)
+        p = _np.log((cls + 2.0) / (cls + 1.0)) / log_range
+        return p * num_sampled
+
+    exp_true = nd_pkg.array(expected(
+        true_classes.asnumpy()).astype(_np.float32))
+    exp_sampled = nd_pkg.array(expected(sampled).astype(_np.float32))
+    return sampled_nd, exp_true, exp_sampled

@@ -330,3 +330,22 @@ def _count_sketch(data, h, s, out_dim=0, processing_batch_size=32):
     sign = s.reshape(-1).astype(data.dtype)
     out = jnp.zeros(data.shape[:-1] + (od,), data.dtype)
     return out.at[..., idx].add(data * sign)
+
+
+@register_op("_contrib_quadratic", aliases=("quadratic",))
+def _quadratic(data, a=0.0, b=0.0, c=0.0):
+    """Elementwise a*x^2 + b*x + c (reference:
+    src/operator/contrib/quadratic_op-inl.h — the "how to add an
+    operator" tutorial op)."""
+    return a * data * data + b * data + c
+
+
+@register_op("_contrib_index_copy", input_names=("old", "idx", "new"))
+def _index_copy(old, idx, new):
+    """Copy rows of *new* into *old* at positions *idx* (reference:
+    src/operator/contrib/index_copy.cc).  Deviation: the reference
+    bounds-checks and errors on out-of-range indices; under XLA a
+    data-dependent error cannot be raised inside the compiled op, so
+    out-of-range indices are DROPPED (no write) instead of silently
+    clamping onto a wrong row."""
+    return old.at[idx.astype(jnp.int32)].set(new, mode="drop")

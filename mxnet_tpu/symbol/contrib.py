@@ -140,3 +140,24 @@ def cond(pred, then_func, else_func, name="cond"):
         name=name)
     outputs = [out[i] for i in range(len(then_l))]
     return _maybe_scalar(outputs, tscalar)
+
+
+def rand_zipfian(true_classes, num_sampled, range_max):
+    """Symbolic log-uniform candidate sampler (reference:
+    python/mxnet/symbol/contrib.py rand_zipfian); same math as the
+    ndarray version, built from symbolic ops."""
+    import math
+    import mxnet_tpu.symbol as sym_pkg
+
+    log_range = math.log(range_max + 1)
+    u = sym_pkg._random_uniform(low=0.0, high=1.0,
+                                shape=(int(num_sampled),))
+    sampled = sym_pkg.floor(sym_pkg.exp(u * log_range) - 1.0)
+    sampled = sampled - sym_pkg.floor(
+        sampled / range_max) * range_max    # mod range_max
+
+    def expected(cls):
+        p = (sym_pkg.log((cls + 2.0) / (cls + 1.0))) / log_range
+        return p * float(num_sampled)
+
+    return sampled, expected(true_classes), expected(sampled)

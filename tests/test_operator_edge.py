@@ -158,3 +158,71 @@ def test_multinomial_distribution():
         shape=50000).asnumpy().ravel()
     freq = np.bincount(draws.astype(int), minlength=4) / draws.size
     np.testing.assert_allclose(freq, [0.1, 0.2, 0.3, 0.4], atol=0.01)
+
+
+# --- small contrib ops ----------------------------------------------------
+
+def test_quadratic_op():
+    x = nd.array(np.array([1.0, -2.0, 3.0], np.float32))
+    out = nd.contrib.quadratic(x, a=2.0, b=-1.0, c=0.5)
+    np.testing.assert_allclose(out.asnumpy(), 2 * x.asnumpy() ** 2
+                               - x.asnumpy() + 0.5, rtol=1e-6)
+    # symbolic + gradient (it is the op-tutorial op; grads must flow)
+    from mxnet_tpu import autograd
+    x.attach_grad()
+    with autograd.record():
+        L = nd.sum(nd.contrib.quadratic(x, a=1.0, b=0.0, c=0.0))
+    L.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy(),
+                               rtol=1e-6)
+
+
+def test_index_copy_op():
+    old = nd.array(np.zeros((5, 3), np.float32))
+    new = nd.array(np.ones((2, 3), np.float32))
+    idx = nd.array(np.array([1, 3], np.float32))
+    out = nd.contrib.index_copy(old, idx, new).asnumpy()
+    ref = np.zeros((5, 3), np.float32)
+    ref[[1, 3]] = 1.0
+    np.testing.assert_allclose(out, ref)
+
+
+def test_rand_zipfian_sampler():
+    mx.random.seed(0)
+    true_cls = nd.array(np.array([0.0, 10.0, 100.0], np.float32))
+    sampled, exp_true, exp_sampled = nd.contrib.rand_zipfian(
+        true_cls, num_sampled=4096, range_max=1000)
+    s = sampled.asnumpy()
+    assert s.shape == (4096,) and (s >= 0).all() and (s < 1000).all()
+    assert np.issubdtype(s.dtype, np.integer)  # exact int ids
+    # log-uniform: low classes drawn far more often than high ones
+    low = (s < 10).mean()
+    high = (s >= 500).mean()
+    assert low > high
+    assert exp_sampled.shape == (4096,)
+    # expected_count = P(c) * num_sampled (with-replacement semantics,
+    # reference contrib.py): for class 0, p = log(2)/log(1001)
+    et = exp_true.asnumpy()
+    p0 = np.log(2.0) / np.log(1001.0)
+    np.testing.assert_allclose(et[0], p0 * 4096, rtol=1e-4)
+    assert et[0] > et[1] > et[2] > 0
+    # empirical frequency of class 0 matches its expected count
+    np.testing.assert_allclose((s == 0).sum(), et[0], rtol=0.2)
+    # symbolic mirror evaluates to the same shapes
+    import mxnet_tpu.symbol as sym
+    tc = sym.var("tc")
+    ss, et_s, es_s = sym.contrib.rand_zipfian(tc, 64, 1000)
+    out = sym.Group([ss, et_s, es_s]).bind(
+        mx.cpu(), {"tc": true_cls}).forward()
+    assert out[0].shape == (64,) and out[1].shape == (3,)
+
+
+def test_index_copy_out_of_range_dropped():
+    # XLA deviation (documented): OOB writes are dropped, not clamped
+    old = nd.array(np.zeros((5, 3), np.float32))
+    new = nd.array(np.ones((2, 3), np.float32))
+    idx = nd.array(np.array([1, 7], np.float32))
+    out = nd.contrib.index_copy(old, idx, new).asnumpy()
+    ref = np.zeros((5, 3), np.float32)
+    ref[1] = 1.0
+    np.testing.assert_allclose(out, ref)
