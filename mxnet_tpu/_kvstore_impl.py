@@ -22,6 +22,7 @@ TPU-native mapping (SURVEY.md §5.8):
 
 from __future__ import annotations
 
+import json
 import os
 import pickle
 import socket
@@ -216,23 +217,34 @@ class KVStoreTPU(KVStoreLocal):
 
     def _reduce(self, vals):
         import jax
+        from .ndarray import sparse as _sp
         if len(vals) == 1:
             return vals[0].copy()
         n = len(vals)
         devices = list(self.mesh.devices.flat)
-        ndp = self.mesh.shape.get("dp", len(devices))
-        if n == ndp and n > 1:
-            # one value per mesh device: build a dp-sharded stacked array
-            # in place and psum it over ICI
-            from jax.sharding import NamedSharding, PartitionSpec as P
+        if n <= len(devices) and not any(
+                isinstance(v, _sp.BaseSparseNDArray) for v in vals):
+            # one replica per device: build a sharded stacked array in
+            # place and psum it over ICI.  When the replica count is not
+            # the dp extent, reduce over a dedicated 1-d sub-mesh of the
+            # first n devices instead of falling back to the host loop.
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
             from .parallel import collectives
+            if (len(self.mesh.shape) == 1
+                    and self.mesh.shape.get("dp") == n):
+                mesh, axis = self.mesh, "dp"
+            else:
+                # any other mesh layout (multi-axis, tp/pp-only, or a
+                # replica count != the dp extent): reduce over a
+                # dedicated 1-d sub-mesh of the first n devices
+                mesh, axis = Mesh(_np.array(devices[:n]), ("kv",)), "kv"
             arrs = [v._data for v in vals]
             shards = [jax.device_put(a.reshape((1,) + a.shape), d)
-                      for a, d in zip(arrs, devices)]
+                      for a, d in zip(arrs, mesh.devices.flat)]
             stacked = jax.make_array_from_single_device_arrays(
                 (n,) + tuple(arrs[0].shape),
-                NamedSharding(self.mesh, P("dp")), shards)
-            summed = collectives.allreduce(stacked, self.mesh, "dp")
+                NamedSharding(mesh, P(axis)), shards)
+            summed = collectives.allreduce(stacked, mesh, axis)
             return NDArray(summed)
         return super()._reduce(vals)
 
@@ -251,28 +263,126 @@ _MSG_SET_OPT = 6
 _MSG_ROWPULL = 7
 _MSG_HEARTBEAT = 8
 _MSG_DEADQUERY = 9
+_MSG_REPLY = 100
+
+# ---------------------------------------------------------------------------
+# Wire format: length-prefixed frames with JSON metadata and raw tensor
+# sections — the analogue of ps-lite's zero-copy ZPush/ZPull
+# (reference: kvstore_dist.h:161-169).  Tensor payloads travel as raw
+# C-order bytes (no pickle: a network peer can at most hand us bytes to
+# reinterpret as a numpy array, never code to run); control metadata is
+# JSON.  The ONE exception is SET_OPT, whose body is a pickled optimizer
+# exactly like the reference's set_optimizer — that call is rank-0
+# control plane, not a tensor path, and the trust stance matches the
+# reference's.
+#
+#   frame  := u64 body_len | body
+#   body   := u8 kind | u32 meta_len | meta (UTF-8 JSON)
+#             | u8 n_tensors | tensor*
+#   tensor := u8 name_len | dtype name (ascii, numpy dtype .name)
+#             | u8 ndim | u64 shape[ndim] | u64 nbytes | raw bytes
+#
+# dtype travels by numpy name ('float32', 'bfloat16', ...) so extension
+# dtypes registered by ml_dtypes round-trip; endianness is native on
+# both ends (homogeneous cluster assumption, same as ps-lite's).
+
+_MAX_FRAME = 1 << 38  # 256 GiB sanity bound against corrupt streams
 
 
-def _send_msg(sock, obj):
-    payload = pickle.dumps(obj, protocol=4)
-    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+def _pack_tensor(arr):
+    arr = _np.asarray(arr)
+    shape = arr.shape  # BEFORE ascontiguousarray: it promotes 0-d to (1,)
+    name = arr.dtype.name.encode("ascii")
+    hdr = struct.pack("<B", len(name)) + name + struct.pack("<B", len(shape))
+    if shape:
+        hdr += struct.pack("<%dQ" % len(shape), *shape)
+    hdr += struct.pack("<Q", arr.nbytes)
+    # flat uint8 view: extension dtypes (bfloat16) don't implement the
+    # buffer protocol, so memoryview(arr) would raise on them
+    flat = _np.ascontiguousarray(arr).reshape(-1)
+    return hdr, memoryview(flat.view(_np.uint8))
 
 
-def _recv_msg(sock):
-    hdr = b""
-    while len(hdr) < 8:
-        chunk = sock.recv(8 - len(hdr))
-        if not chunk:
+_COALESCE_BYTES = 1 << 16  # parts under this are copied+batched
+
+
+def _send_frame(sock, kind, meta=None, tensors=()):
+    meta_b = json.dumps(meta).encode() if meta else b"{}"
+    parts = [struct.pack("<BI", kind, len(meta_b)), meta_b,
+             struct.pack("<B", len(tensors))]
+    for t in tensors:
+        hdr, body = _pack_tensor(t)
+        parts.append(hdr)
+        parts.append(body)
+    # coalesce the length prefix + small parts into single writes so a
+    # control frame is ONE TCP segment (a write-write-read pattern would
+    # hit Nagle + delayed-ACK ~40ms stalls); large tensor bodies still go
+    # out zero-copy via their own sendall
+    pending = bytearray(struct.pack(
+        "<Q", sum(len(p) for p in parts)))
+    for p in parts:
+        if len(p) >= _COALESCE_BYTES:
+            if pending:
+                sock.sendall(pending)
+                pending = bytearray()
+            sock.sendall(p)
+        else:
+            pending += p
+    if pending:
+        sock.sendall(pending)
+
+
+def _recv_exact(sock, n):
+    buf = bytearray(n)
+    mv = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(mv[got:], n - got)
+        if not r:
             raise ConnectionError("peer closed")
-        hdr += chunk
-    (n,) = struct.unpack("<Q", hdr)
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(min(1 << 20, n - len(buf)))
-        if not chunk:
-            raise ConnectionError("peer closed")
-        buf += chunk
-    return pickle.loads(bytes(buf))
+        got += r
+    return buf
+
+
+def _recv_frame(sock):
+    (n,) = struct.unpack("<Q", bytes(_recv_exact(sock, 8)))
+    if n > _MAX_FRAME:
+        raise ConnectionError("oversized frame (%d bytes)" % n)
+    mv = memoryview(_recv_exact(sock, n))
+    kind, meta_len = struct.unpack_from("<BI", mv, 0)
+    off = 5
+    meta = json.loads(bytes(mv[off:off + meta_len]).decode())
+    off += meta_len
+    (n_tensors,) = struct.unpack_from("<B", mv, off)
+    off += 1
+    tensors = []
+    for _ in range(n_tensors):
+        (name_len,) = struct.unpack_from("<B", mv, off)
+        off += 1
+        dtype = _np.dtype(bytes(mv[off:off + name_len]).decode("ascii"))
+        off += name_len
+        (ndim,) = struct.unpack_from("<B", mv, off)
+        off += 1
+        shape = struct.unpack_from("<%dQ" % ndim, mv, off) if ndim else ()
+        off += 8 * ndim
+        (nbytes,) = struct.unpack_from("<Q", mv, off)
+        off += 8
+        # views the frame buffer (writable bytearray) — no extra copy
+        tensors.append(_np.frombuffer(mv[off:off + nbytes],
+                                      dtype=dtype).reshape(shape))
+        off += nbytes
+    return kind, meta, tensors
+
+
+def _rpc_call(sock, kind, meta=None, tensors=()):
+    """Round-trip one request on *sock*; raises on an 'err' reply."""
+    _send_frame(sock, kind, meta, tensors)
+    rkind, rmeta, rtensors = _recv_frame(sock)
+    if rkind != _MSG_REPLY:
+        raise ConnectionError("protocol desync: reply kind %d" % rkind)
+    if rmeta.get("status") != "ok":
+        raise MXNetError("kvstore server error: %s" % rmeta.get("msg"))
+    return rmeta, rtensors
 
 
 class KVStoreServer:
@@ -330,6 +440,7 @@ class KVStoreServer:
                 continue
             except OSError:
                 break
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             t = threading.Thread(target=self._serve_conn, args=(conn,),
                                  daemon=True)
             t.start()
@@ -363,120 +474,116 @@ class KVStoreServer:
     def _serve_conn(self, conn):
         try:
             while True:
-                msg = _recv_msg(conn)
-                kind = msg[0]
-                if kind == _MSG_INIT:
-                    _, key, val = msg
-                    with self.lock:
-                        if key not in self.store:
-                            self.store[key] = nd.array(val)
-                    _send_msg(conn, ("ok",))
-                elif kind == _MSG_PUSH:
-                    _, key, val, meta = msg
-                    if meta and meta.get("compressed"):
-                        codes = self._quant_mod.unpack_2bit(
-                            val, meta["n"]).astype(
-                            _np.float32) * meta["threshold"]
-                        val = codes.reshape(meta["shape"])
-                    elif meta and meta.get("rsp"):
-                        # row-sparse wire format: (row_ids, row values);
-                        # reconstruct dense for aggregation/updater
-                        # (reference: kvstore_dist_server.h
-                        # DataHandleRowSparse)
-                        idx, vals = val
-                        dense = _np.zeros(meta["shape"], vals.dtype)
-                        _np.add.at(dense, idx, vals)
-                        val = dense
-                    try:
-                        if self.sync:
-                            self._push_sync(key, val)
-                        else:
-                            self._apply(key, val)
-                        _send_msg(conn, ("ok",))
-                    except MXNetError as e:
-                        # timeout/desync: report to the worker instead of
-                        # killing this handler thread silently
-                        _send_msg(conn, ("err", str(e)))
-                elif kind == _MSG_PULL:
-                    _, key = msg
-                    with self.lock:
-                        arr = self.store[key].asnumpy()
-                    _send_msg(conn, ("ok", arr))
-                elif kind == _MSG_ROWPULL:
-                    # server-side row retain: only the requested rows go
-                    # on the wire (reference: kvstore_dist_server.h
-                    # row-sparse pull path).  Out-of-range/negative ids
-                    # return zero rows (retain semantics) instead of
-                    # wrapping or killing the handler thread.
-                    _, key, row_ids = msg
-                    with self.lock:
-                        full = self.store[key].asnumpy()
-                    ids = _np.asarray(row_ids, _np.int64)
-                    valid = (ids >= 0) & (ids < full.shape[0])
-                    rows = full[_np.clip(ids, 0, full.shape[0] - 1)]
-                    rows[~valid] = 0
-                    _send_msg(conn, ("ok", rows))
-                elif kind == _MSG_BARRIER:
-                    rank = msg[1] if len(msg) > 1 else 0
-                    rnd = msg[2] if len(msg) > 2 else 0
-                    try:
-                        self._barrier(rank, rnd)
-                        _send_msg(conn, ("ok",))
-                    except MXNetError as e:
-                        _send_msg(conn, ("err", str(e)))
-                elif kind == _MSG_HEARTBEAT:
-                    _, node_id = msg
-                    with self.lock:
-                        self.heartbeats[node_id] = time.time()
-                    _send_msg(conn, ("ok",))
-                elif kind == _MSG_DEADQUERY:
-                    _, timeout_s = msg
-                    now = time.time()
-                    with self.lock:
-                        dead = [n for n, ts in self.heartbeats.items()
-                                if now - ts > timeout_s]
-                    _send_msg(conn, ("ok", dead))
-                elif kind == _MSG_SET_OPT:
-                    _, blob = msg
-                    optimizer = pickle.loads(blob)
-                    self.updater = self._opt_mod.get_updater(optimizer)
-                    _send_msg(conn, ("ok",))
-                elif kind == _MSG_CMD:
-                    # rank-0 command channel (reference: kvstore.h
-                    # SendCommandToServers:377); "mode" declares the
-                    # consistency model so one server binary serves both
-                    # dist_sync and dist_async launches; "profiler:*"
-                    # drives this server process's profiler (reference:
-                    # kvstore.h:43-56, test_server_profiling.py)
-                    head = msg[1] if len(msg) >= 2 else ""
-                    body = msg[2] if len(msg) >= 3 else None
-                    try:
-                        if head == "mode":
-                            self.sync = "async" not in str(body)
-                        elif head == "profiler:set_config":
-                            cfg = dict(body)
-                            if "filename" in cfg and self.server_id:
-                                # each server of a group writes its own
-                                # trace (multi-server dumps must not
-                                # clobber one file)
-                                base, ext = os.path.splitext(
-                                    cfg["filename"])
-                                cfg["filename"] = "%s.server%d%s" % (
-                                    base, self.server_id, ext)
-                            self._prof_mod.set_config(**cfg)
-                        elif head == "profiler:set_state":
-                            self._prof_mod.set_state(str(body))
-                        elif head == "profiler:dump":
-                            self._prof_mod.dump(finished=bool(body))
-                        _send_msg(conn, ("ok",))
-                    except Exception as e:
-                        _send_msg(conn, ("err", str(e)))
-                elif kind == _MSG_STOP:
+                kind, meta, tensors = _recv_frame(conn)
+                if kind == _MSG_STOP:
                     self._stop = True
-                    _send_msg(conn, ("ok",))
+                    _send_frame(conn, _MSG_REPLY, {"status": "ok"})
                     return
+                # every other message replies exactly once; ANY handler
+                # exception becomes an 'err' reply instead of killing
+                # this thread and leaving the worker blocked in recv
+                try:
+                    rmeta, rtensors = self._dispatch(kind, meta, tensors)
+                except MXNetError as e:
+                    rmeta, rtensors = {"status": "err", "msg": str(e)}, ()
+                except Exception as e:
+                    rmeta, rtensors = {"status": "err", "msg": "%s: %s"
+                                       % (type(e).__name__, e)}, ()
+                rmeta.setdefault("status", "ok")
+                _send_frame(conn, _MSG_REPLY, rmeta, rtensors)
         except (ConnectionError, OSError):
             return
+
+    def _dispatch(self, kind, meta, tensors):
+        """Handle one request; returns (reply_meta, reply_tensors)."""
+        if kind == _MSG_INIT:
+            key = meta["key"]
+            with self.lock:
+                if key not in self.store:
+                    self.store[key] = nd.array(tensors[0])
+            return {}, ()
+        if kind == _MSG_PUSH:
+            key = meta["key"]
+            if meta.get("compressed"):
+                codes = self._quant_mod.unpack_2bit(
+                    tensors[0], meta["n"]).astype(
+                    _np.float32) * meta["threshold"]
+                val = codes.reshape(meta["shape"])
+            elif meta.get("rsp"):
+                # row-sparse wire format: (row_ids, row values);
+                # reconstruct dense for aggregation/updater
+                # (reference: kvstore_dist_server.h DataHandleRowSparse)
+                idx, vals = tensors
+                dense = _np.zeros(tuple(meta["shape"]), vals.dtype)
+                _np.add.at(dense, _np.asarray(idx, _np.int64), vals)
+                val = dense
+            else:
+                val = tensors[0]
+            if self.sync:
+                self._push_sync(key, val)
+            else:
+                self._apply(key, val)
+            return {}, ()
+        if kind == _MSG_PULL:
+            with self.lock:
+                arr = self.store[meta["key"]].asnumpy()
+            return {}, (arr,)
+        if kind == _MSG_ROWPULL:
+            # server-side row retain: only the requested rows go on the
+            # wire (reference: kvstore_dist_server.h row-sparse pull
+            # path).  Out-of-range/negative ids return zero rows (retain
+            # semantics) instead of wrapping.
+            with self.lock:
+                full = self.store[meta["key"]].asnumpy()
+            ids = _np.asarray(tensors[0], _np.int64)
+            valid = (ids >= 0) & (ids < full.shape[0])
+            rows = full[_np.clip(ids, 0, full.shape[0] - 1)]
+            rows[~valid] = 0
+            return {}, (rows,)
+        if kind == _MSG_BARRIER:
+            self._barrier(meta.get("rank", 0), meta.get("round", 0))
+            return {}, ()
+        if kind == _MSG_HEARTBEAT:
+            with self.lock:
+                self.heartbeats[meta["node"]] = time.time()
+            return {}, ()
+        if kind == _MSG_DEADQUERY:
+            now = time.time()
+            with self.lock:
+                dead = [n for n, ts in self.heartbeats.items()
+                        if now - ts > meta["timeout"]]
+            return {"dead": dead}, ()
+        if kind == _MSG_SET_OPT:
+            # control plane: optimizer ships pickled from rank 0, same
+            # trust stance as the reference's set_optimizer
+            optimizer = pickle.loads(tensors[0].tobytes())
+            self.updater = self._opt_mod.get_updater(optimizer)
+            return {}, ()
+        if kind == _MSG_CMD:
+            # rank-0 command channel (reference: kvstore.h
+            # SendCommandToServers:377); "mode" declares the consistency
+            # model so one server binary serves both dist_sync and
+            # dist_async launches; "profiler:*" drives this server
+            # process's profiler (reference: kvstore.h:43-56)
+            head = meta.get("head", "")
+            body = meta.get("body")
+            if head == "mode":
+                self.sync = "async" not in str(body)
+            elif head == "profiler:set_config":
+                cfg = dict(body)
+                if "filename" in cfg and self.server_id:
+                    # each server of a group writes its own trace
+                    # (multi-server dumps must not clobber one file)
+                    base, ext = os.path.splitext(cfg["filename"])
+                    cfg["filename"] = "%s.server%d%s" % (
+                        base, self.server_id, ext)
+                self._prof_mod.set_config(**cfg)
+            elif head == "profiler:set_state":
+                self._prof_mod.set_state(str(body))
+            elif head == "profiler:dump":
+                self._prof_mod.dump(finished=bool(body))
+            return {}, ()
+        raise MXNetError("unknown kvstore message kind %d" % kind)
 
     def _push_sync(self, key, val):
         """Aggregate until all workers pushed, then apply once
@@ -564,6 +671,7 @@ class KVStoreDist(KVStoreBase):
         deadline = time.time() + 30
         for s in range(self._num_servers):
             sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             while True:
                 try:
                     sock.connect((host, port + s))
@@ -579,7 +687,7 @@ class KVStoreDist(KVStoreBase):
         self._barrier_round = 0
         # declare the consistency mode to every server (idempotent)
         for s in range(self._num_servers):
-            self._rpc((_MSG_CMD, "mode", name), server=s)
+            self._rpc(_MSG_CMD, {"head": "mode", "body": name}, server=s)
         self._start_heartbeat()
         # register for profiler server-command routing (reference:
         # profiler.py set_kvstore_handle)
@@ -602,11 +710,13 @@ class KVStoreDist(KVStoreBase):
                         if s not in socks:
                             hs = socket.socket(socket.AF_INET,
                                                socket.SOCK_STREAM)
+                            hs.setsockopt(socket.IPPROTO_TCP,
+                                          socket.TCP_NODELAY, 1)
                             hs.settimeout(5)
                             hs.connect((host, port + s))
                             socks[s] = hs
-                        _send_msg(socks[s], (_MSG_HEARTBEAT, node))
-                        _recv_msg(socks[s])
+                        _rpc_call(socks[s], _MSG_HEARTBEAT,
+                                  {"node": node})
                     except (ConnectionError, OSError):
                         # transient: server restarting; retry next beat
                         socks.pop(s, None)
@@ -628,7 +738,8 @@ class KVStoreDist(KVStoreBase):
     def num_dead_node(self, node_id="all", timeout=60):
         """Count nodes whose heartbeat is older than *timeout* seconds
         (reference: kvstore_dist.h:119-128 get_num_dead_node)."""
-        dead = self._rpc((_MSG_DEADQUERY, timeout), server=0)[1]
+        dead = self._rpc(_MSG_DEADQUERY, {"timeout": timeout},
+                         server=0)[0]["dead"]
         if node_id == "all":
             return len(dead)
         return int(("worker%d" % node_id) in dead)
@@ -645,15 +756,12 @@ class KVStoreDist(KVStoreBase):
     def num_workers(self):
         return self._num_workers
 
-    def _rpc(self, msg, server=None, key=None):
+    def _rpc(self, kind, meta=None, tensors=(), server=None, key=None):
+        """One framed round-trip; returns (reply_meta, reply_tensors)."""
         s = (server if server is not None
              else self._server_for_key(key) if key is not None else 0)
         with self._locks[s]:
-            _send_msg(self._socks[s], msg)
-            reply = _recv_msg(self._socks[s])
-        if reply and reply[0] == "err":
-            raise MXNetError("kvstore server error: %s" % reply[1])
-        return reply
+            return _rpc_call(self._socks[s], kind, meta, tensors)
 
     def _shard_splits(self, n):
         """Contiguous per-server chunk lengths for a flat size-n array."""
@@ -662,25 +770,32 @@ class KVStoreDist(KVStoreBase):
                 for i in range(self._num_servers)]
 
     def init(self, key, value):
+        from .ndarray import sparse as _sp
         keys, values = _key_list(key, value)
         for k, vs in zip(keys, values):
             arr = vs[0].asnumpy()
             # the sharding decision is taken ONCE at init and recorded:
             # later compression toggles must not change a key's layout
-            # (every worker runs init, so every worker records it)
+            # (every worker runs init, so every worker records it).
+            # Sparse-typed keys are NEVER sharded: their pushes travel in
+            # the compact row_sparse wire format to the hash-picked
+            # server, which would silently miss the '#shard' keys — the
+            # canonical big-embedding case would train on garbage.
             if (self._num_servers > 1 and arr.size > self._big_bound
-                    and not self._compression):
+                    and not self._compression
+                    and not isinstance(vs[0], _sp.BaseSparseNDArray)):
                 self._sharded_keys.add(k)
             if self._rank == 0:
                 if k in self._sharded_keys:
                     flat = arr.ravel()
                     off = 0
                     for s, ln in enumerate(self._shard_splits(arr.size)):
-                        self._rpc((_MSG_INIT, "%s#shard%d" % (k, s),
-                                   flat[off:off + ln]), server=s)
+                        self._rpc(_MSG_INIT,
+                                  {"key": "%s#shard%d" % (k, s)},
+                                  (flat[off:off + ln],), server=s)
                         off += ln
                 else:
-                    self._rpc((_MSG_INIT, k, arr), key=k)
+                    self._rpc(_MSG_INIT, {"key": k}, (arr,), key=k)
         self.barrier()
 
     def push(self, key, value, priority=0):
@@ -691,14 +806,18 @@ class KVStoreDist(KVStoreBase):
                 total = total + v
             from .ndarray import sparse as _sp
             if isinstance(total, _sp.RowSparseNDArray) and \
-                    not self._compression:
+                    not self._compression and \
+                    k not in self._sharded_keys:
                 # compact wire format: only touched rows travel
-                # (reference: kvstore_dist.h PushRowSparse)
-                meta = {"rsp": True,
-                        "shape": tuple(int(s) for s in total.shape)}
-                arr = (_np.asarray(total._aux[0]),
-                       _np.asarray(total._data))
-                self._rpc((_MSG_PUSH, k, arr, meta), key=k)
+                # (reference: kvstore_dist.h PushRowSparse).  A key that
+                # was initialized dense AND sharded lives only as
+                # '#shard' sub-keys, so its sparse gradients fall through
+                # to the dense sharded path below.
+                self._rpc(_MSG_PUSH,
+                          {"key": k, "rsp": True,
+                           "shape": [int(s) for s in total.shape]},
+                          (_np.asarray(total._aux[0]),
+                           _np.asarray(total._data)), key=k)
                 continue
             if isinstance(total, _sp.BaseSparseNDArray):
                 total = total.todense()
@@ -710,11 +829,12 @@ class KVStoreDist(KVStoreBase):
                 flat = arr.ravel()
                 off = 0
                 for s, ln in enumerate(self._shard_splits(arr.size)):
-                    self._rpc((_MSG_PUSH, "%s#shard%d" % (k, s),
-                               flat[off:off + ln], None), server=s)
+                    self._rpc(_MSG_PUSH,
+                              {"key": "%s#shard%d" % (k, s)},
+                              (flat[off:off + ln],), server=s)
                     off += ln
                 continue
-            meta = None
+            meta = {"key": k}
             if self._compression and \
                     self._compression.get("type") == "2bit":
                 from .ops.quantization import pack_2bit
@@ -726,10 +846,10 @@ class KVStoreDist(KVStoreBase):
                     .astype(_np.int8)
                 self._residual[k] = acc - codes * threshold
                 packed, n_ = pack_2bit(codes)
-                meta = {"compressed": True, "threshold": threshold,
-                        "n": n_, "shape": arr.shape}
+                meta.update(compressed=True, threshold=threshold,
+                            n=int(n_), shape=list(arr.shape))
                 arr = packed
-            self._rpc((_MSG_PUSH, k, arr, meta), key=k)
+            self._rpc(_MSG_PUSH, meta, (arr,), key=k)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys, outs = _key_list(key, out)
@@ -744,11 +864,13 @@ class KVStoreDist(KVStoreBase):
                 parts = []
                 for s, _ln in enumerate(self._shard_splits(size)):
                     parts.append(self._rpc(
-                        (_MSG_PULL, "%s#shard%d" % (k, s)), server=s)[1])
+                        _MSG_PULL, {"key": "%s#shard%d" % (k, s)},
+                        server=s)[1][0])
                 arr = nd.array(_np.concatenate(
                     [p.ravel() for p in parts]).reshape(shape))
             else:
-                arr = nd.array(self._rpc((_MSG_PULL, k), key=k)[1])
+                arr = nd.array(
+                    self._rpc(_MSG_PULL, {"key": k}, key=k)[1][0])
             for o in os_:
                 arr.copyto(o)
 
@@ -767,7 +889,7 @@ class KVStoreDist(KVStoreBase):
                 if cache_key not in fetched:
                     # server-side retain: only requested rows come back
                     fetched[cache_key] = self._rpc(
-                        (_MSG_ROWPULL, k, rid_np), key=k)[1]
+                        _MSG_ROWPULL, {"key": k}, (rid_np,), key=k)[1][0]
                 vals = fetched[cache_key]
                 if isinstance(o, _sp.RowSparseNDArray):
                     o._data = _jnp.asarray(vals)
@@ -787,21 +909,22 @@ class KVStoreDist(KVStoreBase):
         """Ship the optimizer to every server (reference: kvstore.py
         set_optimizer:450 pickles the optimizer to servers)."""
         if self._rank == 0:
-            blob = pickle.dumps(optimizer)
+            blob = _np.frombuffer(pickle.dumps(optimizer), _np.uint8)
             for s in range(self._num_servers):
-                self._rpc((_MSG_SET_OPT, blob), server=s)
+                self._rpc(_MSG_SET_OPT, None, (blob,), server=s)
         self.barrier()
 
     def barrier(self):
         # server 0 coordinates; the round number makes overlapping
         # barriers under worker skew unambiguous
         self._barrier_round += 1
-        self._rpc((_MSG_BARRIER, self._rank, self._barrier_round),
+        self._rpc(_MSG_BARRIER,
+                  {"rank": self._rank, "round": self._barrier_round},
                   server=0)
 
     def _send_command_to_servers(self, head, body):
         for s in range(self._num_servers):
-            self._rpc((_MSG_CMD, head, body), server=s)
+            self._rpc(_MSG_CMD, {"head": head, "body": body}, server=s)
 
     def stop_server(self):
         self._closed = True
@@ -810,7 +933,7 @@ class KVStoreDist(KVStoreBase):
             _prof.set_kvstore_handle(None)
         for s in range(self._num_servers):
             try:
-                self._rpc((_MSG_STOP,), server=s)
+                self._rpc(_MSG_STOP, server=s)
             except ConnectionError:
                 pass
 

@@ -297,8 +297,16 @@ class DataLoader:
                         else _np_batchify)
             try:
                 import pickle
-                pickle.dumps(self._dataset)
-                pickle.dumps(batchify)
+
+                # stream to a discarding sink: pickle.dumps would
+                # materialize a full serialized copy of the dataset
+                # (momentarily doubling memory for big in-memory sets)
+                # just to learn whether pickling WORKS
+                class _Null:
+                    def write(self, b):
+                        return len(b)
+                pickle.Pickler(_Null()).dump(self._dataset)
+                pickle.Pickler(_Null()).dump(batchify)
                 self._mp_ok = True
             except Exception:
                 import warnings

@@ -39,7 +39,14 @@ _NEG_INF = -1e30
 
 
 def attention_reference(q, k, v, causal=False, sm_scale=None):
-    """O(S^2)-memory einsum attention — the numeric oracle for tests."""
+    """O(S^2)-memory einsum attention — the numeric oracle for tests.
+
+    Degenerate-row convention (shared by all paths in this module): a
+    causal query row that can see NO keys (seq_q > seq_k under the
+    aligned-ends convention) outputs zeros and contributes zero
+    gradient — softmax over an empty visible set is undefined, and both
+    the uniform-average and NaN alternatives leak masked content or
+    poison training."""
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
@@ -48,7 +55,10 @@ def attention_reference(q, k, v, causal=False, sm_scale=None):
         qlen, klen = s.shape[-2], s.shape[-1]
         mask = jnp.tril(jnp.ones((qlen, klen), bool), klen - qlen)
         s = jnp.where(mask, s, _NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
+        p = jax.nn.softmax(s, axis=-1)
+        p = p * mask.any(-1)[:, None]  # zero fully-masked rows
+    else:
+        p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)
                       ).astype(q.dtype)
 
@@ -69,6 +79,18 @@ def _online_softmax_update(o, m, l, s, vb):
     o = o * alpha[..., None] + jnp.einsum(
         "bhqk,bhkd->bhqd", p, vb.astype(jnp.float32))
     return o, m_new, l
+
+
+def _finalize_softmax(o, m, l):
+    """Final division of an online-softmax accumulation, applying the
+    degenerate-row convention: rows whose running max *m* never rose
+    above the _NEG_INF sentinel saw no visible key and output zeros
+    (with zero gradient — l_safe keeps the untaken 0/0 branch out of
+    the vjp, where 0 * nan would poison it).  Shared by the chunked and
+    ring paths; the flash kernel encodes the same rule in-kernel."""
+    degenerate = m <= _NEG_INF * 0.5
+    l_safe = jnp.where(degenerate, 1.0, l)
+    return jnp.where(degenerate[..., None], 0.0, o / l_safe[..., None])
 
 def _chunked_attention(q, k, v, causal=False, sm_scale=None, chunk=512):
     """Blockwise attention with online softmax over K chunks.
@@ -115,7 +137,7 @@ def _chunked_attention(q, k, v, causal=False, sm_scale=None, chunk=512):
     l0 = jnp.zeros((b, h, sq), jnp.float32)
     (o, m, l), _ = jax.lax.scan(
         body, (o0, m0, l0), (jnp.arange(nchunk), kc, vc))
-    return (o / l[..., None]).astype(q.dtype)
+    return _finalize_softmax(o, m, l).astype(q.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -177,10 +199,22 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_and_scratch,
 
     @pl.when(ik == nk - 1)
     def _finish():
-        o_ref[0] = (acc_ref[...] / l_ref[...][:, None]).astype(o_ref.dtype)
+        # rows whose running max never rose above the sentinel saw no
+        # visible key (causal with seq_q > seq_k): emit zeros, and a
+        # +1e30 lse so the backward's recomputed p = exp(s - lse)
+        # underflows to 0 for them — zero output, zero gradient, same
+        # convention as attention_reference/_chunked_attention
+        m = m_ref[...]
+        l = l_ref[...]
+        degenerate = m <= _NEG_INF * 0.5
+        l_safe = jnp.where(degenerate, 1.0, l)
+        o_ref[0] = jnp.where(degenerate[:, None], 0.0,
+                             acc_ref[...] / l_safe[:, None]
+                             ).astype(o_ref.dtype)
         if lse_ref is not None:
             # logsumexp residual for the flash backward
-            lse_ref[0] = m_ref[...] + jnp.log(l_ref[...])
+            lse_ref[0] = jnp.where(degenerate, -_NEG_INF,
+                                   m + jnp.log(l_safe))
 
 
 def _pad_bh(x, s_pad, d_pad):

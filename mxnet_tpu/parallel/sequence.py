@@ -22,7 +22,8 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 from jax.experimental.shard_map import shard_map
 
-from ..ops.attention import _NEG_INF, _online_softmax_update
+from ..ops.attention import (_NEG_INF, _finalize_softmax,
+                             _online_softmax_update)
 
 __all__ = ["ring_attention_shard", "sequence_parallel_attention"]
 
@@ -70,9 +71,7 @@ def ring_attention_shard(q, k, v, axis_name, causal=False, sm_scale=None):
     l0 = jnp.zeros((b, h, s_loc), jnp.float32)
     (o, m, l, _, _), _ = jax.lax.scan(
         jax.checkpoint(body), (o0, m0, l0, k, v), jnp.arange(n))
-    # a fully-masked row degenerates to uniform weights (exp(0) per key),
-    # matching softmax-over-_NEG_INF in the reference path; l > 0 always
-    return (o / l[..., None]).astype(q.dtype)
+    return _finalize_softmax(o, m, l).astype(q.dtype)
 
 
 def sequence_parallel_attention(q, k, v, mesh, axis="sp", causal=False,

@@ -13,6 +13,40 @@ import mxnet_tpu as mx
 from mxnet_tpu import nd
 
 
+def test_wire_frame_roundtrip():
+    """Raw-buffer wire framing: dtypes (incl. bfloat16 extension),
+    0-d scalars, empty and multi-tensor frames all round-trip."""
+    import socket
+    import ml_dtypes
+    from mxnet_tpu._kvstore_impl import _send_frame, _recv_frame
+
+    cases = [
+        np.arange(12, dtype=np.float32).reshape(3, 4),
+        np.array(7.0, np.float32),                       # 0-d scalar
+        np.ones((4,), ml_dtypes.bfloat16),               # extension dtype
+        np.arange(5, dtype=np.int64),
+        np.zeros((0, 3), np.float32),                    # empty
+        np.asfortranarray(np.arange(6.).reshape(2, 3)),  # non-C-contig
+    ]
+    a, b = socket.socketpair()
+    try:
+        _send_frame(a, 42, {"key": "w", "n": 3}, cases)
+        kind, meta, tensors = _recv_frame(b)
+        assert kind == 42 and meta == {"key": "w", "n": 3}
+        assert len(tensors) == len(cases)
+        for got, want in zip(tensors, cases):
+            assert got.shape == want.shape, (got.shape, want.shape)
+            assert got.dtype == want.dtype
+            np.testing.assert_array_equal(
+                np.asarray(got, np.float64), np.asarray(want, np.float64))
+        _send_frame(b, 7)   # meta-less control frame
+        kind, meta, tensors = _recv_frame(a)
+        assert kind == 7 and meta == {} and tensors == []
+    finally:
+        a.close()
+        b.close()
+
+
 def test_local_push_pull():
     kv = mx.kv.create("local")
     kv.init(3, nd.ones((2, 3)))
@@ -303,15 +337,28 @@ kv = mx.kv.create("dist_sync")
 big = nd.array(np.arange(20, dtype=np.float32).reshape(4, 5))
 kv.init("big", big)           # 20 elts > bound=10 -> sharded, 2 servers
 kv.init("small", nd.zeros((3,)))
+# big sparse key: 24 elts > bound, but sparse keys must NOT be sharded —
+# their pushes ride the compact rsp wire to one hash-picked server
+# (regression: sharding them silently corrupted training)
+from mxnet_tpu.ndarray import sparse
+kv.init("emb", sparse.zeros("row_sparse", (6, 4)))
 kv.push("big", nd.ones((4, 5)) * (rank + 1))
 kv.push("small", nd.ones((3,)) * (rank + 1))
+grad = sparse.RowSparseNDArray(nd.ones((2, 4)) * (rank + 1),
+                               nd.array(np.array([1, 4], np.int32)),
+                               (6, 4))
+kv.push("emb", grad)
 kv.barrier()
 out_b = nd.zeros((4, 5))
 out_s = nd.zeros((3,))
 kv.pull("big", out=out_b)
 kv.pull("small", out=out_s)
+out_e = sparse.zeros("row_sparse", (6, 4))
+kv.row_sparse_pull("emb", out=out_e, row_ids=nd.array([1, 4]))
 print("RESULT", rank, (out_b.asnumpy().ravel().tolist(),
-                       out_s.asnumpy().tolist()), flush=True)
+                       out_s.asnumpy().tolist(),
+                       out_e.todense().asnumpy().ravel().tolist()),
+      flush=True)
 kv.barrier()
 if rank == 0:
     kv.stop_server()
@@ -355,11 +402,15 @@ def test_dist_multi_server_sharding():
         line = [l for l in stdout.decode().splitlines()
                 if l.startswith("RESULT")][0]
         parts = line.split(" ", 2)[2]
-        big_vals, small_vals = eval(parts)
+        big_vals, small_vals, emb_vals = eval(parts)
         # sync aggregate 1+2=3 on every element of both sharded and
         # unsharded keys
         np.testing.assert_allclose(big_vals, [3.0] * 20)
         np.testing.assert_allclose(small_vals, [3.0] * 3)
+        # sparse key: rows 1 and 4 sum to 3, all other rows stay 0
+        want = np.zeros((6, 4), np.float32)
+        want[[1, 4]] = 3.0
+        np.testing.assert_allclose(emb_vals, want.ravel())
     for s in servers:
         s.wait(timeout=30)
 

@@ -118,6 +118,13 @@ def test_lr_scheduler_factor():
     assert sched(5) == 1.0
     assert sched(11) == 0.5
     assert sched(21) == 0.25
+    # pure schedule: out-of-order and repeated queries are consistent
+    assert sched(11) == 0.5 and sched(5) == 1.0
+    # the stop floor applies only to DECAYED values
+    tiny = FactorScheduler(step=10, factor=0.5, base_lr=1e-9,
+                           stop_factor_lr=1e-8)
+    assert tiny(5) == 1e-9
+    assert tiny(11) == 1e-8
 
 
 def test_lr_scheduler_in_optimizer():
