@@ -1,40 +1,18 @@
 """ONNX interop (reference: python/mxnet/contrib/onnx — mx2onnx
 exporter + onnx2mx importer).
 
-Stance: the ``onnx`` package is not available in this environment
-(zero-egress image), so the converters are gated, exactly like the
-reference gates on ``import onnx``.  When onnx IS installed, a
-StableHLO-era build has a better path than the reference's op-by-op
-converter: hybridize the model to one XLA program and use
-jax.export/ONNX tooling.  ``export_model``/``import_model`` keep the
-reference entry-point names and raise with that guidance until onnx is
-present."""
+The ``onnx`` wheel does not exist in this image, but an .onnx file is
+just a serialized protobuf: ``_proto.py`` implements the required
+``ModelProto`` subset directly on the wire format, ``mx2onnx.py``
+converts Symbol graphs + params to it, and ``onnx2mx.py`` parses ONNX
+files back into ``(sym, arg_params, aux_params)``.  Entry-point names
+match the reference (``export_model``; ``import_model``), so reference
+user code ports unchanged.
+"""
 
 from __future__ import annotations
 
+from .mx2onnx import export_model  # noqa: F401
+from .onnx2mx import import_model  # noqa: F401
+
 __all__ = ["export_model", "import_model"]
-
-_MSG = ("onnx is not installed in this environment. The reference "
-        "(python/mxnet/contrib/onnx) gates on `import onnx` the same "
-        "way. With onnx available, export hybridized models through "
-        "jax.export (one XLA program) rather than per-op conversion.")
-
-
-def export_model(*args, **kwargs):
-    try:
-        import onnx  # noqa: F401
-    except ImportError:
-        raise ImportError(_MSG)
-    raise NotImplementedError(
-        "onnx export for this build is tracked but not yet implemented; "
-        "use the checkpoint format (prefix-symbol.json + params) for "
-        "interop with reference tooling")
-
-
-def import_model(*args, **kwargs):
-    try:
-        import onnx  # noqa: F401
-    except ImportError:
-        raise ImportError(_MSG)
-    raise NotImplementedError(
-        "onnx import for this build is tracked but not yet implemented")

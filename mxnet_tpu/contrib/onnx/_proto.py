@@ -1,0 +1,330 @@
+"""Minimal ONNX protobuf wire codec.
+
+The ``onnx`` wheel does not exist in this image, but an .onnx file is
+just a serialized ``ModelProto`` — and protobuf's wire format is three
+primitives (varints, 64/32-bit scalars, length-delimited blobs).  This
+module implements exactly the message subset the exporter/importer
+need, with the field numbers from the public ``onnx/onnx.proto`` schema
+(stable since IR version 3).  ``tools`` like ``protoc
+--decode=onnx.ModelProto`` read the output directly (see
+tests/test_onnx.py), and files produced by real onnx installations
+parse with the decoder here.
+
+Reference entry points mirrored:
+``python/mxnet/contrib/onnx/mx2onnx/export_model.py`` and
+``onnx2mx/import_model.py``.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as _np
+
+# ONNX TensorProto.DataType enum values
+DT_FLOAT = 1
+DT_UINT8 = 2
+DT_INT8 = 3
+DT_INT32 = 6
+DT_INT64 = 7
+DT_BOOL = 9
+DT_FLOAT16 = 10
+DT_DOUBLE = 11
+DT_BFLOAT16 = 16
+
+_NP_TO_DT = {
+    "float32": DT_FLOAT, "uint8": DT_UINT8, "int8": DT_INT8,
+    "int32": DT_INT32, "int64": DT_INT64, "bool": DT_BOOL,
+    "float16": DT_FLOAT16, "float64": DT_DOUBLE, "bfloat16": DT_BFLOAT16,
+}
+DT_TO_NP = {v: k for k, v in _NP_TO_DT.items()}
+
+# AttributeProto.AttributeType
+AT_FLOAT, AT_INT, AT_STRING, AT_TENSOR = 1, 2, 3, 4
+AT_FLOATS, AT_INTS, AT_STRINGS = 6, 7, 8
+
+
+# ---------------------------------------------------------------------------
+# wire primitives
+# ---------------------------------------------------------------------------
+
+def _varint(n):
+    n &= (1 << 64) - 1
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _field_varint(field, value):
+    return _varint(field << 3 | 0) + _varint(value)
+
+
+def _field_bytes(field, payload):
+    if isinstance(payload, str):
+        payload = payload.encode()
+    return _varint(field << 3 | 2) + _varint(len(payload)) + bytes(payload)
+
+
+def _field_float(field, value):
+    return _varint(field << 3 | 5) + struct.pack("<f", value)
+
+
+# ---------------------------------------------------------------------------
+# message builders (each returns serialized bytes)
+# ---------------------------------------------------------------------------
+
+def tensor(name, arr):
+    """TensorProto: dims=1, data_type=2, name=8, raw_data=9."""
+    arr = _np.ascontiguousarray(arr)
+    dt = _NP_TO_DT[arr.dtype.name]
+    out = b"".join(_field_varint(1, int(d)) for d in arr.shape)
+    out += _field_varint(2, dt)
+    out += _field_bytes(8, name)
+    out += _field_bytes(9, arr.tobytes())
+    return out
+
+
+def attribute(name, value):
+    """AttributeProto: name=1, f=2, i=3, s=4, t=5, floats=7, ints=8,
+    strings=9, type=20."""
+    out = _field_bytes(1, name)
+    if isinstance(value, bool):
+        out += _field_varint(3, int(value)) + _field_varint(20, AT_INT)
+    elif isinstance(value, int):
+        out += _field_varint(3, value) + _field_varint(20, AT_INT)
+    elif isinstance(value, float):
+        out += _field_float(2, value) + _field_varint(20, AT_FLOAT)
+    elif isinstance(value, (str, bytes)):
+        out += _field_bytes(4, value) + _field_varint(20, AT_STRING)
+    elif isinstance(value, _np.ndarray):
+        out += _field_bytes(5, tensor(name + "_t", value))
+        out += _field_varint(20, AT_TENSOR)
+    elif isinstance(value, (list, tuple)):
+        if value and isinstance(value[0], float):
+            for v in value:
+                out += _field_float(7, v)
+            out += _field_varint(20, AT_FLOATS)
+        elif value and isinstance(value[0], (str, bytes)):
+            for v in value:
+                out += _field_bytes(9, v)
+            out += _field_varint(20, AT_STRINGS)
+        else:
+            for v in value:
+                out += _field_varint(8, int(v))
+            out += _field_varint(20, AT_INTS)
+    else:
+        raise TypeError("unsupported attribute %r=%r" % (name, value))
+    return out
+
+
+def node(op_type, inputs, outputs, name="", attrs=None):
+    """NodeProto: input=1, output=2, name=3, op_type=4, attribute=5."""
+    out = b"".join(_field_bytes(1, i) for i in inputs)
+    out += b"".join(_field_bytes(2, o) for o in outputs)
+    if name:
+        out += _field_bytes(3, name)
+    out += _field_bytes(4, op_type)
+    for k, v in (attrs or {}).items():
+        out += _field_bytes(5, attribute(k, v))
+    return out
+
+
+def _tensor_shape(shape):
+    """TensorShapeProto: dim=1; Dimension: dim_value=1, dim_param=2."""
+    out = b""
+    for d in shape:
+        if isinstance(d, str):
+            out += _field_bytes(1, _field_bytes(2, d))
+        else:
+            out += _field_bytes(1, _field_varint(1, int(d)))
+    return out
+
+
+def value_info(name, elem_type, shape):
+    """ValueInfoProto: name=1, type=2; TypeProto: tensor_type=1;
+    TypeProto.Tensor: elem_type=1, shape=2."""
+    tt = _field_varint(1, elem_type) + _field_bytes(2,
+                                                   _tensor_shape(shape))
+    return _field_bytes(1, name) + _field_bytes(2, _field_bytes(1, tt))
+
+
+def graph(nodes, name, initializers, inputs, outputs):
+    """GraphProto: node=1, name=2, initializer=5, input=11, output=12."""
+    out = b"".join(_field_bytes(1, n) for n in nodes)
+    out += _field_bytes(2, name)
+    out += b"".join(_field_bytes(5, t) for t in initializers)
+    out += b"".join(_field_bytes(11, vi) for vi in inputs)
+    out += b"".join(_field_bytes(12, vi) for vi in outputs)
+    return out
+
+
+def model(graph_bytes, opset=9, producer="mxnet_tpu",
+          producer_version="0.4", ir_version=4):
+    """ModelProto: ir_version=1, producer_name=2, producer_version=3,
+    graph=7, opset_import=8; OperatorSetIdProto: domain=1, version=2."""
+    out = _field_varint(1, ir_version)
+    out += _field_bytes(2, producer)
+    out += _field_bytes(3, producer_version)
+    out += _field_bytes(7, graph_bytes)
+    out += _field_bytes(8, _field_bytes(1, "") + _field_varint(2, opset))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# decoder: bytes -> {field: [raw values]} trees
+# ---------------------------------------------------------------------------
+
+def decode_fields(buf):
+    """One-level protobuf decode: {field_number: [values]} where varint
+    fields give ints and length-delimited fields give memoryviews."""
+    mv = memoryview(buf)
+    out = {}
+    off = 0
+    n = len(mv)
+    while off < n:
+        key = 0
+        shift = 0
+        while True:
+            b = mv[off]
+            off += 1
+            key |= (b & 0x7F) << shift
+            shift += 7
+            if not b & 0x80:
+                break
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            val = 0
+            shift = 0
+            while True:
+                b = mv[off]
+                off += 1
+                val |= (b & 0x7F) << shift
+                shift += 7
+                if not b & 0x80:
+                    break
+        elif wire == 2:
+            ln = 0
+            shift = 0
+            while True:
+                b = mv[off]
+                off += 1
+                ln |= (b & 0x7F) << shift
+                shift += 7
+                if not b & 0x80:
+                    break
+            val = mv[off:off + ln]
+            off += ln
+        elif wire == 5:
+            val = struct.unpack_from("<f", mv, off)[0]
+            off += 4
+        elif wire == 1:
+            val = struct.unpack_from("<d", mv, off)[0]
+            off += 8
+        else:
+            raise ValueError("unsupported wire type %d" % wire)
+        out.setdefault(field, []).append(val)
+    return out
+
+
+def _sint(v):
+    """varint -> signed int64 (two's complement)."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def parse_tensor(buf):
+    """TensorProto bytes -> (name, numpy array)."""
+    f = decode_fields(buf)
+    dims = [_sint(d) for d in f.get(1, [])]
+    dt = f[2][0]
+    name = bytes(f[8][0]).decode() if 8 in f else ""
+    np_dt = _np.dtype(DT_TO_NP[dt])
+    if 9 in f:
+        arr = _np.frombuffer(bytes(f[9][0]), np_dt).reshape(dims)
+    elif dt == DT_FLOAT and 4 in f:
+        arr = _np.array(f[4], _np.float32).reshape(dims)
+    elif dt in (DT_INT32, DT_BOOL) and 5 in f:
+        arr = _np.array([_sint(v) for v in f[5]], np_dt).reshape(dims)
+    elif dt == DT_INT64 and 7 in f:
+        arr = _np.array([_sint(v) for v in f[7]], _np.int64).reshape(dims)
+    else:
+        arr = _np.zeros(dims, np_dt)
+    return name, arr
+
+
+def parse_attribute(buf):
+    """AttributeProto bytes -> (name, python value)."""
+    f = decode_fields(buf)
+    name = bytes(f[1][0]).decode()
+    at = f.get(20, [0])[0]
+    if at == AT_FLOAT or (at == 0 and 2 in f):
+        return name, float(f[2][0])
+    if at == AT_INT or (at == 0 and 3 in f):
+        return name, _sint(f[3][0])
+    if at == AT_STRING or (at == 0 and 4 in f):
+        return name, bytes(f[4][0]).decode()
+    if at == AT_TENSOR or (at == 0 and 5 in f):
+        return name, parse_tensor(f[5][0])[1]
+    if at == AT_FLOATS:
+        return name, [float(v) for v in f.get(7, [])]
+    if at == AT_INTS:
+        return name, [_sint(v) for v in f.get(8, [])]
+    if at == AT_STRINGS:
+        return name, [bytes(v).decode() for v in f.get(9, [])]
+    raise ValueError("unsupported attribute type %d for %r" % (at, name))
+
+
+def parse_node(buf):
+    """NodeProto bytes -> dict(op_type, name, inputs, outputs, attrs)."""
+    f = decode_fields(buf)
+    return {
+        "inputs": [bytes(v).decode() for v in f.get(1, [])],
+        "outputs": [bytes(v).decode() for v in f.get(2, [])],
+        "name": bytes(f[3][0]).decode() if 3 in f else "",
+        "op_type": bytes(f[4][0]).decode(),
+        "attrs": dict(parse_attribute(a) for a in f.get(5, [])),
+    }
+
+
+def parse_value_info(buf):
+    """ValueInfoProto bytes -> (name, elem_type, shape)."""
+    f = decode_fields(buf)
+    name = bytes(f[1][0]).decode()
+    elem, shape = DT_FLOAT, []
+    if 2 in f:
+        tp = decode_fields(f[2][0])
+        if 1 in tp:
+            tt = decode_fields(tp[1][0])
+            elem = tt.get(1, [DT_FLOAT])[0]
+            if 2 in tt:
+                for dim in decode_fields(tt[2][0]).get(1, []):
+                    df = decode_fields(dim)
+                    if 1 in df:
+                        shape.append(_sint(df[1][0]))
+                    elif 2 in df:
+                        shape.append(bytes(df[2][0]).decode())
+                    else:
+                        shape.append(0)
+    return name, elem, shape
+
+
+def parse_model(buf):
+    """ModelProto bytes -> dict with graph pieces decoded."""
+    f = decode_fields(buf)
+    g = decode_fields(f[7][0])
+    return {
+        "ir_version": f.get(1, [0])[0],
+        "producer": bytes(f[2][0]).decode() if 2 in f else "",
+        "opset": max((decode_fields(o).get(2, [0])[0]
+                      for o in f.get(8, [])), default=0),
+        "nodes": [parse_node(n) for n in g.get(1, [])],
+        "name": bytes(g[2][0]).decode() if 2 in g else "",
+        "initializers": dict(parse_tensor(t) for t in g.get(5, [])),
+        "inputs": [parse_value_info(v) for v in g.get(11, [])],
+        "outputs": [parse_value_info(v) for v in g.get(12, [])],
+    }

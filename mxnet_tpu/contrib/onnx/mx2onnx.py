@@ -1,0 +1,342 @@
+"""Symbol-graph -> ONNX exporter.
+
+Mirrors ``python/mxnet/contrib/onnx/mx2onnx/export_model.py`` (entry
+point) + ``_op_translations.py`` (per-op converters), but serializes
+through the in-repo protobuf codec (``_proto``) instead of the ``onnx``
+wheel, which this image does not have.  Covers the full model-zoo CNN
+surface (Convolution/BatchNorm/Activation/Pooling/FullyConnected/
+Flatten/Concat/Dropout/broadcast & elemwise arithmetic/LRN/Pad/
+Reshape/transpose/clip/LeakyReLU/softmax/mean/slice_axis) at opset 9.
+"""
+
+from __future__ import annotations
+
+import json
+import ast
+
+import numpy as _np
+
+from . import _proto as P
+
+
+def _tuple(v, n=None):
+    t = ast.literal_eval(v) if isinstance(v, str) else v
+    if not isinstance(t, (tuple, list)):
+        t = (t,) * (n or 1)
+    return [int(x) for x in t]
+
+
+def _bool(v):
+    return str(v).lower() in ("true", "1")
+
+
+def _pads2(pad):
+    """MXNet symmetric (ph, pw) -> ONNX [ph, pw, ph, pw]."""
+    p = _tuple(pad)
+    return p + p
+
+
+class _Ctx:
+    """Conversion state: symbol-node index -> ONNX value names."""
+
+    def __init__(self, params):
+        self.params = params
+        self.nodes = []            # serialized NodeProto bytes
+        self.initializers = {}     # name -> np array
+        self.inputs = []           # graph inputs (name, shape)
+        self.out_name = {}         # (node_idx, out_idx) -> value name
+        self.ncount = 0
+
+    def emit(self, op_type, inputs, outputs, name=None, attrs=None):
+        self.ncount += 1
+        self.nodes.append(P.node(op_type, inputs, outputs,
+                                 name or "%s_%d" % (op_type, self.ncount),
+                                 attrs))
+
+    def const(self, name, arr):
+        self.initializers[name] = _np.asarray(arr)
+        return name
+
+
+def _conv(ctx, name, ins, attrs):
+    a = {"kernel_shape": _tuple(attrs["kernel"]),
+         "strides": _tuple(attrs.get("stride", "(1, 1)")),
+         "pads": _pads2(attrs.get("pad", "(0, 0)")),
+         "dilations": _tuple(attrs.get("dilate", "(1, 1)")),
+         "group": int(attrs.get("num_group", 1))}
+    ctx.emit("Conv", ins, [name], name, a)
+
+
+def _bn(ctx, name, ins, attrs):
+    # ins: data, gamma, beta, moving_mean, moving_var
+    if _bool(attrs.get("fix_gamma", "False")) and ins[1] in \
+            ctx.initializers:
+        ctx.initializers[ins[1]] = _np.ones_like(ctx.initializers[ins[1]])
+    ctx.emit("BatchNormalization", ins, [name], name,
+             {"epsilon": float(attrs.get("eps", 1e-5)),
+              "momentum": float(attrs.get("momentum", 0.9))})
+
+
+_ACT = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+        "softrelu": "Softplus", "softsign": "Softsign"}
+
+
+def _activation(ctx, name, ins, attrs):
+    ctx.emit(_ACT[attrs.get("act_type", "relu")], ins, [name], name)
+
+
+def _pooling(ctx, name, ins, attrs):
+    ptype = attrs.get("pool_type", "max")
+    if _bool(attrs.get("global_pool", "False")):
+        op = {"max": "GlobalMaxPool", "avg": "GlobalAveragePool"}[ptype]
+        ctx.emit(op, ins, [name], name)
+        return
+    a = {"kernel_shape": _tuple(attrs["kernel"]),
+         "strides": _tuple(attrs.get("stride", "(1, 1)")),
+         "pads": _pads2(attrs.get("pad", "(0, 0)"))}
+    if ptype == "avg":
+        a["count_include_pad"] = 1   # MXNet averages over padded cells
+        ctx.emit("AveragePool", ins, [name], name, a)
+    else:
+        ctx.emit("MaxPool", ins, [name], name, a)
+
+
+def _fc(ctx, name, ins, attrs):
+    data = ins[0]
+    if _bool(attrs.get("flatten", "True")):
+        ctx.emit("Flatten", [data], [name + "_flat"], attrs=
+                 {"axis": 1})
+        data = name + "_flat"
+    gemm_in = [data, ins[1]] + (ins[2:] if len(ins) > 2 else [])
+    ctx.emit("Gemm", gemm_in, [name], name,
+             {"alpha": 1.0, "beta": 1.0, "transA": 0, "transB": 1})
+
+
+def _binary(onnx_op):
+    def f(ctx, name, ins, attrs):
+        ctx.emit(onnx_op, ins, [name], name)
+    return f
+
+
+def _scalar(onnx_op, reverse=False):
+    def f(ctx, name, ins, attrs):
+        c = ctx.const(name + "_c",
+                      _np.array(float(attrs["scalar"]), _np.float32))
+        ctx.emit(onnx_op, [c, ins[0]] if reverse else [ins[0], c],
+                 [name], name)
+    return f
+
+
+def _softmax(ctx, name, ins, attrs):
+    ctx.emit("Softmax", ins[:1], [name], name,
+             {"axis": int(attrs.get("axis", -1))})
+
+
+def _dropout(ctx, name, ins, attrs):
+    ctx.emit("Dropout", ins, [name], name,
+             {"ratio": float(attrs.get("p", 0.5))})
+
+
+def _reshape(ctx, name, ins, attrs):
+    shape = _tuple(attrs["shape"])
+    c = ctx.const(name + "_shape", _np.array(shape, _np.int64))
+    ctx.emit("Reshape", [ins[0], c], [name], name)
+
+
+def _lrn(ctx, name, ins, attrs):
+    ctx.emit("LRN", ins, [name], name,
+             {"alpha": float(attrs.get("alpha", 1e-4)),
+              "beta": float(attrs.get("beta", 0.75)),
+              "bias": float(attrs.get("knorm", 2.0)),
+              "size": int(attrs["nsize"])})
+
+
+def _pad(ctx, name, ins, attrs):
+    pw = _tuple(attrs["pad_width"])
+    nd2 = len(pw) // 2
+    begins = [pw[2 * i] for i in range(nd2)]
+    ends = [pw[2 * i + 1] for i in range(nd2)]
+    mode = {"constant": "constant", "edge": "edge",
+            "reflect": "reflect"}[attrs.get("mode", "constant")]
+    ctx.emit("Pad", ins, [name], name,
+             {"mode": mode, "pads": begins + ends,
+              "value": float(attrs.get("constant_value", 0.0))})
+
+
+def _transpose(ctx, name, ins, attrs):
+    ctx.emit("Transpose", ins, [name], name,
+             {"perm": _tuple(attrs.get("axes", "()"))})
+
+
+def _clip(ctx, name, ins, attrs):
+    ctx.emit("Clip", ins, [name], name,
+             {"min": float(attrs["a_min"]), "max": float(attrs["a_max"])})
+
+
+def _leaky(ctx, name, ins, attrs):
+    act = attrs.get("act_type", "leaky")
+    slope = float(attrs.get("slope", 0.25))
+    if act == "leaky":
+        ctx.emit("LeakyRelu", ins[:1], [name], name, {"alpha": slope})
+    elif act == "elu":
+        ctx.emit("Elu", ins[:1], [name], name, {"alpha": slope})
+    elif act == "prelu":
+        ctx.emit("PRelu", ins, [name], name)
+    else:
+        raise NotImplementedError("LeakyReLU act_type %r" % act)
+
+
+def _mean(ctx, name, ins, attrs):
+    ax = attrs.get("axis")
+    a = {"keepdims": 1 if _bool(attrs.get("keepdims", "False")) else 0}
+    if ax is not None:
+        t = ast.literal_eval(ax) if isinstance(ax, str) else ax
+        a["axes"] = list(t) if isinstance(t, (tuple, list)) else [int(t)]
+    ctx.emit("ReduceMean", ins, [name], name, a)
+
+
+def _slice_axis(ctx, name, ins, attrs):
+    ax = int(attrs["axis"])
+    begin = int(attrs["begin"])
+    end = attrs.get("end")
+    end = 2 ** 31 - 1 if end in (None, "None") else int(end)
+    ctx.emit("Slice", ins, [name], name,
+             {"axes": [ax], "starts": [begin], "ends": [end]})
+
+
+def _flatten(ctx, name, ins, attrs):
+    ctx.emit("Flatten", ins, [name], name, {"axis": 1})
+
+
+def _identity(ctx, name, ins, attrs):
+    ctx.emit("Identity", ins[:1], [name], name)
+
+
+CONVERTERS = {
+    "Convolution": _conv,
+    "BatchNorm": _bn,
+    "Activation": _activation,
+    "Pooling": _pooling,
+    "FullyConnected": _fc,
+    "Flatten": _flatten,
+    "flatten": _flatten,
+    "Concat": lambda ctx, name, ins, attrs: ctx.emit(
+        "Concat", ins, [name], name,
+        {"axis": int(attrs.get("dim", 1))}),
+    "concat": lambda ctx, name, ins, attrs: ctx.emit(
+        "Concat", ins, [name], name,
+        {"axis": int(attrs.get("dim", 1))}),
+    "Dropout": _dropout,
+    "softmax": _softmax,
+    "SoftmaxOutput": _softmax,
+    "SoftmaxActivation": _softmax,
+    "elemwise_add": _binary("Add"), "broadcast_add": _binary("Add"),
+    "_plus": _binary("Add"),
+    "elemwise_sub": _binary("Sub"), "broadcast_sub": _binary("Sub"),
+    "elemwise_mul": _binary("Mul"), "broadcast_mul": _binary("Mul"),
+    "elemwise_div": _binary("Div"), "broadcast_div": _binary("Div"),
+    "_plus_scalar": _scalar("Add"),
+    "_minus_scalar": _scalar("Sub"),
+    "_mul_scalar": _scalar("Mul"),
+    "_div_scalar": _scalar("Div"),
+    "_rminus_scalar": _scalar("Sub", reverse=True),
+    "_rdiv_scalar": _scalar("Div", reverse=True),
+    "Reshape": _reshape, "reshape": _reshape,
+    "LRN": _lrn,
+    "Pad": _pad, "pad": _pad,
+    "transpose": _transpose,
+    "clip": _clip,
+    "LeakyReLU": _leaky,
+    "mean": _mean,
+    "slice_axis": _slice_axis,
+    "identity": _identity, "_copy": _identity, "BlockGrad": _identity,
+}
+
+
+def export_model(sym, params, input_shape=None, input_type=_np.float32,
+                 onnx_file_path="model.onnx", verbose=False):
+    """Export a Symbol (or saved symbol json path) + params to ONNX.
+
+    Mirrors the reference signature
+    (mx2onnx/export_model.py:export_model).  ``params`` may be a dict of
+    NDArray/ndarray (arg+aux merged, optionally ``arg:``/``aux:``
+    prefixed as in saved .params files) or a path to one.  Returns the
+    output file path.
+    """
+    from ...symbol import Symbol, load as sym_load
+    if isinstance(sym, str):
+        sym = sym_load(sym)
+    if isinstance(params, str):
+        from ...ndarray import load as nd_load
+        params = nd_load(params)
+    np_params = {}
+    for k, v in params.items():
+        k = k.split(":", 1)[1] if ":" in k else k
+        np_params[k] = _np.asarray(v.asnumpy() if hasattr(v, "asnumpy")
+                                   else v)
+    if isinstance(input_shape, tuple):
+        input_shape = [input_shape]
+
+    g = json.loads(sym.tojson()) if isinstance(sym, Symbol) else sym
+    nodes = g["nodes"]
+    heads = [tuple(h[:2]) for h in g["heads"]]
+
+    ctx = _Ctx(np_params)
+    dtype = _np.dtype(input_type)
+    elem = P._NP_TO_DT[dtype.name]
+    # uniquify node names: duplicate names in the symbol JSON would
+    # silently clobber values in the ONNX graph's flat namespace
+    seen = {}
+    uniq = {}
+    for i, n in enumerate(nodes):
+        nm = n["name"]
+        if nm in seen:
+            seen[nm] += 1
+            uniq[i] = "%s_%d" % (nm, seen[nm])
+        else:
+            seen[nm] = 0
+            uniq[i] = nm
+    data_i = 0
+    for i, n in enumerate(nodes):
+        if n["op"] != "null":
+            continue
+        name = n["name"]
+        ctx.out_name[(i, 0)] = name
+        if name in np_params:
+            ctx.initializers[name] = np_params[name]
+        else:
+            shape = (input_shape[data_i] if input_shape and
+                     data_i < len(input_shape) else ["N"])
+            ctx.inputs.append((name, shape))
+            data_i += 1
+
+    for i, n in enumerate(nodes):
+        if n["op"] == "null":
+            continue
+        ins = [ctx.out_name[tuple(e[:2])] for e in n["inputs"]]
+        conv = CONVERTERS.get(n["op"])
+        if conv is None:
+            raise NotImplementedError(
+                "no ONNX converter for op %r (node %r)"
+                % (n["op"], n["name"]))
+        conv(ctx, uniq[i], ins, n.get("attrs", {}))
+        ctx.out_name[(i, 0)] = uniq[i]
+        if verbose:
+            print("converted %s %s" % (n["op"], n["name"]))
+
+    out_infos = []
+    for k, (ni, oi) in enumerate(heads):
+        out_infos.append(P.value_info(ctx.out_name[(ni, oi)], elem,
+                                      ["N"]))
+    in_infos = [P.value_info(nm, elem, shp) for nm, shp in ctx.inputs]
+    # opset-9 style: initializers are also declared as graph inputs
+    for nm, arr in ctx.initializers.items():
+        in_infos.append(P.value_info(nm, P._NP_TO_DT[arr.dtype.name],
+                                     list(arr.shape)))
+    inits = [P.tensor(nm, arr) for nm, arr in ctx.initializers.items()]
+    gb = P.graph(ctx.nodes, "mxnet_tpu_model", inits, in_infos,
+                 out_infos)
+    blob = P.model(gb)
+    with open(onnx_file_path, "wb") as f:
+        f.write(blob)
+    return onnx_file_path

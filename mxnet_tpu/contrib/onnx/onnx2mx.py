@@ -1,0 +1,298 @@
+"""ONNX -> Symbol importer.
+
+Mirrors ``python/mxnet/contrib/onnx/onnx2mx/import_model.py`` (entry
+point) + ``_op_translations.py``, decoding the protobuf with the
+in-repo codec.  Returns ``(sym, arg_params, aux_params)`` exactly like
+the reference, ready for ``mx.mod.Module`` binding.
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from . import _proto as P
+
+
+def _attr(attrs, key, default=None):
+    return attrs.get(key, default)
+
+
+class _Builder:
+    def __init__(self, initializers):
+        self.inits = initializers
+        self.values = {}       # onnx value name -> Symbol
+        self.aux_names = set()
+        self.consumed = set()  # initializer names folded into attrs
+
+    def sym_for(self, name, sym_mod):
+        if name not in self.values:
+            # free value: either an initializer-backed weight or an input
+            self.values[name] = sym_mod.Variable(name)
+        return self.values[name]
+
+
+def _conv(b, sym, node, ins):
+    a = node["attrs"]
+    kwargs = {"kernel": tuple(a.get("kernel_shape", ())),
+              "num_group": int(a.get("group", 1)),
+              "no_bias": len(ins) < 3}
+    if "strides" in a:
+        kwargs["stride"] = tuple(a["strides"])
+    if "pads" in a:
+        p = a["pads"]
+        kwargs["pad"] = tuple(p[:len(p) // 2])
+    if "dilations" in a:
+        kwargs["dilate"] = tuple(a["dilations"])
+    w = b.inits.get(node["inputs"][1])
+    if w is None:
+        raise NotImplementedError(
+            "Conv node %r: weight %r is a runtime graph input, not an "
+            "initializer — num_filter cannot be inferred"
+            % (node["name"], node["inputs"][1]))
+    kwargs["num_filter"] = int(w.shape[0])
+    return sym.Convolution(*ins, name=node["name"] or None, **kwargs)
+
+
+def _bn(b, sym, node, ins):
+    a = node["attrs"]
+    for nm in node["inputs"][3:5]:
+        b.aux_names.add(nm)
+    return sym.BatchNorm(*ins, eps=float(a.get("epsilon", 1e-5)),
+                         momentum=float(a.get("momentum", 0.9)),
+                         fix_gamma=False, name=node["name"] or None)
+
+
+def _pool(op_type):
+    def f(b, sym, node, ins):
+        a = node["attrs"]
+        kwargs = {"pool_type": "max" if "Max" in op_type else "avg"}
+        if op_type.startswith("Global"):
+            kwargs.update(global_pool=True, kernel=(1, 1))
+        else:
+            kwargs["kernel"] = tuple(a["kernel_shape"])
+            if "strides" in a:
+                kwargs["stride"] = tuple(a["strides"])
+            if "pads" in a:
+                p = a["pads"]
+                kwargs["pad"] = tuple(p[:len(p) // 2])
+        return sym.Pooling(*ins, name=node["name"] or None, **kwargs)
+    return f
+
+
+def _gemm(b, sym, node, ins):
+    a = node["attrs"]
+    if int(a.get("transA", 0)):
+        raise NotImplementedError("Gemm with transA")
+    w_name = node["inputs"][1]
+    w = b.inits.get(w_name)
+    if w is None:
+        raise NotImplementedError(
+            "Gemm node %r: weight %r is a runtime graph input, not an "
+            "initializer — num_hidden cannot be inferred"
+            % (node["name"], node["inputs"][1]))
+    data, weight = ins[0], ins[1]
+    if not int(a.get("transB", 1)):
+        # FullyConnected expects (out, in): fold the transpose into the
+        # initializer
+        b.inits[w_name] = _np.ascontiguousarray(w.T)
+        w = b.inits[w_name]
+    # fold alpha into the weight and beta into the bias so the
+    # FullyConnected numerics match Gemm's alpha*A@B' + beta*C
+    alpha = float(a.get("alpha", 1.0))
+    if alpha != 1.0:
+        b.inits[w_name] = b.inits[w_name] * _np.asarray(
+            alpha, b.inits[w_name].dtype)
+        w = b.inits[w_name]
+    beta = float(a.get("beta", 1.0))
+    has_bias = len(ins) >= 3
+    if has_bias and beta == 0.0:
+        ins = ins[:2]
+        has_bias = False
+    elif has_bias and beta != 1.0:
+        c_name = node["inputs"][2]
+        c = b.inits.get(c_name)
+        if c is None:
+            raise NotImplementedError(
+                "Gemm node %r: beta=%g with a runtime bias input"
+                % (node["name"], beta))
+        b.inits[c_name] = c * _np.asarray(beta, c.dtype)
+    fc_ins = [data, weight] + list(ins[2:])
+    return sym.FullyConnected(*fc_ins, num_hidden=int(w.shape[0]),
+                              no_bias=not has_bias, flatten=False,
+                              name=node["name"] or None)
+
+
+def _act(mx_act):
+    def f(b, sym, node, ins):
+        return sym.Activation(ins[0], act_type=mx_act,
+                              name=node["name"] or None)
+    return f
+
+
+def _binary(mx_name):
+    def f(b, sym, node, ins):
+        return getattr(sym, mx_name)(*ins, name=node["name"] or None)
+    return f
+
+
+def _reshape(b, sym, node, ins):
+    shape_name = node["inputs"][1]
+    shape = b.inits[shape_name]
+    b.consumed.add(shape_name)
+    return sym.Reshape(ins[0], shape=tuple(int(s) for s in shape),
+                       name=node["name"] or None)
+
+
+def _dropout(b, sym, node, ins):
+    return sym.Dropout(ins[0],
+                       p=float(node["attrs"].get("ratio", 0.5)),
+                       name=node["name"] or None)
+
+
+def _softmax(b, sym, node, ins):
+    return sym.softmax(ins[0],
+                       axis=int(node["attrs"].get("axis", -1)),
+                       name=node["name"] or None)
+
+
+def _flatten(b, sym, node, ins):
+    return sym.Flatten(ins[0], name=node["name"] or None)
+
+
+def _lrn(b, sym, node, ins):
+    a = node["attrs"]
+    return sym.LRN(ins[0], alpha=float(a.get("alpha", 1e-4)),
+                   beta=float(a.get("beta", 0.75)),
+                   knorm=float(a.get("bias", 2.0)),
+                   nsize=int(a["size"]), name=node["name"] or None)
+
+
+def _pad(b, sym, node, ins):
+    a = node["attrs"]
+    p = a["pads"]
+    half = len(p) // 2
+    pw = []
+    for i in range(half):
+        pw += [p[i], p[half + i]]
+    return sym.Pad(ins[0], mode=a.get("mode", "constant"),
+                   pad_width=tuple(pw),
+                   constant_value=float(a.get("value", 0.0)),
+                   name=node["name"] or None)
+
+
+def _transpose(b, sym, node, ins):
+    return sym.transpose(ins[0],
+                         axes=tuple(node["attrs"].get("perm", ())),
+                         name=node["name"] or None)
+
+
+def _clip(b, sym, node, ins):
+    a = node["attrs"]
+    return sym.clip(ins[0], a_min=float(a["min"]),
+                    a_max=float(a["max"]), name=node["name"] or None)
+
+
+def _leaky(b, sym, node, ins):
+    return sym.LeakyReLU(ins[0],
+                         slope=float(node["attrs"].get("alpha", 0.01)),
+                         act_type="leaky", name=node["name"] or None)
+
+
+def _reduce_mean(b, sym, node, ins):
+    a = node["attrs"]
+    return sym.mean(ins[0], axis=tuple(a.get("axes", ())) or None,
+                    keepdims=bool(a.get("keepdims", 1)),
+                    name=node["name"] or None)
+
+
+def _slice(b, sym, node, ins):
+    a = node["attrs"]
+    # axes is optional in opset-9 Slice: default = leading axes in order
+    axes = a.get("axes") or list(range(len(a.get("starts", []))))
+    out = ins[0]
+    for k, ax in enumerate(axes):
+        end = a["ends"][k]
+        out = sym.slice_axis(out, axis=int(ax),
+                             begin=int(a["starts"][k]),
+                             end=None if end >= 2 ** 31 - 1 else int(end))
+    return out
+
+
+def _identity(b, sym, node, ins):
+    return sym.identity(ins[0], name=node["name"] or None)
+
+
+IMPORTERS = {
+    "Conv": _conv,
+    "BatchNormalization": _bn,
+    "Relu": _act("relu"), "Sigmoid": _act("sigmoid"),
+    "Tanh": _act("tanh"), "Softplus": _act("softrelu"),
+    "Softsign": _act("softsign"),
+    "MaxPool": _pool("MaxPool"), "AveragePool": _pool("AveragePool"),
+    "GlobalMaxPool": _pool("GlobalMaxPool"),
+    "GlobalAveragePool": _pool("GlobalAveragePool"),
+    "Gemm": _gemm,
+    "Flatten": _flatten,
+    "Concat": lambda b, sym, node, ins: sym.Concat(
+        *ins, dim=int(node["attrs"].get("axis", 1)),
+        name=node["name"] or None),
+    "Dropout": _dropout,
+    "Softmax": _softmax,
+    "Add": _binary("broadcast_add"), "Sub": _binary("broadcast_sub"),
+    "Mul": _binary("broadcast_mul"), "Div": _binary("broadcast_div"),
+    "Reshape": _reshape,
+    "LRN": _lrn,
+    "Pad": _pad,
+    "Transpose": _transpose,
+    "Clip": _clip,
+    "LeakyRelu": _leaky, "Elu": lambda b, sym, node, ins:
+        sym.LeakyReLU(ins[0], act_type="elu",
+                      slope=float(node["attrs"].get("alpha", 1.0))),
+    "PRelu": lambda b, sym, node, ins: sym.LeakyReLU(
+        *ins, act_type="prelu"),
+    "ReduceMean": _reduce_mean,
+    "Slice": _slice,
+    "Identity": _identity,
+}
+
+
+def import_model(model_file):
+    """Load an .onnx file -> (sym, arg_params, aux_params)
+    (reference: onnx2mx/import_model.py:import_model)."""
+    from ... import symbol as sym_mod
+    from ... import nd
+
+    with open(model_file, "rb") as f:
+        m = P.parse_model(f.read())
+
+    b = _Builder(dict(m["initializers"]))
+    graph_inputs = [nm for nm, _, _ in m["inputs"]
+                    if nm not in b.inits]
+    for nm in graph_inputs:
+        b.values[nm] = sym_mod.Variable(nm)
+
+    for node in m["nodes"]:
+        conv = IMPORTERS.get(node["op_type"])
+        if conv is None:
+            raise NotImplementedError(
+                "no importer for ONNX op %r (node %r)"
+                % (node["op_type"], node["name"]))
+        ins = [b.sym_for(nm, sym_mod) for nm in node["inputs"]]
+        out = conv(b, sym_mod, node, ins)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for nm, s in zip(node["outputs"], outs):
+            b.values[nm] = s
+
+    outputs = [b.values[nm] for nm, _, _ in m["outputs"]]
+    out_sym = outputs[0] if len(outputs) == 1 else sym_mod.Group(outputs)
+
+    arg_params, aux_params = {}, {}
+    needed = set(out_sym.list_arguments()) | \
+        set(out_sym.list_auxiliary_states())
+    for nm, arr in b.inits.items():
+        if nm in b.consumed or nm not in needed:
+            continue
+        target = aux_params if nm in b.aux_names or \
+            nm in set(out_sym.list_auxiliary_states()) else arg_params
+        target[nm] = nd.array(arr)
+    return out_sym, arg_params, aux_params

@@ -192,8 +192,15 @@ class BatchNorm(HybridBlock):
 
     def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
         if F is sym_mod:
+            # name the node after the parameter prefix so every BN in an
+            # exported graph is unique (a bare "fwd" collides across
+            # layers and breaks any by-name consumer of the JSON)
+            gname = getattr(gamma, "name", "") or ""
+            prefix = gname[:-len("gamma")] if gname.endswith("gamma") \
+                else ""
             return F.BatchNorm(x, gamma, beta, running_mean, running_var,
-                               name="fwd", **self._kwargs)
+                               name=prefix + "fwd" if prefix else None,
+                               **self._kwargs)
         # imperative: call the op directly and write back moving stats
         import functools
         from ...ops import registry as _reg

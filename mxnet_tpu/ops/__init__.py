@@ -21,3 +21,4 @@ from . import image         # noqa: F401
 from . import detection     # noqa: F401
 from . import spatial       # noqa: F401
 from . import attention     # noqa: F401
+from . import parity        # noqa: F401  (must come last: aliases)

@@ -1,0 +1,204 @@
+"""Functional tests for the round-4 op-parity additions
+(mxnet_tpu/ops/parity.py) — legacy layers, long-tail tensor ops,
+multisample distributions, and the graph-level sparse ops that make
+``mx.sym`` sparse configurations runnable."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_legacy_aliases_dispatch():
+    a = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    b = nd.ones((2, 3))
+    np.testing.assert_allclose(nd._Plus(a, b).asnumpy(),
+                               a.asnumpy() + 1)
+    np.testing.assert_allclose(nd._MulScalar(a, scalar=3).asnumpy(),
+                               a.asnumpy() * 3)
+    np.testing.assert_allclose(
+        nd._Logical_And(a, b).asnumpy(),
+        (a.asnumpy() != 0).astype(np.float32))
+    np.testing.assert_allclose(nd.broadcast_plus(a, b).asnumpy(),
+                               a.asnumpy() + 1)
+
+
+def test_hard_sigmoid_and_shape_size_array():
+    x = nd.array(np.array([-10.0, -1.0, 0.0, 1.0, 10.0], np.float32))
+    got = nd.hard_sigmoid(x).asnumpy()
+    np.testing.assert_allclose(got, np.clip(0.2 * x.asnumpy() + 0.5,
+                                            0, 1))
+    m = nd.zeros((2, 5, 3))
+    np.testing.assert_array_equal(nd.shape_array(m).asnumpy(),
+                                  [2, 5, 3])
+    np.testing.assert_array_equal(nd.size_array(m).asnumpy(), [30])
+
+
+def test_slice_assign_and_scalar():
+    x = nd.zeros((4, 4))
+    y = nd.ones((2, 2))
+    out = nd._slice_assign(x, y, begin=(1, 1), end=(3, 3))
+    want = np.zeros((4, 4), np.float32)
+    want[1:3, 1:3] = 1
+    np.testing.assert_array_equal(out.asnumpy(), want)
+    out2 = nd._slice_assign_scalar(x, scalar=7.0, begin=(0, 2),
+                                      end=(4, 4))
+    want2 = np.zeros((4, 4), np.float32)
+    want2[:, 2:] = 7
+    np.testing.assert_array_equal(out2.asnumpy(), want2)
+
+
+def test_crop_layer_center_and_like():
+    data = nd.array(np.arange(2 * 3 * 6 * 6, dtype=np.float32)
+                    .reshape(2, 3, 6, 6))
+    out = nd.Crop(data, h_w=(2, 2), center_crop=True, num_args=1)
+    np.testing.assert_array_equal(out.asnumpy(),
+                                  data.asnumpy()[:, :, 2:4, 2:4])
+    like = nd.zeros((2, 1, 4, 4))
+    out2 = nd.Crop(data, like, offset=(1, 1), num_args=2)
+    np.testing.assert_array_equal(out2.asnumpy(),
+                                  data.asnumpy()[:, :, 1:5, 1:5])
+
+
+def test_svm_output_forward_and_grad():
+    from mxnet_tpu import autograd
+    rs = np.random.RandomState(0)
+    scores = nd.array(rs.randn(5, 4).astype(np.float32))
+    label = nd.array(np.array([0, 1, 2, 3, 1], np.float32))
+    scores.attach_grad()
+    with autograd.record():
+        out = nd.SVMOutput(scores, label, margin=1.0,
+                              regularization_coefficient=0.5)
+    np.testing.assert_allclose(out.asnumpy(), scores.asnumpy())
+    out.backward()
+    g = scores.grad.asnumpy()
+    # L2-SVM analytic gradient
+    s = scores.asnumpy()
+    li = label.asnumpy().astype(int)
+    sy = s[np.arange(5), li][:, None]
+    viol = np.maximum(1.0 - (sy - s), 0.0)
+    viol[np.arange(5), li] = 0
+    want = 2.0 * viol
+    want[np.arange(5), li] = -want.sum(axis=1)
+    np.testing.assert_allclose(g, 0.5 * want, rtol=1e-5, atol=1e-5)
+    # the op ignores the incoming cotangent (reference semantics)
+    assert np.isfinite(g).all()
+
+
+def test_bipartite_matching_doc_example():
+    s = nd.array(np.array([[0.5, 0.6], [0.1, 0.2], [0.3, 0.4]],
+                          np.float32))
+    x, y = nd._contrib_bipartite_matching(s, threshold=1e-12,
+                                             is_ascend=False)
+    np.testing.assert_array_equal(x.asnumpy(), [1, -1, 0])
+    np.testing.assert_array_equal(y.asnumpy(), [2, 0])
+
+
+def test_bipartite_matching_topk_and_threshold():
+    s = nd.array(np.array([[0.9, 0.05], [0.8, 0.7]], np.float32))
+    x, _ = nd._contrib_bipartite_matching(s, threshold=0.5, topk=1)
+    # only the single best (0.9 at r0,c0) is taken
+    np.testing.assert_array_equal(x.asnumpy(), [0, -1])
+
+
+def test_multisample_distributions_moments():
+    rng_shape = (3,)
+    lam = nd.array(np.array([1.0, 4.0, 9.0], np.float32))
+    out = nd._sample_exponential(lam, shape=(20000,)).asnumpy()
+    np.testing.assert_allclose(out.mean(axis=1), 1.0 / lam.asnumpy(),
+                               rtol=0.1)
+    pois = nd._sample_poisson(lam, shape=(20000,)).asnumpy()
+    np.testing.assert_allclose(pois.mean(axis=1), lam.asnumpy(),
+                               rtol=0.1)
+    k = nd.array(np.array([2.0, 5.0], np.float32))
+    p = nd.array(np.array([0.4, 0.7], np.float32))
+    nb = nd._sample_negative_binomial(k, p, shape=(20000,)).asnumpy()
+    want_mean = k.asnumpy() * (1 - p.asnumpy()) / p.asnumpy()
+    np.testing.assert_allclose(nb.mean(axis=1), want_mean, rtol=0.15)
+    mu = nd.array(np.array([3.0, 8.0], np.float32))
+    alpha = nd.array(np.array([0.3, 0.1], np.float32))
+    gnb = nd._sample_generalized_negative_binomial(
+        mu, alpha, shape=(20000,)).asnumpy()
+    np.testing.assert_allclose(gnb.mean(axis=1), mu.asnumpy(), rtol=0.15)
+
+
+def test_group_adagrad_update():
+    w = nd.array(np.ones((3, 4), np.float32))
+    g = nd.array(np.full((3, 4), 2.0, np.float32))
+    h = nd.zeros((3,))
+    out = nd._contrib_group_adagrad_update(
+        w, g, h, lr=0.1, rescale_grad=1.0, epsilon=1e-5)
+    # history[r] = mean(4.0) = 4; w -= 0.1 * 2 / sqrt(4 + eps)
+    want = 1.0 - 0.1 * 2.0 / np.sqrt(4.0 + 1e-5)
+    np.testing.assert_allclose(out.asnumpy(),
+                               np.full((3, 4), want), rtol=1e-6)
+
+
+def test_deformable_psroi_pooling_zero_trans_matches_uniform():
+    # with zero offsets each bin averages its own window; a constant
+    # per-channel input must pool to that constant
+    od, g, k = 2, 2, 2
+    C = od * g * g
+    data = np.zeros((1, C, 8, 8), np.float32)
+    for c in range(C):
+        data[0, c] = c + 1
+    rois = nd.array(np.array([[0, 0, 0, 7, 7]], np.float32))
+    trans = nd.zeros((1, 2, k, k))
+    out = nd._contrib_DeformablePSROIPooling(
+        nd.array(data), rois, trans, spatial_scale=1.0, output_dim=od,
+        group_size=g, pooled_size=k, part_size=k, sample_per_part=2,
+        trans_std=0.1)
+    got = out.asnumpy()
+    # channel for (class c, bin i, j) is c*g*g + i*g + j -> value c*4+i*2+j+1
+    want = np.array([[[1, 2], [3, 4]], [[5, 6], [7, 8]]], np.float32)
+    np.testing.assert_allclose(got[0], want, rtol=1e-5, atol=1e-5)
+
+
+# --- graph-level sparse ops + symbolic sparse linear classification ----
+
+
+def test_sparse_graph_ops_nd():
+    rs = np.random.RandomState(0)
+    x = nd.array(rs.randn(5, 3).astype(np.float32))
+    np.testing.assert_array_equal(
+        nd.cast_storage(x, stype="row_sparse").asnumpy(), x.asnumpy())
+    kept = nd._sparse_retain(x, nd.array(np.array([1, 3], np.float32)))
+    want = np.zeros((5, 3), np.float32)
+    want[[1, 3]] = x.asnumpy()[[1, 3]]
+    np.testing.assert_array_equal(kept.asnumpy(), want)
+    ss = nd._square_sum(x, axis=1)
+    np.testing.assert_allclose(ss.asnumpy(), (x.asnumpy() ** 2).sum(1),
+                               rtol=1e-5)
+
+
+def test_symbolic_sparse_linear_classification():
+    """LibSVM-style config under mx.sym/Module: dot(csr-style data, w)
+    with cast_storage/_square_sum in the graph (the reference's
+    example/sparse/linear_classification shape)."""
+    import mxnet_tpu.optimizer as opt
+    rs = np.random.RandomState(0)
+    n, d = 64, 20
+    w_true = rs.randn(d).astype(np.float32)
+    xs = rs.randn(n, d).astype(np.float32)
+    xs[rs.rand(n, d) > 0.3] = 0          # sparse-looking features
+    ys = (xs @ w_true > 0).astype(np.float32)
+
+    data = mx.sym.Variable("data")
+    weight = mx.sym.Variable("weight", shape=(d, 2))
+    dense = mx.sym.cast_storage(data, stype="default")
+    logits = mx.sym.dot(dense, weight)
+    out = mx.sym.SoftmaxOutput(logits, mx.sym.Variable("softmax_label"),
+                               name="softmax")
+    mod = mx.mod.Module(out, data_names=("data",),
+                        label_names=("softmax_label",))
+    from mxnet_tpu.io import NDArrayIter
+    it = NDArrayIter(xs, ys, batch_size=16, shuffle=False,
+                     label_name="softmax_label")
+    mod.fit(it, num_epoch=12,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5},
+            eval_metric="acc")
+    score = mod.score(it, "acc")
+    acc = dict(score)["accuracy"]
+    assert acc > 0.8, acc
