@@ -187,7 +187,10 @@ def main():
         net, loss, optimizer="lbsgd" if on_tpu else "sgd",
         optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
                           "eta": 0.001},
-        mesh=mesh, multi_precision=on_tpu)
+        mesh=mesh, multi_precision=on_tpu,
+        # BENCH_REMAT=dots|full selects a jax.checkpoint policy for the
+        # step (HBM-pressure experiments on hardware)
+        remat=os.environ.get("BENCH_REMAT") or None)
 
     rng = np.random.RandomState(0)
     x = mx.nd.array(rng.randn(batch, 3, image, image).astype(np.float32))

@@ -1,8 +1,12 @@
 """Evaluation metrics (reference: python/mxnet/metric.py, 1,424 LoC).
 
-Registry of EvalMetrics updated per batch; host-side numpy math (metrics are
-not on the training hot path — outputs are already device arrays, one
-``asnumpy`` sync per batch like the reference's update_metric)."""
+Registry of EvalMetrics updated per batch.  Math is host-side numpy:
+metrics are off the training hot path — outputs are already device
+arrays and each update costs one ``asnumpy`` sync, like the reference's
+``update_metric``.  Structure differs from the reference: batch
+normalization (``_pairs``), binary confusion counting, and regression
+error accumulation are shared helpers instead of per-class copies.
+"""
 
 from __future__ import annotations
 
@@ -23,23 +27,44 @@ __all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
 
 
 def _as_np(x):
-    if isinstance(x, NDArray):
-        return x.asnumpy()
-    return _np.asarray(x)
+    return x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
 
 
 def check_label_shapes(labels, preds, shape=False):
-    if not shape:
-        label_shape, pred_shape = len(labels), len(preds)
-    else:
-        label_shape, pred_shape = labels.shape, preds.shape
-    if label_shape != pred_shape:
+    got = (labels.shape, preds.shape) if shape else (len(labels),
+                                                    len(preds))
+    if got[0] != got[1]:
         raise ValueError("Shape of labels {} does not match shape of "
-                         "predictions {}".format(label_shape, pred_shape))
+                         "predictions {}".format(*got))
+
+
+def _pairs(labels, preds, class_axis=None):
+    """Normalize (labels, preds) to aligned numpy pairs; with
+    ``class_axis`` set, probability tensors are argmaxed to class ids
+    and both sides flatten to int32 vectors."""
+    if isinstance(labels, NDArray):
+        labels = [labels]
+    if isinstance(preds, NDArray):
+        preds = [preds]
+    for label, pred in zip(labels, preds):
+        l_np, p_np = _as_np(label), _as_np(pred)
+        if class_axis is not None:
+            # scores need an argmax exactly when they carry a class
+            # axis the labels lack — element-count comparison also
+            # covers (N, 1)-shaped label columns
+            if p_np.ndim > 1 and p_np.size != l_np.size:
+                p_np = p_np.argmax(axis=class_axis)
+            l_np = l_np.astype("int32").reshape(-1)
+            p_np = p_np.astype("int32").reshape(-1)
+        yield l_np, p_np
 
 
 class EvalMetric:
-    def __init__(self, name, output_names=None, label_names=None, **kwargs):
+    """Accumulator protocol: ``update`` folds one batch into
+    (sum_metric, num_inst); ``get`` reports sum/num."""
+
+    def __init__(self, name, output_names=None, label_names=None,
+                 **kwargs):
         self.name = str(name)
         self.output_names = output_names
         self.label_names = label_names
@@ -50,23 +75,17 @@ class EvalMetric:
         return "EvalMetric: {}".format(dict(self.get_name_value()))
 
     def get_config(self):
-        config = dict(self._kwargs)
-        config.update({"metric": type(self).__name__, "name": self.name,
-                       "output_names": self.output_names,
-                       "label_names": self.label_names})
-        return config
+        return dict(self._kwargs, metric=type(self).__name__,
+                    name=self.name, output_names=self.output_names,
+                    label_names=self.label_names)
 
     def update_dict(self, label, pred):
-        if self.output_names is not None:
-            pred = [pred[name] for name in self.output_names if name in pred]
-        else:
-            pred = list(pred.values())
-        if self.label_names is not None:
-            label = [label[name] for name in self.label_names
-                     if name in label]
-        else:
-            label = list(label.values())
-        self.update(label, pred)
+        def pick(table, names):
+            if names is None:
+                return list(table.values())
+            return [table[n] for n in names if n in table]
+        self.update(pick(label, self.label_names),
+                    pick(pred, self.output_names))
 
     def update(self, labels, preds):
         raise NotImplementedError
@@ -76,17 +95,15 @@ class EvalMetric:
         self.sum_metric = 0.0
 
     def get(self):
-        if self.num_inst == 0:
-            return (self.name, float("nan"))
-        return (self.name, self.sum_metric / self.num_inst)
+        value = (self.sum_metric / self.num_inst if self.num_inst
+                 else float("nan"))
+        return (self.name, value)
 
     def get_name_value(self):
         name, value = self.get()
-        if not isinstance(name, list):
-            name = [name]
-        if not isinstance(value, list):
-            value = [value]
-        return list(zip(name, value))
+        names = name if isinstance(name, list) else [name]
+        values = value if isinstance(value, list) else [value]
+        return list(zip(names, values))
 
 
 def register(klass=None, name=None, aliases=()):
@@ -134,12 +151,9 @@ class CompositeEvalMetric(EvalMetric):
         names, values = [], []
         for metric in self.metrics:
             name, value = metric.get()
-            if isinstance(name, str):
-                name = [name]
-            if isinstance(value, (float, int)):
-                value = [value]
-            names.extend(name)
-            values.extend(value)
+            names += name if isinstance(name, list) else [name]
+            values += value if isinstance(value, (list, tuple)) \
+                else [value]
         return (names, values)
 
 
@@ -151,20 +165,13 @@ class Accuracy(EvalMetric):
         self.axis = axis
 
     def update(self, labels, preds):
-        if isinstance(labels, NDArray):
-            labels = [labels]
-        if isinstance(preds, NDArray):
-            preds = [preds]
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            p = _as_np(pred)
-            l = _as_np(label).astype("int32")
-            if p.ndim > l.ndim:
-                p = p.argmax(axis=self.axis)
-            p = p.astype("int32").reshape(-1)
-            l = l.reshape(-1)
-            self.sum_metric += (p == l).sum()
-            self.num_inst += len(l)
+        check_label_shapes([labels] if isinstance(labels, NDArray)
+                           else labels,
+                           [preds] if isinstance(preds, NDArray)
+                           else preds)
+        for l, p in _pairs(labels, preds, class_axis=self.axis):
+            self.sum_metric += int((p == l).sum())
+            self.num_inst += l.size
 
 
 @register(aliases=("top_k_accuracy", "top_k_acc"))
@@ -176,123 +183,105 @@ class TopKAccuracy(EvalMetric):
         self.name += "_%d" % top_k
 
     def update(self, labels, preds):
-        for label, pred in zip(labels, preds):
-            p = _as_np(pred)
-            l = _as_np(label).astype("int32")
-            num_samples = p.shape[0]
-            num_dims = p.ndim
-            if num_dims == 1:
-                self.sum_metric += (p.astype("int32") == l).sum()
+        for l, p in _pairs(labels, preds):
+            l = l.astype("int32").reshape(-1)
+            if p.ndim == 1:
+                self.sum_metric += int((p.astype("int32") == l).sum())
             else:
-                topk = _np.argpartition(p, -self.top_k,
-                                        axis=-1)[:, -self.top_k:]
-                for j in range(self.top_k):
-                    self.sum_metric += (topk[:, j] == l).sum()
-            self.num_inst += num_samples
+                # hits = label appears among the k largest scores
+                top = _np.argpartition(p, -self.top_k,
+                                       axis=-1)[:, -self.top_k:]
+                self.sum_metric += int((top == l[:, None]).sum())
+            self.num_inst += p.shape[0]
+
+
+class _BinaryConfusion(EvalMetric):
+    """Shared tp/fp/tn/fn accumulation for binary classifiers."""
+
+    def reset(self):
+        super().reset()
+        self._tp = self._fp = self._tn = self._fn = 0.0
+
+    def _count(self, labels, preds):
+        for l, p in _pairs(labels, preds, class_axis=1):
+            self._tp += int(((p == 1) & (l == 1)).sum())
+            self._fp += int(((p == 1) & (l == 0)).sum())
+            self._tn += int(((p == 0) & (l == 0)).sum())
+            self._fn += int(((p == 0) & (l == 1)).sum())
+
+    def _score(self):
+        raise NotImplementedError
+
+    def update(self, labels, preds):
+        self._count(labels, preds)
+        self.sum_metric = self._score()
+        self.num_inst = 1
 
 
 @register
-class F1(EvalMetric):
+class F1(_BinaryConfusion):
     def __init__(self, name="f1", output_names=None, label_names=None,
                  average="macro"):
         super().__init__(name, output_names, label_names)
         self.average = average
-        self._tp = self._fp = self._fn = 0.0
 
-    def reset(self):
-        super().reset()
-        self._tp = self._fp = self._fn = 0.0
-
-    def update(self, labels, preds):
-        for label, pred in zip(labels, preds):
-            p = _as_np(pred)
-            l = _as_np(label).astype("int32").reshape(-1)
-            if p.ndim > 1:
-                p = p.argmax(axis=1)
-            p = p.astype("int32").reshape(-1)
-            self._tp += ((p == 1) & (l == 1)).sum()
-            self._fp += ((p == 1) & (l == 0)).sum()
-            self._fn += ((p == 0) & (l == 1)).sum()
-            precision = self._tp / max(self._tp + self._fp, 1e-12)
-            recall = self._tp / max(self._tp + self._fn, 1e-12)
-            f1 = 2 * precision * recall / max(precision + recall, 1e-12)
-            self.sum_metric = f1
-            self.num_inst = 1
+    def _score(self):
+        precision = self._tp / max(self._tp + self._fp, 1e-12)
+        recall = self._tp / max(self._tp + self._fn, 1e-12)
+        return 2 * precision * recall / max(precision + recall, 1e-12)
 
 
 @register
-class MCC(EvalMetric):
+class MCC(_BinaryConfusion):
     def __init__(self, name="mcc", output_names=None, label_names=None,
                  average="macro"):
         super().__init__(name, output_names, label_names)
-        self._tp = self._fp = self._tn = self._fn = 0.0
 
-    def reset(self):
-        super().reset()
-        self._tp = self._fp = self._tn = self._fn = 0.0
+    def _score(self):
+        terms = ((self._tp + self._fp) * (self._tp + self._fn) *
+                 (self._tn + self._fp) * (self._tn + self._fn))
+        denom = math.sqrt(terms) if terms > 0 else 1.0
+        return (self._tp * self._tn - self._fp * self._fn) / denom
+
+
+class _Regression(EvalMetric):
+    """Shared per-batch error accumulation for regression metrics."""
+
+    @staticmethod
+    def _error(l, p):
+        raise NotImplementedError
 
     def update(self, labels, preds):
-        for label, pred in zip(labels, preds):
-            p = _as_np(pred)
-            l = _as_np(label).astype("int32").reshape(-1)
-            if p.ndim > 1:
-                p = p.argmax(axis=1)
-            p = p.astype("int32").reshape(-1)
-            self._tp += ((p == 1) & (l == 1)).sum()
-            self._fp += ((p == 1) & (l == 0)).sum()
-            self._tn += ((p == 0) & (l == 0)).sum()
-            self._fn += ((p == 0) & (l == 1)).sum()
-            terms = ((self._tp + self._fp) * (self._tp + self._fn) *
-                     (self._tn + self._fp) * (self._tn + self._fn))
-            denom = math.sqrt(terms) if terms > 0 else 1.0
-            self.sum_metric = (self._tp * self._tn -
-                               self._fp * self._fn) / denom
-            self.num_inst = 1
+        for l, p in _pairs(labels, preds):
+            if l.ndim == p.ndim - 1:
+                l = l[..., None]
+            self.sum_metric += float(self._error(l, p))
+            self.num_inst += 1
 
 
 @register
-class MAE(EvalMetric):
+class MAE(_Regression):
     def __init__(self, name="mae", output_names=None, label_names=None):
         super().__init__(name, output_names, label_names)
 
-    def update(self, labels, preds):
-        for label, pred in zip(labels, preds):
-            l = _as_np(label)
-            p = _as_np(pred)
-            if l.ndim == p.ndim - 1:
-                l = l.reshape(l.shape + (1,))
-            self.sum_metric += _np.abs(l - p).mean()
-            self.num_inst += 1
+    _error = staticmethod(lambda l, p: _np.abs(l - p).mean())
 
 
 @register
-class MSE(EvalMetric):
+class MSE(_Regression):
     def __init__(self, name="mse", output_names=None, label_names=None):
         super().__init__(name, output_names, label_names)
 
-    def update(self, labels, preds):
-        for label, pred in zip(labels, preds):
-            l = _as_np(label)
-            p = _as_np(pred)
-            if l.ndim == p.ndim - 1:
-                l = l.reshape(l.shape + (1,))
-            self.sum_metric += ((l - p) ** 2).mean()
-            self.num_inst += 1
+    _error = staticmethod(lambda l, p: ((l - p) ** 2).mean())
 
 
 @register
-class RMSE(EvalMetric):
+class RMSE(_Regression):
     def __init__(self, name="rmse", output_names=None, label_names=None):
         super().__init__(name, output_names, label_names)
 
-    def update(self, labels, preds):
-        for label, pred in zip(labels, preds):
-            l = _as_np(label)
-            p = _as_np(pred)
-            if l.ndim == p.ndim - 1:
-                l = l.reshape(l.shape + (1,))
-            self.sum_metric += math.sqrt(((l - p) ** 2).mean())
-            self.num_inst += 1
+    _error = staticmethod(
+        lambda l, p: math.sqrt(((l - p) ** 2).mean()))
 
 
 @register(aliases=("ce",))
@@ -303,13 +292,12 @@ class CrossEntropy(EvalMetric):
         self.eps = eps
 
     def update(self, labels, preds):
-        for label, pred in zip(labels, preds):
-            l = _as_np(label).ravel().astype("int64")
-            p = _as_np(pred)
-            assert l.shape[0] == p.shape[0]
-            prob = p[_np.arange(l.shape[0]), l]
-            self.sum_metric += (-_np.log(prob + self.eps)).sum()
-            self.num_inst += l.shape[0]
+        for l, p in _pairs(labels, preds):
+            ids = l.ravel().astype("int64")
+            assert ids.shape[0] == p.shape[0]
+            picked = p[_np.arange(ids.shape[0]), ids]
+            self.sum_metric += float(-_np.log(picked + self.eps).sum())
+            self.num_inst += ids.shape[0]
 
 
 @register(aliases=("nll_loss",))
@@ -326,15 +314,16 @@ class PearsonCorrelation(EvalMetric):
         super().__init__(name, output_names, label_names)
 
     def update(self, labels, preds):
-        for label, pred in zip(labels, preds):
-            l = _as_np(label).ravel()
-            p = _as_np(pred).ravel()
-            self.sum_metric += _np.corrcoef(p, l)[0, 1]
+        for l, p in _pairs(labels, preds):
+            self.sum_metric += float(
+                _np.corrcoef(p.ravel(), l.ravel())[0, 1])
             self.num_inst += 1
 
 
 @register
 class Perplexity(EvalMetric):
+    """exp of the mean NLL, with an optional ignored padding label."""
+
     def __init__(self, ignore_label=None, axis=-1, name="perplexity",
                  output_names=None, label_names=None):
         super().__init__(name, output_names, label_names,
@@ -343,29 +332,29 @@ class Perplexity(EvalMetric):
         self.axis = axis
 
     def update(self, labels, preds):
-        loss = 0.0
-        num = 0
-        for label, pred in zip(labels, preds):
-            l = _as_np(label).reshape(-1).astype("int64")
-            p = _as_np(pred).reshape(-1, _as_np(pred).shape[-1])
-            prob = p[_np.arange(l.shape[0]), l]
+        for l, p in _pairs(labels, preds):
+            ids = l.reshape(-1).astype("int64")
+            flat = p.reshape(-1, p.shape[-1])
+            picked = flat[_np.arange(ids.shape[0]), ids]
+            n = ids.shape[0]
             if self.ignore_label is not None:
-                ignore = (l == self.ignore_label)
-                prob = prob * (1 - ignore) + ignore
-                num -= ignore.sum()
-            loss -= _np.log(_np.maximum(1e-10, prob)).sum()
-            num += l.shape[0]
-        self.sum_metric += loss
-        self.num_inst += num
+                pad = ids == self.ignore_label
+                picked = _np.where(pad, 1.0, picked)
+                n -= int(pad.sum())
+            self.sum_metric += float(
+                -_np.log(_np.maximum(1e-10, picked)).sum())
+            self.num_inst += n
 
     def get(self):
-        if self.num_inst == 0:
+        if not self.num_inst:
             return (self.name, float("nan"))
         return (self.name, math.exp(self.sum_metric / self.num_inst))
 
 
 @register
 class Loss(EvalMetric):
+    """Mean of raw loss outputs (no labels involved)."""
+
     def __init__(self, name="loss", output_names=None, label_names=None):
         super().__init__(name, output_names, label_names)
 
@@ -373,9 +362,9 @@ class Loss(EvalMetric):
         if isinstance(preds, NDArray):
             preds = [preds]
         for pred in preds:
-            loss = _as_np(pred).sum()
-            self.sum_metric += loss
-            self.num_inst += _as_np(pred).size
+            p = _as_np(pred)
+            self.sum_metric += float(p.sum())
+            self.num_inst += p.size
 
 
 class Torch(Loss):
@@ -390,11 +379,13 @@ class Caffe(Loss):
 
 @register
 class CustomMetric(EvalMetric):
+    """Wrap a ``feval(label, pred) -> value | (sum, num)`` callable."""
+
     def __init__(self, feval, name=None, allow_extra_outputs=False,
                  output_names=None, label_names=None):
         if name is None:
             name = feval.__name__
-            if name.find("<") != -1:
+            if "<" in name:
                 name = "custom(%s)" % name
         super().__init__(name, output_names, label_names)
         self._feval = feval
@@ -403,20 +394,19 @@ class CustomMetric(EvalMetric):
     def update(self, labels, preds):
         if not self._allow_extra_outputs:
             check_label_shapes(labels, preds)
-        for pred, label in zip(preds, labels):
-            l = _as_np(label)
-            p = _as_np(pred)
-            reval = self._feval(l, p)
-            if isinstance(reval, tuple):
-                sum_metric, num_inst = reval
-                self.sum_metric += sum_metric
-                self.num_inst += num_inst
+        for l, p in _pairs(labels, preds):
+            result = self._feval(l, p)
+            if isinstance(result, tuple):
+                self.sum_metric += result[0]
+                self.num_inst += result[1]
             else:
-                self.sum_metric += reval
+                self.sum_metric += result
                 self.num_inst += 1
 
 
 def np(numpy_feval, name=None, allow_extra_outputs=False):
+    """Lift a plain numpy feval into a CustomMetric (reference:
+    metric.np)."""
     def feval(label, pred):
         return numpy_feval(label, pred)
     feval.__name__ = numpy_feval.__name__

@@ -413,8 +413,22 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     if fix_gamma:
         gamma = jnp.ones_like(gamma)
     if training and not use_global_stats:
-        mean = jnp.mean(data, axis=red)
-        var = jnp.var(data, axis=red)
+        # ONE pass over the activation: sum and sum-of-squares fuse into
+        # a single fused reduction (same input, two outputs), vs
+        # mean+var's dependent two-pass form — BN inputs are the largest
+        # tensors in a conv net, so the extra read is the expensive part.
+        # f32 accumulation regardless of a bf16 input: the cast fuses
+        # into the reduction read, and bf16 accumulation over 1e6+
+        # elements loses the batch statistics entirely.
+        n = 1
+        for i in red:
+            n *= data.shape[i]
+        x32 = data.astype(jnp.float32)
+        s1 = jnp.sum(x32, axis=red)
+        s2 = jnp.sum(x32 * x32, axis=red)
+        mean = (s1 / n).astype(moving_mean.dtype)
+        var = jnp.maximum(s2 / n - jnp.square(s1 / n), 0.0) \
+            .astype(moving_var.dtype)
         new_mm = moving_mean * momentum + mean * (1 - momentum)
         new_mv = moving_var * momentum + var * (1 - momentum)
     else:

@@ -1,11 +1,15 @@
 """Optimizers (reference: python/mxnet/optimizer/optimizer.py, 1,571 LoC).
 
-Each ``update`` dispatches to the fused update ops in
-``mxnet_tpu/ops/optimizer_ops.py`` (one XLA computation per update, weight
-buffers donated), mirroring the reference's fused optimizer kernels
-(src/operator/optimizer_op.cc:43-651).  ``Updater`` reproduces the
-serializable per-index state store that KVStore servers run
-(optimizer.py:1504).
+Design: every ``update`` resolves its per-parameter hyper-parameters
+(lr/wd multipliers, update count) in Python and then dispatches ONE
+fused update op from ``mxnet_tpu/ops/optimizer_ops.py`` — a single XLA
+computation per parameter with the weight/state buffers donated, the
+TPU analogue of the reference's fused optimizer kernels
+(src/operator/optimizer_op.cc:43-651).  The shared ``_fused`` helper
+owns the out-list/common-kwarg plumbing so each optimizer subclass is
+just its hyper-parameters plus one dispatch line.  ``Updater``
+reproduces the serializable per-index state store that KVStore servers
+run (reference optimizer.py:1504).
 """
 
 from __future__ import annotations
@@ -38,117 +42,136 @@ def create(name, **kwargs):
     return _reg.get(name)(**kwargs)
 
 
+_LOW_PRECISION = ("float16", "bfloat16")
+
+
 class Optimizer:
-    """Base optimizer (reference: optimizer.py Optimizer:46)."""
+    """Base optimizer (reference: optimizer.py Optimizer:46).
+
+    Subclass contract: implement ``create_state`` (None or a tuple of
+    state NDArrays per parameter) and ``update``; use ``_bump`` to get
+    the per-parameter step count and ``_fused`` to dispatch the kernel.
+    """
 
     def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
                  clip_gradient=None, learning_rate=0.01,
                  lr_scheduler=None, sym=None, begin_num_update=0,
                  multi_precision=False, param_dict=None):
-        self.rescale_grad = rescale_grad
-        self.lr = learning_rate
+        self.lr, self.wd = learning_rate, wd
+        self.rescale_grad, self.clip_gradient = rescale_grad, clip_gradient
         self.lr_scheduler = lr_scheduler
         if lr_scheduler is not None:
-            self.lr_scheduler.base_lr = learning_rate
-        self.wd = wd
-        self.clip_gradient = clip_gradient
-        self.begin_num_update = begin_num_update
-        self.num_update = begin_num_update
+            lr_scheduler.base_lr = learning_rate
+        self.begin_num_update = self.num_update = begin_num_update
         self._index_update_count = {}
         self.multi_precision = multi_precision
         self.idx2name = dict(param_idx2name or {})
         self.param_dict = param_dict or {}
-        self.lr_mult = {}
-        self.wd_mult = {}
+        self.lr_mult, self.wd_mult = {}, {}
 
-    # -- lr/wd resolution --------------------------------------------------
+    # -- per-parameter hyper-parameter resolution -------------------------
+
     def set_learning_rate(self, lr):
         if self.lr_scheduler is not None:
-            raise UserWarning("LRScheduler of the optimizer has already "
-                              "been defined.")
+            raise UserWarning("learning rate is owned by the attached "
+                              "LRScheduler")
         self.lr = lr
 
     @property
     def learning_rate(self):
-        if self.lr_scheduler is not None:
-            return self.lr_scheduler(self.num_update)
-        return self.lr
+        sched = self.lr_scheduler
+        return self.lr if sched is None else sched(self.num_update)
 
     def set_lr_mult(self, args_lr_mult):
         self.lr_mult = dict(args_lr_mult)
 
     def set_wd_mult(self, args_wd_mult):
-        self.wd_mult = {}
-        for n in self.idx2name.values():
-            # _gamma (BatchNorm scale) keeps weight decay, like _weight
-            if not (n.endswith("_weight") or n.endswith("_gamma")):
-                self.wd_mult[n] = 0.0
+        # decay applies to weights and BN scales; bias/beta/aux get 0
+        # unless explicitly overridden (reference set_wd_mult semantics)
+        self.wd_mult = {n: 0.0 for n in self.idx2name.values()
+                        if not n.endswith(("_weight", "_gamma"))}
         self.wd_mult.update(args_wd_mult)
 
-    def _update_count(self, index):
-        if index not in self._index_update_count:
-            self._index_update_count[index] = self.begin_num_update
-        self._index_update_count[index] += 1
-        self.num_update = max(self._index_update_count[index],
-                              self.num_update)
+    def _multiplier(self, index, table):
+        """Multiplier for *index* from a {index-or-name: mult} table,
+        honoring Parameter objects in param_dict first."""
+        if index in self.param_dict:
+            p = self.param_dict[index]
+            return p.lr_mult if table is self.lr_mult else p.wd_mult
+        if index in table:
+            return table[index]
+        return table.get(self.idx2name.get(index), 1.0)
 
     def _get_lr(self, index):
-        lr = self.learning_rate
-        if index in self.param_dict:
-            lr *= self.param_dict[index].lr_mult
-        elif index in self.lr_mult:
-            lr *= self.lr_mult[index]
-        elif index in self.idx2name:
-            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
-        return lr
+        return self.learning_rate * self._multiplier(index, self.lr_mult)
 
     def _get_wd(self, index):
-        wd = self.wd
-        if index in self.param_dict:
-            wd *= self.param_dict[index].wd_mult
-        elif index in self.wd_mult:
-            wd *= self.wd_mult[index]
-        elif index in self.idx2name:
-            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
-        return wd
+        return self.wd * self._multiplier(index, self.wd_mult)
 
-    # -- state -------------------------------------------------------------
+    def _bump(self, index):
+        """Advance and return this parameter's update count."""
+        t = self._index_update_count.get(index,
+                                         self.begin_num_update) + 1
+        self._index_update_count[index] = t
+        self.num_update = max(t, self.num_update)
+        return t
+
+    # kept under the reference's internal name: subclasses there call it
+    _update_count = _bump
+
+    # -- state ------------------------------------------------------------
+
     def create_state(self, index, weight):
         return None
 
-    def create_state_multi_precision(self, index, weight):
-        if self.multi_precision and weight.dtype in (_np.float16,
-                                                     "bfloat16") or \
-                (self.multi_precision and
-                 str(weight.dtype) in ("float16", "bfloat16")):
+    def _master_copy(self, index, weight):
+        """(state, fp32 master) pair when mp applies, else plain state."""
+        if self.multi_precision and str(weight.dtype) in _LOW_PRECISION:
             w32 = weight.astype("float32")
             return (self.create_state(index, w32), w32)
         return self.create_state(index, weight)
+
+    create_state_multi_precision = _master_copy
+
+    # -- dispatch ---------------------------------------------------------
+
+    def _common_knobs(self):
+        """The knobs every fused/sparse update kernel takes."""
+        kw = {"rescale_grad": self.rescale_grad}
+        if self.clip_gradient is not None:
+            kw["clip_gradient"] = self.clip_gradient
+        return kw
+
+    def _fused(self, op_name, weight, grad, states=(), **hyper):
+        """Run one fused update kernel: outputs alias [weight, *states],
+        common knobs (rescale/clip) merged in."""
+        for k, v in self._common_knobs().items():
+            hyper.setdefault(k, v)
+        bufs = [weight] + [s for s in states if s is not None]
+        getattr(nd, op_name)(
+            weight, grad, *[s for s in states if s is not None],
+            out=bufs if len(bufs) > 1 else weight, **hyper)
+
+    def _densify(self, grad):
+        from ..ndarray import sparse as _sp
+        if isinstance(grad, _sp.BaseSparseNDArray):
+            return grad.todense()
+        return grad
 
     def update(self, index, weight, grad, state):
         raise NotImplementedError
 
     def update_multi_precision(self, index, weight, grad, state):
-        if self.multi_precision and isinstance(state, tuple) and \
-                isinstance(state[-1], NDArray) and \
-                state[-1].dtype == _np.float32 and \
-                weight.dtype != _np.float32:
-            self._update_mp(index, weight, grad, state)
-        else:
-            self.update(index, weight, grad, state)
-
-    def _update_mp(self, index, weight, grad, state):
-        # generic mp fallback: update the fp32 master then cast down
-        inner_state, w32 = state
-        g32 = grad.astype("float32")
-        self.update(index, w32, g32, inner_state)
+        is_mp = (self.multi_precision and isinstance(state, tuple)
+                 and isinstance(state[-1], NDArray)
+                 and state[-1].dtype == _np.float32
+                 and weight.dtype != _np.float32)
+        if not is_mp:
+            return self.update(index, weight, grad, state)
+        # generic fp32-master fallback: update the master, cast down
+        inner, w32 = state
+        self.update(index, w32, grad.astype("float32"), inner)
         weight._data = w32._data.astype(weight._data.dtype)
-
-    def _common_kwargs(self, index):
-        kw = {"rescale_grad": self.rescale_grad}
-        if self.clip_gradient is not None:
-            kw["clip_gradient"] = self.clip_gradient
-        return kw
 
 
 # ---------------------------------------------------------------------------
@@ -156,34 +179,25 @@ class Optimizer:
 
 @register
 class SGD(Optimizer):
-    """SGD with momentum and optional multi-precision
-    (reference: optimizer.py SGD:451)."""
+    """SGD with momentum, lazy row-sparse updates, and fused
+    multi-precision kernels (reference: optimizer.py SGD:451)."""
 
     def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
         super().__init__(**kwargs)
-        self.momentum = momentum
-        self.lazy_update = lazy_update
+        self.momentum, self.lazy_update = momentum, lazy_update
 
     def create_state(self, index, weight):
-        if self.momentum != 0.0:
-            return nd.zeros(weight.shape, dtype=str(weight.dtype))
-        return None
-
-    def create_state_multi_precision(self, index, weight):
-        if self.multi_precision and weight.dtype in (_np.float16,) or \
-                str(weight.dtype) == "bfloat16" and self.multi_precision:
-            w32 = weight.astype("float32")
-            return (self.create_state(index, w32), w32)
-        return self.create_state(index, weight)
+        return nd.zeros(weight.shape, dtype=str(weight.dtype)) \
+            if self.momentum else None
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
+        self._bump(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
-        kw = self._common_kwargs(index)
         from ..ndarray import sparse as _sp
         if isinstance(grad, _sp.RowSparseNDArray) and self.lazy_update:
-            # lazy row-wise path: only the gradient's rows are touched
-            # (reference: optimizer_op.cc sgd row_sparse lazy_update)
+            # only the gradient's rows are touched (reference:
+            # optimizer_op.cc sgd row_sparse lazy_update)
+            kw = self._common_knobs()
             if state is not None:
                 _sp.sgd_mom_row_update(weight, grad, state, lr=lr,
                                        momentum=self.momentum, wd=wd,
@@ -191,61 +205,54 @@ class SGD(Optimizer):
             else:
                 _sp.sgd_row_update(weight, grad, lr=lr, wd=wd, **kw)
             return
-        if isinstance(grad, _sp.BaseSparseNDArray):
-            grad = grad.todense()
+        grad = self._densify(grad)
         if state is not None:
-            nd.sgd_mom_update(weight, grad, state, out=[weight, state],
-                              lr=lr, wd=wd, momentum=self.momentum, **kw)
+            self._fused("sgd_mom_update", weight, grad, (state,),
+                        lr=lr, wd=wd, momentum=self.momentum)
         else:
-            nd.sgd_update(weight, grad, out=weight, lr=lr, wd=wd, **kw)
+            self._fused("sgd_update", weight, grad, lr=lr, wd=wd)
 
     def update_multi_precision(self, index, weight, grad, state):
-        if isinstance(state, tuple) and isinstance(state[1], NDArray) and \
-                state[1].dtype == _np.float32 and \
-                weight.dtype != _np.float32:
-            from ..ndarray import sparse as _sp
-            if isinstance(grad, _sp.BaseSparseNDArray):
-                # the fused mp kernels are dense-only; correctness over
-                # laziness for the fp32-master path
-                grad = grad.todense()
-            self._update_count(index)
-            lr, wd = self._get_lr(index), self._get_wd(index)
-            kw = self._common_kwargs(index)
-            mom, w32 = state
-            if mom is not None:
-                nd.mp_sgd_mom_update(weight, grad, mom, w32,
-                                     out=[weight, mom, w32], lr=lr, wd=wd,
-                                     momentum=self.momentum, **kw)
-            else:
-                nd.mp_sgd_update(weight, grad, w32, out=[weight, w32],
-                                 lr=lr, wd=wd, **kw)
+        is_mp = (isinstance(state, tuple)
+                 and isinstance(state[1], NDArray)
+                 and state[1].dtype == _np.float32
+                 and weight.dtype != _np.float32)
+        if not is_mp:
+            return self.update(index, weight, grad, state)
+        # fused mp kernels are dense-only: correctness over laziness
+        grad = self._densify(grad)
+        self._bump(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        mom, w32 = state
+        if mom is not None:
+            self._fused("mp_sgd_mom_update", weight, grad, (mom, w32),
+                        lr=lr, wd=wd, momentum=self.momentum)
         else:
-            self.update(index, weight, grad, state)
+            self._fused("mp_sgd_update", weight, grad, (w32,),
+                        lr=lr, wd=wd)
 
 
 @register
 class Signum(Optimizer):
+    """Sign-of-momentum updates (reference: optimizer.py Signum:920)."""
+
     def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0,
                  **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
-        self.momentum = momentum
-        self.wd_lh = wd_lh
+        self.momentum, self.wd_lh = momentum, wd_lh
 
     def create_state(self, index, weight):
-        if self.momentum != 0.0:
-            return nd.zeros(weight.shape, dtype=str(weight.dtype))
-        return None
+        return nd.zeros(weight.shape, dtype=str(weight.dtype)) \
+            if self.momentum else None
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
+        self._bump(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
-        kw = self._common_kwargs(index)
         if state is not None:
-            nd.signum_update(weight, grad, state, out=[weight, state],
-                             lr=lr, wd=wd, momentum=self.momentum,
-                             wd_lh=self.wd_lh, **kw)
+            self._fused("signum_update", weight, grad, (state,), lr=lr,
+                        wd=wd, momentum=self.momentum, wd_lh=self.wd_lh)
         else:
-            nd.signsgd_update(weight, grad, out=weight, lr=lr, wd=wd, **kw)
+            self._fused("signsgd_update", weight, grad, lr=lr, wd=wd)
 
 
 @register
@@ -257,32 +264,32 @@ class SignSGD(Signum):
 
 @register
 class FTML(Optimizer):
+    """Follow the moving leader (reference: optimizer.py FTML:830)."""
+
     def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
         super().__init__(**kwargs)
-        self.beta1 = beta1
-        self.beta2 = beta2
-        self.epsilon = epsilon
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
 
     def create_state(self, index, weight):
-        return (nd.zeros(weight.shape), nd.zeros(weight.shape),
-                nd.zeros(weight.shape))
+        z = lambda: nd.zeros(weight.shape)  # noqa: E731
+        return (z(), z(), z())
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        t = self._index_update_count[index]
-        d, v, z = state
+        t = self._bump(index)
         kw = {"rescale_grad": self.rescale_grad}
         if self.clip_gradient is not None:
-            kw["clip_grad"] = self.clip_gradient
+            kw["clip_grad"] = self.clip_gradient   # ftml's knob name
+        d, v, z = state
         nd.ftml_update(weight, grad, d, v, z, out=[weight, d, v, z],
-                       lr=lr, wd=wd, beta1=self.beta1, beta2=self.beta2,
+                       lr=self._get_lr(index), wd=self._get_wd(index),
+                       beta1=self.beta1, beta2=self.beta2,
                        epsilon=self.epsilon, t=t, **kw)
 
 
 @register
 class LBSGD(Optimizer):
-    """Large-batch SGD with LARS layer-wise adaptation
+    """Large-batch SGD: warmup multiplier schedules or LARS layer-wise
+    trust ratios on top of momentum SGD
     (reference: optimizer.py LBSGD:678)."""
 
     def __init__(self, momentum=0.0, multi_precision=False,
@@ -300,153 +307,147 @@ class LBSGD(Optimizer):
         self.lbmult = 1.0
 
     def create_state(self, index, weight):
-        if self.momentum != 0.0:
-            return nd.zeros(weight.shape, dtype=str(weight.dtype))
-        return None
+        return nd.zeros(weight.shape, dtype=str(weight.dtype)) \
+            if self.momentum else None
 
-    def _get_lbmult(self, nup):
-        nwup = self.warmup_epochs * self.updates_per_epoch
-        strategy = self.warmup_strategy
-        maxmult = float(self.batch_scale)
-        if nup >= nwup:
-            mult = maxmult
-        elif nwup <= 1:
-            mult = 1.0
-        else:
-            if strategy == "linear":
-                mult = 1.0 + (maxmult - 1) * nup / nwup
-            elif strategy == "power2":
-                mult = 1.0 + (maxmult - 1) * (nup * nup) / (nwup * nwup)
-            elif strategy == "sqrt":
-                mult = 1.0 + (maxmult - 1) * math.sqrt(float(nup) / nwup)
-            else:
-                mult = 1.0
-        return mult
+    def _warmup_mult(self, nup):
+        """Ramp 1 -> batch_scale over the warmup window."""
+        span = self.warmup_epochs * self.updates_per_epoch
+        top = float(self.batch_scale)
+        if nup >= span:
+            return top
+        if span <= 1:
+            return 1.0
+        frac = {"linear": nup / span,
+                "power2": (nup / span) ** 2,
+                "sqrt": math.sqrt(nup / span)}.get(self.warmup_strategy)
+        return 1.0 + (top - 1.0) * frac if frac is not None else 1.0
 
-    def _get_lars(self, weight, g, wd):
-        """LARS trust ratio ||w|| / (||g|| + wd*||w||)."""
+    def _lars_ratio(self, weight, g, wd):
+        """Trust ratio ||w|| / (||g|| + wd ||w||) per layer."""
         w2 = float((weight * weight).sum().asscalar())
         g2 = float((g * g).sum().asscalar())
-        if w2 == 0 or g2 == 0:
+        if not w2 or not g2:
             return 1.0
         return math.sqrt(w2 / (g2 + wd * w2 + 1e-18))
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
+        self._bump(index)
         wd = self._get_wd(index)
         if self.warmup_strategy == "lars":
-            lbmult = self._get_lars(weight, grad, wd)
+            mult = self._lars_ratio(weight, grad, wd)
         else:
-            lbmult = self._get_lbmult(self.num_update + self.init_updates)
-        lr = self._get_lr(index) * lbmult
-        kw = self._common_kwargs(index)
+            mult = self._warmup_mult(self.num_update + self.init_updates)
+        lr = self._get_lr(index) * mult
         if state is not None:
-            nd.sgd_mom_update(weight, grad, state, out=[weight, state],
-                              lr=lr, wd=wd, momentum=self.momentum, **kw)
+            self._fused("sgd_mom_update", weight, grad, (state,),
+                        lr=lr, wd=wd, momentum=self.momentum)
         else:
-            nd.sgd_update(weight, grad, out=weight, lr=lr, wd=wd, **kw)
+            self._fused("sgd_update", weight, grad, lr=lr, wd=wd)
 
 
 @register
 class DCASGD(Optimizer):
-    """Delay-compensated async SGD (reference: optimizer.py DCASGD:868)."""
+    """Delay-compensated async SGD (reference: optimizer.py DCASGD:868):
+    compensates stale gradients with a grad^2-scaled correction toward
+    the weight drift since the gradient was computed."""
 
     def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
         super().__init__(**kwargs)
-        self.momentum = momentum
-        self.weight_previous = {}
-        self.lamda = lamda
+        self.momentum, self.lamda = momentum, lamda
 
     def create_state(self, index, weight):
-        if self.momentum == 0.0:
-            return (None, weight.copy())
-        return (nd.zeros(weight.shape, dtype=str(weight.dtype)),
-                weight.copy())
+        mom = nd.zeros(weight.shape, dtype=str(weight.dtype)) \
+            if self.momentum else None
+        return (mom, weight.copy())   # (momentum, weight snapshot)
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
+        self._bump(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
-        grad = grad * self.rescale_grad
+        g = grad * self.rescale_grad
         if self.clip_gradient is not None:
-            grad = nd.clip(grad, -self.clip_gradient, self.clip_gradient)
-        mom, previous_weight = state
-        comp = grad + self.lamda * grad * grad * (weight - previous_weight)
+            g = nd.clip(g, -self.clip_gradient, self.clip_gradient)
+        mom, snapshot = state
+        drift = weight - snapshot
+        g_comp = g + self.lamda * g * g * drift
+        step = g_comp + wd * weight
         if mom is not None:
-            m = self.momentum * mom - lr * (comp + wd * weight)
+            m = self.momentum * mom - lr * step
             mom._data = m._data
             weight._data = (weight + m)._data
         else:
-            weight._data = (weight - lr * (comp + wd * weight))._data
-        previous_weight._data = weight._data
+            weight._data = (weight - lr * step)._data
+        snapshot._data = weight._data
 
 
 @register
 class NAG(Optimizer):
+    """Nesterov accelerated SGD (reference: optimizer.py NAG:938)."""
+
     def __init__(self, momentum=0.0, **kwargs):
         super().__init__(**kwargs)
         self.momentum = momentum
 
     def create_state(self, index, weight):
-        if self.momentum != 0.0:
-            return nd.zeros(weight.shape, dtype=str(weight.dtype))
-        return None
+        return nd.zeros(weight.shape, dtype=str(weight.dtype)) \
+            if self.momentum else None
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
+        self._bump(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
-        kw = self._common_kwargs(index)
         if state is not None:
-            nd.nag_mom_update(weight, grad, state, out=[weight, state],
-                              lr=lr, wd=wd, momentum=self.momentum, **kw)
+            self._fused("nag_mom_update", weight, grad, (state,),
+                        lr=lr, wd=wd, momentum=self.momentum)
         else:
-            nd.sgd_update(weight, grad, out=weight, lr=lr, wd=wd, **kw)
+            self._fused("sgd_update", weight, grad, lr=lr, wd=wd)
 
 
 @register
 class SGLD(Optimizer):
-    """Stochastic gradient Langevin dynamics
-    (reference: optimizer.py SGLD:976)."""
+    """Stochastic gradient Langevin dynamics: SGD plus N(0, lr) noise
+    for posterior sampling (reference: optimizer.py SGLD:976)."""
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
+        self._bump(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
-        grad = grad * self.rescale_grad
+        g = grad * self.rescale_grad
         if self.clip_gradient is not None:
-            grad = nd.clip(grad, -self.clip_gradient, self.clip_gradient)
+            g = nd.clip(g, -self.clip_gradient, self.clip_gradient)
         noise = nd.random.normal(0, math.sqrt(lr), shape=weight.shape,
                                  dtype=str(weight.dtype))
-        weight._data = (weight - lr / 2 * (grad + wd * weight) +
-                        noise)._data
+        weight._data = (weight - lr / 2 * (g + wd * weight) + noise)._data
 
 
 @register
 class Adam(Optimizer):
+    """Adam with in-lr bias correction (reference: optimizer.py
+    Adam:1003 folds the correction into lr, not the moments)."""
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, lazy_update=True, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
-        self.beta1 = beta1
-        self.beta2 = beta2
-        self.epsilon = epsilon
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
         self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
-        return (nd.zeros(weight.shape, dtype=str(weight.dtype)),
-                nd.zeros(weight.shape, dtype=str(weight.dtype)))
+        z = lambda: nd.zeros(weight.shape, dtype=str(weight.dtype))  # noqa
+        return (z(), z())
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        t = self._index_update_count[index]
-        lr *= math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
+        t = self._bump(index)
+        lr = self._get_lr(index) * \
+            math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
         mean, var = state
-        kw = self._common_kwargs(index)
-        nd.adam_update(weight, grad, mean, var, out=[weight, mean, var],
-                       lr=lr, wd=wd, beta1=self.beta1, beta2=self.beta2,
-                       epsilon=self.epsilon, **kw)
+        self._fused("adam_update", weight, grad, (mean, var), lr=lr,
+                    wd=self._get_wd(index), beta1=self.beta1,
+                    beta2=self.beta2, epsilon=self.epsilon)
 
 
 @register
 class AdaGrad(Optimizer):
+    """AdaGrad with a row-sparse fast path (reference: optimizer.py
+    AdaGrad:1140 over _sparse_adagrad_update)."""
+
     def __init__(self, eps=1e-7, **kwargs):
         super().__init__(**kwargs)
         self.float_stable_eps = eps
@@ -455,140 +456,133 @@ class AdaGrad(Optimizer):
         return nd.zeros(weight.shape, dtype=str(weight.dtype))
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
+        self._bump(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
-        kw = self._common_kwargs(index)
         from ..ndarray import sparse as _sp
         if isinstance(grad, _sp.RowSparseNDArray):
             _sp.adagrad_row_update(weight, grad, state, lr=lr, wd=wd,
-                                   epsilon=self.float_stable_eps, **kw)
+                                   epsilon=self.float_stable_eps,
+                                   **self._common_knobs())
             return
-        if isinstance(grad, _sp.BaseSparseNDArray):
-            grad = grad.todense()
-        nd._sparse_adagrad_update(weight, grad, state, out=[weight, state],
-                                  lr=lr, wd=wd,
-                                  epsilon=self.float_stable_eps, **kw)
+        self._fused("_sparse_adagrad_update", weight,
+                    self._densify(grad), (state,), lr=lr, wd=wd,
+                    epsilon=self.float_stable_eps)
 
 
 @register
 class RMSProp(Optimizer):
+    """RMSProp, plain or centered (reference: optimizer.py
+    RMSProp:1063; Tieleman & Hinton / Graves variants)."""
+
     def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
-                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+                 epsilon=1e-8, centered=False, clip_weights=None,
+                 **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
-        self.gamma1 = gamma1
-        self.gamma2 = gamma2
-        self.centered = centered
-        self.epsilon = epsilon
+        self.gamma1, self.gamma2 = gamma1, gamma2
+        self.centered, self.epsilon = centered, epsilon
         self.clip_weights = clip_weights
 
     def create_state(self, index, weight):
-        if self.centered:
-            return (nd.zeros(weight.shape), nd.zeros(weight.shape),
-                    nd.zeros(weight.shape))
-        return nd.zeros(weight.shape)
+        z = lambda: nd.zeros(weight.shape)  # noqa: E731
+        return (z(), z(), z()) if self.centered else z()
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
+        self._bump(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
-        kw = self._common_kwargs(index)
-        if self.clip_weights:
-            kw["clip_weights"] = self.clip_weights
+        extra = {"clip_weights": self.clip_weights} \
+            if self.clip_weights else {}
         if self.centered:
             n, g, delta = state
-            nd.rmspropalex_update(weight, grad, n, g, delta,
-                                  out=[weight, n, g, delta], lr=lr, wd=wd,
-                                  gamma1=self.gamma1, gamma2=self.gamma2,
-                                  epsilon=self.epsilon, **kw)
+            self._fused("rmspropalex_update", weight, grad,
+                        (n, g, delta), lr=lr, wd=wd, gamma1=self.gamma1,
+                        gamma2=self.gamma2, epsilon=self.epsilon,
+                        **extra)
         else:
-            nd.rmsprop_update(weight, grad, state, out=[weight, state],
-                              lr=lr, wd=wd, gamma1=self.gamma1,
-                              epsilon=self.epsilon, **kw)
+            self._fused("rmsprop_update", weight, grad, (state,),
+                        lr=lr, wd=wd, gamma1=self.gamma1,
+                        epsilon=self.epsilon, **extra)
 
 
 @register
 class AdaDelta(Optimizer):
+    """AdaDelta (reference: optimizer.py AdaDelta:1224; lr-free)."""
+
     def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
         super().__init__(**kwargs)
-        self.rho = rho
-        self.epsilon = epsilon
+        self.rho, self.epsilon = rho, epsilon
 
     def create_state(self, index, weight):
         return (nd.zeros(weight.shape), nd.zeros(weight.shape))
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        wd = self._get_wd(index)
+        self._bump(index)
         acc_g, acc_delta = state
-        kw = self._common_kwargs(index)
-        nd.adadelta_update(weight, grad, acc_g, acc_delta,
-                           out=[weight, acc_g, acc_delta], rho=self.rho,
-                           epsilon=self.epsilon, wd=wd, **kw)
+        self._fused("adadelta_update", weight, grad, (acc_g, acc_delta),
+                    rho=self.rho, epsilon=self.epsilon,
+                    wd=self._get_wd(index))
 
 
 @register
 class Ftrl(Optimizer):
+    """FTRL-proximal (reference: optimizer.py Ftrl:1160)."""
+
     def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
-        self.lamda1 = lamda1
-        self.beta = beta
+        self.lamda1, self.beta = lamda1, beta
 
     def create_state(self, index, weight):
         return (nd.zeros(weight.shape), nd.zeros(weight.shape))  # z, n
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
+        self._bump(index)
         z, n = state
-        kw = self._common_kwargs(index)
-        nd.ftrl_update(weight, grad, z, n, out=[weight, z, n], lr=lr,
-                       wd=wd, lamda1=self.lamda1, beta=self.beta, **kw)
+        self._fused("ftrl_update", weight, grad, (z, n),
+                    lr=self._get_lr(index), wd=self._get_wd(index),
+                    lamda1=self.lamda1, beta=self.beta)
 
 
 @register
 class Adamax(Optimizer):
+    """Adamax — Adam under the infinity norm (reference: optimizer.py
+    Adamax:1264)."""
+
     def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
                  **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
-        self.beta1 = beta1
-        self.beta2 = beta2
+        self.beta1, self.beta2 = beta1, beta2
 
     def create_state(self, index, weight):
         return (nd.zeros(weight.shape), nd.zeros(weight.shape))
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        t = self._index_update_count[index]
+        t = self._bump(index)
         mean, var = state
-        kw = self._common_kwargs(index)
-        nd.adamax_update(weight, grad, mean, var, out=[weight, mean, var],
-                         lr=lr, wd=wd, beta1=self.beta1, beta2=self.beta2,
-                         t=t, **kw)
+        self._fused("adamax_update", weight, grad, (mean, var),
+                    lr=self._get_lr(index), wd=self._get_wd(index),
+                    beta1=self.beta1, beta2=self.beta2, t=t)
 
 
 @register
 class Nadam(Optimizer):
+    """Nesterov Adam (reference: optimizer.py Nadam:1319)."""
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, schedule_decay=0.004, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
-        self.beta1 = beta1
-        self.beta2 = beta2
-        self.epsilon = epsilon
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
         self.schedule_decay = schedule_decay
 
     def create_state(self, index, weight):
         return (nd.zeros(weight.shape), nd.zeros(weight.shape))
 
     def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        t = self._index_update_count[index]
+        t = self._bump(index)
         mean, var = state
-        kw = self._common_kwargs(index)
-        nd.nadam_update(weight, grad, mean, var, out=[weight, mean, var],
-                        lr=lr, wd=wd, beta1=self.beta1, beta2=self.beta2,
-                        epsilon=self.epsilon, t=t,
-                        schedule_decay=self.schedule_decay, **kw)
+        self._fused("nadam_update", weight, grad, (mean, var),
+                    lr=self._get_lr(index), wd=self._get_wd(index),
+                    beta1=self.beta1, beta2=self.beta2,
+                    epsilon=self.epsilon, t=t,
+                    schedule_decay=self.schedule_decay)
 
 
 @register

@@ -75,7 +75,7 @@ class ParallelTrainer:
 
     def __init__(self, net, loss, optimizer="sgd", optimizer_params=None,
                  mesh=None, shard_params=False, grad_clip=None,
-                 multi_precision=False):
+                 multi_precision=False, remat=None):
         self.net = net
         self.loss = loss
         self.mesh = mesh or make_mesh()
@@ -84,6 +84,12 @@ class ParallelTrainer:
         self.shard_params = shard_params
         self.grad_clip = grad_clip
         self.multi_precision = multi_precision
+        # rematerialization policy for the fwd activations kept for
+        # backward: None (XLA decides), 'full' (recompute everything —
+        # min HBM), 'dots' (save matmul/conv outputs only, recompute the
+        # cheap elementwise chains — the usual sweet spot), or any
+        # jax.checkpoint policy callable
+        self.remat = remat
         self._step_fn = None
         self._eval_fn = None
         self._params = None          # name -> jax array (device, sharded)
@@ -180,6 +186,18 @@ class ParallelTrainer:
         wd = float(self.opt_params.get("wd", 0.0))
         mp = self.multi_precision
 
+        remat = self.remat
+        if remat is not None:
+            policy = None
+            if remat == "dots":
+                policy = jax.checkpoint_policies \
+                    .dots_with_no_batch_dims_saveable
+            elif callable(remat):
+                policy = remat
+            elif remat != "full":
+                raise ValueError("remat must be None, 'full', 'dots' or "
+                                 "a jax.checkpoint policy")
+
         def train_step(params, opt_state, aux, x, y, key, lr, t):
             def loss_of(p):
                 amap = dict(p)
@@ -188,6 +206,8 @@ class ParallelTrainer:
                 outs, auxu = eval_fn(amap, aux, key)
                 return jnp.mean(outs[0].astype(jnp.float32)), auxu
 
+            if remat is not None:
+                loss_of = jax.checkpoint(loss_of, policy=policy)
             (loss_val, auxu), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(params)
             if grad_clip is not None:

@@ -246,10 +246,15 @@ def check_consistency(sym, location=None, shapes=None, aux_states=None,
     determinism check: two independent executions must agree bitwise.
     """
     backends = backends or list_backends()
-    if location is None:
+    if location is None or (shapes is not None
+                            and isinstance(location, dict)):
+        # shapes drive random values; an optional partial location dict
+        # overrides specific inputs (index/range args that must be valid)
         rs = np.random.RandomState(seed)
-        location = {n: rs.normal(0, 1, s).astype(np.float32)
-                    for n, s in shapes.items()}
+        overrides = dict(location or {})
+        location = {n: overrides.get(
+            n, rs.normal(0, 1, s).astype(np.float32))
+            for n, s in shapes.items()}
     else:
         location = _as_location(sym, location)
     rs = np.random.RandomState(seed + 1)
@@ -259,6 +264,11 @@ def check_consistency(sym, location=None, shapes=None, aux_states=None,
         exe = _bind(sym, location, aux_states, grad_req=grad_req,
                     ctx=_ctx_for(backend))
         outs = exe.forward(is_train=True)
+        if grad_req == "null":
+            # forward-only op (integer/index outputs have no gradient)
+            results.append(([o.asnumpy() for o in outs], {}, None,
+                            backend))
+            continue
         proj = [rs.normal(0, 1, o.shape).astype(np.float32)
                 for o in outs] if not results else results[0][2]
         exe.backward(out_grads=[nd.array(p) for p in proj])

@@ -75,3 +75,19 @@ def test_f1():
     label = nd.array([1, 0, 0])
     m.update([label], [pred])
     assert 0 < m.get()[1] <= 1.0
+
+
+def test_column_vector_labels_all_classifiers():
+    """(N, 1)-shaped label columns (a common iterator output) must work
+    in every classification metric and stay within [0, 1]."""
+    rs = np.random.RandomState(0)
+    preds = nd.array(rs.rand(6, 2).astype(np.float32))
+    lab_col = nd.array(rs.randint(0, 2, (6, 1)).astype(np.float32))
+    for name in ("acc", "f1", "mcc"):
+        m = metric.create(name)
+        m.update([lab_col], [preds])
+        v = m.get()[1]
+        assert np.isfinite(v) and abs(v) <= 1.0, (name, v)
+    mk = metric.create("top_k_accuracy", top_k=2)
+    mk.update([lab_col], [nd.array(rs.rand(6, 5).astype(np.float32))])
+    assert 0.0 <= mk.get()[1] <= 1.0

@@ -18,6 +18,8 @@ from __future__ import annotations
 import sys
 import traceback
 
+import numpy as _np
+
 
 def _cases(mx):
     """(name, symbol, shapes, tolerances) — one per op family."""
@@ -26,8 +28,9 @@ def _cases(mx):
     w = s.var("w")
     cases = []
 
-    def add(name, sym, shapes, rtol=2e-3, atol=2e-3):
-        cases.append((name, sym, shapes, rtol, atol))
+    def add(name, sym, shapes, rtol=2e-3, atol=2e-3, grad_req="write",
+            location=None):
+        cases.append((name, sym, shapes, rtol, atol, grad_req, location))
 
     add("fc_relu", s.Activation(s.FullyConnected(
         d, num_hidden=16, name="fc"), act_type="relu"),
@@ -61,6 +64,62 @@ def _cases(mx):
     add("attention", s.contrib.DotProductAttention(
         s.var("q"), s.var("k"), s.var("v")),
         {"q": (1, 2, 16, 8), "k": (1, 2, 16, 8), "v": (1, 2, 16, 8)})
+
+    # --- round 4: one case per remaining op family ---------------------
+    # recurrent: multi-layer bidirectional LSTM / GRU
+    add("lstm_bidir", s.RNN(d, s.var("pl"), s.var("sl"), s.var("cl"),
+                            state_size=6, num_layers=2, mode="lstm",
+                            bidirectional=True, name="rl"),
+        {"data": (4, 2, 5)})
+    add("gru", s.RNN(d, s.var("pg"), s.var("sg"), state_size=6,
+                     num_layers=1, mode="gru", name="rg"),
+        {"data": (4, 2, 5)})
+    # dense NN long tail
+    add("deconv", s.Deconvolution(d, num_filter=4, kernel=(2, 2),
+                                  stride=(2, 2), name="dc"),
+        {"data": (2, 3, 5, 5)})
+    add("pool_avg_global", s.Pooling(d, global_pool=True,
+                                     pool_type="avg", kernel=(1, 1)),
+        {"data": (2, 4, 6, 6)})
+    add("dropout_eval", s.Dropout(d, p=0.5), {"data": (4, 6)})
+    add("lrn", s.LRN(d, nsize=3), {"data": (2, 4, 5, 5)})
+    add("svm_output", s.SVMOutput(s.FullyConnected(
+        d, num_hidden=4, name="f3"), s.var("lbl2")),
+        {"data": (5, 6), "lbl2": (5,)})
+    # detection / spatial
+    add("roi_align", s.contrib.ROIAlign(
+        d, s.var("rois"), pooled_size=(2, 2), spatial_scale=1.0),
+        {"data": (1, 3, 8, 8), "rois": (2, 5)})
+    add("bilinear_sampler", s.BilinearSampler(d, s.var("grid")),
+        {"data": (1, 2, 6, 6), "grid": (1, 2, 4, 4)})
+    add("spatial_transformer", s.SpatialTransformer(
+        d, s.FullyConnected(s.var("loc"), num_hidden=6, name="lf"),
+        target_shape=(4, 4), transform_type="affine",
+        sampler_type="bilinear"),
+        {"data": (1, 2, 6, 6), "loc": (1, 8)})
+    # forward-only families (integer / index outputs)
+    add("box_nms", s.contrib.box_nms(d, overlap_thresh=0.5),
+        {"data": (1, 6, 6)}, grad_req="null")
+    add("topk_argsort", s.topk(d, k=3, ret_typ="indices"),
+        {"data": (4, 9)}, grad_req="null")
+    add("bipartite_match", s.contrib.bipartite_matching(
+        d, threshold=1e-12), {"data": (5, 4)}, grad_req="null")
+    add("quantize_int8", s.contrib.quantize(
+        d, s.var("qmin"), s.var("qmax"), out_type="int8"),
+        {"data": (3, 7), "qmin": (1,), "qmax": (1,)}, grad_req="null",
+        location={"qmin": _np.array([-3.0], _np.float32),
+                  "qmax": _np.array([3.0], _np.float32)})
+    # graph-level sparse ops (explicit integer row ids)
+    add("sparse_square_sum", s._square_sum(s._sparse_retain(
+        d, s.var("sridx")), axis=1),
+        {"data": (6, 5), "sridx": (3,)}, grad_req="null",
+        location={"sridx": _np.array([0, 2, 5], _np.float32)})
+    add("sparse_dot_dense", s.dot(s.cast_storage(d, stype="default"), w),
+        {"data": (4, 6), "w": (6, 3)})
+    # flash vs chunked vs oracle attention agree ON the device itself
+    add("attention_causal", s.contrib.DotProductAttention(
+        s.var("q"), s.var("k"), s.var("v"), causal=True),
+        {"q": (1, 2, 32, 8), "k": (1, 2, 32, 8), "v": (1, 2, 32, 8)})
     return cases
 
 
@@ -76,14 +135,18 @@ def main():
 
     failures = []
     cases = _cases(mx)
-    for name, sym, shapes, rtol, atol in cases:
+    only = sys.argv[1:] or None
+    for name, sym, shapes, rtol, atol, grad_req, location in cases:
+        if only and name not in only:
+            continue
         try:
             # complete the shape dict (weights etc.) via inference
             arg_shapes, _, _ = sym.infer_shape(**shapes)
             full = dict(zip(sym.list_arguments(), arg_shapes))
             test_utils.check_consistency(
-                sym, shapes=full, backends=["cpu", "tpu"],
-                rtol=rtol, atol=atol)
+                sym, shapes=full, location=location,
+                backends=["cpu", "tpu"], rtol=rtol, atol=atol,
+                grad_req=grad_req)
             print("OK   %s" % name, flush=True)
         except Exception:
             failures.append(name)
