@@ -1,0 +1,90 @@
+/*
+ * NDArray + operator-invoke C ABI for the TPU-native framework.
+ *
+ * Mirrors the core training surface of the reference's
+ * include/mxnet/c_api.h: array lifecycle (MXNDArrayCreate/Free),
+ * host<->device copies (MXNDArraySyncCopyFromCPU/ToCPU), shape/dtype
+ * introspection, the generic operator entry point MXImperativeInvoke
+ * (every registered operator — including the fused optimizer updates,
+ * so full training loops are reachable from C), registry listing, and
+ * save/load of the framework-native checkpoint container (reference
+ * API shape; byte layout per ndarray/utils.py, not the CUDA-era
+ * reference binary).
+ *
+ * Like the predict ABI (c_predict_api.h), the library embeds CPython
+ * and routes to mxnet_tpu.capi_bridge; only raw buffers, ints and
+ * strings cross this boundary, so any FFI can bind it.
+ *
+ * All functions return 0 on success, -1 on error (MXGetLastError).
+ */
+#ifndef MXTPU_C_API_H_
+#define MXTPU_C_API_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* NDArrayHandle;
+typedef uint32_t mx_uint;
+
+/* dtype enum (reference mshadow type flags; 7 extends with bfloat16) */
+#define MXTPU_DTYPE_FLOAT32 0
+#define MXTPU_DTYPE_FLOAT64 1
+#define MXTPU_DTYPE_FLOAT16 2
+#define MXTPU_DTYPE_UINT8 3
+#define MXTPU_DTYPE_INT32 4
+#define MXTPU_DTYPE_INT8 5
+#define MXTPU_DTYPE_INT64 6
+#define MXTPU_DTYPE_BFLOAT16 7
+
+const char* MXGetLastError(void);
+int MXGetVersion(int* out);
+
+/* -- lifecycle -------------------------------------------------------- */
+int MXNDArrayCreate(const mx_uint* shape, mx_uint ndim, int dev_type,
+                    int dev_id, int delay_alloc, int dtype,
+                    NDArrayHandle* out);
+int MXNDArrayFree(NDArrayHandle handle);
+
+/* -- host<->device copies (buffer bytes are the array's dtype) -------- */
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void* data,
+                             size_t size_bytes);
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void* data,
+                           size_t size_bytes);
+
+/* -- introspection ---------------------------------------------------- */
+int MXNDArrayGetShape(NDArrayHandle handle, mx_uint* out_dim,
+                      const mx_uint** out_pdata);
+int MXNDArrayGetDType(NDArrayHandle handle, int* out_dtype);
+
+/* -- operators -------------------------------------------------------- */
+/* invoke a registered operator by name; outputs are NEW handles the
+ * caller frees.  params are string key/value pairs exactly like the
+ * reference's MXImperativeInvoke. *num_outputs is set on return and
+ * *outputs points at an array valid until the next invoke on any
+ * thread-local handle (copy the handles out immediately). */
+int MXImperativeInvoke(const char* op_name, int num_inputs,
+                       NDArrayHandle* inputs, int* num_outputs,
+                       NDArrayHandle** outputs, int num_params,
+                       const char** param_keys,
+                       const char** param_vals);
+/* newline-joined registry listing; pointer valid until next call */
+int MXListAllOpNames(const char** out_names);
+
+/* -- save/load (framework-native container, reference API shape) ----- */
+int MXNDArraySave(const char* fname, mx_uint num_args,
+                  NDArrayHandle* args, const char** keys);
+/* loads into library-owned arrays; *out_names entries may be NULL for
+ * unnamed saves.  Handles are new and caller-freed; the name/handle
+ * arrays stay valid until the next MXNDArrayLoad. */
+int MXNDArrayLoad(const char* fname, mx_uint* out_size,
+                  NDArrayHandle** out_arr, mx_uint* out_name_size,
+                  const char*** out_names);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* MXTPU_C_API_H_ */

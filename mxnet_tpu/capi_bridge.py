@@ -111,3 +111,98 @@ def ndlist_load(param_bytes):
         a = v.asnumpy().astype(np.float32)
         out.append((k, tuple(a.shape), a.tobytes()))
     return out
+
+
+# ---------------------------------------------------------------------------
+# NDArray + operator-invoke ABI (include/mxtpu/c_api.h over
+# src/capi/mxtpu_ndarray.cc; reference surface: include/mxnet/c_api.h
+# MXNDArray* / MXImperativeInvoke / MXListAllOpNames / MXNDArraySave).
+# Handles on the C side are owned references to the NDArray objects
+# returned here.
+# ---------------------------------------------------------------------------
+
+# reference mshadow dtype flags (+7 for bfloat16, our extension)
+_DTYPE_BY_FLAG = {0: "float32", 1: "float64", 2: "float16", 3: "uint8",
+                  4: "int32", 5: "int8", 6: "int64", 7: "bfloat16"}
+_FLAG_BY_DTYPE = {v: k for k, v in _DTYPE_BY_FLAG.items()}
+
+
+def nd_create(shape, dtype_flag, dev_type):
+    import mxnet_tpu as mx
+    del dev_type  # single-device placement; jax owns physical devices
+    return mx.nd.zeros(tuple(int(s) for s in shape),
+                       dtype=_DTYPE_BY_FLAG[int(dtype_flag)])
+
+
+def nd_copy_from_bytes(arr, raw):
+    import jax.numpy as jnp
+    import ml_dtypes  # noqa: F401  (registers bfloat16 with numpy)
+    dt = np.dtype(str(arr.dtype))
+    host = np.frombuffer(raw, dtype=dt).reshape(arr.shape)
+    arr._data = jnp.asarray(host)
+    return True
+
+
+def nd_to_bytes(arr):
+    return np.asarray(arr.asnumpy()).tobytes()
+
+
+def nd_shape(arr):
+    return tuple(int(s) for s in arr.shape)
+
+
+def nd_dtype(arr):
+    return _FLAG_BY_DTYPE[str(arr.dtype)]
+
+
+def nd_invoke(op_name, inputs, str_params):
+    """MXImperativeInvoke: string params are parsed exactly like the
+    symbol front end parses serialized attrs.
+
+    Donating ops (the fused optimizer updates) MUST run through the
+    out= rebinding path: on TPU their input buffers are donated to XLA,
+    so without rebinding the C caller's persistent weight/momentum
+    handles would point at deleted buffers after one step.  The fused
+    ops' convention is that output k reuses the k-th donated input."""
+    import ast
+    from mxnet_tpu.ndarray import NDArray
+    from mxnet_tpu.ndarray.ndarray import imperative_invoke
+    from mxnet_tpu.ops.registry import get_op
+
+    params = {}
+    for k, v in str_params.items():
+        try:
+            params[k] = ast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            params[k] = v  # plain strings (act_type=relu etc.)
+    op = get_op(op_name)
+    out = None
+    if op.donate and isinstance(op.num_outputs, int) and \
+            len(op.donate) == op.num_outputs:
+        out = [inputs[i] for i in op.donate]
+    outs = imperative_invoke(op_name, *inputs, out=out, **params)
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    return [o if isinstance(o, NDArray) else NDArray(o) for o in outs]
+
+
+def nd_list_ops():
+    from mxnet_tpu.ops.registry import list_ops
+    return "\n".join(list_ops())
+
+
+def nd_save(fname, arrs, names):
+    import mxnet_tpu as mx
+    if names is None:
+        mx.nd.save(fname, list(arrs))
+    else:
+        mx.nd.save(fname, dict(zip(names, arrs)))
+    return True
+
+
+def nd_load(fname):
+    import mxnet_tpu as mx
+    loaded = mx.nd.load(fname)
+    if isinstance(loaded, dict):
+        return [(k, v) for k, v in loaded.items()]
+    return [(None, v) for v in loaded]

@@ -413,21 +413,30 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     if fix_gamma:
         gamma = jnp.ones_like(gamma)
     if training and not use_global_stats:
-        # ONE pass over the activation: sum and sum-of-squares fuse into
-        # a single fused reduction (same input, two outputs), vs
+        # ONE pass over the activation: shifted sum and sum-of-squares
+        # fuse into a single reduction (same input, two outputs), vs
         # mean+var's dependent two-pass form — BN inputs are the largest
         # tensors in a conv net, so the extra read is the expensive part.
-        # f32 accumulation regardless of a bf16 input: the cast fuses
-        # into the reduction read, and bf16 accumulation over 1e6+
-        # elements loses the batch statistics entirely.
+        # The shift conditions the E[(x-c)^2]-(E[x-c])^2 identity: raw
+        # E[x^2]-E[x]^2 cancels catastrophically when |mean| >> std.
+        # c = one sampled element per channel is within O(std) of the
+        # batch mean by construction (it IS a sample), so both terms
+        # stay O(var) whatever the mean's magnitude — and unlike
+        # moving_mean it cannot be stale.  f32 accumulation regardless
+        # of a bf16 input: the cast fuses into the reduction read, and
+        # bf16 accumulation over 1e6+ elements loses the statistics.
         n = 1
         for i in red:
             n *= data.shape[i]
-        x32 = data.astype(jnp.float32)
-        s1 = jnp.sum(x32, axis=red)
-        s2 = jnp.sum(x32 * x32, axis=red)
-        mean = (s1 / n).astype(moving_mean.dtype)
-        var = jnp.maximum(s2 / n - jnp.square(s1 / n), 0.0) \
+        pick = tuple(0 if i in red else slice(None)
+                     for i in range(data.ndim))
+        c = jax.lax.stop_gradient(data[pick].astype(jnp.float32))
+        xc = data.astype(jnp.float32) - c.reshape(bshape)
+        s1 = jnp.sum(xc, axis=red)
+        s2 = jnp.sum(xc * xc, axis=red)
+        d1 = s1 / n
+        mean = (c + d1).astype(moving_mean.dtype)
+        var = jnp.maximum(s2 / n - jnp.square(d1), 0.0) \
             .astype(moving_var.dtype)
         new_mm = moving_mean * momentum + mean * (1 - momentum)
         new_mv = moving_var * momentum + var * (1 - momentum)

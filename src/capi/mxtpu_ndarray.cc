@@ -1,0 +1,333 @@
+// NDArray + operator-invoke C ABI (see include/mxtpu/c_api.h).
+//
+// Same architecture as mxtpu_predict.cc: the compute path is XLA via the
+// Python runtime, so this library embeds CPython and forwards each call
+// to mxnet_tpu/capi_bridge.py.  An NDArrayHandle is an owned PyObject*
+// of a framework NDArray; everything else crossing the boundary is raw
+// bytes, ints and strings.  Every entry point takes the GIL.
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#define MXTPU_API extern "C" __attribute__((visibility("default")))
+
+typedef void* NDArrayHandle;
+typedef uint32_t mx_uint;
+
+namespace {
+
+thread_local std::string g_last_error;
+// results that must outlive the call that produced them
+thread_local std::vector<mx_uint> g_shape;
+thread_local std::vector<NDArrayHandle> g_outputs;
+thread_local std::string g_op_names;
+thread_local std::vector<NDArrayHandle> g_loaded;
+thread_local std::vector<std::string> g_loaded_name_store;
+thread_local std::vector<const char*> g_loaded_names;
+
+void EnsureInterpreter() {
+  static std::once_flag once;
+  std::call_once(once, []() {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+#if PY_VERSION_HEX < 0x03090000
+      PyEval_InitThreads();
+#endif
+      PyEval_SaveThread();
+    }
+  });
+}
+
+class GILGuard {
+ public:
+  GILGuard() {
+    EnsureInterpreter();
+    state_ = PyGILState_Ensure();
+  }
+  ~GILGuard() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+void SetErrorFromPython() {
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  g_last_error = "unknown python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c) g_last_error = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+PyObject* GetBridge() {
+  return PyImport_ImportModule("mxnet_tpu.capi_bridge");
+}
+
+// Call bridge.<method>(...) with a pre-built args tuple (steals nothing).
+PyObject* CallBridge(const char* method, PyObject* args) {
+  PyObject* bridge = GetBridge();
+  if (!bridge) return nullptr;
+  PyObject* fn = PyObject_GetAttrString(bridge, method);
+  Py_DECREF(bridge);
+  if (!fn) return nullptr;
+  PyObject* r = PyObject_CallObject(fn, args);
+  Py_DECREF(fn);
+  return r;
+}
+
+}  // namespace
+
+MXTPU_API const char* MXGetLastError() { return g_last_error.c_str(); }
+
+MXTPU_API int MXGetVersion(int* out) {
+  *out = 10301;  // reference parity line (1.3.1)
+  return 0;
+}
+
+MXTPU_API int MXNDArrayCreate(const mx_uint* shape, mx_uint ndim,
+                              int dev_type, int dev_id, int delay_alloc,
+                              int dtype, NDArrayHandle* out) {
+  (void)dev_id;
+  (void)delay_alloc;
+  GILGuard gil;
+  PyObject* shp = PyTuple_New(ndim);
+  for (mx_uint i = 0; i < ndim; ++i)
+    PyTuple_SetItem(shp, i, PyLong_FromUnsignedLong(shape[i]));
+  PyObject* args = Py_BuildValue("(Oii)", shp, dtype, dev_type);
+  Py_DECREF(shp);
+  PyObject* nd = CallBridge("nd_create", args);
+  Py_DECREF(args);
+  if (!nd) {
+    SetErrorFromPython();
+    return -1;
+  }
+  *out = nd;  // ownership transfers to the handle
+  return 0;
+}
+
+MXTPU_API int MXNDArrayFree(NDArrayHandle handle) {
+  GILGuard gil;
+  Py_XDECREF(static_cast<PyObject*>(handle));
+  return 0;
+}
+
+MXTPU_API int MXNDArraySyncCopyFromCPU(NDArrayHandle handle,
+                                       const void* data,
+                                       size_t size_bytes) {
+  GILGuard gil;
+  PyObject* bytes = PyBytes_FromStringAndSize(
+      static_cast<const char*>(data), size_bytes);
+  PyObject* args = Py_BuildValue("(OO)",
+                                 static_cast<PyObject*>(handle), bytes);
+  Py_DECREF(bytes);
+  PyObject* r = CallBridge("nd_copy_from_bytes", args);
+  Py_DECREF(args);
+  if (!r) {
+    SetErrorFromPython();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void* data,
+                                     size_t size_bytes) {
+  GILGuard gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* r = CallBridge("nd_to_bytes", args);
+  Py_DECREF(args);
+  if (!r) {
+    SetErrorFromPython();
+    return -1;
+  }
+  char* buf = nullptr;
+  Py_ssize_t n = 0;
+  if (PyBytes_AsStringAndSize(r, &buf, &n) != 0) {
+    Py_DECREF(r);
+    SetErrorFromPython();
+    return -1;
+  }
+  if (static_cast<size_t>(n) > size_bytes) {
+    Py_DECREF(r);
+    g_last_error = "destination buffer too small";
+    return -1;
+  }
+  std::memcpy(data, buf, n);
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXNDArrayGetShape(NDArrayHandle handle, mx_uint* out_dim,
+                                const mx_uint** out_pdata) {
+  GILGuard gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* r = CallBridge("nd_shape", args);
+  Py_DECREF(args);
+  if (!r) {
+    SetErrorFromPython();
+    return -1;
+  }
+  g_shape.clear();
+  for (Py_ssize_t i = 0; i < PyTuple_Size(r); ++i)
+    g_shape.push_back(static_cast<mx_uint>(
+        PyLong_AsUnsignedLong(PyTuple_GetItem(r, i))));
+  Py_DECREF(r);
+  *out_dim = static_cast<mx_uint>(g_shape.size());
+  *out_pdata = g_shape.data();
+  return 0;
+}
+
+MXTPU_API int MXNDArrayGetDType(NDArrayHandle handle, int* out_dtype) {
+  GILGuard gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* r = CallBridge("nd_dtype", args);
+  Py_DECREF(args);
+  if (!r) {
+    SetErrorFromPython();
+    return -1;
+  }
+  *out_dtype = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXImperativeInvoke(const char* op_name, int num_inputs,
+                                 NDArrayHandle* inputs, int* num_outputs,
+                                 NDArrayHandle** outputs, int num_params,
+                                 const char** param_keys,
+                                 const char** param_vals) {
+  GILGuard gil;
+  PyObject* ins = PyList_New(num_inputs);
+  for (int i = 0; i < num_inputs; ++i) {
+    PyObject* o = static_cast<PyObject*>(inputs[i]);
+    Py_INCREF(o);
+    PyList_SetItem(ins, i, o);
+  }
+  PyObject* params = PyDict_New();
+  for (int i = 0; i < num_params; ++i) {
+    PyObject* v = PyUnicode_FromString(param_vals[i]);
+    PyDict_SetItemString(params, param_keys[i], v);  // does not steal
+    Py_DECREF(v);
+  }
+  PyObject* args = Py_BuildValue("(sOO)", op_name, ins, params);
+  Py_DECREF(ins);
+  Py_DECREF(params);
+  PyObject* r = CallBridge("nd_invoke", args);
+  Py_DECREF(args);
+  if (!r) {
+    SetErrorFromPython();
+    return -1;
+  }
+  g_outputs.clear();
+  for (Py_ssize_t i = 0; i < PyList_Size(r); ++i) {
+    PyObject* o = PyList_GetItem(r, i);
+    Py_INCREF(o);  // each output handle is caller-owned
+    g_outputs.push_back(o);
+  }
+  Py_DECREF(r);
+  *num_outputs = static_cast<int>(g_outputs.size());
+  *outputs = g_outputs.data();
+  return 0;
+}
+
+MXTPU_API int MXListAllOpNames(const char** out_names) {
+  GILGuard gil;
+  PyObject* args = PyTuple_New(0);
+  PyObject* r = CallBridge("nd_list_ops", args);
+  Py_DECREF(args);
+  if (!r) {
+    SetErrorFromPython();
+    return -1;
+  }
+  const char* c = PyUnicode_AsUTF8(r);
+  g_op_names = c ? c : "";
+  Py_DECREF(r);
+  *out_names = g_op_names.c_str();
+  return 0;
+}
+
+MXTPU_API int MXNDArraySave(const char* fname, mx_uint num_args,
+                            NDArrayHandle* args_in, const char** keys) {
+  GILGuard gil;
+  PyObject* arrs = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    PyObject* o = static_cast<PyObject*>(args_in[i]);
+    Py_INCREF(o);
+    PyList_SetItem(arrs, i, o);
+  }
+  PyObject* names;
+  if (keys) {
+    names = PyList_New(num_args);
+    for (mx_uint i = 0; i < num_args; ++i)
+      PyList_SetItem(names, i, PyUnicode_FromString(keys[i]));
+  } else {
+    names = Py_None;
+    Py_INCREF(Py_None);
+  }
+  PyObject* args = Py_BuildValue("(sOO)", fname, arrs, names);
+  Py_DECREF(arrs);
+  Py_DECREF(names);
+  PyObject* r = CallBridge("nd_save", args);
+  Py_DECREF(args);
+  if (!r) {
+    SetErrorFromPython();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXNDArrayLoad(const char* fname, mx_uint* out_size,
+                            NDArrayHandle** out_arr,
+                            mx_uint* out_name_size,
+                            const char*** out_names) {
+  GILGuard gil;
+  PyObject* args = Py_BuildValue("(s)", fname);
+  PyObject* r = CallBridge("nd_load", args);  // [(name|None, nd), ...]
+  Py_DECREF(args);
+  if (!r) {
+    SetErrorFromPython();
+    return -1;
+  }
+  g_loaded.clear();
+  g_loaded_name_store.clear();
+  g_loaded_names.clear();
+  bool any_names = false;
+  for (Py_ssize_t i = 0; i < PyList_Size(r); ++i) {
+    PyObject* pair = PyList_GetItem(r, i);
+    PyObject* name = PyTuple_GetItem(pair, 0);
+    PyObject* ndo = PyTuple_GetItem(pair, 1);
+    Py_INCREF(ndo);
+    g_loaded.push_back(ndo);
+    if (name != Py_None) {
+      const char* c = PyUnicode_AsUTF8(name);
+      if (!c) PyErr_Clear();  // unencodable key -> treated as unnamed
+      any_names = any_names || c;
+      g_loaded_name_store.push_back(c ? std::string(c) : std::string());
+    } else {
+      g_loaded_name_store.push_back(std::string());
+    }
+  }
+  Py_DECREF(r);
+  for (auto& s : g_loaded_name_store)
+    g_loaded_names.push_back(s.empty() ? nullptr : s.c_str());
+  *out_size = static_cast<mx_uint>(g_loaded.size());
+  *out_arr = g_loaded.data();
+  *out_name_size = any_names ? *out_size : 0;
+  *out_names = g_loaded_names.data();
+  return 0;
+}

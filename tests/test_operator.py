@@ -108,6 +108,26 @@ def test_batchnorm_inference_forward():
         rtol=1e-3, atol=1e-4)
 
 
+def test_batchnorm_training_stats_large_mean():
+    """The one-pass shifted batch statistics must not cancel when
+    |mean| >> std (E[x^2]-E[x]^2 would), and must hold for any
+    moving-mean state (fresh zeros or converged)."""
+    from mxnet_tpu.ops.registry import get_op
+    import jax.numpy as jnp
+    bn = get_op("BatchNorm").fn
+    rs = np.random.RandomState(0)
+    x = (1000.0 + 0.1 * rs.randn(8, 4, 16, 16)).astype(np.float32)
+    true_var = x.var(axis=(0, 2, 3))
+    for mm0 in (0.0, 1000.0):
+        _, mean, var, _, _ = bn(
+            jnp.array(x), jnp.ones(4), jnp.zeros(4),
+            jnp.full((4,), mm0), jnp.ones(4), eps=1e-5,
+            fix_gamma=False, training=True)
+        np.testing.assert_allclose(np.asarray(var), true_var, rtol=2e-2)
+        np.testing.assert_allclose(np.asarray(mean),
+                                   x.mean(axis=(0, 2, 3)), rtol=1e-5)
+
+
 def test_reduce_gradients():
     for f in (lambda x: sym.sum(x, axis=1),
               lambda x: sym.mean(x, axis=0),

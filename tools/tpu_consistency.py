@@ -136,9 +136,18 @@ def main():
     failures = []
     cases = _cases(mx)
     only = sys.argv[1:] or None
+    if only:
+        known = {c[0] for c in cases}
+        unknown = [n for n in only if n not in known]
+        if unknown:
+            print("unknown case name(s): %s\navailable: %s"
+                  % (unknown, sorted(known)))
+            return 2
+    n_run = 0
     for name, sym, shapes, rtol, atol, grad_req, location in cases:
         if only and name not in only:
             continue
+        n_run += 1
         try:
             # complete the shape dict (weights etc.) via inference
             arg_shapes, _, _ = sym.infer_shape(**shapes)
@@ -152,8 +161,8 @@ def main():
             failures.append(name)
             print("FAIL %s\n%s" % (name, traceback.format_exc()),
                   flush=True)
-    print("%d/%d consistent" % (len(cases) - len(failures), len(cases)))
-    return 1 if failures else 0
+    print("%d/%d consistent" % (n_run - len(failures), n_run))
+    return 1 if failures or not n_run else 0
 
 
 if __name__ == "__main__":
