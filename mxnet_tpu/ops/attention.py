@@ -162,9 +162,12 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_and_scratch,
         l_ref[...] = jnp.zeros_like(l_ref)
 
     def _compute():
-        q = q_ref[0].astype(jnp.float32)           # (blk_q, d)
-        k = k_ref[0].astype(jnp.float32)           # (blk_k, d)
-        v = v_ref[0].astype(jnp.float32)
+        # operands stay in their storage dtype: a bf16 x bf16 MXU dot
+        # with f32 accumulation (preferred_element_type) runs at the
+        # full bf16 MXU rate — pre-casting to f32 would halve it
+        q = q_ref[0]                               # (blk_q, d)
+        k = k_ref[0]                               # (blk_k, d)
+        v = v_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32
                                 ) * sm_scale
@@ -185,8 +188,11 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_and_scratch,
         p = jnp.exp(s - m_new[:, None])
         l_ref[...] = l_ref[...] * alpha + p.sum(axis=-1)
         m_ref[...] = m_new
+        # p in v's dtype for the second MXU dot (flash convention: the
+        # f32 online-softmax state carries the precision; p's entries
+        # are probabilities in [0,1] where bf16 relative error is ~2^-8)
         acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:
@@ -288,9 +294,12 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, blk_q=1024, blk_k=1024,
 
 def _bwd_p_block(q_ref, k_ref, lse_ref, iq, ik, *, sm_scale, causal,
                  blk_q, blk_k, seq_q, seq_k):
-    """Recomputed softmax block p = exp(q k^T * scale - lse)."""
-    q = q_ref[0].astype(jnp.float32)
-    k = k_ref[0].astype(jnp.float32)
+    """Recomputed softmax block p = exp(q k^T * scale - lse).
+
+    The dot keeps the storage dtype (bf16 runs at full MXU rate) and
+    accumulates f32 via preferred_element_type."""
+    q = q_ref[0]
+    k = k_ref[0]
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * sm_scale
     k_pos = ik * blk_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -320,19 +329,20 @@ def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
         p = _bwd_p_block(q_ref, k_ref, lse_ref, iq, ik,
                          sm_scale=sm_scale, causal=causal, blk_q=blk_q,
                          blk_k=blk_k, seq_q=seq_q, seq_k=seq_k)
-        do = do_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        q = q_ref[0].astype(jnp.float32)
-        # dv += p^T dO
+        do = do_ref[0]
+        v = v_ref[0]
+        q = q_ref[0]
+        # dv += p^T dO — p cast to the storage dtype for a full-rate
+        # MXU dot; accumulators stay f32
         dv_acc[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         # ds = p * (dO v^T - delta) * scale;  dk += ds^T q
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta_ref[0][:, None]) * sm_scale
         dk_acc[...] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:
@@ -362,14 +372,14 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
         p = _bwd_p_block(q_ref, k_ref, lse_ref, iq, ik,
                          sm_scale=sm_scale, causal=causal, blk_q=blk_q,
                          blk_k=blk_k, seq_q=seq_q, seq_k=seq_k)
-        do = do_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
+        do = do_ref[0]
+        v = v_ref[0]
+        k = k_ref[0]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta_ref[0][:, None]) * sm_scale
         dq_acc[...] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:
@@ -478,6 +488,11 @@ def flash_attention(q, k, v, causal=False, sm_scale=None, interpret=False):
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     if interpret or jax.default_backend() == "tpu":
+        # the kernels' MXU dots need one operand dtype (f32 q against a
+        # bf16 KV cache would raise); promote once here so the uniform
+        # bf16 fast path is untouched
+        dt = jnp.result_type(q.dtype, k.dtype, v.dtype)
+        q, k, v = q.astype(dt), k.astype(dt), v.astype(dt)
         return _flash(q, k, v, causal, float(sm_scale), interpret)
     return _chunked_attention(q, k, v, causal, sm_scale)
 

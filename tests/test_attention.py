@@ -115,6 +115,34 @@ def test_flash_fully_masked_rows_grads_match():
                                    rtol=1e-3, atol=1e-3)
 
 
+def test_flash_bf16_matches_f32_oracle():
+    """bf16 storage with f32 online-softmax state and f32 MXU
+    accumulation (preferred_element_type): fwd and grads must track the
+    f32 oracle within bf16 tolerance."""
+    q, k, v = _rand_qkv(b=1, h=2, sq=32, sk=32, d=16)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    ref = attention_reference(q, k, v, causal=True)
+    out = flash_attention(qb, kb, vb, causal=True, interpret=True)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref), rtol=5e-2, atol=5e-2)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True,
+                                       interpret=True)
+                       .astype(jnp.float32) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=True)
+                       .astype(jnp.float32) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(qb, kb, vb)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b), rtol=1e-1, atol=1e-1)
+
+
 def test_ring_attention_cross_length_causal():
     mesh = make_mesh({"sp": 8})
     q, k, v = _rand_qkv(b=1, h=2, sq=32, sk=64, d=8)

@@ -1,0 +1,57 @@
+#!/bin/bash
+# Round-4 hardware session: run every TPU-gated deliverable in one
+# wedge-safe sequence the moment the tunnel is healthy.
+#
+#   bash tools/tpu_round4.sh
+#
+# Order matters: ONE TPU process at a time (two concurrent wedge the
+# tunnel — docs/PERF_NOTES.md), health probe first, generous timeouts,
+# artifacts written even on partial completion.  Each step appends to
+# results/tpu_r4/ so a mid-session wedge still leaves evidence.
+
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+OUT="$REPO/results/tpu_r4"
+mkdir -p "$OUT"
+export PYTHONPATH="$REPO:/root/.axon_site"
+cd "$REPO"
+
+step() {
+  name="$1"; shift
+  echo "=== $name: $* (started $(date -u +%H:%M:%S))"
+  "$@" > "$OUT/$name.log" 2>&1
+  rc=$?
+  echo "=== $name: rc=$rc"
+  echo "$name rc=$rc $(date -u +%FT%TZ)" >> "$OUT/status.txt"
+  return $rc
+}
+
+# 1. health probe (abandonable child, bench.py's guard path)
+step probe python -c "
+import subprocess, sys, time
+p = subprocess.Popen([sys.executable, '-c',
+ 'import jax, jax.numpy as jnp;'
+ 'print(int(jnp.sum(jnp.ones((256,256)))))'],
+ stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+end = time.time() + 240
+while time.time() < end:
+    if p.poll() is not None:
+        sys.exit(0 if p.returncode == 0 else 1)
+    time.sleep(2)
+p.kill()
+sys.exit(1)
+" || { echo "tunnel unhealthy - aborting session"; exit 2; }
+
+# 2. cpu-vs-TPU consistency sweep (VERDICT item 3) — the committed
+#    artifact is results/tpu_r4/consistency.log itself
+step consistency timeout 3600 python tools/tpu_consistency.py
+
+# 3. flash fwd+bwd numerics + block sweep (VERDICT item 4)
+step flash timeout 3600 python tools/flash_sweep.py
+
+# 4. the round benchmark (VERDICT item 1) — also what the driver runs
+step bench timeout 5400 python bench.py
+tail -1 "$OUT/bench.log" > "$OUT/bench.json" 2>/dev/null
+
+echo "session complete; artifacts in $OUT"
+cat "$OUT/status.txt"
