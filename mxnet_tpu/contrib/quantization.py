@@ -124,39 +124,152 @@ def quantize_symbol(sym, excluded_sym_names=(), quantized_dtype="int8",
     return qsym, calib_points
 
 
+class _CalibRunner:
+    """Shared calibration-pass driver: binds the collection graph ONCE
+    (each bind creates fresh jitted closures — a per-batch or per-pass
+    bind would recompile it) and streams every layer output to a
+    consume(name, np_array) callback, honoring num_calib_examples."""
+
+    def __init__(self, calib_points, arg_params, aux_params, calib_data,
+                 data_names, num_calib_examples, label_names=()):
+        self.group = S.Group([_entry_symbol(e)
+                              for e in calib_points.values()])
+        self.names = list(calib_points)
+        self.arg_params = dict(arg_params)
+        self.aux_params = dict(aux_params or {})
+        self.calib_data = calib_data
+        self.data_names = data_names
+        self.label_names = label_names
+        self.num_calib_examples = num_calib_examples
+        self._exe = None
+
+    def run(self, consume):
+        self.calib_data.reset()
+        seen = 0
+        for batch in self.calib_data:
+            feeds = {}
+            for dn, arr in zip(self.data_names, batch.data):
+                feeds[dn] = arr
+            if batch.label:
+                for ln, arr in zip(self.label_names, batch.label):
+                    feeds[ln] = arr
+            if self._exe is None:
+                self._exe = self.group.bind(
+                    args={**self.arg_params, **feeds},
+                    aux_states=self.aux_params)
+            outs = self._exe.forward(is_train=False, **feeds)
+            for n, o in zip(self.names, outs):
+                consume(n, o.asnumpy())
+            seen += batch.data[0].shape[0]
+            if self.num_calib_examples is not None and \
+                    seen >= self.num_calib_examples:
+                break
+
+
 def _collect_naive_ranges(sym, calib_points, arg_params, aux_params,
                           calib_data, data_names, num_calib_examples,
                           label_names=()):
     """Global min/max per calibration point over the calib batches
     (reference: quantization.py _LayerOutputMinMaxCollector,
     calib_mode='naive')."""
-    group = S.Group([_entry_symbol(e) for e in calib_points.values()])
-    names = list(calib_points)
-    th = {n: (_np.inf, -_np.inf) for n in names}
-    seen = 0
-    exe = None
-    calib_data.reset()
-    for batch in calib_data:
-        feeds = {}
-        for dn, arr in zip(data_names, batch.data):
-            feeds[dn] = arr
-        if batch.label:
-            for ln, arr in zip(label_names, batch.label):
-                feeds[ln] = arr
-        if exe is None:
-            # bind ONCE: each bind creates fresh jitted closures, so a
-            # per-batch bind would recompile the collection graph every
-            # batch
-            exe = group.bind(args={**dict(arg_params), **feeds},
-                             aux_states=dict(aux_params or {}))
-        outs = exe.forward(is_train=False, **feeds)
-        for n, o in zip(names, outs):
-            v = o.asnumpy()
-            lo, hi = th[n]
-            th[n] = (min(lo, float(v.min())), max(hi, float(v.max())))
-        seen += batch.data[0].shape[0]
-        if num_calib_examples is not None and seen >= num_calib_examples:
-            break
+    runner = _CalibRunner(calib_points, arg_params, aux_params,
+                          calib_data, data_names, num_calib_examples,
+                          label_names)
+    th = {n: (_np.inf, -_np.inf) for n in runner.names}
+
+    def consume(n, v):
+        lo, hi = th[n]
+        th[n] = (min(lo, float(v.min())), max(hi, float(v.max())))
+    runner.run(consume)
+    return th
+
+
+def _kl_optimal_threshold(hist, num_quantized_bins=255):
+    """KL-divergence-optimal symmetric clip threshold from a histogram
+    of |activation| values (reference: quantization.py
+    _get_optimal_threshold, the TensorRT-style entropy calibration).
+
+    Scans candidate clip points; for each, the clipped distribution P
+    (outliers folded into the last kept bin) is compared against Q, the
+    same mass re-expressed with num_quantized_bins levels.  Returns the
+    index (exclusive) of the kept-bin count with minimal KL(P || Q).
+    """
+    nbins = len(hist)
+    hist = hist.astype(_np.float64)
+    eps = 1e-6
+    best_i, best_kl = nbins, _np.inf
+    candidates = list(range(num_quantized_bins, nbins + 1,
+                            max(1, num_quantized_bins // 16)))
+    if candidates[-1] != nbins:
+        candidates.append(nbins)  # the no-clip option must be scorable
+    for i in candidates:
+        # P: kept range with the clipped-off mass folded into the edge
+        p = hist[:i].copy()
+        p[i - 1] += hist[i:].sum()
+        if p.sum() == 0:
+            continue
+        # Q: built from the UNFOLDED histogram, re-binned to
+        # num_quantized_bins levels and spread back uniformly over each
+        # level's nonzero source bins.  The fold appears only in P —
+        # that asymmetry is what charges a clip for the mass it throws
+        # away; folding both sides would score "clip everything" as
+        # lossless.
+        ref = hist[:i]
+        q = _np.zeros(i)
+        step = i / num_quantized_bins
+        for b in range(num_quantized_bins):
+            lo = int(b * step)
+            hi = max(int((b + 1) * step), lo + 1)
+            chunk = ref[lo:hi]
+            nz = chunk > 0
+            if nz.any():
+                q[lo:hi][nz] = chunk.sum() / nz.sum()
+        pk = p / p.sum() + eps
+        qk = q / max(q.sum(), 1e-12) + eps
+        pk /= pk.sum()
+        qk /= qk.sum()
+        kl = float(_np.sum(pk * _np.log(pk / qk)))
+        if kl < best_kl:
+            best_kl, best_i = kl, i
+    return best_i
+
+
+def _collect_entropy_ranges(calib_points, arg_params, aux_params,
+                            calib_data, data_names, num_calib_examples,
+                            label_names=(), nbins=2048):
+    """Two passes over the calibration set: (1) global |x| max per
+    point, (2) histogram accumulation; then the KL-optimal clip
+    (reference: calib_mode='entropy').  The executor is bound once and
+    shared by both passes."""
+    runner = _CalibRunner(calib_points, arg_params, aux_params,
+                          calib_data, data_names, num_calib_examples,
+                          label_names)
+    names = runner.names
+    max_abs = {n: 0.0 for n in names}
+
+    def pass1(n, v):
+        a = _np.abs(v)
+        max_abs[n] = max(max_abs[n], float(a.max()) if a.size else 0.0)
+    runner.run(pass1)
+
+    hists = {n: _np.zeros(nbins, _np.int64) for n in names}
+
+    def pass2(n, v):
+        m = max_abs[n] or 1e-8
+        # clamp: a non-deterministic calib iterator (reshuffle/augment
+        # on reset) can exceed pass-1's max — fold such values into the
+        # last bin rather than silently dropping the outlier mass the
+        # entropy method exists to measure
+        a = _np.minimum(_np.abs(v).ravel(), m)
+        h, _ = _np.histogram(a, bins=nbins, range=(0.0, m))
+        hists[n] += h
+    runner.run(pass2)
+
+    th = {}
+    for n in names:
+        m = max_abs[n] or 1e-8
+        i = _kl_optimal_threshold(hists[n])
+        th[n] = (i / len(hists[n])) * m
     return th
 
 
@@ -191,30 +304,39 @@ def quantize_model(sym, arg_params, aux_params=None, data_names=("data",),
     """(reference: python/mxnet/contrib/quantization.py quantize_model)
 
     calib_mode:
-      'none'  — dynamic: activation min/max computed in-graph per batch
-      'naive' — offline: global min/max over *calib_data* baked in as
-                parameters (requires calib_data)
+      'none'    — dynamic: activation min/max computed in-graph per batch
+      'naive'   — offline: global min/max over *calib_data* baked in as
+                  parameters (requires calib_data)
+      'entropy' — offline: KL-divergence-optimal clip thresholds over
+                  *calib_data* (requires calib_data; robust to outlier
+                  activations that would stretch naive ranges)
     Returns (qsym, qarg_params, aux_params).
     """
+    calib_graph_mode = "none" if calib_mode == "none" else "naive"
     qsym, calib_points = quantize_symbol(
         sym, excluded_sym_names=excluded_sym_names,
-        quantized_dtype=quantized_dtype, calib_mode=calib_mode)
+        quantized_dtype=quantized_dtype, calib_mode=calib_graph_mode)
     qargs = _quantize_weights(qsym, arg_params)
-    if calib_mode == "naive":
+    if calib_mode in ("naive", "entropy"):
         assert calib_data is not None, \
-            "calib_mode='naive' needs calib_data"
-        th = _collect_naive_ranges(sym, calib_points, arg_params,
-                                   aux_params, calib_data, data_names,
-                                   num_calib_examples, label_names)
-        for point, (lo, hi) in th.items():
-            m = max(abs(lo), abs(hi))  # symmetric (see quantize_symbol)
-            logger.info("calibrated %s: [%g, %g] -> +-%g", point, lo,
-                        hi, m)
+            "calib_mode=%r needs calib_data" % calib_mode
+        if calib_mode == "naive":
+            ranges = _collect_naive_ranges(
+                sym, calib_points, arg_params, aux_params, calib_data,
+                data_names, num_calib_examples, label_names)
+            th = {n: max(abs(lo), abs(hi))
+                  for n, (lo, hi) in ranges.items()}
+        else:
+            th = _collect_entropy_ranges(
+                calib_points, arg_params, aux_params, calib_data,
+                data_names, num_calib_examples, label_names)
+        for point, m in th.items():
+            logger.info("calibrated %s (%s): +-%g", point, calib_mode, m)
             qargs["%s_min" % point] = nd.array(
                 _np.asarray(-m, _np.float32))
             qargs["%s_max" % point] = nd.array(
                 _np.asarray(m, _np.float32))
     elif calib_mode != "none":
-        raise ValueError("calib_mode must be 'none' or 'naive', got %r"
-                         % (calib_mode,))
+        raise ValueError("calib_mode must be 'none', 'naive' or "
+                         "'entropy', got %r" % (calib_mode,))
     return qsym, qargs, dict(aux_params or {})

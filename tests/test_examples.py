@@ -122,7 +122,7 @@ def test_train_transformer_lm_sequence_parallel():
     """Same model with ring attention over the 8-device sp mesh."""
     r = _run([sys.executable, "examples/train_transformer_lm.py",
               "--num-steps", "60", "--sequence-parallel"],
-             timeout=900,
+             timeout=1800,
              extra_env={"XLA_FLAGS":
                         "--xla_force_host_platform_device_count=8"})
     assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]

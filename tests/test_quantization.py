@@ -104,7 +104,7 @@ class _OneBatch:
         return DataBatch(data=[nd.array(self._x)])
 
 
-@pytest.mark.parametrize("mode", ["none", "naive"])
+@pytest.mark.parametrize("mode", ["none", "naive", "entropy"])
 def test_quantize_model_close_to_fp32(mode):
     rs = np.random.RandomState(4)
     x = rs.randn(4, 3, 12, 12).astype(np.float32)
@@ -114,7 +114,7 @@ def test_quantize_model_close_to_fp32(mode):
         .forward()[0].asnumpy()
     qsym, qargs, _ = quantize_model(
         sym, arg_params, {}, calib_mode=mode,
-        calib_data=_OneBatch(x) if mode == "naive" else None)
+        calib_data=_OneBatch(x) if mode != "none" else None)
     out = qsym.bind(args={**qargs, "data": nd.array(x)}) \
         .forward()[0].asnumpy()
     err = np.abs(out - ref).max() / np.abs(ref).max()
@@ -175,3 +175,19 @@ def test_quantized_lenet_accuracy_close_to_fp32():
 import os  # noqa: E402
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_entropy_calibration_clips_outliers():
+    """KL calibration must choose a much tighter threshold than the
+    naive max when the calibration data contains rare outliers
+    (reference: calib_mode='entropy')."""
+    from mxnet_tpu.contrib.quantization import _kl_optimal_threshold
+    rs = np.random.RandomState(0)
+    vals = np.abs(rs.randn(100000))
+    with_outlier = np.concatenate([vals, [100.0]])
+    hist, _ = np.histogram(with_outlier, bins=2048, range=(0.0, 100.0))
+    i = _kl_optimal_threshold(hist)
+    thr = i / 2048 * 100.0
+    assert thr < 20.0, thr          # naive would use 100.0
+    # and covers the bulk of the real distribution
+    assert thr > np.percentile(vals, 99), thr
