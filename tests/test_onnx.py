@@ -75,6 +75,32 @@ def test_export_import_model_zoo_roundtrip(model, tmp_path):
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
 
 
+def test_export_import_op_coverage_roundtrip(tmp_path):
+    """Converters beyond the zoo surface: LRN, Pad, slice_axis,
+    transpose+reshape, clip, LeakyReLU, mean, scalar arithmetic — each
+    must survive export -> import -> bind with identical outputs."""
+    rs = np.random.RandomState(0)
+    d = mx.sym.Variable("data")
+    x = mx.sym.LRN(d, nsize=3, name="lrn")
+    x = mx.sym.Pad(x, mode="constant", pad_width=(0, 0, 0, 0, 1, 1, 1, 1),
+                   constant_value=0.5, name="pad")
+    x = mx.sym.LeakyReLU(x, act_type="leaky", slope=0.1, name="lk")
+    x = mx.sym.slice_axis(x, axis=2, begin=1, end=5, name="sl")
+    x = mx.sym.transpose(x, axes=(0, 2, 3, 1), name="tr")
+    x = mx.sym.Reshape(x, shape=(2, -1), name="rs")
+    x = mx.sym.clip(x, a_min=-2.0, a_max=2.0, name="cl")
+    x = mx.sym._mul_scalar(x, scalar=1.5, name="ms")
+    x = mx.sym.mean(x, axis=1, keepdims=True, name="mn")
+    inp = rs.randn(2, 3, 6, 6).astype(np.float32)
+    path, sym2, arg2, aux2 = _roundtrip(x, {}, (2, 3, 6, 6), tmp_path)
+    ex = x.bind(mx.cpu(), {"data": nd.array(inp)})
+    want = ex.forward(is_train=False)[0].asnumpy()
+    ex2 = sym2.bind(mx.cpu(), {"data": nd.array(inp), **arg2},
+                    aux_states=aux2)
+    got = ex2.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
 def test_onnx_wire_parses_with_protoc(tmp_path):
     """Validate the hand-rolled encoding against protoc's parser using
     a schema transcribed from the public onnx.proto field numbers."""
