@@ -1,0 +1,220 @@
+// C++ convenience binding over the NDArray C ABI
+// (include/mxtpu/c_api.h) — the analogue of the reference's
+// cpp-package (cpp-package/include/mxnet-cpp/ndarray.h: NDArray RAII +
+// Operator invocation), hand-written instead of generated because the
+// C surface here is one generic MXImperativeInvoke rather than
+// per-op C entry points.
+//
+// Header-only; link against libmxtpu_nd.so.  Exceptions carry
+// MXGetLastError.
+#ifndef MXTPU_CPP_NDARRAY_HPP_
+#define MXTPU_CPP_NDARRAY_HPP_
+
+#include <cstring>
+#include <iomanip>
+#include <limits>
+#include <map>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <type_traits>
+#include <utility>
+#include <vector>
+
+#include "../c_api.h"
+
+namespace mxtpu {
+
+inline void Check(int rc) {
+  if (rc != 0) throw std::runtime_error(MXGetLastError());
+}
+
+// Owned device array.  Copyable handles are deliberately NOT provided:
+// the C handles are unique owners, so NDArray is move-only (like
+// std::unique_ptr), and Clone() makes an explicit device copy.
+class NDArray {
+ public:
+  NDArray() : handle_(nullptr) {}
+
+  explicit NDArray(const std::vector<mx_uint>& shape,
+                   int dtype = MXTPU_DTYPE_FLOAT32) {
+    Check(MXNDArrayCreate(shape.data(),
+                          static_cast<mx_uint>(shape.size()), 1, 0, 0,
+                          dtype, &handle_));
+  }
+
+  NDArray(const std::vector<mx_uint>& shape,
+          const std::vector<float>& values)
+      : NDArray(shape) {
+    CopyFrom(values.data(), values.size() * sizeof(float));
+  }
+
+  // adopt an ABI-owned handle (e.g. an MXImperativeInvoke output)
+  static NDArray Adopt(NDArrayHandle h) {
+    NDArray a;
+    a.handle_ = h;
+    return a;
+  }
+
+  NDArray(NDArray&& other) noexcept : handle_(other.handle_) {
+    other.handle_ = nullptr;
+  }
+  NDArray& operator=(NDArray&& other) noexcept {
+    if (this != &other) {
+      Release();
+      handle_ = other.handle_;
+      other.handle_ = nullptr;
+    }
+    return *this;
+  }
+  NDArray(const NDArray&) = delete;
+  NDArray& operator=(const NDArray&) = delete;
+  ~NDArray() { Release(); }
+
+  NDArrayHandle handle() const { return handle_; }
+
+  std::vector<mx_uint> Shape() const {
+    mx_uint dim = 0;
+    const mx_uint* data = nullptr;
+    Check(MXNDArrayGetShape(handle_, &dim, &data));
+    return std::vector<mx_uint>(data, data + dim);
+  }
+
+  size_t Size() const {
+    size_t n = 1;
+    for (mx_uint d : Shape()) n *= d;
+    return n;
+  }
+
+  int DType() const {
+    int dt = 0;
+    Check(MXNDArrayGetDType(handle_, &dt));
+    return dt;
+  }
+
+  void CopyFrom(const void* data, size_t nbytes) {
+    Check(MXNDArraySyncCopyFromCPU(handle_, data, nbytes));
+  }
+
+  std::vector<float> ToVector() const {
+    std::vector<float> out(Size());
+    Check(MXNDArraySyncCopyToCPU(handle_, out.data(),
+                                 out.size() * sizeof(float)));
+    return out;
+  }
+
+  NDArray Clone() const;
+
+ private:
+  void Release() {
+    if (handle_) MXNDArrayFree(handle_);
+    handle_ = nullptr;
+  }
+  NDArrayHandle handle_;
+};
+
+// One operator invocation (reference: mxnet-cpp Operator chaining API).
+//   auto outs = Op("sgd_update").Arg(w).Arg(g)
+//                  .Set("lr", 0.1f).Invoke();
+class Op {
+ public:
+  explicit Op(std::string name) : name_(std::move(name)) {}
+
+  Op& Arg(const NDArray& a) {
+    inputs_.push_back(a.handle());
+    return *this;
+  }
+
+  template <typename T>
+  Op& Set(const std::string& key, const T& value) {
+    std::ostringstream ss;
+    if (std::is_floating_point<T>::value) {
+      // round-trip precision: default 6-digit formatting would
+      // silently alter hyper-parameters (e.g. adam epsilon) in transit
+      ss << std::setprecision(std::numeric_limits<T>::max_digits10);
+    }
+    ss << value;
+    params_.emplace_back(key, ss.str());
+    return *this;
+  }
+
+  std::vector<NDArray> Invoke() {
+    std::vector<const char*> keys, vals;
+    for (auto& kv : params_) {
+      keys.push_back(kv.first.c_str());
+      vals.push_back(kv.second.c_str());
+    }
+    int num_out = 0;
+    NDArrayHandle* outs = nullptr;
+    Check(MXImperativeInvoke(
+        name_.c_str(), static_cast<int>(inputs_.size()), inputs_.data(),
+        &num_out, &outs, static_cast<int>(params_.size()),
+        keys.empty() ? nullptr : keys.data(),
+        vals.empty() ? nullptr : vals.data()));
+    std::vector<NDArray> result;
+    result.reserve(num_out);
+    for (int i = 0; i < num_out; ++i)
+      result.push_back(NDArray::Adopt(outs[i]));
+    return result;
+  }
+
+ private:
+  std::string name_;
+  std::vector<NDArrayHandle> inputs_;
+  std::vector<std::pair<std::string, std::string>> params_;
+};
+
+inline NDArray NDArray::Clone() const {
+  Op op("_copy");
+  op.Arg(*this);
+  auto outs = op.Invoke();
+  return std::move(outs[0]);
+}
+
+inline std::vector<std::string> ListOps() {
+  const char* joined = nullptr;
+  Check(MXListAllOpNames(&joined));
+  std::vector<std::string> out;
+  std::string cur;
+  for (const char* p = joined;; ++p) {
+    if (*p == '\n' || *p == '\0') {
+      if (!cur.empty()) out.push_back(cur);
+      cur.clear();
+      if (*p == '\0') break;
+    } else {
+      cur += *p;
+    }
+  }
+  return out;
+}
+
+inline void Save(const std::string& fname,
+                 const std::map<std::string, const NDArray*>& arrays) {
+  std::vector<NDArrayHandle> handles;
+  std::vector<const char*> keys;
+  for (auto& kv : arrays) {
+    keys.push_back(kv.first.c_str());
+    handles.push_back(kv.second->handle());
+  }
+  Check(MXNDArraySave(fname.c_str(),
+                      static_cast<mx_uint>(handles.size()),
+                      handles.data(), keys.data()));
+}
+
+inline std::map<std::string, NDArray> Load(const std::string& fname) {
+  mx_uint n = 0, n_names = 0;
+  NDArrayHandle* arrs = nullptr;
+  const char** names = nullptr;
+  Check(MXNDArrayLoad(fname.c_str(), &n, &arrs, &n_names, &names));
+  std::map<std::string, NDArray> out;
+  for (mx_uint i = 0; i < n; ++i) {
+    std::string key = (n_names && names[i]) ? names[i]
+                                            : std::to_string(i);
+    out.emplace(key, NDArray::Adopt(arrs[i]));
+  }
+  return out;
+}
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_CPP_NDARRAY_HPP_

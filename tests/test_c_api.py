@@ -128,3 +128,23 @@ def test_c_ndarray_api_end_to_end(tmp_path):
         env=env, capture_output=True, text=True, timeout=600)
     assert res.returncode == 0, res.stderr[-3000:]
     assert "C_API_OK" in res.stdout
+
+
+def test_cpp_binding_example_trains(tmp_path):
+    """The C++ header binding (include/mxtpu/cpp/ndarray.hpp) compiles
+    and trains a linear model end to end (examples/cpp/train_linear.cpp
+    — the reference's cpp-package example shape)."""
+    lib = _build_lib()
+    binary = os.path.join(REPO, "build", "train_linear")
+    res = subprocess.run(
+        ["g++", "-std=c++17", "-I" + os.path.join(REPO, "include"),
+         os.path.join(REPO, "examples", "cpp", "train_linear.cpp"),
+         "-L" + os.path.dirname(lib), "-lmxtpu_nd", "-o", binary],
+        capture_output=True, text=True)
+    assert res.returncode == 0, res.stderr[-2000:]
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               LD_LIBRARY_PATH=os.path.dirname(lib))
+    res = subprocess.run([binary, str(tmp_path)], env=env,
+                         capture_output=True, text=True, timeout=600)
+    assert res.returncode == 0, res.stdout[-1500:] + res.stderr[-1500:]
+    assert "CPP-TRAIN-OK" in res.stdout
