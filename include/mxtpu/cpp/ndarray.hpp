@@ -128,10 +128,13 @@ class Op {
   template <typename T>
   Op& Set(const std::string& key, const T& value) {
     std::ostringstream ss;
-    if (std::is_floating_point<T>::value) {
+    // if constexpr: the discarded branch must not instantiate
+    // numeric_limits<char[N]> for string-literal params
+    if constexpr (std::is_floating_point<std::decay_t<T>>::value) {
       // round-trip precision: default 6-digit formatting would
       // silently alter hyper-parameters (e.g. adam epsilon) in transit
-      ss << std::setprecision(std::numeric_limits<T>::max_digits10);
+      ss << std::setprecision(
+          std::numeric_limits<std::decay_t<T>>::max_digits10);
     }
     ss << value;
     params_.emplace_back(key, ss.str());
