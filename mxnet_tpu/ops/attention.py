@@ -71,13 +71,18 @@ def _online_softmax_update(o, m, l, s, vb):
     """One blockwise online-softmax accumulation step over masked scores
     *s* against value block *vb*; shared by the chunked scan here and the
     ring-attention scan (parallel/sequence.py) so the two paths cannot
-    drift numerically."""
+    drift numerically.
+
+    p is cast to vb's storage dtype for the MXU dot (full bf16 rate;
+    f32 inputs are untouched) while the o/m/l state stays f32 via
+    preferred_element_type — the same convention as the Pallas kernel."""
     m_new = jnp.maximum(m, s.max(axis=-1))
     alpha = jnp.exp(m - m_new)
     p = jnp.exp(s - m_new[..., None])
     l = l * alpha + p.sum(axis=-1)
     o = o * alpha[..., None] + jnp.einsum(
-        "bhqk,bhkd->bhqd", p, vb.astype(jnp.float32))
+        "bhqk,bhkd->bhqd", p.astype(vb.dtype), vb,
+        preferred_element_type=jnp.float32)
     return o, m_new, l
 
 
@@ -113,15 +118,16 @@ def _chunked_attention(q, k, v, causal=False, sm_scale=None, chunk=512):
         kp, vp = k, v
     kc = kp.reshape(b, h, nchunk, chunk, d).transpose(2, 0, 1, 3, 4)
     vc = vp.reshape(b, h, nchunk, chunk, d).transpose(2, 0, 1, 3, 4)
-    qf = q.astype(jnp.float32)
     q_pos = jnp.arange(sq) + (sk - sq)  # align ends for causal cross-length
 
     @jax.checkpoint
     def body(carry, xs):
         o, m, l = carry
         ci, kb, vb = xs
-        s = jnp.einsum("bhqd,bhkd->bhqk", qf,
-                       kb.astype(jnp.float32)) * sm_scale
+        # storage-dtype operands, f32 accumulation: bf16 runs at the
+        # full MXU rate (a pre-cast to f32 would halve it)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, kb,
+                       preferred_element_type=jnp.float32) * sm_scale
         k_pos = ci * chunk + jnp.arange(chunk)
         valid = k_pos < sk
         if causal:

@@ -42,7 +42,8 @@ def ring_attention_shard(q, k, v, axis_name, causal=False, sm_scale=None):
     me = jax.lax.axis_index(axis_name)
     b, h, s_loc, d = q.shape
     s_loc_k = k.shape[2]
-    qf = q.astype(jnp.float32)
+    # storage-dtype q for the score dot (bf16 at full MXU rate); the
+    # online-softmax state stays f32 via preferred_element_type
     # global positions, sequence ends aligned (same convention as
     # ops.attention when seq_q != seq_k)
     q_pos = me * s_loc + jnp.arange(s_loc) + (s_loc_k - s_loc) * n
@@ -53,8 +54,8 @@ def ring_attention_shard(q, k, v, axis_name, causal=False, sm_scale=None):
     def body(carry, t):
         o, m, l, kb, vb = carry
         src = (me + t) % n
-        s = jnp.einsum("bhqd,bhkd->bhqk", qf,
-                       kb.astype(jnp.float32)) * sm_scale
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, kb,
+                       preferred_element_type=jnp.float32) * sm_scale
         if causal:
             k_pos = src * s_loc_k + jnp.arange(s_loc_k)
             mask = k_pos[None, :] <= q_pos[:, None]

@@ -152,6 +152,20 @@ def test_ring_attention_cross_length_causal():
                                rtol=2e-5, atol=2e-5)
 
 
+def test_ring_and_chunked_bf16_track_oracle():
+    mesh = make_mesh({"sp": 8})
+    q, k, v = _rand_qkv(b=1, h=2, sq=32, sk=32, d=8)
+    ref = attention_reference(q, k, v, causal=True)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    ring = sequence_parallel_attention(qb, kb, vb, mesh, axis="sp",
+                                       causal=True)
+    np.testing.assert_allclose(np.asarray(ring, np.float32),
+                               np.asarray(ref), rtol=5e-2, atol=5e-2)
+    chk = _chunked_attention(qb, kb, vb, causal=True, chunk=8)
+    np.testing.assert_allclose(np.asarray(chk, np.float32),
+                               np.asarray(ref), rtol=5e-2, atol=5e-2)
+
+
 def test_flash_attention_grad_interpret():
     q, k, v = _rand_qkv(b=1, h=1, sq=32, sk=32, d=8)
 
