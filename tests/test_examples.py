@@ -135,3 +135,13 @@ def test_bandwidth_tool_local():
               "local", "--sizes", "1e5", "--repeat", "2"])
     assert r.returncode == 0, r.stdout[-1500:] + r.stderr[-1500:]
     assert "GB/s" in r.stdout
+
+
+def test_quantize_model_example():
+    """examples/quantize_model.py: fp32 train -> int8 quantize with all
+    three calibration modes -> accuracy holds (reference:
+    example/quantization)."""
+    r = _run([sys.executable, "examples/quantize_model.py"],
+             timeout=1800)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert "QUANTIZE-EXAMPLE-OK" in r.stdout
