@@ -763,6 +763,41 @@ class KVStoreDist(KVStoreBase):
         with self._locks[s]:
             return _rpc_call(self._socks[s], kind, meta, tensors)
 
+    def _rpc_fanout(self, calls):
+        """Round-trip one request per server CONCURRENTLY — sharded
+        keys touch every server, and N sequential TCP round trips would
+        serialize what ps-lite pipelines (kvstore_dist.h ZPush over
+        per-server channels).  calls: [(server, kind, meta, tensors)];
+        returns replies in call order.
+
+        Daemon threads rather than a ThreadPoolExecutor: the executor's
+        atexit hook joins its (non-daemon) workers unconditionally, so a
+        thread stuck in a timeout-less recv against a dead server would
+        wedge process EXIT — with daemon threads a wedged fan-out can
+        only block this call, exactly like the sequential code did."""
+        if len(calls) <= 1:
+            return [self._rpc(kind, meta, tensors, server=s)
+                    for s, kind, meta, tensors in calls]
+        results = [None] * len(calls)
+        errors = []
+
+        def work(i, s, kind, meta, tensors):
+            try:
+                results[i] = self._rpc(kind, meta, tensors, server=s)
+            except BaseException as e:  # surfaced on the caller thread
+                errors.append(e)
+
+        threads = [threading.Thread(target=work, args=(i,) + c,
+                                    daemon=True)
+                   for i, c in enumerate(calls)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+        return results
+
     def _shard_splits(self, n):
         """Contiguous per-server chunk lengths for a flat size-n array."""
         base, rem = divmod(n, self._num_servers)
@@ -823,16 +858,18 @@ class KVStoreDist(KVStoreBase):
                 total = total.todense()
             arr = total.asnumpy()
             if k in self._sharded_keys:
-                # big-array sharding: contiguous chunks across servers
-                # travel in parallel rings (reference: kvstore_dist.h:58
-                # MXNET_KVSTORE_BIGARRAY_BOUND)
+                # big-array sharding: contiguous chunks pushed to every
+                # server concurrently (reference: kvstore_dist.h:58
+                # MXNET_KVSTORE_BIGARRAY_BOUND + ps-lite channels)
                 flat = arr.ravel()
+                calls = []
                 off = 0
                 for s, ln in enumerate(self._shard_splits(arr.size)):
-                    self._rpc(_MSG_PUSH,
-                              {"key": "%s#shard%d" % (k, s)},
-                              (flat[off:off + ln],), server=s)
+                    calls.append((s, _MSG_PUSH,
+                                  {"key": "%s#shard%d" % (k, s)},
+                                  (flat[off:off + ln],)))
                     off += ln
+                self._rpc_fanout(calls)
                 continue
             meta = {"key": k}
             if self._compression and \
@@ -859,15 +896,15 @@ class KVStoreDist(KVStoreBase):
             for s in shape:
                 size *= s
             if k in self._sharded_keys:
-                # reassemble the per-server chunks (same split rule as
-                # init/push)
-                parts = []
-                for s, _ln in enumerate(self._shard_splits(size)):
-                    parts.append(self._rpc(
-                        _MSG_PULL, {"key": "%s#shard%d" % (k, s)},
-                        server=s)[1][0])
+                # pull every server's chunk concurrently, reassemble in
+                # split order (same split rule as init/push)
+                calls = [(s, _MSG_PULL,
+                          {"key": "%s#shard%d" % (k, s)}, ())
+                         for s, _ln in enumerate(
+                             self._shard_splits(size))]
+                replies = self._rpc_fanout(calls)
                 arr = nd.array(_np.concatenate(
-                    [p.ravel() for p in parts]).reshape(shape))
+                    [r[1][0].ravel() for r in replies]).reshape(shape))
             else:
                 arr = nd.array(
                     self._rpc(_MSG_PULL, {"key": k}, key=k)[1][0])
