@@ -271,3 +271,35 @@ def test_model_zoo_pretrained_local_store(tmp_path, monkeypatch):
     np.testing.assert_allclose(net2(x).asnumpy(), ref, rtol=1e-5)
     with pytest.raises(FileNotFoundError, match="no network egress"):
         vision.get_model("alexnet", pretrained=True)
+
+
+def test_contrib_multi_head_attention():
+    """gluon.contrib MultiHeadAttention: shape, hybridize parity,
+    causality, gradient flow, cross-attention (flash-backed on TPU)."""
+    from mxnet_tpu.gluon.contrib.nn import MultiHeadAttention
+    rs = np.random.RandomState(0)
+    mha = MultiHeadAttention(units=16, num_heads=4, causal=True)
+    mha.initialize()
+    x = mx.nd.array(rs.randn(2, 10, 16).astype(np.float32))
+    eager = mha(x)
+    assert eager.shape == (2, 10, 16)
+    mha.hybridize()
+    hybrid = mha(x)
+    np.testing.assert_allclose(eager.asnumpy(), hybrid.asnumpy(),
+                               rtol=1e-5, atol=1e-5)
+    # causal: perturbing future positions leaves earlier outputs alone
+    xp = x.asnumpy().copy()
+    xp[:, 7:] += 10.0
+    pert = mha(mx.nd.array(xp))
+    np.testing.assert_allclose(hybrid.asnumpy()[:, :7],
+                               pert.asnumpy()[:, :7],
+                               rtol=1e-4, atol=1e-4)
+    x.attach_grad()
+    with autograd.record():
+        out = mha(x)
+    out.backward()
+    assert np.isfinite(x.grad.asnumpy()).all()
+    kv = mx.nd.array(rs.randn(2, 6, 16).astype(np.float32))
+    cross = MultiHeadAttention(units=16, num_heads=2)
+    cross.initialize()
+    assert cross(x, kv, kv).shape == (2, 10, 16)
