@@ -99,7 +99,7 @@ def fit_parallel(args):
                           "momentum": args.mom, "wd": args.wd,
                           "eta": 0.001},
         mesh=mesh, multi_precision=args.dtype == "bfloat16",
-        shard_params=args.zero1)
+        shard_params=args.zero1, remat=args.remat or None)
     train, _ = get_iters(args, None)
     logging.info("parallel trainer: mesh=%s dtype=%s", mesh, args.dtype)
     step = 0
@@ -139,6 +139,9 @@ def main():
                         choices=["float32", "bfloat16"])
     parser.add_argument("--zero1", action="store_true",
                         help="ZeRO-1 shard params/optimizer over dp")
+    parser.add_argument("--remat", default="",
+                        choices=["", "dots", "full"],
+                        help="rematerialization policy for the step")
     args = parser.parse_args()
 
     logging.basicConfig(level=logging.INFO)
