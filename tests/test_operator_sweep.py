@@ -87,6 +87,8 @@ UNARY = [
     ("isinf", np.isinf, (-1, 1), False),
     ("isfinite", np.isfinite, (-1, 1), False),
     ("identity", lambda x: x, (-2, 2), True),
+    ("hard_sigmoid", lambda x: np.clip(0.2 * x + 0.5, 0, 1),
+     (-1.5, 1.5), False),
 ]
 
 
