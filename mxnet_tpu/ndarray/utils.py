@@ -38,8 +38,23 @@ def _flatten_for_save(data):
             if v.stype == "csr":
                 entries[name + "/indptr"] = v.indptr.asnumpy()
         else:
-            entries[name] = v.asnumpy()
+            arr = v.asnumpy()
+            if arr.dtype.name not in _NPZ_DTYPES:
+                # ml_dtypes extensions (bfloat16) come back from np.load
+                # as raw void — store the bytes as uint16 plus a dtype
+                # tag so the load path can reinterpret them
+                entries[name + "/__dtype__"] = _np.array(arr.dtype.name)
+                entries[name + "/bits"] = arr.view(_np.uint16) \
+                    if arr.ndim else arr.reshape(1).view(_np.uint16)
+                entries[name + "/shape"] = _np.array(arr.shape, _np.int64)
+            else:
+                entries[name] = arr
     return entries
+
+
+# dtypes the npz container round-trips natively
+_NPZ_DTYPES = {"float16", "float32", "float64", "int8", "int16", "int32",
+               "int64", "uint8", "uint16", "uint32", "uint64", "bool"}
 
 
 def save(fname, data):
@@ -88,6 +103,12 @@ def _load_from(z):
             sub = groups[base]
             if len(sub) == 1 and "/" not in sub[0]:
                 return array(z[base])
+            if base + "/__dtype__" in sub:
+                import ml_dtypes  # noqa: F401 (registers the names)
+                dt = _np.dtype(str(z[base + "/__dtype__"]))
+                shape = tuple(int(s) for s in z[base + "/shape"])
+                return array(z[base + "/bits"].view(dt).reshape(shape),
+                             dtype=dt.name)
             from . import sparse as _sp
             stype = str(z[base + "/__stype__"])
             shape = tuple(int(s) for s in z[base + "/shape"])

@@ -354,6 +354,89 @@ class ParallelTrainer:
         return NDArray(self._predict_fn(self._params, self._aux, xd,
                                         jax.random.PRNGKey(0)))
 
+    # -- checkpoint / resume -------------------------------------------------
+    def save_checkpoint(self, prefix, epoch=0):
+        """Write the FULL training state — params, optimizer state, aux
+        (BN stats), update counter — in the framework checkpoint
+        container (reference shape: Module.save_checkpoint +
+        Trainer.save_states, fused into one file pair here because the
+        compiled step owns all three).  Returns the params path."""
+        import numpy as _np
+        from .. import ndarray as _nd
+        blob = {}
+        for n, arr in self._params.items():
+            blob["arg:%s" % n] = _nd.NDArray(arr)
+        for n, states in self._opt_state.items():
+            for i, s in enumerate(states):
+                blob["opt%d:%s" % (i, n)] = _nd.NDArray(s)
+        for n, arr in self._aux.items():
+            blob["aux:%s" % n] = _nd.NDArray(arr)
+        blob["meta:num_update"] = _nd.array(
+            _np.asarray([self._num_update], _np.int64))
+        path = "%s-%04d.params" % (prefix, epoch)
+        _nd.save(path, blob)
+        return path
+
+    def load_checkpoint(self, prefix, epoch=0):
+        """Restore state written by :meth:`save_checkpoint`; the trainer
+        must already be built (same model/optimizer config)."""
+        from .. import ndarray as _nd
+        if self._step_fn is None:
+            raise RuntimeError("build the trainer first (run one "
+                               "fit_batch) before loading a checkpoint")
+        loaded = _nd.load("%s-%04d.params" % (prefix, epoch))
+        params, opt, aux = {}, {}, {}
+        num_update = self._num_update
+        for k, v in loaded.items():
+            kind, name = k.split(":", 1)
+            if kind == "arg":
+                params[name] = v._data
+            elif kind.startswith("opt"):
+                opt.setdefault(name, {})[int(kind[3:])] = v._data
+            elif kind == "aux":
+                aux[name] = v._data
+            elif k == "meta:num_update":
+                num_update = int(v.asnumpy()[0])
+        if set(params) != set(self._params):
+            # same architecture under different auto-generated name
+            # counters (e.g. several nets built in one process): map by
+            # construction order, which both the save and param_names
+            # preserve, and verify shapes before accepting
+            if len(params) != len(self._params) or \
+                    len(aux) != len(self._aux):
+                raise ValueError(
+                    "checkpoint has %d params / %d aux, trainer has "
+                    "%d / %d" % (len(params), len(aux),
+                                 len(self._params), len(self._aux)))
+            remap = dict(zip(params, self._params))
+            remap.update(zip(aux, self._aux))
+            for tables, current in ((params, self._params),
+                                    (aux, self._aux)):
+                for old in tables:
+                    new = remap[old]
+                    if tuple(tables[old].shape) != \
+                            tuple(current[new].shape):
+                        raise ValueError(
+                            "checkpoint entry %r %s does not match "
+                            "trainer entry %r %s"
+                            % (old, tables[old].shape, new,
+                               current[new].shape))
+            params = {remap[n]: a for n, a in params.items()}
+            opt = {remap[n]: s for n, s in opt.items()}
+            aux = {remap[n]: a for n, a in aux.items()}
+        # commit atomically only after every check passed; stateless
+        # optimizers (plain sgd) save no opt entries and restore to
+        # empty per-param tuples
+        self._params = {n: jax.device_put(a, self._shard_for(a))
+                        for n, a in params.items()}
+        self._opt_state = {
+            n: tuple(jax.device_put(slots[i], self._shard_for(slots[i]))
+                     for i in sorted(slots))
+            for n, slots in ((n, opt.get(n, {})) for n in params)}
+        repl = NamedSharding(self.mesh, P())
+        self._aux = {n: jax.device_put(a, repl) for n, a in aux.items()}
+        self._num_update = num_update
+
     # -- sync back to gluon parameters --------------------------------------
     def sync_params(self):
         """Write the trained values back into the Block's Parameters
