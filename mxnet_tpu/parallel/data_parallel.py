@@ -364,13 +364,17 @@ class ParallelTrainer:
         import numpy as _np
         from .. import ndarray as _nd
         blob = {}
-        for n, arr in self._params.items():
-            blob["arg:%s" % n] = _nd.NDArray(arr)
-        for n, states in self._opt_state.items():
-            for i, s in enumerate(states):
+        # iterate param_names (graph topological order), NOT the state
+        # dicts: jitted steps return dicts with SORTED keys, and
+        # alphabetical order is not stable across name-counter suffixes
+        # (dense10 < dense9) — the load-side positional remap depends on
+        # structural order
+        for n in self.param_names:
+            blob["arg:%s" % n] = _nd.NDArray(self._params[n])
+            for i, s in enumerate(self._opt_state[n]):
                 blob["opt%d:%s" % (i, n)] = _nd.NDArray(s)
-        for n, arr in self._aux.items():
-            blob["aux:%s" % n] = _nd.NDArray(arr)
+        for n in self.aux_names:
+            blob["aux:%s" % n] = _nd.NDArray(self._aux[n])
         blob["meta:num_update"] = _nd.array(
             _np.asarray([self._num_update], _np.int64))
         path = "%s-%04d.params" % (prefix, epoch)
@@ -408,8 +412,12 @@ class ParallelTrainer:
                     "checkpoint has %d params / %d aux, trainer has "
                     "%d / %d" % (len(params), len(aux),
                                  len(self._params), len(self._aux)))
-            remap = dict(zip(params, self._params))
-            remap.update(zip(aux, self._aux))
+            # both sides in structural order: the checkpoint was written
+            # in its trainer's param_names order (see save_checkpoint),
+            # and this trainer's param_names is the same topological
+            # order for the same architecture
+            remap = dict(zip(params, self.param_names))
+            remap.update(zip(aux, self.aux_names))
             for tables, current in ((params, self._params),
                                     (aux, self._aux)):
                 for old in tables:
