@@ -380,3 +380,37 @@ def test_native_recordio_corrupt_length_rejected(tmp_path):
     with pytest.raises(IOError, match="exceeds file size"):
         r.read()
     r.close()
+
+
+def test_rec2idx_and_parse_log_tools(tmp_path):
+    """tools/rec2idx.py rebuilds a working .idx; tools/parse_log.py
+    tabulates Speedometer/epoch log lines (reference: tools/rec2idx.py,
+    tools/parse_log.py)."""
+    import subprocess
+    import sys
+    from mxnet_tpu import recordio
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rec = recordio.MXRecordIO(str(tmp_path / "d.rec"), "w")
+    for i in range(5):
+        rec.write(b"payload-%d" % i)
+    rec.close()
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "rec2idx.py"),
+         str(tmp_path / "d.rec")], capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    reader = recordio.MXIndexedRecordIO(str(tmp_path / "d.idx"),
+                                        str(tmp_path / "d.rec"), "r")
+    assert reader.read_idx(3) == b"payload-3"
+
+    log = tmp_path / "t.log"
+    log.write_text(
+        "INFO:root:Epoch[0] Batch [20]\tSpeed: 100.0 samples/sec\n"
+        "INFO:root:Epoch[0] Batch [40]\tSpeed: 140.0 samples/sec\n"
+        "INFO:root:Epoch[0] Train-accuracy=0.61\n"
+        "INFO:root:Epoch[0] Time cost=9.5\n"
+        "INFO:root:Epoch[0] Validation-accuracy=0.55\n")
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "parse_log.py"),
+         str(log), "--format", "csv"], capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    assert "0,120.0,9.5,0.61,0.55" in r.stdout

@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Summarize training logs into a table (reference: tools/parse_log.py
+— epoch/accuracy/speed extraction into markdown or csv).
+
+Reads the logging output our fit loops produce (Speedometer lines like
+``Epoch[3] Batch [40]  Speed: 123.4 samples/sec  accuracy=0.91`` and
+epoch summaries like ``Epoch[3] Validation-accuracy=0.87`` /
+``Epoch[3] Time cost=12.3``) and prints one row per epoch.
+
+    python tools/parse_log.py train.log [--format markdown|csv]
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+
+
+_SPEED = re.compile(r"Epoch\[(\d+)\].*Speed:\s*([\d.]+)")
+_TRAIN = re.compile(r"Epoch\[(\d+)\].*?Train-([\w-]+)=([\d.naninf]+)")
+_VAL = re.compile(r"Epoch\[(\d+)\].*?Validation-([\w-]+)=([\d.naninf]+)")
+_TIME = re.compile(r"Epoch\[(\d+)\].*?Time cost=([\d.]+)")
+
+
+def parse(lines):
+    """-> {epoch: {"speed": [..], "train-x": v, "val-x": v, "time": v}}"""
+    table = {}
+
+    def row(epoch):
+        return table.setdefault(int(epoch), {"speed": []})
+
+    for line in lines:
+        m = _SPEED.search(line)
+        if m:
+            row(m.group(1))["speed"].append(float(m.group(2)))
+        for pat, prefix in ((_TRAIN, "train-"), (_VAL, "val-")):
+            m = pat.search(line)
+            if m:
+                row(m.group(1))[prefix + m.group(2)] = float(m.group(3))
+        m = _TIME.search(line)
+        if m:
+            row(m.group(1))["time"] = float(m.group(2))
+    return table
+
+
+def render(table, fmt="markdown"):
+    metrics = sorted({k for r in table.values() for k in r
+                      if k not in ("speed",)})
+    header = ["epoch", "speed(avg)"] + metrics
+    rows = []
+    for epoch in sorted(table):
+        r = table[epoch]
+        speed = (sum(r["speed"]) / len(r["speed"])) if r["speed"] else ""
+        vals = [str(epoch),
+                "%.1f" % speed if speed != "" else ""]
+        vals += ["%g" % r[m] if m in r else "" for m in metrics]
+        rows.append(vals)
+    if fmt == "csv":
+        return "\n".join(",".join(r) for r in [header] + rows)
+    out = ["| " + " | ".join(header) + " |",
+           "|" + "---|" * len(header)]
+    out += ["| " + " | ".join(r) + " |" for r in rows]
+    return "\n".join(out)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("logfile")
+    ap.add_argument("--format", default="markdown",
+                    choices=("markdown", "csv"))
+    args = ap.parse_args(argv)
+    with open(args.logfile) as f:
+        print(render(parse(f), args.format))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

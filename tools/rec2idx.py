@@ -1,0 +1,43 @@
+#!/usr/bin/env python
+"""Rebuild the .idx companion for a RecordIO file (reference:
+tools/rec2idx.py — lost-index recovery so shuffled/indexed readers can
+reopen an existing .rec).
+
+    python tools/rec2idx.py data.rec data.idx
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("record_file")
+    ap.add_argument("index_file", nargs="?")
+    args = ap.parse_args(argv)
+    idx_path = args.index_file or \
+        os.path.splitext(args.record_file)[0] + ".idx"
+
+    from mxnet_tpu.recordio import MXRecordIO
+    rec = MXRecordIO(args.record_file, "r")
+    n = 0
+    with open(idx_path, "w") as out:
+        while True:
+            pos = rec.tell()
+            if rec.read() is None:
+                break
+            out.write("%d\t%d\n" % (n, pos))
+            n += 1
+    rec.close()
+    print("wrote %d entries to %s" % (n, idx_path))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
