@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Flakiness checker: run one test many times with varied seeds
+(reference: tools/flakiness_checker.py — the triage tool for
+intermittently failing tests).
+
+    python tools/flakiness_checker.py tests/test_operator.py::test_foo
+    python tools/flakiness_checker.py test_operator.test_foo -n 100
+
+Accepts either pytest node-id syntax (path::name) or the reference's
+module.test syntax, runs the test N times with MXNET_TEST_SEED varied
+per trial, and reports the failure count (exit 1 if any trial failed).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+
+def to_nodeid(spec):
+    if "::" in spec or os.path.exists(spec.split("::")[0]):
+        return spec
+    # reference syntax: test_module.test_name
+    mod, _, name = spec.rpartition(".")
+    path = os.path.join("tests", mod + ".py")
+    return "%s::%s" % (path, name) if name else path
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("test", help="pytest node id or module.test_name")
+    ap.add_argument("-n", "--num-trials", type=int, default=20)
+    ap.add_argument("--seed", type=int, default=None,
+                    help="fixed seed for every trial (default: trial #)")
+    ap.add_argument("-q", "--quiet", action="store_true")
+    args = ap.parse_args(argv)
+
+    nodeid = to_nodeid(args.test)
+    failures = 0
+    for trial in range(args.num_trials):
+        env = dict(os.environ)
+        env["MXNET_TEST_SEED"] = str(args.seed if args.seed is not None
+                                     else trial)
+        res = subprocess.run(
+            [sys.executable, "-m", "pytest", nodeid, "-q", "-x"],
+            capture_output=True, text=True, env=env)
+        ok = res.returncode == 0
+        failures += 0 if ok else 1
+        if not args.quiet or not ok:
+            print("trial %3d seed=%s : %s"
+                  % (trial, env["MXNET_TEST_SEED"],
+                     "ok" if ok else "FAILED"), flush=True)
+        if not ok and not args.quiet:
+            print(res.stdout[-1500:])
+    print("%d/%d trials failed" % (failures, args.num_trials))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
