@@ -31,6 +31,9 @@ from . import ndarray  # noqa: F401
 from . import ndarray as nd  # noqa: F401
 from . import symbol  # noqa: F401
 from .symbol import AttrScope  # noqa: F401
+from . import attribute  # noqa: F401
+from . import name  # noqa: F401
+from . import log  # noqa: F401
 from . import symbol as sym  # noqa: F401
 from .executor import Executor  # noqa: F401
 from . import random  # noqa: F401

@@ -1,0 +1,11 @@
+"""Scoped symbol attributes — public module surface (reference:
+python/mxnet/attribute.py).  The implementation lives with the symbol
+graph (``symbol/symbol.py``); ``with mx.attribute.AttrScope(
+ctx_group='dev1'):`` tags every symbol created in scope, which is how
+manual model-parallel groups are declared for ``group2ctx``."""
+
+from __future__ import annotations
+
+from .symbol.symbol import AttrScope
+
+__all__ = ["AttrScope"]

@@ -1,0 +1,39 @@
+"""Logging helpers (reference: python/mxnet/log.py — get_logger with
+the reference's level names and a head-formatted handler)."""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+__all__ = ["get_logger", "getLogger", "DEBUG", "INFO", "WARNING",
+           "ERROR", "NOTSET"]
+
+DEBUG = logging.DEBUG
+INFO = logging.INFO
+WARNING = logging.WARNING
+ERROR = logging.ERROR
+NOTSET = logging.NOTSET
+
+_HEAD_FMT = "%(asctime)-15s %(name)s %(levelname)s %(message)s"
+
+
+def get_logger(name=None, filename=None, filemode=None, level=WARNING):
+    """Configured logger (reference: log.py getLogger): optional file
+    sink, timestamped head format, idempotent handler setup."""
+    logger = logging.getLogger(name)
+    if getattr(logger, "_mxtpu_configured", False):
+        logger.setLevel(level)
+        return logger
+    if filename:
+        handler = logging.FileHandler(filename, filemode or "a")
+    else:
+        handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(logging.Formatter(_HEAD_FMT))
+    logger.addHandler(handler)
+    logger.setLevel(level)
+    logger._mxtpu_configured = True
+    return logger
+
+
+getLogger = get_logger  # reference spelling

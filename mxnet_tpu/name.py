@@ -1,0 +1,53 @@
+"""Symbol name management (reference: python/mxnet/name.py —
+NameManager auto-naming + the Prefix scope).
+
+The machinery itself lives in ``symbol/symbol.py`` (``_NameManager``,
+which auto-numbers anonymous symbols); this module is the public API
+surface: ``with mx.name.Prefix('layer1_'):`` prepends a prefix to every
+auto-generated name created in scope.
+"""
+
+from __future__ import annotations
+
+from .symbol.symbol import _NameManager
+
+__all__ = ["NameManager", "Prefix", "current"]
+
+
+class NameManager:
+    """Context manager installing a fresh name counter scope."""
+
+    def __enter__(self):
+        self._saved = getattr(_NameManager._tls, "inst", None)
+        _NameManager._tls.inst = _NameManager()
+        return _NameManager._tls.inst
+
+    def __exit__(self, *exc):
+        if self._saved is None:
+            del _NameManager._tls.inst
+        else:
+            _NameManager._tls.inst = self._saved
+        return False
+
+
+class Prefix(NameManager):
+    """Prefix every auto-generated symbol name in scope
+    (reference: name.py Prefix)."""
+
+    def __init__(self, prefix):
+        self._prefix = prefix
+
+    def __enter__(self):
+        mgr = super().__enter__()
+        prefix = self._prefix
+        base_fresh = mgr.fresh
+
+        def fresh(hint):
+            return prefix + base_fresh(hint)
+        mgr.fresh = fresh
+        return mgr
+
+
+def current():
+    """The active name manager (reference: NameManager.current)."""
+    return _NameManager.get()

@@ -185,3 +185,18 @@ def test_variable_shape_attr():
     args, outs, _ = c.infer_shape()
     assert args == [(3, 4), (3, 4)]
     assert outs == [(3, 4)]
+
+
+def test_name_prefix_and_manager_scopes():
+    """mx.name.Prefix / NameManager (reference: python/mxnet/name.py)."""
+    import mxnet_tpu as mx
+    with mx.name.Prefix("blockA_"):
+        s1 = mx.sym.FullyConnected(mx.sym.Variable("d"), num_hidden=3)
+    assert s1.name.startswith("blockA_")
+    s2 = mx.sym.FullyConnected(mx.sym.Variable("d"), num_hidden=3)
+    assert not s2.name.startswith("blockA_")
+    with mx.name.NameManager():
+        s3 = mx.sym.FullyConnected(mx.sym.Variable("d"), num_hidden=3)
+    assert s3.name == "fullyconnected0"
+    # public attribute module aliases the symbol AttrScope
+    assert mx.attribute.AttrScope is mx.AttrScope
