@@ -1,0 +1,54 @@
+"""Library discovery + version info (reference: python/mxnet/libinfo.py
+— find_lib_path locating libmxnet.so for the ctypes layer).
+
+Here the compute path needs no native library, but the optional C ABI
+shims (predict + NDArray) do exist; ``find_lib_path`` locates them for
+FFI consumers and tooling.
+"""
+
+from __future__ import annotations
+
+import os
+
+from . import __version__  # noqa: F401  (reference re-exports it here)
+
+__all__ = ["find_lib_path", "find_include_path", "__version__"]
+
+_LIBS = ("libmxtpu_nd.so", "libmxtpu_predict.so")
+
+
+def _candidates():
+    here = os.path.dirname(os.path.abspath(__file__))
+    repo = os.path.dirname(here)
+    env = os.environ.get("MXNET_LIBRARY_PATH")
+    roots = ([env] if env else []) + [
+        os.path.join(repo, "build"),
+        os.path.join(here, "build"),
+    ]
+    return roots
+
+
+def find_lib_path(optional=False):
+    """Paths of the built C ABI libraries (reference:
+    libinfo.py:find_lib_path; raises unless *optional* when none are
+    built)."""
+    found = []
+    for root in _candidates():
+        for lib in _LIBS:
+            p = os.path.join(root, lib)
+            if os.path.exists(p) and p not in found:
+                found.append(p)
+    if not found and not optional:
+        raise RuntimeError(
+            "native C ABI libraries not built — run `make -C src/capi` "
+            "(searched: %s)" % (_candidates(),))
+    return found
+
+
+def find_include_path():
+    """Path of the C ABI headers (reference: find_include_path)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    inc = os.path.join(repo, "include")
+    if not os.path.isdir(os.path.join(inc, "mxtpu")):
+        raise RuntimeError("include/mxtpu headers not found at %r" % inc)
+    return inc
