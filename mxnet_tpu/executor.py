@@ -97,7 +97,11 @@ class Executor:
                             if self._grad_req[n] != "null" and
                             grad_dict.get(n) is not None]
         self.outputs = []
-        self._key = jax.random.PRNGKey(0)
+        # the PRNG key must live on this executor's device: under a
+        # two-platform session (cpu-vs-tpu consistency runs) a
+        # default-device key mixed with ctx-placed args is a jit error
+        self._key = jax.device_put(jax.random.PRNGKey(0),
+                                   self._ctx.jax_device)
         self._fwd_jit = {}
         self._fused_jit = None
         self._monitor = None
@@ -462,9 +466,11 @@ def _materialize(cots, ex, arg_map, aux_map):
     # cheap shape inference: run eval_shape on the infer function
     try:
         shapes = jax.eval_shape(ex._eval_infer, arg_map, aux_map,
-                                jax.random.PRNGKey(0))[0]
+                                ex._key)[0]
     except Exception:
-        outs, _ = ex._jit_infer(arg_map, aux_map, jax.random.PRNGKey(0))
+        outs, _ = ex._jit_infer(arg_map, aux_map, ex._key)
         shapes = outs
-    return [c if c is not None else jnp.ones(s.shape, s.dtype)
+    dev = ex._ctx.jax_device
+    return [c if c is not None
+            else jax.device_put(jnp.ones(s.shape, s.dtype), dev)
             for c, s in zip(cots, shapes)]

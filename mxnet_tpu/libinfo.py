@@ -33,6 +33,11 @@ def find_lib_path(optional=False):
     libinfo.py:find_lib_path; raises unless *optional* when none are
     built)."""
     found = []
+    # upstream convention: MXNET_LIBRARY_PATH may name the library FILE
+    # itself, not just a directory to search
+    env = os.environ.get("MXNET_LIBRARY_PATH")
+    if env and os.path.isfile(env):
+        found.append(env)
     for root in _candidates():
         for lib in _LIBS:
             p = os.path.join(root, lib)

@@ -218,13 +218,16 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_and_scratch,
         # convention as attention_reference/_chunked_attention
         m = m_ref[...]
         l = l_ref[...]
-        degenerate = m <= _NEG_INF * 0.5
-        l_safe = jnp.where(degenerate, 1.0, l)
-        o_ref[0] = jnp.where(degenerate[:, None], 0.0,
-                             acc_ref[...] / l_safe[:, None]
-                             ).astype(o_ref.dtype)
+        # Mosaic cannot widen an i1 vector to 2D; reshape the f32 state
+        # first and build the mask at its final rank instead
+        deg2 = m[:, None] <= _NEG_INF * 0.5
+        l_safe2 = jnp.where(deg2, 1.0, l[:, None])
+        o_ref[0] = jnp.where(deg2, 0.0,
+                             acc_ref[...] / l_safe2).astype(o_ref.dtype)
         if lse_ref is not None:
             # logsumexp residual for the flash backward
+            degenerate = m <= _NEG_INF * 0.5
+            l_safe = jnp.where(degenerate, 1.0, l)
             lse_ref[0] = jnp.where(degenerate, -_NEG_INF,
                                    m + jnp.log(l_safe))
 
@@ -485,22 +488,38 @@ def _flash_vjp_bwd(causal, sm_scale, interpret, res, g):
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
-def flash_attention(q, k, v, causal=False, sm_scale=None, interpret=False):
+def flash_attention(q, k, v, causal=False, sm_scale=None, interpret=False,
+                    chunk=512):
     """Blockwise (flash) attention, (B, H, S, D) layout.
 
-    Pallas MXU kernel on TPU; chunked-scan XLA path elsewhere.  Both have
-    O(S * block) activation memory; grads flow through either.
+    Pallas MXU kernel on TPU; chunked-scan XLA path elsewhere (*chunk*
+    is its block length).  Both have O(S * block) activation memory;
+    grads flow through either.
     """
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
-    if interpret or jax.default_backend() == "tpu":
+    if interpret:
+        dt = jnp.result_type(q.dtype, k.dtype, v.dtype)
+        return _flash(q.astype(dt), k.astype(dt), v.astype(dt),
+                      causal, float(sm_scale), True).astype(q.dtype)
+
+    def _tpu(q, k, v):
         # the kernels' MXU dots need one operand dtype (f32 q against a
         # bf16 KV cache would raise); promote once here so the uniform
-        # bf16 fast path is untouched
+        # bf16 fast path is untouched.  NOTE platform_dependent traces
+        # BOTH branches on every platform (lax.cond), so the promotion
+        # must stay inside the branch
         dt = jnp.result_type(q.dtype, k.dtype, v.dtype)
-        q, k, v = q.astype(dt), k.astype(dt), v.astype(dt)
-        return _flash(q, k, v, causal, float(sm_scale), interpret)
-    return _chunked_attention(q, k, v, causal, sm_scale)
+        return _flash(q.astype(dt), k.astype(dt), v.astype(dt),
+                      causal, float(sm_scale), False).astype(q.dtype)
+
+    def _other(q, k, v):
+        return _chunked_attention(q, k, v, causal, sm_scale,
+                                  int(chunk)).astype(q.dtype)
+
+    # decided at LOWERING time per platform (not by the process-default
+    # backend, which is wrong in a mixed cpu+tpu session)
+    return jax.lax.platform_dependent(q, k, v, tpu=_tpu, default=_other)
 
 
 # ---------------------------------------------------------------------------
@@ -513,9 +532,5 @@ def _dot_product_attention(query, key, value, causal=False, sm_scale=None,
                            chunk=512):
     """Fused scaled-dot-product attention (TPU-native; no reference
     counterpart — the reference predates Transformers, SURVEY §5.7)."""
-    if sm_scale is None:
-        sm_scale = 1.0 / math.sqrt(query.shape[-1])
-    if jax.default_backend() == "tpu":
-        return _flash(query, key, value, bool(causal), float(sm_scale), False)
-    return _chunked_attention(query, key, value, bool(causal),
-                              float(sm_scale), int(chunk))
+    return flash_attention(query, key, value, causal=bool(causal),
+                           sm_scale=sm_scale, chunk=chunk)

@@ -261,8 +261,9 @@ def check_consistency(sym, location=None, shapes=None, aux_states=None,
     results = []
     for backend in (backends if len(backends) > 1
                     else backends * 2):
+        ctx = _ctx_for(backend)
         exe = _bind(sym, location, aux_states, grad_req=grad_req,
-                    ctx=_ctx_for(backend))
+                    ctx=ctx)
         outs = exe.forward(is_train=True)
         if grad_req == "null":
             # forward-only op (integer/index outputs have no gradient)
@@ -271,7 +272,9 @@ def check_consistency(sym, location=None, shapes=None, aux_states=None,
             continue
         proj = [rs.normal(0, 1, o.shape).astype(np.float32)
                 for o in outs] if not results else results[0][2]
-        exe.backward(out_grads=[nd.array(p) for p in proj])
+        # cotangents must live on THIS executor's backend, not the
+        # session-default device (mixed cpu+tpu sessions)
+        exe.backward(out_grads=[nd.array(p, ctx=ctx) for p in proj])
         grads = {n: exe.grad_dict[n].asnumpy()
                  for n in exe.grad_dict}
         results.append(([o.asnumpy() for o in outs], grads, proj,
