@@ -76,24 +76,28 @@ def _probe_tpu_once(deadline_s):
     return False
 
 
-_target = os.environ.get("JAX_PLATFORMS", "")
-if _target.strip().lower() == "cpu":
-    if not os.environ.get("BENCH_ALLOW_CPU"):
-        print("bench: JAX_PLATFORMS=cpu without BENCH_ALLOW_CPU=1 — "
-              "refusing to produce a CPU number as the bench artifact",
-              file=sys.stderr)
-        sys.exit(3)
-    import jax
-    jax.config.update("jax_platforms", "cpu")
-else:
+def _ensure_platform():
+    """Select/validate the platform; exits the process on an unusable
+    target.  Called from main() so that ``import bench`` (tools reuse
+    `_probe_tpu_once` / `_probe_peak_flops`) has NO side effects."""
+    target = os.environ.get("JAX_PLATFORMS", "")
+    if target.strip().lower() == "cpu":
+        if not os.environ.get("BENCH_ALLOW_CPU"):
+            print("bench: JAX_PLATFORMS=cpu without BENCH_ALLOW_CPU=1 — "
+                  "refusing to produce a CPU number as the bench artifact",
+                  file=sys.stderr)
+            sys.exit(3)
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        return
     healthy = False
     # ~10.5 min total budget: 150 s first attempt (covers slow first
     # compile of the probe), then shorter retries with growing pauses
     # to ride out a tunnel restart.
-    _attempts = [(150, 30), (90, 60), (90, 120), (90, 0)]
-    for attempt, (probe_s, pause_s) in enumerate(_attempts):
+    attempts = [(150, 30), (90, 60), (90, 120), (90, 0)]
+    for attempt, (probe_s, pause_s) in enumerate(attempts):
         healthy = _probe_tpu_once(probe_s)
-        if healthy or attempt == len(_attempts) - 1:
+        if healthy or attempt == len(attempts) - 1:
             break
         print("bench: TPU health probe attempt %d failed; retrying in "
               "%d s" % (attempt + 1, pause_s), file=sys.stderr)
@@ -104,8 +108,8 @@ else:
               file=sys.stderr)
         sys.exit(2)
     import jax
-    if _target:
-        jax.config.update("jax_platforms", _target)
+    if target:
+        jax.config.update("jax_platforms", target)
 
 BASELINE_IMG_S = 363.69  # V100 bs=128 training, docs/faq/perf.md:219
 
@@ -163,8 +167,21 @@ def _probe_peak_flops(iters=40, n=8192):
     return 2.0 * n ** 3 / per
 
 
-def main():
+def timed_resnet_train(batch, image, remat, iters, scan_n, warmup=2,
+                       optimizer="lbsgd", multi_precision=True):
+    """Build the north-star ResNet-50 trainer and time its step.
+
+    This is THE measurement harness (tools/mfu_sweep.py reuses it):
+    steps are scanned inside ONE dispatch per host call — the idiomatic
+    TPU training-loop shape, which also keeps per-call tunnel latency
+    out of the device number — and the timed window is forced complete
+    by a host readback of the final loss (donation chains the steps;
+    `block_until_ready` does NOT wait over the tunnel).
+
+    Returns a dict with img_s / dt / iters / flops_per_step /
+    final_loss."""
     import jax
+    import jax.numpy as jnp
     import mxnet_tpu as mx
     from mxnet_tpu import gluon
     from mxnet_tpu.gluon.model_zoo import vision
@@ -172,25 +189,17 @@ def main():
     from mxnet_tpu.parallel.data_parallel import ParallelTrainer
 
     dev = jax.devices()[0]
-    on_tpu = dev.platform == "tpu"
-    batch = 128 if on_tpu else 8
-    image = 224 if on_tpu else 32
-    warmup, iters = (4, 20) if on_tpu else (2, 10)
-
     net = vision.get_model("resnet50_v1", classes=1000)
     net.initialize()
     loss = gluon.loss.SoftmaxCrossEntropyLoss()
-    mesh = make_mesh({"dp": 1}, [dev])
     # north-star config: bf16 compute weights + f32 masters + LARS
-    # (docs/faq/perf.md fp16 ≈ 2x fp32 sanity ratio applies to bf16 here)
+    # (docs/faq/perf.md fp16 ≈ 2x fp32 sanity ratio applies to bf16)
     trainer = ParallelTrainer(
-        net, loss, optimizer="lbsgd" if on_tpu else "sgd",
+        net, loss, optimizer=optimizer,
         optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
                           "eta": 0.001},
-        mesh=mesh, multi_precision=on_tpu,
-        # BENCH_REMAT=dots|full selects a jax.checkpoint policy for the
-        # step (HBM-pressure experiments on hardware)
-        remat=os.environ.get("BENCH_REMAT") or None)
+        mesh=make_mesh({"dp": 1}, [dev]),
+        multi_precision=multi_precision, remat=remat)
 
     rng = np.random.RandomState(0)
     x = mx.nd.array(rng.randn(batch, 3, image, image).astype(np.float32))
@@ -198,14 +207,9 @@ def main():
 
     for _ in range(warmup):
         l = trainer.fit_batch(x, y)
-    float(np.asarray(l))  # forced readback — see module docstring
+    float(np.asarray(l))  # forced readback
 
-    # timed window: steps scanned inside ONE dispatch per host call —
-    # the idiomatic TPU training loop shape (lax.scan of train steps),
-    # which also keeps per-call tunnel latency out of the device number
-    import jax.numpy as jnp
     step = trainer._step_fn
-    scan_n = 5 if on_tpu else 2  # scan length multiplies CPU compile time
 
     def multi(params, opt_state, aux, xb, yb, key, lr, t):
         def body(carry, i):
@@ -228,16 +232,14 @@ def main():
     float(np.asarray(l))  # warm the scanned executable
 
     t0 = time.perf_counter()
-    for it in range(iters // scan_n):
+    for it in range(max(1, iters // scan_n)):
         p, s, a, l = multi_j(p, s, a, xd, yd,
                              jax.random.PRNGKey(it + 1),
                              np.float32(0.1), np.int32(1))
     final_loss = float(np.asarray(l))  # donation chains all timed steps
     dt = time.perf_counter() - t0
-    iters = (iters // scan_n) * scan_n
+    iters = max(1, iters // scan_n) * scan_n
     trainer._params, trainer._opt_state, trainer._aux = p, s, a
-
-    img_s = batch * iters / dt
 
     # exact per-step FLOPs from the compiled program when available
     flops = None
@@ -255,6 +257,31 @@ def main():
         pass
     if not flops:
         flops = 3 * 4.089e9 * batch  # analytic fwd+bwd ResNet-50/224
+    return {"img_s": batch * iters / dt, "dt": dt, "iters": iters,
+            "flops_per_step": flops, "final_loss": final_loss}
+
+
+def main():
+    _ensure_platform()
+    import jax
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    batch = 128 if on_tpu else 8
+    image = 224 if on_tpu else 32
+    warmup, iters = (4, 20) if on_tpu else (2, 10)
+    scan_n = 5 if on_tpu else 2  # scan length multiplies CPU compile time
+
+    r = timed_resnet_train(
+        batch, image,
+        # BENCH_REMAT=dots|full selects a jax.checkpoint policy for the
+        # step (HBM-pressure experiments on hardware)
+        remat=os.environ.get("BENCH_REMAT") or None,
+        iters=iters, scan_n=scan_n, warmup=warmup,
+        optimizer="lbsgd" if on_tpu else "sgd",
+        multi_precision=on_tpu)
+    img_s, dt, iters = r["img_s"], r["dt"], r["iters"]
+    flops, final_loss = r["flops_per_step"], r["final_loss"]
 
     peak_probe = _probe_peak_flops() if on_tpu else None
     sustained = flops * iters / dt

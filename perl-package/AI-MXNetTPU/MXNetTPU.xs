@@ -1,0 +1,132 @@
+/* XS glue: AI::MXNetTPU <-> libmxtpu_predict.so
+ *
+ * Wraps the C predict ABI (include/mxtpu/c_predict_api.h), the same
+ * surface the reference exposes to its non-Python bindings
+ * (reference: include/mxnet/c_predict_api.h:78-207; the perl-package
+ * there wraps the full C API — here the predict scope matches our
+ * README "C ABI stance").  Raw float payloads cross as packed scalars
+ * (pack "f*"); lib/AI/MXNetTPU.pm turns them into Perl lists.
+ */
+#define PERL_NO_GET_CONTEXT
+#include "EXTERN.h"
+#include "perl.h"
+#include "XSUB.h"
+
+#include <mxtpu/c_predict_api.h>
+#include <stdlib.h>
+
+static void die_on(pTHX_ int rc, const char* what) {
+  if (rc != 0) croak("%s: %s", what, MXGetLastError());
+}
+
+MODULE = AI::MXNetTPU  PACKAGE = AI::MXNetTPU
+
+PROTOTYPES: DISABLE
+
+UV
+_create(sym_json, params, dev_type, dev_id, keys_ref, shapes_ref)
+    SV* sym_json
+    SV* params
+    int dev_type
+    int dev_id
+    SV* keys_ref
+    SV* shapes_ref
+  CODE:
+    {
+      STRLEN sym_len, param_len;
+      const char* sym = SvPV(sym_json, sym_len);
+      const char* par = SvPV(params, param_len);
+      AV* keys = (AV*)SvRV(keys_ref);
+      AV* shapes = (AV*)SvRV(shapes_ref);
+      mx_uint n = (mx_uint)(av_len(keys) + 1);
+      if ((mx_uint)(av_len(shapes) + 1) != n)
+        croak("keys and shapes must have the same length");
+      const char** ckeys = (const char**)malloc(n * sizeof(char*));
+      mx_uint* indptr = (mx_uint*)malloc((n + 1) * sizeof(mx_uint));
+      mx_uint total = 0, i, j;
+      for (i = 0; i < n; i++) {
+        AV* shp = (AV*)SvRV(*av_fetch(shapes, i, 0));
+        total += (mx_uint)(av_len(shp) + 1);
+      }
+      mx_uint* sdata = (mx_uint*)malloc(total * sizeof(mx_uint));
+      mx_uint off = 0;
+      for (i = 0; i < n; i++) {
+        ckeys[i] = SvPV_nolen(*av_fetch(keys, i, 0));
+        indptr[i] = off;
+        AV* shp = (AV*)SvRV(*av_fetch(shapes, i, 0));
+        for (j = 0; j <= (mx_uint)av_len(shp); j++)
+          sdata[off++] = (mx_uint)SvUV(*av_fetch(shp, j, 0));
+      }
+      indptr[n] = off;
+      PredictorHandle h = NULL;
+      int rc = MXPredCreate(sym, par, (int)param_len, dev_type, dev_id,
+                            n, ckeys, indptr, sdata, &h);
+      free(ckeys); free(indptr); free(sdata);
+      die_on(aTHX_ rc, "MXPredCreate");
+      RETVAL = PTR2UV(h);
+    }
+  OUTPUT:
+    RETVAL
+
+void
+_set_input(handle, key, packed)
+    UV handle
+    const char* key
+    SV* packed
+  CODE:
+    {
+      STRLEN len;
+      const char* buf = SvPV(packed, len);
+      die_on(aTHX_ MXPredSetInput(INT2PTR(PredictorHandle, handle), key,
+                                  (const mx_float*)buf,
+                                  (mx_uint)(len / sizeof(mx_float))),
+             "MXPredSetInput");
+    }
+
+void
+_forward(handle)
+    UV handle
+  CODE:
+    die_on(aTHX_ MXPredForward(INT2PTR(PredictorHandle, handle)),
+           "MXPredForward");
+
+void
+_output_shape(handle, index)
+    UV handle
+    UV index
+  PPCODE:
+    {
+      mx_uint* shape = NULL;
+      mx_uint ndim = 0, i;
+      die_on(aTHX_ MXPredGetOutputShape(INT2PTR(PredictorHandle, handle),
+                                        (mx_uint)index, &shape, &ndim),
+             "MXPredGetOutputShape");
+      EXTEND(SP, ndim);
+      for (i = 0; i < ndim; i++) mPUSHu(shape[i]);
+    }
+
+SV*
+_get_output(handle, index, size)
+    UV handle
+    UV index
+    UV size
+  CODE:
+    {
+      SV* out = newSV(size * sizeof(mx_float));
+      SvPOK_on(out);
+      die_on(aTHX_ MXPredGetOutput(INT2PTR(PredictorHandle, handle),
+                                   (mx_uint)index,
+                                   (mx_float*)SvPVX(out), (mx_uint)size),
+             "MXPredGetOutput");
+      SvCUR_set(out, size * sizeof(mx_float));
+      RETVAL = out;
+    }
+  OUTPUT:
+    RETVAL
+
+void
+_free(handle)
+    UV handle
+  CODE:
+    die_on(aTHX_ MXPredFree(INT2PTR(PredictorHandle, handle)),
+           "MXPredFree");

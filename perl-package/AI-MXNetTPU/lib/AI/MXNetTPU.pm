@@ -1,0 +1,101 @@
+package AI::MXNetTPU;
+
+# Perl binding for the mxnet_tpu C predict ABI — standalone inference
+# from Perl with no Python code in the caller (the .so embeds the
+# runtime).  Mirrors the reference's language-binding pattern of
+# wrapping the C predict API (reference: perl-package/AI-MXNet wraps
+# c_api.h; the predict-only scope here matches matlab/, which the
+# reference also ships).
+#
+#   use AI::MXNetTPU;
+#   my $p = AI::MXNetTPU::Predictor->new(
+#       symbol_json => $json,          # contents of model-symbol.json
+#       params      => $param_bytes,   # contents of model-0000.params
+#       shapes      => { data => [1, 3, 8, 8] },
+#       dev_type    => 'cpu',          # or 'tpu'
+#   );
+#   $p->set_input(data => \@floats);
+#   $p->forward;
+#   my $out = $p->get_output(0);       # array ref of floats
+#   my @shape = $p->output_shape(0);
+
+use strict;
+use warnings;
+
+our $VERSION = '0.01';
+
+require XSLoader;
+XSLoader::load('AI::MXNetTPU', $VERSION);
+
+package AI::MXNetTPU::Predictor;
+
+use strict;
+use warnings;
+use Carp qw(croak);
+
+my %DEV = (cpu => 1, tpu => 2);
+
+sub new {
+    my ($class, %args) = @_;
+    for my $req (qw(symbol_json params shapes)) {
+        croak "missing required argument '$req'" unless exists $args{$req};
+    }
+    my $dev = $args{dev_type} // 'cpu';
+    croak "dev_type must be cpu or tpu" unless exists $DEV{$dev};
+    my @keys   = sort keys %{ $args{shapes} };
+    my @shapes = map { $args{shapes}{$_} } @keys;
+    my $handle = AI::MXNetTPU::_create(
+        $args{symbol_json}, $args{params}, $DEV{$dev},
+        $args{dev_id} // 0, \@keys, \@shapes);
+    return bless { handle => $handle, freed => 0 }, $class;
+}
+
+sub set_input {
+    my ($self, $key, $values) = @_;
+    AI::MXNetTPU::_set_input($self->{handle}, $key,
+                             pack('f*', @$values));
+    return $self;
+}
+
+sub forward {
+    my ($self) = @_;
+    AI::MXNetTPU::_forward($self->{handle});
+    return $self;
+}
+
+sub output_shape {
+    my ($self, $index) = @_;
+    return AI::MXNetTPU::_output_shape($self->{handle}, $index // 0);
+}
+
+sub get_output {
+    my ($self, $index) = @_;
+    $index //= 0;
+    my $n = 1;
+    $n *= $_ for $self->output_shape($index);
+    my $packed = AI::MXNetTPU::_get_output($self->{handle}, $index, $n);
+    return [ unpack('f*', $packed) ];
+}
+
+sub DESTROY {
+    my ($self) = @_;
+    return if $self->{freed}++;
+    AI::MXNetTPU::_free($self->{handle}) if defined $self->{handle};
+}
+
+1;
+
+__END__
+
+=head1 NAME
+
+AI::MXNetTPU - Perl inference binding for the mxnet_tpu framework
+
+=head1 DESCRIPTION
+
+Wraps the C predict ABI (C<include/mxtpu/c_predict_api.h>) exposed by
+C<libmxtpu_predict.so>.  Build the library first with
+C<make -C src/capi>, then build this module with
+C<perl Makefile.PL && make>.
+
+=cut

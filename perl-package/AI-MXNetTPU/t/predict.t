@@ -1,0 +1,80 @@
+#!/usr/bin/env perl
+# End-to-end: load a model exported by the Python layer and verify the
+# Perl-side forward matches Python's expected logits bit-for-bit-ish.
+#
+# Model files are generated on the fly with python3 (JAX_PLATFORMS=cpu)
+# unless MXTPU_TEST_MODEL_DIR already points at
+# {model-symbol.json, model-0000.params, expected.json}.
+use strict;
+use warnings;
+use Test::More;
+use File::Temp qw(tempdir);
+
+use AI::MXNetTPU;
+
+my $dir = $ENV{MXTPU_TEST_MODEL_DIR};
+if (!$dir) {
+    $dir = tempdir(CLEANUP => 1);
+    my $rc = system('python3', '-c', <<"PY");
+import os, json
+os.environ['JAX_PLATFORMS'] = 'cpu'
+import sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(r'''$0'''))))))
+import numpy as np
+import mxnet_tpu as mx
+d = mx.sym.var('data')
+s = mx.sym.FullyConnected(mx.sym.Activation(mx.sym.FullyConnected(
+    d, num_hidden=8, name='h'), act_type='relu'), num_hidden=3, name='o')
+rs = np.random.RandomState(7)
+args = {'h_weight': mx.nd.array(rs.randn(8, 6).astype('float32') * .3),
+        'h_bias': mx.nd.zeros((8,)),
+        'o_weight': mx.nd.array(rs.randn(3, 8).astype('float32') * .3),
+        'o_bias': mx.nd.zeros((3,))}
+mx.model.save_checkpoint(r'''$dir''' + '/model', 0, s, args, {})
+x = rs.randn(2, 6).astype('float32')
+exe = s.bind(mx.cpu(), dict(args, data=mx.nd.array(x)))
+out = exe.forward(is_train=False)[0].asnumpy()
+json.dump({'x': x.ravel().tolist(), 'y': out.ravel().tolist(),
+           'shape': list(out.shape)},
+          open(r'''$dir''' + '/expected.json', 'w'))
+PY
+    $rc == 0 or plan skip_all => 'python3 model generation failed';
+}
+
+my $slurp = sub {
+    my ($p) = @_;
+    open my $fh, '<:raw', $p or die "open $p: $!";
+    local $/; my $c = <$fh>; close $fh; return $c;
+};
+
+my $expected_json = $slurp->("$dir/expected.json");
+my ($xs)    = $expected_json =~ /"x":\s*\[([^\]]*)\]/;
+my ($ys)    = $expected_json =~ /"y":\s*\[([^\]]*)\]/;
+my @x = split /\s*,\s*/, $xs;
+my @y = split /\s*,\s*/, $ys;
+
+my $p = AI::MXNetTPU::Predictor->new(
+    symbol_json => $slurp->("$dir/model-symbol.json"),
+    params      => $slurp->("$dir/model-0000.params"),
+    shapes      => { data => [2, 6] },
+    dev_type    => 'cpu',
+);
+ok($p, 'predictor created');
+
+$p->set_input(data => \@x);
+$p->forward;
+
+my @shape = $p->output_shape(0);
+is_deeply(\@shape, [2, 3], 'output shape');
+
+my $out = $p->get_output(0);
+is(scalar @$out, scalar @y, 'output length');
+my $maxerr = 0;
+for my $i (0 .. $#y) {
+    my $e = abs($out->[$i] - $y[$i]);
+    $maxerr = $e if $e > $maxerr;
+}
+cmp_ok($maxerr, '<', 1e-4, "outputs match python (maxerr=$maxerr)");
+
+done_testing();

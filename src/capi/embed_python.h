@@ -1,0 +1,78 @@
+// Shared embedded-CPython bootstrap for the C ABI libraries
+// (mxtpu_predict.cc, mxtpu_ndarray.cc).  Header-only: each .so is built
+// standalone, so the helpers live in an anonymous namespace per TU.
+#ifndef MXTPU_EMBED_PYTHON_H_
+#define MXTPU_EMBED_PYTHON_H_
+
+#include <Python.h>
+
+#include <dlfcn.h>
+
+#include <mutex>
+#include <string>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+// Bring up the interpreter once (for hosts that never initialized
+// Python themselves); must run before any PyGILState_Ensure.
+inline void EnsureInterpreter() {
+  static std::once_flag once;
+  std::call_once(once, []() {
+    if (!Py_IsInitialized()) {
+      // When THIS library was dlopen'd (perl/ruby/FFI hosts), libpython
+      // came in RTLD_LOCAL and Python's own extension modules (math,
+      // _struct, ...) then fail with unresolved Py* symbols.  Promote
+      // the already-mapped libpython to global scope first; harmless
+      // when the host linked libpython itself (C example, ctypes).
+#ifdef MXTPU_PYLIB_SONAME
+      if (!dlopen(MXTPU_PYLIB_SONAME,
+                  RTLD_GLOBAL | RTLD_NOLOAD | RTLD_LAZY)) {
+        dlopen(MXTPU_PYLIB_SONAME, RTLD_GLOBAL | RTLD_LAZY);
+      }
+#endif
+      Py_InitializeEx(0);
+#if PY_VERSION_HEX < 0x03090000
+      PyEval_InitThreads();
+#endif
+      // release the GIL taken by Py_Initialize so GILGuard can take it
+      PyEval_SaveThread();
+    }
+  });
+}
+
+class GILGuard {
+ public:
+  GILGuard() {
+    EnsureInterpreter();
+    state_ = PyGILState_Ensure();
+  }
+  ~GILGuard() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+// Capture the pending Python exception into g_last_error.
+inline void SetErrorFromPython() {
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  g_last_error = "unknown python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c) g_last_error = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+}  // namespace
+
+#endif  // MXTPU_EMBED_PYTHON_H_

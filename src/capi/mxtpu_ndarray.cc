@@ -8,6 +8,8 @@
 
 #include <Python.h>
 
+#include "embed_python.h"
+
 #include <cstdint>
 #include <cstring>
 #include <mutex>
@@ -21,7 +23,6 @@ typedef uint32_t mx_uint;
 
 namespace {
 
-thread_local std::string g_last_error;
 // results that must outlive the call that produced them
 thread_local std::vector<mx_uint> g_shape;
 thread_local std::vector<NDArrayHandle> g_outputs;
@@ -29,49 +30,6 @@ thread_local std::string g_op_names;
 thread_local std::vector<NDArrayHandle> g_loaded;
 thread_local std::vector<std::string> g_loaded_name_store;
 thread_local std::vector<const char*> g_loaded_names;
-
-void EnsureInterpreter() {
-  static std::once_flag once;
-  std::call_once(once, []() {
-    if (!Py_IsInitialized()) {
-      Py_InitializeEx(0);
-#if PY_VERSION_HEX < 0x03090000
-      PyEval_InitThreads();
-#endif
-      PyEval_SaveThread();
-    }
-  });
-}
-
-class GILGuard {
- public:
-  GILGuard() {
-    EnsureInterpreter();
-    state_ = PyGILState_Ensure();
-  }
-  ~GILGuard() { PyGILState_Release(state_); }
-
- private:
-  PyGILState_STATE state_;
-};
-
-void SetErrorFromPython() {
-  PyObject *type, *value, *tb;
-  PyErr_Fetch(&type, &value, &tb);
-  PyErr_NormalizeException(&type, &value, &tb);
-  g_last_error = "unknown python error";
-  if (value) {
-    PyObject* s = PyObject_Str(value);
-    if (s) {
-      const char* c = PyUnicode_AsUTF8(s);
-      if (c) g_last_error = c;
-      Py_DECREF(s);
-    }
-  }
-  Py_XDECREF(type);
-  Py_XDECREF(value);
-  Py_XDECREF(tb);
-}
 
 PyObject* GetBridge() {
   return PyImport_ImportModule("mxnet_tpu.capi_bridge");

@@ -20,6 +20,8 @@
 
 #include <Python.h>
 
+#include "embed_python.h"
+
 #include <cstdint>
 #include <cstring>
 #include <mutex>
@@ -35,7 +37,6 @@ typedef float mx_float;
 
 namespace {
 
-thread_local std::string g_last_error;
 
 struct Predictor {
   PyObject* obj;  // capi_bridge.Predictor
@@ -50,52 +51,6 @@ struct NDList {
   std::vector<mx_uint> cur_shape;
   std::string cur_bytes;
 };
-
-// Bring up the interpreter once (for pure-C hosts that never initialized
-// Python themselves); must run before any PyGILState_Ensure.
-void EnsureInterpreter() {
-  static std::once_flag once;
-  std::call_once(once, []() {
-    if (!Py_IsInitialized()) {
-      Py_InitializeEx(0);
-#if PY_VERSION_HEX < 0x03090000
-      PyEval_InitThreads();
-#endif
-      // release the GIL taken by Py_Initialize so GILGuard can take it
-      PyEval_SaveThread();
-    }
-  });
-}
-
-class GILGuard {
- public:
-  GILGuard() {
-    EnsureInterpreter();
-    state_ = PyGILState_Ensure();
-  }
-  ~GILGuard() { PyGILState_Release(state_); }
-
- private:
-  PyGILState_STATE state_;
-};
-
-void SetErrorFromPython() {
-  PyObject *type, *value, *tb;
-  PyErr_Fetch(&type, &value, &tb);
-  PyErr_NormalizeException(&type, &value, &tb);
-  g_last_error = "unknown python error";
-  if (value) {
-    PyObject* s = PyObject_Str(value);
-    if (s) {
-      const char* c = PyUnicode_AsUTF8(s);
-      if (c) g_last_error = c;
-      Py_DECREF(s);
-    }
-  }
-  Py_XDECREF(type);
-  Py_XDECREF(value);
-  Py_XDECREF(tb);
-}
 
 // Import the bridge module (caller holds the GIL via GILGuard).
 PyObject* GetBridge() {

@@ -1,0 +1,40 @@
+"""mx.libinfo discovery behavior (reference: python/mxnet/libinfo.py
+find_lib_path / find_include_path)."""
+
+import os
+
+import pytest
+
+from mxnet_tpu import libinfo
+
+
+def test_find_include_path():
+    p = libinfo.find_include_path()
+    assert os.path.isdir(p)
+    assert os.path.exists(os.path.join(p, "mxtpu", "c_predict_api.h"))
+
+
+def test_env_var_names_library_file(tmp_path, monkeypatch):
+    # upstream convention: MXNET_LIBRARY_PATH may be the .so path itself
+    lib = tmp_path / "libcustom.so"
+    lib.write_bytes(b"\x7fELF")
+    monkeypatch.setenv("MXNET_LIBRARY_PATH", str(lib))
+    found = libinfo.find_lib_path(optional=True)
+    assert str(lib) in found
+
+
+def test_env_var_names_directory(tmp_path, monkeypatch):
+    lib = tmp_path / "libmxtpu_nd.so"
+    lib.write_bytes(b"\x7fELF")
+    monkeypatch.setenv("MXNET_LIBRARY_PATH", str(tmp_path))
+    found = libinfo.find_lib_path(optional=True)
+    assert str(lib) in found
+
+
+def test_missing_raises_unless_optional(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_LIBRARY_PATH", str(tmp_path / "nowhere"))
+    # the real build dir may exist; only assert the optional contract
+    assert isinstance(libinfo.find_lib_path(optional=True), list)
+    if not libinfo.find_lib_path(optional=True):
+        with pytest.raises(RuntimeError):
+            libinfo.find_lib_path()

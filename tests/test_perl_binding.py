@@ -1,0 +1,51 @@
+"""AI::MXNetTPU — the Perl language binding over the C predict ABI
+(reference: perl-package/ wraps the C API; predict-only scope here
+mirrors the reference's matlab/ binding).
+
+Builds the XS module if needed and runs its prove-style test, which
+generates a model with the Python layer, loads it from Perl through
+libmxtpu_predict.so, and asserts the logits match."""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PKG = os.path.join(_REPO, "perl-package", "AI-MXNetTPU")
+
+
+def _have_toolchain():
+    if not shutil.which("perl"):
+        return False
+    probe = subprocess.run(
+        ["perl", "-MExtUtils::MakeMaker", "-MTest::More", "-e", "1"],
+        capture_output=True)
+    return probe.returncode == 0
+
+
+@pytest.mark.skipif(not _have_toolchain(),
+                    reason="perl XS toolchain unavailable")
+def test_perl_predict_binding():
+    lib = os.path.join(_REPO, "build", "libmxtpu_predict.so")
+    if not os.path.exists(lib):
+        r = subprocess.run(["make", "-C", os.path.join(_REPO, "src", "capi")],
+                           capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr[-2000:]
+
+    if not os.path.exists(os.path.join(_PKG, "blib", "arch", "auto",
+                                       "AI", "MXNetTPU", "MXNetTPU.so")):
+        r = subprocess.run(["perl", "Makefile.PL"], cwd=_PKG,
+                           capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr[-2000:]
+        r = subprocess.run(["make"], cwd=_PKG, capture_output=True,
+                           text=True)
+        assert r.returncode == 0, r.stderr[-2000:]
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=_REPO)
+    r = subprocess.run(["perl", "-Mblib", "t/predict.t"], cwd=_PKG,
+                       capture_output=True, text=True, env=env,
+                       timeout=600)
+    assert r.returncode == 0, (r.stdout + r.stderr)[-3000:]
+    assert "not ok" not in r.stdout, r.stdout[-3000:]

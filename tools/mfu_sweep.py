@@ -1,18 +1,15 @@
 #!/usr/bin/env python
 """ResNet-50 training-step MFU sweep on the real chip.
 
-Times the ParallelTrainer step (the bench.py workload) across batch
-size x remat policy in ONE process, so a single healthy-tunnel session
-answers "which config should bench.py ship?".  Reports ms/step, img/s,
-sustained TF/s and MFU against the chained-matmul probe (the bench
-denominator, docs/PERF_NOTES.md).
+Times bench.py's exact harness (`bench.timed_resnet_train` — same scan
+dispatch shape, same readback discipline, same cost-analysis FLOPs)
+across batch size x remat policy in ONE process, so a single
+healthy-tunnel session answers "which config should bench.py ship?".
 
     PYTHONPATH=/root/repo:/root/.axon_site python tools/mfu_sweep.py \
         [--configs 128:none 128:dots 256:none 256:dots]
 
-Timing discipline: steps scanned inside one dispatch, timed to a host
-scalar readback (tunnel latency stays out of the number).  Run only
-with a healthy tunnel and NO other TPU process.
+Run only with a healthy tunnel and NO other TPU process.
 """
 
 from __future__ import annotations
@@ -21,87 +18,11 @@ import argparse
 import json
 import os
 import sys
-import time
-
-import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
-
-def run_config(batch, remat, iters=20, scan_n=5, image=224):
-    iters = max(iters, scan_n)  # at least one timed dispatch
-    import jax
-    import jax.numpy as jnp
-    import mxnet_tpu as mx
-    from mxnet_tpu import gluon
-    from mxnet_tpu.gluon.model_zoo import vision
-    from mxnet_tpu.parallel.mesh import make_mesh
-    from mxnet_tpu.parallel.data_parallel import ParallelTrainer
-
-    dev = jax.devices()[0]
-    net = vision.get_model("resnet50_v1", classes=1000)
-    net.initialize()
-    loss = gluon.loss.SoftmaxCrossEntropyLoss()
-    trainer = ParallelTrainer(
-        net, loss, optimizer="lbsgd",
-        optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
-                          "eta": 0.001},
-        mesh=make_mesh({"dp": 1}, [dev]), multi_precision=True,
-        remat=remat)
-
-    rng = np.random.RandomState(0)
-    x = mx.nd.array(rng.randn(batch, 3, image, image).astype(np.float32))
-    y = mx.nd.array(rng.randint(0, 1000, (batch,)).astype(np.float32))
-    l = trainer.fit_batch(x, y)
-    float(np.asarray(l))
-
-    step = trainer._step_fn
-
-    def multi(params, opt_state, aux, xb, yb, key, lr, t):
-        def body(carry, i):
-            p, s, a = carry
-            p, s, a, l = step(p, s, a, xb, yb,
-                              jax.random.fold_in(key, i), lr, t)
-            return (p, s, a), l
-        (p, s, a), ls = jax.lax.scan(
-            body, (params, opt_state, aux), jnp.arange(scan_n))
-        return p, s, a, ls[-1]
-
-    multi_j = jax.jit(multi, donate_argnums=(0, 1, 2))
-    xd = x._data.astype(jnp.bfloat16)
-    yd = y._data
-    p, s, a = trainer._params, trainer._opt_state, trainer._aux
-    p, s, a, l = multi_j(p, s, a, xd, yd, jax.random.PRNGKey(0),
-                         np.float32(0.1), np.int32(1))
-    float(np.asarray(l))  # warm
-
-    t0 = time.perf_counter()
-    for it in range(iters // scan_n):
-        p, s, a, l = multi_j(p, s, a, xd, yd, jax.random.PRNGKey(it + 1),
-                             np.float32(0.1), np.int32(1))
-    float(np.asarray(l))
-    dt = time.perf_counter() - t0
-    n = (iters // scan_n) * scan_n
-
-    flops = None
-    try:
-        ca = step.lower(p, s, a, xd, yd, jax.random.PRNGKey(0),
-                        np.float32(0.1), np.int32(1)).compile() \
-            .cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0]
-        if ca and "flops" in ca:
-            flops = float(ca["flops"])
-    except Exception:
-        pass
-    if not flops:
-        flops = 3 * 4.089e9 * batch
-    return {"batch": batch, "remat": remat or "none",
-            "ms_per_step": round(dt / n * 1e3, 2),
-            "img_s": round(batch * n / dt, 1),
-            "tf_s": round(flops * n / dt / 1e12, 1),
-            "flops_per_step": flops}
+import bench
 
 
 def main():
@@ -113,7 +34,6 @@ def main():
     ap.add_argument("--iters", type=int, default=20)
     args = ap.parse_args()
 
-    import bench
     peak = bench._probe_peak_flops()
     print(json.dumps({"probe_tf_s": round(peak / 1e12, 1)}), flush=True)
 
@@ -121,9 +41,18 @@ def main():
         bs, _, rm = cfg.partition(":")
         rm = None if rm in ("", "none") else rm
         try:
-            r = run_config(int(bs), rm, iters=args.iters)
-            r["mfu"] = round(r["tf_s"] * 1e12 / peak, 4)
-            print(json.dumps(r), flush=True)
+            r = bench.timed_resnet_train(int(bs), 224, rm,
+                                         iters=args.iters, scan_n=5,
+                                         warmup=2)
+            tf_s = r["flops_per_step"] * r["iters"] / r["dt"] / 1e12
+            print(json.dumps({
+                "batch": int(bs), "remat": rm or "none",
+                "ms_per_step": round(r["dt"] / r["iters"] * 1e3, 2),
+                "img_s": round(r["img_s"], 1),
+                "tf_s": round(tf_s, 1),
+                "mfu": round(tf_s * 1e12 / peak, 4),
+                "flops_per_step": r["flops_per_step"],
+            }), flush=True)
         except Exception as e:
             print(json.dumps({"batch": bs, "remat": rm or "none",
                               "error": repr(e)[:300]}), flush=True)
