@@ -168,7 +168,8 @@ def _probe_peak_flops(iters=40, n=8192):
 
 
 def timed_resnet_train(batch, image, remat, iters, scan_n, warmup=2,
-                       optimizer="lbsgd", multi_precision=True):
+                       optimizer="lbsgd", multi_precision=True,
+                       coalesce_small=None, momentum=0.9):
     """Build the north-star ResNet-50 trainer and time its step.
 
     This is THE measurement harness (tools/mfu_sweep.py reuses it):
@@ -194,18 +195,20 @@ def timed_resnet_train(batch, image, remat, iters, scan_n, warmup=2,
     loss = gluon.loss.SoftmaxCrossEntropyLoss()
     # north-star config: bf16 compute weights + f32 masters + LARS
     # (docs/faq/perf.md fp16 ≈ 2x fp32 sanity ratio applies to bf16)
+    opt_params = {"learning_rate": 0.1, "eta": 0.001}
+    if momentum:
+        opt_params["momentum"] = momentum
     trainer = ParallelTrainer(
-        net, loss, optimizer=optimizer,
-        optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
-                          "eta": 0.001},
+        net, loss, optimizer=optimizer, optimizer_params=opt_params,
         mesh=make_mesh({"dp": 1}, [dev]),
-        multi_precision=multi_precision, remat=remat)
+        multi_precision=multi_precision, remat=remat,
+        coalesce_small=coalesce_small)
 
     rng = np.random.RandomState(0)
     x = mx.nd.array(rng.randn(batch, 3, image, image).astype(np.float32))
     y = mx.nd.array(rng.randint(0, 1000, (batch,)).astype(np.float32))
 
-    for _ in range(warmup):
+    for _ in range(max(1, warmup)):
         l = trainer.fit_batch(x, y)
     float(np.asarray(l))  # forced readback
 
@@ -259,6 +262,75 @@ def timed_resnet_train(batch, image, remat, iters, scan_n, warmup=2,
         flops = 3 * 4.089e9 * batch  # analytic fwd+bwd ResNet-50/224
     return {"img_s": batch * iters / dt, "dt": dt, "iters": iters,
             "flops_per_step": flops, "final_loss": final_loss}
+
+
+def timed_resnet_fwd(batch, image, iters, scan_n, warmup=2,
+                     multi_precision=True):
+    """Training-mode FORWARD only, same scan/readback discipline as
+    timed_resnet_train — the fwd/bwd/optimizer decomposition baseline
+    for tools/mfu_sweep.py --decompose."""
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.parallel.mesh import make_mesh
+    from mxnet_tpu.parallel.data_parallel import ParallelTrainer
+
+    dev = jax.devices()[0]
+    net = vision.get_model("resnet50_v1", classes=1000)
+    net.initialize()
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = ParallelTrainer(
+        net, loss, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1},
+        mesh=make_mesh({"dp": 1}, [dev]),
+        multi_precision=multi_precision)
+
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(batch, 3, image, image).astype(np.float32))
+    y = mx.nd.array(rng.randint(0, 1000, (batch,)).astype(np.float32))
+    trainer.fit_batch(x, y)  # build + gather state
+    eval_fn = trainer._eval
+
+    def fwd_multi(params, aux, xb, yb, key):
+        def body(c, i):
+            amap = dict(params)
+            # data depends on the carry so XLA cannot hoist the
+            # loop-invariant forward out of the scan
+            amap["data0"] = xb + (c * 0).astype(xb.dtype)
+            amap["label0"] = yb
+            outs, _ = eval_fn(amap, aux, jax.random.fold_in(key, i))
+            return c + jnp.mean(outs[0].astype(jnp.float32)), None
+        s, _ = jax.lax.scan(body, jnp.float32(0), jnp.arange(scan_n))
+        return s
+
+    fj = jax.jit(fwd_multi)
+    xd = trainer._device_batch(x._data)
+    yd = y._data
+    p, a = trainer._params, trainer._aux
+    for _ in range(max(1, warmup)):
+        float(np.asarray(fj(p, a, xd, yd, jax.random.PRNGKey(0))))
+    t0 = time.perf_counter()
+    for it in range(max(1, iters // scan_n)):
+        s = fj(p, a, xd, yd, jax.random.PRNGKey(it + 1))
+    float(np.asarray(s))
+    dt = time.perf_counter() - t0
+    iters = max(1, iters // scan_n) * scan_n
+    flops = None
+    try:
+        ca = fj.lower(p, a, xd, yd,
+                      jax.random.PRNGKey(0)).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        if ca and "flops" in ca:
+            flops = float(ca["flops"]) / scan_n
+    except Exception:
+        pass
+    if not flops:
+        flops = 4.089e9 * batch  # analytic fwd ResNet-50/224
+    return {"img_s": batch * iters / dt, "dt": dt, "iters": iters,
+            "flops_per_step": flops}
 
 
 def main():

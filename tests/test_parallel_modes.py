@@ -201,3 +201,62 @@ def test_parallel_trainer_checkpoint_resume_exact():
                    for _ in range(3)]
         np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-7)
         assert t2._num_update == 8
+
+
+def test_coalesced_small_param_apply_matches_per_param():
+    """coalesce_small fuses the LARS norms + (mp_)sgd updates of every
+    small parameter into one flat-buffer computation; it must reproduce
+    the per-parameter path numerically (ResNet's ~110 BN tensors are the
+    real target — here a conv+BN+dense net stands in)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel.data_parallel import ParallelTrainer
+
+    def make(coalesce, optimizer, mp, momentum):
+        net = nn.HybridSequential()
+        net.add(nn.Conv2D(8, 3, padding=1), nn.BatchNorm(),
+                nn.Activation("relu"), nn.Dense(5))
+        net.initialize(mx.init.Xavier(rnd_type="gaussian"))
+        params = {"learning_rate": 0.05, "eta": 0.01, "wd": 1e-4}
+        if momentum:
+            params["momentum"] = momentum
+        tr = ParallelTrainer(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(),
+            optimizer=optimizer, optimizer_params=params,
+            mesh=make_mesh({"dp": 8}), multi_precision=mp,
+            coalesce_small=coalesce)
+        return tr, net
+
+    rs = np.random.RandomState(3)
+    x = mx.nd.array(rs.randn(16, 3, 8, 8).astype(np.float32))
+    y = mx.nd.array(rs.randint(0, 5, (16,)).astype(np.float32))
+    for optimizer, mp, momentum in (("lbsgd", True, 0.9),
+                                    ("lbsgd", False, 0.0),
+                                    ("sgd", False, 0.9)):
+        ta, neta = make(False, optimizer, mp, momentum)
+        tb, netb = make(True, optimizer, mp, momentum)
+        # identical starting point: params materialize lazily at the
+        # first forward, so run one dummy forward through each net and
+        # copy a's values into b by structural position BEFORE the
+        # trainers gather state
+        neta(mx.nd.array(np.zeros((1, 3, 8, 8), np.float32)))
+        netb(mx.nd.array(np.zeros((1, 3, 8, 8), np.float32)))
+        psa = list(neta.collect_params().values())
+        psb = list(netb.collect_params().values())
+        assert len(psa) == len(psb)
+        for a, b in zip(psa, psb):
+            assert a.shape == b.shape
+            b.set_data(a.data().copy())
+        la = [float(np.asarray(ta.fit_batch(x, y))) for _ in range(4)]
+        lb = [float(np.asarray(tb.fit_batch(x, y))) for _ in range(4)]
+        np.testing.assert_allclose(lb, la, rtol=2e-4, atol=2e-5)
+        if optimizer == "lbsgd":
+            small = [n for n in tb.param_names
+                     if tb._params[n].size <= 8192]
+            assert len(small) >= 2
+        for na, nb in zip(ta.param_names, tb.param_names):
+            np.testing.assert_allclose(
+                np.asarray(ta._params[na], dtype=np.float32),
+                np.asarray(tb._params[nb], dtype=np.float32),
+                rtol=3e-3 if mp else 1e-5, atol=3e-3 if mp else 1e-6)

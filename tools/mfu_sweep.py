@@ -25,6 +25,45 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 import bench
 
 
+def _decompose(peak, batch, iters):
+    """Time the step's constituent configurations: fwd-only, then full
+    steps with increasing optimizer machinery.  Differences between
+    rows locate the non-conv time (PERF_NOTES 'remaining gap' list)."""
+    rows = [
+        ("fwd_only", dict(fwd=True)),
+        ("sgd_plain_f32", dict(optimizer="sgd", multi_precision=False,
+                               momentum=0.0)),
+        ("sgd_mom_mp", dict(optimizer="sgd", multi_precision=True,
+                            momentum=0.9)),
+        ("lbsgd_mp_percoparam", dict(optimizer="lbsgd",
+                                     multi_precision=True,
+                                     coalesce_small=False)),
+        ("lbsgd_mp_coalesced", dict(optimizer="lbsgd",
+                                    multi_precision=True,
+                                    coalesce_small=True)),
+    ]
+    for name, kw in rows:
+        try:
+            if kw.pop("fwd", False):
+                r = bench.timed_resnet_fwd(batch, 224, iters=iters,
+                                           scan_n=5, warmup=2)
+            else:
+                r = bench.timed_resnet_train(batch, 224, None,
+                                             iters=iters, scan_n=5,
+                                             warmup=2, **kw)
+            tf_s = r["flops_per_step"] * r["iters"] / r["dt"] / 1e12
+            print(json.dumps({
+                "variant": name, "batch": batch,
+                "ms_per_step": round(r["dt"] / r["iters"] * 1e3, 2),
+                "img_s": round(r["img_s"], 1),
+                "tf_s": round(tf_s, 1),
+                "mfu": round(tf_s * 1e12 / peak, 4),
+            }), flush=True)
+        except Exception as e:
+            print(json.dumps({"variant": name,
+                              "error": repr(e)[:300]}), flush=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs", nargs="*",
@@ -32,10 +71,17 @@ def main():
                              "256:dots"],
                     help="batch:remat pairs (remat none|dots|full)")
     ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--decompose", action="store_true",
+                    help="time fwd-only + optimizer-variant full steps")
+    ap.add_argument("--batch", type=int, default=128)
     args = ap.parse_args()
 
     peak = bench._probe_peak_flops()
     print(json.dumps({"probe_tf_s": round(peak / 1e12, 1)}), flush=True)
+
+    if args.decompose:
+        _decompose(peak, args.batch, args.iters)
+        return
 
     for cfg in args.configs:
         bs, _, rm = cfg.partition(":")

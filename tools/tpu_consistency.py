@@ -181,12 +181,16 @@ def run_cases(only=None):
     return 1 if failures or not n_run else 0
 
 
-def _spawn_abandonable(argv, deadline_s):
+def _spawn_abandonable(argv, deadline_s, inactivity_s=None):
     """Run argv, streaming stdout; ABANDON (never reap) on deadline.
 
     A child stuck in a wedged TPU driver call sits in uninterruptible
     sleep: SIGKILL doesn't reap it and waiting blocks forever
     (bench.py's guard, docs/PERF_NOTES.md).  Returns (rc | None, out).
+
+    ``inactivity_s`` resets the clock whenever the child produces
+    output — a batch child running N cases gets ``inactivity_s`` per
+    case instead of one fixed budget for the whole batch.
     """
     import subprocess
     import time
@@ -204,10 +208,13 @@ def _spawn_abandonable(argv, deadline_s):
             sys.stdout.write(text)
             sys.stdout.flush()
             out.append(text)
+            return True
+        return False
 
     end = time.time() + deadline_s
     while time.time() < end:
-        _drain()
+        if _drain() and inactivity_s is not None:
+            end = time.time() + inactivity_s
         if p.poll() is not None:
             _drain()
             return p.returncode, "".join(out)
@@ -225,17 +232,56 @@ def _probe_healthy(deadline_s=150):
     return bench._probe_tpu_once(deadline_s)
 
 
+def _journal_path():
+    return os.environ.get(
+        "CONSISTENCY_JOURNAL",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "results", "tpu_r4",
+            "consistency_results.txt"))
+
+
+def _read_journal():
+    """Case name -> last recorded status (OK/FAIL/HANG/SKIP)."""
+    done = {}
+    try:
+        with open(_journal_path()) as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) >= 2 and parts[0] in (
+                        "OK", "FAIL", "HANG", "SKIP"):
+                    done[parts[1]] = parts[0]
+    except OSError:
+        pass
+    return done
+
+
+def _log_journal(status, name):
+    import time as _t
+    path = _journal_path()
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "a") as f:
+            f.write("%s %s %s\n" % (
+                status, name, _t.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                          _t.gmtime())))
+    except OSError:
+        pass
+
+
 def main():
     argv = sys.argv[1:]
     if argv and argv[0] == "--child":
         return run_cases(argv[1:] or None)
 
-    # Parent mode: one abandonable child per case, so a single case that
-    # wedges the tunnel cannot hang the whole sweep artifact.  After a
-    # hang, probe tunnel health; if it is wedged, record the remaining
-    # cases as SKIP rather than burning a deadline each.
+    # Parent mode: ONE abandonable child runs the whole pending batch
+    # (a fresh process per case pays a full JAX init + tunnel compile
+    # each, ~2 min/case); the inactivity deadline gives each case its
+    # own hang budget.  On a hang the current case is recorded, tunnel
+    # health is probed, and a new child resumes after it.  Every case
+    # result is appended to the journal so an interrupted sweep resumes
+    # where it stopped (CONSISTENCY_FRESH=1 ignores the journal).
     import mxnet_tpu as mx
-    only = argv or None
+    only = [a for a in argv if not a.startswith("-")] or None
     names = [c[0] for c in _cases(mx)]
     if only:
         unknown = [n for n in only if n not in names]
@@ -245,30 +291,59 @@ def main():
             return 2
         names = [n for n in names if n in only]
 
-    per_case_s = float(os.environ.get("CONSISTENCY_CASE_DEADLINE", 600))
+    prior = {} if os.environ.get("CONSISTENCY_FRESH") else _read_journal()
     ok = fail = 0
-    pending = list(names)
+    pending = []
+    for n in names:
+        if prior.get(n) == "OK":
+            print("OK   %s (journaled)" % n, flush=True)
+            ok += 1
+        else:
+            pending.append(n)
+
+    per_case_s = float(os.environ.get("CONSISTENCY_CASE_DEADLINE", 600))
     while pending:
-        name = pending.pop(0)
         rc, out = _spawn_abandonable(
-            [sys.executable, os.path.abspath(__file__), "--child", name],
-            per_case_s)
+            [sys.executable, os.path.abspath(__file__), "--child"]
+            + pending, per_case_s, inactivity_s=per_case_s)
         if rc == 2 and "backend available" in out:
             # missing cpu/tpu backend: every case would fail the same
             # way — keep the documented fast exit 2 (nothing to compare)
             return 2
-        if rc == 0 and ("OK   %s" % name) in out:
-            ok += 1
-            continue
+        finished = set()
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) >= 2 and parts[0] in ("OK", "FAIL"):
+                name = parts[1]
+                if name in pending:
+                    finished.add(name)
+                    _log_journal(parts[0], name)
+                    if parts[0] == "OK":
+                        ok += 1
+                    else:
+                        fail += 1
+        pending = [n for n in pending if n not in finished]
+        if rc is not None:
+            # child exited cleanly: anything left unreported failed at
+            # the process level (crash before/after a case)
+            for n in pending:
+                print("FAIL %s (child rc=%s with no verdict)" % (n, rc),
+                      flush=True)
+                _log_journal("FAIL", n)
+                fail += 1
+            break
+        # hang: the first unfinished case wedged its computation
+        hung = pending.pop(0)
+        print("HANG %s (abandoned after %ds inactivity)"
+              % (hung, per_case_s), flush=True)
+        _log_journal("HANG", hung)
         fail += 1
-        if rc is None:
-            print("HANG %s (abandoned after %ds)" % (name, per_case_s),
-                  flush=True)
-            if pending and not _probe_healthy():
-                for n in pending:
-                    print("SKIP %s (tunnel wedged)" % n, flush=True)
-                fail += len(pending)
-                pending = []
+        if pending and not _probe_healthy():
+            for n in pending:
+                print("SKIP %s (tunnel wedged)" % n, flush=True)
+                _log_journal("SKIP", n)
+            fail += len(pending)
+            pending = []
     print("%d/%d consistent" % (ok, ok + fail))
     return 1 if fail or not ok else 0
 

@@ -2,27 +2,34 @@
 # Round-4 hardware session: run every TPU-gated deliverable in one
 # wedge-safe sequence the moment the tunnel is healthy.
 #
-#   bash tools/tpu_round4.sh
+#   bash tools/tpu_round4.sh [fast]
 #
 # Order matters: ONE TPU process at a time (two concurrent wedge the
 # tunnel — docs/PERF_NOTES.md), health probe first, generous timeouts,
-# artifacts written even on partial completion.  Each step appends to
-# results/tpu_r4/ so a mid-session wedge still leaves evidence.
+# artifacts written even on partial completion.  Each step logs to
+# results/tpu_r4/<name>.<runid>.log (never overwrites a prior run) and
+# appends to status.txt; the consistency sweep journals per-case results
+# and resumes where an interrupted run stopped.
+#
+# "fast" skips the decompose sweep (probe + consistency + flash + bench).
 
 set -u
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
 OUT="$REPO/results/tpu_r4"
+RUN="$(date -u +%m%dT%H%M%S)"
 mkdir -p "$OUT"
 export PYTHONPATH="$REPO:/root/.axon_site"
 cd "$REPO"
 
 step() {
   name="$1"; shift
-  echo "=== $name: $* (started $(date -u +%H:%M:%S))"
-  "$@" > "$OUT/$name.log" 2>&1
+  echo "=== $name: $* (started $(date -u +%H:%M:%S), log $name.$RUN.log)"
+  "$@" > "$OUT/$name.$RUN.log" 2>&1
   rc=$?
   echo "=== $name: rc=$rc"
-  echo "$name rc=$rc $(date -u +%FT%TZ)" >> "$OUT/status.txt"
+  echo "$name rc=$rc run=$RUN $(date -u +%FT%TZ)" >> "$OUT/status.txt"
+  # keep the canonical unsuffixed name pointing at the latest run
+  cp "$OUT/$name.$RUN.log" "$OUT/$name.log" 2>/dev/null
   return $rc
 }
 
@@ -42,16 +49,21 @@ p.kill()
 sys.exit(1)
 " || { echo "tunnel unhealthy - aborting session"; exit 2; }
 
-# 2. cpu-vs-TPU consistency sweep (VERDICT item 3) — the committed
-#    artifact is results/tpu_r4/consistency.log itself
+# 2. cpu-vs-TPU consistency sweep (VERDICT item 3) — journaled; the
+#    committed artifacts are consistency_results.txt + the run log
 step consistency timeout 3600 python tools/tpu_consistency.py
 
 # 3. flash fwd+bwd numerics + block sweep (VERDICT item 4)
 step flash timeout 3600 python tools/flash_sweep.py
 
-# 4. the round benchmark (VERDICT item 1) — also what the driver runs
+# 4. step decomposition: where does the non-conv time go? (VERDICT 2)
+if [ "${1:-}" != "fast" ]; then
+  step decompose timeout 3600 python tools/mfu_sweep.py --decompose
+fi
+
+# 5. the round benchmark (VERDICT item 1) — also what the driver runs
 step bench timeout 5400 python bench.py
-tail -1 "$OUT/bench.log" > "$OUT/bench.json" 2>/dev/null
+tail -1 "$OUT/bench.$RUN.log" > "$OUT/bench.json" 2>/dev/null
 
 echo "session complete; artifacts in $OUT"
-cat "$OUT/status.txt"
+tail -8 "$OUT/status.txt"
