@@ -259,9 +259,55 @@ def timed_resnet_train(batch, image, remat, iters, scan_n, warmup=2,
     except Exception:
         pass
     if not flops:
-        flops = 3 * 4.089e9 * batch  # analytic fwd+bwd ResNet-50/224
+        # analytic fwd+bwd ResNet-50, scaled from the 224x224 figure
+        flops = 3 * 4.089e9 * batch * (image / 224.0) ** 2
     return {"img_s": batch * iters / dt, "dt": dt, "iters": iters,
             "flops_per_step": flops, "final_loss": final_loss}
+
+
+def timed_scan_forward(eval_fn, params, aux, xd, extra, scan_n, iters,
+                       warmup=2):
+    """Shared forward-timing harness (tools/benchmark_score.py reuses
+    it): scan_n forwards chained through a carry inside ONE jit — the
+    data depends on the carry so XLA cannot hoist the loop-invariant
+    computation — timed to a host readback (`block_until_ready` does
+    not wait over the tunnel).
+
+    ``extra`` maps additional eval-graph inputs (e.g. label0).
+    Returns (dt_seconds, iters_run, flops_per_call_or_None)."""
+    import jax
+    import jax.numpy as jnp
+
+    def multi(params, aux, xb, key):
+        def body(c, i):
+            amap = dict(params)
+            amap["data0"] = xb + (c * 0).astype(xb.dtype)
+            amap.update(extra)
+            outs, _ = eval_fn(amap, aux, jax.random.fold_in(key, i))
+            return c + jnp.mean(outs[0].astype(jnp.float32)), None
+        s, _ = jax.lax.scan(body, jnp.float32(0), jnp.arange(scan_n))
+        return s
+
+    mj = jax.jit(multi)
+    for _ in range(max(1, warmup)):
+        float(np.asarray(mj(params, aux, xd, jax.random.PRNGKey(0))))
+    t0 = time.perf_counter()
+    for it in range(max(1, iters // scan_n)):
+        s = mj(params, aux, xd, jax.random.PRNGKey(it + 1))
+    float(np.asarray(s))  # device FIFO: the last readback drains all
+    dt = time.perf_counter() - t0
+    n = max(1, iters // scan_n) * scan_n
+    flops = None
+    try:
+        ca = mj.lower(params, aux, xd,
+                      jax.random.PRNGKey(0)).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        if ca and "flops" in ca:
+            flops = float(ca["flops"]) / scan_n
+    except Exception:
+        pass
+    return dt, n, flops
 
 
 def timed_resnet_fwd(batch, image, iters, scan_n, warmup=2,
@@ -291,45 +337,15 @@ def timed_resnet_fwd(batch, image, iters, scan_n, warmup=2,
     x = mx.nd.array(rng.randn(batch, 3, image, image).astype(np.float32))
     y = mx.nd.array(rng.randint(0, 1000, (batch,)).astype(np.float32))
     trainer.fit_batch(x, y)  # build + gather state
-    eval_fn = trainer._eval
 
-    def fwd_multi(params, aux, xb, yb, key):
-        def body(c, i):
-            amap = dict(params)
-            # data depends on the carry so XLA cannot hoist the
-            # loop-invariant forward out of the scan
-            amap["data0"] = xb + (c * 0).astype(xb.dtype)
-            amap["label0"] = yb
-            outs, _ = eval_fn(amap, aux, jax.random.fold_in(key, i))
-            return c + jnp.mean(outs[0].astype(jnp.float32)), None
-        s, _ = jax.lax.scan(body, jnp.float32(0), jnp.arange(scan_n))
-        return s
-
-    fj = jax.jit(fwd_multi)
     xd = trainer._device_batch(x._data)
-    yd = y._data
-    p, a = trainer._params, trainer._aux
-    for _ in range(max(1, warmup)):
-        float(np.asarray(fj(p, a, xd, yd, jax.random.PRNGKey(0))))
-    t0 = time.perf_counter()
-    for it in range(max(1, iters // scan_n)):
-        s = fj(p, a, xd, yd, jax.random.PRNGKey(it + 1))
-    float(np.asarray(s))
-    dt = time.perf_counter() - t0
-    iters = max(1, iters // scan_n) * scan_n
-    flops = None
-    try:
-        ca = fj.lower(p, a, xd, yd,
-                      jax.random.PRNGKey(0)).compile().cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0]
-        if ca and "flops" in ca:
-            flops = float(ca["flops"]) / scan_n
-    except Exception:
-        pass
+    dt, n, flops = timed_scan_forward(
+        trainer._eval, trainer._params, trainer._aux, xd,
+        {"label0": y._data}, scan_n, iters, warmup)
     if not flops:
-        flops = 4.089e9 * batch  # analytic fwd ResNet-50/224
-    return {"img_s": batch * iters / dt, "dt": dt, "iters": iters,
+        # analytic fwd ResNet-50, scaled from the 224x224 figure
+        flops = 4.089e9 * batch * (image / 224.0) ** 2
+    return {"img_s": batch * n / dt, "dt": dt, "iters": n,
             "flops_per_step": flops}
 
 

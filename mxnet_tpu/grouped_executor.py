@@ -140,7 +140,10 @@ def build_grouped_eval(symbol, group2ctx, default_ctx, training,
             dev = seg.ctx.jax_device
             ins = tuple(jax.device_put(env[e], dev)
                         for e in seg.in_entries)
-            sub = jax.random.fold_in(key, seg.index)
+            # the executor key is committed to the bind ctx device; the
+            # folded per-segment key must live on the SEGMENT's device
+            # or the jit sees a two-device argument assignment
+            sub = jax.device_put(jax.random.fold_in(key, seg.index), dev)
             if want_vjp:
                 outs, vjp = jax.vjp(lambda *a: seg.fn(sub, *a), *ins)
                 vjps.append((seg, vjp,
@@ -173,9 +176,15 @@ def build_grouped_eval(symbol, group2ctx, default_ctx, training,
                     seg_cots.append(c.astype(dtype))
             if not need:
                 continue
-            # materialize Nones as zeros (vjp wants the full pytree)
+            # materialize Nones as zeros (vjp wants the full pytree) and
+            # commit every cotangent to the SEGMENT's device — the
+            # caller's cotangents arrive on the bind-ctx device, and a
+            # vjp whose residuals live elsewhere rejects the mix
+            seg_dev = seg.ctx.jax_device
             seg_cots = tuple(
-                c if c is not None else jnp.zeros(shape, dtype)
+                jax.device_put(
+                    c if c is not None else jnp.zeros(shape, dtype),
+                    seg_dev)
                 for c, (shape, dtype) in zip(seg_cots, out_avals))
             in_cots = vjp(seg_cots)
             for e, c in zip(seg.in_entries, in_cots):

@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Inference throughput sweep across the model zoo — the TPU mirror of
+the reference's `example/image-classification/benchmark_score.py`
+(the harness behind every inference table in docs/faq/perf.md:42-175).
+
+One JSON line per (model, batch) with img/s, using bench.py's timing
+discipline: batches scanned inside one dispatch, completion forced by a
+host readback (``block_until_ready`` does not wait over the tunnel).
+
+    PYTHONPATH=/root/repo:/root/.axon_site python tools/benchmark_score.py \
+        [--models resnet50_v1 vgg16 ...] [--batches 1 32 128] [--image 224]
+
+Run only with a healthy tunnel and NO other TPU process.  On CPU
+(JAX_PLATFORMS=cpu) shrinks shapes for a plumbing smoke.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# the reference sweeps these six families (docs/faq/perf.md tables)
+DEFAULT_MODELS = [
+    "alexnet", "vgg16", "inception_v3", "resnet50_v1", "resnet152_v1",
+    "mobilenet1_0",
+]
+
+
+def _model_image(model, image):
+    # inception's canonical input is 299², but only when measuring at
+    # full scale — a tiny-shape plumbing smoke stays tiny
+    return 299 if model.startswith("inception") and image >= 224 else image
+
+
+def timed_infer(model, batch, image, iters=40, scan_n=10, warmup=2,
+                dtype="bfloat16"):
+    import jax.numpy as jnp
+    from mxnet_tpu.gluon.model_zoo import vision
+    import bench
+
+    net = vision.get_model(model, classes=1000)
+    net.initialize()
+    net.hybridize()
+
+    from mxnet_tpu import nd
+    rng = np.random.RandomState(0)
+    size = _model_image(model, image)
+    x = nd.array(rng.randn(batch, 3, size, size).astype(np.float32))
+    net(x)  # build params + trace
+
+    from mxnet_tpu.executor import _build_eval
+    import mxnet_tpu.symbol as sym_mod
+    data = sym_mod.var("data0")
+    out_sym = net(data)
+    if not isinstance(out_sym, sym_mod.Symbol):
+        out_sym = out_sym[0]
+    eval_fn = _build_eval(out_sym, False)
+    cdt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+    params = {p.name: p.data()._data.astype(cdt)
+              for p in net.collect_params().values()}
+    arg_names = set(out_sym.list_arguments())
+    params = {k: v for k, v in params.items() if k in arg_names}
+    aux = {p.name: p.data()._data
+           for p in net.collect_params().values()
+           if p.name in set(out_sym.list_auxiliary_states())}
+    xd = x._data.astype(cdt)
+
+    dt, n, _ = bench.timed_scan_forward(eval_fn, params, aux, xd, {},
+                                        scan_n, iters, warmup)
+    return batch * n / dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--models", nargs="*", default=DEFAULT_MODELS)
+    ap.add_argument("--batches", nargs="*", type=int,
+                    default=[1, 32, 128])
+    ap.add_argument("--image", type=int, default=224)
+    ap.add_argument("--dtype", default="bfloat16",
+                    choices=["bfloat16", "float32"])
+    ap.add_argument("--iters", type=int, default=40)
+    args = ap.parse_args()
+
+    # mxnet_tpu re-pins jax_platforms from the env var — the axon site
+    # hook force-sets 'axon,cpu' at startup, so a bare jax.devices()
+    # would initialize (and hang on) the tunnel even under
+    # JAX_PLATFORMS=cpu
+    import mxnet_tpu  # noqa: F401
+    import jax
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if not on_tpu:
+        # plumbing smoke only: tiny shapes, tiny models
+        args.image, args.batches = 32, [2]
+        args.iters = 4
+
+    for model in args.models:
+        for batch in args.batches:
+            try:
+                img_s = timed_infer(model, batch, args.image,
+                                    iters=args.iters, dtype=args.dtype)
+                print(json.dumps({
+                    "model": model, "batch": batch,
+                    "dtype": args.dtype,
+                    "image": _model_image(model, args.image),
+                    "img_s": round(img_s, 2),
+                    "device": ("tpu" if on_tpu else "cpu"),
+                }), flush=True)
+            except Exception as e:
+                print(json.dumps({"model": model, "batch": batch,
+                                  "error": repr(e)[:200]}), flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

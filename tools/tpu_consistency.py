@@ -323,15 +323,27 @@ def main():
                     else:
                         fail += 1
         pending = [n for n in pending if n not in finished]
-        if rc is not None:
-            # child exited cleanly: anything left unreported failed at
-            # the process level (crash before/after a case)
-            for n in pending:
-                print("FAIL %s (child rc=%s with no verdict)" % (n, rc),
-                      flush=True)
-                _log_journal("FAIL", n)
-                fail += 1
+        if not pending:
             break
+        if rc is not None:
+            if rc == 0:
+                # clean exit with cases unreported should not happen
+                # (the child runs every requested case) — don't loop
+                for n in pending:
+                    print("FAIL %s (child rc=0 with no verdict)" % n,
+                          flush=True)
+                    _log_journal("FAIL", n)
+                    fail += 1
+                break
+            # child crashed mid-sweep: blame only the FIRST unfinished
+            # case (the one it was running) and respawn for the rest —
+            # one bad case must not eat the remaining hardware window
+            crashed = pending.pop(0)
+            print("FAIL %s (child crashed rc=%s)" % (crashed, rc),
+                  flush=True)
+            _log_journal("FAIL", crashed)
+            fail += 1
+            continue
         # hang: the first unfinished case wedged its computation
         hung = pending.pop(0)
         print("HANG %s (abandoned after %ds inactivity)"
