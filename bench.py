@@ -169,7 +169,7 @@ def _probe_peak_flops(iters=40, n=8192):
 
 def timed_resnet_train(batch, image, remat, iters, scan_n, warmup=2,
                        optimizer="lbsgd", multi_precision=True,
-                       coalesce_small=None, momentum=0.9):
+                       coalesce_small=None, momentum=0.9, stem=None):
     """Build the north-star ResNet-50 trainer and time its step.
 
     This is THE measurement harness (tools/mfu_sweep.py reuses it):
@@ -190,7 +190,10 @@ def timed_resnet_train(batch, image, remat, iters, scan_n, warmup=2,
     from mxnet_tpu.parallel.data_parallel import ParallelTrainer
 
     dev = jax.devices()[0]
-    net = vision.get_model("resnet50_v1", classes=1000)
+    # BENCH_STEM=s2d swaps the 7x7 stem for the space-to-depth variant
+    # (model_zoo SpaceToDepthStem — the MXU-utilization stem)
+    stem = stem or os.environ.get("BENCH_STEM") or "conv7"
+    net = vision.get_model("resnet50_v1", classes=1000, stem=stem)
     net.initialize()
     loss = gluon.loss.SoftmaxCrossEntropyLoss()
     # north-star config: bf16 compute weights + f32 masters + LARS

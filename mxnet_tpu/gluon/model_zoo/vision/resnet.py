@@ -13,7 +13,8 @@ from ...block import HybridBlock
 from ... import nn
 
 __all__ = ["ResNetV1", "ResNetV2", "BasicBlockV1", "BasicBlockV2",
-           "BottleneckV1", "BottleneckV2", "resnet18_v1", "resnet34_v1",
+           "BottleneckV1", "BottleneckV2", "SpaceToDepthStem",
+           "resnet18_v1", "resnet34_v1",
            "resnet50_v1", "resnet101_v1", "resnet152_v1", "resnet18_v2",
            "resnet34_v2", "resnet50_v2", "resnet101_v2", "resnet152_v2",
            "get_resnet"]
@@ -95,9 +96,39 @@ class ResidualUnit(HybridBlock):
         return F.Activation(h + shortcut, act_type="relu")
 
 
+class SpaceToDepthStem(HybridBlock):
+    """TPU-friendly ImageNet stem: space-to-depth(2) the input, then a
+    4x4/stride-1 conv on 12 channels instead of 7x7/stride-2 on 3.
+
+    The MXU is a 128x128 systolic array; a 3-input-channel kernel fills
+    3/128 of its lanes, so the classic stem runs at ~2% MXU utilization
+    regardless of how XLA tiles it.  The s2d form is the standard TPU
+    fix (used by MLPerf ResNet submissions): same output grid, a
+    receptive-field superset of the 7x7 (its taps map to
+    w4[o, a*2C+b*C+c, dp, dq] = w7[o, c, 2dp+a-1, 2dq+b-1] with the
+    out-of-range row/col -1 taps zero — see tests/test_gluon.py
+    equivalence test), and 4x the input-lane occupancy at half the
+    spatial extent.
+    Opt-in via get_model(..., stem='s2d'); weight shape differs from
+    the reference checkpoint format, which is why it is not default.
+    """
+
+    def __init__(self, channels, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.conv = _conv(channels, 4, 1, 2)
+
+    def hybrid_forward(self, F, x):
+        h = self.conv(F.space_to_depth(x, block_size=2))
+        # k=4/pad=2 yields one extra row/col vs the 7x7/s2 grid; the
+        # first 7x7 tap row 2i-3 sits at tap (dp=1, a=0) here, so the
+        # aligned output is the leading slice
+        return F.slice(h, begin=(0, 0, 0, 0), end=(None, None, -1, -1))
+
+
 class _ResNet(HybridBlock):
     def __init__(self, depth, pre_act, classes=1000, thumbnail=False,
-                 **kwargs):
+                 stem="conv7", **kwargs):
         super().__init__(**kwargs)
         bottleneck, units, widths = _SPECS[depth]
         self._pre_act = pre_act
@@ -108,7 +139,12 @@ class _ResNet(HybridBlock):
             if thumbnail:      # CIFAR-style 32x32 stem
                 body.add(_conv(_STEM_CHANNELS, 3, 1, 1))
             else:              # ImageNet stem
-                body.add(_conv(_STEM_CHANNELS, 7, 2, 3))
+                if stem == "s2d":
+                    body.add(SpaceToDepthStem(_STEM_CHANNELS))
+                elif stem == "conv7":
+                    body.add(_conv(_STEM_CHANNELS, 7, 2, 3))
+                else:
+                    raise ValueError("stem must be 'conv7' or 's2d'")
                 body.add(nn.BatchNorm())
                 body.add(nn.Activation("relu"))
                 body.add(nn.MaxPool2D(3, 2, 1))

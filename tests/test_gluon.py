@@ -303,3 +303,61 @@ def test_contrib_multi_head_attention():
     cross = MultiHeadAttention(units=16, num_heads=2)
     cross.initialize()
     assert cross(x, kv, kv).shape == (2, 10, 16)
+
+
+def test_space_to_depth_stem_expresses_conv7():
+    """SpaceToDepthStem is a receptive-field superset of the classic
+    7x7/s2 stem: embedding a 7x7 kernel at the documented tap mapping
+    must reproduce the conv7 output exactly (the TPU MXU-utilization
+    stem variant, model_zoo resnet stem='s2d')."""
+    from mxnet_tpu.gluon.model_zoo.vision.resnet import SpaceToDepthStem
+    rs = np.random.RandomState(0)
+    C, O, H = 3, 5, 16
+    w7 = rs.randn(O, C, 7, 7).astype(np.float32) * 0.3
+    x = rs.randn(2, C, H, H).astype(np.float32)
+    xm = nd.array(x)
+
+    conv7 = nn.Conv2D(O, kernel_size=7, strides=2, padding=3,
+                      use_bias=False)
+    conv7.initialize()
+    conv7(xm)
+    conv7.weight.set_data(nd.array(w7))
+    ref = conv7(xm).asnumpy()
+
+    stem = SpaceToDepthStem(O)
+    stem.initialize()
+    stem(xm)
+    w4 = np.zeros((O, 4 * C, 4, 4), np.float32)
+    for a in range(2):
+        for b in range(2):
+            for c in range(C):
+                k = a * 2 * C + b * C + c
+                for dp in range(4):
+                    for dq in range(4):
+                        u, v = 2 * dp + a - 1, 2 * dq + b - 1
+                        if 0 <= u < 7 and 0 <= v < 7:
+                            w4[:, k, dp, dq] = w7[:, c, u, v]
+    stem.conv.weight.set_data(nd.array(w4))
+    out = stem(xm).asnumpy()
+    assert out.shape == ref.shape
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_resnet_s2d_stem_trains():
+    """stem='s2d' builds, matches the conv7 variant's output shape, and
+    backprops through the whole net."""
+    from mxnet_tpu.gluon.model_zoo import vision
+    x = nd.array(np.random.RandomState(1).randn(2, 3, 64, 64)
+                 .astype(np.float32))
+    net_a = vision.get_model("resnet18_v1", classes=7)
+    net_b = vision.get_model("resnet18_v1", classes=7, stem="s2d")
+    for net in (net_a, net_b):
+        net.initialize()
+    ya, yb = net_a(x), net_b(x)
+    assert ya.shape == yb.shape == (2, 7)
+    with autograd.record():
+        loss = nd.sum(nd.square(net_b(x)))
+    loss.backward()
+    g = net_b.collect_params()
+    got = [p.grad() for p in g.values() if p.grad_req != "null"]
+    assert any(float(nd.sum(nd.abs(gr)).asnumpy()) > 0 for gr in got)

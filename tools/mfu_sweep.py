@@ -41,6 +41,9 @@ def _decompose(peak, batch, iters):
         ("lbsgd_mp_coalesced", dict(optimizer="lbsgd",
                                     multi_precision=True,
                                     coalesce_small=True)),
+        ("lbsgd_mp_coal_s2d", dict(optimizer="lbsgd",
+                                   multi_precision=True,
+                                   coalesce_small=True, stem="s2d")),
     ]
     for name, kw in rows:
         try:

@@ -302,6 +302,7 @@ def main():
             pending.append(n)
 
     per_case_s = float(os.environ.get("CONSISTENCY_CASE_DEADLINE", 600))
+    zero_progress_crashes = 0
     while pending:
         rc, out = _spawn_abandonable(
             [sys.executable, os.path.abspath(__file__), "--child"]
@@ -337,7 +338,20 @@ def main():
                 break
             # child crashed mid-sweep: blame only the FIRST unfinished
             # case (the one it was running) and respawn for the rest —
-            # one bad case must not eat the remaining hardware window
+            # one bad case must not eat the remaining hardware window.
+            # But repeated crashes with ZERO cases completed mean the
+            # environment (not a case) is broken: stop journaling false
+            # per-case FAILs and abort so the journal stays resumable.
+            zero_progress_crashes = (0 if finished
+                                     else zero_progress_crashes + 1)
+            if zero_progress_crashes >= 3:
+                print("ABORT: %d consecutive child crashes with no "
+                      "case verdicts — environment failure, %d cases "
+                      "left un-run" % (zero_progress_crashes,
+                                       len(pending)), flush=True)
+                fail += len(pending)
+                pending = []
+                continue
             crashed = pending.pop(0)
             print("FAIL %s (child crashed rc=%s)" % (crashed, rc),
                   flush=True)
