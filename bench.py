@@ -211,6 +211,23 @@ def timed_resnet_train(batch, image, remat, iters, scan_n, warmup=2,
     x = mx.nd.array(rng.randn(batch, 3, image, image).astype(np.float32))
     y = mx.nd.array(rng.randint(0, 1000, (batch,)).astype(np.float32))
 
+    r = timed_train_steps(trainer, x, y, iters, scan_n, warmup)
+    if not r["flops_per_step"]:
+        # analytic fwd+bwd ResNet-50, scaled from the 224x224 figure
+        r["flops_per_step"] = 3 * 4.089e9 * batch * (image / 224.0) ** 2
+    r["img_s"] = batch * r["iters"] / r["dt"]
+    return r
+
+
+def timed_train_steps(trainer, x, y, iters, scan_n, warmup=2):
+    """Shared training-step timing harness (tools/benchmark_lm.py and
+    timed_resnet_train use it): scan_n steps chained by donation inside
+    ONE jit per host call, timed to a host readback of the final loss.
+    Returns {dt, iters, flops_per_step (None if cost analysis
+    unavailable), final_loss}."""
+    import jax
+    import jax.numpy as jnp
+
     for _ in range(max(1, warmup)):
         l = trainer.fit_batch(x, y)
     float(np.asarray(l))  # forced readback
@@ -229,22 +246,25 @@ def timed_resnet_train(batch, image, remat, iters, scan_n, warmup=2,
 
     multi_j = jax.jit(multi, donate_argnums=(0, 1, 2))
     xd = x._data
-    if trainer.multi_precision:
+    if trainer.multi_precision and jnp.issubdtype(xd.dtype, jnp.floating):
         xd = xd.astype(jnp.bfloat16)
     yd = y._data
+    # the trainer's OWN configured hyperparameters — this harness is
+    # shared (benchmark_lm runs lr=0.01), hard-coding resnet's 0.1
+    # would time steps the model never takes
+    lr = np.float32(trainer._current_lr())
+    t = np.int32(trainer._num_update + 1)
     p, s, a = trainer._params, trainer._opt_state, trainer._aux
-    p, s, a, l = multi_j(p, s, a, xd, yd, jax.random.PRNGKey(0),
-                         np.float32(0.1), np.int32(1))
+    p, s, a, l = multi_j(p, s, a, xd, yd, jax.random.PRNGKey(0), lr, t)
     float(np.asarray(l))  # warm the scanned executable
 
     t0 = time.perf_counter()
     for it in range(max(1, iters // scan_n)):
         p, s, a, l = multi_j(p, s, a, xd, yd,
-                             jax.random.PRNGKey(it + 1),
-                             np.float32(0.1), np.int32(1))
+                             jax.random.PRNGKey(it + 1), lr, t)
     final_loss = float(np.asarray(l))  # donation chains all timed steps
     dt = time.perf_counter() - t0
-    iters = max(1, iters // scan_n) * scan_n
+    n = max(1, iters // scan_n) * scan_n
     trainer._params, trainer._opt_state, trainer._aux = p, s, a
 
     # exact per-step FLOPs from the compiled program when available
@@ -253,19 +273,15 @@ def timed_resnet_train(batch, image, remat, iters, scan_n, warmup=2,
         ca = trainer._step_fn.lower(
             trainer._params, trainer._opt_state, trainer._aux,
             trainer._device_batch(x._data), y._data,
-            jax.random.PRNGKey(0), np.float32(0.1),
-            np.int32(1)).compile().cost_analysis()
+            jax.random.PRNGKey(0), lr, t).compile().cost_analysis()
         if isinstance(ca, (list, tuple)):
             ca = ca[0]
         if ca and "flops" in ca:
             flops = float(ca["flops"])
     except Exception:
         pass
-    if not flops:
-        # analytic fwd+bwd ResNet-50, scaled from the 224x224 figure
-        flops = 3 * 4.089e9 * batch * (image / 224.0) ** 2
-    return {"img_s": batch * iters / dt, "dt": dt, "iters": iters,
-            "flops_per_step": flops, "final_loss": final_loss}
+    return {"dt": dt, "iters": n, "flops_per_step": flops,
+            "final_loss": final_loss}
 
 
 def timed_scan_forward(eval_fn, params, aux, xd, extra, scan_n, iters,

@@ -32,15 +32,17 @@ def _decompose(peak, batch, iters):
     rows = [
         ("fwd_only", dict(fwd=True)),
         ("sgd_plain_f32", dict(optimizer="sgd", multi_precision=False,
-                               momentum=0.0)),
+                               momentum=0.0, stem="conv7")),
         ("sgd_mom_mp", dict(optimizer="sgd", multi_precision=True,
-                            momentum=0.9)),
+                            momentum=0.9, stem="conv7")),
         ("lbsgd_mp_percoparam", dict(optimizer="lbsgd",
                                      multi_precision=True,
-                                     coalesce_small=False)),
+                                     coalesce_small=False,
+                                     stem="conv7")),
         ("lbsgd_mp_coalesced", dict(optimizer="lbsgd",
                                     multi_precision=True,
-                                    coalesce_small=True)),
+                                    coalesce_small=True,
+                                    stem="conv7")),
         ("lbsgd_mp_coal_s2d", dict(optimizer="lbsgd",
                                    multi_precision=True,
                                    coalesce_small=True, stem="s2d")),
