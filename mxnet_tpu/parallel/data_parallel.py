@@ -149,14 +149,29 @@ class ParallelTrainer:
         self._opt_op = get_op(base_op)
         self._opt_n_states = n_states
 
-    def _gather_state(self):
+    def _gather_state(self, data_shape=None, label_shape=None):
         params = {p.name: p for p in self.net.collect_params().values()}
         repl = NamedSharding(self.mesh, P())
         self._resolve_opt()
         cdtype = jnp.bfloat16 if self.multi_precision else None
+        # graph arguments with no backing Parameter (e.g. the fused RNN
+        # op's auto-created begin-state vars) are zero-filled constant
+        # inputs, exactly like simple_bind's unbound-arg semantics —
+        # they get no optimizer state and pass through the step frozen
+        self._frozen = frozenset(
+            n for n in self.param_names if n not in params)
+        frozen_arrays = {}
+        if self._frozen:
+            frozen_arrays = self._infer_frozen(data_shape, label_shape)
+            self._frozen_built_for = (tuple(data_shape or ()),
+                                      tuple(label_shape or ()))
         self._params = {}
         self._opt_state = {}
         for n in self.param_names:
+            if n in self._frozen:
+                self._params[n] = jax.device_put(frozen_arrays[n], repl)
+                self._opt_state[n] = ()
+                continue
             arr = params[n].data()._data
             if cdtype is not None:
                 master = arr.astype(jnp.float32)
@@ -176,6 +191,41 @@ class ParallelTrainer:
                 jax.device_put(s, self._shard_for(s)) for s in states)
         self._aux = {n: jax.device_put(params[n].data()._data, repl)
                      for n in self.aux_names}
+
+    def _infer_frozen(self, data_shape, label_shape):
+        """Zero arrays for the frozen (non-Parameter) graph args at the
+        shapes inference yields for this batch geometry."""
+        params = {p.name: p for p in self.net.collect_params().values()}
+        cdtype = jnp.bfloat16 if self.multi_precision else None
+        shapes = {}
+        if data_shape is not None:
+            shapes["data0"] = tuple(data_shape)
+        if label_shape is not None:
+            shapes["label0"] = tuple(label_shape)
+        # every materialized Parameter shape is a known — only the
+        # frozen args are left for inference to solve
+        for pname, p in params.items():
+            shp = getattr(p, "shape", None)
+            if pname in self.param_names and shp and \
+                    all(int(s) > 0 for s in shp):
+                shapes[pname] = tuple(int(s) for s in shp)
+        arg_shapes, _, _ = self._graph.infer_shape(**shapes)
+        inferred = dict(zip(self._graph.list_arguments(), arg_shapes))
+        return {n: jnp.zeros(inferred[n], cdtype or jnp.float32)
+                for n in self._frozen}
+
+    def _refresh_frozen(self, x_shape, y_shape):
+        """Frozen begin-states are shaped by the batch geometry; a new
+        batch size means new zeros (the step retraces anyway)."""
+        if not self._frozen:
+            return
+        key = (tuple(x_shape), tuple(y_shape))
+        if key == self._frozen_built_for:
+            return
+        repl = NamedSharding(self.mesh, P())
+        for n, z in self._infer_frozen(x_shape, y_shape).items():
+            self._params[n] = jax.device_put(z, repl)
+        self._frozen_built_for = key
 
     def _shard_for(self, arr):
         ndp = self.mesh.shape.get("dp", 1)
@@ -208,7 +258,8 @@ class ParallelTrainer:
         if coalesce:
             _SMALL_MAX = 8192
             small = [n for n in self.param_names
-                     if self._params[n].size <= _SMALL_MAX]
+                     if n not in self._frozen
+                     and self._params[n].size <= _SMALL_MAX]
             coalesce = len(small) >= 2
         if coalesce:
             small_set = frozenset(small)
@@ -286,6 +337,7 @@ class ParallelTrainer:
             small_set = frozenset()
             _apply_small = None
 
+        frozen = self._frozen
         remat = self.remat
         if remat is not None:
             policy = None
@@ -313,7 +365,7 @@ class ParallelTrainer:
             if grad_clip is not None:
                 gnorm = jnp.sqrt(sum(
                     jnp.sum(jnp.square(g.astype(jnp.float32)))
-                    for g in grads.values()))
+                    for n, g in grads.items() if n not in frozen))
                 scale = jnp.minimum(1.0, grad_clip / (gnorm + 1e-8))
                 grads = {k: (g.astype(jnp.float32) * scale).astype(g.dtype)
                          for k, g in grads.items()}
@@ -323,6 +375,12 @@ class ParallelTrainer:
             if "t" in opt_op.param_names:
                 hp["t"] = t
             for n, w in params.items():
+                if n in frozen:
+                    # zero-filled non-Parameter graph inputs (RNN
+                    # begin-states): never updated
+                    new_params[n] = w
+                    new_state[n] = ()
+                    continue
                 if n in small_set:
                     continue
                 g = grads[n]
@@ -399,7 +457,7 @@ class ParallelTrainer:
         if self._step_fn is None:
             self.net._ensure_params(NDArray(x))
             self._trace(x, y)
-            self._gather_state()
+            self._gather_state(data_shape=x.shape, label_shape=y.shape)
             self._build_step()
 
     def _device_batch(self, x):
@@ -422,6 +480,7 @@ class ParallelTrainer:
         if isinstance(y, NDArray):
             y = y._data
         self._ensure_built(x, y)
+        self._refresh_frozen(x.shape, y.shape)
         xd = self._device_batch(x)
         yd = jax.device_put(y, NamedSharding(self.mesh, P("dp")))
         self._key, sub = jax.random.split(self._key)
@@ -558,6 +617,8 @@ class ParallelTrainer:
         import numpy as _np
         params = {p.name: p for p in self.net.collect_params().values()}
         for n, arr in self._params.items():
+            if n in self._frozen:
+                continue  # zero-filled graph inputs, no Parameter behind
             if self.multi_precision:
                 arr = self._opt_state[n][-1]   # f32 master copy
             params[n].data()._data = jnp.asarray(_np.asarray(arr))

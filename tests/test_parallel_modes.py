@@ -260,3 +260,78 @@ def test_coalesced_small_param_apply_matches_per_param():
                 np.asarray(ta._params[na], dtype=np.float32),
                 np.asarray(tb._params[nb], dtype=np.float32),
                 rtol=3e-3 if mp else 1e-5, atol=3e-3 if mp else 1e-6)
+
+
+def test_parallel_trainer_rnn_frozen_begin_states():
+    """Graph args with no backing Parameter (the fused RNN op's
+    auto-created begin-state vars) are zero-filled frozen inputs under
+    ParallelTrainer — simple_bind's unbound-arg semantics at the
+    compiled-step layer (tools/benchmark_lm.py --arch lstm path)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn, rnn
+    from mxnet_tpu.gluon.block import HybridBlock
+    from mxnet_tpu.parallel.data_parallel import ParallelTrainer
+
+    class LSTMLM(HybridBlock):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            with self.name_scope():
+                self.embed = nn.Embedding(30, 16)
+                self.lstm = rnn.LSTM(16, num_layers=2, layout="NTC")
+                self.head = nn.Dense(30, use_bias=False, flatten=False)
+
+        def hybrid_forward(self, F, x):
+            return self.head(self.lstm(self.embed(x)))
+
+    net = LSTMLM()
+    net.initialize()
+    tr = ParallelTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                         optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.1,
+                                           "momentum": 0.9},
+                         mesh=make_mesh({"dp": 8}))
+    rs = np.random.RandomState(0)
+    x = mx.nd.array(rs.randint(0, 30, (8, 12)).astype(np.float32))
+    y = mx.nd.array(rs.randint(0, 30, (8, 12)).astype(np.float32))
+    losses = [float(np.asarray(tr.fit_batch(x, y))) for _ in range(6)]
+    assert losses[-1] < losses[0]
+    # the begin-state args stayed frozen zeros with empty opt state
+    assert tr._frozen
+    for n in tr._frozen:
+        assert tr._opt_state[n] == ()
+        assert float(jnp.sum(jnp.abs(tr._params[n]))) == 0.0
+
+
+def test_parallel_trainer_frozen_states_batch_resize():
+    """A different batch size rebuilds the frozen begin-state zeros
+    (jit retraces; the frozen inputs must follow the batch geometry)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn, rnn
+    from mxnet_tpu.gluon.block import HybridBlock
+    from mxnet_tpu.parallel.data_parallel import ParallelTrainer
+
+    class Tiny(HybridBlock):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            with self.name_scope():
+                self.embed = nn.Embedding(20, 8)
+                self.lstm = rnn.LSTM(8, num_layers=1, layout="NTC")
+                self.head = nn.Dense(20, use_bias=False, flatten=False)
+
+        def hybrid_forward(self, F, x):
+            return self.head(self.lstm(self.embed(x)))
+
+    net = Tiny()
+    net.initialize()
+    tr = ParallelTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                         optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.1},
+                         mesh=make_mesh({"dp": 8}))
+    rs = np.random.RandomState(0)
+    for bs in (16, 8, 16):
+        x = mx.nd.array(rs.randint(0, 20, (bs, 6)).astype(np.float32))
+        y = mx.nd.array(rs.randint(0, 20, (bs, 6)).astype(np.float32))
+        loss = float(np.asarray(tr.fit_batch(x, y)))
+        assert np.isfinite(loss)

@@ -28,8 +28,33 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
+def _lstm_lm(vocab, dim, layers):
+    """Embedding + fused-RNN LSTM stack + head — the reference's own
+    LM headline shape (example/rnn, fused rnn op → lax.scan here)."""
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn, rnn
+    from mxnet_tpu.gluon.block import HybridBlock
+
+    class LSTMLM(HybridBlock):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            with self.name_scope():
+                self.embed = nn.Embedding(vocab, dim)
+                self.lstm = rnn.LSTM(dim, num_layers=layers,
+                                     layout="NTC")
+                self.head = nn.Dense(vocab, use_bias=False,
+                                     flatten=False)
+
+        def hybrid_forward(self, F, x):
+            return self.head(self.lstm(self.embed(x)))
+
+    return LSTMLM()
+
+
 def main():
     ap = argparse.ArgumentParser()
+    ap.add_argument("--arch", default="transformer",
+                    choices=["transformer", "lstm"])
     ap.add_argument("--dim", type=int, default=1024)
     ap.add_argument("--heads", type=int, default=16)
     ap.add_argument("--layers", type=int, default=12)
@@ -54,10 +79,15 @@ def main():
         args.dim, args.heads, args.layers = 64, 4, 2
         args.seq, args.batch, args.vocab = 128, 2, 64
         args.iters, args.scan = 4, 2
-
-    net = get_transformer_lm(vocab=args.vocab, dim=args.dim,
-                             heads=args.heads, layers=args.layers,
-                             max_seq=max(args.seq, 16))
+    if args.arch == "lstm":
+        # reference LSTM-LM shapes: 2x650 medium / 2x1500 large PTB
+        n_layers = max(2, args.layers // 6)
+        net = _lstm_lm(args.vocab, args.dim, n_layers)
+    else:
+        n_layers = args.layers
+        net = get_transformer_lm(vocab=args.vocab, dim=args.dim,
+                                 heads=args.heads, layers=args.layers,
+                                 max_seq=max(args.seq, 16))
     net.initialize()
     trainer = ParallelTrainer(
         net, gluon.loss.SoftmaxCrossEntropyLoss(),
@@ -67,8 +97,12 @@ def main():
         multi_precision=on_tpu)
 
     rng = np.random.RandomState(0)
+    # token ids travel as int32: a float32 id cast to bf16 by the
+    # multi-precision input path rounds to multiples of 128 above 256,
+    # silently corrupting every embedding lookup (integer dtypes are
+    # exempt from the compute-dtype cast)
     x = mx.nd.array(rng.randint(0, args.vocab, (args.batch, args.seq))
-                    .astype(np.float32))
+                    .astype(np.int32), dtype="int32")
     y = mx.nd.array(rng.randint(0, args.vocab, (args.batch, args.seq))
                     .astype(np.float32))
 
@@ -78,17 +112,23 @@ def main():
     tok_s = tokens * r["iters"] / r["dt"]
     flops = r["flops_per_step"]
     if not flops:
-        # 6*P per token (fwd+bwd) + attention 12*S*D per token term
-        p_count = (args.vocab * args.dim * 2
-                   + args.layers * 12 * args.dim * args.dim)
-        flops = tokens * (6.0 * p_count
-                          + 12.0 * args.layers * args.seq * args.dim)
+        # 6*P per token (fwd+bwd); transformer adds the attention
+        # 12*S*D-per-token term, lstm has 8*D^2 params per layer
+        if args.arch == "lstm":
+            p_count = (args.vocab * args.dim * 2
+                       + n_layers * 8 * args.dim * args.dim)
+            flops = tokens * 6.0 * p_count
+        else:
+            p_count = (args.vocab * args.dim * 2
+                       + n_layers * 12 * args.dim * args.dim)
+            flops = tokens * (6.0 * p_count
+                              + 12.0 * n_layers * args.seq * args.dim)
     out = {
-        "metric": "transformer_lm_train",
+        "metric": "%s_lm_train" % args.arch,
         "tokens_per_s": round(tok_s, 1),
         "ms_per_step": round(r["dt"] / r["iters"] * 1e3, 2),
         "batch": args.batch, "seq": args.seq, "dim": args.dim,
-        "heads": args.heads, "layers": args.layers,
+        "heads": args.heads, "layers": n_layers,
         "flops_per_step": flops,
         "final_loss": r["final_loss"],
         "device": getattr(dev, "device_kind", str(dev)),
