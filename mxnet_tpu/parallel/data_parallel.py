@@ -214,11 +214,16 @@ class ParallelTrainer:
         return {n: jnp.zeros(inferred[n], cdtype or jnp.float32)
                 for n in self._frozen}
 
-    def _refresh_frozen(self, x_shape, y_shape):
+    def _refresh_frozen(self, x_shape, y_shape=None):
         """Frozen begin-states are shaped by the batch geometry; a new
-        batch size means new zeros (the step retraces anyway)."""
+        batch size means new zeros (the step retraces anyway).  With no
+        label (predict), the label shape is derived from the stored one
+        at the new batch size."""
         if not self._frozen:
             return
+        if y_shape is None:
+            tail = self._frozen_built_for[1][1:]
+            y_shape = (tuple(x_shape)[0],) + tuple(tail)
         key = (tuple(x_shape), tuple(y_shape))
         if key == self._frozen_built_for:
             return
@@ -504,6 +509,7 @@ class ParallelTrainer:
         if isinstance(y, NDArray):
             y = y._data
         self._ensure_built(x, y)
+        self._refresh_frozen(x.shape, y.shape)
         xd = self._device_batch(x)
         yd = jax.device_put(y, NamedSharding(self.mesh, P("dp")))
         return self._eval_fn(self._params, self._aux, xd, yd,
@@ -515,6 +521,7 @@ class ParallelTrainer:
             x = x._data
         if self._step_fn is None:
             raise RuntimeError("run fit_batch or evaluate_batch first")
+        self._refresh_frozen(x.shape)
         xd = self._device_batch(x)
         return NDArray(self._predict_fn(self._params, self._aux, xd,
                                         jax.random.PRNGKey(0)))
@@ -587,6 +594,8 @@ class ParallelTrainer:
                                     (aux, self._aux)):
                 for old in tables:
                     new = remap[old]
+                    if new in self._frozen:
+                        continue  # batch-geometry zeros, not restored
                     if tuple(tables[old].shape) != \
                             tuple(current[new].shape):
                         raise ValueError(
@@ -599,9 +608,13 @@ class ParallelTrainer:
             aux = {remap[n]: a for n, a in aux.items()}
         # commit atomically only after every check passed; stateless
         # optimizers (plain sgd) save no opt entries and restore to
-        # empty per-param tuples
-        self._params = {n: jax.device_put(a, self._shard_for(a))
-                        for n, a in params.items()}
+        # empty per-param tuples.  Frozen begin-state args keep the
+        # CURRENT zeros: the checkpoint may have been written at a
+        # different batch size, and they are always zeros anyway.
+        self._params = {
+            n: (self._params[n] if n in self._frozen
+                else jax.device_put(a, self._shard_for(a)))
+            for n, a in params.items()}
         self._opt_state = {
             n: tuple(jax.device_put(slots[i], self._shard_for(slots[i]))
                      for i in sorted(slots))

@@ -173,3 +173,28 @@ def test_proposal_shapes():
     assert rois.shape == (10, 5)
     r = rois.asnumpy()
     assert (r[:, 1:] >= 0).all() and (r[:, 1:] <= 63).all()
+
+
+def test_ssd300_reference_anchor_grid():
+    """The SSD-300/VGG16-reduced graph reproduces the reference's
+    anchor geometry: 8732 boxes over six scales, detection output
+    (B, 8732, 6), and the training graph's target/loss heads infer
+    cleanly (example/ssd/symbol parity at the architecture level)."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "examples"))
+    from ssd_model import build_ssd300_infer, build_ssd300_train
+
+    infer = build_ssd300_infer(num_classes=20)
+    _, outs, _ = infer.infer_shape(data0=(2, 3, 300, 300))
+    assert outs == [(2, 8732, 6)]
+
+    train = build_ssd300_train(num_classes=20)
+    _, touts, _ = train.infer_shape(data0=(2, 3, 300, 300),
+                                    label=(2, 1, 5))
+    # cls softmax over (B*A, C+1), smooth-l1 over (B, A*4), anchors
+    assert touts[0] == (2 * 8732, 21)
+    assert touts[1] == (2, 8732 * 4)
+    assert touts[2] == (1, 8732, 4)

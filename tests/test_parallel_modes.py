@@ -269,22 +269,10 @@ def test_parallel_trainer_rnn_frozen_begin_states():
     compiled-step layer (tools/benchmark_lm.py --arch lstm path)."""
     import mxnet_tpu as mx
     from mxnet_tpu import gluon
-    from mxnet_tpu.gluon import nn, rnn
-    from mxnet_tpu.gluon.block import HybridBlock
+    from mxnet_tpu.gluon.model_zoo.lm import get_lstm_lm
     from mxnet_tpu.parallel.data_parallel import ParallelTrainer
 
-    class LSTMLM(HybridBlock):
-        def __init__(self, **kwargs):
-            super().__init__(**kwargs)
-            with self.name_scope():
-                self.embed = nn.Embedding(30, 16)
-                self.lstm = rnn.LSTM(16, num_layers=2, layout="NTC")
-                self.head = nn.Dense(30, use_bias=False, flatten=False)
-
-        def hybrid_forward(self, F, x):
-            return self.head(self.lstm(self.embed(x)))
-
-    net = LSTMLM()
+    net = get_lstm_lm(30, 16, 2)
     net.initialize()
     tr = ParallelTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
                          optimizer="sgd",
@@ -308,22 +296,10 @@ def test_parallel_trainer_frozen_states_batch_resize():
     (jit retraces; the frozen inputs must follow the batch geometry)."""
     import mxnet_tpu as mx
     from mxnet_tpu import gluon
-    from mxnet_tpu.gluon import nn, rnn
-    from mxnet_tpu.gluon.block import HybridBlock
+    from mxnet_tpu.gluon.model_zoo.lm import get_lstm_lm
     from mxnet_tpu.parallel.data_parallel import ParallelTrainer
 
-    class Tiny(HybridBlock):
-        def __init__(self, **kwargs):
-            super().__init__(**kwargs)
-            with self.name_scope():
-                self.embed = nn.Embedding(20, 8)
-                self.lstm = rnn.LSTM(8, num_layers=1, layout="NTC")
-                self.head = nn.Dense(20, use_bias=False, flatten=False)
-
-        def hybrid_forward(self, F, x):
-            return self.head(self.lstm(self.embed(x)))
-
-    net = Tiny()
+    net = get_lstm_lm(20, 8, 1)
     net.initialize()
     tr = ParallelTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
                          optimizer="sgd",

@@ -29,26 +29,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 
 def _lstm_lm(vocab, dim, layers):
-    """Embedding + fused-RNN LSTM stack + head — the reference's own
-    LM headline shape (example/rnn, fused rnn op → lax.scan here)."""
-    from mxnet_tpu import gluon
-    from mxnet_tpu.gluon import nn, rnn
-    from mxnet_tpu.gluon.block import HybridBlock
-
-    class LSTMLM(HybridBlock):
-        def __init__(self, **kwargs):
-            super().__init__(**kwargs)
-            with self.name_scope():
-                self.embed = nn.Embedding(vocab, dim)
-                self.lstm = rnn.LSTM(dim, num_layers=layers,
-                                     layout="NTC")
-                self.head = nn.Dense(vocab, use_bias=False,
-                                     flatten=False)
-
-        def hybrid_forward(self, F, x):
-            return self.head(self.lstm(self.embed(x)))
-
-    return LSTMLM()
+    from mxnet_tpu.gluon.model_zoo.lm import get_lstm_lm
+    return get_lstm_lm(vocab, dim, layers)
 
 
 def main():
