@@ -61,7 +61,19 @@ if [ "${1:-}" != "fast" ]; then
   step decompose timeout 3600 python tools/mfu_sweep.py --decompose
 fi
 
-# 5. the round benchmark (VERDICT item 1) — also what the driver runs
+# 5. headline benches beyond ResNet: inference score table (fp + int8),
+#    transformer + lstm LM, SSD-300 detection
+if [ "${1:-}" != "fast" ]; then
+  step score timeout 3600 python tools/benchmark_score.py
+  step score_int8 timeout 1800 python tools/benchmark_score.py \
+      --models resnet50_v1 --batches 32 128 --dtype int8
+  step lm timeout 1800 python tools/benchmark_lm.py
+  step lm_lstm timeout 1800 python tools/benchmark_lm.py --arch lstm \
+      --dim 650 --seq 512 --batch 32
+  step ssd timeout 1800 python tools/benchmark_ssd.py
+fi
+
+# 6. the round benchmark (VERDICT item 1) — also what the driver runs
 step bench timeout 5400 python bench.py
 tail -1 "$OUT/bench.$RUN.log" > "$OUT/bench.json" 2>/dev/null
 
