@@ -10,3 +10,4 @@ from .sequence import (ring_attention_shard,  # noqa: F401
                        sequence_parallel_attention)
 from .pipeline import pipeline_apply  # noqa: F401
 from .moe import moe_apply  # noqa: F401
+from . import multihost  # noqa: F401

@@ -82,6 +82,12 @@ class ParallelTrainer:
         self.opt_name = optimizer
         self.opt_params = dict(optimizer_params or {})
         self.shard_params = shard_params
+        from .multihost import is_multihost_mesh
+        self._multihost = is_multihost_mesh(self.mesh)
+        if shard_params and self._multihost:
+            raise NotImplementedError(
+                "shard_params (ZeRO) over a multi-host mesh needs "
+                "host-local shard feeding; use replicated params")
         self.grad_clip = grad_clip
         self.multi_precision = multi_precision
         # coalesce_small: apply the optimizer (and the LARS trust-ratio
@@ -169,7 +175,7 @@ class ParallelTrainer:
         self._opt_state = {}
         for n in self.param_names:
             if n in self._frozen:
-                self._params[n] = jax.device_put(frozen_arrays[n], repl)
+                self._params[n] = self._put(frozen_arrays[n], P())
                 self._opt_state[n] = ()
                 continue
             arr = params[n].data()._data
@@ -186,10 +192,10 @@ class ParallelTrainer:
                 # neither promote nor retrace
                 states = [jnp.zeros_like(arr)
                           for _ in range(self._opt_n_states)]
-            self._params[n] = jax.device_put(arr, self._shard_for(arr))
+            self._params[n] = self._put(arr, self._spec_for(arr))
             self._opt_state[n] = tuple(
-                jax.device_put(s, self._shard_for(s)) for s in states)
-        self._aux = {n: jax.device_put(params[n].data()._data, repl)
+                self._put(s, self._spec_for(s)) for s in states)
+        self._aux = {n: self._put(params[n].data()._data, P())
                      for n in self.aux_names}
 
     def _infer_frozen(self, data_shape, label_shape):
@@ -197,7 +203,19 @@ class ParallelTrainer:
         shapes inference yields for this batch geometry."""
         params = {p.name: p for p in self.net.collect_params().values()}
         cdtype = jnp.bfloat16 if self.multi_precision else None
+
+        def _global(shape):
+            # callers pass HOST-LOCAL batch shapes; the compiled step
+            # sees the global batch (rows concatenated across hosts)
+            if shape is None or not self._multihost:
+                return shape
+            shape = tuple(shape)
+            import jax as _jax
+            return (shape[0] * _jax.process_count(),) + shape[1:]
+
         shapes = {}
+        data_shape = _global(data_shape)
+        label_shape = _global(label_shape)
         if data_shape is not None:
             shapes["data0"] = tuple(data_shape)
         if label_shape is not None:
@@ -227,17 +245,32 @@ class ParallelTrainer:
         key = (tuple(x_shape), tuple(y_shape))
         if key == self._frozen_built_for:
             return
-        repl = NamedSharding(self.mesh, P())
         for n, z in self._infer_frozen(x_shape, y_shape).items():
-            self._params[n] = jax.device_put(z, repl)
+            self._params[n] = self._put(z, P())
         self._frozen_built_for = key
 
-    def _shard_for(self, arr):
+    def _put(self, arr, spec):
+        """Place an array at (mesh, spec).  On a mesh spanning several
+        processes, device_put cannot move bytes across hosts — instead
+        every process contributes its local copy/shard
+        (multihost_utils), which is the SPMD contract: replicated
+        values must already be identical on every host (same init
+        seed), sharded values must be the host-local rows."""
+        if self._mesh_is_multihost():
+            from .multihost import host_local_to_global
+            return host_local_to_global(jnp.asarray(arr), self.mesh,
+                                        spec)
+        return jax.device_put(arr, NamedSharding(self.mesh, spec))
+
+    def _spec_for(self, arr):
         ndp = self.mesh.shape.get("dp", 1)
         if self.shard_params and arr.ndim >= 1 and \
                 arr.shape[0] % ndp == 0 and arr.shape[0] >= ndp:
-            return NamedSharding(self.mesh, P("dp"))
-        return NamedSharding(self.mesh, P())
+            return P("dp")
+        return P()
+
+    def _shard_for(self, arr):
+        return NamedSharding(self.mesh, self._spec_for(arr))
 
     # -- compiled step -----------------------------------------------------
     def _build_step(self):
@@ -476,7 +509,17 @@ class ParallelTrainer:
         # the batch array a previous step produced) — skip the transfer
         if isinstance(x, jax.Array) and getattr(x, "sharding", None) == sh:
             return x
-        return jax.device_put(x, sh)
+        # on a multihost mesh each process feeds only ITS rows and
+        # _put assembles the global batch (multihost feeding contract)
+        return self._put(x, P("dp"))
+
+    def _mesh_is_multihost(self):
+        return self._multihost
+
+    def _label_batch(self, y):
+        if isinstance(y, NDArray):
+            y = y._data
+        return self._put(y, P("dp"))
 
     def fit_batch(self, x, y):
         """Run one training step; returns the (replicated) mean loss."""
@@ -487,7 +530,7 @@ class ParallelTrainer:
         self._ensure_built(x, y)
         self._refresh_frozen(x.shape, y.shape)
         xd = self._device_batch(x)
-        yd = jax.device_put(y, NamedSharding(self.mesh, P("dp")))
+        yd = self._label_batch(y)
         self._key, sub = jax.random.split(self._key)
         lr = jnp.asarray(self._current_lr(), jnp.float32)
         t = jnp.asarray(self._num_update + 1, jnp.int32)
@@ -511,7 +554,7 @@ class ParallelTrainer:
         self._ensure_built(x, y)
         self._refresh_frozen(x.shape, y.shape)
         xd = self._device_batch(x)
-        yd = jax.device_put(y, NamedSharding(self.mesh, P("dp")))
+        yd = self._label_batch(y)
         return self._eval_fn(self._params, self._aux, xd, yd,
                              jax.random.PRNGKey(0))
 
@@ -523,8 +566,14 @@ class ParallelTrainer:
             raise RuntimeError("run fit_batch or evaluate_batch first")
         self._refresh_frozen(x.shape)
         xd = self._device_batch(x)
-        return NDArray(self._predict_fn(self._params, self._aux, xd,
-                                        jax.random.PRNGKey(0)))
+        out = self._predict_fn(self._params, self._aux, xd,
+                               jax.random.PRNGKey(0))
+        if self._multihost:
+            # hand each process back ITS rows (the dp-sharded global
+            # output is not locally addressable)
+            from .multihost import global_to_host_local
+            out = global_to_host_local(out, self.mesh, P("dp"))
+        return NDArray(out)
 
     # -- checkpoint / resume -------------------------------------------------
     def save_checkpoint(self, prefix, epoch=0):
@@ -613,14 +662,13 @@ class ParallelTrainer:
         # different batch size, and they are always zeros anyway.
         self._params = {
             n: (self._params[n] if n in self._frozen
-                else jax.device_put(a, self._shard_for(a)))
+                else self._put(a, self._spec_for(a)))
             for n, a in params.items()}
         self._opt_state = {
-            n: tuple(jax.device_put(slots[i], self._shard_for(slots[i]))
+            n: tuple(self._put(slots[i], self._spec_for(slots[i]))
                      for i in sorted(slots))
             for n, slots in ((n, opt.get(n, {})) for n in params)}
-        repl = NamedSharding(self.mesh, P())
-        self._aux = {n: jax.device_put(a, repl) for n, a in aux.items()}
+        self._aux = {n: self._put(a, P()) for n, a in aux.items()}
         self._num_update = num_update
 
     # -- sync back to gluon parameters --------------------------------------

@@ -1,0 +1,129 @@
+"""Multi-host (multi-process) training over one global mesh
+(parallel.multihost; SURVEY §5.8 — the DCN-scale story the reference
+covers with ps-lite worker processes).
+
+Two REAL processes x 4 virtual CPU devices join a jax.distributed
+coordinator bootstrapped from the reference's DMLC_* env names, build
+one 8-device global mesh, and train data-parallel with each process
+feeding only its half of the batch.  The per-step losses must be
+identical across processes (replicated SPMD state) AND match a
+single-process run over the same global batch."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHILD = r"""
+import os, sys
+pid = int(sys.argv[1])
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["DMLC_PS_ROOT_URI"] = "localhost"
+os.environ["DMLC_PS_ROOT_PORT"] = sys.argv[2]
+os.environ["DMLC_NUM_WORKER"] = "2"
+os.environ["DMLC_WORKER_ID"] = str(pid)
+sys.path.insert(0, %(repo)r)
+import jax
+jax.config.update("jax_platforms", "cpu")
+from mxnet_tpu.parallel import multihost
+assert multihost.init_multihost()
+assert multihost.process_count() == 2
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.parallel.data_parallel import ParallelTrainer
+
+mesh = multihost.global_mesh({"dp": -1})
+assert len(list(mesh.devices.flat)) == 8
+assert multihost.is_multihost_mesh(mesh)
+net = nn.HybridSequential()
+net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+net.initialize()
+tr = ParallelTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                     optimizer="sgd",
+                     optimizer_params={"learning_rate": 0.1},
+                     mesh=mesh)
+rs = np.random.RandomState(0)
+Xg = rs.randn(16, 8).astype(np.float32)
+Yg = rs.randint(0, 4, (16,)).astype(np.float32)
+lo = slice(pid * 8, (pid + 1) * 8)
+x = mx.nd.array(Xg[lo]); y = mx.nd.array(Yg[lo])
+losses = [float(np.asarray(tr.fit_batch(x, y))) for _ in range(5)]
+print("LOSSES", " ".join("%%.7f" %% l for l in losses), flush=True)
+# predict returns THIS process's rows of the global output
+pred = tr.predict_batch(x)
+assert np.asarray(pred._data).shape == (8, 4)
+# frozen begin-states (fused RNN) follow the GLOBAL batch geometry
+from mxnet_tpu.gluon.model_zoo.lm import get_lstm_lm
+lnet = get_lstm_lm(12, 8, 1)
+lnet.initialize()
+ltr = ParallelTrainer(lnet, gluon.loss.SoftmaxCrossEntropyLoss(),
+                      optimizer="sgd",
+                      optimizer_params={"learning_rate": 0.1},
+                      mesh=mesh)
+xt = mx.nd.array(rs.randint(0, 12, (8, 4)).astype(np.float32))
+yt = mx.nd.array(rs.randint(0, 12, (8, 4)).astype(np.float32))
+l0 = float(np.asarray(ltr.fit_batch(xt, yt)))
+assert np.isfinite(l0) and ltr._frozen
+print("FROZEN-OK", flush=True)
+""" % {"repo": _REPO}
+
+
+def _single_process_reference():
+    """Same model/batch on this process's own 8-device mesh."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import make_mesh
+    from mxnet_tpu.parallel.data_parallel import ParallelTrainer
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize()
+    tr = ParallelTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                         optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.1},
+                         mesh=make_mesh({"dp": 8}))
+    rs = np.random.RandomState(0)
+    Xg = rs.randn(16, 8).astype(np.float32)
+    Yg = rs.randint(0, 4, (16,)).astype(np.float32)
+    x = mx.nd.array(Xg)
+    y = mx.nd.array(Yg)
+    return [float(np.asarray(tr.fit_batch(x, y))) for _ in range(5)]
+
+
+@pytest.mark.timeout(600)
+def test_two_process_global_mesh_matches_single_process():
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = str(s.getsockname()[1])
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _CHILD, str(pid), port],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for pid in (0, 1)]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=540)
+        outs.append(out)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-2000:]
+    losses = []
+    for out in outs:
+        line = [ln for ln in out.splitlines()
+                if ln.startswith("LOSSES")][0]
+        losses.append([float(v) for v in line.split()[1:]])
+    # both processes observe the identical replicated loss curve
+    np.testing.assert_allclose(losses[0], losses[1], rtol=0, atol=0)
+    # and it matches single-process training on the same global batch
+    # (init RNG is per-process deterministic, so weights start equal)
+    ref = _single_process_reference()
+    np.testing.assert_allclose(losses[0], ref, rtol=1e-5, atol=1e-6)
