@@ -99,19 +99,14 @@ def _platform_devices(platform):
     (multi-host) ``jax.devices()`` is the global list including peers'
     non-addressable devices; a Context must always name a local one."""
     try:
-        devs = jax.devices(platform)
+        return jax.local_devices(backend=platform)
     except RuntimeError:
         return []
-    me = getattr(jax, "process_index", lambda: 0)()
-    local = [d for d in devs if d.process_index == me]
-    return local or devs
 
 
 def _accelerator_devices():
     """This process's devices of the default (non-cpu) platform, else cpu."""
-    me = getattr(jax, "process_index", lambda: 0)()
-    devs = [d for d in jax.devices() if d.process_index == me] \
-        or jax.devices()
+    devs = jax.local_devices()
     non_cpu = [d for d in devs if d.platform != "cpu"]
     return non_cpu if non_cpu else devs
 

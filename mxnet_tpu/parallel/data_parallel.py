@@ -88,6 +88,28 @@ class ParallelTrainer:
             raise NotImplementedError(
                 "shard_params (ZeRO) over a multi-host mesh needs "
                 "host-local shard feeding; use replicated params")
+        if self._multihost:
+            # the host-local batch contract assumes processes partition
+            # the mesh ALONG dp: every device's owning process must be
+            # a function of its dp coordinate alone (frozen-state
+            # scaling and host_local_to_global both build on it)
+            import numpy as _onp
+            names = list(self.mesh.axis_names)
+            if "dp" not in names:
+                raise NotImplementedError(
+                    "a multi-host mesh needs a 'dp' axis spanning the "
+                    "processes (got axes %s)" % names)
+            dp_axis = names.index("dp")
+            owner_of_dp = {}
+            for idx, dev in _onp.ndenumerate(self.mesh.devices):
+                prev = owner_of_dp.setdefault(idx[dp_axis],
+                                              dev.process_index)
+                if prev != dev.process_index:
+                    raise NotImplementedError(
+                        "multi-host meshes must span processes along "
+                        "the dp axis only (dp index %d maps to "
+                        "processes %d and %d)"
+                        % (idx[dp_axis], prev, dev.process_index))
         self.grad_clip = grad_clip
         self.multi_precision = multi_precision
         # coalesce_small: apply the optimizer (and the LARS trust-ratio

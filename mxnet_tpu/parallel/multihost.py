@@ -12,7 +12,7 @@ launcher bootstraps this path unchanged:
 
 - DMLC_PS_ROOT_URI / DMLC_PS_ROOT_PORT -> coordinator address
 - DMLC_NUM_WORKER                      -> process count
-- DMLC_WORKER_ID (or DMLC_RANK)        -> process id
+- DMLC_WORKER_ID / DMLC_WORKER_RANK    -> process id
 - or the jax-native COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID
 
 Typical flow (each process)::
@@ -35,7 +35,6 @@ from __future__ import annotations
 import os
 
 import jax
-import numpy as _np
 
 __all__ = ["init_multihost", "global_mesh", "host_local_to_global",
            "global_to_host_local", "is_multihost_mesh",
@@ -61,8 +60,10 @@ def init_multihost(coordinator=None, num_processes=None,
         num_processes = int(env.get("DMLC_NUM_WORKER",
                                     env.get("NUM_PROCESSES", 0)) or 0)
     if process_id is None:
-        pid = env.get("DMLC_WORKER_ID", env.get("DMLC_RANK",
-                      env.get("PROCESS_ID")))
+        pid = env.get("DMLC_WORKER_ID",
+                      env.get("DMLC_WORKER_RANK",
+                              env.get("DMLC_RANK",
+                                      env.get("PROCESS_ID"))))
         process_id = int(pid) if pid is not None else None
     if num_processes in (0, 1):
         return False

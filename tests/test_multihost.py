@@ -127,3 +127,22 @@ def test_two_process_global_mesh_matches_single_process():
     # (init RNG is per-process deterministic, so weights start equal)
     ref = _single_process_reference()
     np.testing.assert_allclose(losses[0], ref, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.timeout(600)
+def test_launcher_no_server_mode_runs_multihost_example():
+    """tools/launch.py -n 2 -s 0 bootstraps a pure jax.distributed
+    worker group (no parameter servers) running
+    examples/train_multihost.py to convergence on both ranks."""
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["JAX_PLATFORMS"] = "cpu"
+    p = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "launch.py"),
+         "-n", "2", "-s", "0", "--", sys.executable,
+         os.path.join(_REPO, "examples", "train_multihost.py"),
+         "--num-steps", "12"],
+        capture_output=True, text=True, timeout=540, env=env,
+        cwd=_REPO)
+    assert p.returncode == 0, (p.stdout[-1500:], p.stderr[-1500:])
+    assert p.stdout.count("MULTIHOST-TRAIN-OK") == 2, p.stdout[-1500:]

@@ -58,8 +58,10 @@ def main(argv=None):
     args = parser.parse_args(argv)
     if not args.command:
         parser.error("no command given")
-    if args.num_servers < 1:
-        parser.error("-s/--num-servers must be >= 1")
+    if args.num_servers < 0:
+        parser.error("-s/--num-servers must be >= 0 (0 = no parameter "
+                     "servers: a pure jax.distributed worker group, "
+                     "parallel.multihost)")
     command = args.command
     if command[0] == "--":
         command = command[1:]
@@ -86,12 +88,14 @@ def main(argv=None):
             servers.append(("server%d" % i, subprocess.Popen(
                 command, env=env)))
         procs.extend(servers)
-        time.sleep(0.3)  # let the root server bind before workers connect
+        if servers:
+            time.sleep(0.3)  # let the root server bind first
         workers = []
         for i in range(args.num_workers):
             env = dict(base_env)
             env["DMLC_ROLE"] = "worker"
             env["DMLC_WORKER_RANK"] = str(i)
+            env["DMLC_WORKER_ID"] = str(i)
             p = subprocess.Popen(command, env=env)
             workers.append(("worker%d" % i, p))
         procs.extend(workers)
