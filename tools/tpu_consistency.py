@@ -132,6 +132,40 @@ def _cases(mx):
     add("attention_causal", s.contrib.DotProductAttention(
         s.var("q"), s.var("k"), s.var("v"), causal=True),
         {"q": (1, 2, 32, 8), "k": (1, 2, 32, 8), "v": (1, 2, 32, 8)})
+
+    # --- session-2 additions: remaining op families ---------------------
+    add("conv_depthwise", s.Convolution(
+        d, num_filter=6, kernel=(3, 3), pad=(1, 1), num_group=6,
+        name="dwc"), {"data": (2, 6, 8, 8)})
+    add("conv_dilated", s.Convolution(
+        d, num_filter=4, kernel=(3, 3), pad=(2, 2), dilate=(2, 2),
+        name="dlc"), {"data": (1, 3, 9, 9)})
+    add("embedding_take", s.take(w, s.var("idx2")),
+        {"w": (10, 5), "idx2": (4,)}, grad_req="null",
+        location={"idx2": _np.array([1, 3, 5, 7], _np.float32)})
+    add("linalg_chain", s.linalg_gemm2(d, w),
+        {"data": (3, 4), "w": (4, 5)})
+    add("l2norm_channel", s.L2Normalization(d, mode="channel"),
+        {"data": (2, 4, 5, 5)})
+    add("adaptive_avg_pool", s.contrib.AdaptiveAvgPooling2D(
+        d, output_size=(3, 3)), {"data": (2, 3, 7, 7)})
+    add("bilinear_resize", s.contrib.BilinearResize2D(
+        d, height=9, width=9), {"data": (1, 2, 5, 5)})
+    add("instance_norm", s.InstanceNorm(d, s.var("g2"), s.var("b2")),
+        {"data": (2, 3, 6, 6), "g2": (3,), "b2": (3,)})
+    add("smooth_l1_where", s.smooth_l1(
+        s.where(s.var("c") > 0, d, -d), scalar=1.0),
+        {"data": (4, 5), "c": (4, 5)})
+    add("foreach_scan", s.contrib.foreach(
+        lambda x_, st: (x_ * st[0], [st[0] + 1.0]),
+        d, [s.var("st0")])[0],
+        {"data": (5, 3, 4), "st0": (3, 4)})
+    add("stem_s2d", s.space_to_depth(d, block_size=2),
+        {"data": (2, 4, 6, 6)})
+    add("multibox_prior_det", s.concat(
+        s.Reshape(s.MultiBoxPrior(d, sizes=(0.3,), ratios=(1.0, 2.0)),
+                  (1, -1, 4)), dim=1),
+        {"data": (1, 3, 4, 4)}, grad_req="null")
     return cases
 
 
