@@ -212,6 +212,105 @@ def _identity(ctx, name, ins, attrs):
     ctx.emit("Identity", ins[:1], [name], name)
 
 
+def _embedding(ctx, name, ins, attrs):
+    # framework convention stores indices as floats; ONNX Gather needs
+    # an integer tensor
+    idx = name + "_idx"
+    ctx.emit("Cast", [ins[0]], [idx], idx, {"to": P.DT_INT64})
+    ctx.emit("Gather", [ins[1], idx], [name], name, {"axis": 0})
+
+
+def _layer_norm(ctx, name, ins, attrs):
+    """Decomposed (opset-9 has no LayerNormalization, and its reduce
+    ops do not admit negative axes): the last-axis mean is a MatMul
+    with a constant ones/D vector — rank-agnostic and opset-9 legal.
+    D comes from the gamma initializer."""
+    ax = int(attrs.get("axis", -1))
+    if ax != -1:
+        raise NotImplementedError("LayerNorm export supports axis=-1")
+    x, g, b = ins
+    if g not in ctx.initializers and g not in ctx.params:
+        raise NotImplementedError(
+            "LayerNorm export needs gamma as an initializer (to know "
+            "the normalized width)")
+    dim = int((ctx.initializers.get(g) if g in ctx.initializers
+               else ctx.params[g]).shape[0])
+    eps = float(attrs.get("eps", 1e-5))
+    ones = ctx.const(name + "_avg",
+                     _np.full((dim, 1), 1.0 / dim, _np.float32))
+    mu, c, vr, ve, sd, nm_, sc = [name + s for s in
+                                  ("_mu", "_c", "_var", "_ve", "_sd",
+                                   "_n", "_sc")]
+    ctx.emit("MatMul", [x, ones], [mu], mu)      # (..., 1) last-axis mean
+    ctx.emit("Sub", [x, mu], [c], c)
+    sq = name + "_sq"
+    ctx.emit("Mul", [c, c], [sq], sq)
+    ctx.emit("MatMul", [sq, ones], [vr], vr)
+    ctx.emit("Add", [vr, ctx.const(name + "_eps",
+                                   _np.float32(eps))], [ve], ve)
+    ctx.emit("Sqrt", [ve], [sd], sd)
+    ctx.emit("Div", [c, sd], [nm_], nm_)
+    ctx.emit("Mul", [nm_, g], [sc], sc)
+    ctx.emit("Add", [sc, b], [name], name)
+
+
+def _slice_like(ctx, name, ins, attrs):
+    axes = _tuple(attrs.get("axes", "(0,)"))
+    if axes != [1] or not getattr(ctx, "input_shapes", None):
+        raise NotImplementedError(
+            "slice_like export supports axes=(1,) with a known input "
+            "shape (the positional-table pattern)")
+    seq = int(ctx.input_shapes[0][1])
+    ctx.emit("Slice", [ins[0]], [name], name,
+             {"axes": [1], "starts": [0], "ends": [seq]})
+
+
+def _dot_product_attention(ctx, name, ins, attrs):
+    """Scaled dot-product attention decomposition: MatMul/Softmax/
+    MatMul with a dynamic 1/sqrt(d) scale (Shape->Gather->Sqrt) and,
+    for causal, a constant additive mask at the export seq length."""
+    q, k, v = ins
+    causal = _bool(attrs.get("causal", "False"))
+    kt = name + "_kt"
+    ctx.emit("Transpose", [k], [kt], kt, {"perm": [0, 1, 3, 2]})
+    s0 = name + "_qk"
+    ctx.emit("MatMul", [q, kt], [s0], s0)
+    sm_scale = attrs.get("sm_scale")
+    if sm_scale not in (None, "None"):
+        cur = name + "_scaled"
+        ctx.emit("Mul", [s0, ctx.const(name + "_scale",
+                                       _np.float32(float(sm_scale)))],
+                 [cur], cur)
+    else:
+        shp, didx, dfl, dsq = [name + s for s in
+                               ("_shape", "_d", "_df", "_sqrtd")]
+        ctx.emit("Shape", [q], [shp], shp)
+        ctx.emit("Gather", [shp, ctx.const(name + "_didx",
+                                           _np.array([3], _np.int64))],
+                 [didx], didx, {"axis": 0})
+        ctx.emit("Cast", [didx], [dfl], dfl, {"to": P.DT_FLOAT})
+        ctx.emit("Sqrt", [dfl], [dsq], dsq)
+        cur = name + "_scaled"
+        ctx.emit("Div", [s0, dsq], [cur], cur)
+    if causal:
+        shapes = getattr(ctx, "input_shapes", None)
+        if not shapes or len(shapes[0]) != 2:
+            raise NotImplementedError(
+                "causal attention export supports square causal "
+                "SELF-attention with a rank-2 (batch, seq) token input "
+                "shape — the additive mask is a constant at that "
+                "sequence length")
+        seq = int(shapes[0][1])
+        mask = _np.triu(_np.full((seq, seq), -1e9, _np.float32), 1)
+        am = name + "_masked"
+        ctx.emit("Add", [cur, ctx.const(name + "_mask", mask)],
+                 [am], am)
+        cur = am
+    p = name + "_p"
+    ctx.emit("Softmax", [cur], [p], p, {"axis": 3})
+    ctx.emit("MatMul", [p, v], [name], name)
+
+
 CONVERTERS = {
     "Convolution": _conv,
     "BatchNorm": _bn,
@@ -250,6 +349,10 @@ CONVERTERS = {
     "mean": _mean,
     "slice_axis": _slice_axis,
     "identity": _identity, "_copy": _identity, "BlockGrad": _identity,
+    "Embedding": _embedding,
+    "LayerNorm": _layer_norm,
+    "slice_like": _slice_like,
+    "_contrib_DotProductAttention": _dot_product_attention,
 }
 
 
@@ -282,6 +385,7 @@ def export_model(sym, params, input_shape=None, input_type=_np.float32,
     heads = [tuple(h[:2]) for h in g["heads"]]
 
     ctx = _Ctx(np_params)
+    ctx.input_shapes = input_shape  # slice_like / causal-mask exports
     dtype = _np.dtype(input_type)
     elem = P._NP_TO_DT[dtype.name]
     # uniquify node names: duplicate names in the symbol JSON would

@@ -222,6 +222,20 @@ def _identity(b, sym, node, ins):
     return sym.identity(ins[0], name=node["name"] or None)
 
 
+def _cast(b, sym, node, ins):
+    to = int(node["attrs"]["to"])
+    return sym.cast(ins[0], dtype=P.DT_TO_NP[to],
+                    name=node["name"] or None)
+
+
+def _gather(b, sym, node, ins):
+    ax = int(node["attrs"].get("axis", 0))
+    # sym.take(data, indices, axis): the framework convention accepts
+    # integer-typed index symbols directly
+    return sym.take(ins[0], ins[1], axis=ax,
+                    name=node["name"] or None)
+
+
 IMPORTERS = {
     "Conv": _conv,
     "BatchNormalization": _bn,
@@ -253,6 +267,16 @@ IMPORTERS = {
     "ReduceMean": _reduce_mean,
     "Slice": _slice,
     "Identity": _identity,
+    # transformer-LM surface (mx2onnx Embedding/LayerNorm/attention
+    # decompositions re-import through these primitives)
+    "Cast": _cast,
+    "Gather": _gather,
+    "MatMul": lambda b, sym, node, ins: sym.linalg_gemm2(
+        *ins, name=node["name"] or None),
+    "Sqrt": lambda b, sym, node, ins: sym.sqrt(
+        ins[0], name=node["name"] or None),
+    "Shape": lambda b, sym, node, ins: sym.shape_array(
+        ins[0], name=node["name"] or None),
 }
 
 
