@@ -107,9 +107,20 @@ def _fc(ctx, name, ins, attrs):
         ctx.emit("Flatten", [data], [name + "_flat"], attrs=
                  {"axis": 1})
         data = name + "_flat"
-    gemm_in = [data, ins[1]] + (ins[2:] if len(ins) > 2 else [])
-    ctx.emit("Gemm", gemm_in, [name], name,
-             {"alpha": 1.0, "beta": 1.0, "transA": 0, "transB": 1})
+        gemm_in = [data, ins[1]] + (ins[2:] if len(ins) > 2 else [])
+        ctx.emit("Gemm", gemm_in, [name], name,
+                 {"alpha": 1.0, "beta": 1.0, "transA": 0, "transB": 1})
+        return
+    # flatten=False keeps leading batch dims (rank >= 3) — opset-9
+    # Gemm is strictly 2-D, so emit MatMul(x, W^T) (+ Add bias)
+    wt = name + "_wT"
+    ctx.emit("Transpose", [ins[1]], [wt], wt, {"perm": [1, 0]})
+    if len(ins) > 2:
+        mm = name + "_mm"
+        ctx.emit("MatMul", [data, wt], [mm], mm)
+        ctx.emit("Add", [mm, ins[2]], [name], name)
+    else:
+        ctx.emit("MatMul", [data, wt], [name], name)
 
 
 def _binary(onnx_op):

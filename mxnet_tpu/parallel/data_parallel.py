@@ -22,6 +22,8 @@ what Gluon's Trainer uses when constructed with ``kvstore='tpu'``.
 
 from __future__ import annotations
 
+import re
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -75,7 +77,8 @@ class ParallelTrainer:
 
     def __init__(self, net, loss, optimizer="sgd", optimizer_params=None,
                  mesh=None, shard_params=False, grad_clip=None,
-                 multi_precision=False, remat=None, coalesce_small=None):
+                 multi_precision=False, remat=None, coalesce_small=None,
+                 param_specs=None):
         self.net = net
         self.loss = loss
         self.mesh = mesh or make_mesh()
@@ -122,6 +125,15 @@ class ParallelTrainer:
         # config); only supported for those kernels and for replicated
         # (non-ZeRO) parameter layouts.
         self.coalesce_small = coalesce_small
+        # param_specs: tensor parallelism at the trainer level — a dict
+        # mapping a parameter-name regex to the PartitionSpec its
+        # weight (and optimizer state) lives at, e.g. a megatron MLP:
+        #   {r"fc1.*weight": P("tp", None),   # column-parallel
+        #    r"fc2.*weight": P(None, "tp")}   # row-parallel
+        # First match wins; unmatched params follow the replicated /
+        # ZeRO-dp default.  XLA's SPMD partitioner closes the tp
+        # collectives inside the compiled step.
+        self.param_specs = dict(param_specs or {})
         # rematerialization policy for the fwd activations kept for
         # backward: None (XLA decides), 'full' (recompute everything —
         # min HBM), 'dots' (save matmul/conv outputs only, recompute the
@@ -214,9 +226,9 @@ class ParallelTrainer:
                 # neither promote nor retrace
                 states = [jnp.zeros_like(arr)
                           for _ in range(self._opt_n_states)]
-            self._params[n] = self._put(arr, self._spec_for(arr))
+            self._params[n] = self._put(arr, self._spec_for(arr, n))
             self._opt_state[n] = tuple(
-                self._put(s, self._spec_for(s)) for s in states)
+                self._put(s, self._spec_for(s, n)) for s in states)
         self._aux = {n: self._put(params[n].data()._data, P())
                      for n in self.aux_names}
 
@@ -284,15 +296,19 @@ class ParallelTrainer:
                                         spec)
         return jax.device_put(arr, NamedSharding(self.mesh, spec))
 
-    def _spec_for(self, arr):
+    def _spec_for(self, arr, name=None):
+        if name is not None:
+            for pat, spec in self.param_specs.items():
+                if re.search(pat, name):
+                    return spec
         ndp = self.mesh.shape.get("dp", 1)
         if self.shard_params and arr.ndim >= 1 and \
                 arr.shape[0] % ndp == 0 and arr.shape[0] >= ndp:
             return P("dp")
         return P()
 
-    def _shard_for(self, arr):
-        return NamedSharding(self.mesh, self._spec_for(arr))
+    def _shard_for(self, arr, name=None):
+        return NamedSharding(self.mesh, self._spec_for(arr, name))
 
     # -- compiled step -----------------------------------------------------
     def _build_step(self):
@@ -319,7 +335,9 @@ class ParallelTrainer:
             _SMALL_MAX = 8192
             small = [n for n in self.param_names
                      if n not in self._frozen
-                     and self._params[n].size <= _SMALL_MAX]
+                     and self._params[n].size <= _SMALL_MAX
+                     and not any(re.search(p, n)
+                                 for p in self.param_specs)]
             coalesce = len(small) >= 2
         if coalesce:
             small_set = frozenset(small)
@@ -474,9 +492,13 @@ class ParallelTrainer:
 
         repl = NamedSharding(self.mesh, P())
         batch_sh = NamedSharding(self.mesh, P("dp"))
-        param_sh = {n: self._shard_for(self._params[n])
+        # frozen args always live replicated, whatever param_specs says
+        param_sh = {n: self._shard_for(self._params[n],
+                                       None if n in self._frozen else n)
                     for n in self._params}
-        state_sh = {n: tuple(self._shard_for(s) for s in self._opt_state[n])
+        state_sh = {n: tuple(self._shard_for(
+                        s, None if n in self._frozen else n)
+                             for s in self._opt_state[n])
                     for n in self._opt_state}
         aux_sh = {n: repl for n in self._aux}
         self._step_fn = jax.jit(
@@ -684,10 +706,10 @@ class ParallelTrainer:
         # different batch size, and they are always zeros anyway.
         self._params = {
             n: (self._params[n] if n in self._frozen
-                else self._put(a, self._spec_for(a)))
+                else self._put(a, self._spec_for(a, n)))
             for n, a in params.items()}
         self._opt_state = {
-            n: tuple(self._put(slots[i], self._spec_for(slots[i]))
+            n: tuple(self._put(slots[i], self._spec_for(slots[i], n))
                      for i in sorted(slots))
             for n, slots in ((n, opt.get(n, {})) for n in params)}
         self._aux = {n: self._put(a, P()) for n, a in aux.items()}
