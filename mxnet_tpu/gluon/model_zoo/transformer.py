@@ -23,7 +23,8 @@ from ..block import HybridBlock
 from .. import nn
 from ..contrib.nn import MultiHeadAttention
 
-__all__ = ["TransformerBlock", "TransformerLM", "get_transformer_lm"]
+__all__ = ["TransformerBlock", "TransformerLM", "get_transformer_lm",
+           "tensor_parallel_specs"]
 
 
 class TransformerBlock(HybridBlock):
@@ -85,3 +86,22 @@ def get_transformer_lm(vocab=32000, dim=512, heads=8, layers=6,
                        max_seq=8192, **kwargs):
     return TransformerLM(vocab=vocab, dim=dim, heads=heads,
                          layers=layers, max_seq=max_seq, **kwargs)
+
+
+def tensor_parallel_specs(axis="tp"):
+    """Megatron-style ``ParallelTrainer(param_specs=...)`` preset for
+    :class:`TransformerLM`: attention q/k/v and the MLP up-projection
+    are column-parallel (output dim sharded), the attention output and
+    MLP down-projection row-parallel (input dim sharded) — each block
+    then needs exactly one all-reduce per sublayer, which XLA inserts.
+    Embedding and LM head stay replicated (their vocab dim rarely
+    divides small tp extents; shard them via an explicit entry when it
+    does)."""
+    from jax.sharding import PartitionSpec as P
+    col, row = P(axis, None), P(None, axis)
+    return {
+        r"(query|key|value)_weight$": col,
+        r"out_weight$": row,
+        r"fc1_weight$": col,
+        r"fc2_weight$": row,
+    }

@@ -312,6 +312,39 @@ def test_parallel_trainer_frozen_states_batch_resize():
         loss = float(np.asarray(tr.fit_batch(x, y)))
         assert np.isfinite(loss)
 
+def _tp_equivalence(net_fn, specs, x, y, steps=5, rtol=1e-5, atol=1e-6,
+                    opt_params=None):
+    """Train the same model replicated (dp=8) and tp-sharded (dp2xtp4)
+    from identical weights; assert equal loss curves.  Returns the
+    sharded trainer for further assertions."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.parallel.data_parallel import ParallelTrainer
+
+    opt_params = opt_params or {"learning_rate": 0.1, "momentum": 0.9}
+
+    def make(param_specs, mesh_axes):
+        net = net_fn()
+        net.initialize()
+        return ParallelTrainer(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(),
+            optimizer="sgd", optimizer_params=dict(opt_params),
+            mesh=make_mesh(mesh_axes), param_specs=param_specs), net
+
+    ta, neta = make({}, {"dp": 8})
+    tb, netb = make(specs, {"dp": 2, "tp": 4})
+    zero = mx.nd.array(np.zeros((1,) + tuple(x.shape[1:]), np.float32))
+    neta(zero)
+    netb(zero)
+    for a, b in zip(neta.collect_params().values(),
+                    netb.collect_params().values()):
+        b.set_data(a.data().copy())
+    la = [float(np.asarray(ta.fit_batch(x, y))) for _ in range(steps)]
+    lb = [float(np.asarray(tb.fit_batch(x, y))) for _ in range(steps)]
+    np.testing.assert_allclose(lb, la, rtol=rtol, atol=atol)
+    return tb
+
+
 
 def test_parallel_trainer_tensor_parallel_param_specs():
     """param_specs shards weights megatron-style over a dp x tp mesh
@@ -319,41 +352,45 @@ def test_parallel_trainer_tensor_parallel_param_specs():
     collectives and the loss curve must match the fully replicated
     run."""
     import mxnet_tpu as mx
-    from mxnet_tpu import gluon
     from mxnet_tpu.gluon import nn
-    from mxnet_tpu.parallel.data_parallel import ParallelTrainer
     from jax.sharding import PartitionSpec as P
 
-    def make(param_specs, mesh_axes):
+    def net_fn():
         net = nn.HybridSequential()
         net.add(nn.Dense(32, activation="relu", prefix="fc1_"),
                 nn.Dense(8, prefix="fc2_"))
-        net.initialize()
-        return ParallelTrainer(
-            net, gluon.loss.SoftmaxCrossEntropyLoss(),
-            optimizer="sgd",
-            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
-            mesh=make_mesh(mesh_axes), param_specs=param_specs), net
+        return net
 
     rs = np.random.RandomState(0)
     x = mx.nd.array(rs.randn(16, 12).astype(np.float32))
     y = mx.nd.array(rs.randint(0, 8, (16,)).astype(np.float32))
-
-    ta, neta = make({}, {"dp": 8})
-    tb, netb = make({r"fc1_weight": P("tp", None),    # (hidden, in)
-                     r"fc2_weight": P(None, "tp")},   # (out, hidden)
-                    {"dp": 2, "tp": 4})
-    # identical start
-    neta(mx.nd.array(np.zeros((1, 12), np.float32)))
-    netb(mx.nd.array(np.zeros((1, 12), np.float32)))
-    for a, b in zip(neta.collect_params().values(),
-                    netb.collect_params().values()):
-        b.set_data(a.data().copy())
-    la = [float(np.asarray(ta.fit_batch(x, y))) for _ in range(6)]
-    lb = [float(np.asarray(tb.fit_batch(x, y))) for _ in range(6)]
-    np.testing.assert_allclose(lb, la, rtol=1e-5, atol=1e-6)
+    tb = _tp_equivalence(net_fn,
+                         {r"fc1_weight": P("tp", None),   # (hidden, in)
+                          r"fc2_weight": P(None, "tp")},  # (out, hidden)
+                         x, y, steps=6)
     # the weight really is tp-sharded on device
     w1 = tb._params[[n for n in tb.param_names
                      if "fc1_weight" in n][0]]
     spec = w1.sharding.spec
     assert tuple(spec)[:1] == ("tp",), spec
+
+
+def test_transformer_lm_tensor_parallel_preset():
+    """model_zoo.transformer.tensor_parallel_specs shards the LM's
+    attention/MLP projections over a dp x tp mesh; the loss curve must
+    match the fully replicated run (megatron pattern end to end
+    through ParallelTrainer)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.transformer import (
+        get_transformer_lm, tensor_parallel_specs)
+
+    rs = np.random.RandomState(0)
+    x = mx.nd.array(rs.randint(0, 24, (8, 8)).astype(np.float32))
+    y = mx.nd.array(rs.randint(0, 24, (8, 8)).astype(np.float32))
+    tb = _tp_equivalence(
+        lambda: get_transformer_lm(vocab=24, dim=16, heads=4, layers=2,
+                                   max_seq=16),
+        tensor_parallel_specs(), x, y, steps=5, rtol=2e-5, atol=2e-6)
+    # at least one projection is really tp-sharded on device
+    qn = [n for n in tb.param_names if n.endswith("query_weight")][0]
+    assert tuple(tb._params[qn].sharding.spec)[:1] == ("tp",)
