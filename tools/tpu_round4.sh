@@ -68,6 +68,8 @@ if [ "${1:-}" != "fast" ]; then
   step score_int8 timeout 1800 python tools/benchmark_score.py \
       --models resnet50_v1 --batches 32 128 --dtype int8
   step lm timeout 1800 python tools/benchmark_lm.py
+  step lm_long timeout 1800 python tools/benchmark_lm.py \
+      --seq 8192 --batch 2 --iters 10
   step lm_lstm timeout 1800 python tools/benchmark_lm.py --arch lstm \
       --dim 650 --seq 512 --batch 32
   step ssd timeout 1800 python tools/benchmark_ssd.py
