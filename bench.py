@@ -377,7 +377,10 @@ def main():
     batch = 128 if on_tpu else 8
     image = 224 if on_tpu else 32
     warmup, iters = (4, 20) if on_tpu else (2, 10)
-    scan_n = 5 if on_tpu else 2  # scan length multiplies CPU compile time
+    # 10-deep scan: at ~50 ms/step one dispatch covers ~500 ms, taking
+    # the 4-7 ms tunnel latency under 1.5% of the window (CPU keeps a
+    # short scan — it multiplies compile time)
+    scan_n = 10 if on_tpu else 2
 
     r = timed_resnet_train(
         batch, image,
