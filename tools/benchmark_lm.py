@@ -45,6 +45,10 @@ def main():
     ap.add_argument("--vocab", type=int, default=32000)
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--scan", type=int, default=5)
+    ap.add_argument("--remat", default="none",
+                    choices=["none", "dots", "full"],
+                    help="activation rematerialization policy (long "
+                         "sequences need 'dots' to fit HBM)")
     args = ap.parse_args()
 
     import mxnet_tpu as mx  # re-pins jax_platforms from the env var
@@ -76,7 +80,8 @@ def main():
         optimizer="sgd",
         optimizer_params={"learning_rate": 0.01, "momentum": 0.9},
         mesh=make_mesh({"dp": 1}, [dev]),
-        multi_precision=on_tpu)
+        multi_precision=on_tpu,
+        remat=None if args.remat == "none" else args.remat)
 
     rng = np.random.RandomState(0)
     # token ids travel as int32: a float32 id cast to bf16 by the

@@ -69,7 +69,7 @@ if [ "${1:-}" != "fast" ]; then
       --models resnet50_v1 --batches 32 128 --dtype int8
   step lm timeout 1800 python tools/benchmark_lm.py
   step lm_long timeout 1800 python tools/benchmark_lm.py \
-      --seq 8192 --batch 2 --iters 10
+      --seq 8192 --batch 2 --iters 10 --remat dots
   step lm_lstm timeout 1800 python tools/benchmark_lm.py --arch lstm \
       --dim 650 --seq 512 --batch 32
   step ssd timeout 1800 python tools/benchmark_ssd.py
