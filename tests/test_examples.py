@@ -145,3 +145,23 @@ def test_quantize_model_example():
              timeout=1800)
     assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
     assert "QUANTIZE-EXAMPLE-OK" in r.stdout
+
+
+def test_train_dcgan_adversarial_dynamics():
+    """DCGAN (reference example/gan): Deconvolution generator +
+    alternating two-Trainer adversarial loop; the discriminator must
+    learn (its loss falls) and the game must stay finite."""
+    r = _run([sys.executable, "examples/train_dcgan.py",
+              "--num-steps", "80"], timeout=600)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert "DCGAN-OK" in r.stdout
+
+
+def test_train_multihost_launcher():
+    """tools/launch.py -n 2 -s 0 drives the jax.distributed worker
+    group (see also tests/test_multihost.py)."""
+    r = _run([sys.executable, "tools/launch.py", "-n", "2", "-s", "0",
+              "--", sys.executable, "examples/train_multihost.py",
+              "--num-steps", "10"], timeout=600)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert r.stdout.count("MULTIHOST-TRAIN-OK") == 2
