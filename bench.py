@@ -91,17 +91,26 @@ def _ensure_platform():
         jax.config.update("jax_platforms", "cpu")
         return
     healthy = False
-    # ~10.5 min total budget: 150 s first attempt (covers slow first
-    # compile of the probe), then shorter retries with growing pauses
-    # to ride out a tunnel restart.
-    attempts = [(150, 30), (90, 60), (90, 120), (90, 0)]
-    for attempt, (probe_s, pause_s) in enumerate(attempts):
-        healthy = _probe_tpu_once(probe_s)
-        if healthy or attempt == len(attempts) - 1:
+    # Default ~10.5 min budget: 150 s first attempt (covers slow first
+    # compile of the probe), then shorter retries with growing pauses to
+    # ride out a tunnel restart.  BENCH_PROBE_BUDGET_S extends the total
+    # wait — a round wrapper that wants to camp on a dead tunnel for an
+    # hour sets it; past the listed attempts we keep cycling 90 s probes
+    # with 120 s pauses until the budget runs out.
+    budget_s = float(os.environ.get("BENCH_PROBE_BUDGET_S", "630"))
+    deadline = time.time() + budget_s
+    attempts = [(150, 30), (90, 60), (90, 120)]
+    attempt = 0
+    while True:
+        probe_s, pause_s = attempts[attempt] if attempt < len(attempts) \
+            else (90, 120)
+        healthy = _probe_tpu_once(min(probe_s, max(30, deadline - time.time())))
+        if healthy or time.time() + pause_s + 30 > deadline:
             break
         print("bench: TPU health probe attempt %d failed; retrying in "
               "%d s" % (attempt + 1, pause_s), file=sys.stderr)
         time.sleep(pause_s)
+        attempt += 1
     if not healthy:
         print("bench: TPU tunnel never answered a real computation — "
               "exiting nonzero (no CPU fallback for the round artifact)",

@@ -165,12 +165,15 @@ def graph(nodes, name, initializers, inputs, outputs):
 
 
 def model(graph_bytes, opset=9, producer="mxnet_tpu",
-          producer_version="0.4", ir_version=4):
+          producer_version="0.4", ir_version=4, doc_string=None):
     """ModelProto: ir_version=1, producer_name=2, producer_version=3,
-    graph=7, opset_import=8; OperatorSetIdProto: domain=1, version=2."""
+    doc_string=6, graph=7, opset_import=8; OperatorSetIdProto:
+    domain=1, version=2."""
     out = _field_varint(1, ir_version)
     out += _field_bytes(2, producer)
     out += _field_bytes(3, producer_version)
+    if doc_string:
+        out += _field_bytes(6, doc_string)
     out += _field_bytes(7, graph_bytes)
     out += _field_bytes(8, _field_bytes(1, "") + _field_varint(2, opset))
     return out

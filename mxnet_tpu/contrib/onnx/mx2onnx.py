@@ -376,6 +376,14 @@ def export_model(sym, params, input_shape=None, input_type=_np.float32,
     NDArray/ndarray (arg+aux merged, optionally ``arg:``/``aux:``
     prefixed as in saved .params files) or a path to one.  Returns the
     output file path.
+
+    Shape caveat: converters that need a concrete length at export time
+    (the causal-attention additive mask, slice_like positional-table
+    bounds) bake ``input_shape``'s sequence length into the graph as
+    constants, so the exported model only accepts inputs of that exact
+    sequence length (batch stays dynamic).  The traced input shapes are
+    recorded in the ModelProto ``doc_string`` so a consumer hitting a
+    downstream broadcast error can see the expected shapes.
     """
     from ...symbol import Symbol, load as sym_load
     if isinstance(sym, str):
@@ -451,7 +459,10 @@ def export_model(sym, params, input_shape=None, input_type=_np.float32,
     inits = [P.tensor(nm, arr) for nm, arr in ctx.initializers.items()]
     gb = P.graph(ctx.nodes, "mxnet_tpu_model", inits, in_infos,
                  out_infos)
-    blob = P.model(gb)
+    doc = ("traced input shapes: %r (constants such as causal masks are "
+           "baked at these lengths)" % (input_shape,)) if input_shape \
+        else None
+    blob = P.model(gb, doc_string=doc)
     with open(onnx_file_path, "wb") as f:
         f.write(blob)
     return onnx_file_path

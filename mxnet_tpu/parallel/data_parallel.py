@@ -328,8 +328,15 @@ class ParallelTrainer:
         coalesce = self.coalesce_small
         if coalesce is None:
             coalesce = lars
-        coalesce = (coalesce and not self.shard_params
-                    and self._opt_base in ("sgd", "sgd_mom"))
+        supported = (not self.shard_params
+                     and self._opt_base in ("sgd", "sgd_mom"))
+        if self.coalesce_small and not supported:
+            raise ValueError(
+                "coalesce_small=True requires an (mp_)sgd[_mom] optimizer "
+                "and shard_params=False (got optimizer base %r, "
+                "shard_params=%r); drop the flag to use the per-tensor "
+                "apply path" % (self._opt_base, self.shard_params))
+        coalesce = coalesce and supported
         small = []
         if coalesce:
             _SMALL_MAX = 8192
@@ -371,10 +378,18 @@ class ParallelTrainer:
                              for n in small])
                 gf = flat([grads[n] for n in small])
                 if lars:
-                    wsq = c_sel @ jnp.sum(
-                        w32f.reshape(-1, 128) ** 2, axis=1)
-                    gsq = c_sel @ jnp.sum(
-                        gf.reshape(-1, 128) ** 2, axis=1)
+                    # the per-tensor path computes these norms with
+                    # jnp.sum (f32 regardless of matmul precision), so
+                    # this contraction is pinned to HIGHEST outright —
+                    # not via matmul_precision(), whose env override
+                    # would silently de-sync the two paths
+                    prec = jax.lax.Precision.HIGHEST
+                    wsq = jnp.matmul(
+                        c_sel, jnp.sum(w32f.reshape(-1, 128) ** 2, axis=1),
+                        precision=prec)
+                    gsq = jnp.matmul(
+                        c_sel, jnp.sum(gf.reshape(-1, 128) ** 2, axis=1),
+                        precision=prec)
                     wnorm = jnp.sqrt(wsq)
                     gnorm = jnp.sqrt(gsq)
                     trust = jnp.where(
