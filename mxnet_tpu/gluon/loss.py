@@ -1,4 +1,14 @@
-"""Gluon losses (reference: python/mxnet/gluon/loss.py, 769 LoC)."""
+"""Gluon losses.
+
+API parity target: the reference's ``python/mxnet/gluon/loss.py`` (769
+LoC) — same class names, constructor knobs, and reduction semantics
+(elementwise loss -> optional ``sample_weight``/``weight`` scaling ->
+mean over every axis except ``batch_axis``).  The plumbing lives once in
+``Loss._per_sample`` here instead of being repeated per class; each
+subclass contributes only its formula via ``_elementwise`` (or a full
+``hybrid_forward`` where the shape story differs, e.g. pick-based CE,
+CTC, triplet).
+"""
 
 from __future__ import annotations
 
@@ -10,19 +20,10 @@ __all__ = ["Loss", "L2Loss", "L1Loss", "SigmoidBinaryCrossEntropyLoss",
            "SquaredHingeLoss", "LogisticLoss", "TripletLoss"]
 
 
-def _apply_weighting(F, loss, weight=None, sample_weight=None):
-    if sample_weight is not None:
-        loss = F.broadcast_mul(loss, sample_weight)
-    if weight is not None:
-        loss = loss * weight
-    return loss
-
-
-def _reshape_like(F, x, y):
-    return F.reshape_like(x, y)
-
-
 class Loss(HybridBlock):
+    """Base class: holds the global ``weight`` scale and the batch axis
+    the reduction preserves."""
+
     def __init__(self, weight, batch_axis, **kwargs):
         super().__init__(**kwargs)
         self._weight = weight
@@ -32,33 +33,53 @@ class Loss(HybridBlock):
         return "{}(batch_axis={}, w={})".format(
             type(self).__name__, self._batch_axis, self._weight)
 
-    def hybrid_forward(self, F, x, *args, **kwargs):
+    # -- shared reduction plumbing ------------------------------------
+    def _scale(self, F, loss, sample_weight):
+        """Per-element ``sample_weight`` (broadcast), then the scalar
+        ``weight`` knob."""
+        if sample_weight is not None:
+            loss = F.broadcast_mul(loss, sample_weight)
+        if self._weight is not None:
+            loss = loss * self._weight
+        return loss
+
+    def _per_sample(self, F, loss, sample_weight):
+        """Scale, then collapse everything but the batch axis."""
+        loss = self._scale(F, loss, sample_weight)
+        return F.mean(loss, axis=self._batch_axis, exclude=True)
+
+    def _elementwise(self, F, pred, label):
         raise NotImplementedError
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        # default pattern: label takes pred's shape, formula, reduce
+        raw = self._elementwise(F, pred, F.reshape_like(label, pred))
+        return self._per_sample(F, raw, sample_weight)
 
 
 class L2Loss(Loss):
+    """Half squared error (the 1/2 lives in the formula, so the weight
+    knob composes with it)."""
+
     def __init__(self, weight=1.0, batch_axis=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
 
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.square(label - pred)
-        loss = _apply_weighting(F, loss, self._weight / 2, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+    def _elementwise(self, F, pred, label):
+        return 0.5 * F.square(pred - label)
 
 
 class L1Loss(Loss):
     def __init__(self, weight=None, batch_axis=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
 
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.abs(label - pred)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+    def _elementwise(self, F, pred, label):
+        return F.abs(pred - label)
 
 
 class SigmoidBinaryCrossEntropyLoss(Loss):
+    """BCE on logits (default) or on probabilities
+    (``from_sigmoid=True``)."""
+
     def __init__(self, from_sigmoid=False, weight=None, batch_axis=0,
                  **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
@@ -66,24 +87,40 @@ class SigmoidBinaryCrossEntropyLoss(Loss):
 
     def hybrid_forward(self, F, pred, label, sample_weight=None,
                        pos_weight=None):
-        label = _reshape_like(F, label, pred)
-        if not self._from_sigmoid:
-            # numerically stable: max(x,0) - x*z + log(1+exp(-|x|))
-            loss = F.relu(pred) - pred * label + \
-                F.Activation(-F.abs(pred), act_type="softrelu")
-        else:
+        label = F.reshape_like(label, pred)
+        if self._from_sigmoid:
+            # clamp away from log(0)
             eps = 1e-12
-            loss = -(F.log(pred + eps) * label +
-                     F.log(1. - pred + eps) * (1. - label))
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+            hit = F.log(pred + eps) * label
+            if pos_weight is not None:
+                hit = F.broadcast_mul(hit, pos_weight)
+            miss = F.log(1. - pred + eps) * (1. - label)
+            raw = -(hit + miss)
+        elif pos_weight is None:
+            # logit form, the overflow-safe identity:
+            #   bce(x, z) = max(x, 0) - x*z + log1p(exp(-|x|))
+            softplus_neg_abs = F.Activation(-F.abs(pred),
+                                            act_type="softrelu")
+            raw = F.relu(pred) - pred * label + softplus_neg_abs
+        else:
+            # positive-class weighting: the log1p term picks up the
+            # weight  1 + (pos_weight - 1) * z  (derivation: weighted
+            # -[w*z*log(s(x)) + (1-z)*log(1-s(x))] regrouped around the
+            # same stable softplus)
+            lw = 1. + F.broadcast_mul(pos_weight - 1., label)
+            softplus = F.Activation(-F.abs(pred), act_type="softrelu") + \
+                F.relu(-pred)
+            raw = pred - pred * label + F.broadcast_mul(lw, softplus)
+        return self._per_sample(F, raw, sample_weight)
 
 
 SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss
 
 
 class SoftmaxCrossEntropyLoss(Loss):
-    """Softmax + CE (reference: loss.py SoftmaxCrossEntropyLoss)."""
+    """Cross entropy over ``axis``; integer labels gather via pick
+    (``sparse_label=True``), dense labels contract against the full
+    log-probability row."""
 
     def __init__(self, axis=-1, sparse_label=True, from_logits=False,
                  weight=None, batch_axis=0, **kwargs):
@@ -93,21 +130,23 @@ class SoftmaxCrossEntropyLoss(Loss):
         self._from_logits = from_logits
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        if not self._from_logits:
-            pred = F.log_softmax(pred, axis=self._axis)
+        logp = pred if self._from_logits else \
+            F.log_softmax(pred, axis=self._axis)
         if self._sparse_label:
-            loss = -F.pick(pred, label, axis=self._axis, keepdims=True)
+            raw = -F.pick(logp, label, axis=self._axis, keepdims=True)
         else:
-            label = _reshape_like(F, label, pred)
-            loss = -F.sum(pred * label, axis=self._axis, keepdims=True)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+            raw = -F.sum(logp * F.reshape_like(label, logp),
+                         axis=self._axis, keepdims=True)
+        return self._per_sample(F, raw, sample_weight)
 
 
 SoftmaxCELoss = SoftmaxCrossEntropyLoss
 
 
 class KLDivLoss(Loss):
+    """KL(label || pred) with pred given as log-probabilities by
+    default; the label-entropy term keeps the minimum at zero."""
+
     def __init__(self, from_logits=True, axis=-1, weight=None, batch_axis=0,
                  **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
@@ -115,62 +154,63 @@ class KLDivLoss(Loss):
         self._axis = axis
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        if not self._from_logits:
-            pred = F.log_softmax(pred, axis=self._axis)
-        loss = label * (F.log(label + 1e-12) - pred)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+        logp = pred if self._from_logits else \
+            F.log_softmax(pred, axis=self._axis)
+        raw = label * (F.log(label + 1e-12) - logp)
+        return self._per_sample(F, raw, sample_weight)
 
 
 class CTCLoss(Loss):
+    """Layout-normalizing wrapper over the CTCLoss op (blank = last
+    class, as in the reference's warp-ctc binding)."""
+
     def __init__(self, layout="NTC", label_layout="NT", weight=None,
                  **kwargs):
         assert layout in ("NTC", "TNC")
         assert label_layout in ("NT", "TN")
         self._layout = layout
         self._label_layout = label_layout
-        batch_axis = label_layout.find("N")
-        super().__init__(weight, batch_axis, **kwargs)
+        super().__init__(weight, label_layout.find("N"), **kwargs)
 
     def hybrid_forward(self, F, pred, label, pred_lengths=None,
                        label_lengths=None, sample_weight=None):
-        if self._layout == "NTC":
+        if self._layout == "NTC":  # op wants time-major activations
             pred = F.swapaxes(pred, 0, 1) if hasattr(F, "swapaxes") else \
                 F.SwapAxis(pred, dim1=0, dim2=1)
         if self._batch_axis == 1:
             label = F.SwapAxis(label, dim1=0, dim2=1)
-        loss = F.CTCLoss(pred, label,
-                         use_data_lengths=pred_lengths is not None,
-                         use_label_lengths=label_lengths is not None,
-                         blank_label="last")
-        return _apply_weighting(F, loss, self._weight, sample_weight)
+        # the length tensors are optional op INPUTS gated by the flags
+        extra = [t for t in (pred_lengths, label_lengths) if t is not None]
+        raw = F.CTCLoss(pred, label, *extra,
+                        use_data_lengths=pred_lengths is not None,
+                        use_label_lengths=label_lengths is not None,
+                        blank_label="last")
+        return self._scale(F, raw, sample_weight)
 
 
 class HuberLoss(Loss):
+    """Quadratic within ``rho`` of the target, linear beyond."""
+
     def __init__(self, rho=1, weight=None, batch_axis=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
         self._rho = rho
 
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.abs(label - pred)
-        loss = F.where(loss > self._rho,
-                       loss - 0.5 * self._rho,
-                       (0.5 / self._rho) * F.square(loss))
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+    def _elementwise(self, F, pred, label):
+        err = F.abs(pred - label)
+        quad = (0.5 / self._rho) * F.square(err)
+        lin = err - 0.5 * self._rho
+        return F.where(err > self._rho, lin, quad)
 
 
 class HingeLoss(Loss):
+    """max(0, margin - y*f(x)) for signed labels."""
+
     def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
         self._margin = margin
 
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.relu(self._margin - pred * label)
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+    def _elementwise(self, F, pred, label):
+        return F.relu(self._margin - pred * label)
 
 
 class SquaredHingeLoss(Loss):
@@ -178,39 +218,37 @@ class SquaredHingeLoss(Loss):
         super().__init__(weight, batch_axis, **kwargs)
         self._margin = margin
 
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
-        loss = F.square(F.relu(self._margin - pred * label))
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+    def _elementwise(self, F, pred, label):
+        return F.square(F.relu(self._margin - pred * label))
 
 
 class LogisticLoss(Loss):
+    """BCE on logits with labels in {-1, 1} (``signed``, remapped to
+    {0, 1}) or already in {0, 1} (``binary``)."""
+
     def __init__(self, weight=None, batch_axis=0, label_format="signed",
                  **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
         self._label_format = label_format
 
-    def hybrid_forward(self, F, pred, label, sample_weight=None):
-        label = _reshape_like(F, label, pred)
+    def _elementwise(self, F, pred, label):
         if self._label_format == "signed":
             label = (label + 1.0) / 2.0
-        loss = F.relu(pred) - pred * label + \
-            F.Activation(-F.abs(pred), act_type="softrelu")
-        loss = _apply_weighting(F, loss, self._weight, sample_weight)
-        return F.mean(loss, axis=self._batch_axis, exclude=True)
+        softplus_neg_abs = F.Activation(-F.abs(pred), act_type="softrelu")
+        return F.relu(pred) - pred * label + softplus_neg_abs
 
 
 class TripletLoss(Loss):
+    """max(0, margin + ||a-p||^2 - ||a-n||^2), distances summed over
+    the non-batch axes before the hinge."""
+
     def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
         super().__init__(weight, batch_axis, **kwargs)
         self._margin = margin
 
     def hybrid_forward(self, F, pred, positive, negative,
                        sample_weight=None):
-        positive = _reshape_like(F, positive, pred)
-        negative = _reshape_like(F, negative, pred)
-        loss = F.sum(F.square(positive - pred) - F.square(negative - pred),
-                     axis=self._batch_axis, exclude=True)
-        loss = F.relu(loss + self._margin)
-        return _apply_weighting(F, loss, self._weight, sample_weight)
+        d_pos = F.square(F.reshape_like(positive, pred) - pred)
+        d_neg = F.square(F.reshape_like(negative, pred) - pred)
+        gap = F.sum(d_pos - d_neg, axis=self._batch_axis, exclude=True)
+        return self._scale(F, F.relu(gap + self._margin), sample_weight)

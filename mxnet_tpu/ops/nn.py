@@ -603,12 +603,29 @@ def _custom(*inputs, op_type=None, **kwargs):
 
 
 @register_op("CTCLoss", aliases=("ctc_loss", "_contrib_CTCLoss",
-                                 "_contrib_ctc_loss"))
-def _ctc_loss(data, label, use_data_lengths=False, use_label_lengths=False,
-              blank_label="first", data_lengths=None, label_lengths=None):
+                                 "_contrib_ctc_loss"),
+             input_names=("data", "label", "data_lengths",
+                          "label_lengths"))
+def _ctc_loss(*inputs, use_data_lengths=False, use_label_lengths=False,
+              blank_label="first"):
     """CTC loss. data: (seq, batch, alphabet) reference layout
-    (src/operator/nn/ctc_loss.cc); lowered to optax.ctc_loss (blank=0)."""
+    (src/operator/nn/ctc_loss.cc); lowered to optax.ctc_loss (blank=0).
+
+    Like the reference op, the per-sequence length tensors are optional
+    graph INPUTS gated by the use_*_lengths flags (active_inputs below),
+    so padded activations/labels past each sequence's length are
+    excluded from the alignment."""
     import optax
+    expected = 2 + bool(use_data_lengths) + bool(use_label_lengths)
+    if len(inputs) != expected:
+        raise TypeError(
+            "CTCLoss expects %d inputs for use_data_lengths=%r, "
+            "use_label_lengths=%r; got %d"
+            % (expected, use_data_lengths, use_label_lengths, len(inputs)))
+    rest = list(inputs[2:])
+    data_lengths = rest.pop(0) if use_data_lengths else None
+    label_lengths = rest.pop(0) if use_label_lengths else None
+    data, label = inputs[0], inputs[1]
     seq, batch, nalpha = data.shape
     logits = jnp.transpose(data, (1, 0, 2))          # (B, T, A)
     labels = label.astype(jnp.int32)
@@ -620,12 +637,33 @@ def _ctc_loss(data, label, use_data_lengths=False, use_label_lengths=False,
         # 'last': blank is alphabet-1; rotate so blank becomes 0
         logits = jnp.concatenate([logits[..., -1:], logits[..., :-1]], -1)
         labels = labels + 1
-    logit_paddings = jnp.zeros((batch, seq), jnp.float32)
-    lab_valid = (labels > 0).astype(jnp.float32)
-    label_paddings = 1.0 - lab_valid
+    if data_lengths is not None:
+        t_idx = jnp.arange(seq)[None, :]
+        logit_paddings = (t_idx >=
+                          data_lengths.astype(jnp.int32).reshape(-1, 1)
+                          ).astype(jnp.float32)
+    else:
+        logit_paddings = jnp.zeros((batch, seq), jnp.float32)
+    if label_lengths is not None:
+        l_idx = jnp.arange(labels.shape[1])[None, :]
+        label_paddings = (l_idx >=
+                          label_lengths.astype(jnp.int32).reshape(-1, 1)
+                          ).astype(jnp.float32)
+    else:
+        lab_valid = (labels > 0).astype(jnp.float32)
+        label_paddings = 1.0 - lab_valid
     loss = optax.ctc_loss(jax.nn.log_softmax(logits, -1), logit_paddings,
                           labels, label_paddings)
     return loss
+
+
+def _ctc_inputs(params):
+    names = ["data", "label"]
+    if params.get("use_data_lengths", False):
+        names.append("data_lengths")
+    if params.get("use_label_lengths", False):
+        names.append("label_lengths")
+    return tuple(names)
 
 
 # -- symbolic metadata -------------------------------------------------------
@@ -640,6 +678,7 @@ def _conv_inputs(params):
     return ("data", "weight", "bias")
 
 _get_op("Convolution").active_inputs = _conv_inputs
+_get_op("CTCLoss").active_inputs = _ctc_inputs
 _get_op("FullyConnected").active_inputs = _conv_inputs
 
 def _deconv_inputs(params):

@@ -172,6 +172,50 @@ def test_losses():
     np.testing.assert_allclose(h.asnumpy(), [1.5], rtol=1e-5)
 
 
+def test_sigmoid_bce_pos_weight():
+    rs = np.random.RandomState(3)
+    x = rs.randn(4, 3).astype('float32')
+    z = (rs.rand(4, 3) > 0.5).astype('float32')
+    w = np.array([2.0, 0.5, 3.0], 'float32')
+    s = 1 / (1 + np.exp(-x))
+    want = (-(w * z * np.log(s) + (1 - z) * np.log(1 - s))).mean(1)
+    logit = gluon.loss.SigmoidBinaryCrossEntropyLoss()(
+        nd.array(x), nd.array(z), None, nd.array(w))
+    np.testing.assert_allclose(logit.asnumpy(), want, rtol=1e-4)
+    prob = gluon.loss.SigmoidBinaryCrossEntropyLoss(from_sigmoid=True)(
+        nd.array(s.astype('float32')), nd.array(z), None, nd.array(w))
+    np.testing.assert_allclose(prob.asnumpy(), want, rtol=1e-3)
+    # pos_weight of ones reduces to the unweighted loss
+    ones = gluon.loss.SigmoidBinaryCrossEntropyLoss()(
+        nd.array(x), nd.array(z), None, nd.array(np.ones(3, 'float32')))
+    base = gluon.loss.SigmoidBinaryCrossEntropyLoss()(
+        nd.array(x), nd.array(z))
+    np.testing.assert_allclose(ones.asnumpy(), base.asnumpy(), rtol=1e-5)
+
+
+def test_ctc_loss_lengths():
+    import pytest
+    rs = np.random.RandomState(5)
+    pred = rs.randn(2, 6, 5).astype('float32')      # NTC
+    label = nd.array([[1.0, 2.0, 0.0], [3.0, 1.0, 2.0]])
+    full = gluon.loss.CTCLoss(layout='NTC')(nd.array(pred), label)
+    cut = gluon.loss.CTCLoss(layout='NTC')(
+        nd.array(pred), label, nd.array([4.0, 6.0]), nd.array([2.0, 3.0]))
+    assert np.isfinite(cut.asnumpy()).all()
+    # shorter sequences change the alignment -> different loss
+    assert not np.allclose(full.asnumpy(), cut.asnumpy())
+    # a flag without its tensor (or vice versa) is an error, not a
+    # silent full-length loss
+    with pytest.raises(TypeError):
+        nd.CTCLoss(nd.array(np.zeros((6, 2, 5), 'float32')),
+                   nd.array([[1.0, 2.0], [1.0, 2.0]]),
+                   use_data_lengths=True)
+    with pytest.raises(TypeError):
+        nd.CTCLoss(nd.array(np.zeros((6, 2, 5), 'float32')),
+                   nd.array([[1.0, 2.0], [1.0, 2.0]]),
+                   nd.array([3.0, 4.0]))
+
+
 def test_trainer_save_load_states(tmp_path):
     net = nn.Dense(4, in_units=3)
     net.initialize(ctx=mx.cpu())
