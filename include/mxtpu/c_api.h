@@ -83,6 +83,46 @@ int MXNDArrayLoad(const char* fname, mx_uint* out_size,
                   NDArrayHandle** out_arr, mx_uint* out_name_size,
                   const char*** out_names);
 
+/* -- symbol + executor (reference: c_api_symbolic.cc, c_api_executor.cc) */
+typedef void* SymbolHandle;
+typedef void* ExecutorHandle;
+
+int MXSymbolCreateFromJSON(const char* json, SymbolHandle* out);
+int MXSymbolFree(SymbolHandle handle);
+/* serialized graph; pointer valid until the next SaveToJSON */
+int MXSymbolSaveToJSON(SymbolHandle handle, const char** out_json);
+/* newline-joined name listings; pointer valid until the next listing */
+int MXSymbolListArguments(SymbolHandle handle, const char** out);
+int MXSymbolListAuxiliaryStates(SymbolHandle handle, const char** out);
+int MXSymbolListOutputs(SymbolHandle handle, const char** out);
+
+/* Bind with named input shapes (flat shape_data, per-input ndim);
+ * remaining shapes are inferred and allocated on the device.
+ * in_args/arg_grads/aux_states receive one NEW caller-owned handle per
+ * name in listing order; arg_grads entries are NULL where grad_req
+ * excludes the argument.  The handle arrays stay valid until the next
+ * SimpleBind on the thread.  The handles alias the executor state:
+ * writing an argument (e.g. an sgd_update step through
+ * MXImperativeInvoke) is seen by the next Forward, and Backward writes
+ * gradients into the arg_grads arrays. */
+int MXExecutorSimpleBind(SymbolHandle sym, int dev_type, int dev_id,
+                         const char* grad_req, mx_uint num_inputs,
+                         const char** input_keys,
+                         const mx_uint* input_shape_data,
+                         const mx_uint* input_shape_ndim,
+                         ExecutorHandle* out, mx_uint* num_in_args,
+                         NDArrayHandle** in_args,
+                         NDArrayHandle** arg_grads, mx_uint* num_aux,
+                         NDArrayHandle** aux_states);
+int MXExecutorFree(ExecutorHandle handle);
+int MXExecutorForward(ExecutorHandle handle, int is_train);
+/* head_grads may be empty (len 0) for loss-style single outputs */
+int MXExecutorBackward(ExecutorHandle handle, mx_uint len,
+                       NDArrayHandle* head_grads);
+/* NEW caller-owned output handles; array valid until next call */
+int MXExecutorOutputs(ExecutorHandle handle, mx_uint* out_size,
+                      NDArrayHandle** outputs);
+
 #ifdef __cplusplus
 }
 #endif

@@ -174,9 +174,8 @@ inline NDArray NDArray::Clone() const {
   return std::move(outs[0]);
 }
 
-inline std::vector<std::string> ListOps() {
-  const char* joined = nullptr;
-  Check(MXListAllOpNames(&joined));
+// split the ABI's newline-joined listing convention
+inline std::vector<std::string> SplitLines(const char* joined) {
   std::vector<std::string> out;
   std::string cur;
   for (const char* p = joined;; ++p) {
@@ -189,6 +188,12 @@ inline std::vector<std::string> ListOps() {
     }
   }
   return out;
+}
+
+inline std::vector<std::string> ListOps() {
+  const char* joined = nullptr;
+  Check(MXListAllOpNames(&joined));
+  return SplitLines(joined);
 }
 
 inline void Save(const std::string& fname,

@@ -1,0 +1,135 @@
+// C++ convenience binding over the symbol/executor C ABI
+// (include/mxtpu/c_api.h) — the analogue of the reference
+// cpp-package's Symbol/Executor (cpp-package/include/mxnet-cpp/
+// symbol.h, executor.h), scoped to the graph-training surface:
+// load a serialized symbol, SimpleBind, Forward/Backward, and the
+// caller drives parameter updates through Op("sgd_update") on the
+// aliased argument arrays.
+//
+// Header-only; link against libmxtpu_nd.so.
+#ifndef MXTPU_CPP_SYMBOL_HPP_
+#define MXTPU_CPP_SYMBOL_HPP_
+
+#include <map>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "ndarray.hpp"
+
+namespace mxtpu {
+
+class Symbol {
+ public:
+  explicit Symbol(const std::string& json) {
+    Check(MXSymbolCreateFromJSON(json.c_str(), &handle_));
+  }
+  Symbol(Symbol&& o) noexcept : handle_(o.handle_) { o.handle_ = nullptr; }
+  Symbol(const Symbol&) = delete;
+  Symbol& operator=(const Symbol&) = delete;
+  ~Symbol() {
+    if (handle_) MXSymbolFree(handle_);
+  }
+
+  SymbolHandle handle() const { return handle_; }
+
+  std::vector<std::string> ListArguments() const {
+    const char* s = nullptr;
+    Check(MXSymbolListArguments(handle_, &s));
+    return SplitLines(s);
+  }
+  std::vector<std::string> ListAuxiliaryStates() const {
+    const char* s = nullptr;
+    Check(MXSymbolListAuxiliaryStates(handle_, &s));
+    return SplitLines(s);
+  }
+  std::vector<std::string> ListOutputs() const {
+    const char* s = nullptr;
+    Check(MXSymbolListOutputs(handle_, &s));
+    return SplitLines(s);
+  }
+  std::string ToJSON() const {
+    const char* s = nullptr;
+    Check(MXSymbolSaveToJSON(handle_, &s));
+    return s;
+  }
+
+ private:
+  SymbolHandle handle_ = nullptr;
+};
+
+// A bound computation: owns the executor handle plus the argument/
+// gradient/aux arrays it aliases.  Args()/Grads() expose them by name;
+// mutating an arg (sgd_update through the op ABI's donation path) is
+// visible to the next Forward, and Backward fills the grad arrays.
+class Executor {
+ public:
+  Executor(const Symbol& sym,
+           const std::map<std::string, std::vector<mx_uint>>& input_shapes,
+           const std::string& grad_req = "write", int dev_type = 1,
+           int dev_id = 0) {
+    std::vector<const char*> keys;
+    std::vector<mx_uint> flat;
+    std::vector<mx_uint> ndims;
+    for (auto& kv : input_shapes) {
+      keys.push_back(kv.first.c_str());
+      ndims.push_back(static_cast<mx_uint>(kv.second.size()));
+      for (mx_uint d : kv.second) flat.push_back(d);
+    }
+    mx_uint n_args = 0, n_aux = 0;
+    NDArrayHandle *args = nullptr, *grads = nullptr, *aux = nullptr;
+    Check(MXExecutorSimpleBind(
+        sym.handle(), dev_type, dev_id, grad_req.c_str(),
+        static_cast<mx_uint>(keys.size()), keys.data(), flat.data(),
+        ndims.data(), &handle_, &n_args, &args, &grads, &n_aux, &aux));
+    arg_names_ = sym.ListArguments();
+    aux_names_ = sym.ListAuxiliaryStates();
+    for (mx_uint i = 0; i < n_args; ++i) {
+      args_.emplace(arg_names_[i], NDArray::Adopt(args[i]));
+      if (grads[i])
+        grads_.emplace(arg_names_[i], NDArray::Adopt(grads[i]));
+    }
+    for (mx_uint i = 0; i < n_aux; ++i)
+      aux_.emplace(aux_names_[i], NDArray::Adopt(aux[i]));
+  }
+  Executor(const Executor&) = delete;
+  Executor& operator=(const Executor&) = delete;
+  ~Executor() {
+    if (handle_) MXExecutorFree(handle_);
+  }
+
+  void Forward(bool is_train) {
+    Check(MXExecutorForward(handle_, is_train ? 1 : 0));
+  }
+
+  // loss-head graphs (SoftmaxOutput etc.) take no explicit head grads
+  void Backward() { Check(MXExecutorBackward(handle_, 0, nullptr)); }
+
+  std::vector<NDArray> Outputs() {
+    mx_uint n = 0;
+    NDArrayHandle* outs = nullptr;
+    Check(MXExecutorOutputs(handle_, &n, &outs));
+    std::vector<NDArray> result;
+    result.reserve(n);
+    for (mx_uint i = 0; i < n; ++i)
+      result.push_back(NDArray::Adopt(outs[i]));
+    return result;
+  }
+
+  std::map<std::string, NDArray>& Args() { return args_; }
+  std::map<std::string, NDArray>& Grads() { return grads_; }
+  std::map<std::string, NDArray>& Aux() { return aux_; }
+  const std::vector<std::string>& ArgNames() const { return arg_names_; }
+
+ private:
+  ExecutorHandle handle_ = nullptr;
+  std::vector<std::string> arg_names_;
+  std::vector<std::string> aux_names_;
+  std::map<std::string, NDArray> args_;
+  std::map<std::string, NDArray> grads_;
+  std::map<std::string, NDArray> aux_;
+};
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_CPP_SYMBOL_HPP_

@@ -206,3 +206,76 @@ def nd_load(fname):
     if isinstance(loaded, dict):
         return [(k, v) for k, v in loaded.items()]
     return [(None, v) for v in loaded]
+
+
+# ---------------------------------------------------------------------------
+# Symbolic + executor surface (reference: src/c_api/c_api_symbolic.cc and
+# c_api_executor.cc:661 — CreateFromJSON, SimpleBind, Forward, Backward).
+# A SymbolHandle is an owned PyObject* of a Symbol; an ExecutorHandle is
+# an owned PyObject* of CExecutor below.
+# ---------------------------------------------------------------------------
+
+def sym_from_json(json_str):
+    from mxnet_tpu.symbol import load_json
+    return load_json(json_str)
+
+
+def sym_to_json(sym):
+    return sym.tojson()
+
+
+def sym_list(sym, which):
+    """Newline-joined name listing (same marshaling as nd_list_ops)."""
+    if which == "arguments":
+        names = sym.list_arguments()
+    elif which == "aux":
+        names = sym.list_auxiliary_states()
+    elif which == "outputs":
+        names = sym.list_outputs()
+    else:
+        raise ValueError("unknown listing %r" % which)
+    return "\n".join(names)
+
+
+class CExecutor(object):
+    """One MXExecutorSimpleBind handle.
+
+    Keeps the bound executor; the arg/grad/aux NDArray objects handed to
+    the C caller at bind time are the SAME objects the executor reads
+    and writes (forward/backward update their ._data in place), so a C
+    training loop that mutates args through MXImperativeInvoke's
+    donation-rebind path and reads grads after backward just works.
+    """
+
+    def __init__(self, ex):
+        self.ex = ex
+
+
+def exec_simple_bind(sym, dev_type, dev_id, grad_req, keys, shapes):
+    import mxnet_tpu as mx
+    from mxnet_tpu.executor import Executor
+    ctx = mx.Context("tpu" if dev_type == 2 else "cpu", dev_id)
+    shape_dict = {k: tuple(int(d) for d in s)
+                  for k, s in zip(keys, shapes)}
+    # the internal dict-based entry point: variable names from the
+    # symbol JSON are user-chosen and may collide with simple_bind's
+    # own keyword parameters (ctx, grad_req, ...)
+    ex = Executor._simple_bind(sym._maybe_partition(), ctx, grad_req,
+                               None, shape_dict)
+    args = [ex.arg_dict[n] for n in sym.list_arguments()]
+    grads = [ex.grad_dict.get(n) for n in sym.list_arguments()]
+    auxs = [ex.aux_dict[n] for n in sym.list_auxiliary_states()]
+    return CExecutor(ex), args, grads, auxs
+
+
+def exec_forward(cex, is_train):
+    return list(cex.ex.forward(is_train=bool(is_train)))
+
+
+def exec_backward(cex, head_grads):
+    cex.ex.backward(out_grads=head_grads if head_grads else None)
+    return True
+
+
+def exec_outputs(cex):
+    return list(cex.ex.outputs)

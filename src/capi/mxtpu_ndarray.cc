@@ -289,3 +289,216 @@ MXTPU_API int MXNDArrayLoad(const char* fname, mx_uint* out_size,
   *out_names = g_loaded_names.data();
   return 0;
 }
+
+// ---------------------------------------------------------------------------
+// Symbol + executor surface (reference: src/c_api/c_api_symbolic.cc,
+// c_api_executor.cc).  SymbolHandle / ExecutorHandle are owned
+// PyObject* like NDArrayHandle; listings marshal as newline-joined
+// strings (the MXListAllOpNames convention) to keep the FFI shape
+// trivial for any binder.
+// ---------------------------------------------------------------------------
+
+typedef void* SymbolHandle;
+typedef void* ExecutorHandle;
+
+namespace {
+thread_local std::string g_sym_list;
+thread_local std::string g_sym_json;
+thread_local std::vector<NDArrayHandle> g_bind_args;
+thread_local std::vector<NDArrayHandle> g_bind_grads;
+thread_local std::vector<NDArrayHandle> g_bind_auxs;
+thread_local std::vector<NDArrayHandle> g_exec_outputs;
+
+// shared tail for the two listing-style string returns
+int StringResult(PyObject* r, std::string* store, const char** out) {
+  if (!r) {
+    SetErrorFromPython();
+    return -1;
+  }
+  const char* c = PyUnicode_AsUTF8(r);
+  if (!c) {
+    Py_DECREF(r);
+    SetErrorFromPython();
+    return -1;
+  }
+  *store = c;
+  Py_DECREF(r);
+  *out = store->c_str();
+  return 0;
+}
+
+// copy a bridge list of (NDArray | None) into caller-visible handles
+void HandlesFromList(PyObject* list, std::vector<NDArrayHandle>* dst) {
+  dst->clear();
+  for (Py_ssize_t i = 0; i < PyList_Size(list); ++i) {
+    PyObject* o = PyList_GetItem(list, i);
+    if (o == Py_None) {
+      dst->push_back(nullptr);
+    } else {
+      Py_INCREF(o);
+      dst->push_back(o);
+    }
+  }
+}
+}  // namespace
+
+MXTPU_API int MXSymbolCreateFromJSON(const char* json, SymbolHandle* out) {
+  GILGuard gil;
+  PyObject* args = Py_BuildValue("(s)", json);
+  PyObject* r = CallBridge("sym_from_json", args);
+  Py_DECREF(args);
+  if (!r) {
+    SetErrorFromPython();
+    return -1;
+  }
+  *out = r;
+  return 0;
+}
+
+MXTPU_API int MXSymbolFree(SymbolHandle handle) {
+  GILGuard gil;
+  Py_XDECREF(static_cast<PyObject*>(handle));
+  return 0;
+}
+
+MXTPU_API int MXSymbolSaveToJSON(SymbolHandle handle, const char** out_json) {
+  GILGuard gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* r = CallBridge("sym_to_json", args);
+  Py_DECREF(args);
+  return StringResult(r, &g_sym_json, out_json);
+}
+
+namespace {
+int SymList(SymbolHandle handle, const char* which, const char** out) {
+  GILGuard gil;
+  PyObject* args = Py_BuildValue("(Os)", static_cast<PyObject*>(handle),
+                                 which);
+  int rc = StringResult(CallBridge("sym_list", args), &g_sym_list, out);
+  Py_DECREF(args);
+  return rc;
+}
+}  // namespace
+
+MXTPU_API int MXSymbolListArguments(SymbolHandle h, const char** out) {
+  return SymList(h, "arguments", out);
+}
+
+MXTPU_API int MXSymbolListAuxiliaryStates(SymbolHandle h,
+                                          const char** out) {
+  return SymList(h, "aux", out);
+}
+
+MXTPU_API int MXSymbolListOutputs(SymbolHandle h, const char** out) {
+  return SymList(h, "outputs", out);
+}
+
+// Bind a symbol with named input shapes; remaining arg/aux shapes are
+// inferred and allocated.  in_args/arg_grads/aux_states receive one
+// NEW caller-owned handle per name in list-order (arg_grads entries
+// are NULL where grad_req excludes the arg).  The three arrays stay
+// valid until the next SimpleBind on the thread.
+MXTPU_API int MXExecutorSimpleBind(
+    SymbolHandle sym, int dev_type, int dev_id, const char* grad_req,
+    mx_uint num_inputs, const char** input_keys,
+    const mx_uint* input_shape_data, const mx_uint* input_shape_ndim,
+    ExecutorHandle* out, mx_uint* num_in_args, NDArrayHandle** in_args,
+    NDArrayHandle** arg_grads, mx_uint* num_aux,
+    NDArrayHandle** aux_states) {
+  GILGuard gil;
+  PyObject* keys = PyList_New(num_inputs);
+  PyObject* shapes = PyList_New(num_inputs);
+  size_t off = 0;
+  for (mx_uint i = 0; i < num_inputs; ++i) {
+    PyList_SetItem(keys, i, PyUnicode_FromString(input_keys[i]));
+    PyObject* shp = PyTuple_New(input_shape_ndim[i]);
+    for (mx_uint d = 0; d < input_shape_ndim[i]; ++d)
+      PyTuple_SetItem(shp, d,
+                      PyLong_FromUnsignedLong(input_shape_data[off + d]));
+    off += input_shape_ndim[i];
+    PyList_SetItem(shapes, i, shp);
+  }
+  PyObject* args = Py_BuildValue("(OiisOO)",
+                                 static_cast<PyObject*>(sym), dev_type,
+                                 dev_id, grad_req, keys, shapes);
+  Py_DECREF(keys);
+  Py_DECREF(shapes);
+  PyObject* r = CallBridge("exec_simple_bind", args);
+  Py_DECREF(args);
+  if (!r) {
+    SetErrorFromPython();
+    return -1;
+  }
+  PyObject* cex = PyTuple_GetItem(r, 0);
+  Py_INCREF(cex);
+  HandlesFromList(PyTuple_GetItem(r, 1), &g_bind_args);
+  HandlesFromList(PyTuple_GetItem(r, 2), &g_bind_grads);
+  HandlesFromList(PyTuple_GetItem(r, 3), &g_bind_auxs);
+  Py_DECREF(r);
+  *out = cex;
+  *num_in_args = static_cast<mx_uint>(g_bind_args.size());
+  *in_args = g_bind_args.data();
+  *arg_grads = g_bind_grads.data();
+  *num_aux = static_cast<mx_uint>(g_bind_auxs.size());
+  *aux_states = g_bind_auxs.data();
+  return 0;
+}
+
+MXTPU_API int MXExecutorFree(ExecutorHandle handle) {
+  GILGuard gil;
+  Py_XDECREF(static_cast<PyObject*>(handle));
+  return 0;
+}
+
+MXTPU_API int MXExecutorForward(ExecutorHandle handle, int is_train) {
+  GILGuard gil;
+  PyObject* args = Py_BuildValue("(Oi)", static_cast<PyObject*>(handle),
+                                 is_train);
+  PyObject* r = CallBridge("exec_forward", args);
+  Py_DECREF(args);
+  if (!r) {
+    SetErrorFromPython();
+    return -1;
+  }
+  Py_DECREF(r);  // outputs re-fetched via MXExecutorOutputs
+  return 0;
+}
+
+MXTPU_API int MXExecutorBackward(ExecutorHandle handle, mx_uint len,
+                                 NDArrayHandle* head_grads) {
+  GILGuard gil;
+  PyObject* grads = PyList_New(len);
+  for (mx_uint i = 0; i < len; ++i) {
+    PyObject* o = static_cast<PyObject*>(head_grads[i]);
+    Py_INCREF(o);
+    PyList_SetItem(grads, i, o);
+  }
+  PyObject* args = Py_BuildValue("(OO)", static_cast<PyObject*>(handle),
+                                 grads);
+  Py_DECREF(grads);
+  PyObject* r = CallBridge("exec_backward", args);
+  Py_DECREF(args);
+  if (!r) {
+    SetErrorFromPython();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXExecutorOutputs(ExecutorHandle handle, mx_uint* out_size,
+                                NDArrayHandle** outputs) {
+  GILGuard gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* r = CallBridge("exec_outputs", args);
+  Py_DECREF(args);
+  if (!r) {
+    SetErrorFromPython();
+    return -1;
+  }
+  HandlesFromList(r, &g_exec_outputs);
+  Py_DECREF(r);
+  *out_size = static_cast<mx_uint>(g_exec_outputs.size());
+  *outputs = g_exec_outputs.data();
+  return 0;
+}

@@ -148,3 +148,24 @@ def test_cpp_binding_example_trains(tmp_path):
                          capture_output=True, text=True, timeout=600)
     assert res.returncode == 0, res.stdout[-1500:] + res.stderr[-1500:]
     assert "CPP-TRAIN-OK" in res.stdout
+
+
+def test_cpp_symbolic_training_example(tmp_path):
+    """The symbolic C ABI (MXSymbolCreateFromJSON + MXExecutorSimpleBind
+    + Forward/Backward, include/mxtpu/cpp/symbol.hpp) trains a
+    symbol-JSON MLP classifier from C++ end to end (reference surface:
+    src/c_api/c_api_executor.cc)."""
+    lib = _build_lib()
+    binary = os.path.join(REPO, "build", "train_symbolic")
+    res = subprocess.run(
+        ["g++", "-std=c++17", "-I" + os.path.join(REPO, "include"),
+         os.path.join(REPO, "examples", "cpp", "train_symbolic.cpp"),
+         "-L" + os.path.dirname(lib), "-lmxtpu_nd", "-o", binary],
+        capture_output=True, text=True)
+    assert res.returncode == 0, res.stderr[-2000:]
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               LD_LIBRARY_PATH=os.path.dirname(lib))
+    res = subprocess.run([binary], env=env, capture_output=True,
+                         text=True, timeout=600)
+    assert res.returncode == 0, res.stdout[-1500:] + res.stderr[-1500:]
+    assert "symbolic C ABI training OK" in res.stdout
