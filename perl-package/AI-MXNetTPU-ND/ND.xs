@@ -1,0 +1,254 @@
+/* XS glue: AI::MXNetTPU::ND <-> libmxtpu_nd.so
+ *
+ * Wraps the TRAINING surface of the C ABI (include/mxtpu/c_api.h):
+ * NDArray lifecycle + copies, MXImperativeInvoke over every registered
+ * op (so fused optimizer updates run from Perl), and the symbolic
+ * executor (CreateFromJSON / SimpleBind / Forward / Backward) — the
+ * scope the reference's AI::MXNet reaches through c_api.h, vs the
+ * predict-only sibling module AI::MXNetTPU.
+ *
+ * Handles cross as UVs; float payloads as packed scalars (pack "f*").
+ */
+#define PERL_NO_GET_CONTEXT
+#include "EXTERN.h"
+#include "perl.h"
+#include "XSUB.h"
+
+#include <mxtpu/c_api.h>
+#include <stdlib.h>
+
+static void die_on(pTHX_ int rc, const char* what) {
+  if (rc != 0) croak("%s: %s", what, MXGetLastError());
+}
+
+MODULE = AI::MXNetTPU::ND  PACKAGE = AI::MXNetTPU::ND
+
+PROTOTYPES: DISABLE
+
+UV
+_nd_create(shape_ref)
+    SV* shape_ref
+  CODE:
+    {
+      AV* shp = (AV*)SvRV(shape_ref);
+      mx_uint ndim = (mx_uint)(av_len(shp) + 1), i;
+      mx_uint* dims = (mx_uint*)malloc(ndim * sizeof(mx_uint));
+      for (i = 0; i < ndim; i++)
+        dims[i] = (mx_uint)SvUV(*av_fetch(shp, i, 0));
+      NDArrayHandle h = NULL;
+      int rc = MXNDArrayCreate(dims, ndim, 1, 0, 0, 0, &h);
+      free(dims);
+      die_on(aTHX_ rc, "MXNDArrayCreate");
+      RETVAL = PTR2UV(h);
+    }
+  OUTPUT:
+    RETVAL
+
+void
+_nd_free(handle)
+    UV handle
+  CODE:
+    die_on(aTHX_ MXNDArrayFree(INT2PTR(NDArrayHandle, handle)),
+           "MXNDArrayFree");
+
+void
+_nd_copy_from(handle, packed)
+    UV handle
+    SV* packed
+  CODE:
+    {
+      STRLEN len;
+      const char* buf = SvPV(packed, len);
+      die_on(aTHX_ MXNDArraySyncCopyFromCPU(
+                 INT2PTR(NDArrayHandle, handle), buf, (size_t)len),
+             "MXNDArraySyncCopyFromCPU");
+    }
+
+SV*
+_nd_to_packed(handle, nbytes)
+    UV handle
+    UV nbytes
+  CODE:
+    {
+      SV* out = newSV(nbytes);
+      SvPOK_on(out);
+      die_on(aTHX_ MXNDArraySyncCopyToCPU(
+                 INT2PTR(NDArrayHandle, handle), SvPVX(out),
+                 (size_t)nbytes),
+             "MXNDArraySyncCopyToCPU");
+      SvCUR_set(out, nbytes);
+      RETVAL = out;
+    }
+  OUTPUT:
+    RETVAL
+
+void
+_nd_shape(handle)
+    UV handle
+  PPCODE:
+    {
+      mx_uint ndim = 0, i;
+      const mx_uint* dims = NULL;
+      die_on(aTHX_ MXNDArrayGetShape(INT2PTR(NDArrayHandle, handle),
+                                     &ndim, &dims),
+             "MXNDArrayGetShape");
+      EXTEND(SP, ndim);
+      for (i = 0; i < ndim; i++) mPUSHu(dims[i]);
+    }
+
+void
+_invoke(op_name, in_ref, params_ref)
+    const char* op_name
+    SV* in_ref
+    SV* params_ref
+  PPCODE:
+    {
+      AV* ins = (AV*)SvRV(in_ref);
+      HV* params = (HV*)SvRV(params_ref);
+      int n_in = (int)(av_len(ins) + 1), i;
+      NDArrayHandle* handles =
+          (NDArrayHandle*)malloc(n_in * sizeof(NDArrayHandle));
+      for (i = 0; i < n_in; i++)
+        handles[i] = INT2PTR(NDArrayHandle,
+                             SvUV(*av_fetch(ins, i, 0)));
+      int n_params = (int)HvUSEDKEYS(params);
+      const char** keys =
+          (const char**)malloc(n_params * sizeof(char*));
+      const char** vals =
+          (const char**)malloc(n_params * sizeof(char*));
+      HE* he;
+      i = 0;
+      hv_iterinit(params);
+      while ((he = hv_iternext(params)) != NULL) {
+        STRLEN klen;
+        keys[i] = HePV(he, klen);
+        vals[i] = SvPV_nolen(HeVAL(he));
+        i++;
+      }
+      int n_out = 0;
+      NDArrayHandle* outs = NULL;
+      int rc = MXImperativeInvoke(op_name, n_in, handles, &n_out, &outs,
+                                  n_params, keys, vals);
+      free(handles); free(keys); free(vals);
+      die_on(aTHX_ rc, "MXImperativeInvoke");
+      EXTEND(SP, n_out);
+      for (i = 0; i < n_out; i++) mPUSHu(PTR2UV(outs[i]));
+    }
+
+UV
+_sym_from_json(json)
+    const char* json
+  CODE:
+    {
+      SymbolHandle h = NULL;
+      die_on(aTHX_ MXSymbolCreateFromJSON(json, &h),
+             "MXSymbolCreateFromJSON");
+      RETVAL = PTR2UV(h);
+    }
+  OUTPUT:
+    RETVAL
+
+void
+_sym_free(handle)
+    UV handle
+  CODE:
+    die_on(aTHX_ MXSymbolFree(INT2PTR(SymbolHandle, handle)),
+           "MXSymbolFree");
+
+const char*
+_sym_arguments(handle)
+    UV handle
+  CODE:
+    {
+      const char* s = NULL;
+      die_on(aTHX_ MXSymbolListArguments(
+                 INT2PTR(SymbolHandle, handle), &s),
+             "MXSymbolListArguments");
+      RETVAL = s;
+    }
+  OUTPUT:
+    RETVAL
+
+void
+_simple_bind(sym, grad_req, keys_ref, shapes_ref)
+    UV sym
+    const char* grad_req
+    SV* keys_ref
+    SV* shapes_ref
+  PPCODE:
+    {
+      AV* keys = (AV*)SvRV(keys_ref);
+      AV* shapes = (AV*)SvRV(shapes_ref);
+      mx_uint n = (mx_uint)(av_len(keys) + 1), i, j, total = 0;
+      const char** ckeys = (const char**)malloc(n * sizeof(char*));
+      mx_uint* ndims = (mx_uint*)malloc(n * sizeof(mx_uint));
+      for (i = 0; i < n; i++) {
+        AV* shp = (AV*)SvRV(*av_fetch(shapes, i, 0));
+        ndims[i] = (mx_uint)(av_len(shp) + 1);
+        total += ndims[i];
+      }
+      mx_uint* flat = (mx_uint*)malloc(total * sizeof(mx_uint));
+      mx_uint off = 0;
+      for (i = 0; i < n; i++) {
+        ckeys[i] = SvPV_nolen(*av_fetch(keys, i, 0));
+        AV* shp = (AV*)SvRV(*av_fetch(shapes, i, 0));
+        for (j = 0; j < ndims[i]; j++)
+          flat[off++] = (mx_uint)SvUV(*av_fetch(shp, j, 0));
+      }
+      ExecutorHandle ex = NULL;
+      mx_uint n_args = 0, n_aux = 0;
+      NDArrayHandle *args = NULL, *grads = NULL, *aux = NULL;
+      int rc = MXExecutorSimpleBind(
+          INT2PTR(SymbolHandle, sym), 1, 0, grad_req, n, ckeys, flat,
+          ndims, &ex, &n_args, &args, &grads, &n_aux, &aux);
+      free(ckeys); free(ndims); free(flat);
+      die_on(aTHX_ rc, "MXExecutorSimpleBind");
+      /* flat return: exec, n_args, args..., grads... (0 where null),
+         n_aux, aux... */
+      EXTEND(SP, 2 + 2 * n_args + 1 + n_aux);
+      mPUSHu(PTR2UV(ex));
+      mPUSHu(n_args);
+      for (i = 0; i < n_args; i++) mPUSHu(PTR2UV(args[i]));
+      for (i = 0; i < n_args; i++)
+        mPUSHu(grads[i] ? PTR2UV(grads[i]) : 0);
+      mPUSHu(n_aux);
+      for (i = 0; i < n_aux; i++) mPUSHu(PTR2UV(aux[i]));
+    }
+
+void
+_exec_free(handle)
+    UV handle
+  CODE:
+    die_on(aTHX_ MXExecutorFree(INT2PTR(ExecutorHandle, handle)),
+           "MXExecutorFree");
+
+void
+_exec_forward(handle, is_train)
+    UV handle
+    int is_train
+  CODE:
+    die_on(aTHX_ MXExecutorForward(INT2PTR(ExecutorHandle, handle),
+                                   is_train),
+           "MXExecutorForward");
+
+void
+_exec_backward(handle)
+    UV handle
+  CODE:
+    die_on(aTHX_ MXExecutorBackward(INT2PTR(ExecutorHandle, handle), 0,
+                                    NULL),
+           "MXExecutorBackward");
+
+void
+_exec_outputs(handle)
+    UV handle
+  PPCODE:
+    {
+      mx_uint n = 0, i;
+      NDArrayHandle* outs = NULL;
+      die_on(aTHX_ MXExecutorOutputs(INT2PTR(ExecutorHandle, handle),
+                                     &n, &outs),
+             "MXExecutorOutputs");
+      EXTEND(SP, n);
+      for (i = 0; i < n; i++) mPUSHu(PTR2UV(outs[i]));
+    }

@@ -1,0 +1,196 @@
+package AI::MXNetTPU::ND;
+
+# Perl TRAINING binding for the mxnet_tpu framework — wraps the
+# NDArray/op-invoke + symbolic executor C ABI (include/mxtpu/c_api.h,
+# libmxtpu_nd.so), the same surface the reference's AI::MXNet reaches
+# through c_api.h.  The predict-only sibling is AI::MXNetTPU.
+#
+#   use AI::MXNetTPU::ND;
+#   my $sym = AI::MXNetTPU::ND::Symbol->new($json);
+#   my $ex  = $sym->simple_bind(shapes => { data => [32, 8],
+#                                           softmax_label => [32] });
+#   $ex->arg('data')->copy_from(\@floats);
+#   $ex->forward(1);  $ex->backward;
+#   AI::MXNetTPU::ND::invoke('sgd_update',
+#       [$ex->arg($_), $ex->grad($_)], { lr => 0.1 }) for @params;
+
+use strict;
+use warnings;
+
+our $VERSION = '0.01';
+
+require XSLoader;
+XSLoader::load('AI::MXNetTPU::ND', $VERSION);
+
+# invoke(op_name, \@ndarrays, \%params) -> list of new NDArrays
+sub invoke {
+    my ($op, $ins, $params) = @_;
+    my @in_handles = map { $_->{handle} } @$ins;
+    my %str_params = map { $_ => "" . $params->{$_} } keys %{ $params || {} };
+    my @out = AI::MXNetTPU::ND::_invoke($op, \@in_handles, \%str_params);
+    return map { AI::MXNetTPU::ND::NDArray->_adopt($_) } @out;
+}
+
+package AI::MXNetTPU::ND::NDArray;
+
+use strict;
+use warnings;
+use Carp qw(croak);
+
+sub new {
+    my ($class, $shape) = @_;
+    my $h = AI::MXNetTPU::ND::_nd_create($shape);
+    return bless { handle => $h, owned => 1 }, $class;
+}
+
+sub _adopt {
+    my ($class, $h) = @_;
+    return bless { handle => $h, owned => 1 }, $class;
+}
+
+# non-owning view (executor-aliased handles are freed with the batch)
+sub _view {
+    my ($class, $h) = @_;
+    return bless { handle => $h, owned => 0 }, $class;
+}
+
+sub shape { my ($self) = @_;
+            return AI::MXNetTPU::ND::_nd_shape($self->{handle}); }
+
+sub size { my ($self) = @_; my $n = 1; $n *= $_ for $self->shape;
+           return $n; }
+
+sub copy_from {
+    my ($self, $values) = @_;
+    croak "copy_from expects an array ref" unless ref $values eq 'ARRAY';
+    AI::MXNetTPU::ND::_nd_copy_from($self->{handle},
+                                    pack('f*', @$values));
+    return $self;
+}
+
+sub to_list {
+    my ($self) = @_;
+    my $packed = AI::MXNetTPU::ND::_nd_to_packed($self->{handle},
+                                                 4 * $self->size);
+    return [ unpack('f*', $packed) ];
+}
+
+sub DESTROY {
+    my ($self) = @_;
+    return unless $self->{owned} && defined $self->{handle};
+    AI::MXNetTPU::ND::_nd_free($self->{handle});
+    $self->{handle} = undef;
+}
+
+package AI::MXNetTPU::ND::Symbol;
+
+use strict;
+use warnings;
+
+sub new {
+    my ($class, $json) = @_;
+    my $h = AI::MXNetTPU::ND::_sym_from_json($json);
+    return bless { handle => $h }, $class;
+}
+
+sub list_arguments {
+    my ($self) = @_;
+    return [ split /\n/,
+             AI::MXNetTPU::ND::_sym_arguments($self->{handle}) ];
+}
+
+sub simple_bind {
+    my ($self, %args) = @_;
+    my $shapes = $args{shapes} or die "simple_bind needs shapes";
+    my @keys = sort keys %$shapes;
+    my @shp = map { $shapes->{$_} } @keys;
+    my @flat = AI::MXNetTPU::ND::_simple_bind(
+        $self->{handle}, $args{grad_req} // 'write', \@keys, \@shp);
+    my $ex = shift @flat;
+    my $n_args = shift @flat;
+    my @arg_h = splice @flat, 0, $n_args;
+    my @grad_h = splice @flat, 0, $n_args;
+    my $n_aux = shift @flat;
+    my @aux_h = splice @flat, 0, $n_aux;
+    my $names = $self->list_arguments;
+    my (%args_by, %grads_by);
+    for my $i (0 .. $n_args - 1) {
+        # the executor aliases these handles; Perl frees them on
+        # executor DESTROY, not per-NDArray
+        $args_by{$names->[$i]} =
+            AI::MXNetTPU::ND::NDArray->_view($arg_h[$i]);
+        $grads_by{$names->[$i]} =
+            AI::MXNetTPU::ND::NDArray->_view($grad_h[$i])
+            if $grad_h[$i];
+    }
+    return AI::MXNetTPU::ND::Executor->_new(
+        $ex, \%args_by, \%grads_by, [@arg_h, grep { $_ } @grad_h,
+                                     @aux_h]);
+}
+
+sub DESTROY {
+    my ($self) = @_;
+    return unless defined $self->{handle};
+    AI::MXNetTPU::ND::_sym_free($self->{handle});
+    $self->{handle} = undef;
+}
+
+package AI::MXNetTPU::ND::Executor;
+
+use strict;
+use warnings;
+
+sub _new {
+    my ($class, $h, $args, $grads, $owned_handles) = @_;
+    return bless { handle => $h, args => $args, grads => $grads,
+                   owned => $owned_handles }, $class;
+}
+
+sub arg  { my ($self, $name) = @_; return $self->{args}{$name}; }
+sub grad { my ($self, $name) = @_; return $self->{grads}{$name}; }
+sub arg_names { my ($self) = @_; return [ sort keys %{ $self->{args} } ]; }
+
+sub forward {
+    my ($self, $is_train) = @_;
+    AI::MXNetTPU::ND::_exec_forward($self->{handle}, $is_train ? 1 : 0);
+    return $self;
+}
+
+sub backward {
+    my ($self) = @_;
+    AI::MXNetTPU::ND::_exec_backward($self->{handle});
+    return $self;
+}
+
+sub outputs {
+    my ($self) = @_;
+    my @h = AI::MXNetTPU::ND::_exec_outputs($self->{handle});
+    return [ map { AI::MXNetTPU::ND::NDArray->_adopt($_) } @h ];
+}
+
+sub DESTROY {
+    my ($self) = @_;
+    return unless defined $self->{handle};
+    AI::MXNetTPU::ND::_nd_free($_) for @{ $self->{owned} || [] };
+    AI::MXNetTPU::ND::_exec_free($self->{handle});
+    $self->{handle} = undef;
+}
+
+1;
+
+__END__
+
+=head1 NAME
+
+AI::MXNetTPU::ND - Perl training binding for the mxnet_tpu framework
+
+=head1 DESCRIPTION
+
+Wraps the NDArray/op-invoke and symbolic executor C ABI
+(C<include/mxtpu/c_api.h>) exposed by C<libmxtpu_nd.so>: create device
+arrays, invoke any registered operator (including the fused optimizer
+updates), bind a symbol JSON graph, and run Forward/Backward — a full
+training loop from Perl.  Build the library first with
+C<make -C src/capi>, then this module with C<perl Makefile.PL && make>.
+
+=cut

@@ -1,10 +1,10 @@
-"""AI::MXNetTPU — the Perl language binding over the C predict ABI
-(reference: perl-package/ wraps the C API; predict-only scope here
-mirrors the reference's matlab/ binding).
+"""The Perl language bindings over the C ABIs (reference:
+perl-package/ wraps the C API).
 
-Builds the XS module if needed and runs its prove-style test, which
-generates a model with the Python layer, loads it from Perl through
-libmxtpu_predict.so, and asserts the logits match."""
+AI::MXNetTPU wraps the predict ABI (libmxtpu_predict.so);
+AI::MXNetTPU::ND wraps the NDArray/op-invoke + symbolic executor ABI
+(libmxtpu_nd.so) and trains a model from Perl.  Each test builds the
+XS module if needed and runs its prove-style test script."""
 
 import os
 import shutil
@@ -13,7 +13,6 @@ import subprocess
 import pytest
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-_PKG = os.path.join(_REPO, "perl-package", "AI-MXNetTPU")
 
 
 def _have_toolchain():
@@ -25,27 +24,46 @@ def _have_toolchain():
     return probe.returncode == 0
 
 
-@pytest.mark.skipif(not _have_toolchain(),
-                    reason="perl XS toolchain unavailable")
-def test_perl_predict_binding():
-    lib = os.path.join(_REPO, "build", "libmxtpu_predict.so")
+def _build_and_run(pkg, lib_name, so_relpath, test_script):
+    """Shared scaffold: ensure the C library and XS module are built,
+    then run the package's Perl test under -Mblib."""
+    pkg_dir = os.path.join(_REPO, "perl-package", pkg)
+    lib = os.path.join(_REPO, "build", lib_name)
     if not os.path.exists(lib):
-        r = subprocess.run(["make", "-C", os.path.join(_REPO, "src", "capi")],
+        r = subprocess.run(["make", "-C", os.path.join(_REPO, "src",
+                                                       "capi")],
                            capture_output=True, text=True)
         assert r.returncode == 0, r.stderr[-2000:]
 
-    if not os.path.exists(os.path.join(_PKG, "blib", "arch", "auto",
-                                       "AI", "MXNetTPU", "MXNetTPU.so")):
-        r = subprocess.run(["perl", "Makefile.PL"], cwd=_PKG,
+    if not os.path.exists(os.path.join(pkg_dir, "blib", "arch", "auto",
+                                       *so_relpath)):
+        r = subprocess.run(["perl", "Makefile.PL"], cwd=pkg_dir,
                            capture_output=True, text=True)
-        assert r.returncode == 0, r.stderr[-2000:]
-        r = subprocess.run(["make"], cwd=_PKG, capture_output=True,
+        assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
+        r = subprocess.run(["make"], cwd=pkg_dir, capture_output=True,
                            text=True)
-        assert r.returncode == 0, r.stderr[-2000:]
+        assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
 
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=_REPO)
-    r = subprocess.run(["perl", "-Mblib", "t/predict.t"], cwd=_PKG,
+    r = subprocess.run(["perl", "-Mblib", test_script], cwd=pkg_dir,
                        capture_output=True, text=True, env=env,
                        timeout=600)
     assert r.returncode == 0, (r.stdout + r.stderr)[-3000:]
     assert "not ok" not in r.stdout, r.stdout[-3000:]
+
+
+@pytest.mark.skipif(not _have_toolchain(),
+                    reason="perl XS toolchain unavailable")
+def test_perl_predict_binding():
+    _build_and_run("AI-MXNetTPU", "libmxtpu_predict.so",
+                   ("AI", "MXNetTPU", "MXNetTPU.so"), "t/predict.t")
+
+
+@pytest.mark.skipif(not _have_toolchain(),
+                    reason="perl XS toolchain unavailable")
+def test_perl_training_binding():
+    """AI::MXNetTPU::ND drives a full training loop from Perl through
+    the NDArray/op-invoke + symbolic executor C ABI (reference scope:
+    perl-package/AI-MXNet trains through c_api.h)."""
+    _build_and_run("AI-MXNetTPU-ND", "libmxtpu_nd.so",
+                   ("AI", "MXNetTPU", "ND", "ND.so"), "t/train.t")
