@@ -30,6 +30,10 @@ from .symbol.symbol import Symbol, _infer_shapes
 
 __all__ = ["Executor"]
 
+# differentiable-leaf suffix for Embedding sparse_grad perturbations
+# (train_step diff keys; see ops/sparse_graph.py SparseGradWeight)
+_SPARSE_VALS = "!sparse_vals"
+
 
 def _build_eval(symbol, training):
     """Build the pure graph-evaluation function:
@@ -93,9 +97,67 @@ class Executor:
             grad_req = dict(zip(self._arg_names, grad_req))
         self._grad_req = {n: grad_req.get(n, "null")
                           for n in self._arg_names}
+        # CSR args flow through the traced graph as (values, indices,
+        # indptr) carriers (ops/sparse_graph.py); gradients THROUGH a
+        # csr input are not computed (the reference likewise has no
+        # backward for its csr-lhs dot kernels) — a blanket grad_req
+        # simply excludes them
+        from .ndarray.sparse import CSRNDArray
+        for n, a in arg_dict.items():
+            if isinstance(a, CSRNDArray):
+                self._grad_req[n] = "null"
         self._grad_names = [n for n in self._arg_names
                             if self._grad_req[n] != "null" and
                             grad_dict.get(n) is not None]
+        # Embedding(sparse_grad=True): deliver the weight grad as
+        # row_sparse (ids, rows) pairs instead of a dense (vocab, dim)
+        # buffer — see ops/sparse_graph.py SparseGradWeight
+        self._sparse_embeds = {}
+        for node in symbol._topo():
+            if node.is_var or node.op.name != "Embedding":
+                continue
+            sg = node.params.get("sparse_grad", False)
+            if isinstance(sg, str):
+                sg = sg in ("True", "true", "1")
+            if not sg:
+                continue
+            wsrc, _ = node.inputs[1]
+            dsrc, _ = node.inputs[0]
+            if self._grad_req.get(wsrc.name, "null") == "null":
+                continue
+            if not (wsrc.is_var and dsrc.is_var):
+                raise MXNetError(
+                    "Embedding sparse_grad=True needs variable data and "
+                    "weight inputs (got computed inputs for %r)"
+                    % node.name)
+            if self._grad_req[wsrc.name] == "add":
+                raise MXNetError(
+                    "grad_req='add' is unsupported for sparse_grad "
+                    "Embedding weights (rsp pair grads are rebuilt each "
+                    "backward)")
+            if wsrc.name in self._sparse_embeds:
+                raise MXNetError(
+                    "weight %r feeds multiple sparse_grad Embedding "
+                    "nodes; share a dense-grad weight or split it"
+                    % wsrc.name)
+            self._sparse_embeds[wsrc.name] = (
+                dsrc.name, int(node.params.get("output_dim")))
+        if self._sparse_embeds:
+            # a sparse-grad weight must feed ONLY its Embedding node:
+            # train_step wraps it in a SparseGradWeight carrier, which
+            # other ops (e.g. a tied output projection) cannot consume
+            for node in symbol._topo():
+                if node.is_var:
+                    continue
+                for i, (src, _) in enumerate(node.inputs):
+                    if src.is_var and src.name in self._sparse_embeds \
+                            and not (node.op.name == "Embedding"
+                                     and i == 1):
+                        raise MXNetError(
+                            "weight %r has sparse_grad=True but is also "
+                            "consumed by %r (%s); weight tying requires "
+                            "a dense gradient" % (src.name, node.name,
+                                                  node.op.name))
         self.outputs = []
         # the PRNG key must live on this executor's device: under a
         # two-platform session (cpu-vs-tpu consistency runs) a
@@ -121,13 +183,31 @@ class Executor:
             lambda arg_map, aux_map, key: eval_train(arg_map, aux_map, key))
 
         grad_names = self._grad_names
+        sparse_embeds = {n: v for n, v in self._sparse_embeds.items()
+                         if n in grad_names}
 
         def train_step(arg_map, aux_map, key, out_cots):
-            diff = {n: arg_map[n] for n in grad_names}
+            diff = {n: arg_map[n] for n in grad_names
+                    if n not in sparse_embeds}
+            for w, (dname, dim) in sparse_embeds.items():
+                # the differentiable leaf is the zero per-occurrence
+                # perturbation; the weight itself stays non-diff so no
+                # dense (vocab, dim) cotangent is ever formed
+                ids = arg_map[dname]
+                diff[w + _SPARSE_VALS] = jnp.zeros(ids.shape + (dim,),
+                                                   arg_map[w].dtype)
             rest = {n: v for n, v in arg_map.items() if n not in diff}
 
             def run(d):
-                outs, auxu = eval_train(dict(rest, **d), aux_map, key)
+                amap = dict(rest)
+                for n, v in d.items():
+                    if n.endswith(_SPARSE_VALS):
+                        from .ops.sparse_graph import SparseGradWeight
+                        w = n[:-len(_SPARSE_VALS)]
+                        amap[w] = SparseGradWeight(rest[w], v)
+                    else:
+                        amap[n] = v
+                outs, auxu = eval_train(amap, aux_map, key)
                 return outs, auxu
 
             (outs, auxu), vjp_fn = jax.vjp(lambda d: run(d), diff)
@@ -137,6 +217,15 @@ class Executor:
                     for c, o in zip(cots, outs)]
             zero_aux = jax.tree_util.tree_map(jnp.zeros_like, auxu)
             grads = vjp_fn((cots, zero_aux))[0]
+            # canonicalize rsp grads in-graph: unique sorted rows with
+            # summed values (row-wise optimizer kernels require
+            # duplicate-free ids; tail slots pad with an out-of-bounds
+            # id that every .at[] consumer drops)
+            from .ops.sparse_graph import dedup_rsp_pairs
+            for w, (dname, dim) in sparse_embeds.items():
+                vals = grads.pop(w + _SPARSE_VALS)
+                grads[w] = dedup_rsp_pairs(arg_map[dname], vals,
+                                           arg_map[w].shape[0])
             return outs, auxu, grads
 
         self._jit_train_step = jax.jit(train_step)
@@ -147,6 +236,10 @@ class Executor:
     def _init_grouped(self):
         """Replace the whole-graph jits with the segment-chained
         model-parallel path (see grouped_executor.py)."""
+        if self._sparse_embeds:
+            raise MXNetError(
+                "Embedding sparse_grad=True is not supported together "
+                "with group2ctx model parallelism")
         from .grouped_executor import build_grouped_eval
         sym = self._symbol
         aux_names = self._aux_names
@@ -262,7 +355,16 @@ class Executor:
 
     # -- execution ---------------------------------------------------------
     def _arg_map(self):
-        return {n: a._data for n, a in self.arg_dict.items()}
+        from .ndarray.sparse import CSRNDArray
+        from .ops.sparse_graph import CsrCarrier
+        out = {}
+        for n, a in self.arg_dict.items():
+            if isinstance(a, CSRNDArray):
+                out[n] = CsrCarrier(a._data, a._aux[0], a._aux[1],
+                                    a.shape)
+            else:
+                out[n] = a._data
+        return out
 
     def _aux_map(self):
         return {n: a._data for n, a in self.aux_dict.items()}
@@ -356,6 +458,15 @@ class Executor:
             self.aux_dict[n]._data = v
         self.outputs = [NDArray(o) for o in outs]
         for n in self._grad_names:
+            if n in self._sparse_embeds:
+                # rsp pair grad: a NEW RowSparseNDArray per backward,
+                # already deduped to unique sorted rows in-graph
+                from .ndarray.sparse import RowSparseNDArray
+                ids, vals = grads[n]
+                self.grad_dict[n] = RowSparseNDArray(
+                    NDArray(vals), NDArray(ids),
+                    tuple(self.arg_dict[n].shape))
+                continue
             g = grads[n]
             dst = self.grad_dict[n]
             g = g.astype(dst.dtype) if g.dtype != dst.dtype else g

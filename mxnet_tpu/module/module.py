@@ -144,10 +144,20 @@ class _ExecGroup:
         (reference: kvstore local push/pull)."""
         if len(self.execs) == 1:
             return
+        from ..ndarray.sparse import BaseSparseNDArray
         for name in self.param_names:
             if self.grad_req[name] == "null":
                 continue
             total = self.execs[0].grad_dict[name]
+            if isinstance(total, BaseSparseNDArray):
+                # rsp grads (Embedding sparse_grad): sparse_add grows
+                # the component arrays, so replace the dict entry
+                # wholesale instead of writing back ._data alone
+                for ex in self.execs[1:]:
+                    total = total + ex.grad_dict[name]
+                for ex in self.execs:
+                    ex.grad_dict[name] = total
+                continue
             for ex in self.execs[1:]:
                 total._data = (total + ex.grad_dict[name].as_in_context(
                     self.contexts[0]))._data

@@ -230,21 +230,14 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
     sparse paths)."""
     if isinstance(lhs, CSRNDArray) and isinstance(rhs, NDArray) and \
             not isinstance(rhs, BaseSparseNDArray):
-        vals = lhs._data
-        cols = lhs._aux[0].astype(jnp.int32)
-        indptr = lhs._aux[1].astype(jnp.int32)
-        nnz = vals.shape[0]
-        row_ids = jnp.searchsorted(indptr, jnp.arange(nnz), side="right") - 1
-        if transpose_a:
-            # out[c] += v * rhs[r]  -> scatter-add over columns
-            contrib = vals[:, None] * rhs._data[row_ids]
-            out = jnp.zeros((lhs.shape[1], rhs.shape[1]), vals.dtype)
-            out = out.at[cols].add(contrib)
-            return NDArray(out)
-        gathered = vals[:, None] * rhs._data[cols]
-        out = jax.ops.segment_sum(gathered, row_ids,
-                                  num_segments=lhs.shape[0])
-        return NDArray(out)
+        # one lowering shared with the graph-level dot op
+        from ..ops.sparse_graph import CsrCarrier, csr_dot_dense
+        carrier = CsrCarrier(lhs._data, lhs._aux[0], lhs._aux[1],
+                             lhs.shape)
+        r = rhs._data
+        if transpose_b:
+            r = jnp.swapaxes(r, -1, -2) if r.ndim > 1 else r
+        return NDArray(csr_dot_dense(carrier, r, transpose_a))
     if not isinstance(lhs, BaseSparseNDArray) and \
             isinstance(rhs, BaseSparseNDArray):
         return NDArray(jnp.dot(lhs._data, rhs.todense()._data))

@@ -508,9 +508,17 @@ def _deformable_psroi_pooling(data, rois, trans=None, spatial_scale=1.0,
 
 @register_op("cast_storage")
 def _cast_storage_op(data, stype="default"):
-    """Storage-format cast (cast_storage.cc:71).  Traced values are
-    dense; the stype tag matters to the eager/kvstore layer, so inside
-    a graph this is the identity with the tag recorded on the node."""
+    """Storage-format cast (cast_storage.cc:71).  A CSR carrier bound
+    as a graph input densifies for real (gather/scatter lowering, see
+    ops/sparse_graph.py).  Dense->sparse inside a graph stays a tagged
+    identity: the nnz of a traced value is data-dependent, which XLA's
+    static shapes cannot express — the eager layer (ndarray/sparse.py
+    cast_storage) does the real conversion outside jit."""
+    from .sparse_graph import CsrCarrier
+    if isinstance(data, CsrCarrier):
+        if stype in ("default", "row_sparse"):
+            return data.todense()
+        return data
     return data + 0
 
 

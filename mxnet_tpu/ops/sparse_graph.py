@@ -1,0 +1,154 @@
+"""Graph-level sparse lowering — (values, indices[, indptr]) pairs
+inside traced graphs (SURVEY §7 hard part (b)).
+
+Reference: ``src/operator/tensor/cast_storage.cc:71`` +
+``dot-inl.h`` sparse kernels behind storage-type inference
+(``src/executor/infer_graph_attr_pass.cc``).
+
+TPU-native design: XLA has no sparse tensors and jit needs static
+shapes, so a sparse value crossing a traced graph is a registered
+PYTREE carrier of dense component arrays.  ``jax.jit``/``jax.vjp``
+treat the carrier as structure, ops dispatch on its type, and the
+lowering is gather/segment_sum/scatter HLO — no dense projection of
+the sparse operand is ever materialized:
+
+* ``CsrCarrier`` — a CSR matrix bound as a graph input.  The executor
+  builds one per ``CSRNDArray`` argument (executor.py ``_arg_map``);
+  the ``dot`` op contracts it against dense right-hand sides via the
+  same segment-sum lowering the eager layer uses (shared here), and
+  ``cast_storage(stype='default')`` densifies it in-graph.
+* ``SparseGradWeight`` — the Embedding ``sparse_grad=True`` path.  The
+  executor's train step passes the weight wrapped with a zero
+  per-occurrence perturbation ``vals``; the op computes
+  ``take(stop_gradient(W), ids) + vals`` so the whole-graph vjp yields
+  d(loss)/d(vals) — exactly the row_sparse gradient rows — while the
+  stop_gradient guarantees NO dense (vocab, dim) cotangent exists
+  anywhere in the backward program (the reference gets the same shape
+  from SparseEmbedding's backward, indexing_op.cc).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["CsrCarrier", "SparseGradWeight", "csr_dot_dense",
+           "dedup_rsp_pairs"]
+
+
+@jax.tree_util.register_pytree_node_class
+class CsrCarrier:
+    """CSR components as one traced value: data/indices (nnz,),
+    indptr (rows+1,), dense ``shape`` static."""
+
+    def __init__(self, data, indices, indptr, shape):
+        self.data = data
+        self.indices = indices
+        self.indptr = indptr
+        self.shape = tuple(int(s) for s in shape)
+
+    def tree_flatten(self):
+        return (self.data, self.indices, self.indptr), self.shape
+
+    @classmethod
+    def tree_unflatten(cls, shape, children):
+        return cls(*children, shape)
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    def row_ids(self):
+        """Row id per nnz entry, from indptr (static nnz)."""
+        nnz = self.data.shape[0]
+        return jnp.searchsorted(self.indptr.astype(jnp.int32),
+                                jnp.arange(nnz), side="right") - 1
+
+    def todense(self):
+        out = jnp.zeros(self.shape, self.data.dtype)
+        return out.at[self.row_ids(),
+                      self.indices.astype(jnp.int32)].add(self.data)
+
+
+@jax.tree_util.register_pytree_node_class
+class SparseGradWeight:
+    """Embedding weight + a zero per-occurrence perturbation whose
+    cotangent IS the row_sparse gradient values (see module
+    docstring)."""
+
+    def __init__(self, weight, vals):
+        self.weight = weight
+        self.vals = vals
+
+    def tree_flatten(self):
+        return (self.weight, self.vals), None
+
+    @classmethod
+    def tree_unflatten(cls, _, children):
+        return cls(*children)
+
+
+def dedup_rsp_pairs(ids, vals, num_rows):
+    """Canonicalize per-occurrence (ids, vals) pairs into sorted UNIQUE
+    rows with summed values — jit-able at static shape.
+
+    Row-wise optimizer kernels (lazy sgd/adagrad, sparse.py
+    ``*_row_update``) use ``.at[rows].set`` and apply weight decay per
+    listed row, so duplicate ids would corrupt their updates.  The
+    output keeps the input's (n, dim) shape: slot i < num_unique holds
+    a unique sorted id with its occurrences summed; the tail slots get
+    id == num_rows — deliberately OUT OF BOUNDS, which jax scatter
+    drops (and gather clamps, its result then dropped on write), so
+    padding is a no-op for every .at[] consumer."""
+    flat_ids = ids.reshape(-1).astype(jnp.int32)
+    n = flat_ids.shape[0]
+    order = jnp.argsort(flat_ids)
+    s_ids = flat_ids[order]
+    s_vals = vals.reshape(n, -1)[order]
+    is_new = jnp.concatenate([jnp.ones((1,), jnp.int32),
+                              (s_ids[1:] != s_ids[:-1]).astype(jnp.int32)])
+    seg = jnp.cumsum(is_new) - 1            # segment index per slot
+    summed = jax.ops.segment_sum(s_vals, seg, num_segments=n)
+    seg_ids = jnp.full((n,), num_rows, jnp.int32).at[seg].set(s_ids)
+    return seg_ids, summed
+
+
+def csr_dot_dense(csr, rhs, transpose_a=False):
+    """csr × dense matmul by gather + segment-sum (transpose: scatter-
+    add over columns) — the one lowering shared by the eager
+    ``ndarray.sparse.dot`` and the graph-level ``dot`` op.  ``rhs`` may
+    be 1-d or 2-d like the reference kernel (dot-inl.h csr paths)."""
+    vals = csr.data
+    cols = csr.indices.astype(jnp.int32)
+    rhs2 = rhs.reshape(rhs.shape[0], -1)
+    row_ids = csr.row_ids()
+    if transpose_a:
+        # out[col] += v * rhs[row]
+        contrib = vals[:, None] * rhs2[row_ids]
+        out = jnp.zeros((csr.shape[1], rhs2.shape[1]), vals.dtype)
+        out = out.at[cols].add(contrib)
+    else:
+        gathered = vals[:, None] * rhs2[cols]
+        out = jax.ops.segment_sum(gathered, row_ids,
+                                  num_segments=csr.shape[0])
+    if rhs.ndim == 1:
+        return out.reshape(-1)
+    return out
+
+
+def dense_dot_maybe_sparse(a, b, transpose_a, transpose_b, dense_dot):
+    """Dispatch helper for the registered ``dot`` op: route CSR
+    carriers to the sparse lowering, everything else to ``dense_dot``.
+
+    transpose_b on a CSR lhs and csr-rhs contraction fall back to
+    densification — the reference's dot also densifies the pairs it
+    has no sparse kernel for (dot-inl.h fallback)."""
+    if isinstance(a, CsrCarrier):
+        if isinstance(b, CsrCarrier):
+            b = b.todense()
+        if transpose_b:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return csr_dot_dense(a, b, transpose_a)
+    if isinstance(b, CsrCarrier):
+        b = b.todense()
+    return dense_dot(a, b, transpose_a, transpose_b)

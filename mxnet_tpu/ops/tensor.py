@@ -289,6 +289,15 @@ def _batch_take(a, indices):
 @register_op("Embedding")
 def _embedding(data, weight, input_dim=0, output_dim=0, dtype="float32",
                sparse_grad=False):
+    from .sparse_graph import SparseGradWeight
+    if isinstance(weight, SparseGradWeight):
+        # sparse_grad train path (see sparse_graph module docstring):
+        # the vjp flows ONLY through the per-occurrence vals, so the
+        # weight gradient is delivered as row_sparse pairs and no
+        # (vocab, dim) dense cotangent exists in the backward program
+        rows = jnp.take(jax.lax.stop_gradient(weight.weight),
+                        data.astype(jnp.int32), axis=0)
+        return rows + weight.vals
     return jnp.take(weight, data.astype(jnp.int32), axis=0)
 
 
@@ -445,8 +454,7 @@ def _shuffle(rng, x):
 # ---------------------------------------------------------------------------
 
 
-@register_op("dot")
-def _dot(a, b, transpose_a=False, transpose_b=False):
+def _dense_dot(a, b, transpose_a, transpose_b):
     if transpose_a:
         a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
     if transpose_b:
@@ -456,6 +464,13 @@ def _dot(a, b, transpose_a=False, transpose_b=False):
         return jnp.dot(a, b, precision=prec)
     # mxnet dot contracts last axis of a with first axis of b
     return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]), precision=prec)
+
+
+@register_op("dot")
+def _dot(a, b, transpose_a=False, transpose_b=False):
+    from .sparse_graph import dense_dot_maybe_sparse
+    return dense_dot_maybe_sparse(a, b, transpose_a, transpose_b,
+                                  _dense_dot)
 
 
 @register_op("batch_dot")

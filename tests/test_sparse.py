@@ -223,3 +223,106 @@ def test_sparse_linear_example_dist_converges():
          "--kv-store", "dist_sync", "--min-accuracy", "0.9"],
         cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
     assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+
+
+# ---------------------------------------------------------------------------
+# graph-level sparse lowering (ops/sparse_graph.py): CSR carriers and
+# Embedding sparse_grad rsp pairs INSIDE traced graphs — SURVEY §7 hard
+# part (b); reference: cast_storage.cc:71, dot-inl.h sparse kernels,
+# indexing_op.cc SparseEmbedding backward.
+# ---------------------------------------------------------------------------
+
+def test_graph_csr_dot_and_cast_storage():
+    import mxnet_tpu as mx
+    rs = np.random.RandomState(0)
+    dense = rs.randn(6, 8).astype(np.float32)
+    dense[dense < 0.5] = 0
+    csr = sp.csr_matrix(dense, shape=(6, 8))
+    W = rs.randn(8, 4).astype(np.float32)
+
+    x = mx.sym.Variable('x', stype='csr')
+    w = mx.sym.Variable('w')
+    ex = mx.sym.dot(x, w).bind(mx.cpu(), {'x': csr, 'w': nd.array(W)})
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(), dense.dot(W),
+                               rtol=1e-5)
+
+    w2 = mx.sym.Variable('w2')
+    rhs = rs.randn(6, 3).astype(np.float32)
+    ex_t = mx.sym.dot(x, w2, transpose_a=True).bind(
+        mx.cpu(), {'x': csr, 'w2': nd.array(rhs)})
+    np.testing.assert_allclose(ex_t.forward()[0].asnumpy(),
+                               dense.T.dot(rhs), rtol=1e-4)
+
+    ex_c = mx.sym.cast_storage(x, stype='default').bind(
+        mx.cpu(), {'x': csr})
+    np.testing.assert_allclose(ex_c.forward()[0].asnumpy(), dense,
+                               rtol=1e-6)
+
+    # grads flow to the dense operand; the csr arg is auto-excluded
+    ex_g = mx.sym.dot(x, w).bind(
+        mx.cpu(), {'x': csr, 'w': nd.array(W)},
+        args_grad={'w': nd.zeros((8, 4))}, grad_req='write')
+    ex_g.forward(is_train=True)
+    ex_g.backward(nd.ones((6, 4)))
+    np.testing.assert_allclose(
+        ex_g.grad_dict['w'].asnumpy(),
+        dense.T.dot(np.ones((6, 4), np.float32)), rtol=1e-4)
+
+
+def test_embedding_sparse_grad_rsp_pair():
+    """sparse_grad=True delivers the weight grad as a RowSparseNDArray
+    of per-occurrence (ids, rows) pairs whose densification equals the
+    dense-path grad — with NO scatter in the compiled train step (the
+    dense path needs one for its (vocab, dim) cotangent)."""
+    import re
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+
+    vocab, dim, B, T = 50, 4, 3, 5
+    rs = np.random.RandomState(7)
+    ids = rs.randint(0, vocab, (B, T)).astype(np.float32)
+    ids[:, 0] = 3.0  # force duplicate ids: occurrences must SUM
+    W = rs.randn(vocab, dim).astype(np.float32)
+    d = mx.sym.Variable('ids')
+    wv = mx.sym.Variable('emb_weight')
+
+    def bind(sparse):
+        emb = mx.sym.Embedding(d, wv, input_dim=vocab, output_dim=dim,
+                               sparse_grad=sparse)
+        loss = mx.sym.sum(emb * emb)
+        ex = loss.bind(
+            mx.cpu(), {'ids': nd.array(ids), 'emb_weight': nd.array(W)},
+            args_grad={'emb_weight': nd.zeros((vocab, dim))},
+            grad_req={'emb_weight': 'write', 'ids': 'null'})
+        ex.forward(is_train=True)
+        ex.backward()
+        return ex
+
+    ex_s, ex_d = bind(True), bind(False)
+    g = ex_s.grad_dict['emb_weight']
+    assert isinstance(g, sp.RowSparseNDArray)
+    assert g.data.shape == (B * T, dim)  # static slot count
+    np.testing.assert_allclose(g.todense().asnumpy(),
+                               ex_d.grad_dict['emb_weight'].asnumpy(),
+                               rtol=1e-5)
+    # pairs are canonical: sorted unique ids, out-of-bounds padding
+    # (== vocab) on the tail slots with zero values — duplicate-free
+    # for the row-wise lazy optimizer kernels
+    gids = g.indices.asnumpy().astype(np.int64)
+    valid = gids[gids < vocab]
+    assert len(set(valid)) == len(valid)
+    assert (np.sort(valid) == valid).all()
+    assert np.all(g.data.asnumpy()[gids >= vocab] == 0)
+
+    # the sparse path's train step never materializes a (vocab, dim)
+    # cotangent: no scatter at that size (the dedup's own scatters are
+    # (n,)-shaped); the dense path needs exactly that scatter
+    def vocab_scatters(ex):
+        jp = str(jax.make_jaxpr(ex._jit_train_step)(
+            ex._arg_map(), ex._aux_map(), ex._key, [jnp.ones(())]))
+        return [ln for ln in jp.splitlines()
+                if "scatter" in ln and "f32[%d,%d]" % (vocab, dim) in ln]
+
+    assert not vocab_scatters(ex_s)
+    assert vocab_scatters(ex_d)
