@@ -41,7 +41,14 @@ def _build_eval(symbol, training):
     order = symbol._topo()
     out_entries = list(symbol._outputs)
 
+    # ops that consume CSR carriers natively; every other op gets the
+    # densified value — the reference's storage-type fallback
+    # (infer_graph_attr_pass.cc dispatches to dense kernels with a
+    # storage fallback warning)
+    csr_aware = ("dot", "cast_storage")
+
     def fn(arg_map, aux_map, key):
+        from .ops.sparse_graph import CsrCarrier
         vals = {}
         aux_updates = {}
         for pos, node in enumerate(order):
@@ -55,6 +62,9 @@ def _build_eval(symbol, training):
                 continue
             op = node.op
             ins = [vals[(id(s), i)] for (s, i) in node.inputs]
+            if op.name not in csr_aware:
+                ins = [v.todense() if isinstance(v, CsrCarrier) else v
+                       for v in ins]
             params = node.params
             if "training" in op.param_names:
                 params = dict(params, training=training)
@@ -76,6 +86,16 @@ def _build_eval(symbol, training):
         return outputs, aux_updates
 
     return fn
+
+
+def _wrap_out(o):
+    """Graph output -> NDArray; CSR carriers surface as CSRNDArray."""
+    from .ops.sparse_graph import CsrCarrier
+    if isinstance(o, CsrCarrier):
+        from .ndarray.sparse import CSRNDArray
+        return CSRNDArray(NDArray(o.data), NDArray(o.indices),
+                          NDArray(o.indptr), o.shape)
+    return NDArray(o)
 
 
 class Executor:
@@ -142,6 +162,22 @@ class Executor:
                     % wsrc.name)
             self._sparse_embeds[wsrc.name] = (
                 dsrc.name, int(node.params.get("output_dim")))
+        # swap the grad buffer for an rsp container ONCE at bind so the
+        # handle a caller grabs (args_grad, the C ABI's arg_grads) stays
+        # aliased across backwards — writeback mutates it in place.
+        # Until the first backward it holds one zero row at an
+        # out-of-bounds id (todense == zeros).
+        if self._sparse_embeds:
+            from .ndarray.sparse import RowSparseNDArray
+            for n in list(self._sparse_embeds):
+                if n in self._grad_names:
+                    dense = grad_dict[n]
+                    dim = self._sparse_embeds[n][1]
+                    grad_dict[n] = RowSparseNDArray(
+                        NDArray(jnp.zeros((1, dim), dense.dtype)),
+                        NDArray(jnp.full((1,), dense.shape[0],
+                                         jnp.int32)),
+                        tuple(dense.shape))
         if self._sparse_embeds:
             # a sparse-grad weight must feed ONLY its Embedding node:
             # train_step wraps it in a SparseGradWeight carrier, which
@@ -409,7 +445,7 @@ class Executor:
             self._pending = (self._arg_map(), self._aux_map(), key)
         for n, v in auxu.items():
             self.aux_dict[n]._data = v
-        self.outputs = [NDArray(o) for o in outs]
+        self.outputs = [_wrap_out(o) for o in outs]
         if self._monitor is not None:
             if getattr(self, "_monitor_all", False):
                 taps = self._monitor_taps(self._arg_map(),
@@ -456,16 +492,16 @@ class Executor:
             _materialize(cots, self, arg_map, aux_map))
         for n, v in auxu.items():
             self.aux_dict[n]._data = v
-        self.outputs = [NDArray(o) for o in outs]
+        self.outputs = [_wrap_out(o) for o in outs]
         for n in self._grad_names:
             if n in self._sparse_embeds:
-                # rsp pair grad: a NEW RowSparseNDArray per backward,
-                # already deduped to unique sorted rows in-graph
-                from .ndarray.sparse import RowSparseNDArray
+                # rsp pair grad, deduped to unique sorted rows
+                # in-graph; the container object is stable from bind
+                # time (caller handles alias it) — update in place
                 ids, vals = grads[n]
-                self.grad_dict[n] = RowSparseNDArray(
-                    NDArray(vals), NDArray(ids),
-                    tuple(self.arg_dict[n].shape))
+                dst = self.grad_dict[n]
+                dst._data = vals
+                dst._aux[0] = ids
                 continue
             g = grads[n]
             dst = self.grad_dict[n]

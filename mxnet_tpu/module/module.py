@@ -150,13 +150,22 @@ class _ExecGroup:
                 continue
             total = self.execs[0].grad_dict[name]
             if isinstance(total, BaseSparseNDArray):
-                # rsp grads (Embedding sparse_grad): sparse_add grows
-                # the component arrays, so replace the dict entry
-                # wholesale instead of writing back ._data alone
+                # rsp grads (Embedding sparse_grad): sparse_add
+                # concatenates shards (duplicate row ids), so
+                # re-canonicalize to unique sorted rows — the row-wise
+                # lazy optimizer kernels require duplicate-free ids —
+                # and give each exec its OWN container (a shared one
+                # would make the next backwards clobber each other)
+                from ..ops.sparse_graph import dedup_rsp_pairs
+                from ..ndarray import NDArray as _ND
                 for ex in self.execs[1:]:
                     total = total + ex.grad_dict[name]
+                ids, vals = dedup_rsp_pairs(total.indices._data,
+                                            total.data._data,
+                                            total.shape[0])
                 for ex in self.execs:
-                    ex.grad_dict[name] = total
+                    ex.grad_dict[name] = type(total)(
+                        _ND(vals), _ND(ids), total.shape)
                 continue
             for ex in self.execs[1:]:
                 total._data = (total + ex.grad_dict[name].as_in_context(

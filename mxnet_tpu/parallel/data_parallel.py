@@ -212,25 +212,30 @@ class ParallelTrainer:
                 self._params[n] = self._put(frozen_arrays[n], P())
                 self._opt_state[n] = ()
                 continue
-            arr = params[n].data()._data
-            if cdtype is not None:
-                master = arr.astype(jnp.float32)
-                arr = arr.astype(cdtype)
-                # f32 states + trailing f32 master copy (mp op
-                # signature: ..., mom, weight32)
-                states = [jnp.zeros_like(master)
-                          for _ in range(self._opt_n_states)]
-                states.append(master)
-            else:
-                # states match the stored weight dtype so fused updates
-                # neither promote nor retrace
-                states = [jnp.zeros_like(arr)
-                          for _ in range(self._opt_n_states)]
+            arr, states = self._state_for_array(params[n].data()._data)
             self._params[n] = self._put(arr, self._spec_for(arr, n))
             self._opt_state[n] = tuple(
                 self._put(s, self._spec_for(s, n)) for s in states)
         self._aux = {n: self._put(params[n].data()._data, P())
                      for n in self.aux_names}
+
+    def _state_for_array(self, arr):
+        """(stored array, fresh optimizer states) for one parameter,
+        honoring multi_precision (bf16 compute + f32 master copy)."""
+        if self.multi_precision:
+            master = arr.astype(jnp.float32)
+            arr = arr.astype(jnp.bfloat16)
+            # f32 states + trailing f32 master copy (mp op signature:
+            # ..., mom, weight32)
+            states = [jnp.zeros_like(master)
+                      for _ in range(self._opt_n_states)]
+            states.append(master)
+        else:
+            # states match the stored weight dtype so fused updates
+            # neither promote nor retrace
+            states = [jnp.zeros_like(arr)
+                      for _ in range(self._opt_n_states)]
+        return arr, states
 
     def _infer_frozen(self, data_shape, label_shape):
         """Zero arrays for the frozen (non-Parameter) graph args at the
@@ -748,3 +753,199 @@ class ParallelTrainer:
     @property
     def params(self):
         return self._params
+
+
+class PipelineTrainer(ParallelTrainer):
+    """GPipe pipeline parallelism as a trainer-level peer of DP/TP.
+
+    The net must be a stack (HybridSequential-style ``_children``) of
+    ARCHITECTURALLY IDENTICAL blocks — same parameter shapes per block,
+    activation shape preserved (the transformer-block case,
+    parallel/pipeline.py).  With S = the mesh's ``pp`` axis size and
+    C = len(children) (C % S == 0), each pp device owns C/S consecutive
+    blocks; per-block parameters are STACKED into (C, ...) leaves
+    sharded ``P('pp')``, so weights AND optimizer state live
+    stage-local, and the train step streams ``microbatches``
+    microbatches through the loop-skew schedule with activations
+    hopping stage-to-stage over ``ppermute``.  Composes with a dp axis:
+    mesh ``{'dp': d, 'pp': s}`` shards the batch over dp while the
+    pipeline runs inside each dp row.
+
+    Everything else (optimizer kernels, LARS, grad clip, LR schedule,
+    checkpointed state) is inherited from ParallelTrainer — the stacked
+    leaves are ordinary named parameters to the step builder.
+
+    Restriction: blocks with auxiliary state (BatchNorm running stats)
+    are rejected — per-stage aux writeback inside the scanned schedule
+    is not implemented (reference group2ctx model parallelism has the
+    same limitation per placed segment).
+    """
+
+    _STACK = "pp:"
+
+    def __init__(self, net, loss, microbatches, **kwargs):
+        super().__init__(net, loss, **kwargs)
+        if "pp" not in self.mesh.shape:
+            raise ValueError(
+                "PipelineTrainer needs a mesh with a 'pp' axis "
+                "(got axes %r); make_mesh({'dp': d, 'pp': s})"
+                % (tuple(self.mesh.axis_names),))
+        if "dp" not in self.mesh.shape:
+            raise ValueError(
+                "PipelineTrainer needs a 'dp' axis for the batch "
+                "layout (use {'dp': 1, 'pp': s} for pure pipeline)")
+        self.microbatches = int(microbatches)
+        if self.shard_params:
+            raise ValueError("shard_params (ZeRO over dp) is not "
+                             "supported together with the pp stack")
+        # stacked leaves shard along pp on their leading (block) axis
+        self.param_specs.setdefault(r"\App:", P("pp"))
+
+    # -- tracing ----------------------------------------------------------
+    def _trace(self, x, y):
+        from .. import symbol as sym_mod
+        from ..executor import _build_eval
+        from .pipeline import pipeline_apply
+
+        children = list(self.net._children.values())
+        S = self.mesh.shape["pp"]
+        if not children or len(children) % S != 0:
+            raise ValueError(
+                "net has %d child blocks; need a positive multiple of "
+                "the pp axis size %d" % (len(children), S))
+        per_stage = len(children) // S
+
+        # trace child 0 once; all blocks share its graph with their own
+        # parameter slice
+        data = sym_mod.var("data0")
+        out0 = children[0](data)
+        if out0.list_auxiliary_states():
+            raise NotImplementedError(
+                "pipeline stages with auxiliary state (BatchNorm "
+                "running stats) are not supported")
+        child_eval_t = _build_eval(out0, True)
+        child_eval_i = _build_eval(out0, False)
+        child_args = [a for a in out0.list_arguments() if a != "data0"]
+
+        # local (prefix-stripped) name -> child-0 graph arg name
+        def locals_of(block):
+            pre = block.prefix
+            out = {}
+            for p in block.collect_params().values():
+                local = p.name[len(pre):] if p.name.startswith(pre) \
+                    else p.name
+                out[local] = p
+            return out
+
+        child0_locals = locals_of(children[0])
+        self._local_to_arg = {}
+        for arg in child_args:
+            pre = children[0].prefix
+            local = arg[len(pre):] if arg.startswith(pre) else arg
+            if local not in child0_locals:
+                raise ValueError(
+                    "cannot map child graph arg %r to a block "
+                    "parameter" % arg)
+            self._local_to_arg[local] = arg
+        self._block_locals = sorted(self._local_to_arg)
+        self._per_block_params = []
+        for i, c in enumerate(children):
+            loc = locals_of(c)
+            if sorted(loc) != self._block_locals:
+                raise ValueError(
+                    "block %d parameters %r differ from block 0's %r — "
+                    "pipeline stages must be architecturally identical"
+                    % (i, sorted(loc), self._block_locals))
+            self._per_block_params.append(loc)
+
+        # loss traced on the final activation
+        pred = sym_mod.var("pred0")
+        label = sym_mod.var("label0")
+        loss_sym = self.loss(pred, label)
+        loss_eval_t = _build_eval(loss_sym, True)
+        loss_eval_i = _build_eval(loss_sym, False)
+        extra = [a for a in loss_sym.list_arguments()
+                 if a not in ("pred0", "label0")]
+        if extra or loss_sym.list_auxiliary_states():
+            raise NotImplementedError(
+                "parametrized losses are not supported in the pipeline "
+                "trainer (loss args %r)" % extra)
+
+        M = self.microbatches
+        mesh = self.mesh
+        stack = self._STACK
+        local_to_arg = self._local_to_arg
+        locals_sorted = self._block_locals
+
+        def _pipe_forward(amap, key, training):
+            child_eval = child_eval_t if training else child_eval_i
+            x_in = amap["data0"]
+            B = x_in.shape[0]
+            if B % M != 0:
+                raise ValueError(
+                    "batch %d not divisible by microbatches %d" % (B, M))
+            xm = x_in.reshape((M, B // M) + x_in.shape[1:])
+            stage_params = {
+                loc: amap[stack + loc].reshape(
+                    (S, per_stage) + amap[stack + loc].shape[1:])
+                for loc in locals_sorted}
+
+            def stage_fn(pslice, xmb):
+                # distinct randomness per (stage, sub-block); masks DO
+                # repeat across microbatches of one step — a pipeline-
+                # semantics caveat vs the sequential trainer
+                k_stage = jax.random.fold_in(
+                    key, jax.lax.axis_index("pp"))
+
+                def body(h, scanned):
+                    pj, j = scanned
+                    cam = {local_to_arg[loc]: pj[loc]
+                           for loc in locals_sorted}
+                    cam["data0"] = h
+                    outs, _ = child_eval(
+                        cam, {}, jax.random.fold_in(k_stage, j))
+                    return outs[0], None
+                h, _ = jax.lax.scan(body, xmb,
+                                    (pslice, jnp.arange(per_stage)))
+                return h
+
+            out = pipeline_apply(stage_fn, stage_params, xm,
+                                 axis_name="pp", mesh=mesh,
+                                 x_spec=P(None, "dp"))
+            return out.reshape((B,) + out.shape[2:])
+
+        def eval_train(amap, aux, key):
+            pred_v = _pipe_forward(amap, key, True)
+            louts, _ = loss_eval_t(
+                {"pred0": pred_v, "label0": amap["label0"]}, {}, key)
+            return [louts[0]], {}
+
+        def eval_infer(amap, aux, key):
+            pred_v = _pipe_forward(amap, key, False)
+            louts, _ = loss_eval_i(
+                {"pred0": pred_v, "label0": amap["label0"]}, {}, key)
+            return [louts[0]], {}
+
+        def fwd_eval(amap, aux, key):
+            return [_pipe_forward(amap, key, False)], {}
+
+        self._eval = eval_train
+        self._eval_infer = eval_infer
+        self._fwd_eval = fwd_eval
+        self.param_names = [stack + loc for loc in locals_sorted]
+        self.aux_names = []
+
+    def _gather_state(self, data_shape=None, label_shape=None):
+        self._resolve_opt()
+        self._frozen = frozenset()
+        self._params = {}
+        self._opt_state = {}
+        for loc in self._block_locals:
+            stacked = jnp.stack([blk[loc].data()._data
+                                 for blk in self._per_block_params])
+            name = self._STACK + loc
+            arr, states = self._state_for_array(stacked)
+            self._params[name] = self._put(arr, self._spec_for(arr, name))
+            self._opt_state[name] = tuple(
+                self._put(s, self._spec_for(s, name)) for s in states)
+        self._aux = {}

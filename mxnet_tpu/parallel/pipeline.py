@@ -24,14 +24,16 @@ __all__ = ["pipeline_apply"]
 
 
 def pipeline_apply(stage_fn, stage_params, x_micro, axis_name="pp",
-                   mesh=None):
+                   mesh=None, x_spec=None):
     """Run S pipeline stages over microbatches.
 
     stage_fn(params_i, x) -> y : one stage's computation (same shape in
         and out across stages, the usual transformer-block case).
     stage_params : pytree whose leaves have leading dim S — leaf i is
         stage i's weights (sharded over *axis_name*).
-    x_micro : (M, B, ...) microbatched input (replicated).
+    x_micro : (M, B, ...) microbatched input (replicated, or laid out
+        per *x_spec* — e.g. P(None, "dp") composes the pipeline with a
+        data-parallel batch axis; outputs keep the same layout).
     Returns (M, B, ...) outputs of the final stage.
 
     Schedule: T = M + S - 1 ticks of [receive from left neighbor ->
@@ -88,8 +90,9 @@ def pipeline_apply(stage_fn, stage_params, x_micro, axis_name="pp",
 
     if mesh is not None:
         param_specs = jax.tree.map(lambda _: P(axis_name), stage_params)
+        xs = P() if x_spec is None else x_spec
         return shard_map(shard_fn, mesh=mesh,
-                         in_specs=(param_specs, P()),
-                         out_specs=P(), check_rep=False)(
+                         in_specs=(param_specs, xs),
+                         out_specs=xs, check_rep=False)(
             stage_params, x_micro)
     return shard_fn(stage_params, x_micro)

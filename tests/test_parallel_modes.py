@@ -394,3 +394,78 @@ def test_transformer_lm_tensor_parallel_preset():
     # at least one projection is really tp-sharded on device
     qn = [n for n in tb.param_names if n.endswith("query_weight")][0]
     assert tuple(tb._params[qn].sharding.spec)[:1] == ("tp",)
+
+
+def test_pipeline_trainer_matches_sequential():
+    """Trainer-grade PP (VERDICT r4 item 9): a 4-block net trained via
+    PipelineTrainer on a dp x pp mesh gives the SAME loss trajectory as
+    the plain sequential ParallelTrainer, with stacked weights and
+    optimizer state sharded along pp."""
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel.data_parallel import (ParallelTrainer,
+                                                  PipelineTrainer)
+
+    D = 16
+
+    def build():
+        net2 = nn.HybridSequential()
+        for i in range(4):
+            net2.add(nn.Dense(D, activation="tanh",
+                              prefix="blk%d_" % i))
+        net2.initialize()
+        net2(mx.nd.array(np.zeros((2, D), np.float32)))
+        return net2
+
+    rs = np.random.RandomState(0)
+    X = rs.randn(16, D).astype(np.float32)
+    Y = rs.randn(16, D).astype(np.float32)
+    lossfn = gluon.loss.L2Loss()
+
+    net_a = build()
+    tr_a = ParallelTrainer(
+        net_a, lossfn, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+        mesh=make_mesh({"dp": 1}, jax.devices()[:1]))
+    net_b = build()
+    pa = {p.name: p for p in net_a.collect_params().values()}
+    for p in net_b.collect_params().values():
+        p.set_data(mx.nd.array(pa[p.name].data().asnumpy()))
+    tr_b = PipelineTrainer(
+        net_b, lossfn, microbatches=4, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+        mesh=make_mesh({"dp": 2, "pp": 4}))
+
+    for _ in range(3):
+        la = float(tr_a.fit_batch(X, Y))
+        lb = float(tr_b.fit_batch(X, Y))
+        assert abs(la - lb) < 1e-4 * max(1.0, abs(la)), (la, lb)
+
+    # stacked leaves and their optimizer state live stage-local
+    for n, w in tr_b._params.items():
+        assert tuple(w.sharding.spec)[:1] == ("pp",), (n, w.sharding)
+        for s in tr_b._opt_state[n]:
+            assert tuple(s.sharding.spec)[:1] == ("pp",), n
+
+
+def test_pipeline_trainer_rejects_nonuniform_stages():
+    import pytest
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel.data_parallel import PipelineTrainer
+
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(16, prefix="a_"), nn.Dense(8, prefix="b_"),
+             nn.Dense(16, prefix="c_"), nn.Dense(16, prefix="d_"))
+    net2.initialize()
+    net2(mx.nd.array(np.zeros((2, 16), np.float32)))
+    tr = PipelineTrainer(net2, gluon.loss.L2Loss(), microbatches=2,
+                         optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.1},
+                         mesh=make_mesh({"dp": 2, "pp": 4}))
+    with pytest.raises(Exception):
+        tr.fit_batch(np.zeros((8, 16), np.float32),
+                     np.zeros((8, 16), np.float32))
