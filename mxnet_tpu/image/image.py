@@ -538,6 +538,17 @@ class ImageIter(DataIter):
             CreateAugmenter(data_shape)
         self._n_threads = num_threads or min(8, os.cpu_count() or 1)
         self._pool = None
+        # native fast path: decode+resize+crop+mirror in the C++
+        # libjpeg team (io/native_decode.py).  Only engaged when the
+        # caller passes the pipeline spec (ImageRecordIter does for
+        # plain classification configs) AND the library is built.
+        self._native_cfg = None
+        self._native_pool = None
+        native_pipeline = kwargs.get("native_pipeline")
+        if native_pipeline is not None:
+            from ..io.native_decode import available as _native_ok
+            if _native_ok():
+                self._native_cfg = dict(native_pipeline)
         self.reset()
 
     @property
@@ -593,10 +604,54 @@ class ImageIter(DataIter):
         return label, _np.ascontiguousarray(
             _np.transpose(img, (2, 0, 1)).astype(_np.float32))
 
+    def _ensure_native(self):
+        """Build the C++ decode team lazily (first batch)."""
+        if self._native_pool is None:
+            from ..io.native_decode import NativeDecodePool
+            cfg = self._native_cfg
+            self._native_pool = NativeDecodePool(
+                self._n_threads, self.data_shape[1:],
+                resize=cfg.get("resize", 0),
+                rand_crop=cfg.get("rand_crop", False),
+                rand_mirror=cfg.get("rand_mirror", False))
+        return self._native_pool
+
+    def _next_native(self, raws, pad):
+        """Batch path through the libjpeg worker team
+        (src/io/jpeg_decode_pool.cc): decode + resize + crop + mirror
+        run in C++ threads; mean/std normalization is one vectorized
+        numpy pass over the assembled batch.  Returns None when any
+        record is not a decodable JPEG — the caller re-runs the batch
+        through the cv2 chain, which also handles PNG-packed records."""
+        cfg = self._native_cfg
+        bufs = [bytes(buf) for _, buf in raws]
+        if not all(b[:2] == b"\xff\xd8" for b in bufs):
+            return None
+        out, ok = self._ensure_native().decode_batch(bufs)
+        if not ok.all():
+            return None
+        data = out.astype(_np.float32)
+        mean, std = cfg.get("mean"), cfg.get("std")
+        if mean is not None:
+            data -= mean
+        if std is not None:
+            data /= std
+        data = _np.ascontiguousarray(data.transpose(0, 3, 1, 2))
+        if pad:
+            data = _np.concatenate(
+                [data, _np.zeros((pad,) + data.shape[1:],
+                                 _np.float32)])
+        labels = _np.zeros(
+            (self.batch_size, self.label_width), _np.float32)
+        for i, (label, _) in enumerate(raws):
+            labels[i, :len(label)] = label[:self.label_width]
+        if self.label_width == 1:
+            labels = labels[:, 0]
+        return DataBatch(data=[nd.array(data)],
+                         label=[nd.array(labels)], pad=pad)
+
     def next(self):
         import concurrent.futures as cf
-        if self._pool is None:
-            self._pool = cf.ThreadPoolExecutor(self._n_threads)
         raws = []
         while len(raws) < self.batch_size:
             raw = self._read_raw()
@@ -606,6 +661,13 @@ class ImageIter(DataIter):
         if not raws:
             raise StopIteration
         pad = self.batch_size - len(raws)
+        if self._native_cfg is not None:
+            batch = self._next_native(raws, pad)
+            if batch is not None:
+                return batch
+            # non-JPEG or corrupt record: cv2 chain handles the batch
+        if self._pool is None:
+            self._pool = cf.ThreadPoolExecutor(self._n_threads)
         decoded = list(self._pool.map(self._decode_augment, raws))
         data = _np.zeros((self.batch_size,) + self.data_shape,
                          _np.float32)

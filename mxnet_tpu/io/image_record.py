@@ -47,11 +47,23 @@ def ImageRecordIter(path_imgrec, data_shape, batch_size,
         contrast=max_random_contrast,
         saturation=max_random_saturation, hue=max_random_hue,
         pca_noise=pca_noise, rand_gray=random_gray_prob)
+    # plain classification configs (resize + crop + mirror + mean/std,
+    # no color/aspect augmentation) take the native libjpeg team —
+    # the reference's OMP decode path (iter_image_recordio_2.cc:141);
+    # anything fancier stays on the cv2 augmenter chain
+    native = None
+    if os.environ.get("MXNET_TPU_NATIVE_DECODE", "1") != "0" and \
+            not (rand_resize or max_random_brightness
+                 or max_random_contrast or max_random_saturation
+                 or max_random_hue or random_gray_prob or pca_noise):
+        native = {"resize": int(resize or 0), "rand_crop": rand_crop,
+                  "rand_mirror": rand_mirror, "mean": mean, "std": std}
     inner = ImageIter(
         batch_size=batch_size, data_shape=data_shape,
         label_width=label_width, path_imgrec=path_imgrec,
         path_imgidx=path_imgidx, shuffle=shuffle, aug_list=augs,
         data_name=data_name, label_name=label_name,
         num_threads=preprocess_threads or
-        max(1, (os.cpu_count() or 2) // 2))
+        max(1, (os.cpu_count() or 2) // 2),
+        native_pipeline=native)
     return PrefetchingIter(inner, prefetch_depth=prefetch_buffer)

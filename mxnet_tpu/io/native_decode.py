@@ -1,0 +1,113 @@
+"""ctypes binding for the native JPEG decode+augment worker team.
+
+Reference capability: ``src/io/iter_image_recordio_2.cc:141-149`` — the
+reference decodes and augments inside a C++ OMP team, so image
+throughput scales with cores instead of paying a Python call per image.
+``src/io/jpeg_decode_pool.cc`` is that team for this framework; one
+``decode_batch`` call turns a list of encoded JPEG buffers into an
+assembled (n, h, w, 3) uint8 RGB batch, with shorter-side resize,
+center/seeded-random crop, and mirror done worker-side.
+
+The pool covers the plain classification pipeline (resize + crop +
+mirror, the ResNet config).  Color/PCA/aspect augmenters stay on the
+cv2 path — ``ImageIter`` falls back automatically when they are
+requested.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as _np
+
+_LIB_PATH = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "build", "libjpeg_decode_pool.so")
+
+_lib = None
+
+
+class _DecodeCfg(ctypes.Structure):
+    _fields_ = [("resize", ctypes.c_int32),
+                ("out_h", ctypes.c_int32),
+                ("out_w", ctypes.c_int32),
+                ("rand_crop", ctypes.c_int32),
+                ("rand_mirror", ctypes.c_int32)]
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB_PATH):
+        return None
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.MXIOPoolCreate.restype = ctypes.c_void_p
+    lib.MXIOPoolCreate.argtypes = [ctypes.c_int]
+    lib.MXIOPoolFree.argtypes = [ctypes.c_void_p]
+    lib.MXIOPoolDecodeBatch.restype = ctypes.c_int
+    lib.MXIOPoolDecodeBatch.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_char_p),
+        ctypes.POINTER(ctypes.c_size_t),
+        ctypes.c_int,
+        ctypes.POINTER(_DecodeCfg),
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_int32)]
+    _lib = lib
+    return lib
+
+
+def available():
+    """True when the native library is built (make -C src/io)."""
+    return _load() is not None
+
+
+class NativeDecodePool:
+    """A persistent decode worker team (one per iterator)."""
+
+    def __init__(self, num_threads, out_hw, resize=0, rand_crop=False,
+                 rand_mirror=False):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(
+                "libjpeg_decode_pool.so not built; run make -C src/io")
+        self._lib = lib
+        self._pool = lib.MXIOPoolCreate(int(num_threads))
+        self._cfg = _DecodeCfg(int(resize), int(out_hw[0]),
+                               int(out_hw[1]), int(bool(rand_crop)),
+                               int(bool(rand_mirror)))
+
+    def decode_batch(self, bufs):
+        """list[bytes] -> ((n, h, w, 3) uint8 RGB, ok mask)."""
+        n = len(bufs)
+        h, w = self._cfg.out_h, self._cfg.out_w
+        out = _np.empty((n, h, w, 3), _np.uint8)
+        rcs = _np.zeros((n,), _np.int32)
+        # per-image augment seeds come from numpy's GLOBAL stream so
+        # np.random.seed(...) pins this path exactly like it pins the
+        # cv2 augmenter chain
+        seeds = _np.random.randint(1, 2 ** 63 - 1, size=n,
+                                   dtype=_np.uint64)
+        buf_arr = (ctypes.c_char_p * n)(*bufs)
+        len_arr = (ctypes.c_size_t * n)(*[len(b) for b in bufs])
+        rc = self._lib.MXIOPoolDecodeBatch(
+            self._pool, buf_arr, len_arr, n, ctypes.byref(self._cfg),
+            seeds.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            rcs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        if rc != 0:
+            raise RuntimeError("MXIOPoolDecodeBatch rc=%d" % rc)
+        return out, rcs == 0
+
+    def close(self):
+        if getattr(self, "_pool", None):
+            self._lib.MXIOPoolFree(self._pool)
+            self._pool = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

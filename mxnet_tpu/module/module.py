@@ -157,15 +157,18 @@ class _ExecGroup:
                 # and give each exec its OWN container (a shared one
                 # would make the next backwards clobber each other)
                 from ..ops.sparse_graph import dedup_rsp_pairs
-                from ..ndarray import NDArray as _ND
+                summed = total
                 for ex in self.execs[1:]:
-                    total = total + ex.grad_dict[name]
-                ids, vals = dedup_rsp_pairs(total.indices._data,
-                                            total.data._data,
-                                            total.shape[0])
+                    summed = summed + ex.grad_dict[name]
+                ids, vals = dedup_rsp_pairs(summed.indices._data,
+                                            summed.data._data,
+                                            summed.shape[0])
+                # mutate each exec's OWN bind-time container in place:
+                # args_grad / C-ABI handles stay aliased
                 for ex in self.execs:
-                    ex.grad_dict[name] = type(total)(
-                        _ND(vals), _ND(ids), total.shape)
+                    dst = ex.grad_dict[name]
+                    dst._data = vals
+                    dst._aux[0] = ids
                 continue
             for ex in self.execs[1:]:
                 total._data = (total + ex.grad_dict[name].as_in_context(

@@ -193,7 +193,6 @@ class ParallelTrainer:
         params = {p.name: p for p in self.net.collect_params().values()}
         repl = NamedSharding(self.mesh, P())
         self._resolve_opt()
-        cdtype = jnp.bfloat16 if self.multi_precision else None
         # graph arguments with no backing Parameter (e.g. the fused RNN
         # op's auto-created begin-state vars) are zero-filled constant
         # inputs, exactly like simple_bind's unbound-arg semantics —
@@ -798,6 +797,16 @@ class PipelineTrainer(ParallelTrainer):
         if self.shard_params:
             raise ValueError("shard_params (ZeRO over dp) is not "
                              "supported together with the pp stack")
+        if self.opt_name in _LARS_NAMES:
+            # LARS trust ratios are per named parameter; a (C, ...)
+            # stacked leaf would get ONE stack-wide ratio instead of
+            # per-layer rates, silently diverging from the sequential
+            # trainer
+            raise ValueError(
+                "LARS-family optimizers are not supported by "
+                "PipelineTrainer (stacked block leaves would share one "
+                "trust ratio); use sgd/adam/... or per-stage LARS via "
+                "the sequential trainer")
         # stacked leaves shard along pp on their leading (block) axis
         self.param_specs.setdefault(r"\App:", P("pp"))
 

@@ -414,3 +414,57 @@ def test_rec2idx_and_parse_log_tools(tmp_path):
          str(log), "--format", "csv"], capture_output=True, text=True)
     assert r.returncode == 0, r.stderr
     assert "0,120.0,9.5,0.61,0.55" in r.stdout
+
+
+def test_native_decode_matches_cv2_path(tmp_path):
+    """The libjpeg worker-team fast path (src/io/jpeg_decode_pool.cc)
+    produces the same batches as the cv2 augmenter chain for the plain
+    classification config, modulo decoder/interpolation differences
+    (fractional-DCT scaled decode vs cv2's full decode)."""
+    import subprocess
+
+    from mxnet_tpu.io.native_decode import available
+    if not available():
+        r = subprocess.run(["make", "-C",
+                            os.path.join(REPO, "src", "io")],
+                           capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr[-2000:]
+
+    import cv2
+    from mxnet_tpu import recordio
+    from mxnet_tpu.io import ImageRecordIter
+
+    rs = np.random.RandomState(0)
+    rec = recordio.MXIndexedRecordIO(
+        str(tmp_path / "d.idx"), str(tmp_path / "d.rec"), "w")
+    for i in range(8):
+        # smooth gradient images keep decoder differences small
+        yy, xx = np.mgrid[0:400, 0:500]
+        img = np.stack([(yy * 0.5 + i * 9) % 256,
+                        (xx * 0.4) % 256,
+                        ((yy + xx) * 0.3) % 256], -1).astype(np.uint8)
+        header = recordio.IRHeader(0, float(i), i, 0)
+        rec.write_idx(i, recordio.pack_img(header, img, quality=95))
+    rec.close()
+
+    def batch_with(native):
+        env_val = os.environ.get("MXNET_TPU_NATIVE_DECODE")
+        os.environ["MXNET_TPU_NATIVE_DECODE"] = "1" if native else "0"
+        try:
+            it = ImageRecordIter(
+                path_imgrec=str(tmp_path / "d.rec"),
+                path_imgidx=str(tmp_path / "d.idx"),
+                data_shape=(3, 224, 224), batch_size=8, resize=256,
+                mean_r=123.68, mean_g=116.78, mean_b=103.94)
+            return next(iter(it)).data[0].asnumpy()
+        finally:
+            if env_val is None:
+                os.environ.pop("MXNET_TPU_NATIVE_DECODE", None)
+            else:
+                os.environ["MXNET_TPU_NATIVE_DECODE"] = env_val
+
+    a = batch_with(native=True)
+    b = batch_with(native=False)
+    assert a.shape == b.shape == (8, 3, 224, 224)
+    # same labels/geometry; pixel values agree within decoder tolerance
+    assert np.abs(a - b).mean() < 8.0, np.abs(a - b).mean()
