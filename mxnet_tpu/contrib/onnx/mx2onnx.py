@@ -91,9 +91,31 @@ def _pooling(ctx, name, ins, attrs):
         op = {"max": "GlobalMaxPool", "avg": "GlobalAveragePool"}[ptype]
         ctx.emit(op, ins, [name], name)
         return
-    a = {"kernel_shape": _tuple(attrs["kernel"]),
-         "strides": _tuple(attrs.get("stride", "(1, 1)")),
-         "pads": _pads2(attrs.get("pad", "(0, 0)"))}
+    kernel = _tuple(attrs["kernel"])
+    stride = _tuple(attrs.get("stride", "(1, 1)"))
+    pads = _pads2(attrs.get("pad", "(0, 0)"))
+    if attrs.get("pooling_convention", "valid") == "full":
+        # opset 9 has no ceil_mode; emulate ceil division with extra
+        # END padding computed from the inferred input shape (max pool
+        # only — padded cells would corrupt an average)
+        if ptype != "max":
+            raise NotImplementedError(
+                "pooling_convention='full' export is supported for max "
+                "pooling only at opset 9 (no ceil_mode)")
+        shape = getattr(ctx, "value_shapes", {}).get(ins[0])
+        if not shape:
+            raise NotImplementedError(
+                "pooling_convention='full' export needs input_shape "
+                "for pad computation")
+        nd_ = len(kernel)
+        spatial = shape[-nd_:]
+        pads = list(pads)
+        for d in range(nd_):
+            rem = (spatial[d] + 2 * pads[d] - kernel[d]) % stride[d]
+            if rem:
+                pads[nd_ + d] += stride[d] - rem
+        pads = tuple(pads)
+    a = {"kernel_shape": kernel, "strides": stride, "pads": pads}
     if ptype == "avg":
         a["count_include_pad"] = 1   # MXNet averages over padded cells
         ctx.emit("AveragePool", ins, [name], name, a)
@@ -322,8 +344,77 @@ def _dot_product_attention(ctx, name, ins, attrs):
     ctx.emit("MatMul", [p, v], [name], name)
 
 
+def _deconv(ctx, name, ins, attrs):
+    a = {"kernel_shape": _tuple(attrs["kernel"]),
+         "strides": _tuple(attrs.get("stride", "(1, 1)")),
+         "pads": _pads2(attrs.get("pad", "(0, 0)")),
+         "output_padding": _tuple(attrs.get("adj", "(0, 0)")),
+         "dilations": _tuple(attrs.get("dilate", "(1, 1)")),
+         "group": int(attrs.get("num_group", 1))}
+    ctx.emit("ConvTranspose", ins, [name], name, a)
+
+
+def _l2_normalization(ctx, name, ins, attrs):
+    mode = attrs.get("mode", "instance")
+    if mode != "channel":
+        raise NotImplementedError(
+            "L2Normalization export supports mode='channel' "
+            "(LpNormalization axis=1); got %r" % mode)
+    ctx.emit("LpNormalization", ins, [name], name, {"axis": 1, "p": 2})
+
+
+def _multibox_prior(ctx, name, ins, attrs):
+    """Anchors depend only on the feature-map geometry, which is fixed
+    at export time — bake them as a constant initializer by running the
+    real op (ops/detection.py) on the inferred shape.  This is the
+    standard way SSD exports its priors (the reference exporter does
+    the same shape-driven materialization)."""
+    fshape = getattr(ctx, "value_shapes", {}).get(ins[0])
+    if not fshape:
+        raise NotImplementedError(
+            "MultiBoxPrior export needs input_shape for anchor "
+            "materialization")
+    import jax.numpy as jnp
+    from ...ops.registry import get_op
+    params = {}
+    for k in ("sizes", "ratios", "steps", "offsets", "clip"):
+        if k in attrs:
+            v = attrs[k]
+            params[k] = ast.literal_eval(v) if isinstance(v, str) else v
+    anchors = get_op("_contrib_MultiBoxPrior").fn(
+        jnp.zeros(tuple(int(s) for s in fshape), _np.float32), **params)
+    ctx.const(name, _np.asarray(anchors))
+
+
+def _multibox_detection(ctx, name, ins, attrs):
+    """Decode+NMS head.  Standard ONNX has no opset-9 equivalent
+    (NonMaxSuppression is opset 10+), so this exports as an op in the
+    'mxtpu' custom domain: round-trips through this package's importer,
+    clearly rejected by generic runtimes instead of silently wrong."""
+    a = {}
+    for k in ("nms_threshold", "threshold"):
+        if k in attrs:
+            a[k] = float(attrs[k])
+    for k in ("nms_topk", "background_id"):
+        if k in attrs:
+            a[k] = int(attrs[k])
+    for k in ("force_suppress", "clip"):
+        if k in attrs:
+            a[k] = int(_bool(attrs[k]))
+    if "variances" in attrs:
+        v = attrs["variances"]
+        a["variances"] = [float(x) for x in
+                          (ast.literal_eval(v) if isinstance(v, str)
+                           else v)]
+    ctx.emit("MXTPU_MultiBoxDetection", ins, [name], name, a)
+
+
 CONVERTERS = {
     "Convolution": _conv,
+    "Deconvolution": _deconv,
+    "L2Normalization": _l2_normalization,
+    "_contrib_MultiBoxPrior": _multibox_prior,
+    "_contrib_MultiBoxDetection": _multibox_detection,
     "BatchNorm": _bn,
     "Activation": _activation,
     "Pooling": _pooling,
@@ -405,6 +496,25 @@ def export_model(sym, params, input_shape=None, input_type=_np.float32,
 
     ctx = _Ctx(np_params)
     ctx.input_shapes = input_shape  # slice_like / causal-mask exports
+    # per-value shapes for converters that materialize shape-dependent
+    # constants (MultiBoxPrior anchors): internal-output inference over
+    # the ORIGINAL symbol, keyed by the producing node's name
+    ctx.value_shapes = {}
+    if isinstance(sym, Symbol) and input_shape:
+        data_names = [n["name"] for n in g["nodes"]
+                      if n["op"] == "null" and
+                      n["name"] not in np_params]
+        feed = {nm: tuple(s) for nm, s in zip(data_names, input_shape)}
+        try:
+            ints = sym.get_internals()
+            _, out_shapes, _ = ints.infer_shape_partial(**feed)
+            for nm, shp in zip(ints.list_outputs(), out_shapes):
+                if shp:
+                    key = nm[:-7] if nm.endswith("_output") else nm
+                    ctx.value_shapes[key] = tuple(shp)
+            ctx.value_shapes.update(feed)
+        except Exception:
+            pass  # shape-needing converters raise their own error
     dtype = _np.dtype(input_type)
     elem = P._NP_TO_DT[dtype.name]
     # uniquify node names: duplicate names in the symbol JSON would
@@ -419,6 +529,13 @@ def export_model(sym, params, input_shape=None, input_type=_np.float32,
         else:
             seen[nm] = 0
             uniq[i] = nm
+    # duplicate node names make the name-keyed shape map ambiguous
+    # (and converters look up by uniquified name anyway): drop them so
+    # a shape-needing converter raises its clear error instead of
+    # using the wrong duplicate's shape
+    for nm, cnt in seen.items():
+        if cnt > 0:
+            ctx.value_shapes.pop(nm, None)
     data_i = 0
     for i, n in enumerate(nodes):
         if n["op"] != "null":

@@ -74,7 +74,21 @@ def _pool(op_type):
                 kwargs["stride"] = tuple(a["strides"])
             if "pads" in a:
                 p = a["pads"]
-                kwargs["pad"] = tuple(p[:len(p) // 2])
+                half = len(p) // 2
+                begin, end = tuple(p[:half]), tuple(p[half:])
+                kwargs["pad"] = begin
+                if any(e > s for e, s in zip(end, begin)):
+                    # asymmetric END padding is how mx2onnx encodes
+                    # pooling_convention='full' for MAX pooling at
+                    # opset 9 (no ceil_mode).  For average pooling the
+                    # semantics differ (ONNX averages the padded end
+                    # cells; MXNet 'full' does not) — refuse rather
+                    # than silently change values.
+                    if "Max" not in op_type:
+                        raise NotImplementedError(
+                            "asymmetric AveragePool padding has no "
+                            "MXNet Pooling equivalent")
+                    kwargs["pooling_convention"] = "full"
         return sym.Pooling(*ins, name=node["name"] or None, **kwargs)
     return f
 
@@ -236,8 +250,67 @@ def _gather(b, sym, node, ins):
                     name=node["name"] or None)
 
 
+def _conv_transpose(b, sym, node, ins):
+    a = node["attrs"]
+    kwargs = {"kernel": tuple(a.get("kernel_shape", ())),
+              "stride": tuple(a.get("strides", (1, 1))),
+              "num_group": int(a.get("group", 1)),
+              "no_bias": len(ins) < 3}
+    pads = a.get("pads")
+    if pads:
+        kwargs["pad"] = tuple(pads[:len(pads) // 2])
+    adj = a.get("output_padding")
+    if adj:
+        kwargs["adj"] = tuple(adj)
+    dil = a.get("dilations")
+    if dil:
+        kwargs["dilate"] = tuple(dil)
+    # num_filter from the weight initializer: (in, out/group, kh, kw)
+    wname = node["inputs"][1]
+    if wname not in b.inits:
+        raise NotImplementedError(
+            "ConvTranspose with a runtime-input weight (num_filter "
+            "cannot be inferred without the initializer)")
+    w = b.inits[wname]
+    kwargs["num_filter"] = int(w.shape[1]) * kwargs["num_group"]
+    return sym.Deconvolution(*ins, name=node["name"] or None, **kwargs)
+
+
+def _lp_normalization(b, sym, node, ins):
+    a = node["attrs"]
+    if int(a.get("p", 2)) != 2 or int(a.get("axis", 1)) != 1:
+        raise NotImplementedError(
+            "LpNormalization import supports p=2, axis=1")
+    return sym.L2Normalization(ins[0], mode="channel",
+                               name=node["name"] or None)
+
+
+def _multibox_detection(b, sym, node, ins):
+    a = node["attrs"]
+    kwargs = {}
+    for k in ("nms_threshold", "threshold"):
+        if k in a:
+            kwargs[k] = float(a[k])
+    for k in ("nms_topk", "background_id"):
+        if k in a:
+            kwargs[k] = int(a[k])
+    for k in ("force_suppress", "clip"):
+        if k in a:
+            kwargs[k] = bool(int(a[k]))
+    if "variances" in a:
+        kwargs["variances"] = tuple(float(v) for v in a["variances"])
+    return sym._contrib_MultiBoxDetection(*ins,
+                                          name=node["name"] or None,
+                                          **kwargs)
+
+
 IMPORTERS = {
     "Conv": _conv,
+    "ConvTranspose": _conv_transpose,
+    "LpNormalization": _lp_normalization,
+    # mxtpu custom-domain detection head (see mx2onnx
+    # _multibox_detection: no opset-9 standard equivalent)
+    "MXTPU_MultiBoxDetection": _multibox_detection,
     "BatchNormalization": _bn,
     "Relu": _act("relu"), "Sigmoid": _act("sigmoid"),
     "Tanh": _act("tanh"), "Softplus": _act("softrelu"),

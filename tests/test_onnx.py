@@ -15,6 +15,9 @@ import pytest
 import mxnet_tpu as mx
 from mxnet_tpu import nd
 from mxnet_tpu.contrib import onnx as onnx_mxnet
+from mxnet_tpu.contrib.onnx import mx2onnx, onnx2mx
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _roundtrip(sym, params, shape, tmp_path, aux=()):
@@ -207,3 +210,65 @@ def test_export_import_transformer_lm_roundtrip(tmp_path):
     got = ex2.forward()[0].asnumpy()
     assert got.shape == (B, S, V)
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_export_import_deconvolution_roundtrip(tmp_path):
+    """Deconvolution (the DCGAN generator op) -> ConvTranspose and
+    back, numerically identical (VERDICT r4 item 10)."""
+    d = mx.sym.Variable('z')
+    h = mx.sym.Deconvolution(d, num_filter=8, kernel=(4, 4),
+                             stride=(2, 2), pad=(1, 1), name='up1')
+    h = mx.sym.Activation(h, act_type='relu')
+    h = mx.sym.Deconvolution(h, num_filter=3, kernel=(4, 4),
+                             stride=(2, 2), pad=(1, 1), name='up2')
+    out = mx.sym.Activation(h, act_type='tanh')
+
+    args, _, _ = out.infer_shape(z=(2, 16, 8, 8))
+    rs = np.random.RandomState(0)
+    params = {n: mx.nd.array(rs.randn(*s).astype(np.float32) * 0.1)
+              for n, s in zip(out.list_arguments(), args) if n != 'z'}
+    path = mx2onnx.export_model(
+        out, params, input_shape=[(2, 16, 8, 8)],
+        onnx_file_path=str(tmp_path / "gen.onnx"))
+    sym2, arg2, aux2 = onnx2mx.import_model(path)
+    x = rs.randn(2, 16, 8, 8).astype(np.float32)
+    o1 = out.bind(mx.cpu(), dict(params, z=mx.nd.array(x))) \
+        .forward()[0].asnumpy()
+    b2 = dict(arg2)
+    b2[sym2.list_arguments()[0]] = mx.nd.array(x)
+    o2 = sym2.bind(mx.cpu(), b2, aux_states=aux2).forward()[0].asnumpy()
+    assert o1.shape == o2.shape == (2, 3, 32, 32)
+    np.testing.assert_allclose(o1, o2, atol=1e-5)
+
+
+def test_export_import_ssd300_roundtrip(tmp_path):
+    """Full SSD-300 detection graph round-trip (VERDICT r4 item 10):
+    VGG-reduced backbone, L2Normalization -> LpNormalization,
+    MultiBoxPrior anchors baked as export-time constants,
+    pooling_convention='full' encoded as asymmetric end pads (opset 9
+    has no ceil_mode), and the decode+NMS head as an mxtpu
+    custom-domain node this package's importer reconstructs."""
+    import sys
+    sys.path.insert(0, REPO)
+    from examples.ssd_model import build_ssd300_infer
+
+    sym = build_ssd300_infer(num_classes=4)
+    if isinstance(sym, tuple):
+        sym = sym[0]
+    dname = sym.list_arguments()[0]
+    args, _, _ = sym.infer_shape(**{dname: (1, 3, 300, 300)})
+    rs = np.random.RandomState(0)
+    params = {n: mx.nd.array(rs.randn(*s).astype(np.float32) * 0.05)
+              for n, s in zip(sym.list_arguments(), args) if n != dname}
+    path = mx2onnx.export_model(
+        sym, params, input_shape=[(1, 3, 300, 300)],
+        onnx_file_path=str(tmp_path / "ssd300.onnx"))
+    sym2, arg2, aux2 = onnx2mx.import_model(path)
+    x = rs.randn(1, 3, 300, 300).astype(np.float32) * 0.3
+    o1 = sym.bind(mx.cpu(), dict(params, **{dname: mx.nd.array(x)})) \
+        .forward()[0].asnumpy()
+    b2 = dict(arg2)
+    b2[sym2.list_arguments()[0]] = mx.nd.array(x)
+    o2 = sym2.bind(mx.cpu(), b2, aux_states=aux2).forward()[0].asnumpy()
+    assert o1.shape == o2.shape == (1, 8732, 6)
+    np.testing.assert_allclose(o1, o2, atol=1e-3)
