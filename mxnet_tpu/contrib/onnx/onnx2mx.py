@@ -77,17 +77,25 @@ def _pool(op_type):
                 half = len(p) // 2
                 begin, end = tuple(p[:half]), tuple(p[half:])
                 kwargs["pad"] = begin
-                if any(e > s for e, s in zip(end, begin)):
+                if end != begin:
                     # asymmetric END padding is how mx2onnx encodes
                     # pooling_convention='full' for MAX pooling at
-                    # opset 9 (no ceil_mode).  For average pooling the
-                    # semantics differ (ONNX averages the padded end
-                    # cells; MXNet 'full' does not) — refuse rather
-                    # than silently change values.
-                    if "Max" not in op_type:
+                    # opset 9 (no ceil_mode); the extra end pad is
+                    # always < stride there.  Anything else (average
+                    # pooling, or end pads from another producer's
+                    # SAME-padding scheme) has no MXNet Pooling
+                    # equivalent — refuse rather than silently change
+                    # values.
+                    stride = kwargs.get("stride",
+                                        (1,) * len(begin))
+                    if "Max" not in op_type or any(
+                            e - b < 0 or e - b >= s for e, b, s in
+                            zip(end, begin, stride)):
                         raise NotImplementedError(
-                            "asymmetric AveragePool padding has no "
-                            "MXNet Pooling equivalent")
+                            "asymmetric pooling padding %r is not "
+                            "representable (only this package's "
+                            "'full'-convention encoding imports)"
+                            % (p,))
                     kwargs["pooling_convention"] = "full"
         return sym.Pooling(*ins, name=node["name"] or None, **kwargs)
     return f
@@ -258,7 +266,13 @@ def _conv_transpose(b, sym, node, ins):
               "no_bias": len(ins) < 3}
     pads = a.get("pads")
     if pads:
-        kwargs["pad"] = tuple(pads[:len(pads) // 2])
+        half = len(pads) // 2
+        begin, end = tuple(pads[:half]), tuple(pads[half:])
+        if begin != end:
+            raise NotImplementedError(
+                "ConvTranspose with asymmetric padding has no "
+                "Deconvolution equivalent (pads=%r)" % (pads,))
+        kwargs["pad"] = begin
     adj = a.get("output_padding")
     if adj:
         kwargs["adj"] = tuple(adj)

@@ -77,79 +77,69 @@ class BaseModule:
         self.forward(data_batch, is_train=True)
         self.backward()
 
+    def _fire(self, callbacks, param):
+        for cb in _as_list(callbacks):
+            cb(param)
+
+    def _eval_batches(self, eval_data, num_batch, reset):
+        """Inference-mode batches with a LAZY padding-trimmed outputs
+        getter (score never asks for outputs, so none are fetched)."""
+        assert self.binded and self.params_initialized
+        if reset:
+            eval_data.reset()
+        for idx, batch in enumerate(eval_data):
+            if idx == num_batch:
+                return
+            self.forward(batch, is_train=False)
+            keep = -(batch.pad or 0) or None
+            yield idx, batch, \
+                lambda k=keep: [o[:k] for o in self.get_outputs()]
+
     def score(self, eval_data, eval_metric, num_batch=None,
               batch_end_callback=None, score_end_callback=None, reset=True,
               epoch=0, sparse_row_id_fn=None):
         """(reference: base_module.py score:210)"""
-        assert self.binded and self.params_initialized
-        if reset:
-            eval_data.reset()
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
         eval_metric.reset()
-        actual_num_batch = 0
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            self.update_metric(eval_metric, eval_batch.label)
-            if batch_end_callback is not None:
-                params = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                       eval_metric=eval_metric,
-                                       locals=locals())
-                for callback in _as_list(batch_end_callback):
-                    callback(params)
-            actual_num_batch += 1
+        seen = 0
+        for idx, batch, _ in self._eval_batches(eval_data, num_batch,
+                                                reset):
+            self.update_metric(eval_metric, batch.label)
+            seen = idx + 1
+            self._fire(batch_end_callback, BatchEndParam(
+                epoch=epoch, nbatch=idx, eval_metric=eval_metric,
+                locals=locals()))
         if score_end_callback:
-            params = BatchEndParam(epoch=epoch, nbatch=actual_num_batch,
-                                   eval_metric=eval_metric, locals=locals())
-            for callback in _as_list(score_end_callback):
-                callback(params)
+            self._fire(score_end_callback, BatchEndParam(
+                epoch=epoch, nbatch=seen, eval_metric=eval_metric,
+                locals=locals()))
         return eval_metric.get_name_value()
 
     def iter_predict(self, eval_data, num_batch=None, reset=True):
-        assert self.binded and self.params_initialized
-        if reset:
-            eval_data.reset()
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
-            outputs = [out[0:out.shape[0] - (pad or 0)]
-                       for out in self.get_outputs()]
-            yield outputs, nbatch, eval_batch
+        for idx, batch, outs in self._eval_batches(eval_data, num_batch,
+                                                   reset):
+            yield outs(), idx, batch
 
     def predict(self, eval_data, num_batch=None, merge_batches=True,
                 reset=True, always_output_list=False,
                 sparse_row_id_fn=None):
         """(reference: base_module.py predict:320)"""
-        assert self.binded and self.params_initialized
-        if reset:
-            eval_data.reset()
-        output_list = []
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
-            outputs = [out[0:out.shape[0] - (pad or 0)].copy()
-                       for out in self.get_outputs()]
-            output_list.append(outputs)
-        if len(output_list) == 0:
-            return output_list
-        if merge_batches:
-            num_outputs = len(output_list[0])
-            for out in output_list:
-                assert len(out) == num_outputs, \
-                    "Cannot merge batches, as num of outputs is not the " \
-                    "same in mini-batches. Maybe bucketing is used?"
-            output_list2 = [nd.concatenate([out[i] for out in output_list])
-                            for i in range(num_outputs)]
-            if num_outputs == 1 and not always_output_list:
-                return output_list2[0]
-            return output_list2
-        return output_list
+        collected = [
+            [o.copy() for o in outs()]
+            for _, _, outs in self._eval_batches(eval_data, num_batch,
+                                                 reset)]
+        if not collected or not merge_batches:
+            return collected
+        widths = {len(outs) for outs in collected}
+        assert len(widths) == 1, \
+            "Cannot merge batches, as num of outputs is not the " \
+            "same in mini-batches. Maybe bucketing is used?"
+        merged = [nd.concatenate(list(column))
+                  for column in zip(*collected)]
+        if len(merged) == 1 and not always_output_list:
+            return merged[0]
+        return merged
 
     def fit(self, train_data, eval_data=None, eval_metric="acc",
             epoch_end_callback=None, batch_end_callback=None,
@@ -183,44 +173,30 @@ class BaseModule:
             eval_metric = metric_mod.create(eval_metric)
 
         for epoch in range(begin_epoch, num_epoch):
-            tic = time.time()
+            epoch_start = time.perf_counter()
             eval_metric.reset()
-            nbatch = 0
-            data_iter = iter(train_data)
-            end_of_batch = False
-            next_data_batch = next(data_iter)
-            while not end_of_batch:
-                data_batch = next_data_batch
+            for nbatch, data_batch in enumerate(train_data):
                 if monitor is not None:
                     monitor.tic()
                 self.forward_backward(data_batch)
                 self.update()
-                try:
-                    next_data_batch = next(data_iter)
-                except StopIteration:
-                    end_of_batch = True
                 self.update_metric(eval_metric, data_batch.label)
                 if monitor is not None:
                     monitor.toc_print()
-                if batch_end_callback is not None:
-                    batch_end_params = BatchEndParam(
-                        epoch=epoch, nbatch=nbatch,
-                        eval_metric=eval_metric, locals=locals())
-                    for callback in _as_list(batch_end_callback):
-                        callback(batch_end_params)
-                nbatch += 1
+                self._fire(batch_end_callback, BatchEndParam(
+                    epoch=epoch, nbatch=nbatch,
+                    eval_metric=eval_metric, locals=locals()))
 
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            toc = time.time()
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch, toc - tic)
+            self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                             time.perf_counter() - epoch_start)
 
-            arg_params_now, aux_params_now = self.get_params()
-            self.set_params(arg_params_now, aux_params_now)
-            if epoch_end_callback is not None:
-                for callback in _as_list(epoch_end_callback):
-                    callback(epoch, self.symbol, arg_params_now,
-                             aux_params_now)
+            # sync the user-visible snapshot, then checkpoint callbacks
+            snapshot = self.get_params()
+            self.set_params(*snapshot)
+            for cb in _as_list(epoch_end_callback):
+                cb(epoch, self.symbol, *snapshot)
 
             if eval_data is not None:
                 res = self.score(eval_data, validation_metric,
