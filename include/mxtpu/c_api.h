@@ -123,6 +123,22 @@ int MXExecutorBackward(ExecutorHandle handle, mx_uint len,
 int MXExecutorOutputs(ExecutorHandle handle, mx_uint* out_size,
                       NDArrayHandle** outputs);
 
+/* -- kvstore (reference: c_api.cc MXKVStore* string-key variants) ---- */
+typedef void* KVStoreHandle;
+
+int MXKVStoreCreate(const char* type, KVStoreHandle* out);
+int MXKVStoreFree(KVStoreHandle handle);
+int MXKVStoreInitEx(KVStoreHandle handle, mx_uint num, const char** keys,
+                    NDArrayHandle* vals);
+int MXKVStorePushEx(KVStoreHandle handle, mx_uint num, const char** keys,
+                    NDArrayHandle* vals, int priority);
+int MXKVStorePullEx(KVStoreHandle handle, mx_uint num, const char** keys,
+                    NDArrayHandle* outs, int priority);
+/* pointer valid until the next GetType call */
+int MXKVStoreGetType(KVStoreHandle handle, const char** out);
+int MXKVStoreGetRank(KVStoreHandle handle, int* out);
+int MXKVStoreGetGroupSize(KVStoreHandle handle, int* out);
+
 #ifdef __cplusplus
 }
 #endif

@@ -279,3 +279,42 @@ def exec_backward(cex, head_grads):
 
 def exec_outputs(cex):
     return list(cex.ex.outputs)
+
+
+# ---------------------------------------------------------------------------
+# KVStore surface (reference: src/c_api/c_api.cc MXKVStoreCreate/Init/
+# Push/Pull + rank/size).  A KVStoreHandle is an owned PyObject* of a
+# framework KVStore; keys cross as string lists (the reference's *Ex
+# string-key variants).
+# ---------------------------------------------------------------------------
+
+def kv_create(kind):
+    import mxnet_tpu as mx
+    return mx.kv.create(kind)
+
+
+def kv_init(kv, keys, arrays):
+    kv.init(list(keys), list(arrays))
+    return True
+
+
+def kv_push(kv, keys, arrays, priority):
+    kv.push(list(keys), list(arrays), priority=int(priority))
+    return True
+
+
+def kv_pull(kv, keys, outs, priority):
+    kv.pull(list(keys), out=list(outs), priority=int(priority))
+    return True
+
+
+def kv_type(kv):
+    return kv.type
+
+
+def kv_rank(kv):
+    return int(kv.rank)
+
+
+def kv_group_size(kv):
+    return int(kv.num_workers)

@@ -502,3 +502,130 @@ MXTPU_API int MXExecutorOutputs(ExecutorHandle handle, mx_uint* out_size,
   *outputs = g_exec_outputs.data();
   return 0;
 }
+
+// ---------------------------------------------------------------------------
+// KVStore surface (reference: src/c_api/c_api.cc MXKVStoreCreate /
+// Init / Push / Pull string-key variants + rank/size).  KVStoreHandle
+// is an owned PyObject* like the other handles.
+// ---------------------------------------------------------------------------
+
+typedef void* KVStoreHandle;
+
+namespace {
+thread_local std::string g_kv_type;
+
+// (keys, NDArray handles) -> bridge args (list[str], list[NDArray]);
+// NULL on a bad (non-UTF-8) key, with the Python error set
+PyObject* KeyedArrays(const char** keys, NDArrayHandle* vals, mx_uint n) {
+  PyObject* ks = PyList_New(n);
+  PyObject* vs = PyList_New(n);
+  for (mx_uint i = 0; i < n; ++i) {
+    PyObject* k = PyUnicode_FromString(keys[i]);
+    if (!k) {
+      Py_DECREF(ks);
+      Py_DECREF(vs);
+      return nullptr;
+    }
+    PyList_SetItem(ks, i, k);
+    PyObject* o = static_cast<PyObject*>(vals[i]);
+    Py_INCREF(o);
+    PyList_SetItem(vs, i, o);
+  }
+  PyObject* pair = PyTuple_Pack(2, ks, vs);
+  Py_DECREF(ks);
+  Py_DECREF(vs);
+  return pair;
+}
+
+// one keyed bridge call; priority < 0 means the method takes none
+int KvKeyedCall(const char* method, KVStoreHandle h, mx_uint n,
+                const char** keys, NDArrayHandle* vals, int priority) {
+  GILGuard gil;
+  PyObject* ka = KeyedArrays(keys, vals, n);
+  if (!ka) {
+    SetErrorFromPython();
+    return -1;
+  }
+  PyObject* args = priority < 0
+      ? Py_BuildValue("(OOO)", static_cast<PyObject*>(h),
+                      PyTuple_GetItem(ka, 0), PyTuple_GetItem(ka, 1))
+      : Py_BuildValue("(OOOi)", static_cast<PyObject*>(h),
+                      PyTuple_GetItem(ka, 0), PyTuple_GetItem(ka, 1),
+                      priority);
+  Py_DECREF(ka);
+  PyObject* r = CallBridge(method, args);
+  Py_DECREF(args);
+  if (!r) {
+    SetErrorFromPython();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int KvIntResult(const char* method, KVStoreHandle h, int* out) {
+  GILGuard gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(h));
+  PyObject* r = CallBridge(method, args);
+  Py_DECREF(args);
+  if (!r) {
+    SetErrorFromPython();
+    return -1;
+  }
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+}  // namespace
+
+MXTPU_API int MXKVStoreCreate(const char* type, KVStoreHandle* out) {
+  GILGuard gil;
+  PyObject* args = Py_BuildValue("(s)", type);
+  PyObject* r = CallBridge("kv_create", args);
+  Py_DECREF(args);
+  if (!r) {
+    SetErrorFromPython();
+    return -1;
+  }
+  *out = r;
+  return 0;
+}
+
+MXTPU_API int MXKVStoreFree(KVStoreHandle handle) {
+  GILGuard gil;
+  Py_XDECREF(static_cast<PyObject*>(handle));
+  return 0;
+}
+
+MXTPU_API int MXKVStoreInitEx(KVStoreHandle h, mx_uint num,
+                              const char** keys, NDArrayHandle* vals) {
+  return KvKeyedCall("kv_init", h, num, keys, vals, /*priority=*/-1);
+}
+
+MXTPU_API int MXKVStorePushEx(KVStoreHandle h, mx_uint num,
+                              const char** keys, NDArrayHandle* vals,
+                              int priority) {
+  return KvKeyedCall("kv_push", h, num, keys, vals, priority);
+}
+
+MXTPU_API int MXKVStorePullEx(KVStoreHandle h, mx_uint num,
+                              const char** keys, NDArrayHandle* outs,
+                              int priority) {
+  return KvKeyedCall("kv_pull", h, num, keys, outs, priority);
+}
+
+MXTPU_API int MXKVStoreGetType(KVStoreHandle h, const char** out) {
+  GILGuard gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(h));
+  PyObject* r = CallBridge("kv_type", args);
+  Py_DECREF(args);
+  return StringResult(r, &g_kv_type, out);
+}
+
+MXTPU_API int MXKVStoreGetRank(KVStoreHandle h, int* out) {
+  return KvIntResult("kv_rank", h, out);
+}
+
+MXTPU_API int MXKVStoreGetGroupSize(KVStoreHandle h, int* out) {
+  return KvIntResult("kv_group_size", h, out);
+}

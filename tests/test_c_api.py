@@ -169,3 +169,77 @@ def test_cpp_symbolic_training_example(tmp_path):
                          text=True, timeout=600)
     assert res.returncode == 0, res.stdout[-1500:] + res.stderr[-1500:]
     assert "symbolic C ABI training OK" in res.stdout
+
+
+_KV_DRIVER = textwrap.dedent("""
+    import ctypes, sys
+    import numpy as np
+
+    lib = ctypes.CDLL(sys.argv[1])
+    u32, i32 = ctypes.c_uint32, ctypes.c_int
+
+    def check(rc):
+        if rc != 0:
+            lib.MXGetLastError.restype = ctypes.c_char_p
+            raise RuntimeError(lib.MXGetLastError().decode())
+
+    def make_nd(arr):
+        arr = np.ascontiguousarray(arr, np.float32)
+        shape = (u32 * arr.ndim)(*arr.shape)
+        h = ctypes.c_void_p()
+        check(lib.MXNDArrayCreate(shape, u32(arr.ndim), 1, 0, 0, 0,
+                                  ctypes.byref(h)))
+        check(lib.MXNDArraySyncCopyFromCPU(
+            h, arr.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_size_t(arr.nbytes)))
+        return h
+
+    def to_np(h, shape):
+        # ctypes passes bare ints as 32-bit: always wrap handles
+        h = ctypes.c_void_p(h) if isinstance(h, int) else h
+        out = np.empty(shape, np.float32)
+        check(lib.MXNDArraySyncCopyToCPU(
+            h, out.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_size_t(out.nbytes)))
+        return out
+
+    kv = ctypes.c_void_p()
+    check(lib.MXKVStoreCreate(b"local", ctypes.byref(kv)))
+    t = ctypes.c_char_p()
+    check(lib.MXKVStoreGetType(kv, ctypes.byref(t)))
+    assert t.value == b"local", t.value
+    rank, size = i32(), i32()
+    check(lib.MXKVStoreGetRank(kv, ctypes.byref(rank)))
+    check(lib.MXKVStoreGetGroupSize(kv, ctypes.byref(size)))
+    assert rank.value == 0 and size.value == 1
+
+    w0 = np.zeros((4, 3), np.float32)
+    keys = (ctypes.c_char_p * 1)(b"w")
+    init_h = (ctypes.c_void_p * 1)(make_nd(w0))
+    check(lib.MXKVStoreInitEx(kv, 1, keys, init_h))
+
+    # default store semantics (no updater): push assigns, pull reads
+    g = np.arange(12, dtype=np.float32).reshape(4, 3)
+    push_h = (ctypes.c_void_p * 1)(make_nd(g))
+    check(lib.MXKVStorePushEx(kv, 1, keys, push_h, 0))
+
+    out_h = (ctypes.c_void_p * 1)(make_nd(np.zeros((4, 3), np.float32)))
+    check(lib.MXKVStorePullEx(kv, 1, keys, out_h, 0))
+    got = to_np(out_h[0], (4, 3))
+    assert np.allclose(got, g), got
+    check(lib.MXKVStoreFree(kv))
+    print("KV_C_API_OK")
+""")
+
+
+def test_c_kvstore_api_push_pull():
+    """local KVStore init/push/pull through the C ABI: push assigns
+    (the reference's no-updater semantics) and pull reads it back
+    (reference surface: c_api.cc MXKVStore*Ex)."""
+    lib = _build_lib()
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    res = subprocess.run(
+        [sys.executable, "-c", _KV_DRIVER, lib],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert res.returncode == 0, (res.stdout + res.stderr)[-3000:]
+    assert "KV_C_API_OK" in res.stdout

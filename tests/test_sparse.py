@@ -326,3 +326,31 @@ def test_embedding_sparse_grad_rsp_pair():
 
     assert not vocab_scatters(ex_s)
     assert vocab_scatters(ex_d)
+
+
+def test_module_sparse_grad_embedding_trains():
+    """User-level path: Module + Embedding(sparse_grad=True) trains
+    with adagrad while the executor delivers RowSparseNDArray pair
+    grads (the graph-level rsp pipeline end to end)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.io import NDArrayIter
+
+    vocab, dim = 100, 8
+    rs = np.random.RandomState(0)
+    X = rs.randint(0, vocab, (200, 6)).astype(np.float32)
+    Y = (X.sum(1) % 3).astype(np.float32)
+
+    data = mx.sym.Variable('data')
+    emb = mx.sym.Embedding(data, input_dim=vocab, output_dim=dim,
+                           sparse_grad=True, name='emb')
+    feat = mx.sym.mean(emb, axis=1)
+    out = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(feat, num_hidden=3, name='fc'),
+        mx.sym.Variable('softmax_label'), name='softmax')
+    mod = mx.mod.Module(out)
+    mod.fit(NDArrayIter(X, Y, batch_size=20, shuffle=True), num_epoch=6,
+            optimizer='adagrad', optimizer_params={'learning_rate': 0.5})
+    g = mod._exec_group.execs[0].grad_dict['emb_weight']
+    assert isinstance(g, sp.RowSparseNDArray)
+    score = dict(mod.score(NDArrayIter(X, Y, batch_size=20), 'acc'))
+    assert score['accuracy'] > 0.6, score
