@@ -139,6 +139,24 @@ int MXKVStoreGetType(KVStoreHandle handle, const char** out);
 int MXKVStoreGetRank(KVStoreHandle handle, int* out);
 int MXKVStoreGetGroupSize(KVStoreHandle handle, int* out);
 
+/* -- data iterators (reference: c_api.cc MXDataIter*) ----------------- */
+typedef void* DataIterHandle;
+
+/* newline-joined creator listing; pointer valid until next call */
+int MXListDataIters(const char** out_names);
+/* create by name with string params (e.g. MNISTIter, image/label path
+ * + batch_size); Get* read the batch the last Next advanced to, as NEW
+ * caller-owned NDArray handles */
+int MXDataIterCreateIter(const char* name, mx_uint num_params,
+                         const char** keys, const char** vals,
+                         DataIterHandle* out);
+int MXDataIterFree(DataIterHandle handle);
+int MXDataIterNext(DataIterHandle handle, int* out);
+int MXDataIterBeforeFirst(DataIterHandle handle);
+int MXDataIterGetData(DataIterHandle handle, NDArrayHandle* out);
+int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle* out);
+int MXDataIterGetPadNum(DataIterHandle handle, int* out);
+
 #ifdef __cplusplus
 }
 #endif

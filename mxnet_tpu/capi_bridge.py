@@ -155,6 +155,26 @@ def nd_dtype(arr):
     return _FLAG_BY_DTYPE[str(arr.dtype)]
 
 
+def _coerce_str_params(str_params):
+    """String param dict -> python values: dmlc-style booleans
+    ("true"/"false", any case) then python literals, else the raw
+    string.  Shared by every C surface that takes string params."""
+    import ast
+    out = {}
+    for k, v in str_params.items():
+        low = v.lower() if isinstance(v, str) else v
+        if low == "true":
+            out[k] = True
+        elif low == "false":
+            out[k] = False
+        else:
+            try:
+                out[k] = ast.literal_eval(v)
+            except (ValueError, SyntaxError):
+                out[k] = v
+    return out
+
+
 def nd_invoke(op_name, inputs, str_params):
     """MXImperativeInvoke: string params are parsed exactly like the
     symbol front end parses serialized attrs.
@@ -164,17 +184,11 @@ def nd_invoke(op_name, inputs, str_params):
     so without rebinding the C caller's persistent weight/momentum
     handles would point at deleted buffers after one step.  The fused
     ops' convention is that output k reuses the k-th donated input."""
-    import ast
     from mxnet_tpu.ndarray import NDArray
     from mxnet_tpu.ndarray.ndarray import imperative_invoke
     from mxnet_tpu.ops.registry import get_op
 
-    params = {}
-    for k, v in str_params.items():
-        try:
-            params[k] = ast.literal_eval(v)
-        except (ValueError, SyntaxError):
-            params[k] = v  # plain strings (act_type=relu etc.)
+    params = _coerce_str_params(str_params)
     op = get_op(op_name)
     out = None
     if op.donate and isinstance(op.num_outputs, int) and \
@@ -318,3 +332,77 @@ def kv_rank(kv):
 
 def kv_group_size(kv):
     return int(kv.num_workers)
+
+
+# ---------------------------------------------------------------------------
+# DataIter surface (reference: src/c_api/c_api.cc MXListDataIters /
+# MXDataIterCreateIter / Next / BeforeFirst / GetData / GetLabel /
+# GetPadNum).  A DataIterHandle is an owned PyObject* of CDataIter.
+# ---------------------------------------------------------------------------
+
+_ITER_FACTORIES = ("MNISTIter", "ImageRecordIter", "CSVIter",
+                   "LibSVMIter", "NDArrayIter")
+
+
+def io_list_iters():
+    return "\n".join(_ITER_FACTORIES)
+
+
+class CDataIter(object):
+    """One MXDataIterCreateIter handle: the iterator plus the current
+    batch (MXDataIterNext advances; Get* read the cursor batch, the
+    reference's cursor contract)."""
+
+    def __init__(self, name, str_params):
+        import mxnet_tpu as mx
+        if name not in _ITER_FACTORIES:
+            raise ValueError("unknown data iter %r (have %s)"
+                             % (name, ", ".join(_ITER_FACTORIES)))
+        self._it = getattr(mx.io, name)(**_coerce_str_params(str_params))
+        self._batch = None
+
+    def next(self):
+        try:
+            self._batch = next(self._it)
+            return 1
+        except StopIteration:
+            self._batch = None
+            return 0
+
+    def before_first(self):
+        self._it.reset()
+        self._batch = None
+        return True
+
+    def data(self):
+        return self._batch.data[0]
+
+    def label(self):
+        return self._batch.label[0]
+
+    def pad(self):
+        return int(self._batch.pad or 0)
+
+
+def io_create(name, keys, vals):
+    return CDataIter(name, dict(zip(keys, vals)))
+
+
+def io_next(it):
+    return it.next()
+
+
+def io_before_first(it):
+    return it.before_first()
+
+
+def io_data(it):
+    return it.data()
+
+
+def io_label(it):
+    return it.label()
+
+
+def io_pad(it):
+    return it.pad()

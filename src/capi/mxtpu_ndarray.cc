@@ -563,7 +563,9 @@ int KvKeyedCall(const char* method, KVStoreHandle h, mx_uint n,
   return 0;
 }
 
-int KvIntResult(const char* method, KVStoreHandle h, int* out) {
+// int-valued single-handle bridge call (kvstore rank/size, iterator
+// next/pad)
+int KvIntResult(const char* method, void* h, int* out) {
   GILGuard gil;
   PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(h));
   PyObject* r = CallBridge(method, args);
@@ -628,4 +630,110 @@ MXTPU_API int MXKVStoreGetRank(KVStoreHandle h, int* out) {
 
 MXTPU_API int MXKVStoreGetGroupSize(KVStoreHandle h, int* out) {
   return KvIntResult("kv_group_size", h, out);
+}
+
+// ---------------------------------------------------------------------------
+// DataIter surface (reference: src/c_api/c_api.cc MXListDataIters /
+// MXDataIterCreateIter / Next / BeforeFirst / GetData / GetLabel /
+// GetPadNum).  DataIterHandle is an owned PyObject* like the others;
+// creation takes string key/value params exactly like the reference's
+// creator entry point.
+// ---------------------------------------------------------------------------
+
+typedef void* DataIterHandle;
+
+namespace {
+thread_local std::string g_iter_names;
+
+int IterNdResult(const char* method, DataIterHandle h,
+                 NDArrayHandle* out) {
+  GILGuard gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(h));
+  PyObject* r = CallBridge(method, args);
+  Py_DECREF(args);
+  if (!r) {
+    SetErrorFromPython();
+    return -1;
+  }
+  *out = r;  // new caller-owned NDArray reference
+  return 0;
+}
+
+}  // namespace
+
+MXTPU_API int MXListDataIters(const char** out_names) {
+  GILGuard gil;
+  PyObject* args = PyTuple_New(0);
+  PyObject* r = CallBridge("io_list_iters", args);
+  Py_DECREF(args);
+  return StringResult(r, &g_iter_names, out_names);
+}
+
+MXTPU_API int MXDataIterCreateIter(const char* name, mx_uint num_params,
+                                   const char** keys, const char** vals,
+                                   DataIterHandle* out) {
+  GILGuard gil;
+  PyObject* ks = PyList_New(num_params);
+  PyObject* vs = PyList_New(num_params);
+  for (mx_uint i = 0; i < num_params; ++i) {
+    PyObject* k = PyUnicode_FromString(keys[i]);
+    PyObject* v = k ? PyUnicode_FromString(vals[i]) : nullptr;
+    if (!k || !v) {
+      Py_XDECREF(k);
+      Py_DECREF(ks);
+      Py_DECREF(vs);
+      SetErrorFromPython();
+      return -1;
+    }
+    PyList_SetItem(ks, i, k);
+    PyList_SetItem(vs, i, v);
+  }
+  PyObject* args = Py_BuildValue("(sOO)", name, ks, vs);
+  Py_DECREF(ks);
+  Py_DECREF(vs);
+  PyObject* r = CallBridge("io_create", args);
+  Py_DECREF(args);
+  if (!r) {
+    SetErrorFromPython();
+    return -1;
+  }
+  *out = r;
+  return 0;
+}
+
+MXTPU_API int MXDataIterFree(DataIterHandle handle) {
+  GILGuard gil;
+  Py_XDECREF(static_cast<PyObject*>(handle));
+  return 0;
+}
+
+MXTPU_API int MXDataIterNext(DataIterHandle handle, int* out) {
+  return KvIntResult("io_next", handle, out);
+}
+
+MXTPU_API int MXDataIterBeforeFirst(DataIterHandle handle) {
+  GILGuard gil;
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* r = CallBridge("io_before_first", args);
+  Py_DECREF(args);
+  if (!r) {
+    SetErrorFromPython();
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+MXTPU_API int MXDataIterGetData(DataIterHandle handle,
+                                NDArrayHandle* out) {
+  return IterNdResult("io_data", handle, out);
+}
+
+MXTPU_API int MXDataIterGetLabel(DataIterHandle handle,
+                                 NDArrayHandle* out) {
+  return IterNdResult("io_label", handle, out);
+}
+
+MXTPU_API int MXDataIterGetPadNum(DataIterHandle handle, int* out) {
+  return KvIntResult("io_pad", handle, out);
 }

@@ -243,3 +243,89 @@ def test_c_kvstore_api_push_pull():
         env=env, capture_output=True, text=True, timeout=600)
     assert res.returncode == 0, (res.stdout + res.stderr)[-3000:]
     assert "KV_C_API_OK" in res.stdout
+
+
+_ITER_DRIVER = textwrap.dedent("""
+    import ctypes, os, sys
+    import numpy as np
+
+    lib = ctypes.CDLL(sys.argv[1])
+    lib.MXGetLastError.restype = ctypes.c_char_p
+    tmp = sys.argv[2]
+
+    def check(rc):
+        if rc != 0:
+            raise RuntimeError(lib.MXGetLastError().decode())
+
+    names_p = ctypes.c_char_p()
+    check(lib.MXListDataIters(ctypes.byref(names_p)))
+    names = names_p.value.decode().split("\\n")
+    assert "CSVIter" in names and "MNISTIter" in names, names
+
+    # 8 rows of 3 features + labels, batches of 4
+    data = np.arange(24, dtype=np.float32).reshape(8, 3)
+    np.savetxt(os.path.join(tmp, "d.csv"), data, delimiter=",")
+    np.savetxt(os.path.join(tmp, "l.csv"),
+               np.arange(8, dtype=np.float32), delimiter=",")
+    keys = (ctypes.c_char_p * 4)(b"data_csv", b"label_csv",
+                                 b"data_shape", b"batch_size")
+    vals = (ctypes.c_char_p * 4)(
+        os.path.join(tmp, "d.csv").encode(),
+        os.path.join(tmp, "l.csv").encode(), b"(3,)", b"4")
+    it = ctypes.c_void_p()
+    check(lib.MXDataIterCreateIter(b"CSVIter", 4, keys, vals,
+                                   ctypes.byref(it)))
+
+    def epoch():
+        seen = []
+        has = ctypes.c_int()
+        while True:
+            check(lib.MXDataIterNext(it, ctypes.byref(has)))
+            if not has.value:
+                break
+            d = ctypes.c_void_p()
+            check(lib.MXDataIterGetData(it, ctypes.byref(d)))
+            buf = np.zeros((4, 3), np.float32)
+            check(lib.MXNDArraySyncCopyToCPU(
+                d, buf.ctypes.data_as(ctypes.c_void_p),
+                ctypes.c_size_t(buf.nbytes)))
+            lab = ctypes.c_void_p()
+            check(lib.MXDataIterGetLabel(it, ctypes.byref(lab)))
+            lbuf = np.zeros((4,), np.float32)
+            check(lib.MXNDArraySyncCopyToCPU(
+                lab, lbuf.ctypes.data_as(ctypes.c_void_p),
+                ctypes.c_size_t(lbuf.nbytes)))
+            pad = ctypes.c_int()
+            check(lib.MXDataIterGetPadNum(it, ctypes.byref(pad)))
+            seen.append((buf.copy(), lbuf.copy(), pad.value))
+            check(lib.MXNDArrayFree(d))
+            check(lib.MXNDArrayFree(lab))
+        return seen
+
+    first = epoch()
+    assert len(first) == 2, len(first)
+    np.testing.assert_allclose(first[0][0], data[:4])
+    np.testing.assert_allclose(first[1][1], np.arange(4, 8))
+    assert first[0][2] == 0
+
+    check(lib.MXDataIterBeforeFirst(it))
+    second = epoch()
+    np.testing.assert_allclose(second[0][0], first[0][0])
+    check(lib.MXDataIterFree(it))
+    print("ITER_C_API_OK")
+""")
+
+
+def test_c_dataiter_api():
+    """CSVIter through the C ABI: listing, string-param creation,
+    Next/GetData/GetLabel/GetPadNum, and BeforeFirst rewind (reference
+    surface: c_api.cc MXDataIter*)."""
+    lib = _build_lib()
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+        res = subprocess.run(
+            [sys.executable, "-c", _ITER_DRIVER, lib, td],
+            env=env, capture_output=True, text=True, timeout=600)
+        assert res.returncode == 0, (res.stdout + res.stderr)[-3000:]
+        assert "ITER_C_API_OK" in res.stdout
