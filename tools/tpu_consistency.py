@@ -166,6 +166,22 @@ def _cases(mx):
         s.Reshape(s.MultiBoxPrior(d, sizes=(0.3,), ratios=(1.0, 2.0)),
                   (1, -1, 4)), dim=1),
         {"data": (1, 3, 4, 4)}, grad_req="null")
+
+    # --- round-5 additions ----------------------------------------------
+    # CTC with per-sequence lengths (flag-gated optional graph inputs)
+    add("ctc_lengths", s.CTCLoss(
+        d, s.var("clab"), s.var("cdl"), s.var("cll"),
+        use_data_lengths=True, use_label_lengths=True,
+        blank_label="last"),
+        {"data": (6, 2, 5), "clab": (2, 3), "cdl": (2,), "cll": (2,)},
+        grad_req="null",
+        location={"clab": _np.array([[1, 2, 0], [3, 1, 2]], _np.float32),
+                  "cdl": _np.array([4, 6], _np.float32),
+                  "cll": _np.array([2, 3], _np.float32)})
+    # 'full'-convention pooling (the SSD/VGG pool3 path)
+    add("pool_full_conv", s.Pooling(
+        d, kernel=(2, 2), stride=(2, 2), pool_type="max",
+        pooling_convention="full"), {"data": (1, 2, 7, 7)})
     return cases
 
 
