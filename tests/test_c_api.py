@@ -130,24 +130,32 @@ def test_c_ndarray_api_end_to_end(tmp_path):
     assert "C_API_OK" in res.stdout
 
 
-def test_cpp_binding_example_trains(tmp_path):
-    """The C++ header binding (include/mxtpu/cpp/ndarray.hpp) compiles
-    and trains a linear model end to end (examples/cpp/train_linear.cpp
-    — the reference's cpp-package example shape)."""
+def _compile_and_run_example(source_name, binary_name, marker, argv=()):
+    """Shared scaffold for the C++ example tests: compile against
+    libmxtpu_nd, run with the runtime env, assert the success marker."""
     lib = _build_lib()
-    binary = os.path.join(REPO, "build", "train_linear")
+    binary = os.path.join(REPO, "build", binary_name)
     res = subprocess.run(
         ["g++", "-std=c++17", "-I" + os.path.join(REPO, "include"),
-         os.path.join(REPO, "examples", "cpp", "train_linear.cpp"),
+         os.path.join(REPO, "examples", "cpp", source_name),
          "-L" + os.path.dirname(lib), "-lmxtpu_nd", "-o", binary],
         capture_output=True, text=True)
     assert res.returncode == 0, res.stderr[-2000:]
     env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
                LD_LIBRARY_PATH=os.path.dirname(lib))
-    res = subprocess.run([binary, str(tmp_path)], env=env,
-                         capture_output=True, text=True, timeout=600)
+    res = subprocess.run([binary, *argv], env=env, capture_output=True,
+                         text=True, timeout=600)
     assert res.returncode == 0, res.stdout[-1500:] + res.stderr[-1500:]
-    assert "CPP-TRAIN-OK" in res.stdout
+    assert marker in res.stdout
+
+
+def test_cpp_binding_example_trains(tmp_path):
+
+    """The C++ header binding (include/mxtpu/cpp/ndarray.hpp) compiles
+    and trains a linear model end to end (examples/cpp/train_linear.cpp
+    — the reference's cpp-package example shape)."""
+    _compile_and_run_example("train_linear.cpp", "train_linear",
+                             "CPP-TRAIN-OK", argv=(str(tmp_path),))
 
 
 def test_cpp_symbolic_training_example(tmp_path):
@@ -155,20 +163,8 @@ def test_cpp_symbolic_training_example(tmp_path):
     + Forward/Backward, include/mxtpu/cpp/symbol.hpp) trains a
     symbol-JSON MLP classifier from C++ end to end (reference surface:
     src/c_api/c_api_executor.cc)."""
-    lib = _build_lib()
-    binary = os.path.join(REPO, "build", "train_symbolic")
-    res = subprocess.run(
-        ["g++", "-std=c++17", "-I" + os.path.join(REPO, "include"),
-         os.path.join(REPO, "examples", "cpp", "train_symbolic.cpp"),
-         "-L" + os.path.dirname(lib), "-lmxtpu_nd", "-o", binary],
-        capture_output=True, text=True)
-    assert res.returncode == 0, res.stderr[-2000:]
-    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
-               LD_LIBRARY_PATH=os.path.dirname(lib))
-    res = subprocess.run([binary], env=env, capture_output=True,
-                         text=True, timeout=600)
-    assert res.returncode == 0, res.stdout[-1500:] + res.stderr[-1500:]
-    assert "symbolic C ABI training OK" in res.stdout
+    _compile_and_run_example("train_symbolic.cpp", "train_symbolic",
+                             "symbolic C ABI training OK")
 
 
 _KV_DRIVER = textwrap.dedent("""
@@ -329,3 +325,13 @@ def test_c_dataiter_api():
             env=env, capture_output=True, text=True, timeout=600)
         assert res.returncode == 0, (res.stdout + res.stderr)[-3000:]
         assert "ITER_C_API_OK" in res.stdout
+
+
+def test_cpp_full_stack_training_example(tmp_path):
+    """Every C ABI surface composed in one C++ training loop: CSVIter
+    batches -> SimpleBind executor -> Forward/Backward -> KVStore
+    push/pull -> fused sgd_update (examples/cpp/train_full_stack.cpp;
+    the reference's Module loop over c_api.h)."""
+    _compile_and_run_example("train_full_stack.cpp", "train_full_stack",
+                             "full-stack C ABI training OK",
+                             argv=(str(tmp_path),))
