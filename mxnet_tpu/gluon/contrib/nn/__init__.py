@@ -1,5 +1,5 @@
 """Contrib layers (reference: gluon/contrib/nn/basic_layers.py)."""
 
 from .basic_layers import (Concurrent, HybridConcurrent, Identity,  # noqa
-                           MultiHeadAttention, SparseEmbedding,
+                           MoEFFN, MultiHeadAttention, SparseEmbedding,
                            SyncBatchNorm)

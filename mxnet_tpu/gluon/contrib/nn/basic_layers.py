@@ -140,3 +140,37 @@ class MultiHeadAttention(HybridBlock):
     def __repr__(self):
         return "MultiHeadAttention(units=%d, heads=%d, causal=%s)" % (
             self._units, self._num_heads, self._causal)
+
+
+class MoEFFN(HybridBlock):
+    """Top-1 capacity-routed mixture-of-experts feed-forward layer over
+    the ``_contrib_MoEFFN`` op (GShard einsum formulation).
+
+    The reference has no MoE; this is the expert-parallel TPU extension
+    at the USER level: dispatch/combine are static-shape einsums, so a
+    ``ParallelTrainer(param_specs={r"expert_w": P("ep", None, None)})``
+    shards the expert weights (and their optimizer state) over an
+    ``ep`` mesh axis and XLA's SPMD partitioner inserts the token
+    all-to-alls inside the compiled step — the trainer-level peer of
+    ``parallel.moe_apply``'s explicit shard_map dispatch.
+
+    Input/output: (batch, in_units) tokens (flatten sequences first).
+    """
+
+    def __init__(self, in_units, hidden, num_experts,
+                 capacity_factor=1.0, act_type="relu", **kwargs):
+        super().__init__(**kwargs)
+        self._cf = float(capacity_factor)
+        self._act = act_type
+        with self.name_scope():
+            self.gate_weight = self.params.get(
+                "gate_weight", shape=(in_units, num_experts))
+            self.expert_w1 = self.params.get(
+                "expert_w1", shape=(num_experts, in_units, hidden))
+            self.expert_w2 = self.params.get(
+                "expert_w2", shape=(num_experts, hidden, in_units))
+
+    def hybrid_forward(self, F, x, gate_weight, expert_w1, expert_w2):
+        return F._contrib_MoEFFN(x, gate_weight, expert_w1, expert_w2,
+                                 capacity_factor=self._cf,
+                                 act_type=self._act)

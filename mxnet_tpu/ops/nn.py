@@ -687,3 +687,76 @@ def _deconv_inputs(params):
     return ("data", "weight", "bias")
 
 _get_op("Deconvolution").active_inputs = _deconv_inputs
+
+
+def top1_route(x, gate_weight, cap, precision=None):
+    """Shared top-1 capacity routing: softmax router, argmax expert,
+    1-based cumsum position within the expert's capacity buffer.
+    Returns (probs, gate, expert_idx, slot, keep).  Used by the
+    _contrib_MoEFFN op below and parallel/moe.py's shard_map variant —
+    one definition so the two MoE paths cannot diverge."""
+    e = gate_weight.shape[1]
+    logits = jnp.einsum("nd,de->ne", x, gate_weight,
+                        precision=precision)
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)
+    gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=1)[:, 0]
+    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.int32)
+    pos = jnp.cumsum(onehot, axis=0) * onehot              # 1-based
+    slot = jnp.sum(pos, axis=-1) - 1
+    keep = slot < cap
+    return probs, gate, expert_idx, slot, keep
+
+
+@register_op("_contrib_MoEFFN", aliases=("MoEFFN",), num_outputs=2,
+             num_visible_outputs=lambda p: 2
+             if p.get("output_aux_loss") else 1)
+def _moe_ffn(data, gate_weight, expert_w1, expert_w2,
+             capacity_factor=1.0, act_type="relu",
+             output_aux_loss=False):
+    """Top-1 capacity-routed mixture-of-experts FFN, GShard einsum
+    formulation (reference has no MoE; TPU extension alongside
+    parallel/moe.py's explicit shard_map variant).
+
+    data: (N, D) tokens; gate_weight: (D, E); expert_w1: (E, D, H);
+    expert_w2: (E, H, D).  All routing/dispatch/combine are static-
+    shape einsums over a (N, E, C) dispatch tensor, so the op traces
+    like any other symbol op and — with the expert leaves sharded
+    P('ep', ...) at trainer level — XLA's SPMD partitioner inserts the
+    token all-to-alls itself; no shard_map or mesh plumbing in the op.
+    Tokens beyond an expert's capacity C = ceil(cf * N / E) are dropped
+    (standard top-1 semantics); combine carries the router probability
+    so the gate learns.
+
+    Outputs: out (N, D); with ``output_aux_loss=True`` also the
+    load-balancing loss (mean fraction-routed x mean gate-prob per
+    expert, scaled by E^2 — the GShard/Switch auxiliary) as a second
+    visible output to add to the training loss.
+    """
+    n, dmodel = data.shape
+    e = gate_weight.shape[1]
+    cap = max(1, int(-(-float(capacity_factor) * n // e)))
+    prec = matmul_precision(data.dtype, expert_w1.dtype)
+    probs, gate, expert_idx, slot, keep = top1_route(
+        data, gate_weight, cap, precision=prec)
+    # dispatch: (N, E, C) one-hot of (expert, capacity slot)
+    dispatch = (jax.nn.one_hot(expert_idx, e, dtype=data.dtype)[:, :, None]
+                * jax.nn.one_hot(jnp.where(keep, slot, cap),
+                                 cap + 1, dtype=data.dtype)[:, None, :cap])
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch, data,
+                           precision=prec)                 # (E, C, D)
+    h = jnp.einsum("ecd,edh->ech", expert_in, expert_w1,
+                   precision=prec)
+    h = _activation(h, act_type=act_type)
+    out_e = jnp.einsum("ech,ehd->ecd", h, expert_w2, precision=prec)
+    combine = dispatch * gate[:, None, None]
+    out = jnp.einsum("nec,ecd->nd", combine, out_e, precision=prec)
+    # load balancing (Switch aux): fraction routed x mean router prob.
+    # Only visible with output_aux_loss=True (LayerNorm's
+    # output_mean_var pattern) — add it to the training loss to avoid
+    # expert collapse.
+    frac = jnp.mean(jax.nn.one_hot(expert_idx, e, dtype=data.dtype),
+                    axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = jnp.sum(frac * mean_prob) * (e * e)
+    return out, aux.astype(data.dtype)

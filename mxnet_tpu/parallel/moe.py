@@ -36,20 +36,12 @@ def moe_apply(expert_fn, expert_params, x, gate_w, axis_name="ep",
     """
 
     def shard_fn(params, xs, gw):
+        from ..ops.nn import top1_route
         params = jax.tree.map(lambda a: a[0], params)
         e = jax.lax.axis_size(axis_name)
         nloc, d = xs.shape
         cap = max(1, int(capacity_factor * nloc / e))
-        logits = xs @ gw                                   # (nloc, E)
-        probs = jax.nn.softmax(logits, axis=-1)
-        expert_idx = jnp.argmax(probs, axis=-1)            # (nloc,)
-        gate = jnp.take_along_axis(probs, expert_idx[:, None],
-                                   axis=1)[:, 0]
-        # position of each token within its expert's capacity buffer
-        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.int32)
-        pos = jnp.cumsum(onehot, axis=0) * onehot          # 1-based
-        slot = jnp.sum(pos, axis=-1) - 1                   # (nloc,)
-        keep = slot < cap
+        _, gate, expert_idx, slot, keep = top1_route(xs, gw, cap)
         # dispatch buffer: (E, cap, D) of this device's tokens, plus a
         # filled-slot mask that travels with it
         disp = jnp.zeros((e, cap, d), xs.dtype)
