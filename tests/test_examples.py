@@ -165,3 +165,16 @@ def test_train_multihost_launcher():
               "--num-steps", "10"], timeout=600)
     assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
     assert r.stdout.count("MULTIHOST-TRAIN-OK") == 2
+
+
+def test_train_moe_expert_parallel_converges():
+    """MoE classifier (contrib.nn.MoEFFN, GShard einsum routing)
+    trained with expert weights sharded P('ep') over the dp x ep mesh
+    converges to >=0.9 accuracy (examples/train_moe.py)."""
+    r = _run([sys.executable, "examples/train_moe.py",
+              "--num-epochs", "25"],
+             timeout=1800,
+             extra_env={"XLA_FLAGS":
+                        "--xla_force_host_platform_device_count=8"})
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert "MOE-TRAIN-OK" in r.stdout

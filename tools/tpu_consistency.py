@@ -182,6 +182,12 @@ def _cases(mx):
     add("pool_full_conv", s.Pooling(
         d, kernel=(2, 2), stride=(2, 2), pool_type="max",
         pooling_convention="full"), {"data": (1, 2, 7, 7)})
+    # GShard-einsum MoE (routing argmax ties break identically only at
+    # matched precision — exactly what the sweep checks)
+    add("moe_ffn", s.MoEFFN(d, s.var("mgw"), s.var("mw1"),
+                            s.var("mw2"), capacity_factor=2.0),
+        {"data": (16, 8), "mgw": (8, 4), "mw1": (4, 8, 16),
+         "mw2": (4, 16, 8)})
     return cases
 
 
