@@ -449,6 +449,21 @@ def test_pipeline_trainer_matches_sequential():
         for s in tr_b._opt_state[n]:
             assert tuple(s.sharding.spec)[:1] == ("pp",), n
 
+    # evaluate/predict run the pipeline in inference mode; predict
+    # equals the sequential forward with the current stacked weights,
+    # and evaluate equals the L2 loss of that forward
+    ev = float(tr_b.evaluate_batch(X, Y))
+    pred = np.asarray(tr_b.predict_batch(X)).astype(np.float32)
+    Wst = np.asarray(tr_b._params["pp:weight"]).astype(np.float32)
+    Bst = np.asarray(tr_b._params["pp:bias"]).astype(np.float32)
+    h = X.copy()
+    for i in range(4):
+        h = np.tanh(h @ Wst[i].T + Bst[i])
+    np.testing.assert_allclose(pred, h, rtol=1e-4, atol=1e-5)
+    # L2Loss: mean over batch of mean-per-sample 0.5*(h-y)^2
+    want_ev = float(np.mean(0.5 * (h - Y) ** 2))
+    np.testing.assert_allclose(ev, want_ev, rtol=1e-4)
+
 
 def test_pipeline_trainer_rejects_nonuniform_stages():
     import pytest
