@@ -377,7 +377,85 @@ def timed_resnet_fwd(batch, image, iters, scan_n, warmup=2,
             "flops_per_step": flops}
 
 
+def compare_update_paths(n_layers=30, dim=64, batch=32, steps=30,
+                         optimizer="sgd", opt_params=None):
+    """``--compare-update-paths``: fused ``forward_backward_update``
+    (one donated XLA program per step) vs the legacy
+    forward_backward + per-parameter Updater loop, on a deep synthetic
+    MLP (2*n_layers+2 parameters — launch-overhead bound, so the
+    per-step dispatch count is what's measured).  Runs anywhere; on CPU
+    it is the fused-step acceptance microbench.  Prints one JSON line
+    and returns the dict."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import sym
+    from mxnet_tpu.io import DataBatch
+
+    def build():
+        data = sym.var("data")
+        net = data
+        for i in range(n_layers):
+            net = sym.FullyConnected(net, num_hidden=dim, name="l%d" % i)
+            net = sym.Activation(net, act_type="relu")
+        net = sym.FullyConnected(net, num_hidden=4, name="out")
+        return sym.SoftmaxOutput(net, name="softmax")
+
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(batch, dim).astype(np.float32))
+    y = mx.nd.array(rng.randint(0, 4, (batch,)).astype(np.float32))
+    data_batch = DataBatch(data=[x], label=[y])
+    params = dict(opt_params or {"learning_rate": 0.01, "momentum": 0.9})
+
+    def run(fused):
+        prior = os.environ.get("MXNET_MODULE_FUSED_STEP")
+        os.environ["MXNET_MODULE_FUSED_STEP"] = "1" if fused else "0"
+        try:
+            mod = mx.Module(build(), context=mx.cpu())
+            mod.bind([("data", (batch, dim))],
+                     [("softmax_label", (batch,))])
+            mod.init_params(mx.init.Xavier())
+            mod.init_optimizer(optimizer=optimizer,
+                               optimizer_params=dict(params))
+            for _ in range(3):                       # warmup/compile
+                mod.forward_backward_update(data_batch)
+            mod.get_outputs()[0].asnumpy()
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                mod.forward_backward_update(data_batch)
+            # readbacks drain the async chain before the clock stops
+            mod.get_outputs()[0].asnumpy()
+            mod._exec_group.execs[0].arg_dict["l0_weight"].asnumpy()
+            return steps / (time.perf_counter() - t0)
+        finally:
+            if prior is None:
+                os.environ.pop("MXNET_MODULE_FUSED_STEP", None)
+            else:
+                os.environ["MXNET_MODULE_FUSED_STEP"] = prior
+
+    legacy = run(False)
+    fused = run(True)
+    out = {
+        "metric": "fused_vs_legacy_update_paths",
+        "fused_steps_per_s": round(fused, 2),
+        "legacy_steps_per_s": round(legacy, 2),
+        "speedup": round(fused / legacy, 3),
+        "n_params": 2 * n_layers + 2,
+        "optimizer": optimizer,
+        "batch_size": batch,
+    }
+    print(json.dumps(out))
+    return out
+
+
 def main():
+    if "--compare-update-paths" in sys.argv:
+        # explicit A/B of the two update paths — a relative dispatch-
+        # overhead measurement, so it ALWAYS runs on CPU: the shell's
+        # JAX_PLATFORMS=axon export would route it over the TPU tunnel
+        # with none of the tunnel-health probing below (a wedged tunnel
+        # hangs compute forever)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        compare_update_paths()
+        return
     _ensure_platform()
     import jax
 

@@ -10,6 +10,8 @@ through the graph executor (c_predict_api.cc:106 MXPredCreatePartialOut).
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 
@@ -155,23 +157,55 @@ def nd_dtype(arr):
     return _FLAG_BY_DTYPE[str(arr.dtype)]
 
 
-def _coerce_str_params(str_params):
+@functools.lru_cache(maxsize=None)
+def _declared_bools(fn):
+    """Parameter names whose declared default is a bool — the only
+    params dmlc-style "true"/"false" coercion may apply to.  Cached:
+    nd_invoke is the eager C-ABI hot path.
+
+    Returns None ("no signature to consult", i.e. legacy coercion for
+    every param) when the signature is unavailable OR takes **kwargs
+    (e.g. Custom): params routed through VAR_KEYWORD cannot be
+    enumerated, so an empty set would silently disable coercion for
+    ALL of that op's params."""
+    import inspect
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return None
+    if any(p.kind is inspect.Parameter.VAR_KEYWORD
+           for p in sig.parameters.values()):
+        return None
+    return frozenset(p.name for p in sig.parameters.values()
+                     if isinstance(p.default, bool))
+
+
+def _coerce_str_params(str_params, bool_params=None):
     """String param dict -> python values: dmlc-style booleans
-    ("true"/"false", any case) then python literals, else the raw
-    string.  Shared by every C surface that takes string params."""
+    ("true"/"false", any case) for DECLARED-boolean params only, then
+    python literals, else the raw string.  Shared by every C surface
+    that takes string params.
+
+    *bool_params* is the set of param names declared boolean (see
+    `_declared_bools`); with None every param is eligible (legacy
+    behavior, for surfaces with no signature to consult).  Limiting the
+    coercion matters for string-typed params: a mode string that
+    happens to be "true" must stay a string, not become True."""
     import ast
     out = {}
     for k, v in str_params.items():
         low = v.lower() if isinstance(v, str) else v
-        if low == "true":
-            out[k] = True
-        elif low == "false":
-            out[k] = False
-        else:
-            try:
-                out[k] = ast.literal_eval(v)
-            except (ValueError, SyntaxError):
-                out[k] = v
+        if low in ("true", "false"):
+            # any-case bool spelling, "True"/"TRUE" included: either a
+            # declared-bool param (coerce) or a string-typed one (keep
+            # the raw string) — never let literal_eval decide
+            out[k] = low == "true" \
+                if bool_params is None or k in bool_params else v
+            continue
+        try:
+            out[k] = ast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            out[k] = v
     return out
 
 
@@ -188,8 +222,8 @@ def nd_invoke(op_name, inputs, str_params):
     from mxnet_tpu.ndarray.ndarray import imperative_invoke
     from mxnet_tpu.ops.registry import get_op
 
-    params = _coerce_str_params(str_params)
     op = get_op(op_name)
+    params = _coerce_str_params(str_params, _declared_bools(op.fn))
     out = None
     if op.donate and isinstance(op.num_outputs, int) and \
             len(op.donate) == op.num_outputs:
@@ -358,7 +392,10 @@ class CDataIter(object):
         if name not in _ITER_FACTORIES:
             raise ValueError("unknown data iter %r (have %s)"
                              % (name, ", ".join(_ITER_FACTORIES)))
-        self._it = getattr(mx.io, name)(**_coerce_str_params(str_params))
+        factory = getattr(mx.io, name)
+        sig_fn = factory.__init__ if isinstance(factory, type) else factory
+        self._it = factory(**_coerce_str_params(
+            str_params, _declared_bools(sig_fn)))
         self._batch = None
 
     def next(self):

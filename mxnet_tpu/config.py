@@ -93,6 +93,11 @@ register_env("MXNET_SUBGRAPH_BACKEND", str, "",
 register_env("MXNET_TPU_MATMUL_PRECISION", str, "",
              "Override jax matmul precision: bfloat16 | float32 | "
              "tensorfloat32 (TPU-native knob)")
+register_env("MXNET_MODULE_FUSED_STEP", bool, True,
+             "Module.forward_backward_update fuses forward + backward + "
+             "gradient reduction + optimizer update into one donated "
+             "XLA program when eligible; off = always run the legacy "
+             "per-parameter Updater loop (TPU-native knob)")
 register_env("MXNET_UPDATE_ON_KVSTORE", bool, True,
              "Run the optimizer on the kvstore server (dist) / store "
              "(local) instead of locally (reference: module/trainer)")

@@ -133,6 +133,7 @@ class Executor:
         # row_sparse (ids, rows) pairs instead of a dense (vocab, dim)
         # buffer — see ops/sparse_graph.py SparseGradWeight
         self._sparse_embeds = {}
+        self._sparse_embed_nodes = {}
         for node in symbol._topo():
             if node.is_var or node.op.name != "Embedding":
                 continue
@@ -162,6 +163,7 @@ class Executor:
                     % wsrc.name)
             self._sparse_embeds[wsrc.name] = (
                 dsrc.name, int(node.params.get("output_dim")))
+            self._sparse_embed_nodes[wsrc.name] = node
         # swap the grad buffer for an rsp container ONCE at bind so the
         # handle a caller grabs (args_grad, the C ABI's arg_grads) stays
         # aliased across backwards — writeback mutates it in place.
@@ -181,14 +183,17 @@ class Executor:
         if self._sparse_embeds:
             # a sparse-grad weight must feed ONLY its Embedding node:
             # train_step wraps it in a SparseGradWeight carrier, which
-            # other ops (e.g. a tied output projection) cannot consume
+            # other ops (e.g. a tied output projection) cannot consume.
+            # The exemption is the SPECIFIC registered node — a weight
+            # shared with a second Embedding (sparse or not) must fail
+            # here too, not surface as a trace-time shape error
             for node in symbol._topo():
                 if node.is_var:
                     continue
                 for i, (src, _) in enumerate(node.inputs):
                     if src.is_var and src.name in self._sparse_embeds \
-                            and not (node.op.name == "Embedding"
-                                     and i == 1):
+                            and not (node is self._sparse_embed_nodes[
+                                src.name] and i == 1):
                         raise MXNetError(
                             "weight %r has sparse_grad=True but is also "
                             "consumed by %r (%s); weight tying requires "
@@ -265,6 +270,9 @@ class Executor:
             return outs, auxu, grads
 
         self._jit_train_step = jax.jit(train_step)
+        # unjitted core kept for nesting inside the fused
+        # forward+backward+update program (init_fused_step)
+        self._train_step_fn = train_step
 
         if self._group2ctx:
             self._init_grouped()
@@ -310,6 +318,60 @@ class Executor:
         self._jit_infer = jit_infer
         self._jit_train = jit_train
         self._jit_train_step = train_step
+        # segment-chained evaluation is not one pure program; the fused
+        # single-program step cannot be built on top of it
+        self._train_step_fn = None
+
+    def init_fused_step(self, tree_update_fn):
+        """Build the fused train step: forward + VJP + optimizer update
+        in ONE donated ``jax.jit`` — weights and optimizer state stay
+        device-resident and step N+1 chains on step N's donated
+        buffers (no per-parameter host dispatch; the TVM/CUDA-Graph
+        whole-step-capture idea applied at the XLA level).
+
+        ``tree_update_fn(grads, params, state, lrs, wds, ts)`` is the
+        pure tree-level optimizer sweep (optimizer/tree_opt.py).
+        Signature of the returned callable::
+
+            fused(params, rest, aux_map, base_key, opt_state, lrs,
+                  wds, ts, step) -> (outs, new_aux, new_params,
+                                     new_opt_state)
+
+        *params* holds only the UPDATABLE args (donated); data/labels/
+        fixed params ride in *rest* undonated so caller-owned batch
+        buffers stay valid.  *ts* carries the per-name update counts;
+        *step* is the scalar step the PRNG key is folded with in-graph,
+        so not even a key split dispatches per step."""
+        if self._train_step_fn is None:
+            raise MXNetError(
+                "the fused train step is not supported with group2ctx "
+                "model parallelism (segment-chained execution)")
+        core = self._train_step_fn
+        n_outs = len(self._symbol._outputs)
+        from . import profiler as _prof
+
+        def fused_step(params, rest, aux_map, base_key, opt_state, lrs,
+                       wds, ts, step):
+            # the Python body only runs at trace time — this IS the
+            # compile counter (cached executions bump nothing)
+            _prof.bump_counter("fused_step_compiles")
+            key = jax.random.fold_in(base_key, step)
+            arg_map = dict(rest)
+            arg_map.update(params)
+            outs, auxu, grads = core(arg_map, aux_map, key,
+                                     [None] * n_outs)
+            new_params, new_state = tree_update_fn(
+                grads, params, opt_state, lrs, wds, ts)
+            new_aux = dict(aux_map)
+            new_aux.update(auxu)
+            return outs, new_aux, new_params, new_state
+
+        from .ops.registry import supports_donation
+        # donate weights + optimizer state (argnums 0 and 4)
+        donate = (0, 4) if supports_donation() else ()
+        # the caller owns the program (Module keeps it in _fused["fn"]
+        # and rebuilds on hyper-param mutation) — not stored here
+        return jax.jit(fused_step, donate_argnums=donate)
 
     # -- binding constructors ---------------------------------------------
     @staticmethod
@@ -438,6 +500,8 @@ class Executor:
                                              is_train)
         else:
             fn = self._jit_train if is_train else self._jit_infer
+            from . import profiler as _prof
+            _prof.bump_counter("executor_dispatches")
             outs, auxu = fn(self._arg_map(), self._aux_map(), key)
         if is_train:
             # keep the key: backward() must replay the same stochastic
@@ -487,6 +551,8 @@ class Executor:
             arg_map, aux_map = self._arg_map(), self._aux_map()
             key = self._next_key()
         # None cotangents must be materialized as ones for jit
+        from . import profiler as _prof
+        _prof.bump_counter("executor_dispatches")
         outs, auxu, grads = self._jit_train_step(
             arg_map, aux_map, key,
             _materialize(cots, self, arg_map, aux_map))

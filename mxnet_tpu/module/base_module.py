@@ -77,6 +77,13 @@ class BaseModule:
         self.forward(data_batch, is_train=True)
         self.backward()
 
+    def forward_backward_update(self, data_batch):
+        """One full training step.  Subclasses may override to fuse the
+        three stages into fewer device dispatches (Module folds them
+        into a single donated XLA program — see module.py)."""
+        self.forward_backward(data_batch)
+        self.update()
+
     def _fire(self, callbacks, param):
         for cb in _as_list(callbacks):
             cb(param)
@@ -178,8 +185,7 @@ class BaseModule:
             for nbatch, data_batch in enumerate(train_data):
                 if monitor is not None:
                     monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
+                self.forward_backward_update(data_batch)
                 self.update_metric(eval_metric, data_batch.label)
                 if monitor is not None:
                     monitor.toc_print()

@@ -216,6 +216,12 @@ class Module(BaseModule):
         self._kvstore = None
         self._grad_req = "write"
         self._monitor = None
+        # fused train step (forward_backward_update): lazy-built context
+        # dict, False once setup found a hard blocker, None = not built
+        self._fused = None
+        # device-resident optimizer state tree; None = (re)import from
+        # the legacy Updater before the next fused step
+        self._fused_state = None
 
     @staticmethod
     def load(prefix, epoch, load_optimizer_states=False, **kwargs):
@@ -233,14 +239,32 @@ class Module(BaseModule):
         arg_params, aux_params = self.get_params()
         save_checkpoint(prefix, epoch, None, arg_params, aux_params)
         if save_optimizer_states:
-            fname = "%s-%04d.states" % (prefix, epoch)
-            if self._updater is not None:
-                with open(fname, "wb") as f:
-                    f.write(self._updater.get_states())
-            elif self._kvstore is not None and self._update_on_kvstore:
-                # updater state lives in the kvstore (reference:
-                # module.py save_optimizer_states via kvstore)
-                self._kvstore.save_optimizer_states(fname)
+            self.save_optimizer_states("%s-%04d.states" % (prefix, epoch))
+
+    def save_optimizer_states(self, fname):
+        """Serialize optimizer state in the legacy per-index Updater
+        format — fused-trained state is exported into the Updater first,
+        so the file is identical whichever path trained it."""
+        assert self.optimizer_initialized
+        if self._updater is not None:
+            self._sync_fused_to_updater()
+            with open(fname, "wb") as f:
+                f.write(self._updater.get_states())
+        elif self._kvstore is not None and self._update_on_kvstore:
+            # updater state lives in the kvstore (reference:
+            # module.py save_optimizer_states via kvstore)
+            self._kvstore.save_optimizer_states(fname)
+
+    def load_optimizer_states(self, fname):
+        """Load optimizer state saved by :meth:`save_optimizer_states`;
+        the fused path re-imports it on its next step."""
+        assert self.optimizer_initialized
+        if self._updater is not None:
+            with open(fname, "rb") as f:
+                self._updater.set_states(f.read())
+            self._fused_state = None
+        elif self._kvstore is not None and self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
 
     # -- properties --------------------------------------------------------
     @property
@@ -326,6 +350,12 @@ class Module(BaseModule):
                     "shared_module bind: not all parameters could be "
                     "aliased (shape mismatch or missing) — call "
                     "init_params on this module")
+        # a rebind voids any fused-step program built on the old
+        # executors (but NOT the state tree: it re-exports via the
+        # updater interop if the caller kept the same optimizer)
+        self._sync_fused_to_updater()
+        self._fused = None
+        self._fused_state = None
         self.binded = True
         if self._arg_params is not None:
             self._set_exec_params(self._arg_params, self._aux_params)
@@ -413,6 +443,9 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized
         if self.optimizer_initialized and not force_init:
             return
+        # the fused step closes over the optimizer — rebuild lazily
+        self._fused = None
+        self._fused_state = None
         self._kvstore, self._update_on_kvstore = self._create_kvstore(
             kvstore, len(self._context))
         if isinstance(optimizer, str):
@@ -481,6 +514,12 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized and \
             self.optimizer_initialized
         self._params_dirty = True
+        if self._fused_state is not None:
+            # fused steps ran earlier: the device tree holds the truth —
+            # hand it back to the Updater so this legacy sweep continues
+            # from it (and the next fused step re-imports)
+            self._sync_fused_to_updater()
+            self._fused_state = None
         group = self._exec_group
         ex0 = group.execs[0]
         if self._kvstore is not None and self._update_on_kvstore:
@@ -498,8 +537,21 @@ class Module(BaseModule):
                 self._kvstore.pull(
                     i, out=[ex.arg_dict[name] for ex in group.execs])
             return
+        self._aggregate_grads(group)
+        for i, name in enumerate(group.param_names):
+            if group.grad_req[name] == "null":
+                continue
+            # grads were summed across device slices, so with
+            # rescale_grad=1/batch_size this is already the batch mean
+            self._updater(i, ex0.grad_dict[name], ex0.arg_dict[name])
+        self._exec_group.broadcast_params()
+
+    def _aggregate_grads(self, group):
+        """Cross-device gradient aggregation into every exec's
+        grad_dict: through a (local) kvstore when one is attached,
+        otherwise an in-process reduce.  Shared by the legacy update()
+        sweep and the partial-fused step."""
         if self._kvstore is not None:
-            # aggregate grads through the store, update locally
             for i, name in enumerate(group.param_names):
                 if group.grad_req[name] == "null":
                     continue
@@ -509,13 +561,237 @@ class Module(BaseModule):
                     i, out=[ex.grad_dict[name] for ex in group.execs])
         else:
             group.reduce_grads()
-        for i, name in enumerate(group.param_names):
-            if group.grad_req[name] == "null":
-                continue
-            # grads were summed across device slices, so with
-            # rescale_grad=1/batch_size this is already the batch mean
-            self._updater(i, ex0.grad_dict[name], ex0.arg_dict[name])
-        self._exec_group.broadcast_params()
+
+    # -- fused train step --------------------------------------------------
+    def forward_backward_update(self, data_batch):
+        """One training step.  When eligible (no kvstore or a local
+        one, a local Updater, and an optimizer with a tree-level kernel
+        mapping — optimizer/tree_opt.py), this runs the FUSED path:
+
+        * single device: the whole step — forward, VJP, optimizer
+          update — is ONE donated XLA program
+          (``Executor.init_fused_step``), so the ~O(params) per-step
+          eager dispatches of the legacy loop collapse to one, and
+          weights/momenta stay device-resident across steps;
+        * multiple devices: per-device forward_backward programs, then
+          the per-name ``Updater`` loop collapses to one jitted tree
+          update between ``reduce_grads()`` (or kvstore push/pull) and
+          ``broadcast_params()``.
+
+        Falls back to ``forward_backward()`` + ``update()`` for dist
+        kvstores, ``update_on_kvstore``, installed monitors,
+        ``inputs_need_grad``, non-'write' grad_req, and optimizers
+        without a tree mapping.  Disable with
+        ``MXNET_MODULE_FUSED_STEP=0``.
+
+        .. note:: on the full-fused path the gradients live only
+           inside the XLA program — ``grad_dict`` / bind-time
+           ``args_grad`` aliases are NOT refreshed (same opacity as a
+           captured CUDA graph).  Callbacks that inspect per-step
+           gradients must disable fusion or call
+           ``forward_backward()`` + ``update()`` themselves.
+        """
+        assert self.binded and self.params_initialized and \
+            self.optimizer_initialized
+        if not self._fused_ok():
+            self.forward_backward(data_batch)
+            self.update()
+            return
+        if self._fused is None:
+            self._setup_fused()
+        if self._fused is False:
+            self.forward_backward(data_batch)
+            self.update()
+            return
+        from ..optimizer import tree_opt
+        if self._fused["hyper"] != tree_opt.hyper_sig(self._optimizer):
+            # a baked-in hyper-param (rescale_grad, momentum, ...) was
+            # mutated mid-run — the legacy loop re-reads these every
+            # step, so rebuild the program instead of applying the
+            # stale constant (the state tree stays valid)
+            self._fused = None
+            self._setup_fused()
+        if self._fused_state is None:
+            self._import_fused_state()
+        if self._fused["mode"] == "full":
+            self._run_fused_full(data_batch)
+        else:
+            self._run_fused_partial(data_batch)
+
+    def _fused_ok(self):
+        from ..config import get_env
+        if not get_env("MXNET_MODULE_FUSED_STEP"):
+            return False
+        cls = type(self)
+        if cls.forward_backward is not Module.forward_backward \
+                or cls.update is not Module.update:
+            # a subclass customizing either stage (e.g. SVRGModule's
+            # variance-reduced gradient rewrite) composes them — the
+            # fused program would silently skip the override
+            return False
+        if self._updater is None:
+            return False       # update_on_kvstore: state lives store-side
+        if self._kvstore is not None and \
+                "dist" in getattr(self._kvstore, "type", ""):
+            return False
+        if self._monitor is not None or self.inputs_need_grad:
+            return False
+        if self._grad_req != "write":
+            return False       # 'add' accumulation breaks donation
+        from ..optimizer import tree_opt
+        return tree_opt.supports_fused(self._optimizer)
+
+    def _setup_fused(self):
+        from ..optimizer import tree_opt
+        group = self._exec_group
+        ex0 = group.execs[0]
+        names = [n for n in group.param_names
+                 if group.grad_req[n] != "null"]
+        if not names:
+            self._fused = False
+            return
+        if any(ex._group2ctx for ex in group.execs):
+            # group2ctx places parameters on different devices; one
+            # jitted tree update cannot span them — the legacy loop's
+            # per-param dispatch lands on each param's device
+            self._fused = False
+            return
+        # updater indices are positions in param_names (see update())
+        idx_of = {n: i for i, n in enumerate(group.param_names)}
+        tree_update = tree_opt.make_tree_update(self._optimizer)
+        ctx = {"names": names, "idx": idx_of,
+               "hyper": tree_opt.hyper_sig(self._optimizer)}
+        if len(group.execs) == 1 and self._kvstore is None and \
+                ex0._train_step_fn is not None:
+            ctx["mode"] = "full"
+            ctx["fn"] = ex0.init_fused_step(tree_update)
+        else:
+            import jax
+            from .. import profiler as _prof
+
+            def tree_apply(grads, params, state, lrs, wds, ts):
+                # trace-time only: the compile counter for this program
+                _prof.bump_counter("tree_apply_compiles")
+                return tree_update(grads, params, state, lrs, wds, ts)
+
+            from ..ops.registry import supports_donation
+            # donate params + optimizer state (argnums 1 and 2)
+            donate = (1, 2) if supports_donation() else ()
+            ctx["mode"] = "partial"
+            ctx["fn"] = jax.jit(tree_apply, donate_argnums=donate)
+        self._fused = ctx
+
+    def _import_fused_state(self):
+        """Legacy Updater state -> device-resident tree (fresh zeros for
+        indices the updater has not seen — its own lazy-create rule)."""
+        from ..optimizer import tree_opt
+        from ..ops.registry import supports_donation
+        ex0 = self._exec_group.execs[0]
+        put = ex0._place
+        if supports_donation():
+            # the first fused step DONATES these buffers, and the
+            # Updater's NDArrays alias them (import rebinds handles) —
+            # copy so updater.states never points at deleted arrays
+            import jax.numpy as jnp
+            place = ex0._place
+            put = lambda a: jnp.array(place(a))
+        params_nd = {n: ex0.arg_dict[n] for n in self._fused["names"]}
+        self._fused_state = tree_opt.import_from_updater(
+            self._updater, self._optimizer, params_nd,
+            self._fused["idx"], put=put)
+
+    def _sync_fused_to_updater(self):
+        """Export the device state tree into Updater.states (handle
+        rebinding only) so get_states / save_optimizer_states serialize
+        the exact legacy per-index format."""
+        if self._fused_state is not None and self._fused and \
+                self._updater is not None:
+            from ..optimizer import tree_opt
+            from ..ops.registry import supports_donation
+            tree_opt.export_to_updater(self._fused_state, self._updater,
+                                       self._fused["idx"],
+                                       copy=supports_donation())
+
+    def _run_fused_full(self, data_batch):
+        from ..optimizer import tree_opt
+        from .. import profiler as _prof
+        from ..executor import _wrap_out
+        from ..ndarray.ndarray import _as_nd
+        ctx = self._fused
+        group = self._exec_group
+        ex = group.execs[0]
+        names = ctx["names"]
+        data = _as_list(data_batch.data)
+        labels = _as_list(data_batch.label) if data_batch.label else []
+        for name, arr in zip(group.data_names, data):
+            dst = ex.arg_dict[name]
+            dst._data = ex._place(
+                _as_nd(arr)._data.astype(dst.dtype))
+        for name, arr in zip(group.label_names, labels):
+            if name in ex.arg_dict:
+                dst = ex.arg_dict[name]
+                dst._data = ex._place(
+                    _as_nd(arr)._data.astype(dst.dtype))
+        # a prior forward(is_train=True) snapshotted raw param buffers
+        # for backward() replay — this step donates exactly those, so
+        # the snapshot must not outlive it
+        ex._pending = None
+        arg_map = ex._arg_map()
+        params = {n: arg_map[n] for n in names}
+        rest = {n: v for n, v in arg_map.items() if n not in params}
+        ts, lrs, wds = tree_opt.host_hyper(self._optimizer, names,
+                                           ctx["idx"])
+        # the PRNG key folds in THIS module's update count, which
+        # advances every step — num_update only ratchets via max() and
+        # can stall when the optimizer is shared with a module trained
+        # further, which would replay the same dropout masks
+        outs, new_aux, new_params, new_state = ctx["fn"](
+            params, rest, ex._aux_map(), ex._key, self._fused_state,
+            lrs, wds, ts, max(ts.values()))
+        _prof.bump_counter("fused_step_dispatches")
+        self._fused_state = new_state
+        # rebind the bind-time containers in place: every alias (shared
+        # modules, C-ABI handles) sees the new buffers, and the donated
+        # old ones are never touched again
+        for n in names:
+            ex.arg_dict[n]._data = new_params[n]
+        for n, v in new_aux.items():
+            ex.aux_dict[n]._data = v
+        ex.outputs = [_wrap_out(o) for o in outs]
+        self._params_dirty = True
+
+    def _run_fused_partial(self, data_batch):
+        from ..optimizer import tree_opt
+        from .. import profiler as _prof
+        from ..ndarray.sparse import BaseSparseNDArray
+        ctx = self._fused
+        group = self._exec_group
+        ex0 = group.execs[0]
+        names = ctx["names"]
+        group.forward_backward(data_batch)
+        # the jitted tree update donates ex0's param buffers — a stale
+        # forward(is_train=True) snapshot must not outlive them (same
+        # rule as the full-fused path)
+        ex0._pending = None
+        self._aggregate_grads(group)
+        grads = {}
+        for n in names:
+            g = ex0.grad_dict[n]
+            if isinstance(g, BaseSparseNDArray):
+                grads[n] = (g._aux[0], g._data)   # rsp (ids, vals)
+            else:
+                grads[n] = g._data
+        params = {n: ex0.arg_dict[n]._data for n in names}
+        ts, lrs, wds = tree_opt.host_hyper(self._optimizer, names,
+                                           ctx["idx"])
+        new_params, new_state = ctx["fn"](
+            grads, params, self._fused_state, lrs, wds, ts)
+        _prof.bump_counter("tree_apply_dispatches")
+        self._fused_state = new_state
+        for n in names:
+            ex0.arg_dict[n]._data = new_params[n]
+        group.broadcast_params()
+        self._params_dirty = True
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
@@ -543,6 +819,7 @@ class Module(BaseModule):
 
     def install_monitor(self, mon):
         assert self.binded
+        self._monitor = mon    # per-op taps need the legacy step path
         for ex in self._exec_group.execs:
             mon.install(ex)
 

@@ -758,5 +758,8 @@ def _moe_ffn(data, gate_weight, expert_w1, expert_w2,
     frac = jnp.mean(jax.nn.one_hot(expert_idx, e, dtype=data.dtype),
                     axis=0)
     mean_prob = jnp.mean(probs, axis=0)
-    aux = jnp.sum(frac * mean_prob) * (e * e)
+    # Switch/GShard formulation: E * sum_e(frac_e * prob_e), i.e. the
+    # MEAN over experts scaled by E^2 (== 1 at uniform routing); sum
+    # would be E x too large
+    aux = jnp.mean(frac * mean_prob) * (e * e)
     return out, aux.astype(data.dtype)

@@ -171,6 +171,14 @@ def _freeze(v):
     return v
 
 
+def supports_donation():
+    """Whether the active backend honors jit buffer donation — CPU
+    PJRT does not (donating there only emits per-call warnings).  The
+    single source of truth for every donate_argnums decision (eager op
+    cache here, the fused train step, the jitted tree update)."""
+    return jax.default_backend() != "cpu"
+
+
 @functools.lru_cache(maxsize=None)
 def _compiled(name, frozen_params, dyn_names, donate):
     op = _OPS[name]
@@ -179,8 +187,8 @@ def _compiled(name, frozen_params, dyn_names, donate):
     def fn(*arrays, **dyn):
         return op.fn(*arrays, **params, **dyn)
 
-    if jax.default_backend() == "cpu":
-        donate = ()  # CPU PJRT has no donation; avoids per-call warnings
+    if not supports_donation():
+        donate = ()
     return jax.jit(fn, donate_argnums=donate)
 
 
@@ -252,6 +260,7 @@ def invoke(op, args, params, rng=None):
         else op.donate
     fn = _compiled(op.name, frozen, tuple(sorted(dyn)), donate)
     from .. import profiler as _prof
+    _prof.bump_counter("eager_dispatches")
     profiling = _prof.is_running() and \
         _prof._config["profile_imperative"]
     t0 = _time.perf_counter() if profiling else 0.0

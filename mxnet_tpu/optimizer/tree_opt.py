@@ -1,0 +1,456 @@
+"""Pure-functional tree-level optimizer layer for the fused train step.
+
+The per-parameter ``Optimizer.update`` path dispatches one eager XLA
+computation per parameter per step (optimizer.py ``_fused``) — ~160
+host round trips for a ResNet-50.  This module maps the SAME fused
+update kernels (ops/optimizer_ops.py) over a whole parameter pytree
+INSIDE one traced program, so ``Executor.init_fused_step`` can fold
+forward + backward + gradient reduction + optimizer update into a
+single donated ``jax.jit`` (SURVEY §L2: the dependency engine
+collapses into XLA async dispatch — now including the update).
+
+Contract with the legacy layer (optimizer.py):
+
+* state trees reuse ``Optimizer.create_state_multi_precision`` per
+  index, so the structure per parameter is EXACTLY the legacy
+  ``Updater.states[index]`` nesting — ``export_to_updater`` /
+  ``import_from_updater`` convert by rebinding array handles only (no
+  copies), which is what makes optimizer-state checkpoints round-trip
+  bit-exact across the fused/legacy boundary.
+* per-step scalars (lr after scheduler + multipliers + Adam's bias
+  correction, wd after multipliers, the shared update count t) are
+  resolved HOST-side by ``host_hyper`` with the same code the legacy
+  loop runs (``_bump``/``_get_lr``/``_get_wd``), then enter the jit
+  as traced scalars — bit-identical hyper-parameters, and no
+  recompiles when the scheduler moves lr.
+* row-sparse ``(ids, vals)`` gradient pairs from the executor's
+  sparse-Embedding path get the functional mirror of the eager lazy
+  row updates (ndarray/sparse.py ``*_row_update``): out-of-bounds
+  padding ids drop out of ``.at[]`` scatters exactly like the eager
+  path, so only touched rows see the update (and its weight decay).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from . import optimizer as _opt
+
+__all__ = ["supports_fused", "host_hyper", "hyper_sig",
+           "init_tree_state", "tree_update", "make_tree_update",
+           "to_device_tree", "tree_to_nd", "export_to_updater",
+           "import_from_updater"]
+
+# every hyper-param any builder bakes into the compiled program as a
+# Python constant (lr/wd/t are NOT here — they enter as traced
+# scalars).  The legacy Updater loop re-reads these from the optimizer
+# every step, so Module re-checks this signature per fused step and
+# rebuilds on mutation (e.g. rescale_grad reset after a batch-size
+# change) instead of silently applying the stale baked value.
+_HYPER_ATTRS = ("rescale_grad", "clip_gradient", "momentum",
+                "lazy_update", "multi_precision", "wd_lh", "gamma1",
+                "gamma2", "epsilon", "centered", "clip_weights",
+                "beta1", "beta2", "rho", "lamda1", "beta",
+                "schedule_decay", "float_stable_eps")
+
+
+def hyper_sig(optimizer):
+    """Snapshot of the build-time-baked hyper-params (see
+    ``_HYPER_ATTRS``); compare across steps to detect mid-run
+    mutation."""
+    return tuple(getattr(optimizer, a, None) for a in _HYPER_ATTRS)
+
+
+def _get_op(name):
+    from ..ops.registry import get_op
+    return get_op(name)
+
+
+def _is_arr(x):
+    return hasattr(x, "dtype") and hasattr(x, "shape")
+
+
+def _is_rsp(g):
+    """Executor sparse-Embedding grads arrive as (ids, vals) pairs."""
+    return isinstance(g, tuple) and len(g) == 2
+
+
+def _knobs(opt, op):
+    """Static rescale/clip knobs, honoring ftml's clip_grad spelling
+    (mirrors Optimizer._common_knobs + FTML.update)."""
+    kw = {"rescale_grad": opt.rescale_grad}
+    if opt.clip_gradient is not None:
+        key = "clip_grad" if "clip_grad" in op.param_names \
+            else "clip_gradient"
+        kw[key] = opt.clip_gradient
+    return kw
+
+
+def _densify_pair(g, shape):
+    """(ids, vals) -> dense grad; out-of-bounds padding ids drop."""
+    ids, vals = g
+    out = jnp.zeros(shape, vals.dtype)
+    return out.at[ids.astype(jnp.int32)].add(vals)
+
+
+def _rsp_prep(w, ids, vals, rescale, clip, wd):
+    """Functional mirror of ndarray/sparse.py _prep_row_grad: gather
+    touched rows, rescale/clip, add wd on those rows only.  wd is a
+    traced scalar here so it is applied unconditionally (identical
+    when wd == 0)."""
+    rows = ids.astype(jnp.int32)
+    g = vals * rescale
+    if clip is not None and clip > 0:
+        g = jnp.clip(g, -clip, clip)
+    g = g + wd * w[rows]
+    return rows, g
+
+
+# -- per-class update builders ----------------------------------------------
+# Each builder returns upd(w, g, state, lr, wd, t) -> (new_w, new_state)
+# preserving the exact legacy state nesting for that class.
+
+
+def _make_sgd(opt):
+    mom = opt.momentum
+    kn = _knobs(opt, _get_op("sgd_update"))
+    rescale = kn["rescale_grad"]
+    clip = kn.get("clip_gradient")
+
+    def upd(w, g, state, lr, wd, t):
+        # SGD's own mp check is structural (optimizer.py
+        # update_multi_precision): (mom_or_None, f32 master) pair
+        is_mp = (isinstance(state, tuple) and len(state) == 2
+                 and _is_arr(state[1])
+                 and state[1].dtype == jnp.float32
+                 and w.dtype != jnp.float32)
+        if _is_rsp(g):
+            if opt.lazy_update and not is_mp:
+                ids, vals = g
+                rows, gr = _rsp_prep(w, ids, vals, rescale, clip, wd)
+                if state is None:
+                    return w.at[rows].add((-lr * gr).astype(w.dtype)), None
+                m_rows = mom * state[rows] - lr * gr
+                new_m = state.at[rows].set(m_rows.astype(state.dtype))
+                return w.at[rows].add(m_rows.astype(w.dtype)), new_m
+            g = _densify_pair(g, w.shape)
+        if is_mp:
+            m, w32 = state
+            if m is not None:
+                nw, nm, nw32 = _get_op("mp_sgd_mom_update").fn(
+                    w, g, m, w32, lr=lr, momentum=mom, wd=wd, **kn)
+                return nw, (nm, nw32)
+            nw, nw32 = _get_op("mp_sgd_update").fn(
+                w, g, w32, lr=lr, wd=wd, **kn)
+            return nw, (None, nw32)
+        if state is not None:
+            nw, nm = _get_op("sgd_mom_update").fn(
+                w, g, state, lr=lr, momentum=mom, wd=wd, **kn)
+            return nw, nm
+        return _get_op("sgd_update").fn(w, g, lr=lr, wd=wd, **kn), None
+
+    return upd
+
+
+def _make_adagrad(opt):
+    eps = opt.float_stable_eps
+    op = _get_op("_sparse_adagrad_update")
+    kn = _knobs(opt, op)
+    rescale = kn["rescale_grad"]
+    clip = kn.get("clip_gradient")
+
+    def upd(w, g, state, lr, wd, t):
+        if _is_rsp(g):
+            # mirror of sparse.py adagrad_row_update (always lazy)
+            ids, vals = g
+            rows, gr = _rsp_prep(w, ids, vals, rescale, clip, wd)
+            h_rows = state[rows] + jnp.square(gr)
+            new_h = state.at[rows].set(h_rows.astype(state.dtype))
+            nw = w.at[rows].add(
+                (-lr * gr / (jnp.sqrt(h_rows) + eps)).astype(w.dtype))
+            return nw, new_h
+        nw, nh = op.fn(w, g, state, lr=lr, epsilon=eps, wd=wd, **kn)
+        return nw, nh
+
+    return upd
+
+
+def _make_simple(op_name, static_of, needs_t=False):
+    """Builder for optimizers that are one dense kernel call.  The
+    state nesting in == nesting out: None, a single array, or a tuple,
+    exactly as create_state built it."""
+
+    def make(opt):
+        op = _get_op(op_name)
+        hyper = dict(static_of(opt))
+        hyper.update(_knobs(opt, op))
+        takes_lr = "lr" in op.param_names
+
+        def upd(w, g, state, lr, wd, t):
+            if _is_rsp(g):
+                g = _densify_pair(g, w.shape)
+            states = state if isinstance(state, tuple) \
+                else (() if state is None else (state,))
+            kw = dict(hyper, wd=wd)
+            if takes_lr:
+                kw["lr"] = lr
+            if needs_t:
+                kw["t"] = t
+            out = op.fn(w, g, *states, **kw)
+            out = out if isinstance(out, tuple) else (out,)
+            if isinstance(state, tuple):
+                return out[0], tuple(out[1:])
+            if state is None:
+                return out[0], None
+            return out[0], out[1]
+
+        return upd
+
+    return make
+
+
+def _per_state(mom_make, plain_make):
+    """Legacy NAG/Signum pick the kernel per UPDATE from ``state is
+    not None``, not from the momentum hyper-param — mirror that, so a
+    momentum raised from 0 mid-run (hyper rebuild) keeps treating the
+    existing None states momentumless instead of crashing."""
+
+    def make(opt):
+        mom_upd, plain_upd = mom_make(opt), plain_make(opt)
+
+        def upd(w, g, state, lr, wd, t):
+            if state is None:
+                return plain_upd(w, g, None, lr, wd, t)
+            return mom_upd(w, g, state, lr, wd, t)
+
+        return upd
+
+    return make
+
+
+_make_nag = _per_state(
+    _make_simple("nag_mom_update", lambda o: {"momentum": o.momentum}),
+    _make_simple("sgd_update", lambda o: {}))
+
+
+_make_signum = _per_state(
+    _make_simple("signum_update",
+                 lambda o: {"momentum": o.momentum, "wd_lh": o.wd_lh}),
+    _make_simple("signsgd_update", lambda o: {}))
+
+
+def _make_rmsprop(opt):
+    extra = {"clip_weights": opt.clip_weights} if opt.clip_weights else {}
+    if opt.centered:
+        return _make_simple(
+            "rmspropalex_update",
+            lambda o: dict(gamma1=o.gamma1, gamma2=o.gamma2,
+                           epsilon=o.epsilon, **extra))(opt)
+    return _make_simple(
+        "rmsprop_update",
+        lambda o: dict(gamma1=o.gamma1, epsilon=o.epsilon, **extra))(opt)
+
+
+_BUILDERS = {
+    _opt.SGD: _make_sgd,
+    _opt.AdaGrad: _make_adagrad,
+    _opt.NAG: _make_nag,
+    _opt.Signum: _make_signum,
+    _opt.SignSGD: _make_signum,
+    _opt.RMSProp: _make_rmsprop,
+    _opt.Adam: _make_simple(
+        "adam_update",
+        lambda o: dict(beta1=o.beta1, beta2=o.beta2, epsilon=o.epsilon)),
+    _opt.AdaDelta: _make_simple(
+        "adadelta_update", lambda o: dict(rho=o.rho, epsilon=o.epsilon)),
+    _opt.Ftrl: _make_simple(
+        "ftrl_update", lambda o: dict(lamda1=o.lamda1, beta=o.beta)),
+    _opt.Adamax: _make_simple(
+        "adamax_update", lambda o: dict(beta1=o.beta1, beta2=o.beta2),
+        needs_t=True),
+    _opt.Nadam: _make_simple(
+        "nadam_update",
+        lambda o: dict(beta1=o.beta1, beta2=o.beta2, epsilon=o.epsilon,
+                       schedule_decay=o.schedule_decay), needs_t=True),
+    _opt.FTML: _make_simple(
+        "ftml_update",
+        lambda o: dict(beta1=o.beta1, beta2=o.beta2, epsilon=o.epsilon),
+        needs_t=True),
+}
+
+
+def supports_fused(optimizer):
+    """True when *optimizer* maps onto the tree kernels.  Exact class
+    match on purpose: a subclass overriding ``update`` (LBSGD's LARS
+    host readbacks, DCASGD, SGLD's rng) must keep the legacy loop."""
+    return type(optimizer) in _BUILDERS
+
+
+def _with_generic_mp(opt, upd):
+    """Mirror of Optimizer.update_multi_precision's generic fp32-master
+    fallback: update the master, cast down."""
+
+    def wrapped(w, g, state, lr, wd, t):
+        is_mp = (opt.multi_precision and isinstance(state, tuple)
+                 and len(state) == 2 and _is_arr(state[1])
+                 and state[1].dtype == jnp.float32
+                 and w.dtype != jnp.float32)
+        if not is_mp:
+            return upd(w, g, state, lr, wd, t)
+        inner, w32 = state
+        if _is_rsp(g):
+            g = (g[0], g[1].astype(jnp.float32))
+        else:
+            g = g.astype(jnp.float32)
+        nw32, ninner = upd(w32, g, inner, lr, wd, t)
+        return nw32.astype(w.dtype), (ninner, nw32)
+
+    return wrapped
+
+
+def make_tree_update(optimizer):
+    """Build the pure fn(grads, params, state, lrs, wds, t) ->
+    (new_params, new_state) mapping the optimizer's kernel over a
+    name-keyed param pytree with per-name lr/wd scalars."""
+    try:
+        upd = _BUILDERS[type(optimizer)](optimizer)
+    except KeyError:
+        raise ValueError(
+            "optimizer %r has no tree-level kernel mapping; the fused "
+            "train step supports %s"
+            % (type(optimizer).__name__,
+               sorted(c.__name__ for c in _BUILDERS)))
+    if type(optimizer) is not _opt.SGD:
+        upd = _with_generic_mp(optimizer, upd)
+
+    def tree_update_fn(grads, params, state, lrs, wds, ts):
+        new_p, new_s = {}, {}
+        for n in params:
+            new_p[n], new_s[n] = upd(params[n], grads[n], state[n],
+                                     lrs[n], wds[n], ts[n])
+        return new_p, new_s
+
+    return tree_update_fn
+
+
+def tree_update(optimizer, step, grads, params, state, lrs=None,
+                wds=None):
+    """One functional optimizer sweep over a param tree (the direct
+    API; the executor's fused step closes over make_tree_update
+    instead).  *step* is the update count t applied to every name;
+    *lrs*/*wds* default to the optimizer's current flat lr/wd —
+    including Adam's in-lr bias correction at t=step, matching the
+    legacy Updater and host_hyper."""
+    if lrs is None:
+        lr = optimizer.learning_rate
+        if type(optimizer) is _opt.Adam:
+            lr = lr * math.sqrt(1.0 - optimizer.beta2 ** step) / \
+                (1.0 - optimizer.beta1 ** step)
+        lrs = {n: lr for n in params}
+    if wds is None:
+        wds = {n: optimizer.wd for n in params}
+    return make_tree_update(optimizer)(grads, params, state, lrs, wds,
+                                       {n: step for n in params})
+
+
+def host_hyper(optimizer, names, idx_of):
+    """Advance the per-index update counts and resolve this step's
+    per-parameter (t, lr, wd) exactly like one legacy update sweep —
+    each index keeps its OWN count (they diverge e.g. when an optimizer
+    is shared across modules), and Adam's in-lr bias correction uses
+    that per-index count with the same host-side math.  Returns
+    (ts, lrs, wds), name-keyed dicts of Python scalars (they enter the
+    jit as traced weak-typed scalars, so no recompiles as they move).
+    One caveat vs the legacy loop: a scheduler-driven lr is resolved
+    AFTER all counts advanced, while the legacy loop ratchets
+    num_update mid-sweep — identical whenever the counts are uniform,
+    which every pure fused/legacy training run keeps them."""
+    ts, lrs, wds = {}, {}, {}
+    for n in names:
+        ts[n] = optimizer._bump(idx_of[n])
+    adam = type(optimizer) is _opt.Adam
+    for n in names:
+        i = idx_of[n]
+        lr = optimizer._get_lr(i)
+        if adam:
+            t = ts[n]
+            lr = lr * math.sqrt(1.0 - optimizer.beta2 ** t) / \
+                (1.0 - optimizer.beta1 ** t)
+        lrs[n] = lr
+        wds[n] = optimizer._get_wd(i)
+    return ts, lrs, wds
+
+
+# -- state trees and legacy Updater interop ---------------------------------
+
+
+def to_device_tree(s, put=None):
+    """Legacy state nesting (NDArray/tuple/None) -> jax-array nesting,
+    rebinding handles (optionally placing via *put*)."""
+    from ..ndarray import NDArray
+    if isinstance(s, NDArray):
+        return put(s._data) if put is not None else s._data
+    if isinstance(s, (tuple, list)):
+        return tuple(to_device_tree(x, put) for x in s)
+    if _is_arr(s):
+        return put(s) if put is not None else s
+    return s
+
+
+def tree_to_nd(s):
+    """jax-array nesting -> the legacy NDArray nesting Updater stores."""
+    from ..ndarray import NDArray
+    if _is_arr(s):
+        return NDArray(s)
+    if isinstance(s, (tuple, list)):
+        return tuple(tree_to_nd(x) for x in s)
+    return s
+
+
+def init_tree_state(optimizer, params, idx_of=None, put=None):
+    """Fresh per-name state trees via the legacy
+    ``create_state_multi_precision`` (identical nesting and zeros)."""
+    state = {}
+    for n, w in params.items():
+        i = idx_of[n] if idx_of is not None else n
+        state[n] = to_device_tree(
+            optimizer.create_state_multi_precision(i, w), put)
+    return state
+
+
+def import_from_updater(updater, optimizer, params, idx_of, put=None):
+    """Updater.states (legacy per-index format) -> name-keyed tree,
+    creating fresh state for indices the updater has not seen — the
+    lazy-create contract of Updater.__call__."""
+    state = {}
+    for n, w in params.items():
+        i = idx_of[n]
+        if i in updater.states:
+            state[n] = to_device_tree(updater.states[i], put)
+        else:
+            state[n] = to_device_tree(
+                optimizer.create_state_multi_precision(i, w), put)
+    return state
+
+
+def export_to_updater(tree_state, updater, idx_of, copy=False):
+    """Name-keyed tree -> Updater.states in the exact legacy per-index
+    format, so ``Updater.get_states()`` (and save_optimizer_states)
+    serializes the fused state.  With *copy* (donating backends) the
+    arrays are copied: a handle-rebound alias of the live tree would be
+    deleted by the next fused step's donation — the mirror of the copy
+    ``import_from_updater`` callers make on the way in."""
+
+    def conv(s):
+        if _is_arr(s):
+            return jnp.array(s) if copy else s
+        if isinstance(s, (tuple, list)):
+            return tuple(conv(x) for x in s)
+        return s
+
+    for n, s in tree_state.items():
+        i = idx_of[n]
+        updater.states[i] = tree_to_nd(conv(s))
+        updater.states_synced[i] = True

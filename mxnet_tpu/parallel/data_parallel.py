@@ -448,6 +448,11 @@ class ParallelTrainer:
                                  "a jax.checkpoint policy")
 
         def train_step(params, opt_state, aux, x, y, key, lr, t):
+            # trace-time only — the compile counter for the sharded step
+            # (cached executions bump nothing; see profiler.py counters)
+            from .. import profiler as _prof
+            _prof.bump_counter("parallel_step_compiles")
+
             def loss_of(p):
                 amap = dict(p)
                 amap["data0"] = x
@@ -597,6 +602,8 @@ class ParallelTrainer:
         self._key, sub = jax.random.split(self._key)
         lr = jnp.asarray(self._current_lr(), jnp.float32)
         t = jnp.asarray(self._num_update + 1, jnp.int32)
+        from .. import profiler as _prof
+        _prof.bump_counter("parallel_step_dispatches")
         self._params, self._opt_state, self._aux, loss = self._step_fn(
             self._params, self._opt_state, self._aux, xd, yd, sub, lr, t)
         self._num_update += 1

@@ -25,11 +25,56 @@ import time
 __all__ = ["set_config", "set_state", "pause", "resume", "dump", "dumps",
            "profiler_set_config", "profiler_set_state",
            "Domain", "Task", "Frame", "Event", "Counter", "Marker",
-           "scope"]
+           "scope", "bump_counter", "counter_value", "counters",
+           "reset_counters"]
 
 _lock = threading.RLock()
 _events = []            # chrome trace event dicts
 _agg = {}               # name -> [count, total_us, min_us, max_us]
+
+# -- dispatch / compile counters --------------------------------------------
+# Always-on (unlike spans, which need set_state('run')): these are the
+# observable for the fused-train-step contract — "after warmup, one
+# training step is exactly ONE jitted dispatch and ZERO compiles" —
+# and tests must be able to assert it without turning tracing on.
+# Sites:  eager_dispatches       ops/registry.invoke (per eager op)
+#         executor_dispatches    LOGICAL executor-level calls
+#                                (forward/train_step); a group2ctx
+#                                segment-chained step counts ONCE even
+#                                though it issues one program per
+#                                segment — the counter's contract is
+#                                the fused-step assertion, which never
+#                                applies to grouped executors
+#         fused_step_dispatches  Module full-fused step invocations
+#         fused_step_compiles    fused-step trace-time (bumped inside the
+#                                traced body, so cached executions add 0)
+#         tree_apply_dispatches  Module partial-fused (multi-device)
+#                                tree-update invocations
+#         tree_apply_compiles    tree-update trace-time
+#         parallel_step_dispatches / parallel_step_compiles
+#                                ParallelTrainer fit_batch step
+_counts = {}
+
+
+def bump_counter(name, n=1):
+    """Increment a named dispatch/compile counter.  Deliberately
+    lock-free: this sits on the per-op eager dispatch hot path, and a
+    rare lost increment under free-threading beats taking the profiler
+    RLock on every dispatch (readers tolerate racy snapshots)."""
+    _counts[name] = _counts.get(name, 0) + n
+
+
+def counter_value(name):
+    return _counts.get(name, 0)
+
+
+def counters():
+    """Snapshot of all dispatch/compile counters."""
+    return dict(_counts)
+
+
+def reset_counters():
+    _counts.clear()
 _config = {
     "filename": "profile.json",
     "profile_all": False,

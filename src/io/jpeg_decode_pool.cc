@@ -307,7 +307,11 @@ MXTPU_API int MXIOPoolDecodeBatch(void* pool, const uint8_t* const* bufs,
                                   const DecodeCfg* cfg,
                                   const uint64_t* seeds, uint8_t* out,
                                   int32_t* rcs) {
-  if (!pool || n <= 0 || cfg->out_h <= 0 || cfg->out_w <= 0) return -1;
+  // every pointer is caller-provided over the C ABI: reject nulls
+  // instead of crashing the process (cfg was dereferenced unchecked)
+  if (!pool || !bufs || !lens || !cfg || !seeds || !out || !rcs)
+    return -1;
+  if (n <= 0 || cfg->out_h <= 0 || cfg->out_w <= 0) return -1;
   static_cast<Pool*>(pool)->RunBatch(bufs, lens, n, cfg, seeds, out,
                                      rcs);
   return 0;

@@ -335,3 +335,45 @@ def test_cpp_full_stack_training_example(tmp_path):
     _compile_and_run_example("train_full_stack.cpp", "train_full_stack",
                              "full-stack C ABI training OK",
                              argv=(str(tmp_path),))
+
+
+def test_str_param_bool_coercion_only_for_declared_bools():
+    """Satellite regression: dmlc-style "true"/"false" coercion is
+    limited to params DECLARED boolean in the op signature — a
+    string-typed param whose value happens to be "true" must reach the
+    kernel as the string, not as Python True."""
+    from mxnet_tpu import capi_bridge as cb
+    from mxnet_tpu.ops.registry import get_op
+
+    # declared bool (transpose_a=False): coerced, any case
+    bools = cb._declared_bools(get_op("dot").fn)
+    assert "transpose_a" in bools
+    assert cb._coerce_str_params({"transpose_a": "True"}, bools) \
+        == {"transpose_a": True}
+    assert cb._coerce_str_params({"transpose_a": "false"}, bools) \
+        == {"transpose_a": False}
+    # string-typed param (act_type): "true" stays a string, in ANY
+    # case — "True" must not sneak through as a python literal
+    act_bools = cb._declared_bools(get_op("Activation").fn)
+    assert cb._coerce_str_params({"act_type": "true"}, act_bools) \
+        == {"act_type": "true"}
+    assert cb._coerce_str_params({"act_type": "True"}, act_bools) \
+        == {"act_type": "True"}
+    # no signature to consult -> legacy coercion for every param
+    assert cb._coerce_str_params({"x": "true"}) == {"x": True}
+    # **kwargs signature (e.g. Custom routes params through
+    # VAR_KEYWORD): cannot enumerate bools -> None, NOT an empty set
+    # that would silently disable coercion for the whole op
+    def kw_fn(*inputs, op_type=None, **kwargs):
+        pass
+    assert cb._declared_bools(kw_fn) is None
+    assert cb._coerce_str_params({"my_flag": "false"},
+                                 cb._declared_bools(kw_fn)) \
+        == {"my_flag": False}
+    # end to end through MXImperativeInvoke's python bridge
+    import numpy as np
+    import mxnet_tpu as mx
+    a = mx.nd.array(np.ones((2, 3), np.float32))
+    b = mx.nd.array(np.ones((2, 3), np.float32))
+    out = cb.nd_invoke("dot", [a, b], {"transpose_a": "true"})
+    assert out[0].shape == (3, 3)
