@@ -8,6 +8,13 @@ cd "$(dirname "$0")/.."
 echo "== sanity: byte-compile =="
 python -m compileall -q mxnet_tpu tools examples
 
+echo "== sanity: graftlint static analysis =="
+# Pure-stdlib AST pass (no jax import, no accelerator needed, <10s):
+# tracer leaks, donation misuse, recompile hazards, registry contract.
+# Exits nonzero on any finding not in tools/graftlint/baseline.json;
+# the last stdout line is the scrapeable summary ("graftlint: ...").
+python -m tools.graftlint mxnet_tpu
+
 echo "== native: C predict ABI + RecordIO reader =="
 if command -v g++ >/dev/null; then
     make -C src/capi

@@ -17,6 +17,7 @@ conventions, including grad_req write/add/null and auxiliary-state updates
 from __future__ import annotations
 
 import functools
+import logging
 
 import numpy as _np
 import jax
@@ -354,7 +355,8 @@ class Executor:
                        wds, ts, step):
             # the Python body only runs at trace time — this IS the
             # compile counter (cached executions bump nothing)
-            _prof.bump_counter("fused_step_compiles")
+            _prof.bump_counter(  # graftlint: disable=JG003
+                "fused_step_compiles")  # trace-time-only on purpose
             key = jax.random.fold_in(base_key, step)
             arg_map = dict(rest)
             arg_map.update(params)
@@ -680,7 +682,12 @@ def _materialize(cots, ex, arg_map, aux_map):
     try:
         shapes = jax.eval_shape(ex._eval_infer, arg_map, aux_map,
                                 ex._key)[0]
-    except Exception:
+    except Exception as e:
+        # fall back to a real forward for the shapes, but keep the
+        # eval_shape failure diagnosable instead of eating it
+        logging.getLogger(__name__).debug(
+            "eval_shape failed in _materialize (%s: %s); falling back "
+            "to an executed forward pass", type(e).__name__, e)
         outs, _ = ex._jit_infer(arg_map, aux_map, ex._key)
         shapes = outs
     dev = ex._ctx.jax_device

@@ -501,8 +501,19 @@ class Module(BaseModule):
         self._exec_group.forward(data_batch, is_train)
 
     def forward_backward(self, data_batch):
-        """Fused per-device forward+backward (single XLA program each)."""
+        """Fused per-device forward+backward (single XLA program each).
+
+        A subclass overriding ``forward`` or ``backward`` (gradient
+        hooks, custom heads) gets the composed two-stage path instead,
+        so its override actually runs — the reference's
+        base_module.py:194 semantics."""
         assert self.binded and self.params_initialized
+        cls = type(self)
+        if cls.forward is not Module.forward or \
+                cls.backward is not Module.backward:
+            self.forward(data_batch, is_train=True)
+            self.backward()
+            return
         self._exec_group.forward_backward(data_batch)
 
     def backward(self, out_grads=None):
@@ -624,10 +635,14 @@ class Module(BaseModule):
             return False
         cls = type(self)
         if cls.forward_backward is not Module.forward_backward \
-                or cls.update is not Module.update:
-            # a subclass customizing either stage (e.g. SVRGModule's
-            # variance-reduced gradient rewrite) composes them — the
-            # fused program would silently skip the override
+                or cls.update is not Module.update \
+                or cls.forward is not Module.forward \
+                or cls.backward is not Module.backward:
+            # a subclass customizing any stage (e.g. SVRGModule's
+            # variance-reduced gradient rewrite, or a backward override
+            # that clips grads) composes them — the fused program runs
+            # the whole step in one XLA call and would silently skip
+            # the override
             return False
         if self._updater is None:
             return False       # update_on_kvstore: state lives store-side
@@ -671,7 +686,8 @@ class Module(BaseModule):
 
             def tree_apply(grads, params, state, lrs, wds, ts):
                 # trace-time only: the compile counter for this program
-                _prof.bump_counter("tree_apply_compiles")
+                _prof.bump_counter(  # graftlint: disable=JG003
+                    "tree_apply_compiles")  # trace-time-only on purpose
                 return tree_update(grads, params, state, lrs, wds, ts)
 
             from ..ops.registry import supports_donation

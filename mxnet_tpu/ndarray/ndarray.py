@@ -32,7 +32,13 @@ __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
 def _ctx_of(jarr):
     try:
         dev = list(jarr.devices())[0]
-    except Exception:
+    except (AttributeError, TypeError, IndexError, RuntimeError,
+            ValueError):
+        # tracers raise ConcretizationTypeError (a TypeError) on
+        # .devices(); abstract values lack the attribute; deleted
+        # (donated) buffers raise RuntimeError.  Anything else — e.g.
+        # a real jax dispatch failure — must propagate, not default to
+        # current_context()
         return current_context()
     if dev.platform == "cpu":
         return Context("cpu", dev.id)

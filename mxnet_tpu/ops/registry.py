@@ -25,7 +25,8 @@ import time as _time
 import jax
 import numpy as _np
 
-__all__ = ["Op", "register_op", "get_op", "list_ops", "invoke", "alias"]
+__all__ = ["Op", "register_op", "get_op", "list_ops", "invoke", "alias",
+           "iter_registrations", "op_contract"]
 
 _OPS: dict[str, "Op"] = {}
 
@@ -159,6 +160,77 @@ def get_op(name):
 
 def list_ops():
     return sorted(_OPS)
+
+
+def iter_registrations():
+    """Yield ``(canonical_name, Op)`` once per registered op (aliases
+    collapsed).  The runtime mirror of the static registration table
+    tools/graftlint builds from the ``@register_op`` decorators — the
+    registry cross-check test walks this to hold every op to the JG005
+    contract."""
+    seen = set()
+    for name in sorted(_OPS):
+        op = _OPS[name]
+        if id(op) in seen:
+            continue
+        seen.add(id(op))
+        yield op.name, op
+
+
+_RNG_PARAM_NAMES = ("rng", "key", "rng_key", "prng_key", "prng")
+
+
+def op_contract(op):
+    """Statically-checkable contract facts for *op*, derived from its
+    kernel signature (the JG005 invariants, computed at runtime so the
+    cross-check test can't drift from the analyzer):
+
+    - ``positional_params``: positional parameter names of ``op.fn``
+    - ``array_arity``: count of array inputs (no-default positionals,
+      rng excluded), or None when the kernel takes ``*args``
+    - ``rng_param_ok``: needs_rng ops name their first positional
+      parameter like a PRNG key (the runtime passes it positionally)
+    - ``donate_valid``: every donate index addresses a real array input
+    - ``input_names_consistent``: every declared input name is an
+      actual positional parameter of the kernel, and the required
+      (no-default) array params form a prefix of input_names — extra
+      declared names must be optional array inputs like Convolution's
+      ``bias=None``
+    """
+    import inspect
+    try:
+        sig = inspect.signature(op.fn)
+    except (TypeError, ValueError):
+        return {"positional_params": (), "array_arity": None,
+                "rng_param_ok": True, "donate_valid": True,
+                "input_names_consistent": True}
+    required, all_pos, has_var = [], [], False
+    for p in sig.parameters.values():
+        if p.kind in (inspect.Parameter.POSITIONAL_ONLY,
+                      inspect.Parameter.POSITIONAL_OR_KEYWORD):
+            all_pos.append(p.name)
+            if p.default is inspect.Parameter.empty:
+                required.append(p.name)
+        elif p.kind == inspect.Parameter.VAR_POSITIONAL:
+            has_var = True
+    rng_ok = True
+    arr = list(required)
+    if op.needs_rng:
+        rng_ok = bool(arr) and arr[0] in _RNG_PARAM_NAMES
+        arr = arr[1:]
+    arity = None if has_var else len(arr)
+    donate_valid = True
+    if op.donate and arity is not None:
+        # donation may also target declared optional array inputs
+        n_donatable = max(arity, len(op.input_names))
+        donate_valid = all(0 <= i < n_donatable for i in op.donate)
+    names_ok = True
+    if not has_var and op.input_names:
+        names_ok = (all(n in all_pos for n in op.input_names)
+                    and list(op.input_names[:len(arr)]) == arr)
+    return {"positional_params": tuple(required), "array_arity": arity,
+            "rng_param_ok": rng_ok, "donate_valid": donate_valid,
+            "input_names_consistent": names_ok}
 
 
 def _freeze(v):

@@ -91,7 +91,10 @@ def sequence_parallel_attention(q, k, v, mesh, axis="sp", causal=False,
     if not isinstance(q, jax.core.Tracer) and hasattr(q, "devices"):
         try:
             devs = list(q.devices())
-        except Exception:  # abstract/uncommitted values have no devices
+        except (AttributeError, TypeError, RuntimeError, ValueError):
+            # abstract/uncommitted values have no devices; anything
+            # else must propagate rather than silently lose the
+            # caller's placement
             devs = []
         if len(devs) == 1:
             orig_dev = devs[0]

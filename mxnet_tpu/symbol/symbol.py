@@ -885,7 +885,14 @@ def _eval_shape_op(node, in_shapes):
 
     try:
         out = jax.eval_shape(call, *specs)
-    except Exception:
+    except Exception as e:
+        # unknown shape, not an error (partial inference fills it in
+        # later) — but log why, so op bugs don't hide behind "None"
+        import logging
+        logging.getLogger(__name__).debug(
+            "eval_shape failed for op '%s' with input shapes %s "
+            "(%s: %s)", node.op.name, list(in_shapes),
+            type(e).__name__, e)
         return [None] * node.num_outputs()
     if not isinstance(out, (tuple, list)):
         out = (out,)

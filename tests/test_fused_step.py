@@ -294,6 +294,37 @@ def test_fused_disabled_by_env_falls_back():
     assert mod._updater.states          # per-index state store in use
 
 
+def test_subclass_forward_backward_overrides_fall_back():
+    """A Module subclass overriding forward() or backward() (e.g. a
+    grad-clipping hook) must take the legacy path: the fused program
+    runs the whole step in one XLA call and would silently skip the
+    override."""
+    calls = {"backward": 0}
+
+    class ClipModule(mx.Module):
+        def backward(self, out_grads=None):
+            calls["backward"] += 1
+            super().backward(out_grads)
+
+    rng = np.random.RandomState(11)
+    init = _mlp_init(rng)
+    batches = _toy_batches(rng)
+    os.environ["MXNET_MODULE_FUSED_STEP"] = "1"
+    try:
+        mod = ClipModule(_mlp(), context=mx.cpu())
+        mod.bind([("data", (16, 8))], [("softmax_label", (16,))])
+        mod.init_params(arg_params={k: v.copy() for k, v in init.items()})
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1})
+        assert not mod._fused_ok()
+        for i in range(3):
+            mod.forward_backward_update(batches[i % len(batches)])
+    finally:
+        os.environ.pop("MXNET_MODULE_FUSED_STEP", None)
+    assert mod._fused is None
+    assert calls["backward"] == 3   # the hook ran every step
+
+
 def test_fused_unsupported_optimizer_falls_back():
     """A subclass overriding update (host readbacks, rng) must keep the
     legacy loop — exact-class matching in tree_opt.supports_fused."""

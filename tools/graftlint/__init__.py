@@ -1,0 +1,41 @@
+"""graftlint — AST-level JAX-hazard analyzer for the mxnet_tpu tree.
+
+The reference framework caught operator misuse at compile time through
+nnvm attribute checks and the dmlc type registries; the JAX rebuild has
+no compiler front-end of its own, so the hazard classes the fused train
+step introduced (donated-buffer reuse, host round-trips under trace,
+silent per-step recompiles) are only visible at runtime — if at all.
+graftlint restores an ahead-of-time whole-program check (Relay's
+argument, applied as a linter): a pure-stdlib ``ast`` pass, a call
+graph seeded from ``register_op`` registrations and ``jax.jit`` sites,
+and a rule engine with per-line suppressions and a committed baseline.
+
+Usage::
+
+    python -m tools.graftlint mxnet_tpu            # lint, exit 1 on new findings
+    python -m tools.graftlint mxnet_tpu --format json
+    python -m tools.graftlint mxnet_tpu --update-baseline
+
+The analyzer never imports the code it checks (no jax, no mxnet_tpu
+import) — it is safe on a machine with no accelerator stack and fast
+enough for the tier-1 sanity stage.
+
+Rules
+-----
+JG001  host materialization of possibly-traced values
+JG002  use of a donated buffer after the donating call
+JG003  side effects under trace (fire once at trace time, then vanish)
+JG004  recompile hazards (time/random under trace, jit-in-loop, ...)
+JG005  register_op contract violations (donate/num_outputs/needs_rng)
+JG006  silent overbroad exception handler in a dispatch path
+JG007  mutable default argument in public API
+JG008  jnp/jax backend-forcing call at module import time
+
+Suppress a single line with ``# graftlint: disable=JG003`` (comma-
+separate multiple IDs, or ``disable=all``).
+"""
+
+from .engine import LintEngine, Finding, Baseline  # noqa: F401
+from .rules import ALL_RULES, RULE_DOCS  # noqa: F401
+
+__version__ = "1.0"

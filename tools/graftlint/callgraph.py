@@ -1,0 +1,534 @@
+"""Project index + jit-reachability call graph for graftlint.
+
+Builds, without importing anything it analyzes:
+
+* a per-module index of imports, top-level functions, classes/methods
+  and nested functions;
+* a set of *trace entry points*: functions decorated with
+  ``@register_op(...)`` (their array inputs are traced under the eager
+  executable cache and the graph executor) and functions passed to
+  ``jax.jit`` (as argument or decorator, directly or via
+  ``functools.partial``);
+* a fixpoint reachability + taint propagation over resolvable call
+  edges: a function called (or referenced — ``lax.scan``/``lax.cond``
+  style combinators take function *values*) from jit-reachable code is
+  jit-reachable, and parameters fed from tainted (possibly-traced)
+  names become tainted themselves.
+
+Resolution is deliberately lexical and conservative: bare names via
+enclosing scopes -> module top level -> in-project ``from`` imports;
+``mod.f`` via import aliases of in-project modules; ``self.f`` /
+``cls.f`` via the enclosing class.  Unresolvable calls are skipped —
+the baseline absorbs what heuristics miss.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+
+def dotted_name(expr):
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if not isinstance(expr, ast.Name):
+        return None
+    parts.append(expr.id)
+    return ".".join(reversed(parts))
+
+
+def literal_int_tuple(node):
+    """Statically-known tuple of ints from a Tuple/List/Constant node,
+    else None (indeterminate)."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int) \
+                    and not isinstance(e.value, bool):
+                vals.append(e.value)
+            else:
+                return None
+        return tuple(vals)
+    return None
+
+
+class FunctionInfo:
+    """One def (top-level, method, or nested)."""
+
+    __slots__ = ("module", "node", "name", "qualname", "parent",
+                 "class_name", "pos_params", "no_default_params",
+                 "has_varargs", "children", "registered", "tainted",
+                 "reachable", "reason", "_bound_names")
+
+    def __init__(self, module, node, qualname, parent, class_name):
+        self.module = module
+        self.node = node
+        self.name = node.name
+        self.qualname = qualname
+        self.parent = parent          # enclosing FunctionInfo or None
+        self.class_name = class_name  # immediate class, or None
+        a = node.args
+        self.pos_params = [p.arg for p in a.posonlyargs + a.args]
+        ndef = len(a.defaults)
+        self.no_default_params = self.pos_params[:len(self.pos_params) - ndef]
+        self.has_varargs = a.vararg is not None
+        self.children = {}            # nested def name -> FunctionInfo
+        self.registered = None        # register_op metadata dict
+        self.tainted = set()          # names possibly holding tracers
+        self.reachable = False
+        self.reason = None
+        self._bound_names = None
+
+    def bound_names(self):
+        """Names bound inside this function (params, assignments, for
+        targets, nested defs, imports) — used to stop closure taint at
+        shadowing bindings."""
+        if self._bound_names is None:
+            bound = set(self.pos_params)
+            a = self.node.args
+            bound.update(p.arg for p in a.kwonlyargs)
+            if a.vararg:
+                bound.add(a.vararg.arg)
+            if a.kwarg:
+                bound.add(a.kwarg.arg)
+            for n in body_walk(self.node):
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+                    bound.add(n.id)
+                elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.ClassDef)):
+                    bound.add(n.name)
+                elif isinstance(n, (ast.Import, ast.ImportFrom)):
+                    for al in n.names:
+                        bound.add((al.asname or al.name).split(".")[0])
+            self._bound_names = bound
+        return self._bound_names
+
+    def __repr__(self):
+        return "FunctionInfo(%s:%s)" % (self.module.relpath, self.qualname)
+
+
+class ModuleInfo:
+    __slots__ = ("path", "relpath", "modname", "tree", "lines",
+                 "imports", "toplevel", "classes", "functions", "is_pkg")
+
+    def __init__(self, path, relpath, modname, tree, lines, is_pkg=False):
+        self.path = path
+        self.relpath = relpath
+        self.modname = modname      # dotted, e.g. "mxnet_tpu.ops.nn"
+        self.tree = tree
+        self.lines = lines
+        self.is_pkg = is_pkg
+        self.imports = {}           # local alias -> dotted target
+        self.toplevel = {}          # name -> FunctionInfo
+        self.classes = {}           # class name -> {method -> FunctionInfo}
+        self.functions = []         # every FunctionInfo, any nesting
+
+
+def body_walk(func_node):
+    """Walk a function body WITHOUT descending into nested defs (they
+    are separate FunctionInfos) — lambda bodies stay in, since they run
+    in the enclosing trace context."""
+    stack = list(func_node.body)
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # decorators/defaults evaluate in this scope; body does not
+            stack.extend(n.decorator_list)
+            stack.extend(d for d in n.args.defaults if d is not None)
+            stack.extend(d for d in n.args.kw_defaults if d is not None)
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def module_level_walk(tree):
+    """Walk statements that execute at import time: module body and
+    class bodies, including function decorators and default-argument
+    expressions — but not function/lambda bodies."""
+    stack = list(tree.body)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            stack.extend(n.decorator_list)
+            stack.extend(d for d in n.args.defaults if d is not None)
+            stack.extend(d for d in n.args.kw_defaults if d is not None)
+            continue
+        if isinstance(n, ast.Lambda):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+class _IndexVisitor(ast.NodeVisitor):
+    """Collects imports, functions (any nesting) and classes."""
+
+    def __init__(self, module):
+        self.m = module
+        self.func_stack = []   # FunctionInfo stack
+        self.class_stack = []  # class name stack
+
+    # -- imports ----------------------------------------------------------
+    def visit_Import(self, node):
+        for al in node.names:
+            if al.asname:
+                self.m.imports[al.asname] = al.name
+            else:
+                # "import a.b" binds "a"
+                top = al.name.split(".")[0]
+                self.m.imports[top] = top
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node):
+        base = node.module or ""
+        if node.level:  # relative: resolve against this module's package
+            # a package __init__ IS its package: level 1 strips nothing
+            strip = node.level - 1 if self.m.is_pkg else node.level
+            parts = self.m.modname.split(".")
+            pkg_parts = parts[:len(parts) - strip] if strip else parts
+            base = ".".join(pkg_parts + ([base] if base else []))
+        for al in node.names:
+            if al.name == "*":
+                continue
+            target = "%s.%s" % (base, al.name) if base else al.name
+            self.m.imports[al.asname or al.name] = target
+        self.generic_visit(node)
+
+    # -- defs -------------------------------------------------------------
+    def _enter_func(self, node):
+        parent = self.func_stack[-1] if self.func_stack else None
+        cls = self.class_stack[-1] if self.class_stack else None
+        if parent is not None:
+            qual = parent.qualname + ".<locals>." + node.name
+        elif cls is not None:
+            qual = cls + "." + node.name
+        else:
+            qual = node.name
+        fi = FunctionInfo(self.m, node, qual, parent, cls)
+        self.m.functions.append(fi)
+        if parent is not None:
+            parent.children[node.name] = fi
+        elif cls is not None:
+            self.m.classes.setdefault(cls, {})[node.name] = fi
+        else:
+            self.m.toplevel[node.name] = fi
+        return fi
+
+    def visit_FunctionDef(self, node):
+        fi = self._enter_func(node)
+        self.func_stack.append(fi)
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        # only track classes outside functions (methods of local classes
+        # are rarely trace entry points)
+        if self.func_stack:
+            self.generic_visit(node)
+            return
+        self.class_stack.append(node.name)
+        self.m.classes.setdefault(node.name, {})
+        self.generic_visit(node)
+        self.class_stack.pop()
+
+
+class ProjectIndex:
+    """All modules under the scanned roots + the jit-reachability graph."""
+
+    #: jax.jit spellings: "<alias>.jit" where alias resolves to jax, or a
+    #: bare name imported from jax.
+    def __init__(self):
+        self.modules = []           # ModuleInfo list
+        self.by_modname = {}        # dotted modname -> ModuleInfo
+
+    # -- construction -----------------------------------------------------
+    @classmethod
+    def build(cls, files, root_base):
+        """files: iterable of absolute paths; root_base: directory the
+        DISPLAY relpaths are computed against (the scan roots' parent,
+        usually the repo root).  Dotted module names are computed
+        independently, by ascending from each file past ``__init__.py``
+        package dirs — so they stay import-accurate (and cross-module
+        ``from mxnet_tpu.x import f`` edges resolve) no matter what
+        directory the scan was rooted at."""
+        idx = cls()
+        for path in files:
+            try:
+                src = open(path, encoding="utf-8").read()
+                tree = ast.parse(src, filename=path)
+            except (SyntaxError, UnicodeDecodeError, OSError):
+                continue
+            rel = os.path.relpath(path, root_base)
+            pkg_base = os.path.dirname(path)
+            while os.path.exists(os.path.join(pkg_base, "__init__.py")):
+                parent = os.path.dirname(pkg_base)
+                if parent == pkg_base:
+                    break
+                pkg_base = parent
+            modname = os.path.relpath(path, pkg_base)[:-3] \
+                .replace(os.sep, ".")
+            is_pkg = modname.endswith(".__init__") or modname == "__init__"
+            if modname.endswith(".__init__"):
+                modname = modname[:-len(".__init__")]
+            m = ModuleInfo(path, rel.replace(os.sep, "/"), modname, tree,
+                           src.splitlines(), is_pkg=is_pkg)
+            _IndexVisitor(m).visit(tree)
+            idx.modules.append(m)
+            idx.by_modname[modname] = m
+        idx._seed()
+        idx._propagate()
+        return idx
+
+    # -- name resolution --------------------------------------------------
+    def _project_module(self, dotted):
+        """ModuleInfo for a dotted import target if it is in-project."""
+        if dotted in self.by_modname:
+            return self.by_modname[dotted]
+        return None
+
+    def resolve_name(self, module, scope, name):
+        """Resolve a bare name to a FunctionInfo: enclosing nested defs,
+        module top level, then from-imports of project modules."""
+        fi = scope
+        while fi is not None:
+            if name in fi.children:
+                return fi.children[name]
+            fi = fi.parent
+        if name in module.toplevel:
+            return module.toplevel[name]
+        target = module.imports.get(name)
+        if target and "." in target:
+            mod, _, attr = target.rpartition(".")
+            pm = self._project_module(mod)
+            if pm is not None and attr in pm.toplevel:
+                return pm.toplevel[attr]
+        return None
+
+    def resolve_callee(self, module, scope, func_expr):
+        """FunctionInfo for a call/reference target expression, or None."""
+        if isinstance(func_expr, ast.Name):
+            return self.resolve_name(module, scope, func_expr.id)
+        if isinstance(func_expr, ast.Attribute):
+            base = func_expr.value
+            if isinstance(base, ast.Name):
+                if base.id in ("self", "cls") and scope is not None \
+                        and scope.class_name:
+                    methods = module.classes.get(scope.class_name, {})
+                    return methods.get(func_expr.attr)
+                target = module.imports.get(base.id)
+                if target:
+                    pm = self._project_module(target)
+                    if pm is not None:
+                        return pm.toplevel.get(func_expr.attr)
+        return None
+
+    def is_jax_jit(self, module, expr):
+        """True if *expr* denotes jax.jit under this module's imports."""
+        d = dotted_name(expr)
+        if d is None:
+            return False
+        if "." in d:
+            head, _, tail = d.partition(".")
+            return module.imports.get(head) == "jax" and tail == "jit"
+        return module.imports.get(d) == "jax.jit"
+
+    # -- seeding ----------------------------------------------------------
+    def _register_op_meta(self, module, fi):
+        """Metadata dict if fi is decorated @register_op(...), else None."""
+        for dec in fi.node.decorator_list:
+            if not isinstance(dec, ast.Call):
+                continue
+            d = dotted_name(dec.func)
+            if d is None:
+                continue
+            last = d.rsplit(".", 1)[-1]
+            if last != "register_op":
+                continue
+            meta = {"decorator": dec, "op_name": None, "needs_rng": False,
+                    "donate": None, "num_outputs": 1, "input_names": None}
+            if dec.args and isinstance(dec.args[0], ast.Constant):
+                meta["op_name"] = dec.args[0].value
+            for kw in dec.keywords:
+                if kw.arg == "needs_rng" and isinstance(kw.value,
+                                                        ast.Constant):
+                    meta["needs_rng"] = bool(kw.value.value)
+                elif kw.arg == "donate":
+                    meta["donate"] = literal_int_tuple(kw.value)
+                    meta["donate_node"] = kw.value
+                elif kw.arg == "num_outputs":
+                    if isinstance(kw.value, ast.Constant) and \
+                            isinstance(kw.value.value, int):
+                        meta["num_outputs"] = kw.value.value
+                    else:
+                        meta["num_outputs"] = None  # callable/indeterminate
+                elif kw.arg == "input_names":
+                    meta["input_names"] = kw.value
+            return meta
+        return None
+
+    def _jit_static_excludes(self, call):
+        """Param indices/names excluded from tracing by static_arg*."""
+        idxs, names = (), ()
+        for kw in call.keywords:
+            if kw.arg == "static_argnums":
+                idxs = literal_int_tuple(kw.value) or ()
+            elif kw.arg == "static_argnames":
+                if isinstance(kw.value, ast.Constant):
+                    names = (kw.value.value,)
+                elif isinstance(kw.value, (ast.Tuple, ast.List)):
+                    names = tuple(e.value for e in kw.value.elts
+                                  if isinstance(e, ast.Constant))
+        return idxs, names
+
+    def _mark(self, fi, reason, tainted):
+        changed = not fi.reachable
+        if not fi.reachable:
+            fi.reachable = True
+            fi.reason = reason
+        new = tainted - fi.tainted
+        if new:
+            fi.tainted.update(new)
+            changed = True
+        return changed
+
+    def _taint_all_params(self, fi, skip_idxs=(), skip_names=()):
+        return {p for i, p in enumerate(fi.pos_params)
+                if i not in skip_idxs and p not in skip_names}
+
+    def _seed(self):
+        self._worklist = []
+        for m in self.modules:
+            for fi in m.functions:
+                meta = self._register_op_meta(m, fi)
+                if meta is not None:
+                    fi.registered = meta
+                    inputs = list(fi.no_default_params)
+                    if meta["needs_rng"] and inputs:
+                        inputs = inputs[1:]
+                    if self._mark(fi, "register_op(%s)" % (meta["op_name"],),
+                                  set(inputs)):
+                        self._worklist.append(fi)
+            # jax.jit sites anywhere in the module
+            for fi_scope, call in self._iter_calls(m):
+                if not (isinstance(call, ast.Call)
+                        and self.is_jax_jit(m, call.func) and call.args):
+                    continue
+                target = self.resolve_callee(m, fi_scope, call.args[0])
+                if target is None:
+                    continue
+                idxs, names = self._jit_static_excludes(call)
+                if self._mark(target, "jax.jit site %s:%d"
+                              % (m.relpath, call.lineno),
+                              self._taint_all_params(target, idxs, names)):
+                    self._worklist.append(target)
+            # @jax.jit / @partial(jax.jit, ...) decorators
+            for fi in m.functions:
+                for dec in fi.node.decorator_list:
+                    idxs, names = (), ()
+                    hit = False
+                    if self.is_jax_jit(m, dec):
+                        hit = True
+                    elif isinstance(dec, ast.Call):
+                        if self.is_jax_jit(m, dec.func):
+                            hit = True
+                            idxs, names = self._jit_static_excludes(dec)
+                        else:
+                            d = dotted_name(dec.func)
+                            if d and d.rsplit(".", 1)[-1] == "partial" \
+                                    and dec.args \
+                                    and self.is_jax_jit(m, dec.args[0]):
+                                hit = True
+                                idxs, names = self._jit_static_excludes(dec)
+                    if hit and self._mark(
+                            fi, "@jax.jit %s:%d" % (m.relpath, fi.node.lineno),
+                            self._taint_all_params(fi, idxs, names)):
+                        self._worklist.append(fi)
+
+    def _iter_calls(self, module):
+        """Yield (enclosing FunctionInfo or None, Call node) pairs."""
+        # module level (incl. class bodies)
+        for n in module_level_walk(module.tree):
+            if isinstance(n, ast.Call):
+                yield None, n
+        for fi in module.functions:
+            for n in body_walk(fi.node):
+                if isinstance(n, ast.Call):
+                    yield fi, n
+
+    # -- propagation ------------------------------------------------------
+    def _arg_tainted(self, fi, expr):
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Name) and n.id in fi.tainted:
+                return True
+        return False
+
+    def _propagate(self):
+        work = list(self._worklist)
+        del self._worklist
+        guard = 0
+        while work and guard < 100000:
+            guard += 1
+            fi = work.pop()
+            m = fi.module
+            for n in body_walk(fi.node):
+                if not isinstance(n, ast.Call):
+                    continue
+                # direct call edge with positional/keyword taint mapping
+                callee = self.resolve_callee(m, fi, n.func)
+                if callee is not None:
+                    tainted = set()
+                    for i, a in enumerate(n.args):
+                        if isinstance(a, ast.Starred):
+                            break
+                        if i < len(callee.pos_params) and \
+                                self._arg_tainted(fi, a):
+                            tainted.add(callee.pos_params[i])
+                    for kw in n.keywords:
+                        if kw.arg and kw.arg in callee.pos_params and \
+                                self._arg_tainted(fi, kw.value):
+                            tainted.add(kw.arg)
+                    if self._mark(callee, "called from %s" % fi.qualname,
+                                  tainted):
+                        work.append(callee)
+                # function VALUES passed into combinators
+                # (lax.scan/cond/while_loop/custom_vjp/...) become trace
+                # entry points with every parameter possibly traced
+                for a in list(n.args) + [kw.value for kw in n.keywords]:
+                    if isinstance(a, (ast.Name, ast.Attribute)) \
+                            and a is not n.func:
+                        ref = self.resolve_callee(m, fi, a)
+                        if ref is not None and ref is not callee:
+                            if self._mark(ref, "passed as callback from %s"
+                                          % fi.qualname,
+                                          self._taint_all_params(ref)):
+                                work.append(ref)
+            # closure taint: nested defs see the parent's tainted names
+            # unless they rebind them
+            for child in fi.children.values():
+                inherit = (fi.tainted - child.bound_names()) \
+                    if child.reachable else set()
+                if child.reachable and inherit and \
+                        self._mark(child, child.reason, inherit):
+                    work.append(child)
+
+    # -- queries used by rules -------------------------------------------
+    def reachable_functions(self):
+        for m in self.modules:
+            for fi in m.functions:
+                if fi.reachable:
+                    yield fi
+
+    def registered_functions(self):
+        for m in self.modules:
+            for fi in m.functions:
+                if fi.registered is not None:
+                    yield fi

@@ -1,0 +1,249 @@
+"""graftlint engine: file discovery, suppressions, baseline, reporting.
+
+Pure stdlib.  The engine parses every ``*.py`` under the scan roots
+once, hands the :class:`ProjectIndex` to each rule, then filters the
+findings through per-line suppressions and the committed baseline.
+
+Suppression syntax (same line as the finding)::
+
+    risky_thing()  # graftlint: disable=JG001
+    other_thing()  # graftlint: disable=JG003,JG004
+    anything()     # graftlint: disable=all
+
+Baseline workflow: pre-existing findings live in a committed JSON file
+keyed by (rule, path, normalized source line) — stable across
+unrelated line-number drift.  ``--update-baseline`` rewrites it from
+the current findings; CI fails on any finding NOT in the baseline, so
+the count can only go down.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+|all)")
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+BASELINE_VERSION = 1
+
+
+class Finding:
+    __slots__ = ("rule", "path", "line", "col", "message", "status")
+
+    def __init__(self, rule, path, line, col, message):
+        self.rule = rule
+        self.path = path
+        self.line = line
+        self.col = col
+        self.message = message
+        self.status = "new"     # new | baselined | suppressed
+
+    def fingerprint(self, source_line=""):
+        return "%s|%s|%s" % (self.rule, self.path,
+                             " ".join(source_line.split()))
+
+    def as_dict(self):
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "status": self.status}
+
+    def __repr__(self):
+        return "%s:%d:%d: %s %s" % (self.path, self.line, self.col,
+                                    self.rule, self.message)
+
+
+class Baseline:
+    """Committed ledger of accepted pre-existing findings."""
+
+    def __init__(self, path=DEFAULT_BASELINE):
+        self.path = path
+        self.counts = {}    # fingerprint -> accepted count
+
+    @classmethod
+    def load(cls, path=DEFAULT_BASELINE):
+        b = cls(path)
+        if path and os.path.exists(path):
+            with open(path, encoding="utf-8") as f:
+                data = json.load(f)
+            b.counts = dict(data.get("findings", {}))
+        return b
+
+    def save(self, findings, lines_of):
+        entries = {}
+        for f in findings:
+            fp = f.fingerprint(lines_of(f))
+            entries[fp] = entries.get(fp, 0) + 1
+        payload = {
+            "version": BASELINE_VERSION,
+            "comment": "accepted pre-existing graftlint findings; "
+                       "regenerate with --update-baseline (see "
+                       "docs/static_analysis.md)",
+            "findings": dict(sorted(entries.items())),
+        }
+        with open(self.path, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=1, sort_keys=False)
+            f.write("\n")
+
+    def consume(self, finding, source_line):
+        """True (and decrement) if the finding is baselined."""
+        fp = finding.fingerprint(source_line)
+        left = self.counts.get(fp, 0)
+        if left > 0:
+            self.counts[fp] = left - 1
+            return True
+        return False
+
+
+def parse_suppressions(lines):
+    """{lineno: set of rule ids (or {'all'})} for one file."""
+    sup = {}
+    for i, line in enumerate(lines, 1):
+        mark = line.find("#")
+        if mark < 0 or "graftlint" not in line[mark:]:
+            continue
+        mobj = _SUPPRESS_RE.search(line, mark)
+        if not mobj:
+            continue
+        spec = mobj.group(1).strip()
+        if spec.lower() == "all":
+            sup[i] = {"all"}
+        else:
+            sup[i] = {s.strip().upper() for s in spec.split(",")
+                      if s.strip()}
+    return sup
+
+
+class LintEngine:
+    def __init__(self, paths, rules=None, baseline_path=DEFAULT_BASELINE,
+                 use_baseline=True):
+        from .rules import ALL_RULES
+        self.paths = [os.path.abspath(p) for p in paths]
+        self.rule_ids = sorted(rules or ALL_RULES)
+        self.rules = {rid: ALL_RULES[rid] for rid in self.rule_ids}
+        self.baseline_path = baseline_path
+        self.use_baseline = use_baseline
+        self.project = None
+        self.stats = {}
+
+    # -- discovery --------------------------------------------------------
+    def _discover(self):
+        files = []
+        for p in self.paths:
+            if not os.path.exists(p):
+                # a missing path must fail loudly: a typo'd/renamed CI
+                # target would otherwise lint nothing and stay green
+                raise FileNotFoundError(
+                    "graftlint: scan path does not exist: %s" % p)
+            if os.path.isfile(p) and p.endswith(".py"):
+                files.append(p)
+            elif os.path.isdir(p):
+                for base, dirs, names in os.walk(p):
+                    dirs[:] = sorted(d for d in dirs
+                                     if d != "__pycache__"
+                                     and not d.startswith("."))
+                    files.extend(os.path.join(base, n)
+                                 for n in sorted(names)
+                                 if n.endswith(".py"))
+        if not files:
+            raise FileNotFoundError(
+                "graftlint: no .py files under %s" % ", ".join(self.paths))
+        return files
+
+    def _root_base(self):
+        """Directory module names/relpaths are computed against: the
+        parent of the TOP enclosing package of each scan root, so
+        ``graftlint mxnet_tpu/executor.py`` still sees the relpath
+        ``mxnet_tpu/executor.py`` and modname ``mxnet_tpu.executor``
+        (dispatch-path scoping and cross-module resolution depend on
+        it), not a bare ``executor.py``."""
+        bases = set()
+        for p in self.paths:
+            # start from the directory whose name should NOT appear in
+            # relpaths: a scanned dir's parent, or a file's directory
+            d = os.path.dirname(p)
+            # then ascend past package dirs (__init__.py) to the top
+            while os.path.exists(os.path.join(d, "__init__.py")):
+                parent = os.path.dirname(d)
+                if parent == d:
+                    break
+                d = parent
+            bases.add(d or os.getcwd())
+        return os.path.commonpath(sorted(bases)) if bases \
+            else os.getcwd()
+
+    # -- run --------------------------------------------------------------
+    def run(self):
+        from .callgraph import ProjectIndex
+        t0 = time.perf_counter()
+        files = self._discover()
+        self.project = ProjectIndex.build(files, self._root_base())
+        lines_by_path = {m.relpath: m.lines for m in self.project.modules}
+        sup_by_path = {m.relpath: parse_suppressions(m.lines)
+                       for m in self.project.modules}
+
+        findings = []
+        for rid in self.rule_ids:
+            findings.extend(self.rules[rid](self.project))
+        findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+
+        def src_line(f):
+            lines = lines_by_path.get(f.path, ())
+            return lines[f.line - 1] if 0 < f.line <= len(lines) else ""
+
+        self._src_line = src_line
+        baseline = Baseline.load(self.baseline_path) if self.use_baseline \
+            else Baseline(self.baseline_path)
+
+        n_sup = n_base = 0
+        for f in findings:
+            sup = sup_by_path.get(f.path, {}).get(f.line, ())
+            if "all" in sup or f.rule in sup:
+                f.status = "suppressed"
+                n_sup += 1
+            elif baseline.consume(f, src_line(f)):
+                f.status = "baselined"
+                n_base += 1
+
+        new = [f for f in findings if f.status == "new"]
+        self.stats = {
+            "files": len(self.project.modules),
+            "rules": len(self.rule_ids),
+            "findings": len(findings),
+            "suppressed": n_sup,
+            "baselined": n_base,
+            "new": len(new),
+            "seconds": round(time.perf_counter() - t0, 3),
+        }
+        return findings
+
+    def update_baseline(self, findings):
+        """Accept every current non-suppressed finding into the baseline."""
+        keep = [f for f in findings if f.status != "suppressed"]
+        Baseline(self.baseline_path).save(keep, self._src_line)
+        return len(keep)
+
+    # -- reporting --------------------------------------------------------
+    def summary_line(self):
+        s = self.stats
+        return ("graftlint: files=%d rules=%d findings=%d baselined=%d "
+                "suppressed=%d new=%d time=%.2fs"
+                % (s["files"], s["rules"], s["findings"], s["baselined"],
+                   s["suppressed"], s["new"], s["seconds"]))
+
+    def report_text(self, findings, show_all=False):
+        out = []
+        for f in findings:
+            if f.status == "new" or show_all:
+                tag = "" if f.status == "new" else " [%s]" % f.status
+                out.append("%s:%d:%d: %s%s %s"
+                           % (f.path, f.line, f.col, f.rule, tag, f.message))
+        return "\n".join(out)
+
+    def report_json(self, findings):
+        return json.dumps({"summary": self.stats,
+                           "findings": [f.as_dict() for f in findings]},
+                          indent=1)

@@ -1,0 +1,613 @@
+"""graftlint rules JG001–JG008.
+
+Each rule is a function ``check(project) -> list[Finding]`` over the
+:class:`~tools.graftlint.callgraph.ProjectIndex`.  Rules never import
+the analyzed code; everything is decided from the AST plus the
+jit-reachability/taint graph.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .callgraph import (body_walk, dotted_name, literal_int_tuple,
+                        module_level_walk)
+from .engine import Finding
+
+#: modules whose exception handling sits on the dispatch path between
+#: user code and jax — a silent broad except there eats the very
+#: jax.errors a user needs to see (JG006 scope)
+DISPATCH_PREFIXES = (
+    "mxnet_tpu/executor.py", "mxnet_tpu/grouped_executor.py",
+    "mxnet_tpu/autograd.py", "mxnet_tpu/capi_bridge.py",
+    "mxnet_tpu/ops/registry.py", "mxnet_tpu/module/",
+    "mxnet_tpu/optimizer/", "mxnet_tpu/symbol/", "mxnet_tpu/ndarray/",
+    "mxnet_tpu/parallel/",
+)
+
+#: jax top-level calls that force backend/device initialization (JG008)
+_JAX_INIT_CALLS = {
+    "jax.devices", "jax.device_count", "jax.local_devices",
+    "jax.local_device_count", "jax.default_backend", "jax.device_put",
+    "jax.random.PRNGKey",
+}
+
+_RNG_PARAM_NAMES = {"rng", "key", "rng_key", "prng_key", "prng"}
+
+
+def _f(rule, fi_or_module, node, msg):
+    m = fi_or_module if not hasattr(fi_or_module, "module") \
+        else fi_or_module.module
+    return Finding(rule, m.relpath, node.lineno,
+                   getattr(node, "col_offset", 0), msg)
+
+
+def _resolves_to_module(module, expr, dotted_targets):
+    """True if expr's dotted path, after import-alias resolution of its
+    root, starts with one of *dotted_targets*."""
+    d = dotted_name(expr)
+    if d is None:
+        return False
+    head, _, tail = d.partition(".")
+    resolved = module.imports.get(head)
+    if resolved is None:
+        return False
+    full = resolved + ("." + tail if tail else "")
+    return any(full == t or full.startswith(t + ".")
+               for t in dotted_targets)
+
+
+# ---------------------------------------------------------------------------
+# JG001 — host materialization of possibly-traced values
+# ---------------------------------------------------------------------------
+
+_HOST_COERCIONS = {"float", "int", "bool", "complex"}
+_HOST_METHODS = {"item", "tolist", "numpy", "asnumpy"}
+
+
+def check_jg001(project):
+    out = []
+    for fi in project.reachable_functions():
+        if not fi.tainted:
+            continue
+        m = fi.module
+        for n in body_walk(fi.node):
+            if not isinstance(n, ast.Call):
+                continue
+            # float(x) / int(x) / bool(x) on a tainted name
+            if isinstance(n.func, ast.Name) and \
+                    n.func.id in _HOST_COERCIONS and n.args and \
+                    isinstance(n.args[0], ast.Name) and \
+                    n.args[0].id in fi.tainted:
+                out.append(_f("JG001", fi, n,
+                              "%s(%s) materializes a possibly-traced value "
+                              "on host inside jit-reachable '%s' (%s); "
+                              "this raises ConcretizationTypeError under "
+                              "trace — keep it device-side (jnp) or hoist "
+                              "it out of the traced path"
+                              % (n.func.id, n.args[0].id, fi.qualname,
+                                 fi.reason)))
+            # x.item() / x.tolist() / x.numpy() on a tainted name
+            elif isinstance(n.func, ast.Attribute) and \
+                    n.func.attr in _HOST_METHODS and \
+                    isinstance(n.func.value, ast.Name) and \
+                    n.func.value.id in fi.tainted:
+                out.append(_f("JG001", fi, n,
+                              "%s.%s() forces a device->host round-trip on "
+                              "a possibly-traced value inside "
+                              "jit-reachable '%s' (%s)"
+                              % (n.func.value.id, n.func.attr, fi.qualname,
+                                 fi.reason)))
+            # np.asarray(x) / np.array(x) on a tainted name
+            elif isinstance(n.func, ast.Attribute) and \
+                    n.func.attr in ("asarray", "array") and \
+                    _resolves_to_module(m, n.func, ("numpy",)) and \
+                    n.args and isinstance(n.args[0], ast.Name) and \
+                    n.args[0].id in fi.tainted:
+                out.append(_f("JG001", fi, n,
+                              "np.%s(%s) copies a possibly-traced value to "
+                              "host inside jit-reachable '%s' — use jnp"
+                              % (n.func.attr, n.args[0].id, fi.qualname)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# JG002 — use after donation
+# ---------------------------------------------------------------------------
+
+def _donated_positions(call, scope_literals):
+    """Donated argnums of a jax.jit(...) call: tuple of ints, 'all' when
+    donating but positions are indeterminate, or None when not donating."""
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        lit = literal_int_tuple(v)
+        if lit is not None:
+            return lit or None          # empty tuple donates nothing
+        if isinstance(v, ast.Name) and v.id in scope_literals:
+            lit = scope_literals[v.id]
+            return lit or None
+        if isinstance(v, ast.IfExp):
+            # the `(0, 4) if supports_donation() else ()` idiom: the
+            # truthy branch is what donates on TPU
+            lit = literal_int_tuple(v.body)
+            if lit is not None:
+                return lit or None
+        return "all"
+    return None
+
+
+class _OrderedEvents(ast.NodeVisitor):
+    """Emit (kind, name, node) events of one function body in
+    evaluation order: 'load', 'store', 'call' (call of a tracked
+    name).  Nested defs are skipped; control flow is linearized (a
+    linter approximation — branches are treated as sequential)."""
+
+    def __init__(self):
+        self.events = []
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass
+
+    def visit_Assign(self, node):
+        self.visit(node.value)
+        for t in node.targets:
+            self.visit(t)
+
+    def visit_AugAssign(self, node):
+        # target is read, value evaluated, target stored
+        if isinstance(node.target, ast.Name):
+            self.events.append(("load", node.target.id, node.target))
+        self.visit(node.value)
+        if isinstance(node.target, ast.Name):
+            self.events.append(("store", node.target.id, node.target))
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:
+            self.visit(node.value)
+        self.visit(node.target)
+
+    def visit_Call(self, node):
+        self.visit(node.func)
+        for a in node.args:
+            self.visit(a)
+        for kw in node.keywords:
+            self.visit(kw.value)
+        self.events.append(("call", None, node))
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Store):
+            self.events.append(("store", node.id, node))
+        elif isinstance(node.ctx, ast.Load):
+            self.events.append(("load", node.id, node))
+
+
+def check_jg002(project):
+    out = []
+    for m in project.modules:
+        for fi in m.functions:
+            out.extend(_jg002_scope(project, m, fi))
+    return out
+
+
+def _jg002_scope(project, m, fi):
+    ev = _OrderedEvents()
+    for stmt in fi.node.body:
+        ev.visit(stmt)
+    events = ev.events
+
+    # pre-pass A: literal int-tuple bindings (for donate_argnums=<name>)
+    scope_literals = {}
+    # pre-pass B: names assigned from jax.jit(..., donate_argnums=...)
+    assigned_jits = {}  # target name -> donated positions
+    for stmt in fi.node.body:
+        for n in ast.walk(stmt):
+            if not (isinstance(n, ast.Assign) and len(n.targets) == 1
+                    and isinstance(n.targets[0], ast.Name)):
+                continue
+            lit = literal_int_tuple(n.value)
+            if lit is None and isinstance(n.value, ast.IfExp):
+                lit = literal_int_tuple(n.value.body)
+            if lit is not None:
+                scope_literals[n.targets[0].id] = lit
+            if isinstance(n.value, ast.Call) and \
+                    project.is_jax_jit(m, n.value.func):
+                pos = _donated_positions(n.value, scope_literals)
+                if pos is not None:
+                    assigned_jits[n.targets[0].id] = pos
+
+    def report(name, node, dcall, callee):
+        return _f(
+            "JG002", m, node,
+            "'%s' was donated to '%s' at line %d and is read afterwards "
+            "— its buffer is invalid after the donating call (XLA reuses "
+            "it for the outputs); reorder the read, or rebind the name "
+            "to the call's result" % (name, callee, dcall.lineno))
+
+    findings = []
+    donated = {}   # arg name -> (donating call node, callee label)
+    for kind, name, node in events:
+        if kind == "call":
+            call = node
+            # invocation of a name bound to a donating jit in this scope
+            if isinstance(call.func, ast.Name) and \
+                    call.func.id in assigned_jits:
+                pos = assigned_jits[call.func.id]
+                idxs = range(len(call.args)) if pos == "all" else pos
+                for i in idxs:
+                    if i < len(call.args) and \
+                            isinstance(call.args[i], ast.Name):
+                        donated[call.args[i].id] = (call, call.func.id)
+            # inline jax.jit(f, donate_argnums=...)(args)
+            elif isinstance(call.func, ast.Call) and \
+                    project.is_jax_jit(m, call.func.func):
+                pos = _donated_positions(call.func, scope_literals)
+                if pos is not None:
+                    idxs = range(len(call.args)) if pos == "all" else pos
+                    for i in idxs:
+                        if i < len(call.args) and \
+                                isinstance(call.args[i], ast.Name):
+                            donated[call.args[i].id] = (call, "<inline jit>")
+        elif kind == "store":
+            # rebinding a donated name makes later reads safe again
+            donated.pop(name, None)
+        elif kind == "load":
+            if name in donated:
+                dcall, callee = donated.pop(name)  # one report / donation
+                findings.append(report(name, node, dcall, callee))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# JG003 — side effects under trace
+# ---------------------------------------------------------------------------
+
+_SIDE_EFFECT_MODULES = ("mxnet_tpu.profiler", "logging", "warnings")
+
+
+def check_jg003(project):
+    out = []
+    for fi in project.reachable_functions():
+        m = fi.module
+        stored = {n.id for n in body_walk(fi.node)
+                  if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store)}
+        for n in body_walk(fi.node):
+            if isinstance(n, ast.Call):
+                if isinstance(n.func, ast.Name) and n.func.id == "print":
+                    out.append(_f("JG003", fi, n,
+                                  "print() inside jit-reachable '%s' fires "
+                                  "once at trace time and never again — "
+                                  "use jax.debug.print for per-step output"
+                                  % fi.qualname))
+                elif _resolves_to_module(m, n.func, _SIDE_EFFECT_MODULES):
+                    out.append(_f("JG003", fi, n,
+                                  "'%s' inside jit-reachable '%s' runs at "
+                                  "trace time only (cached executions skip "
+                                  "the Python body) — counters/log lines "
+                                  "here silently under-report"
+                                  % (dotted_name(n.func), fi.qualname)))
+            elif isinstance(n, (ast.Global, ast.Nonlocal)):
+                written = [nm for nm in n.names if nm in stored]
+                if written:
+                    kw = "global" if isinstance(n, ast.Global) else "nonlocal"
+                    out.append(_f("JG003", fi, n,
+                                  "%s write to %s inside jit-reachable '%s' "
+                                  "mutates host state at trace time only — "
+                                  "the compiled program never re-runs it"
+                                  % (kw, ", ".join(written), fi.qualname)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# JG004 — recompile hazards
+# ---------------------------------------------------------------------------
+
+_IMPURE_MODULES = ("time", "random", "datetime")
+
+
+def check_jg004(project):
+    out = []
+    for fi in project.reachable_functions():
+        m = fi.module
+        for n in body_walk(fi.node):
+            if not isinstance(n, ast.Call):
+                continue
+            d = dotted_name(n.func)
+            if d is None:
+                continue
+            if _resolves_to_module(m, n.func, _IMPURE_MODULES):
+                out.append(_f("JG004", fi, n,
+                              "'%s' inside jit-reachable '%s' is evaluated "
+                              "at trace time: its value is burned into the "
+                              "compiled program as a constant (and a fresh "
+                              "value forces a retrace)" % (d, fi.qualname)))
+            elif ".random." in ("." + d + ".") and \
+                    _resolves_to_module(m, n.func, ("numpy",)):
+                out.append(_f("JG004", fi, n,
+                              "np.random call '%s' inside jit-reachable "
+                              "'%s' is host-side and trace-time-only — use "
+                              "jax.random with an explicit key"
+                              % (d, fi.qualname)))
+    # jax.jit inside a loop body: a fresh wrapper per iteration defeats
+    # the jit cache (cache key includes function identity) -> retrace
+    # and recompile every iteration
+    for m in project.modules:
+        for scope, call in m_loop_jits(project, m):
+            out.append(Finding(
+                "JG004", m.relpath, call.lineno, call.col_offset,
+                "jax.jit called inside a loop: each iteration builds a "
+                "fresh jitted callable whose cache is empty, so every "
+                "call retraces and recompiles — hoist the jit out of "
+                "the loop"))
+    # unhashable literal passed at a static_argnums position of an
+    # inline jit call — TypeError at call time, statically determinable
+    for m in project.modules:
+        for fi_scope, call in project._iter_calls(m):
+            if not (isinstance(call.func, ast.Call)
+                    and project.is_jax_jit(m, call.func.func)):
+                continue
+            idxs, _names = project._jit_static_excludes(call.func)
+            for i in idxs:
+                if i < len(call.args) and \
+                        isinstance(call.args[i], (ast.List, ast.Dict,
+                                                  ast.Set)):
+                    out.append(Finding(
+                        "JG004", m.relpath, call.args[i].lineno,
+                        call.args[i].col_offset,
+                        "unhashable %s literal passed at static_argnums "
+                        "position %d — static args must be hashable (use "
+                        "a tuple), else every call raises/retraces"
+                        % (type(call.args[i]).__name__.lower(), i)))
+    return out
+
+
+def m_loop_jits(project, m):
+    """(scope, jax.jit Call) pairs lexically inside for/while bodies —
+    a function def inside the loop resets the context (its body runs
+    when called, not per loop iteration)."""
+    hits = []
+
+    def scan(node, in_loop):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                scan(child, False)
+                continue
+            child_in_loop = in_loop or isinstance(node, (ast.For, ast.While))
+            if isinstance(child, ast.Call) and child_in_loop and \
+                    project.is_jax_jit(m, child.func):
+                hits.append((None, child))
+            scan(child, child_in_loop)
+
+    scan(m.tree, False)
+    return hits
+
+
+# ---------------------------------------------------------------------------
+# JG005 — register_op contract violations
+# ---------------------------------------------------------------------------
+
+def check_jg005(project):
+    out = []
+    for fi in project.registered_functions():
+        meta = fi.registered
+        node = fi.node
+        arity_params = list(fi.no_default_params)
+        if meta["needs_rng"]:
+            if not arity_params or \
+                    arity_params[0] not in _RNG_PARAM_NAMES:
+                out.append(_f("JG005", fi, node,
+                              "op '%s' declares needs_rng=True but '%s' "
+                              "does not take an rng key as first "
+                              "positional parameter (got %s) — the "
+                              "runtime passes the key positionally"
+                              % (meta["op_name"], fi.name,
+                                 arity_params[:1] or "nothing")))
+            arity_params = arity_params[1:]
+        n_inputs = len(arity_params)
+        # declared input_names may legally extend past the required
+        # positionals with optional array inputs (Convolution's
+        # bias=None) — those are donatable too, matching the runtime
+        # mirror registry.op_contract
+        n_donatable = n_inputs
+        names_node = meta.get("input_names")
+        if isinstance(names_node, (ast.Tuple, ast.List)):
+            n_donatable = max(n_donatable, len(names_node.elts))
+        donate = meta.get("donate")
+        if donate:
+            if fi.has_varargs:
+                pass  # arity indeterminate
+            else:
+                for i in donate:
+                    if i < 0 or i >= n_donatable:
+                        out.append(_f(
+                            "JG005", fi, meta.get("donate_node", node),
+                            "op '%s': donate index %d is out of range for "
+                            "%d donatable array input(s) %s — donation "
+                            "would alias a nonexistent buffer"
+                            % (meta["op_name"], i, n_donatable,
+                               tuple(arity_params))))
+        n_out = meta["num_outputs"]
+        if isinstance(n_out, int):
+            arities = _return_arities(node)
+            if arities is not None and arities and \
+                    all(a == arities[0] for a in arities) and \
+                    arities[0] != n_out:
+                out.append(_f("JG005", fi, node,
+                              "op '%s' declares num_outputs=%d but '%s' "
+                              "statically returns %d value(s) — the "
+                              "executor would mis-split the outputs"
+                              % (meta["op_name"], n_out, fi.name,
+                                 arities[0])))
+    return out
+
+
+def _return_arities(func_node):
+    """Arity of each return when ALL are statically determinable tuple
+    literals (or single non-tuple expressions -> arity 1); None when any
+    return is indeterminate."""
+    arities = []
+    for n in body_walk(func_node):
+        if not isinstance(n, ast.Return):
+            continue
+        v = n.value
+        if v is None:
+            return None
+        if isinstance(v, ast.Tuple):
+            arities.append(len(v.elts))
+        elif isinstance(v, (ast.Name, ast.IfExp, ast.Starred)):
+            return None  # could be anything
+        elif isinstance(v, ast.Call):
+            return None
+        else:
+            arities.append(1)
+    return arities
+
+
+# ---------------------------------------------------------------------------
+# JG006 — silent overbroad exception handler in a dispatch path
+# ---------------------------------------------------------------------------
+
+def check_jg006(project):
+    out = []
+    for m in project.modules:
+        if not any(m.relpath.startswith(p) or ("/" + p) in m.relpath
+                   for p in DISPATCH_PREFIXES):
+            continue
+        for n in ast.walk(m.tree):
+            if not isinstance(n, ast.ExceptHandler):
+                continue
+            broad = n.type is None or (
+                isinstance(n.type, ast.Name)
+                and n.type.id in ("Exception", "BaseException"))
+            if not broad:
+                continue
+            if _handler_is_loud(n):
+                continue
+            what = "bare except:" if n.type is None \
+                else "except %s:" % n.type.id
+            out.append(Finding(
+                "JG006", m.relpath, n.lineno, n.col_offset,
+                "%s in a dispatch path swallows jax.errors silently — "
+                "narrow the exception type, re-raise, or at minimum bind "
+                "and log the exception so trace/compile failures stay "
+                "diagnosable" % what))
+    return out
+
+
+def _handler_is_loud(handler):
+    """A handler that re-raises, logs, or otherwise uses the caught
+    exception is deliberate fallback handling, not a silent swallow."""
+    for n in ast.walk(handler):
+        if isinstance(n, ast.Raise):
+            return True
+        if handler.name and isinstance(n, ast.Name) and \
+                n.id == handler.name and isinstance(n.ctx, ast.Load):
+            return True
+        if isinstance(n, ast.Call):
+            d = dotted_name(n.func)
+            if d and ("log" in d.lower() or d.endswith("warn")):
+                return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# JG007 — mutable default argument in public API
+# ---------------------------------------------------------------------------
+
+def check_jg007(project):
+    out = []
+    for m in project.modules:
+        for fi in m.functions:
+            node = fi.node
+            for d in list(node.args.defaults) + \
+                    [x for x in node.args.kw_defaults if x is not None]:
+                mutable = isinstance(d, (ast.List, ast.Dict, ast.Set)) or (
+                    isinstance(d, ast.Call) and isinstance(d.func, ast.Name)
+                    and d.func.id in ("list", "dict", "set", "bytearray")
+                    and not d.args and not d.keywords)
+                if mutable:
+                    if isinstance(d, ast.Call):
+                        what = "%s()" % d.func.id
+                    else:
+                        what = type(d).__name__.lower()
+                    public = "public API " if not fi.name.startswith("_") \
+                        else ""
+                    out.append(_f(
+                        "JG007", fi, d,
+                        "mutable default %s in %s'%s' is shared across "
+                        "calls — one caller's mutation leaks into the "
+                        "next; default to None and construct inside"
+                        % (what, public, fi.qualname)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# JG008 — backend-forcing jnp/jax call at module import time
+# ---------------------------------------------------------------------------
+
+def check_jg008(project):
+    out = []
+    for m in project.modules:
+        for n in module_level_walk(m.tree):
+            if not isinstance(n, ast.Call):
+                continue
+            d = dotted_name(n.func)
+            if d is None:
+                continue
+            head, _, tail = d.partition(".")
+            resolved = m.imports.get(head)
+            if resolved is None:
+                continue
+            full = resolved + ("." + tail if tail else "")
+            is_jnp = full == "jax.numpy" or full.startswith("jax.numpy.")
+            if is_jnp or full in _JAX_INIT_CALLS:
+                out.append(Finding(
+                    "JG008", m.relpath, n.lineno, n.col_offset,
+                    "'%s' at module import time forces jax backend "
+                    "initialization on import (device dial-out, several "
+                    "seconds on TPU; breaks JAX_PLATFORMS overrides set "
+                    "after import) — build the constant lazily inside "
+                    "the op or cache it behind a function" % d))
+    return out
+
+
+# ---------------------------------------------------------------------------
+
+ALL_RULES = {
+    "JG001": check_jg001,
+    "JG002": check_jg002,
+    "JG003": check_jg003,
+    "JG004": check_jg004,
+    "JG005": check_jg005,
+    "JG006": check_jg006,
+    "JG007": check_jg007,
+    "JG008": check_jg008,
+}
+
+RULE_DOCS = {
+    "JG001": "host materialization of possibly-traced values "
+             "(float()/int()/bool()/.item()/.tolist()/np.asarray on "
+             "values reachable from a jax.jit or register_op trace)",
+    "JG002": "use of a buffer after it was donated to a "
+             "donate_argnums jit call in the same scope",
+    "JG003": "side effects under trace: print/profiler/logging calls "
+             "and global/nonlocal writes in jit-reachable code run "
+             "once at trace time, then silently never again",
+    "JG004": "recompile hazards: time/random/datetime under trace, "
+             "jax.jit built inside a loop, unhashable static args",
+    "JG005": "register_op contract: donate indices must address real "
+             "array inputs, num_outputs must match the statically "
+             "visible return arity, needs_rng ops must accept a key",
+    "JG006": "silent overbroad except (bare/Exception) in dispatch-path "
+             "modules swallows jax.errors",
+    "JG007": "mutable default argument shared across calls in API "
+             "functions",
+    "JG008": "jnp/jax backend-forcing call at module import time",
+}
