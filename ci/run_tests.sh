@@ -15,6 +15,14 @@ echo "== sanity: graftlint static analysis =="
 # the last stdout line is the scrapeable summary ("graftlint: ...").
 python -m tools.graftlint mxnet_tpu
 
+echo "== resilience: chaos-injected fault drills =="
+# The resilience suite under the chaos harness: kill-mid-save,
+# corrupt-checkpoint, NaN-step, and preemption drills against the REAL
+# checkpoint/guard/fit code paths.  Deterministic counters + injected
+# backoff clocks — no sleeps, seconds not minutes (docs/resilience.md).
+MXNET_CHAOS=on python -m pytest tests/test_resilience.py -q \
+    -p no:cacheprovider
+
 echo "== native: C predict ABI + RecordIO reader =="
 if command -v g++ >/dev/null; then
     make -C src/capi

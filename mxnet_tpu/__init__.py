@@ -64,6 +64,7 @@ from . import recordio  # noqa: F401
 from . import visualization  # noqa: F401
 viz = visualization  # reference alias: mx.viz
 from . import subgraph  # noqa: F401
+from . import resilience  # noqa: F401
 from . import config  # noqa: F401
 from . import rtc  # noqa: F401
 from .runtime import engine  # noqa: F401

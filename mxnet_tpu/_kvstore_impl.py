@@ -83,10 +83,13 @@ class KVStoreBase:
     def barrier(self):
         pass
 
-    def save_optimizer_states(self, fname, dump_optimizer=False):
+    def get_optimizer_states(self, dump_optimizer=False):
         assert self._updater is not None, "updater is not set"
-        with open(fname, "wb") as f:
-            f.write(self._updater.get_states(dump_optimizer))
+        return self._updater.get_states(dump_optimizer)
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        from .resilience.checkpoint import atomic_write
+        atomic_write(fname, self.get_optimizer_states(dump_optimizer))
 
     def load_optimizer_states(self, fname):
         assert self._updater is not None, "updater is not set"

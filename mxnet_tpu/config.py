@@ -98,6 +98,30 @@ register_env("MXNET_MODULE_FUSED_STEP", bool, True,
              "gradient reduction + optimizer update into one donated "
              "XLA program when eligible; off = always run the legacy "
              "per-parameter Updater loop (TPU-native knob)")
+register_env("MXNET_GUARD_NONFINITE", bool, False,
+             "Skip optimizer updates whose loss/gradients contain "
+             "NaN/Inf: one in-graph isfinite reduction inside the "
+             "fused train step selects the unchanged params/state, so "
+             "a diverged step costs no extra dispatch and no "
+             "recompile (TPU-native knob; see docs/resilience.md)")
+register_env("MXNET_GUARD_MAX_BAD_STEPS", int, 0,
+             "With the non-finite guard on, this many CONSECUTIVE "
+             "skipped steps trigger the divergence action (raise, or "
+             "rollback via Module.set_nonfinite_guard); 0 = count "
+             "and skip only")
+register_env("MXNET_CHAOS", str, "",
+             "Fault-injection spec for the resilience chaos harness, "
+             "e.g. 'fail_file_writes=2,nan_grads_at_step=3'; 'on' "
+             "enables the harness with nothing armed; empty = off "
+             "(see mxnet_tpu/resilience/chaos.py)")
+register_env("MXNET_CHECKPOINT_KEEP_LAST", int, 0,
+             "Default keep-last-K rotation for CheckpointManager "
+             "(older epochs' files are deleted once unreferenced); "
+             "0 = keep every checkpoint")
+register_env("MXNET_DATALOADER_RESPAWNS", int, 2,
+             "How many crashed DataLoader worker processes are "
+             "respawned (with backoff, lost batches resubmitted) "
+             "before the loader gives up and raises")
 register_env("MXNET_UPDATE_ON_KVSTORE", bool, True,
              "Run the optimizer on the kvstore server (dist) / store "
              "(local) instead of locally (reference: module/trainer)")

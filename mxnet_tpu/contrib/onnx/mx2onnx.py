@@ -580,6 +580,6 @@ def export_model(sym, params, input_shape=None, input_type=_np.float32,
            "baked at these lengths)" % (input_shape,)) if input_shape \
         else None
     blob = P.model(gb, doc_string=doc)
-    with open(onnx_file_path, "wb") as f:
-        f.write(blob)
+    from ...resilience.checkpoint import atomic_write
+    atomic_write(onnx_file_path, blob)
     return onnx_file_path

@@ -323,7 +323,7 @@ class Executor:
         # single-program step cannot be built on top of it
         self._train_step_fn = None
 
-    def init_fused_step(self, tree_update_fn):
+    def init_fused_step(self, tree_update_fn, guard_nonfinite=False):
         """Build the fused train step: forward + VJP + optimizer update
         in ONE donated ``jax.jit`` — weights and optimizer state stay
         device-resident and step N+1 chains on step N's donated
@@ -336,13 +336,20 @@ class Executor:
 
             fused(params, rest, aux_map, base_key, opt_state, lrs,
                   wds, ts, step) -> (outs, new_aux, new_params,
-                                     new_opt_state)
+                                     new_opt_state[, skipped])
 
         *params* holds only the UPDATABLE args (donated); data/labels/
         fixed params ride in *rest* undonated so caller-owned batch
         buffers stay valid.  *ts* carries the per-name update counts;
         *step* is the scalar step the PRNG key is folded with in-graph,
-        so not even a key split dispatches per step."""
+        so not even a key split dispatches per step.
+
+        With *guard_nonfinite*, one fused ``isfinite`` reduction over
+        the loss outputs + gradient tree decides in-graph whether the
+        update applies: a non-finite step returns params, optimizer
+        state AND aux (BatchNorm stats) bit-identical, plus a trailing
+        int32 ``skipped`` flag — still the same single program, no
+        recompile (see docs/resilience.md)."""
         if self._train_step_fn is None:
             raise MXNetError(
                 "the fused train step is not supported with group2ctx "
@@ -350,6 +357,7 @@ class Executor:
         core = self._train_step_fn
         n_outs = len(self._symbol._outputs)
         from . import profiler as _prof
+        from .optimizer import tree_opt as _tree_opt
 
         def fused_step(params, rest, aux_map, base_key, opt_state, lrs,
                        wds, ts, step):
@@ -366,6 +374,16 @@ class Executor:
                 grads, params, opt_state, lrs, wds, ts)
             new_aux = dict(aux_map)
             new_aux.update(auxu)
+            if guard_nonfinite:
+                bad = jnp.logical_or(_tree_opt.nonfinite_any(outs),
+                                     _tree_opt.nonfinite_any(grads))
+                new_params = _tree_opt.select_tree(bad, params,
+                                                   new_params)
+                new_state = _tree_opt.select_tree(bad, opt_state,
+                                                  new_state)
+                new_aux = _tree_opt.select_tree(bad, aux_map, new_aux)
+                return (outs, new_aux, new_params, new_state,
+                        bad.astype(jnp.int32))
             return outs, new_aux, new_params, new_state
 
         from .ops.registry import supports_donation

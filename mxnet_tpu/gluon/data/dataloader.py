@@ -16,6 +16,7 @@ memory (cpu_shared context, dataloader.py:26-110).  Two worker modes here:
 
 from __future__ import annotations
 
+import logging
 import os
 import queue
 import threading
@@ -23,6 +24,8 @@ import traceback
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as _np
+
+log = logging.getLogger(__name__)
 
 from ... import ndarray as nd
 from ...ndarray import NDArray
@@ -65,8 +68,12 @@ def _tree_to_shm(obj):
         # worker's exit doesn't double-unlink
         try:
             resource_tracker.unregister(shm._name, "shared_memory")
-        except Exception:
-            pass
+        except Exception as exc:
+            # tracker internals vary across Pythons; a failed
+            # unregister only risks a spurious tracker warning at
+            # worker exit — keep it diagnosable, not fatal
+            log.debug("shm tracker unregister failed for %s: %s",
+                      shm._name, exc)
         shm.close()
         return ("shm", name, obj.shape, str(obj.dtype))
     if isinstance(obj, tuple):
@@ -111,46 +118,70 @@ def _worker_loop(dataset, batchify_fn, work_q, res_q):
             batch = batchify_fn([dataset[i] for i in indices])
             res_q.put((seq, _tree_to_shm(batch), None))
         except Exception:
+            # the traceback travels to the consumer and is raised there;
+            # log here too so a worker whose result is never consumed
+            # (shutdown race) still leaves a trace
+            log.debug("dataloader worker failed on batch %d:\n%s", seq,
+                      traceback.format_exc())
             res_q.put((seq, None, traceback.format_exc()))
 
 
 class _MultiWorkerIter:
     """Ordered iterator over worker-process results (reference:
-    dataloader.py _MultiWorkerIter with rcvd_idx ordering)."""
+    dataloader.py _MultiWorkerIter with rcvd_idx ordering).
+
+    Each worker owns a PRIVATE index queue (jobs are round-robined):
+    a worker killed while blocked in ``Queue.get`` dies holding that
+    queue's reader semaphore, and with a shared queue that one death
+    would wedge every other reader forever.  Private queues make a
+    crashed worker fully disposable — its queue is dropped, a
+    replacement is spawned (with retry/backoff) onto a fresh queue,
+    and exactly the batches assigned to the dead worker are
+    resubmitted."""
 
     def __init__(self, dataset, batchify_fn, batch_sampler, num_workers,
-                 prefetch):
+                 prefetch, max_respawns=None):
         import multiprocessing as mp
         # spawn, never fork: the parent holds live XLA/TPU state that must
         # not leak into children; spawned children re-import under
         # JAX_PLATFORMS=cpu (set in the env below, inherited at exec)
-        ctx = mp.get_context("spawn")
-        self._work_q = ctx.Queue()
-        self._res_q = ctx.Queue()
-        self._workers = [
-            ctx.Process(target=_worker_loop,
-                        args=(dataset, batchify_fn, self._work_q,
-                              self._res_q),
-                        daemon=True)
-            for _ in range(num_workers)]
+        self._ctx = mp.get_context("spawn")
+        self._dataset = dataset
+        self._batchify_fn = batchify_fn
+        self._res_q = self._ctx.Queue()
+        if max_respawns is None:
+            from ...config import get_env
+            max_respawns = get_env("MXNET_DATALOADER_RESPAWNS")
+        self._max_respawns = max(0, max_respawns)
+        self._respawns = 0
+        self._work_qs = [self._ctx.Queue() for _ in range(num_workers)]
+        self._workers = [self._spawn_worker(q) for q in self._work_qs]
+        self._batches = iter(batch_sampler)
+        self._sent = 0
+        self._rcvd = 0
+        self._buffer = {}
+        self._inflight = {}     # seq -> (worker slot, indices)
+        self._exhausted = False
+        for _ in range(prefetch):
+            self._push_next()
+
+    def _spawn_worker(self, work_q):
+        worker = self._ctx.Process(
+            target=_worker_loop,
+            args=(self._dataset, self._batchify_fn, work_q,
+                  self._res_q),
+            daemon=True)
         # children inherit the env at start(): pin cpu for them only
         prev = os.environ.get("JAX_PLATFORMS")
         os.environ["JAX_PLATFORMS"] = "cpu"
         try:
-            for w in self._workers:
-                w.start()
+            worker.start()
         finally:
             if prev is None:
                 del os.environ["JAX_PLATFORMS"]
             else:
                 os.environ["JAX_PLATFORMS"] = prev
-        self._batches = iter(batch_sampler)
-        self._sent = 0
-        self._rcvd = 0
-        self._buffer = {}
-        self._exhausted = False
-        for _ in range(prefetch):
-            self._push_next()
+        return worker
 
     def _push_next(self):
         try:
@@ -158,37 +189,100 @@ class _MultiWorkerIter:
         except StopIteration:
             self._exhausted = True
             return
-        self._work_q.put((self._sent, indices))
+        slot = self._sent % len(self._workers)
+        self._inflight[self._sent] = (slot, indices)
+        self._work_qs[slot].put((self._sent, indices))
         self._sent += 1
+
+    def _revive_dead_workers(self):
+        """Respawn crashed workers (retry/backoff on the spawn itself)
+        onto fresh queues and resubmit exactly the batches the dead
+        workers owned.  False when the respawn budget is exhausted."""
+        dead = [i for i, w in enumerate(self._workers)
+                if not w.is_alive()]
+        if not dead:
+            return True
+        if self._respawns + len(dead) > self._max_respawns:
+            return False
+        from ...resilience.retry import retry_call
+        for i in dead:
+            w = self._workers[i]
+            log.warning("DataLoader worker pid=%s died (exitcode=%s); "
+                        "respawning (%d/%d respawns used)", w.pid,
+                        w.exitcode, self._respawns + 1,
+                        self._max_respawns)
+            self._respawns += 1
+            # the dead worker's queue may be semaphore-poisoned (killed
+            # mid-get) — discard it wholesale
+            self._work_qs[i] = self._ctx.Queue()
+            self._workers[i] = retry_call(
+                self._spawn_worker, (self._work_qs[i],), attempts=3,
+                base_delay=0.05, max_delay=0.5,
+                retry_on=(OSError, RuntimeError))
+            for seq in range(self._rcvd, self._sent):
+                if seq in self._buffer or seq not in self._inflight:
+                    continue
+                slot, indices = self._inflight[seq]
+                if slot == i:
+                    self._work_qs[i].put((seq, indices))
+        return True
 
     def __iter__(self):
         return self
+
+    #: consecutive result-less seconds with live workers before the
+    #: loader concludes the SHARED result queue is wedged (a worker
+    #: killed mid-put can die holding its write lock — the one shared
+    #: resource respawning cannot replace) and fails loudly
+    _STALL_LIMIT_S = 60
 
     def __next__(self):
         if self._rcvd == self._sent:
             self.shutdown()
             raise StopIteration
+        stalled = 0
         while self._rcvd not in self._buffer:
+            if stalled >= self._STALL_LIMIT_S:
+                self.shutdown()
+                raise RuntimeError(
+                    "DataLoader produced no batch for %ds despite live "
+                    "workers — the shared result queue is likely "
+                    "poisoned (a worker was killed while holding its "
+                    "write lock). Restart the loader; lower batch "
+                    "sizes/augmentation cost if workers are being "
+                    "OOM-killed." % self._STALL_LIMIT_S)
             try:
                 seq, payload, err = self._res_q.get(timeout=1.0)
             except queue.Empty:
+                stalled += 1
                 # liveness check: a crashed worker (OOM-kill, segfault,
                 # failed spawn import) would otherwise hang this get
                 # forever — workers only exit after the shutdown sentinel
-                if any(not w.is_alive() for w in self._workers):
+                if any(not w.is_alive() for w in self._workers) and \
+                        not self._revive_dead_workers():
                     self.shutdown()
                     raise RuntimeError(
                         "DataLoader worker died unexpectedly (killed or "
-                        "crashed before producing its batch). If this "
+                        "crashed before producing its batch; %d "
+                        "respawn(s) already attempted). If this "
                         "happened at startup, the training script likely "
                         "lacks an `if __name__ == \"__main__\":` guard — "
                         "workers are spawned (never forked: the parent "
                         "holds live XLA/TPU state), so the main module "
                         "must be importable; alternatively pass "
-                        "thread_workers=True.")
+                        "thread_workers=True." % self._respawns)
                 continue
+            if seq < self._rcvd or seq in self._buffer:
+                # duplicate delivery after a respawn resubmission: the
+                # original worker produced it after all — drop it and
+                # unlink its shm segments
+                if payload is not None:
+                    self._unlink_tree(payload)
+                continue
+            stalled = 0
             self._buffer[seq] = (payload, err)
         payload, err = self._buffer.pop(self._rcvd)
+        self._inflight.pop(self._rcvd, None)
         self._rcvd += 1
         self._push_next()
         if err is not None:
@@ -214,16 +308,20 @@ class _MultiWorkerIter:
                 _MultiWorkerIter._unlink_tree(d)
 
     def shutdown(self):
-        for _ in self._workers:
+        for q in self._work_qs:
             try:
-                self._work_q.put(None)
-            except Exception:
-                pass
+                q.put(None)
+            except (OSError, ValueError) as exc:
+                # queue already closed/broken mid-teardown: the join
+                # below falls back to terminate(), but say what happened
+                log.debug("work queue rejected shutdown sentinel: %s",
+                          exc)
         for w in self._workers:
             w.join(timeout=5)
             if w.is_alive():
                 w.terminate()
         self._workers = []
+        self._work_qs = []
         # drain prefetched-but-unconsumed results: their shm segments
         # survive process exit unless unlinked here (early `break` from a
         # training loop would otherwise leak /dev/shm permanently)
@@ -308,11 +406,12 @@ class DataLoader:
                 pickle.Pickler(_Null()).dump(self._dataset)
                 pickle.Pickler(_Null()).dump(batchify)
                 self._mp_ok = True
-            except Exception:
+            except Exception as exc:
                 import warnings
                 warnings.warn(
-                    "DataLoader: dataset/batchify_fn not picklable; "
-                    "using thread workers instead of processes")
+                    "DataLoader: dataset/batchify_fn not picklable "
+                    "(%s: %s); using thread workers instead of "
+                    "processes" % (type(exc).__name__, exc))
                 self._mp_ok = False
 
     def _make_batch(self, indices):

@@ -8,13 +8,30 @@ directory only (``$MXNET_HOME/models`` or ``~/.mxnet/models`` — the same
 location the reference caches into), so checkpoints placed there by the
 user (or exported by ``Block.save_parameters``) load exactly like the
 reference's pretrained flow; a missing file raises with instructions
-instead of attempting a download."""
+instead of attempting a download.
+
+The read probe runs under the resilience retry policy: transient
+storage errors (an NFS/FUSE model dir flaking, the reference's download
+path retried the same way) back off and retry, while a genuinely
+missing file fails fast.
+"""
 
 from __future__ import annotations
 
 import os
+import time
 
 __all__ = ["get_model_file", "purge"]
+
+# retry policy for the store probe; _sleep is module-level so tests can
+# stub the clock out.  Non-transient shapes (missing file, permission
+# denied, path-is-a-directory) fail fast — only plausible storage
+# flakes burn backoff
+_sleep = time.sleep
+_RETRY = dict(attempts=4, base_delay=0.05, max_delay=0.5,
+              retry_on=(OSError,),
+              give_up_on=(FileNotFoundError, PermissionError,
+                          IsADirectoryError, NotADirectoryError))
 
 
 def _model_dir():
@@ -24,19 +41,30 @@ def _model_dir():
         "models")
 
 
+def _probe(path):
+    """Open-and-touch the weight file; OSError here is how flaky
+    network storage announces itself."""
+    with open(path, "rb") as f:
+        f.read(1)
+
+
 def get_model_file(name, root=None):
     """Path to ``<root>/<name>.params``; raises FileNotFoundError with
     the offline explanation when absent (reference: model_store.py
-    get_model_file — which would download on miss)."""
+    get_model_file — which would download on miss).  Transient read
+    failures are retried with jittered backoff."""
+    from ...resilience.retry import retry_call
     root = root or _model_dir()
     path = os.path.join(root, "%s.params" % name)
-    if os.path.exists(path):
-        return path
-    raise FileNotFoundError(
-        "pretrained weights %r not found at %s. This build has no "
-        "network egress: place the .params file there yourself (any "
-        "checkpoint saved with save_parameters works), then retry."
-        % (name, path))
+    try:
+        retry_call(_probe, (path,), sleep=_sleep, **_RETRY)
+    except FileNotFoundError:
+        raise FileNotFoundError(
+            "pretrained weights %r not found at %s. This build has no "
+            "network egress: place the .params file there yourself (any "
+            "checkpoint saved with save_parameters works), then retry."
+            % (name, path))
+    return path
 
 
 def purge(root=None):

@@ -192,8 +192,9 @@ class Trainer:
 
     def save_states(self, fname):
         assert self._optimizer is not None
-        with open(fname, "wb") as f:
-            f.write(self._updaters[0].get_states(dump_optimizer=False))
+        from ..resilience.checkpoint import atomic_write
+        atomic_write(fname,
+                     self._updaters[0].get_states(dump_optimizer=False))
 
     def load_states(self, fname):
         with open(fname, "rb") as f:

@@ -269,10 +269,22 @@ class ResizeIter(DataIter):
 
 class PrefetchingIter(DataIter):
     """Background-thread prefetch (reference: io.py PrefetchingIter:345,
-    C++ iter_prefetcher.h)."""
+    C++ iter_prefetcher.h).
+
+    Failure semantics (resilience subsystem): an exception in the
+    producer thread travels to the consumer and is raised from
+    ``next()`` ONCE; further ``next()`` calls see ``StopIteration``
+    (never a hang on an empty queue whose producer is gone), and
+    ``reset()`` fully restores the iterator.  The producer only ever
+    blocks on the queue in a stop-aware loop, so ``reset()`` can always
+    drain + join it — no deadlock regardless of where the producer was.
+    An optional *retry* spec (kwargs for
+    :func:`mxnet_tpu.resilience.retry.retry_call`) retries transient
+    inner-iterator failures with jittered backoff before surfacing
+    them."""
 
     def __init__(self, iters, rename_data=None, rename_label=None,
-                 prefetch_depth=2):
+                 prefetch_depth=2, retry=None):
         super().__init__()
         if not isinstance(iters, list):
             iters = [iters]
@@ -283,8 +295,9 @@ class PrefetchingIter(DataIter):
         self.rename_label = rename_label
         self.batch_size = iters[0].batch_size
         self._depth = prefetch_depth
-        self._queue = queue.Queue(maxsize=prefetch_depth)
-        self._stop = threading.Event()
+        self._retry = dict(retry) if retry else None
+        self._queue = None
+        self._stop = None
         self._thread = None
         self._peek = None
         self.current_batch = None
@@ -298,38 +311,83 @@ class PrefetchingIter(DataIter):
     def provide_label(self):
         return self.iters[0].provide_label
 
-    def _producer(self):
-        try:
-            while not self._stop.is_set():
-                try:
-                    batch = self.iters[0].next()
-                except StopIteration:
-                    self._queue.put(None)
-                    return
-                except Exception as e:  # exception travels to consumer
-                    self._queue.put(e)
-                    return
-                self._queue.put(batch)
-        finally:
-            pass
+    @staticmethod
+    def _put(q, stop, item):
+        """Stop-aware put: never blocks past a reset() request."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _next_inner(self):
+        if self._retry:
+            from ..resilience.retry import retry_call
+            cfg = dict(self._retry)
+            cfg.setdefault("retry_on", (Exception,))
+            give_up = tuple(cfg.pop("give_up_on", ()))
+            return retry_call(self.iters[0].next,
+                              give_up_on=give_up + (StopIteration,),
+                              **cfg)
+        return self.iters[0].next()
+
+    def _producer(self, q, stop):
+        # q/stop are bound per-thread: a producer abandoned by reset()
+        # keeps talking to ITS queue and stop event, never the
+        # replacement epoch's
+        while not stop.is_set():
+            try:
+                batch = self._next_inner()
+            except StopIteration:
+                self._put(q, stop, None)
+                return
+            except Exception as e:  # exception travels to consumer
+                self._put(q, stop, e)
+                # trailing sentinel: after the consumer raises the
+                # exception, further next() calls end the epoch
+                # instead of hanging on a dead producer
+                self._put(q, stop, None)
+                return
+            if not self._put(q, stop, batch):
+                return
 
     def _start(self):
-        self._thread = threading.Thread(target=self._producer, daemon=True)
+        self._queue = queue.Queue(maxsize=self._depth)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._producer, args=(self._queue, self._stop),
+            daemon=True)
         self._thread.start()
 
     def reset(self):
+        import logging
+        import time as _time
         self._stop.set()
-        try:
-            while True:
-                self._queue.get_nowait()
-        except queue.Empty:
-            pass
-        if self._thread is not None:
-            self._thread.join(timeout=5)
+        # drain-then-join until the producer exits: it can only block
+        # in the stop-aware _put, so freeing queue slots always
+        # unwedges it (a producer mid-put refills what we drain, hence
+        # the loop rather than a single drain).  Bounded: a producer
+        # wedged inside the INNER iterator's next() is abandoned — the
+        # fresh queue below detaches it either way
+        deadline = _time.monotonic() + 10.0
+        while self._thread is not None and self._thread.is_alive():
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=0.1)
+            if _time.monotonic() > deadline:
+                logging.getLogger(__name__).warning(
+                    "PrefetchingIter.reset: producer thread did not "
+                    "exit within 10s (inner iterator wedged?); "
+                    "detaching it")
+                break
         self.iters[0].reset()
-        self._stop.clear()
-        self._queue = queue.Queue(maxsize=self._depth)
         self._peek = None
+        self.current_batch = None
         self._start()
 
     def next(self):

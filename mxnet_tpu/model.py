@@ -5,14 +5,22 @@ FeedForward API is subsumed by mxnet_tpu.module).
 Checkpoint format matches the reference's convention:
 ``prefix-symbol.json`` (graph) + ``prefix-NNNN.params`` (tensors keyed
 ``arg:<name>`` / ``aux:<name>``) so Module/Gluon/SymbolBlock all share it.
+Persistence routes through the resilience subsystem
+(mxnet_tpu/resilience/checkpoint.py): every file is written atomically
+and committed to a checksum manifest, and loads verify against that
+manifest when one exists — a torn or bit-rotted checkpoint fails loudly
+at load instead of as a shape error three layers later.
 """
 
 from __future__ import annotations
+
+import logging
 
 from collections import namedtuple
 
 from . import ndarray as nd
 from . import symbol as sym_mod
+from .base import MXNetError
 
 __all__ = ["BatchEndParam", "save_checkpoint", "load_checkpoint"]
 
@@ -21,22 +29,23 @@ BatchEndParam = namedtuple("BatchEndParams",
 
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
-    """Save (reference: model.py save_checkpoint:383)."""
-    if symbol is not None:
-        symbol.save("%s-symbol.json" % prefix)
-    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
-    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
-    param_name = "%s-%04d.params" % (prefix, epoch)
-    nd.save(param_name, save_dict)
+    """Save (reference: model.py save_checkpoint:383) — crash-safe:
+    atomic per-file writes plus a checksum-manifest commit (see
+    :class:`mxnet_tpu.resilience.CheckpointManager`)."""
+    from .resilience.checkpoint import CheckpointManager
+    CheckpointManager(prefix).save_checkpoint(
+        epoch, symbol=symbol, arg_params=arg_params,
+        aux_params=aux_params)
 
 
-def load_checkpoint(prefix, epoch):
-    """Load (reference: model.py load_checkpoint:413).  Returns
-    (symbol, arg_params, aux_params)."""
-    symbol = sym_mod.load("%s-symbol.json" % prefix)
-    save_dict = nd.load("%s-%04d.params" % (prefix, epoch))
+def _split_save_dict(save_dict, context="params file"):
+    """Split an ``arg:``/``aux:``-keyed save dict into (arg_params,
+    aux_params).  Unrecognized key prefixes are warn-and-skipped: a
+    corrupt or foreign file announces itself at load time instead of
+    surfacing as a shape error three layers later."""
     arg_params = {}
     aux_params = {}
+    unknown = []
     for k, v in save_dict.items():
         tp, _, name = k.partition(":")
         if tp == "arg":
@@ -44,5 +53,33 @@ def load_checkpoint(prefix, epoch):
         elif tp == "aux":
             aux_params[name] = v
         else:
-            arg_params[k] = v
+            unknown.append(k)
+    if unknown:
+        logging.getLogger(__name__).warning(
+            "%s contains %d key(s) without the expected 'arg:'/'aux:' "
+            "prefix (%s%s) — skipped; the file may be foreign (e.g. a "
+            "gluon save_parameters file) or corrupt", context,
+            len(unknown), ", ".join(repr(k) for k in unknown[:5]),
+            ", ..." if len(unknown) > 5 else "")
+    return arg_params, aux_params
+
+
+def load_checkpoint(prefix, epoch):
+    """Load (reference: model.py load_checkpoint:413).  Returns
+    (symbol, arg_params, aux_params).  When a resilience manifest
+    covers this epoch, the files are checksum-verified first and a
+    corrupt/torn checkpoint raises (``CheckpointManager(prefix)
+    .restore_latest()`` falls back to the newest intact one)."""
+    from .resilience.checkpoint import CheckpointManager
+    ok = CheckpointManager(prefix).verify(epoch)
+    if ok is False:
+        raise MXNetError(
+            "checkpoint %r epoch %d failed checksum verification "
+            "(torn write or on-disk corruption); use "
+            "CheckpointManager(%r).restore_latest() to fall back to "
+            "the newest intact checkpoint" % (prefix, epoch, prefix))
+    symbol = sym_mod.load("%s-symbol.json" % prefix)
+    save_dict = nd.load("%s-%04d.params" % (prefix, epoch))
+    arg_params, aux_params = _split_save_dict(
+        save_dict, context="checkpoint %r epoch %d" % (prefix, epoch))
     return symbol, arg_params, aux_params

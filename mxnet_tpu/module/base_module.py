@@ -156,10 +156,20 @@ class BaseModule:
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None, sparse_row_id_fn=None):
-        """Full training loop (reference: base_module.py fit:410)."""
+            monitor=None, sparse_row_id_fn=None,
+            checkpoint_manager=None):
+        """Full training loop (reference: base_module.py fit:410).
+
+        With a :class:`~mxnet_tpu.resilience.CheckpointManager`, each
+        epoch end writes a crash-safe checkpoint through it, and a
+        preemption request (``resilience.request_preemption()``, an
+        installed SIGTERM handler, or the chaos harness) is honored at
+        the next batch boundary: the in-flight batch finishes, a
+        checkpoint is committed, and fit returns cleanly — the job
+        resumes from ``checkpoint_manager.restore_latest()``."""
         assert num_epoch is not None, "please specify number of epochs"
         from .. import initializer as init_mod
+        from .. import resilience
         if initializer is None:
             initializer = init_mod.Uniform(0.01)
 
@@ -192,6 +202,20 @@ class BaseModule:
                 self._fire(batch_end_callback, BatchEndParam(
                     epoch=epoch, nbatch=nbatch,
                     eval_metric=eval_metric, locals=locals()))
+                if resilience.preemption_requested(tick=True):
+                    # finish-the-batch semantics: the step and its
+                    # callbacks completed; checkpoint and exit cleanly
+                    self.logger.warning(
+                        "preemption requested: checkpointing after "
+                        "epoch %d batch %d and exiting fit", epoch,
+                        nbatch)
+                    if checkpoint_manager is not None:
+                        checkpoint_manager.save_module(self, epoch)
+                        checkpoint_manager.wait()
+                    # consume the request: a later fit() in this
+                    # process (in-process resume) must actually train
+                    resilience.clear_preemption()
+                    return
 
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
@@ -203,6 +227,8 @@ class BaseModule:
             self.set_params(*snapshot)
             for cb in _as_list(epoch_end_callback):
                 cb(epoch, self.symbol, *snapshot)
+            if checkpoint_manager is not None:
+                checkpoint_manager.save_module(self, epoch)
 
             if eval_data is not None:
                 res = self.score(eval_data, validation_metric,

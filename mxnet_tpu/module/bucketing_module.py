@@ -241,6 +241,14 @@ class BucketingModule(BaseModule):
         for mod in self._buckets.values():
             mod.install_monitor(mon)
 
-    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False,
+                        checkpoint_manager=None):
         self._buckets[self._default_bucket_key].save_checkpoint(
-            prefix, epoch, save_optimizer_states)
+            prefix, epoch, save_optimizer_states,
+            checkpoint_manager=checkpoint_manager)
+
+    def _optimizer_states_bytes(self):
+        # CheckpointManager.save_module probes this (shared optimizer:
+        # any bucket's module serializes the same updater state)
+        return self._buckets[
+            self._default_bucket_key]._optimizer_states_bytes()

@@ -25,7 +25,7 @@ from .. import ndarray as nd
 from ..ndarray import NDArray
 from .. import optimizer as opt
 from ..initializer import InitDesc
-from ..model import load_checkpoint, save_checkpoint
+from ..model import load_checkpoint
 
 __all__ = ["Module"]
 
@@ -222,6 +222,13 @@ class Module(BaseModule):
         # device-resident optimizer state tree; None = (re)import from
         # the legacy Updater before the next fused step
         self._fused_state = None
+        # non-finite guard (resilience subsystem): explicit config from
+        # set_nonfinite_guard, None = fall back to the env knobs
+        self._guard = None
+        self._guard_skipped = 0     # total skipped steps
+        self._guard_consec = 0      # consecutive skipped steps
+        self._step_seq = 0          # forward_backward_update calls
+        #                             (chaos nan-injection index)
 
     @staticmethod
     def load(prefix, epoch, load_optimizer_states=False, **kwargs):
@@ -234,26 +241,42 @@ class Module(BaseModule):
             mod._preload_opt_states = "%s-%04d.states" % (prefix, epoch)
         return mod
 
-    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
-        self._symbol.save("%s-symbol.json" % prefix)
-        arg_params, aux_params = self.get_params()
-        save_checkpoint(prefix, epoch, None, arg_params, aux_params)
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False,
+                        checkpoint_manager=None):
+        """Checkpoint through the resilience subsystem: every file is
+        written atomically (tmp + fsync + rename) and committed to the
+        checksum manifest LAST, so a preemption at any instruction
+        leaves the previous checkpoint fully restorable (see
+        docs/resilience.md).  File names match the reference layout."""
+        from ..resilience.checkpoint import CheckpointManager
+        mgr = checkpoint_manager or CheckpointManager(prefix)
+        states = None
         if save_optimizer_states:
-            self.save_optimizer_states("%s-%04d.states" % (prefix, epoch))
+            states = self._optimizer_states_bytes()
+        arg_params, aux_params = self.get_params()
+        mgr.save_checkpoint(epoch, symbol=self._symbol,
+                            arg_params=arg_params, aux_params=aux_params,
+                            optimizer_states=states)
 
-    def save_optimizer_states(self, fname):
-        """Serialize optimizer state in the legacy per-index Updater
-        format — fused-trained state is exported into the Updater first,
-        so the file is identical whichever path trained it."""
+    def _optimizer_states_bytes(self):
+        """Optimizer state serialized in the legacy per-index Updater
+        format — fused-trained state is exported into the Updater
+        first, so the bytes are identical whichever path trained it."""
         assert self.optimizer_initialized
         if self._updater is not None:
             self._sync_fused_to_updater()
-            with open(fname, "wb") as f:
-                f.write(self._updater.get_states())
-        elif self._kvstore is not None and self._update_on_kvstore:
-            # updater state lives in the kvstore (reference:
-            # module.py save_optimizer_states via kvstore)
-            self._kvstore.save_optimizer_states(fname)
+            return self._updater.get_states()
+        if self._kvstore is not None and self._update_on_kvstore:
+            return self._kvstore.get_optimizer_states()
+        return None
+
+    def save_optimizer_states(self, fname):
+        """Serialize optimizer state (atomic write; legacy Updater
+        format — see :meth:`_optimizer_states_bytes`)."""
+        from ..resilience.checkpoint import atomic_write
+        states = self._optimizer_states_bytes()
+        if states is not None:
+            atomic_write(fname, states)
 
     def load_optimizer_states(self, fname):
         """Load optimizer state saved by :meth:`save_optimizer_states`;
@@ -573,6 +596,131 @@ class Module(BaseModule):
         else:
             group.reduce_grads()
 
+    # -- non-finite guard (resilience subsystem) ---------------------------
+    def set_nonfinite_guard(self, enabled=True, max_consecutive=None,
+                            action="raise", checkpoint_manager=None):
+        """Configure the NaN/Inf divergence guard for this module's
+        training steps.
+
+        When enabled, a step whose loss/gradients contain non-finite
+        values is SKIPPED: weights and optimizer state pass through
+        bit-identical.  On the fused path the check is one in-graph
+        ``isfinite`` reduction compiled into the same single XLA
+        program (plus one scalar device→host read per step for the
+        counter); the legacy/fallback path mirrors it host-side.
+
+        *max_consecutive* bad steps in a row trigger the divergence
+        *action*: ``"raise"`` (:class:`~mxnet_tpu.resilience.
+        DivergenceError`), ``"rollback"`` (restore the newest intact
+        checkpoint from *checkpoint_manager* — params and optimizer
+        state), or any callable taking this module.  ``None`` means
+        the ``MXNET_GUARD_MAX_BAD_STEPS`` env default (0 = skip and
+        count only).  Explicit configuration overrides the
+        ``MXNET_GUARD_NONFINITE`` env knob in both directions."""
+        if enabled:
+            if max_consecutive is None:
+                from ..config import get_env
+                max_consecutive = get_env("MXNET_GUARD_MAX_BAD_STEPS")
+            self._guard = {"enabled": True,
+                           "max_consecutive": max_consecutive or 0,
+                           "action": action,
+                           "manager": checkpoint_manager}
+        else:
+            self._guard = {"enabled": False}
+        self._guard_consec = 0
+        # the guard is compiled into the fused program — rebuild lazily
+        self._fused = None
+        return self
+
+    @property
+    def nonfinite_skipped(self):
+        """Total training steps the guard skipped for non-finite
+        loss/gradients."""
+        return self._guard_skipped
+
+    def _guard_cfg(self):
+        """Active guard config dict, or None when the guard is off
+        (explicit set_nonfinite_guard wins over the env knobs)."""
+        if self._guard is not None:
+            return self._guard if self._guard["enabled"] else None
+        from ..config import get_env
+        if get_env("MXNET_GUARD_NONFINITE"):
+            return {"enabled": True,
+                    "max_consecutive": get_env("MXNET_GUARD_MAX_BAD_STEPS"),
+                    "action": "raise", "manager": None}
+        return None
+
+    def _grads_nonfinite(self):
+        """Host-side guard check for the legacy path: any NaN/Inf in
+        any device's reduced-to-be gradients or outputs."""
+        import jax.numpy as jnp
+
+        def _bad(arr):
+            data = getattr(arr, "_data", None)
+            return (data is not None
+                    and jnp.issubdtype(data.dtype, jnp.inexact)
+                    and bool(jnp.logical_not(
+                        jnp.all(jnp.isfinite(data)))))
+
+        group = self._exec_group
+        for ex in group.execs:
+            for name in group.param_names:
+                if group.grad_req[name] == "null":
+                    continue
+                if _bad(ex.grad_dict.get(name)):
+                    return True
+            for out in ex.outputs:
+                if _bad(out):
+                    return True
+        return False
+
+    def _note_guard(self, skipped, guard):
+        """Account one guarded step; fire the divergence action after
+        max_consecutive bad steps in a row."""
+        if not skipped:
+            self._guard_consec = 0
+            return
+        from .. import profiler as _prof
+        self._guard_skipped += 1
+        self._guard_consec += 1
+        _prof.bump_counter("guard_skipped_steps")
+        self.logger.warning(
+            "non-finite loss/gradients: optimizer update skipped "
+            "(%d consecutive, %d total)", self._guard_consec,
+            self._guard_skipped)
+        limit = guard.get("max_consecutive") or 0
+        if limit and self._guard_consec >= limit:
+            self._guard_consec = 0
+            self._on_divergence(guard)
+
+    def _on_divergence(self, guard):
+        from ..resilience import DivergenceError
+        action = guard.get("action", "raise")
+        if callable(action):
+            action(self)
+            return
+        if action == "rollback":
+            mgr = guard.get("manager")
+            rec = mgr.restore_latest() if mgr is not None else None
+            if rec is None:
+                raise DivergenceError(
+                    "training diverged (%d consecutive non-finite "
+                    "steps) and no intact checkpoint is available to "
+                    "roll back to" % (guard.get("max_consecutive") or 0))
+            _, arg_params, aux_params = rec.load()
+            self.set_params(arg_params, aux_params)
+            if rec.states_path is not None and self.optimizer_initialized:
+                self.load_optimizer_states(rec.states_path)
+            self.logger.warning(
+                "training diverged: rolled back to checkpoint epoch %d "
+                "(%s)", rec.epoch, rec.params_path)
+            return
+        raise DivergenceError(
+            "training diverged: %d consecutive steps had non-finite "
+            "loss/gradients (%d skipped in total); lower the learning "
+            "rate, enable rollback, or inspect the data pipeline"
+            % (guard.get("max_consecutive") or 0, self._guard_skipped))
+
     # -- fused train step --------------------------------------------------
     def forward_backward_update(self, data_batch):
         """One training step.  When eligible (no kvstore or a local
@@ -604,15 +752,17 @@ class Module(BaseModule):
         """
         assert self.binded and self.params_initialized and \
             self.optimizer_initialized
+        from ..resilience import chaos
+        data_batch = chaos.maybe_poison_batch(data_batch, self._step_seq)
+        self._step_seq += 1
+        guard = self._guard_cfg()
         if not self._fused_ok():
-            self.forward_backward(data_batch)
-            self.update()
+            self._legacy_step(data_batch, guard)
             return
         if self._fused is None:
             self._setup_fused()
         if self._fused is False:
-            self.forward_backward(data_batch)
-            self.update()
+            self._legacy_step(data_batch, guard)
             return
         from ..optimizer import tree_opt
         if self._fused["hyper"] != tree_opt.hyper_sig(self._optimizer):
@@ -622,12 +772,46 @@ class Module(BaseModule):
             # stale constant (the state tree stays valid)
             self._fused = None
             self._setup_fused()
+        if self._fused["guard"] != (guard is not None):
+            # guard toggled mid-run (set_nonfinite_guard or the env
+            # knob): the guard is compiled into the program
+            self._fused = None
+            self._setup_fused()
         if self._fused_state is None:
             self._import_fused_state()
         if self._fused["mode"] == "full":
             self._run_fused_full(data_batch)
         else:
             self._run_fused_partial(data_batch)
+
+    def _legacy_step(self, data_batch, guard):
+        """forward_backward + update, with the host-side mirror of the
+        in-graph guard when one is configured (the composed path keeps
+        subclass overrides live, so the check must stay outside)."""
+        aux_snap = self._snapshot_aux() if guard is not None else None
+        self.forward_backward(data_batch)
+        if guard is not None and self._grads_nonfinite():
+            # forward already rebound aux (BatchNorm running stats) to
+            # NaN-poisoned arrays — restore the pre-step handles so the
+            # skip really is a no-op, matching the fused path
+            self._restore_aux(aux_snap)
+            self._note_guard(1, guard)   # update skipped entirely
+            return
+        self.update()
+        if guard is not None:
+            self._note_guard(0, guard)
+
+    def _snapshot_aux(self):
+        """Pre-step aux array handles, per exec.  jax arrays are
+        immutable and aux updates REBIND ``_data``, so this is
+        reference capture — no copy."""
+        return [{n: a._data for n, a in ex.aux_dict.items()}
+                for ex in self._exec_group.execs]
+
+    def _restore_aux(self, snapshot):
+        for ex, snap in zip(self._exec_group.execs, snapshot):
+            for n, data in snap.items():
+                ex.aux_dict[n]._data = data
 
     def _fused_ok(self):
         from ..config import get_env
@@ -674,21 +858,25 @@ class Module(BaseModule):
         # updater indices are positions in param_names (see update())
         idx_of = {n: i for i, n in enumerate(group.param_names)}
         tree_update = tree_opt.make_tree_update(self._optimizer)
-        ctx = {"names": names, "idx": idx_of,
+        guard = self._guard_cfg() is not None
+        ctx = {"names": names, "idx": idx_of, "guard": guard,
                "hyper": tree_opt.hyper_sig(self._optimizer)}
         if len(group.execs) == 1 and self._kvstore is None and \
                 ex0._train_step_fn is not None:
             ctx["mode"] = "full"
-            ctx["fn"] = ex0.init_fused_step(tree_update)
+            ctx["fn"] = ex0.init_fused_step(tree_update,
+                                            guard_nonfinite=guard)
         else:
             import jax
             from .. import profiler as _prof
+            inner = tree_opt.guarded_tree_update(tree_update) if guard \
+                else tree_update
 
             def tree_apply(grads, params, state, lrs, wds, ts):
                 # trace-time only: the compile counter for this program
                 _prof.bump_counter(  # graftlint: disable=JG003
                     "tree_apply_compiles")  # trace-time-only on purpose
-                return tree_update(grads, params, state, lrs, wds, ts)
+                return inner(grads, params, state, lrs, wds, ts)
 
             from ..ops.registry import supports_donation
             # donate params + optimizer state (argnums 1 and 2)
@@ -761,9 +949,13 @@ class Module(BaseModule):
         # advances every step — num_update only ratchets via max() and
         # can stall when the optimizer is shared with a module trained
         # further, which would replay the same dropout masks
-        outs, new_aux, new_params, new_state = ctx["fn"](
+        res = ctx["fn"](
             params, rest, ex._aux_map(), ex._key, self._fused_state,
             lrs, wds, ts, max(ts.values()))
+        if ctx["guard"]:
+            outs, new_aux, new_params, new_state, skipped = res
+        else:
+            outs, new_aux, new_params, new_state = res
         _prof.bump_counter("fused_step_dispatches")
         self._fused_state = new_state
         # rebind the bind-time containers in place: every alias (shared
@@ -775,6 +967,10 @@ class Module(BaseModule):
             ex.aux_dict[n]._data = v
         ex.outputs = [_wrap_out(o) for o in outs]
         self._params_dirty = True
+        if ctx["guard"]:
+            # one scalar device->host read per step — the price of a
+            # host-visible skip counter (see docs/resilience.md)
+            self._note_guard(int(skipped), self._guard_cfg())
 
     def _run_fused_partial(self, data_batch):
         from ..optimizer import tree_opt
@@ -784,6 +980,7 @@ class Module(BaseModule):
         group = self._exec_group
         ex0 = group.execs[0]
         names = ctx["names"]
+        aux_snap = self._snapshot_aux() if ctx["guard"] else None
         group.forward_backward(data_batch)
         # the jitted tree update donates ex0's param buffers — a stale
         # forward(is_train=True) snapshot must not outlive them (same
@@ -800,14 +997,24 @@ class Module(BaseModule):
         params = {n: ex0.arg_dict[n]._data for n in names}
         ts, lrs, wds = tree_opt.host_hyper(self._optimizer, names,
                                            ctx["idx"])
-        new_params, new_state = ctx["fn"](
-            grads, params, self._fused_state, lrs, wds, ts)
+        res = ctx["fn"](grads, params, self._fused_state, lrs, wds, ts)
+        if ctx["guard"]:
+            new_params, new_state, skipped = res
+        else:
+            new_params, new_state = res
         _prof.bump_counter("tree_apply_dispatches")
         self._fused_state = new_state
         for n in names:
             ex0.arg_dict[n]._data = new_params[n]
         group.broadcast_params()
         self._params_dirty = True
+        if ctx["guard"]:
+            skipped = int(skipped)
+            if skipped:
+                # the per-device forward_backward already rebound aux
+                # (BatchNorm stats) to this bad step's values — restore
+                self._restore_aux(aux_snap)
+            self._note_guard(skipped, self._guard_cfg())
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized

@@ -10,7 +10,6 @@ raw binary layout is CUDA-era and not reproduced bit-for-bit).
 
 from __future__ import annotations
 
-import os
 import numpy as _np
 
 from .ndarray import NDArray, array
@@ -58,13 +57,14 @@ _NPZ_DTYPES = {"float16", "float32", "float64", "int8", "int16", "int32",
 
 
 def save(fname, data):
-    """Save NDArrays to file (reference: mx.nd.save)."""
+    """Save NDArrays to file (reference: mx.nd.save).  Routed through
+    the resilience atomic writer (tmp + fsync + rename), so a crash
+    mid-save never leaves a torn file at *fname* — streamed, so peak
+    memory stays ~one array, not the whole serialized archive."""
+    from ..resilience.checkpoint import atomic_write_stream
     entries = _flatten_for_save(data)
     entries["__magic__"] = _np.array(_MAGIC)
-    tmp = fname + ".tmp.npz"
-    with open(tmp, "wb") as f:
-        _np.savez(f, **entries)
-    os.replace(tmp, fname)
+    atomic_write_stream(fname, lambda f: _np.savez(f, **entries))
 
 
 def save_bytes(data):

@@ -41,7 +41,8 @@ from . import optimizer as _opt
 __all__ = ["supports_fused", "host_hyper", "hyper_sig",
            "init_tree_state", "tree_update", "make_tree_update",
            "to_device_tree", "tree_to_nd", "export_to_updater",
-           "import_from_updater"]
+           "import_from_updater", "nonfinite_any", "select_tree",
+           "guarded_tree_update"]
 
 # every hyper-param any builder bakes into the compiled program as a
 # Python constant (lr/wd/t are NOT here — they enter as traced
@@ -333,6 +334,56 @@ def make_tree_update(optimizer):
         return new_p, new_s
 
     return tree_update_fn
+
+
+# -- non-finite guard (resilience subsystem) --------------------------------
+# One in-graph isfinite reduction over the loss+grad tree decides
+# whether the optimizer update applies; on a bad step the params and
+# state pass through BIT-IDENTICAL (jnp.where with a scalar predicate
+# is a bitwise select).  Everything stays inside the enclosing jit —
+# no extra dispatch, no recompile (the predicate is a traced value).
+
+
+def nonfinite_any(tree):
+    """Scalar bool: True when any inexact-dtype leaf of *tree* holds a
+    NaN/Inf.  Integer leaves (rsp row ids, counters) are finite by
+    construction and skipped; non-array leaves are ignored.  XLA fuses
+    the per-leaf reductions into the surrounding program."""
+    import jax
+    bad = jnp.asarray(False)
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if hasattr(leaf, "dtype") and \
+                jnp.issubdtype(leaf.dtype, jnp.inexact):
+            bad = jnp.logical_or(
+                bad, jnp.logical_not(jnp.all(jnp.isfinite(leaf))))
+    return bad
+
+
+def select_tree(pred, if_true, if_false):
+    """Per-leaf ``where(pred, t, f)`` over two same-structure trees
+    (None subtrees pass through).  With a False predicate the result
+    is bit-identical *if_false*, with True bit-identical *if_true* —
+    which is what lets a skipped step leave weights and optimizer
+    state untouched down to the last bit."""
+    import jax
+    return jax.tree_util.tree_map(
+        lambda t, f: jnp.where(pred, t, f), if_true, if_false)
+
+
+def guarded_tree_update(tree_update_fn):
+    """Wrap a tree-update sweep with the non-finite guard: returns
+    ``fn(grads, params, state, lrs, wds, ts) -> (new_params,
+    new_state, skipped)`` where *skipped* is an int32 0/1.  On a bad
+    step params/state pass through bit-identical."""
+
+    def guarded(grads, params, state, lrs, wds, ts):
+        bad = nonfinite_any(grads)
+        new_p, new_s = tree_update_fn(grads, params, state, lrs, wds, ts)
+        new_p = select_tree(bad, params, new_p)
+        new_s = select_tree(bad, state, new_s)
+        return new_p, new_s, bad.astype(jnp.int32)
+
+    return guarded
 
 
 def tree_update(optimizer, step, grads, params, state, lrs=None,

@@ -1,0 +1,215 @@
+"""Deterministic fault-injection harness (chaos engineering for the
+run side — the TPU-era mirror of the reference's ps-lite dead-node
+drills, which exercised server replication by killing processes).
+
+Injection points are consulted by the production code itself
+(checkpoint writer, fused train step, fit loop), so a chaos-enabled
+test run drives the EXACT recovery paths a preempted TPU job takes —
+no mocks of the code under test.  Everything is counter-based and
+deterministic: no randomness, no sleeps.
+
+Activation: programmatic :func:`configure` wins; otherwise the
+``MXNET_CHAOS`` env knob supplies a spec string such as
+``"fail_file_writes=2,nan_grads_at_step=3,preempt_at_batch=5"``
+(bare ``on``/``1`` enables the harness with no injections armed).
+
+Spec keys (all integers):
+
+``fail_file_writes=N``
+    The next N atomic file writes raise ``OSError`` before touching
+    disk (transient-storage failure; exercises retry/backoff).
+``kill_mid_save=N``
+    The next N atomic writes crash AFTER the tmp file is written but
+    BEFORE ``os.replace`` — a preemption mid-checkpoint.  Raises
+    :class:`SimulatedCrash` (a ``BaseException``, so ordinary
+    ``except Exception`` recovery code cannot accidentally survive
+    it, same as a real SIGKILL; the tmp file is left behind exactly
+    like a real kill would).
+``kill_before_commit=N``
+    Crash after a checkpoint's data files are durably written but
+    before the manifest commit — the classic torn-metadata window.
+``corrupt_checkpoint_bytes=N``
+    The next N non-manifest checkpoint files get their leading bytes
+    flipped on disk AFTER the atomic replace (bit rot / torn storage
+    under a manifest that still records the intended checksum).
+``nan_grads_at_step=K``
+    The K-th ``forward_backward_update`` call (0-based, per module)
+    has its input batch poisoned with NaN so loss and every gradient
+    go non-finite — exercises the in-graph guard.
+``preempt_at_batch=N``
+    ``preemption_requested()`` turns true once the fit loop has
+    ticked N batch boundaries.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+__all__ = ["SimulatedCrash", "configure", "reset", "active", "enabled",
+           "on_file_write", "on_pre_replace", "on_commit",
+           "on_post_replace", "maybe_poison_batch", "tick", "counter",
+           "preemption_requested"]
+
+log = logging.getLogger(__name__)
+
+
+class SimulatedCrash(BaseException):
+    """An injected hard kill.  Subclasses ``BaseException`` on purpose:
+    recovery code written as ``except Exception`` must not be able to
+    'survive' a crash the way it never could survive SIGKILL."""
+
+
+_lock = threading.Lock()
+_spec = None        # programmatic spec (dict) — None = env-driven
+_used = {}          # injection key -> how many times it already fired
+_ticks = {}         # named event counters (fit batch boundaries, ...)
+
+
+def _parse_spec(raw):
+    spec = {}
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, _, val = part.partition("=")
+        key = key.strip()
+        if not val:
+            continue
+        try:
+            spec[key] = int(val)
+        except ValueError:
+            raise ValueError(
+                "MXNET_CHAOS: %r is not an integer in %r" % (val, raw))
+    return spec
+
+
+def active():
+    """The active injection spec (programmatic beats env); {} when the
+    harness is idle."""
+    with _lock:
+        if _spec is not None:
+            return dict(_spec)
+    from ..config import get_env
+    raw = get_env("MXNET_CHAOS").strip()
+    if not raw or raw.lower() in ("0", "off", "false"):
+        return {}
+    if raw.lower() in ("1", "on", "true"):
+        return {}
+    return _parse_spec(raw)
+
+
+def enabled():
+    """True when chaos is switched on at all (even with nothing armed)."""
+    with _lock:
+        if _spec is not None:
+            return True
+    from ..config import get_env
+    raw = get_env("MXNET_CHAOS").strip()
+    return bool(raw) and raw.lower() not in ("0", "off", "false")
+
+
+def configure(**spec):
+    """Arm injections programmatically (resets fire/tick counters)."""
+    global _spec
+    with _lock:
+        _spec = {k: int(v) for k, v in spec.items() if v is not None}
+        _used.clear()
+        _ticks.clear()
+
+
+def reset():
+    """Disarm everything and fall back to the env-driven spec."""
+    global _spec
+    with _lock:
+        _spec = None
+        _used.clear()
+        _ticks.clear()
+
+
+def _consume(key):
+    """True (and advance the fire counter) while fires remain for *key*."""
+    budget = active().get(key, 0)
+    with _lock:
+        fired = _used.get(key, 0)
+        if fired < budget:
+            _used[key] = fired + 1
+            return True
+    return False
+
+
+def fired(key):
+    """How many times injection *key* has fired."""
+    with _lock:
+        return _used.get(key, 0)
+
+
+def tick(name):
+    """Advance (and return) a named event counter."""
+    with _lock:
+        _ticks[name] = _ticks.get(name, 0) + 1
+        return _ticks[name]
+
+
+def counter(name):
+    with _lock:
+        return _ticks.get(name, 0)
+
+
+# -- injection points consulted by production code --------------------------
+
+def on_file_write(path):
+    """Atomic-writer entry: transient write failure."""
+    if _consume("fail_file_writes"):
+        log.warning("chaos: injected write failure for %s", path)
+        raise OSError("chaos: injected transient write failure (%s)" % path)
+
+
+def on_pre_replace(path):
+    """Between tmp-file fsync and ``os.replace``: preemption mid-save."""
+    if _consume("kill_mid_save"):
+        log.warning("chaos: simulated crash before os.replace of %s", path)
+        raise SimulatedCrash("killed mid-save before replacing %s" % path)
+
+
+def on_commit(path):
+    """Between checkpoint data files and the manifest commit."""
+    if _consume("kill_before_commit"):
+        log.warning("chaos: simulated crash before manifest commit %s",
+                    path)
+        raise SimulatedCrash("killed before manifest commit of %s" % path)
+
+
+def on_post_replace(path):
+    """After the atomic replace: flip bytes on disk (bit rot / torn
+    storage) — manifest checksums must catch this at restore time."""
+    if path.endswith(".manifest.json"):
+        return
+    if _consume("corrupt_checkpoint_bytes"):
+        log.warning("chaos: corrupting on-disk bytes of %s", path)
+        with open(path, "r+b") as f:
+            head = f.read(16)
+            f.seek(0)
+            f.write(bytes(b ^ 0xFF for b in head))
+            f.flush()
+
+
+def maybe_poison_batch(batch, step):
+    """``nan_grads_at_step=K``: return a NaN-poisoned copy of *batch*
+    when *step* == K (the caller's own batch object is not mutated)."""
+    k = active().get("nan_grads_at_step")
+    if k is None or step != k:
+        return batch
+    import copy
+    log.warning("chaos: poisoning batch at step %d with NaN", step)
+    poisoned = copy.copy(batch)
+    poisoned.data = [d * float("nan") for d in batch.data]
+    return poisoned
+
+
+def preemption_requested():
+    """True once the fit loop ticked ``preempt_at_batch`` boundaries."""
+    n = active().get("preempt_at_batch")
+    if n is None:
+        return False
+    return counter("fit_batch") >= n

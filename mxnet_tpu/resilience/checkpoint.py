@@ -1,0 +1,448 @@
+"""Crash-safe checkpointing: atomic writes + a checksum manifest.
+
+The reference framework could rely on ps-lite server replication and a
+C++ engine that was never half-killed mid-write; a preemptible TPU job
+has neither, so every persisted artifact here follows one rule: **a
+path either holds the complete old bytes or the complete new bytes,
+never a mixture**, and the manifest — itself written atomically, and
+always LAST — is the single commit point.  A kill at any instruction
+leaves the previous checkpoint fully restorable.
+
+Layout::
+
+    <prefix>-NNNN-symbol.json     graph, per epoch (manifest-tracked)
+    <prefix>-NNNN.params          tensors  (``arg:<n>`` / ``aux:<n>``)
+    <prefix>-NNNN.states          optimizer state (legacy Updater bytes)
+    <prefix>.manifest.json        commit ledger (written last)
+    <prefix>-symbol.json          convenience copy at the reference's
+                                  legacy name (NOT manifest-tracked)
+
+Every manifest entry references only its OWN files — a shared symbol
+file would let epoch N's save invalidate epoch N-1's checksums in the
+crash window before the commit.  The legacy ``<prefix>-symbol.json``
+name the reference's loaders expect is maintained as a last-write-wins
+convenience copy outside the integrity guarantee;
+``CheckpointRecord.load`` always reads the verified per-epoch file.
+
+Manifest format (version 1)::
+
+    {"version": 1,
+     "checkpoints": [
+       {"epoch": 3,
+        "files": {"run-0003.params": {"sha256": "...", "size": 1234},
+                  "run-symbol.json": {"sha256": "...", "size": 567}}},
+       ...newest last...
+     ]}
+
+Checksums are computed over the exact in-memory bytes handed to the
+atomic writer, so any later divergence on disk (torn write, bit rot,
+truncation) is detected by :meth:`CheckpointManager.restore_latest`,
+which walks newest→oldest and returns the first fully-intact entry.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import json
+import logging
+import os
+import threading
+
+from . import chaos
+
+__all__ = ["atomic_write", "atomic_write_stream", "fsync_dir",
+           "CheckpointManager", "CheckpointRecord", "MANIFEST_VERSION"]
+
+log = logging.getLogger(__name__)
+
+MANIFEST_VERSION = 1
+
+
+def fsync_dir(dirname):
+    """Best-effort fsync of a directory so a rename survives power
+    loss (no-op on platforms without directory fds)."""
+    try:
+        fd = os.open(dirname or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write(path, data, fsync=True):
+    """Write *data* (bytes) to *path* atomically: tmp file in the same
+    directory + flush + fsync + ``os.replace`` + directory fsync.  A
+    crash at ANY point leaves either the old complete file or the new
+    complete file at *path* — never a torn mixture (a stale ``.tmp.*``
+    sibling at worst, which the next write of the same path replaces).
+    """
+    if not isinstance(data, (bytes, bytearray, memoryview)):
+        raise TypeError("atomic_write expects bytes, got %s"
+                        % type(data).__name__)
+    chaos.on_file_write(path)
+    # pid + per-process sequence: concurrent writers of the SAME path
+    # (background checkpoint thread vs a foreground save) must never
+    # share a tmp file, or the replace could promote interleaved bytes
+    tmp = "%s.tmp.%d.%d" % (path, os.getpid(), next(_TMP_SEQ))
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            if fsync:
+                os.fsync(f.fileno())
+        chaos.on_pre_replace(path)
+        os.replace(tmp, path)
+    except Exception:
+        # transient failure (not a simulated kill, which subclasses
+        # BaseException and must leave the tmp behind like a real one):
+        # don't litter the directory
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    if fsync:
+        fsync_dir(os.path.dirname(path))
+    chaos.on_post_replace(path)
+
+
+def atomic_write_stream(path, writer, fsync=True):
+    """Like :func:`atomic_write`, but *writer(fileobj)* streams the
+    payload into the tmp file — for serializers (``np.savez``) whose
+    output would otherwise have to be materialized in memory first.
+    Same crash guarantee, same chaos injection points."""
+    chaos.on_file_write(path)
+    tmp = "%s.tmp.%d.%d" % (path, os.getpid(), next(_TMP_SEQ))
+    try:
+        with open(tmp, "wb") as f:
+            writer(f)
+            f.flush()
+            if fsync:
+                os.fsync(f.fileno())
+        chaos.on_pre_replace(path)
+        os.replace(tmp, path)
+    except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    if fsync:
+        fsync_dir(os.path.dirname(path))
+    chaos.on_post_replace(path)
+
+
+_TMP_SEQ = itertools.count()
+
+# one commit lock per manifest path, shared across CheckpointManager
+# instances in this process: two managers on the same prefix must not
+# interleave their manifest read-modify-write (cross-PROCESS writers
+# are out of scope — run one trainer per prefix)
+_COMMIT_LOCKS = {}
+_COMMIT_LOCKS_GUARD = threading.Lock()
+
+
+def _commit_lock(manifest_path):
+    key = os.path.abspath(manifest_path)
+    with _COMMIT_LOCKS_GUARD:
+        lock = _COMMIT_LOCKS.get(key)
+        if lock is None:
+            lock = _COMMIT_LOCKS[key] = threading.Lock()
+        return lock
+
+
+def _sha256(data):
+    return hashlib.sha256(data).hexdigest()
+
+
+class CheckpointRecord:
+    """One intact checkpoint as returned by
+    :meth:`CheckpointManager.restore_latest` — verified paths plus a
+    loader."""
+
+    __slots__ = ("epoch", "dirname", "files")
+
+    def __init__(self, epoch, dirname, files):
+        self.epoch = epoch
+        self.dirname = dirname
+        self.files = dict(files)        # basename -> verified abs path
+
+    def _path_with_suffix(self, suffix):
+        for name, path in self.files.items():
+            if name.endswith(suffix):
+                return path
+        return None
+
+    @property
+    def symbol_path(self):
+        return self._path_with_suffix("-symbol.json")
+
+    @property
+    def params_path(self):
+        return self._path_with_suffix(".params")
+
+    @property
+    def states_path(self):
+        return self._path_with_suffix(".states")
+
+    def load(self):
+        """Deserialize to ``(symbol_or_None, arg_params, aux_params)``
+        — same split as ``model.load_checkpoint``."""
+        from ..ndarray import utils as nd_utils
+        symbol = None
+        if self.symbol_path is not None:
+            from .. import symbol as sym_mod
+            symbol = sym_mod.load(self.symbol_path)
+        from ..model import _split_save_dict
+        arg_params, aux_params = _split_save_dict(
+            nd_utils.load(self.params_path), context="checkpoint %r"
+            % self.params_path)
+        return symbol, arg_params, aux_params
+
+    def __repr__(self):
+        return "CheckpointRecord(epoch=%d, files=%s)" % (
+            self.epoch, sorted(self.files))
+
+
+class CheckpointManager:
+    """Crash-safe checkpoint store for one ``prefix``.
+
+    * every file goes through :func:`atomic_write`;
+    * the manifest is updated last (the commit point) and carries
+      per-file sha256 + size;
+    * ``keep_last=K`` rotates old epochs out, deleting files no
+      remaining entry references (the shared symbol file survives);
+    * ``background=True`` (or per-call) serializes synchronously —
+      the caller may mutate parameters right after — and performs the
+      writes + commit on a daemon thread; :meth:`wait` joins and
+      re-raises any background failure.
+    """
+
+    def __init__(self, prefix, keep_last=None, background=False,
+                 logger=None):
+        self.prefix = prefix
+        if keep_last is None:
+            from ..config import get_env
+            keep_last = get_env("MXNET_CHECKPOINT_KEEP_LAST")
+        self.keep_last = int(keep_last or 0)       # 0 = keep everything
+        self.background = background
+        self.logger = logger or log
+        # write+commit section — shared per manifest path across
+        # manager instances in this process
+        self._lock = _commit_lock(prefix + ".manifest.json")
+        self._pending = []                         # background threads
+        self._bg_error = None
+
+    @property
+    def manifest_path(self):
+        return self.prefix + ".manifest.json"
+
+    @property
+    def dirname(self):
+        return os.path.dirname(os.path.abspath(self.prefix))
+
+    @property
+    def basename(self):
+        return os.path.basename(self.prefix)
+
+    # -- manifest ----------------------------------------------------------
+    def _read_manifest(self):
+        path = self.manifest_path
+        if not os.path.exists(path):
+            return {"version": MANIFEST_VERSION, "checkpoints": []}
+        try:
+            with open(path, encoding="utf-8") as f:
+                man = json.load(f)
+        except (OSError, ValueError) as exc:
+            # the manifest is written atomically, so a torn one means
+            # external meddling — treat as empty but say so
+            self.logger.warning(
+                "checkpoint manifest %s is unreadable (%s); treating as "
+                "empty", path, exc)
+            return {"version": MANIFEST_VERSION, "checkpoints": []}
+        man.setdefault("checkpoints", [])
+        return man
+
+    def epochs(self):
+        """Committed epochs, oldest first (no integrity check)."""
+        return [e["epoch"] for e in self._read_manifest()["checkpoints"]]
+
+    # -- saving ------------------------------------------------------------
+    def save_checkpoint(self, epoch, symbol=None, arg_params=None,
+                        aux_params=None, optimizer_states=None,
+                        background=None):
+        """Persist one checkpoint.  Serialization happens before this
+        returns (the caller may keep training and mutating parameters);
+        with *background*, the disk writes + manifest commit run on a
+        daemon thread."""
+        self._raise_pending()
+        from ..ndarray import utils as nd_utils
+        files = {}
+        if symbol is not None:
+            # per-epoch symbol file: every manifest entry stays
+            # self-contained (see module docstring)
+            files["%s-%04d-symbol.json" % (self.basename, epoch)] = \
+                symbol.tojson().encode("utf-8")
+        save_dict = {("arg:%s" % k): v
+                     for k, v in (arg_params or {}).items()}
+        save_dict.update({("aux:%s" % k): v
+                          for k, v in (aux_params or {}).items()})
+        files["%s-%04d.params" % (self.basename, epoch)] = \
+            nd_utils.save_bytes(save_dict)
+        if optimizer_states is not None:
+            files["%s-%04d.states" % (self.basename, epoch)] = \
+                bytes(optimizer_states)
+        entry = {"epoch": int(epoch),
+                 "files": {name: {"sha256": _sha256(data),
+                                  "size": len(data)}
+                           for name, data in files.items()}}
+        if background is None:
+            background = self.background
+        if background:
+            self._pending = [t for t in self._pending if t.is_alive()]
+            t = threading.Thread(target=self._write_and_commit_guarded,
+                                 args=(files, entry), daemon=True)
+            self._pending.append(t)
+            t.start()
+        else:
+            self._write_and_commit(files, entry)
+        return entry
+
+    def save_module(self, module, epoch, save_optimizer_states=True,
+                    background=None):
+        """Checkpoint a bound Module (params + aux + optimizer state
+        when available) through this manager."""
+        arg_params, aux_params = module.get_params()
+        states = None
+        if save_optimizer_states and \
+                getattr(module, "optimizer_initialized", False):
+            get_bytes = getattr(module, "_optimizer_states_bytes", None)
+            if get_bytes is not None:
+                states = get_bytes()
+        return self.save_checkpoint(
+            epoch, symbol=getattr(module, "symbol", None),
+            arg_params=arg_params, aux_params=aux_params,
+            optimizer_states=states, background=background)
+
+    def _write_and_commit_guarded(self, files, entry):
+        try:
+            self._write_and_commit(files, entry)
+        except Exception as exc:
+            self.logger.error("background checkpoint save failed: %s", exc)
+            self._bg_error = exc
+
+    def _write_and_commit(self, files, entry):
+        dirname = self.dirname
+        if dirname:
+            os.makedirs(dirname, exist_ok=True)
+        with self._lock:
+            for name, data in sorted(files.items()):
+                atomic_write(os.path.join(dirname, name), data)
+            # the commit point: only a manifest entry makes the files
+            # above part of the checkpoint history
+            chaos.on_commit(self.manifest_path)
+            man = self._read_manifest()
+            entries = [e for e in man["checkpoints"]
+                       if e["epoch"] != entry["epoch"]]
+            entries.append(entry)
+            entries.sort(key=lambda e: e["epoch"])
+            dropped = []
+            if self.keep_last > 0 and len(entries) > self.keep_last:
+                dropped = entries[:-self.keep_last]
+                entries = entries[-self.keep_last:]
+            man["version"] = MANIFEST_VERSION
+            man["checkpoints"] = entries
+            atomic_write(self.manifest_path,
+                         (json.dumps(man, indent=1, sort_keys=True)
+                          + "\n").encode("utf-8"))
+            self._delete_orphans(dropped, entries)
+            # after the commit, refresh the legacy-named convenience
+            # copy (outside the integrity guarantee — the reference's
+            # loaders expect `<prefix>-symbol.json`)
+            for name, data in files.items():
+                if name.endswith("-symbol.json"):
+                    atomic_write("%s-symbol.json" % self.prefix, data)
+
+    def _delete_orphans(self, dropped, kept):
+        still_referenced = set()
+        for e in kept:
+            still_referenced.update(e["files"])
+        for e in dropped:
+            for name in e["files"]:
+                if name in still_referenced:
+                    continue
+                try:
+                    os.unlink(os.path.join(self.dirname, name))
+                except OSError:
+                    pass
+
+    def wait(self):
+        """Join outstanding background saves; re-raise the first
+        background failure."""
+        pending, self._pending = self._pending, []
+        for t in pending:
+            t.join()
+        self._raise_pending()
+
+    def _raise_pending(self):
+        if self._bg_error is not None:
+            exc, self._bg_error = self._bg_error, None
+            raise exc
+
+    # -- restore -----------------------------------------------------------
+    def _verify_entry(self, entry):
+        """'' when intact, else a human-readable reason.  Hashes in
+        1 MiB chunks — multi-GB params files must not be slurped into
+        one allocation just to be verified."""
+        for name, meta in entry["files"].items():
+            path = os.path.join(self.dirname, name)
+            digest = hashlib.sha256()
+            size = 0
+            try:
+                with open(path, "rb") as f:
+                    while True:
+                        chunk = f.read(1 << 20)
+                        if not chunk:
+                            break
+                        digest.update(chunk)
+                        size += len(chunk)
+            except OSError as exc:
+                return "%s unreadable (%s)" % (name, exc)
+            if size != meta["size"]:
+                return "%s truncated (%d bytes, manifest says %d)" % (
+                    name, size, meta["size"])
+            if digest.hexdigest() != meta["sha256"]:
+                return "%s checksum mismatch" % name
+        return ""
+
+    def verify(self, epoch):
+        """True/False for a committed epoch; None when the manifest has
+        no entry for it (legacy checkpoint without a manifest)."""
+        for entry in self._read_manifest()["checkpoints"]:
+            if entry["epoch"] == int(epoch):
+                return not self._verify_entry(entry)
+        return None
+
+    def restore_latest(self):
+        """Newest fully-intact checkpoint (every file present, sized,
+        and checksum-verified) as a :class:`CheckpointRecord`; corrupt
+        or torn entries are skipped with a warning.  None when nothing
+        intact exists."""
+        self.wait()
+        entries = self._read_manifest()["checkpoints"]
+        for entry in reversed(entries):
+            reason = self._verify_entry(entry)
+            if not reason:
+                files = {name: os.path.join(self.dirname, name)
+                         for name in entry["files"]}
+                return CheckpointRecord(entry["epoch"], self.dirname,
+                                        files)
+            self.logger.warning(
+                "checkpoint epoch %d is corrupt (%s); falling back to "
+                "the previous one", entry["epoch"], reason)
+        return None

@@ -1,0 +1,94 @@
+"""Jittered-exponential-backoff retry with a deadline.
+
+The reference framework leaned on ps-lite's resender/heartbeats for
+transient-failure masking; this rebuild's host-side IO paths (weight
+store reads, dataloader worker respawn, prefetch recovery) use this
+one retry policy instead of ad-hoc loops.
+
+Everything time-related is injectable (``sleep``, ``clock``, ``rng``)
+so tests — and the CI chaos drills — run deterministic backoff
+schedules with zero real sleeping.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import random
+import time
+
+__all__ = ["retry", "retry_call"]
+
+log = logging.getLogger(__name__)
+
+
+def backoff_delays(attempts, base_delay, max_delay, multiplier, jitter,
+                   rng):
+    """The delay after attempt i (1-based): capped exponential with
+    multiplicative jitter in ``[1 - jitter, 1]`` — jitter decorrelates
+    a fleet of workers hammering the same recovering resource."""
+    for i in range(1, attempts):
+        delay = min(max_delay, base_delay * multiplier ** (i - 1))
+        if jitter:
+            delay *= 1.0 - jitter * rng.random()
+        yield delay
+
+
+def retry_call(fn, args=(), kwargs=None, *, attempts=5, base_delay=0.05,
+               max_delay=2.0, multiplier=2.0, jitter=0.5, deadline=None,
+               retry_on=(OSError,), give_up_on=(), sleep=time.sleep,
+               clock=time.monotonic, rng=None, logger=None, on_retry=None):
+    """Call ``fn(*args, **kwargs)``, retrying on *retry_on* exceptions.
+
+    *give_up_on* exceptions propagate immediately even when they
+    subclass a *retry_on* type (e.g. ``FileNotFoundError`` under
+    ``OSError``: a missing file is not transient).  *deadline* bounds
+    the TOTAL time budget: a retry whose backoff would overrun it
+    re-raises instead of sleeping.  The last exception always
+    propagates unwrapped — callers keep their except clauses.
+    """
+    kwargs = kwargs or {}
+    rng = rng if rng is not None else random.Random()
+    delays = backoff_delays(attempts, base_delay, max_delay, multiplier,
+                            jitter, rng)
+    lg = logger or log
+    start = clock()
+    attempt = 1
+    while True:
+        try:
+            return fn(*args, **kwargs)
+        except give_up_on:
+            raise
+        except retry_on as exc:
+            if attempt >= attempts:
+                raise
+            delay = next(delays)
+            if deadline is not None and \
+                    (clock() - start) + delay > deadline:
+                lg.debug("retry: deadline %.3fs would be exceeded; "
+                         "giving up after attempt %d (%s)", deadline,
+                         attempt, exc)
+                raise
+            lg.debug("retry: attempt %d/%d failed (%s: %s); backing off "
+                     "%.3fs", attempt, attempts, type(exc).__name__, exc,
+                     delay)
+            if on_retry is not None:
+                on_retry(attempt, exc, delay)
+            sleep(delay)
+            attempt += 1
+
+
+def retry(**cfg):
+    """Decorator form of :func:`retry_call`::
+
+        @retry(attempts=4, retry_on=(OSError,),
+               give_up_on=(FileNotFoundError,))
+        def read_weights(path): ...
+    """
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            return retry_call(fn, args, kwargs, **cfg)
+        wrapper.retry_config = dict(cfg)
+        return wrapper
+    return deco

@@ -442,8 +442,10 @@ class Symbol:
         }, indent=2)
 
     def save(self, fname):
-        with open(fname, "w") as f:
-            f.write(self.tojson())
+        # atomic: a preemption mid-write must not tear the only copy
+        # of a checkpoint's graph (resilience subsystem)
+        from ..resilience.checkpoint import atomic_write
+        atomic_write(fname, self.tojson().encode("utf-8"))
 
     # -- binding -----------------------------------------------------------
     def _maybe_partition(self):

@@ -1,6 +1,6 @@
 """graftlint static analyzer (tools/graftlint).
 
-Covers: a positive and a negative fixture per rule (JG001–JG008),
+Covers: a positive and a negative fixture per rule (JG001–JG009),
 suppression syntax, the baseline workflow, the CLI (exit codes, JSON,
 scrapeable summary line), the guarantee that the shipped mxnet_tpu
 tree is clean, the runtime registry cross-check (every register_op
@@ -379,6 +379,66 @@ def test_jg008_negative(tmp_path):
         def inside(x):
             return jnp.asarray(x)                # runs at call time
         """, rules=["JG008"])
+    assert fs == []
+
+
+def test_jg009_positive(tmp_path):
+    fs = lint(tmp_path, """\
+        import pickle
+
+        def save_checkpoint(prefix, blob, states):
+            with open(prefix + "-0000.params", "wb") as f:
+                f.write(blob)
+            with open(prefix + "-0000.states", "wb") as f:
+                pickle.dump(states, f)
+        """, rules=["JG009"])
+    # two raw open()-for-write + one pickle.dump
+    assert len(fs) == 3 and rule_ids(fs) == ["JG009"] * 3
+    assert "atomic_write" in fs[0].message
+
+
+def test_jg009_positive_np_savez(tmp_path):
+    fs = lint(tmp_path, """\
+        import numpy as np
+
+        def dump_states(path, tree):
+            np.savez(path + ".states", **tree)
+        """, rules=["JG009"])
+    assert len(fs) == 1 and "np.savez" in fs[0].message
+
+
+def test_jg009_negative(tmp_path):
+    fs = lint(tmp_path, """\
+        from mxnet_tpu.resilience.checkpoint import atomic_write
+
+        def save_checkpoint(prefix, blob):
+            # routed through the atomic writer: fine
+            atomic_write(prefix + "-0000.params", blob)
+
+        def write_log(path, lines):
+            # write-mode open, but no checkpoint/state artifact
+            with open(path, "w") as f:
+                f.writelines(lines)
+
+        def load_checkpoint(prefix):
+            # read-mode open of a checkpoint path: fine
+            with open(prefix + "-0000.params", "rb") as f:
+                return f.read()
+
+        def compute_checkpoint_size(prefix):
+            # persistence-flavored strings but no save-ish name
+            return len(prefix + "-0000.params")
+        """, rules=["JG009"])
+    assert fs == []
+
+
+def test_jg009_exempts_the_atomic_writer_itself(tmp_path):
+    fs = lint(tmp_path, """\
+        def atomic_write(path, data):
+            tmp = path + ".tmp"        # the checkpoint writer itself
+            with open(tmp, "wb") as f:
+                f.write(data)
+        """, filename="resilience/checkpoint.py", rules=["JG009"])
     assert fs == []
 
 

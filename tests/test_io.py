@@ -141,6 +141,108 @@ def test_prefetching_iter():
     assert count == 4
 
 
+class _DyingIter(NDArrayIter):
+    """Inner iterator whose worker 'dies' (raises) on one batch of the
+    first epoch, then behaves after reset — the prefetch thread must
+    surface the exception and stay recoverable."""
+
+    def __init__(self, fail_at=2, exc=RuntimeError("worker died"),
+                 **kwargs):
+        self._fail_at = fail_at
+        self._exc = exc
+        self._served = 0
+        self._failed_once = False
+        super().__init__(**kwargs)
+
+    def next(self):
+        if not self._failed_once and self._served == self._fail_at:
+            self._failed_once = True
+            raise self._exc
+        self._served += 1
+        return super().next()
+
+
+def _dying_iter(fail_at=2, exc=None):
+    return _DyingIter(
+        fail_at=fail_at, exc=exc or RuntimeError("worker died"),
+        data=np.arange(40).reshape(20, 2).astype(np.float32),
+        label=np.zeros(20), batch_size=5)
+
+
+def test_prefetch_worker_death_reaches_consumer_then_reset_recovers():
+    """SATELLITE: an in-flight exception in the prefetch producer must
+    reach the consumer — and a subsequent reset() must neither
+    deadlock nor replay stale state."""
+    pre = PrefetchingIter(_dying_iter(fail_at=2))
+    assert pre.next() is not None
+    assert pre.next() is not None
+    with pytest.raises(RuntimeError, match="worker died"):
+        pre.next()
+    # the producer is gone; further next() calls must END the epoch,
+    # not hang on an empty queue forever
+    with pytest.raises(StopIteration):
+        pre.next()
+    pre.reset()           # must return promptly (bounded drain+join)
+    count = 0
+    while True:
+        try:
+            pre.next()
+            count += 1
+        except StopIteration:
+            break
+    assert count == 4     # full epoch after recovery
+    pre.reset()
+    assert pre.iter_next()
+
+
+def test_prefetch_reset_while_producer_blocked_on_full_queue():
+    """reset() with the producer wedged in put() (slow consumer, full
+    queue) must drain it loose and come back — the historical deadlock
+    shape."""
+    inner = NDArrayIter(np.arange(80).reshape(40, 2).astype(np.float32),
+                        np.zeros(40), batch_size=5)
+    pre = PrefetchingIter(inner, prefetch_depth=1)
+    import time
+    time.sleep(0.1)       # let the producer fill the depth-1 queue
+    pre.reset()           # producer is mid-put: must not deadlock
+    batches = []
+    while True:
+        try:
+            batches.append(pre.next())
+        except StopIteration:
+            break
+    assert len(batches) == 8
+
+
+def test_prefetch_exception_during_iteration_then_iter_next_protocol():
+    """iter_next() (peek form) after a producer death reports False
+    instead of raising through the peek path twice."""
+    pre = PrefetchingIter(_dying_iter(fail_at=0))
+    with pytest.raises(RuntimeError, match="worker died"):
+        pre.next()
+    assert pre.iter_next() is False
+    pre.reset()
+    assert pre.iter_next() is True
+
+
+def test_prefetch_retry_spec_recovers_transient_failures():
+    """A retry spec turns transient inner-iterator failures into
+    backoff+retry instead of an epoch-ending exception."""
+    sleeps = []
+    pre = PrefetchingIter(
+        _dying_iter(fail_at=2, exc=OSError("transient storage flake")),
+        retry=dict(attempts=3, retry_on=(OSError,),
+                   sleep=sleeps.append))
+    batches = []
+    while True:
+        try:
+            batches.append(pre.next())
+        except StopIteration:
+            break
+    assert len(batches) == 4          # nothing lost
+    assert len(sleeps) == 1           # exactly one backoff happened
+
+
 def test_recordio(tmp_path):
     from mxnet_tpu import recordio
     path = str(tmp_path / "test.rec")

@@ -1,0 +1,732 @@
+"""Resilience subsystem (mxnet_tpu/resilience): crash-safe
+checkpoints, the in-graph non-finite guard, retry/backoff, and the
+chaos fault-injection harness driving them end-to-end.
+
+The chaos drills here exercise the REAL production paths — the same
+atomic writer, manifest commit, fused-step guard, and fit loop a
+preempted TPU job runs — with deterministic injected faults and an
+injectable backoff clock (no real sleeps)."""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu import profiler as prof
+from mxnet_tpu import resilience
+from mxnet_tpu.io import DataBatch, NDArrayIter
+from mxnet_tpu.resilience import (CheckpointManager, DivergenceError,
+                                  atomic_write, chaos, retry_call)
+from mxnet_tpu.resilience.chaos import SimulatedCrash
+
+
+@pytest.fixture(autouse=True)
+def _clean_harness():
+    """Every test starts with chaos disarmed and no pending
+    preemption; profiler counters reset."""
+    chaos.reset()
+    resilience.clear_preemption()
+    prof.reset_counters()
+    yield
+    chaos.reset()
+    resilience.clear_preemption()
+    prof.reset_counters()
+
+
+# ---------------------------------------------------------------------------
+# model + data helpers (same tiny MLP as test_fused_step)
+# ---------------------------------------------------------------------------
+
+def _mlp():
+    data = sym.var("data")
+    net = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def _batches(rng, n=4, batch=16, dim=8):
+    X = rng.randn(n * batch, dim).astype(np.float32)
+    Y = rng.randint(0, 4, n * batch).astype(np.float32)
+    return [DataBatch(data=[nd.array(X[i * batch:(i + 1) * batch])],
+                      label=[nd.array(Y[i * batch:(i + 1) * batch])])
+            for i in range(n)]
+
+
+def _nan_batch(batch=16, dim=8):
+    return DataBatch(data=[nd.array(np.full((batch, dim), np.nan,
+                                            np.float32))],
+                     label=[nd.array(np.zeros(batch, np.float32))])
+
+
+def _bn_mlp():
+    data = sym.var("data")
+    net = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = sym.BatchNorm(net, name="bn")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+
+def _module(fused=True, contexts=None, opt_params=None, net=_mlp):
+    os.environ["MXNET_MODULE_FUSED_STEP"] = "1" if fused else "0"
+    mod = mx.Module(net(), context=contexts or mx.cpu())
+    mod.bind([("data", (16, 8))], [("softmax_label", (16,))])
+    mod.init_params()
+    mod.init_optimizer(kvstore=None, optimizer="sgd",
+                       optimizer_params=opt_params or
+                       {"learning_rate": 0.1, "momentum": 0.9})
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _restore_fused_env():
+    prev = os.environ.get("MXNET_MODULE_FUSED_STEP")
+    yield
+    if prev is None:
+        os.environ.pop("MXNET_MODULE_FUSED_STEP", None)
+    else:
+        os.environ["MXNET_MODULE_FUSED_STEP"] = prev
+
+
+def _param_bytes(mod):
+    args, auxs = mod.get_params()
+    return {k: v.asnumpy().tobytes() for k, v in {**args, **auxs}.items()}
+
+
+# ---------------------------------------------------------------------------
+# atomic writer
+# ---------------------------------------------------------------------------
+
+def test_atomic_write_roundtrip_and_no_tmp_litter(tmp_path):
+    path = str(tmp_path / "blob.bin")
+    atomic_write(path, b"first")
+    atomic_write(path, b"second")
+    with open(path, "rb") as f:
+        assert f.read() == b"second"
+    assert [p.name for p in tmp_path.iterdir()] == ["blob.bin"]
+
+
+def test_atomic_write_injected_failure_leaves_target_intact(tmp_path):
+    path = str(tmp_path / "blob.bin")
+    atomic_write(path, b"good")
+    chaos.configure(fail_file_writes=1)
+    with pytest.raises(OSError, match="chaos"):
+        atomic_write(path, b"never")
+    with open(path, "rb") as f:
+        assert f.read() == b"good"
+    # the injection budget is spent: a retry goes through — the exact
+    # transient-failure shape the retry decorator exists for
+    retry_call(atomic_write, (path, b"after"), sleep=lambda s: None)
+    with open(path, "rb") as f:
+        assert f.read() == b"after"
+
+
+def test_atomic_write_rejects_non_bytes(tmp_path):
+    with pytest.raises(TypeError):
+        atomic_write(str(tmp_path / "x"), "a string")
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager
+# ---------------------------------------------------------------------------
+
+def _save_epoch(mgr, epoch, seed):
+    rng = np.random.RandomState(seed)
+    args = {"w": nd.array(rng.randn(4, 3).astype(np.float32))}
+    auxs = {"m": nd.array(rng.randn(4).astype(np.float32))}
+    mgr.save_checkpoint(epoch, symbol=_mlp(), arg_params=args,
+                        aux_params=auxs,
+                        optimizer_states=b"states-%d" % epoch)
+    return args, auxs
+
+
+def test_manager_save_restore_roundtrip(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "run"))
+    _save_epoch(mgr, 0, seed=0)
+    args1, auxs1 = _save_epoch(mgr, 1, seed=1)
+    rec = mgr.restore_latest()
+    assert rec.epoch == 1
+    symbol, args, auxs = rec.load()
+    assert symbol is not None
+    np.testing.assert_array_equal(args["w"].asnumpy(),
+                                  args1["w"].asnumpy())
+    np.testing.assert_array_equal(auxs["m"].asnumpy(),
+                                  auxs1["m"].asnumpy())
+    with open(rec.states_path, "rb") as f:
+        assert f.read() == b"states-1"
+    assert mgr.epochs() == [0, 1]
+    assert mgr.verify(0) is True and mgr.verify(1) is True
+    assert mgr.verify(7) is None
+
+
+def test_restore_latest_empty(tmp_path):
+    assert CheckpointManager(str(tmp_path / "none")).restore_latest() \
+        is None
+
+
+def test_kill_mid_save_never_points_at_torn_file(tmp_path):
+    """ACCEPTANCE: a crash during the checkpoint write leaves the
+    manifest pointing at the previous intact checkpoint — verified by
+    checksum — never at a torn file."""
+    mgr = CheckpointManager(str(tmp_path / "run"))
+    args0, _ = _save_epoch(mgr, 0, seed=0)
+    chaos.configure(kill_mid_save=1)
+    with pytest.raises(SimulatedCrash):
+        _save_epoch(mgr, 1, seed=1)
+    chaos.reset()
+    # a real kill leaves the tmp sibling behind; the manifest must not
+    # reference it nor any epoch-1 artifact
+    mgr2 = CheckpointManager(str(tmp_path / "run"))
+    assert mgr2.epochs() == [0]
+    rec = mgr2.restore_latest()
+    assert rec.epoch == 0
+    _, args, _ = rec.load()
+    np.testing.assert_array_equal(args["w"].asnumpy(),
+                                  args0["w"].asnumpy())
+
+
+def test_kill_before_manifest_commit_rolls_back(tmp_path):
+    """Data files fully written, crash before the manifest commit: the
+    files exist on disk but are not part of history."""
+    mgr = CheckpointManager(str(tmp_path / "run"))
+    _save_epoch(mgr, 0, seed=0)
+    chaos.configure(kill_before_commit=1)
+    with pytest.raises(SimulatedCrash):
+        _save_epoch(mgr, 1, seed=1)
+    chaos.reset()
+    assert os.path.exists(str(tmp_path / "run-0001.params"))
+    mgr2 = CheckpointManager(str(tmp_path / "run"))
+    assert mgr2.restore_latest().epoch == 0
+
+
+def test_corrupt_checkpoint_detected_and_skipped(tmp_path, caplog):
+    """Bit rot / torn storage under a committed manifest entry: the
+    checksum catches it and restore falls back to the previous epoch."""
+    mgr = CheckpointManager(str(tmp_path / "run"))
+    _save_epoch(mgr, 0, seed=0)
+    chaos.configure(corrupt_checkpoint_bytes=1)
+    _save_epoch(mgr, 1, seed=1)      # epoch 1's first file is corrupted
+    chaos.reset()
+    assert mgr.verify(1) is False
+    import logging
+    with caplog.at_level(logging.WARNING):
+        rec = mgr.restore_latest()
+    assert rec.epoch == 0
+    assert any("corrupt" in r.message for r in caplog.records)
+    # loading the corrupt epoch explicitly fails loudly
+    with pytest.raises(mx.MXNetError, match="checksum"):
+        mx.model.load_checkpoint(str(tmp_path / "run"), 1)
+
+
+def test_truncated_file_detected(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "run"))
+    _save_epoch(mgr, 0, seed=0)
+    _save_epoch(mgr, 1, seed=1)
+    params = str(tmp_path / "run-0001.params")
+    with open(params, "rb") as f:
+        blob = f.read()
+    with open(params, "wb") as f:      # deliberate out-of-band tear
+        f.write(blob[:len(blob) // 2])
+    assert mgr.verify(1) is False
+    assert mgr.restore_latest().epoch == 0
+
+
+def test_keep_last_rotation_deletes_only_orphans(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "run"), keep_last=2)
+    for epoch in range(4):
+        _save_epoch(mgr, epoch, seed=epoch)
+    assert mgr.epochs() == [2, 3]
+    names = sorted(p.name for p in tmp_path.iterdir())
+    assert "run-0000.params" not in names
+    assert "run-0001.params" not in names
+    assert "run-0002.params" in names and "run-0003.params" in names
+    # the symbol file is shared by the surviving entries
+    assert "run-symbol.json" in names
+    assert mgr.restore_latest().epoch == 3
+
+
+def test_background_save_and_error_surfacing(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "run"), background=True)
+    _save_epoch(mgr, 0, seed=0)
+    mgr.wait()
+    assert mgr.restore_latest().epoch == 0
+    chaos.configure(fail_file_writes=1)
+    _save_epoch(mgr, 1, seed=1)        # fails on the worker thread
+    with pytest.raises(OSError, match="chaos"):
+        mgr.wait()
+    chaos.reset()
+    assert mgr.restore_latest().epoch == 0
+
+
+def test_load_checkpoint_warns_and_skips_unknown_key_prefixes(
+        tmp_path, caplog):
+    """SATELLITE: a foreign/corrupt params file announces itself at
+    load time instead of dumping stray keys into arg_params and dying
+    as a shape error three layers later."""
+    prefix = str(tmp_path / "run")
+    _mlp().save(prefix + "-symbol.json")
+    nd.save(prefix + "-0001.params",
+            {"arg:w": nd.array(np.ones((2, 2), np.float32)),
+             "aux:m": nd.array(np.ones(2, np.float32)),
+             "bogus_plain_key": nd.array(np.zeros(2, np.float32))})
+    import logging
+    with caplog.at_level(logging.WARNING):
+        _, args, auxs = mx.model.load_checkpoint(prefix, 1)
+    assert set(args) == {"w"} and set(auxs) == {"m"}
+    assert any("bogus_plain_key" in r.message for r in caplog.records)
+
+
+def test_module_checkpoint_roundtrip_through_manager(tmp_path):
+    rng = np.random.RandomState(0)
+    batches = _batches(rng)
+    mod = _module(fused=True)
+    for i in range(2):
+        mod.forward_backward_update(batches[i])
+    prefix = str(tmp_path / "m")
+    mod.save_checkpoint(prefix, 2, save_optimizer_states=True)
+    rec = CheckpointManager(prefix).restore_latest()
+    assert rec.epoch == 2 and rec.states_path is not None
+    mod2 = mx.Module.load(prefix, 2, load_optimizer_states=True,
+                          context=mx.cpu())
+    mod2.bind([("data", (16, 8))], [("softmax_label", (16,))])
+    mod2.init_optimizer(optimizer="sgd", optimizer_params={
+        "learning_rate": 0.1, "momentum": 0.9})
+    a1, _ = mod.get_params()
+    a2, _ = mod2.get_params()
+    for k in a1:
+        np.testing.assert_allclose(a1[k].asnumpy(), a2[k].asnumpy(),
+                                   err_msg=k)
+    # states bytes round-trip through the manager identically
+    with open(rec.states_path, "rb") as f:
+        assert pickle.loads(f.read()).keys() == \
+            pickle.loads(mod._optimizer_states_bytes()).keys()
+
+
+# ---------------------------------------------------------------------------
+# non-finite guard
+# ---------------------------------------------------------------------------
+
+def test_guard_fused_skip_bit_identical_and_single_program():
+    """ACCEPTANCE: a NaN-injected step is skipped IN-GRAPH — weights
+    and optimizer state bit-identical, skip counter increments — and
+    the one-program-per-step property holds with the guard enabled."""
+    rng = np.random.RandomState(1)
+    batches = _batches(rng)
+    mod = _module(fused=True).set_nonfinite_guard(True)
+    for i in range(2):                       # warmup: trace + compile
+        mod.forward_backward_update(batches[i])
+    assert mod._fused and mod._fused["guard"] and \
+        mod._fused["mode"] == "full"
+
+    # one-program-per-step with the guard compiled in
+    prof.reset_counters()
+    mod.forward_backward_update(batches[2])
+    c = prof.counters()
+    assert c.get("fused_step_dispatches") == 1, c
+    assert c.get("fused_step_compiles", 0) == 0, c
+    assert c.get("executor_dispatches", 0) == 0, c
+    assert mod.nonfinite_skipped == 0
+
+    before = _param_bytes(mod)
+    states_before = mod._optimizer_states_bytes()
+    chaos.configure(nan_grads_at_step=mod._step_seq)
+    prof.reset_counters()
+    mod.forward_backward_update(batches[3])  # poisoned -> skipped
+    chaos.reset()
+    c = prof.counters()
+    assert c.get("fused_step_compiles", 0) == 0, c   # no recompile
+    assert mod.nonfinite_skipped == 1
+    assert c.get("guard_skipped_steps") == 1
+    assert _param_bytes(mod) == before               # bit-identical
+    assert mod._optimizer_states_bytes() == states_before
+
+    # a clean step afterwards trains normally and resets the streak
+    mod.forward_backward_update(batches[0])
+    assert mod.nonfinite_skipped == 1
+    assert mod._guard_consec == 0
+    assert _param_bytes(mod) != before
+
+
+def test_guard_divergence_raises_after_n_consecutive():
+    rng = np.random.RandomState(2)
+    batches = _batches(rng)
+    mod = _module(fused=True).set_nonfinite_guard(True, max_consecutive=2)
+    mod.forward_backward_update(batches[0])
+    nan = _nan_batch()
+    mod.forward_backward_update(nan)
+    with pytest.raises(DivergenceError, match="consecutive"):
+        mod.forward_backward_update(nan)
+    assert mod.nonfinite_skipped == 2
+
+
+def test_guard_divergence_rollback_restores_checkpoint(tmp_path):
+    rng = np.random.RandomState(3)
+    batches = _batches(rng)
+    prefix = str(tmp_path / "g")
+    mgr = CheckpointManager(prefix)
+    mod = _module(fused=True)
+    mod.forward_backward_update(batches[0])
+    mod.save_checkpoint(prefix, 0, save_optimizer_states=True,
+                        checkpoint_manager=mgr)
+    good = _param_bytes(mod)
+    mod.set_nonfinite_guard(True, max_consecutive=2, action="rollback",
+                            checkpoint_manager=mgr)
+    nan = _nan_batch()
+    mod.forward_backward_update(nan)
+    mod.forward_backward_update(nan)         # triggers the rollback
+    assert _param_bytes(mod) == good
+    # training continues from the restored weights
+    mod.forward_backward_update(batches[1])
+    assert _param_bytes(mod) != good
+
+
+def test_guard_rollback_without_checkpoint_raises():
+    mod = _module(fused=True).set_nonfinite_guard(
+        True, max_consecutive=1, action="rollback",
+        checkpoint_manager=None)
+    with pytest.raises(DivergenceError, match="no intact checkpoint"):
+        mod.forward_backward_update(_nan_batch())
+
+
+def test_guard_legacy_path_skips_host_side():
+    """MXNET_MODULE_FUSED_STEP=0: the guard's host-side mirror skips
+    the update and keeps params bit-identical on the legacy loop."""
+    rng = np.random.RandomState(4)
+    batches = _batches(rng)
+    mod = _module(fused=False).set_nonfinite_guard(True)
+    mod.forward_backward_update(batches[0])
+    assert mod._fused is None                # legacy loop in use
+    before = _param_bytes(mod)
+    mod.forward_backward_update(_nan_batch())
+    assert mod.nonfinite_skipped == 1
+    assert _param_bytes(mod) == before
+    mod.forward_backward_update(batches[1])
+    assert _param_bytes(mod) != before
+
+
+def test_guard_partial_path_two_devices():
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    rng = np.random.RandomState(5)
+    batches = _batches(rng)
+    mod = _module(fused=True, contexts=[mx.cpu(0), mx.cpu(1)])
+    mod.set_nonfinite_guard(True)
+    mod.forward_backward_update(batches[0])
+    assert mod._fused["mode"] == "partial" and mod._fused["guard"]
+    before = _param_bytes(mod)
+    mod.forward_backward_update(_nan_batch())
+    assert mod.nonfinite_skipped == 1
+    assert _param_bytes(mod) == before
+
+
+@pytest.mark.parametrize("path", ["legacy", "partial", "full"])
+def test_guard_restores_batchnorm_aux_on_skip(path):
+    """A skipped step must not poison aux states: BatchNorm's running
+    mean/var are rebound by forward itself, so the guard restores the
+    pre-step handles on every path, not just the full-fused one."""
+    import jax
+    contexts = None
+    fused = path != "legacy"
+    if path == "partial":
+        if len(jax.devices()) < 2:
+            pytest.skip("needs >= 2 devices")
+        contexts = [mx.cpu(0), mx.cpu(1)]
+    rng = np.random.RandomState(15)
+    batches = _batches(rng)
+    mod = _module(fused=fused, contexts=contexts, net=_bn_mlp)
+    mod.set_nonfinite_guard(True)
+    mod.forward_backward_update(batches[0])     # one clean step
+    if fused:
+        assert mod._fused["mode"] == ("partial" if contexts else "full")
+    before_aux = {k: v.asnumpy().tobytes()
+                  for k, v in mod.get_params()[1].items()}
+    assert before_aux                           # bn moving stats exist
+    before = _param_bytes(mod)
+    mod.forward_backward_update(_nan_batch())
+    assert mod.nonfinite_skipped == 1
+    after_aux = {k: v.asnumpy().tobytes()
+                 for k, v in mod.get_params()[1].items()}
+    assert after_aux == before_aux              # stats not NaN-poisoned
+    assert _param_bytes(mod) == before
+
+
+def test_guard_off_trajectory_matches_guarded_clean_run():
+    """With finite data the guard's select is a no-op: the guarded and
+    unguarded programs land on the same parameters (allclose — the two
+    programs may compile to differently fused kernels)."""
+    rng = np.random.RandomState(6)
+    batches = _batches(rng)
+    plain = _module(fused=True)
+    guarded = _module(fused=True).set_nonfinite_guard(True)
+    # same init for both
+    args, auxs = plain.get_params()
+    guarded.set_params(args, auxs)
+    for i in range(3):
+        plain.forward_backward_update(batches[i])
+        guarded.forward_backward_update(batches[i])
+    assert guarded.nonfinite_skipped == 0
+    a1, _ = plain.get_params()
+    a2, _ = guarded.get_params()
+    for k in a1:
+        np.testing.assert_allclose(a1[k].asnumpy(), a2[k].asnumpy(),
+                                   rtol=1e-6, atol=1e-7, err_msg=k)
+
+
+def test_guard_env_knob_enables(monkeypatch):
+    monkeypatch.setenv("MXNET_GUARD_NONFINITE", "1")
+    mod = _module(fused=True)
+    mod.forward_backward_update(_batches(np.random.RandomState(7))[0])
+    assert mod._fused["guard"]
+    mod.forward_backward_update(_nan_batch())
+    assert mod.nonfinite_skipped == 1
+    # explicit config wins over the env knob, in both directions
+    mod.set_nonfinite_guard(False)
+    assert mod._guard_cfg() is None
+
+
+# ---------------------------------------------------------------------------
+# retry/backoff
+# ---------------------------------------------------------------------------
+
+def test_retry_backoff_schedule_deterministic():
+    sleeps = []
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        raise OSError("still down")
+
+    with pytest.raises(OSError):
+        retry_call(flaky, attempts=4, base_delay=0.1, max_delay=0.5,
+                   multiplier=2.0, jitter=0, sleep=sleeps.append)
+    assert len(calls) == 4
+    assert sleeps == [0.1, 0.2, 0.4]        # capped exponential
+
+
+def test_retry_jitter_bounded_and_seeded():
+    import random
+    sleeps = []
+    with pytest.raises(OSError):
+        retry_call(lambda: (_ for _ in ()).throw(OSError()),
+                   attempts=4, base_delay=1.0, max_delay=8.0,
+                   jitter=0.5, sleep=sleeps.append,
+                   rng=random.Random(0))
+    assert len(sleeps) == 3
+    for nominal, actual in zip([1.0, 2.0, 4.0], sleeps):
+        assert nominal * 0.5 <= actual <= nominal
+
+
+def test_retry_deadline_stops_early():
+    clock = {"t": 0.0}
+
+    def sleep(s):
+        clock["t"] += s
+
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        raise OSError("down")
+
+    with pytest.raises(OSError):
+        retry_call(flaky, attempts=100, base_delay=1.0, max_delay=1.0,
+                   jitter=0, deadline=2.5, sleep=sleep,
+                   clock=lambda: clock["t"])
+    assert len(calls) == 3                  # 0s, 1s, 2s; 3s > deadline
+
+
+def test_retry_give_up_on_beats_retry_on():
+    calls = []
+
+    def missing():
+        calls.append(1)
+        raise FileNotFoundError("gone")
+
+    with pytest.raises(FileNotFoundError):
+        retry_call(missing, retry_on=(OSError,),
+                   give_up_on=(FileNotFoundError,),
+                   sleep=lambda s: None)
+    assert len(calls) == 1                  # not transient: no retries
+
+
+def test_retry_decorator_success_after_failures():
+    calls = []
+
+    @resilience.retry(attempts=5, sleep=lambda s: None)
+    def eventually():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("flake")
+        return "ok"
+
+    assert eventually() == "ok"
+    assert len(calls) == 3
+
+
+def test_model_store_retries_transient_reads(tmp_path, monkeypatch):
+    from mxnet_tpu.gluon.model_zoo import model_store
+    root = str(tmp_path)
+    with open(os.path.join(root, "net.params"), "wb") as f:
+        f.write(b"weights")
+    real_probe = model_store._probe
+    state = {"fails": 2}
+
+    def flaky_probe(path):
+        if state["fails"] > 0:
+            state["fails"] -= 1
+            raise OSError("nfs flake")
+        return real_probe(path)
+
+    sleeps = []
+    monkeypatch.setattr(model_store, "_probe", flaky_probe)
+    monkeypatch.setattr(model_store, "_sleep", sleeps.append)
+    assert model_store.get_model_file("net", root=root).endswith(
+        "net.params")
+    assert len(sleeps) == 2                 # two backoffs, no real sleep
+    # a genuinely missing file fails fast (no retries burned)
+    sleeps.clear()
+    with pytest.raises(FileNotFoundError, match="no network egress"):
+        model_store.get_model_file("absent", root=root)
+    assert sleeps == []
+
+
+# ---------------------------------------------------------------------------
+# fit loop: preemption + epoch checkpoints
+# ---------------------------------------------------------------------------
+
+def _toy_iter(n=48, batch=16):
+    rng = np.random.RandomState(0)
+    X = rng.randn(n, 8).astype(np.float32)
+    Y = rng.randint(0, 4, n).astype(np.float32)
+    return NDArrayIter(X, Y, batch_size=batch)
+
+
+def test_fit_preemption_finishes_batch_checkpoints_and_exits(tmp_path):
+    """The chaos preemption flag is honored at a batch boundary: the
+    in-flight batch finishes, a checkpoint is committed through the
+    manager, and fit returns cleanly."""
+    prefix = str(tmp_path / "pre")
+    mgr = CheckpointManager(prefix)
+    seen = []
+    chaos.configure(preempt_at_batch=2)
+    mod = mx.Module(_mlp(), context=mx.cpu())
+    mod.fit(_toy_iter(), num_epoch=5, optimizer="sgd",
+            batch_end_callback=lambda p: seen.append(p.nbatch),
+            checkpoint_manager=mgr)
+    assert seen == [0, 1]                   # finished batch 2, then left
+    rec = mgr.restore_latest()
+    assert rec is not None and rec.epoch == 0
+    assert rec.states_path is not None      # optimizer state included
+    # the job is resumable from the record
+    _, args, auxs = rec.load()
+    assert set(args) >= {"fc1_weight", "fc2_weight"}
+
+
+def test_fit_programmatic_preemption_flag(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "sig"))
+    calls = []
+
+    def request_then_count(param):
+        calls.append(param.nbatch)
+        if param.nbatch == 0:
+            resilience.request_preemption()
+
+    mod = mx.Module(_mlp(), context=mx.cpu())
+    mod.fit(_toy_iter(), num_epoch=3, optimizer="sgd",
+            batch_end_callback=request_then_count,
+            checkpoint_manager=mgr)
+    assert calls == [0]
+    assert mgr.restore_latest() is not None
+
+
+def test_fit_epoch_end_checkpoints_through_manager(tmp_path):
+    prefix = str(tmp_path / "ep")
+    mgr = CheckpointManager(prefix)
+    mod = mx.Module(_mlp(), context=mx.cpu())
+    mod.fit(_toy_iter(), num_epoch=2, optimizer="sgd",
+            checkpoint_manager=mgr)
+    assert mgr.epochs() == [0, 1]
+    rec = mgr.restore_latest()
+    assert rec.epoch == 1
+    # resume: Module.load off the record's epoch sees the same params
+    mod2 = mx.Module.load(prefix, rec.epoch, context=mx.cpu())
+    mod2.bind([("data", (16, 8))], [("softmax_label", (16,))],
+              for_training=False)
+    a1, _ = mod.get_params()
+    a2, _ = mod2.get_params()
+    for k in a1:
+        np.testing.assert_allclose(a1[k].asnumpy(), a2[k].asnumpy(),
+                                   err_msg=k)
+
+
+def test_preemption_handler_installs_and_restores():
+    import signal
+    prev = resilience.install_preemption_handler()
+    try:
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert resilience.preemption_requested()
+    finally:
+        for sig, handler in prev.items():
+            signal.signal(sig, handler)
+        resilience.clear_preemption()
+
+
+# ---------------------------------------------------------------------------
+# chaos harness itself
+# ---------------------------------------------------------------------------
+
+def test_chaos_env_spec_parsing(monkeypatch):
+    monkeypatch.setenv("MXNET_CHAOS",
+                       "fail_file_writes=2, nan_grads_at_step=3")
+    assert chaos.active() == {"fail_file_writes": 2,
+                              "nan_grads_at_step": 3}
+    assert chaos.enabled()
+    monkeypatch.setenv("MXNET_CHAOS", "on")
+    assert chaos.active() == {} and chaos.enabled()
+    monkeypatch.setenv("MXNET_CHAOS", "off")
+    assert not chaos.enabled()
+    monkeypatch.setenv("MXNET_CHAOS", "fail_file_writes=nope")
+    with pytest.raises(ValueError, match="not an integer"):
+        chaos.active()
+
+
+def test_chaos_budgets_are_exact(tmp_path):
+    chaos.configure(fail_file_writes=2)
+    path = str(tmp_path / "f")
+    for _ in range(2):
+        with pytest.raises(OSError):
+            atomic_write(path, b"x")
+    atomic_write(path, b"x")                # budget spent
+    assert chaos.fired("fail_file_writes") == 2
+
+
+# ---------------------------------------------------------------------------
+# dataloader worker respawn (spawns real processes -> slow)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_dataloader_respawns_killed_worker():
+    from mxnet_tpu.gluon.data import DataLoader
+    from mxnet_tpu.gluon.data.dataset import ArrayDataset
+    X = np.arange(64, dtype=np.float32).reshape(16, 4)
+    Y = np.arange(16, dtype=np.float32)
+    loader = DataLoader(ArrayDataset(nd.array(X), nd.array(Y)),
+                        batch_size=4, num_workers=1)
+    it = iter(loader)
+    first = next(it)
+    # reach into the worker iter and hard-kill the process mid-epoch
+    inner = it.gi_frame.f_locals["it"]
+    for w in inner._workers:
+        w.terminate()
+        w.join()
+    rest = list(it)
+    assert len(rest) == 3                   # every batch still arrives
+    assert inner._respawns >= 1
+    got = np.concatenate([first[0].asnumpy()] +
+                         [b[0].asnumpy() for b in rest])
+    np.testing.assert_array_equal(np.sort(got.ravel()), X.ravel())

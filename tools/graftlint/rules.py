@@ -1,4 +1,4 @@
-"""graftlint rules JG001–JG008.
+"""graftlint rules JG001–JG009.
 
 Each rule is a function ``check(project) -> list[Finding]`` over the
 :class:`~tools.graftlint.callgraph.ProjectIndex`.  Rules never import
@@ -9,6 +9,7 @@ jit-reachability/taint graph.
 from __future__ import annotations
 
 import ast
+import re
 
 from .callgraph import (body_walk, dotted_name, literal_int_tuple,
                         module_level_walk)
@@ -579,6 +580,96 @@ def check_jg008(project):
 
 
 # ---------------------------------------------------------------------------
+# JG009 — non-atomic persistence write
+# ---------------------------------------------------------------------------
+
+#: a function counts as a persistence writer when its NAME says it
+#: persists something...
+_JG009_FUNC_RE = re.compile(
+    r"save|dump|write|serial|export|checkpoint", re.IGNORECASE)
+#: ...AND its name or any string literal in its body mentions a
+#: checkpoint/state artifact
+_JG009_TOKENS = (".params", ".states", "-symbol.json", "checkpoint",
+                 "ckpt", "manifest")
+#: the atomic writer implementation itself is the one place raw
+#: open()-for-write on these paths is correct
+_JG009_EXEMPT = ("mxnet_tpu/resilience/",)
+
+_WRITE_MODE_CHARS = ("w", "a", "x")
+
+
+def _jg009_write_mode(call):
+    """The mode literal of an ``open()`` call when it opens for
+    writing, else None."""
+    mode = None
+    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant):
+        mode = call.args[1].value
+    for kw in call.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+            mode = kw.value.value
+    if isinstance(mode, str) and \
+            any(c in mode for c in _WRITE_MODE_CHARS):
+        return mode
+    return None
+
+
+def _jg009_is_persistence_writer(fi):
+    if not _JG009_FUNC_RE.search(fi.name):
+        return False
+    hay = [fi.name.lower()]
+    for n in body_walk(fi.node):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            hay.append(n.value.lower())
+    return any(tok in h for h in hay for tok in _JG009_TOKENS)
+
+
+def check_jg009(project):
+    out = []
+    for m in project.modules:
+        if any(p in m.relpath for p in _JG009_EXEMPT):
+            continue
+        for fi in m.functions:
+            if not _jg009_is_persistence_writer(fi):
+                continue
+            for n in body_walk(fi.node):
+                if not isinstance(n, ast.Call):
+                    continue
+                if isinstance(n.func, ast.Name) and n.func.id == "open":
+                    mode = _jg009_write_mode(n)
+                    if mode is not None:
+                        out.append(_f(
+                            "JG009", fi, n,
+                            "open(..., %r) in persistence writer '%s' "
+                            "writes a checkpoint/state path in place — "
+                            "a crash mid-write tears the only copy; "
+                            "route it through resilience.checkpoint."
+                            "atomic_write (tmp + fsync + os.replace)"
+                            % (mode, fi.qualname)))
+                elif isinstance(n.func, ast.Attribute) and \
+                        n.func.attr in ("save", "savez",
+                                        "savez_compressed") and \
+                        _resolves_to_module(m, n.func, ("numpy",)):
+                    out.append(_f(
+                        "JG009", fi, n,
+                        "np.%s in persistence writer '%s' streams a "
+                        "checkpoint/state file in place — serialize to "
+                        "bytes and hand them to resilience.checkpoint."
+                        "atomic_write" % (n.func.attr, fi.qualname)))
+                elif isinstance(n.func, ast.Attribute) and \
+                        n.func.attr == "dump" and \
+                        _resolves_to_module(m, n.func,
+                                            ("pickle", "json")):
+                    out.append(_f(
+                        "JG009", fi, n,
+                        "%s.dump in persistence writer '%s' streams a "
+                        "checkpoint/state file in place — use dumps() "
+                        "and resilience.checkpoint.atomic_write"
+                        % (dotted_name(n.func).split(".")[0],
+                           fi.qualname)))
+    return out
+
+
+# ---------------------------------------------------------------------------
 
 ALL_RULES = {
     "JG001": check_jg001,
@@ -589,6 +680,7 @@ ALL_RULES = {
     "JG006": check_jg006,
     "JG007": check_jg007,
     "JG008": check_jg008,
+    "JG009": check_jg009,
 }
 
 RULE_DOCS = {
@@ -610,4 +702,7 @@ RULE_DOCS = {
     "JG007": "mutable default argument shared across calls in API "
              "functions",
     "JG008": "jnp/jax backend-forcing call at module import time",
+    "JG009": "non-atomic persistence write: open()-for-write/np.save*/"
+             "pickle.dump of a checkpoint or optimizer-state path not "
+             "routed through resilience.checkpoint.atomic_write",
 }
