@@ -15,6 +15,14 @@ echo "== sanity: graftlint static analysis =="
 # the last stdout line is the scrapeable summary ("graftlint: ...").
 python -m tools.graftlint mxnet_tpu
 
+echo "== graftsan: sanitizer-enabled smoke train step =="
+# Fused + partial-fused train steps, PrefetchingIter, local kvstore
+# with ALL FOUR runtime sanitizers on (race/lockset + lock-order,
+# recompile-blame, use-after-donate poison, host-transfer guard).
+# Fails on any sanitizer report or a broken one-program-per-step
+# contract.  Seconds, CPU-only (docs/sanitizers.md).
+MXNET_SAN=all python ci/graftsan_smoke.py
+
 echo "== resilience: chaos-injected fault drills =="
 # The resilience suite under the chaos harness: kill-mid-save,
 # corrupt-checkpoint, NaN-step, and preemption drills against the REAL

@@ -10,6 +10,38 @@ __version__ = "0.1.0"
 
 import os as _os
 
+# Server-role bootstrap: a process launched with DMLC_ROLE=server never
+# returns to user code — the reference's behavior
+# (python/mxnet/kvstore_server.py _init_kvstore_server_module, invoked
+# from python/mxnet/__init__.py).  Implementation detail: we re-exec a
+# fresh interpreter running ``-m mxnet_tpu.kvstore_server`` instead of
+# blocking here, because a server loop inside this (still-initializing)
+# package import would deadlock its handler threads on the package
+# import lock the moment they unpickle an optimizer.
+#
+# This block sits at the TOP of the package, before any heavy imports:
+# the pre-exec interpreter used to pay the FULL package import (jax,
+# gluon, module, ...) only to throw it away in execv and import it all
+# again — doubling server spin-up, which the multi-process dist drills
+# pay per spawned server.
+if _os.environ.get("DMLC_ROLE") == "server" and \
+        not _os.environ.get("_MXTPU_SERVER_BOOT"):
+    import sys as _sys
+    # A ``python -m mxnet_tpu.kvstore_server ...`` launch imports this
+    # package while argv[0] is still the "-m" placeholder; let it
+    # proceed so its own argv (kv type) is honored rather than
+    # re-execing over it.
+    if _sys.argv and _sys.argv[0] != "-m":
+        _os.environ["_MXTPU_SERVER_BOOT"] = "1"
+        _pkg_parent = _os.path.dirname(_os.path.dirname(
+            _os.path.abspath(__file__)))
+        _pp = _os.environ.get("PYTHONPATH", "")
+        _os.environ["PYTHONPATH"] = _pkg_parent + (_os.pathsep + _pp
+                                                   if _pp else "")
+        _os.execv(_sys.executable,
+                  [_sys.executable, "-m", "mxnet_tpu.kvstore_server",
+                   _os.environ.get("MXNET_KVSTORE_TYPE", "dist_sync")])
+
 # Honor JAX_PLATFORMS before any backend init: this image's TPU-plugin
 # site hook force-sets jax_platforms='axon,cpu' at interpreter startup,
 # overriding even an explicit JAX_PLATFORMS=cpu env — so a CPU-only run
@@ -66,36 +98,10 @@ viz = visualization  # reference alias: mx.viz
 from . import subgraph  # noqa: F401
 from . import resilience  # noqa: F401
 from . import config  # noqa: F401
+from . import sanitizer  # noqa: F401  (graftsan bridge — see MXNET_SAN)
 from . import rtc  # noqa: F401
 from .runtime import engine  # noqa: F401
 
 
 def waitall():
     engine.wait_all()
-
-
-# Server-role bootstrap: a process launched with DMLC_ROLE=server never
-# returns to user code — the reference's behavior
-# (python/mxnet/kvstore_server.py _init_kvstore_server_module, invoked
-# from python/mxnet/__init__.py).  Implementation detail: we re-exec a
-# fresh interpreter running ``-m mxnet_tpu.kvstore_server`` instead of
-# blocking here, because a server loop inside this (still-initializing)
-# package import would deadlock its handler threads on the package
-# import lock the moment they unpickle an optimizer.
-if _os.environ.get("DMLC_ROLE") == "server" and \
-        not _os.environ.get("_MXTPU_SERVER_BOOT"):
-    import sys as _sys
-    # A ``python -m mxnet_tpu.kvstore_server ...`` launch imports this
-    # package while argv[0] is still the "-m" placeholder; let it
-    # proceed so its own argv (kv type) is honored rather than
-    # re-execing over it.
-    if _sys.argv and _sys.argv[0] != "-m":
-        _os.environ["_MXTPU_SERVER_BOOT"] = "1"
-        _pkg_parent = _os.path.dirname(_os.path.dirname(
-            _os.path.abspath(__file__)))
-        _pp = _os.environ.get("PYTHONPATH", "")
-        _os.environ["PYTHONPATH"] = _pkg_parent + (_os.pathsep + _pp
-                                                   if _pp else "")
-        _os.execv(_sys.executable,
-                  [_sys.executable, "-m", "mxnet_tpu.kvstore_server",
-                   _os.environ.get("MXNET_KVSTORE_TYPE", "dist_sync")])

@@ -33,6 +33,7 @@ import time
 import numpy as _np
 
 from . import ndarray as nd
+from . import sanitizer as _san
 from .ndarray import NDArray
 from .base import MXNetError
 
@@ -377,6 +378,29 @@ def _recv_frame(sock):
     return kind, meta, tensors
 
 
+def _connect_retry(host, port, deadline):
+    """Connect with retry until *deadline*, a FRESH socket per attempt.
+
+    Reusing one socket across attempts is not portable: after a
+    ``connect`` fails with ECONNREFUSED (server still importing/binding),
+    some kernels and sandboxes leave the fd permanently broken — every
+    retry then fails with ECONNABORTED until the deadline, which is
+    exactly the "worker never connects although the server came up 2s
+    later" flakiness the dist drills showed.  A fresh socket per attempt
+    connects on the first try once the server listens."""
+    while True:
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            sock.connect((host, port))
+            return sock
+        except (ConnectionRefusedError, OSError):
+            sock.close()
+            if time.time() > deadline:
+                raise
+            time.sleep(0.1)
+
+
 def _rpc_call(sock, kind, meta=None, tensors=()):
     """Round-trip one request on *sock*; raises on an 'err' reply."""
     _send_frame(sock, kind, meta, tensors)
@@ -410,14 +434,14 @@ class KVStoreServer:
         self.heartbeats = {}       # node id -> last heartbeat walltime
         from .config import get_env as _get_env
         self.sync_timeout = _get_env("MXNET_KVSTORE_SYNC_TIMEOUT")
-        self.cv = threading.Condition()
-        self.lock = threading.RLock()
+        self.cv = _san.condition(label="KVStoreServer.cv")
+        self.lock = _san.rlock(label="KVStoreServer.lock")
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self.sock.bind((host, port or 0))
         self.port = self.sock.getsockname()[1]
         self.sock.listen(64)
-        self._stop = False
+        self._stop = _san.event()
         # Resolve handler-thread imports NOW, on the constructing thread.
         # The server may be started from the tail of mxnet_tpu/__init__.py
         # (DMLC_ROLE=server bootstrap) while the package is still marked
@@ -430,13 +454,21 @@ class KVStoreServer:
         self._opt_mod = _opt_mod
         self._quant_mod = _quant_mod
         self._prof_mod = _prof_mod
+        # attributes conn-handler threads share; every one of these
+        # must be consistently guarded (store/pending/heartbeats by
+        # self.lock or self.cv; updater/sync rebinding by self.lock —
+        # the SET_OPT/'mode' handlers race _apply's reads otherwise,
+        # which is exactly what the lockset detector reports)
+        _san.track(self, ("store", "pending", "updater", "sync",
+                          "heartbeats", "barrier_rounds",
+                          "barrier_done"), "KVStoreServer")
 
     def run(self):
         """Serve until a STOP message (reference: RunServer blocks the
         server process, python/mxnet/kvstore_server.py)."""
         threads = []
         self.sock.settimeout(0.5)
-        while not self._stop:
+        while not self._stop.is_set():
             try:
                 conn, _ = self.sock.accept()
             except socket.timeout:
@@ -444,8 +476,8 @@ class KVStoreServer:
             except OSError:
                 break
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            t = threading.Thread(target=self._serve_conn, args=(conn,),
-                                 daemon=True)
+            t = _san.thread(target=self._serve_conn, args=(conn,),
+                            daemon=True)
             t.start()
             threads.append(t)
         for t in threads:
@@ -479,7 +511,7 @@ class KVStoreServer:
             while True:
                 kind, meta, tensors = _recv_frame(conn)
                 if kind == _MSG_STOP:
-                    self._stop = True
+                    self._stop.set()
                     _send_frame(conn, _MSG_REPLY, {"status": "ok"})
                     return
                 # every other message replies exactly once; ANY handler
@@ -522,7 +554,14 @@ class KVStoreServer:
                 val = dense
             else:
                 val = tensors[0]
-            if self.sync:
+            # self.sync is rebound by the rank-0 'mode' command on a
+            # DIFFERENT conn thread — unsynchronized, this read raced
+            # the write (caught by the graftsan lockset detector); a
+            # worker's first pushes could land on the wrong
+            # consistency path
+            with self.lock:
+                sync = self.sync
+            if sync:
                 self._push_sync(key, val)
             else:
                 self._apply(key, val)
@@ -558,9 +597,15 @@ class KVStoreServer:
             return {"dead": dead}, ()
         if kind == _MSG_SET_OPT:
             # control plane: optimizer ships pickled from rank 0, same
-            # trust stance as the reference's set_optimizer
+            # trust stance as the reference's set_optimizer.  The
+            # rebinding must hold self.lock: _apply reads self.updater
+            # under it from other conn threads (an unlocked write here
+            # raced a concurrent async push — the lockset detector's
+            # first real finding)
             optimizer = pickle.loads(tensors[0].tobytes())
-            self.updater = self._opt_mod.get_updater(optimizer)
+            updater = self._opt_mod.get_updater(optimizer)
+            with self.lock:
+                self.updater = updater
             return {}, ()
         if kind == _MSG_CMD:
             # rank-0 command channel (reference: kvstore.h
@@ -571,7 +616,8 @@ class KVStoreServer:
             head = meta.get("head", "")
             body = meta.get("body")
             if head == "mode":
-                self.sync = "async" not in str(body)
+                with self.lock:
+                    self.sync = "async" not in str(body)
             elif head == "profiler:set_config":
                 cfg = dict(body)
                 if "filename" in cfg and self.server_id:
@@ -671,20 +717,10 @@ class KVStoreDist(KVStoreBase):
         # server s listens on root port + s (tools/launch.py convention)
         self._socks = []
         self._locks = []
-        deadline = time.time() + 30
+        deadline = time.time() + _get_env("MXNET_KVSTORE_CONNECT_TIMEOUT")
         for s in range(self._num_servers):
-            sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            while True:
-                try:
-                    sock.connect((host, port + s))
-                    break
-                except (ConnectionRefusedError, OSError):
-                    if time.time() > deadline:
-                        raise
-                    time.sleep(0.1)
-            self._socks.append(sock)
-            self._locks.append(threading.Lock())
+            self._socks.append(_connect_retry(host, port + s, deadline))
+            self._locks.append(_san.lock())
         self._residual = {}
         self._sharded_keys = set()
         self._barrier_round = 0
@@ -731,7 +767,7 @@ class KVStoreDist(KVStoreBase):
                         return
                 time.sleep(interval)
 
-        self._hb_thread = threading.Thread(target=beat, daemon=True)
+        self._hb_thread = _san.thread(target=beat, daemon=True)
         self._hb_thread.start()
 
     def _server_for_key(self, k):
@@ -790,8 +826,7 @@ class KVStoreDist(KVStoreBase):
             except BaseException as e:  # surfaced on the caller thread
                 errors.append(e)
 
-        threads = [threading.Thread(target=work, args=(i,) + c,
-                                    daemon=True)
+        threads = [_san.thread(target=work, args=(i,) + c, daemon=True)
                    for i, c in enumerate(calls)]
         for t in threads:
             t.start()

@@ -82,6 +82,15 @@ register_env("MXNET_KVSTORE_SYNC_TIMEOUT", float, 120.0,
 register_env("MXNET_KVSTORE_HEARTBEAT_INTERVAL", float, 1.0,
              "Seconds between worker heartbeats feeding dead-node "
              "detection (reference: ps-lite heartbeats)")
+register_env("MXNET_KVSTORE_CONNECT_TIMEOUT", float, 120.0,
+             "Seconds a dist worker retries connecting to its servers "
+             "(fresh socket per attempt) before raising — covers "
+             "server-process spin-up, which includes a full package "
+             "import")
+register_env("MXNET_SAN", str, "",
+             "graftsan runtime sanitizer components to enable: comma "
+             "list of race,recompile,donation,transfer, or 'all'; "
+             "empty = off (zero overhead; see docs/sanitizers.md)")
 register_env("MXNET_KVSTORE_BIGARRAY_BOUND", int, 1000000,
              "Arrays above this many elements shard across all servers "
              "(reference: kvstore_dist.h:58)")

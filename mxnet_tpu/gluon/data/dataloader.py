@@ -28,6 +28,7 @@ import numpy as _np
 log = logging.getLogger(__name__)
 
 from ... import ndarray as nd
+from ... import sanitizer as _san
 from ...ndarray import NDArray
 from .sampler import SequentialSampler, RandomSampler, BatchSampler
 
@@ -165,6 +166,12 @@ class _MultiWorkerIter:
         for _ in range(prefetch):
             self._push_next()
 
+    #: two loaders (or a loader and a respawn) starting workers
+    #: concurrently would interleave their os.environ mutation and
+    #: could leak JAX_PLATFORMS=cpu into the parent permanently —
+    #: serialize the mutate-start-restore window
+    _spawn_env_lock = _san.lock(label="dataloader._spawn_env_lock")
+
     def _spawn_worker(self, work_q):
         worker = self._ctx.Process(
             target=_worker_loop,
@@ -172,15 +179,16 @@ class _MultiWorkerIter:
                   self._res_q),
             daemon=True)
         # children inherit the env at start(): pin cpu for them only
-        prev = os.environ.get("JAX_PLATFORMS")
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        try:
-            worker.start()
-        finally:
-            if prev is None:
-                del os.environ["JAX_PLATFORMS"]
-            else:
-                os.environ["JAX_PLATFORMS"] = prev
+        with self._spawn_env_lock:
+            prev = os.environ.get("JAX_PLATFORMS")
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            try:
+                worker.start()
+            finally:
+                if prev is None:
+                    del os.environ["JAX_PLATFORMS"]
+                else:
+                    os.environ["JAX_PLATFORMS"] = prev
         return worker
 
     def _push_next(self):

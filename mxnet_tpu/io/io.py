@@ -24,6 +24,7 @@ import numpy as _np
 
 from ..base import np_dtype
 from .. import ndarray as nd
+from .. import sanitizer as _san
 from ..ndarray import NDArray
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
@@ -354,9 +355,9 @@ class PrefetchingIter(DataIter):
                 return
 
     def _start(self):
-        self._queue = queue.Queue(maxsize=self._depth)
-        self._stop = threading.Event()
-        self._thread = threading.Thread(
+        self._queue = _san.queue(maxsize=self._depth)
+        self._stop = _san.event()
+        self._thread = _san.thread(
             target=self._producer, args=(self._queue, self._stop),
             daemon=True)
         self._thread.start()

@@ -20,8 +20,15 @@ def run_server(kv_type="dist_sync", host=None, port=None, num_workers=None):
     import jax
     try:
         jax.config.update("jax_platforms", "cpu")
-    except Exception:
-        pass
+    except Exception as exc:
+        # backend already initialized on another platform: the server
+        # still works, but say so — a TPU-grabbing server starves the
+        # training processes of the accelerator
+        import logging
+        logging.getLogger(__name__).warning(
+            "kvstore server could not pin the cpu backend (%s: %s); "
+            "continuing on the default platform",
+            type(exc).__name__, exc)
     sync = "async" not in kv_type
     # server s of a multi-server group listens at root port + s
     # (tools/launch.py sets DMLC_SERVER_ID; key sharding lives worker-side)

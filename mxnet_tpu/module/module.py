@@ -857,15 +857,35 @@ class Module(BaseModule):
             return
         # updater indices are positions in param_names (see update())
         idx_of = {n: i for i, n in enumerate(group.param_names)}
+        # COMMIT params/aux to the executor device before the first
+        # fused call: initializer-produced arrays are uncommitted, the
+        # program's outputs are committed, and jax keys its jit cache
+        # on committedness — left alone, step 2 silently recompiled the
+        # entire fused program a second time (found by the graftsan
+        # recompile sanitizer; device_put on an on-device array is
+        # zero-copy)
+        import jax as _jax
+        dev = ex0._ctx.jax_device
+        for n in names:
+            arr = ex0.arg_dict[n]._data
+            if not getattr(arr, "_committed", True):
+                ex0.arg_dict[n]._data = _jax.device_put(arr, dev)
+        for a in ex0.aux_dict.values():
+            if not getattr(a._data, "_committed", True):
+                a._data = _jax.device_put(a._data, dev)
         tree_update = tree_opt.make_tree_update(self._optimizer)
         guard = self._guard_cfg() is not None
         ctx = {"names": names, "idx": idx_of, "guard": guard,
                "hyper": tree_opt.hyper_sig(self._optimizer)}
+        from .. import sanitizer as _sanitizer
         if len(group.execs) == 1 and self._kvstore is None and \
                 ex0._train_step_fn is not None:
+            from ..ops.registry import supports_donation
             ctx["mode"] = "full"
-            ctx["fn"] = ex0.init_fused_step(tree_update,
-                                            guard_nonfinite=guard)
+            ctx["donates"] = supports_donation()
+            ctx["fn"] = _sanitizer.wrap_jit(
+                ex0.init_fused_step(tree_update, guard_nonfinite=guard),
+                "fused_step")
         else:
             import jax
             from .. import profiler as _prof
@@ -882,7 +902,9 @@ class Module(BaseModule):
             # donate params + optimizer state (argnums 1 and 2)
             donate = (1, 2) if supports_donation() else ()
             ctx["mode"] = "partial"
-            ctx["fn"] = jax.jit(tree_apply, donate_argnums=donate)
+            ctx["donates"] = bool(donate)
+            ctx["fn"] = _sanitizer.wrap_jit(
+                jax.jit(tree_apply, donate_argnums=donate), "tree_apply")
         self._fused = ctx
 
     def _import_fused_state(self):
@@ -890,15 +912,20 @@ class Module(BaseModule):
         indices the updater has not seen — its own lazy-create rule)."""
         from ..optimizer import tree_opt
         from ..ops.registry import supports_donation
+        import jax as _jax
         ex0 = self._exec_group.execs[0]
-        put = ex0._place
+        dev = ex0._ctx.jax_device
+        # device_put COMMITS the leaf (not just places it): an
+        # uncommitted state leaf at step 1 vs the committed program
+        # output at step 2 would flip the jit cache key and recompile
+        # the whole fused program (see _setup_fused)
+        put = lambda a: _jax.device_put(a, dev)
         if supports_donation():
             # the first fused step DONATES these buffers, and the
             # Updater's NDArrays alias them (import rebinds handles) —
             # copy so updater.states never points at deleted arrays
             import jax.numpy as jnp
-            place = ex0._place
-            put = lambda a: jnp.array(place(a))
+            put = lambda a: _jax.device_put(jnp.array(a), dev)
         params_nd = {n: ex0.arg_dict[n] for n in self._fused["names"]}
         self._fused_state = tree_opt.import_from_updater(
             self._updater, self._optimizer, params_nd,
@@ -945,13 +972,20 @@ class Module(BaseModule):
         rest = {n: v for n, v in arg_map.items() if n not in params}
         ts, lrs, wds = tree_opt.host_hyper(self._optimizer, names,
                                            ctx["idx"])
+        from .. import sanitizer as _sanitizer
+        donated = None
+        if ctx.get("donates") and _sanitizer.enabled("donation"):
+            import jax as _jax
+            donated = list(params.values()) + \
+                _jax.tree_util.tree_leaves(self._fused_state)
         # the PRNG key folds in THIS module's update count, which
         # advances every step — num_update only ratchets via max() and
         # can stall when the optimizer is shared with a module trained
         # further, which would replay the same dropout masks
-        res = ctx["fn"](
-            params, rest, ex._aux_map(), ex._key, self._fused_state,
-            lrs, wds, ts, max(ts.values()))
+        with _sanitizer.transfer_guard("fused train step"):
+            res = ctx["fn"](
+                params, rest, ex._aux_map(), ex._key, self._fused_state,
+                lrs, wds, ts, max(ts.values()))
         if ctx["guard"]:
             outs, new_aux, new_params, new_state, skipped = res
         else:
@@ -966,6 +1000,12 @@ class Module(BaseModule):
         for n, v in new_aux.items():
             ex.aux_dict[n]._data = v
         ex.outputs = [_wrap_out(o) for o in outs]
+        if donated is not None:
+            # every framework container is rebound above — any NDArray
+            # still holding one of the donated buffers is a stale alias
+            _sanitizer.poison_donated(
+                donated, "the fused train step (step %d)"
+                % self._step_seq)
         self._params_dirty = True
         if ctx["guard"]:
             # one scalar device->host read per step — the price of a
@@ -997,7 +1037,15 @@ class Module(BaseModule):
         params = {n: ex0.arg_dict[n]._data for n in names}
         ts, lrs, wds = tree_opt.host_hyper(self._optimizer, names,
                                            ctx["idx"])
-        res = ctx["fn"](grads, params, self._fused_state, lrs, wds, ts)
+        from .. import sanitizer as _sanitizer
+        donated = None
+        if ctx.get("donates") and _sanitizer.enabled("donation"):
+            import jax as _jax
+            donated = list(params.values()) + \
+                _jax.tree_util.tree_leaves(self._fused_state)
+        with _sanitizer.transfer_guard("partial-fused tree update"):
+            res = ctx["fn"](grads, params, self._fused_state, lrs, wds,
+                            ts)
         if ctx["guard"]:
             new_params, new_state, skipped = res
         else:
@@ -1007,6 +1055,10 @@ class Module(BaseModule):
         for n in names:
             ex0.arg_dict[n]._data = new_params[n]
         group.broadcast_params()
+        if donated is not None:
+            _sanitizer.poison_donated(
+                donated, "the partial-fused tree update (step %d)"
+                % self._step_seq)
         self._params_dirty = True
         if ctx["guard"]:
             skipped = int(skipped)

@@ -23,6 +23,7 @@ from ..base import np_dtype, dtype_name
 from ..context import Context, current_context
 from ..ops import registry as _reg
 from .. import autograd as _ag
+from .. import sanitizer as _sanitizer
 
 __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
            "zeros_like", "ones_like", "concatenate", "imperative_invoke",
@@ -106,7 +107,15 @@ class NDArray:
     # -- sync / conversion ------------------------------------------------
     def asnumpy(self):
         """Copy to a numpy array, blocking until the value is ready
-        (reference: WaitToRead + SyncCopyToCPU, ndarray.py asnumpy)."""
+        (reference: WaitToRead + SyncCopyToCPU, ndarray.py asnumpy).
+
+        This is the framework's device->host choke point (asscalar/
+        item/tolist/__float__ all route here), so the graftsan
+        transfer guard hooks it: inside a guarded hot-path region the
+        sync raises at this touch site.  asnumpy is already a blocking
+        sync — the check is one env read, invisible next to the copy."""
+        if _sanitizer._transfer_active():
+            _sanitizer.transfer_check("asnumpy()", self._data.shape)
         return _np.asarray(self._data)
 
     def asscalar(self):

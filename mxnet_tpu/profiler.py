@@ -22,13 +22,15 @@ import os
 import threading
 import time
 
+from . import sanitizer as _san
+
 __all__ = ["set_config", "set_state", "pause", "resume", "dump", "dumps",
            "profiler_set_config", "profiler_set_state",
            "Domain", "Task", "Frame", "Event", "Counter", "Marker",
            "scope", "bump_counter", "counter_value", "counters",
            "reset_counters"]
 
-_lock = threading.RLock()
+_lock = _san.rlock(label="profiler._lock")
 _events = []            # chrome trace event dicts
 _agg = {}               # name -> [count, total_us, min_us, max_us]
 

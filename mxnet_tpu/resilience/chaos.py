@@ -46,6 +46,8 @@ from __future__ import annotations
 import logging
 import threading
 
+from .. import sanitizer as _san
+
 __all__ = ["SimulatedCrash", "configure", "reset", "active", "enabled",
            "on_file_write", "on_pre_replace", "on_commit",
            "on_post_replace", "maybe_poison_batch", "tick", "counter",
@@ -60,7 +62,7 @@ class SimulatedCrash(BaseException):
     'survive' a crash the way it never could survive SIGKILL."""
 
 
-_lock = threading.Lock()
+_lock = _san.lock(label="chaos._lock")
 _spec = None        # programmatic spec (dict) — None = env-driven
 _used = {}          # injection key -> how many times it already fired
 _ticks = {}         # named event counters (fit batch boundaries, ...)

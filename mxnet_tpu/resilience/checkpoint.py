@@ -50,6 +50,7 @@ import os
 import threading
 
 from . import chaos
+from .. import sanitizer as _san
 
 __all__ = ["atomic_write", "atomic_write_stream", "fsync_dir",
            "CheckpointManager", "CheckpointRecord", "MANIFEST_VERSION"]
@@ -144,7 +145,7 @@ _TMP_SEQ = itertools.count()
 # interleave their manifest read-modify-write (cross-PROCESS writers
 # are out of scope — run one trainer per prefix)
 _COMMIT_LOCKS = {}
-_COMMIT_LOCKS_GUARD = threading.Lock()
+_COMMIT_LOCKS_GUARD = _san.lock(label="checkpoint._COMMIT_LOCKS_GUARD")
 
 
 def _commit_lock(manifest_path):
@@ -152,7 +153,8 @@ def _commit_lock(manifest_path):
     with _COMMIT_LOCKS_GUARD:
         lock = _COMMIT_LOCKS.get(key)
         if lock is None:
-            lock = _COMMIT_LOCKS[key] = threading.Lock()
+            lock = _COMMIT_LOCKS[key] = _san.lock(
+                label="checkpoint.commit:" + key)
         return lock
 
 
@@ -305,8 +307,8 @@ class CheckpointManager:
             background = self.background
         if background:
             self._pending = [t for t in self._pending if t.is_alive()]
-            t = threading.Thread(target=self._write_and_commit_guarded,
-                                 args=(files, entry), daemon=True)
+            t = _san.thread(target=self._write_and_commit_guarded,
+                            args=(files, entry), daemon=True)
             self._pending.append(t)
             t.start()
         else:

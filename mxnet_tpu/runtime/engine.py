@@ -28,10 +28,11 @@ import threading
 import jax
 
 from ..config import get_env
+from .. import sanitizer as _san
 
 _naive = None   # None = consult MXNET_ENGINE_TYPE; bool = explicit
 _bulk_size = None  # None = consult MXNET_EXEC_BULK_EXEC_*; int override
-_exc_lock = threading.Lock()
+_exc_lock = _san.lock(label="engine._exc_lock")
 _pending_exceptions = []
 
 
@@ -41,7 +42,15 @@ def wait_all():
     Engine::WaitForAll / MXNDArrayWaitAll + exception chain rethrow)."""
     try:
         jax.effects_barrier()
-    except Exception:
+    except Exception as exc:
+        # older jax without effects_barrier (or a backend that rejects
+        # it): fall back to a trivial device sync, but keep the reason
+        # diagnosable — a real dispatch failure surfacing here must not
+        # vanish
+        import logging
+        logging.getLogger(__name__).debug(
+            "effects_barrier unavailable (%s: %s); falling back to "
+            "block_until_ready", type(exc).__name__, exc)
         jax.block_until_ready(jax.numpy.zeros(()))
     rethrow_pending()
 

@@ -1,0 +1,159 @@
+"""Bridge to the graftsan runtime sanitizer suite (tools/graftsan).
+
+Production code never imports ``tools.graftsan`` directly — it calls
+the factories and hooks here, which fall through to the plain
+``threading``/``queue`` primitives (or to no-ops) unless the matching
+component is enabled via ``MXNET_SAN`` (comma list of
+``race,recompile,donation,transfer``, or ``all``).  The off-path cost
+is one environment read at *creation* time and zero per access, so
+the wrappers can stay threaded through the hot subsystems
+unconditionally.
+
+``MXNET_SAN`` is consulted at call time (not import time) so the
+pytest ``--graftsan`` flag and per-test monkeypatching work; objects
+created while a component is off stay uninstrumented.
+
+The graftsan implementation lives in the repo's ``tools/`` tree (it is
+developer tooling, like graftlint); when the package is used without
+that tree, enabling ``MXNET_SAN`` raises a clear error instead of
+silently sanitizing nothing.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import queue as _queue
+import threading as _threading
+
+__all__ = ["enabled", "lock", "rlock", "condition", "event", "queue",
+           "thread", "track", "wrap_jit", "poison_donated",
+           "transfer_guard", "transfer_check"]
+
+_VALID = ("race", "recompile", "donation", "transfer")
+
+
+def enabled(component):
+    """Is a sanitizer component on?  (read from env each call)"""
+    raw = os.environ.get("MXNET_SAN", "").strip().lower()
+    if not raw or raw in ("0", "off", "none", "false"):
+        return False
+    if raw in ("1", "on", "all", "true"):
+        return True
+    return component in {p.strip() for p in raw.split(",")}
+
+
+def _graftsan():
+    """Import tools.graftsan (repo-root layout) with a clear failure."""
+    try:
+        import tools.graftsan as g
+        return g
+    except ImportError:
+        import sys
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        if root not in sys.path and \
+                os.path.isdir(os.path.join(root, "tools", "graftsan")):
+            sys.path.insert(0, root)
+            import tools.graftsan as g
+            return g
+        raise RuntimeError(
+            "MXNET_SAN is set but the graftsan suite (tools/graftsan) "
+            "is not importable — run from a repo checkout, or unset "
+            "MXNET_SAN")
+
+
+# -- race: instrumented primitive factories ---------------------------------
+
+def lock(label=None):
+    if enabled("race"):
+        return _graftsan().race.lock(label)
+    return _threading.Lock()
+
+
+def rlock(label=None):
+    if enabled("race"):
+        return _graftsan().race.rlock(label)
+    return _threading.RLock()
+
+
+def condition(lock=None, label=None):
+    if enabled("race"):
+        return _graftsan().race.condition(lock, label)
+    return _threading.Condition(lock)
+
+
+def event():
+    return _threading.Event()
+
+
+def queue(maxsize=0):
+    if enabled("race"):
+        return _graftsan().race.queue_(maxsize)
+    return _queue.Queue(maxsize)
+
+
+def thread(group=None, target=None, name=None, args=(), kwargs=None,
+           daemon=None):
+    if enabled("race"):
+        return _graftsan().race.thread(group=group, target=target,
+                                       name=name, args=args,
+                                       kwargs=kwargs, daemon=daemon)
+    # a factory hands ownership to its caller — the join/daemon
+    # obligation (JG011) sits at the call site, not here
+    return _threading.Thread(group=group, target=target,  # graftlint: disable=JG011
+                             name=name, args=args, kwargs=kwargs or {},
+                             daemon=daemon)
+
+
+def track(obj, attrs, label=None):
+    """Register *attrs* of *obj* with the lockset race tracker.
+    Call at the end of ``__init__``; no-op when race is off."""
+    if enabled("race"):
+        _graftsan().race.track_object(obj, attrs, label)
+    return obj
+
+
+# -- recompile ---------------------------------------------------------------
+
+def wrap_jit(fn, name):
+    """Watch a jitted callable for blamed cache misses; identity when
+    the recompile component is off."""
+    if enabled("recompile"):
+        return _graftsan().recompile.wrap_jit(fn, name)
+    return fn
+
+
+# -- donation ----------------------------------------------------------------
+
+def poison_donated(donated_leaves, site):
+    """After a donating dispatch: poison every stale NDArray alias of
+    *donated_leaves* so use-after-donate raises at the touch site."""
+    if enabled("donation"):
+        from .ndarray import NDArray
+        return _graftsan().donation.poison_stale_aliases(
+            donated_leaves, site, ndarray_cls=NDArray)
+    return 0
+
+
+# -- transfer ----------------------------------------------------------------
+
+def transfer_guard(label="hot path"):
+    """Context manager: device→host syncs inside raise.  nullcontext
+    when the transfer component is off."""
+    if enabled("transfer"):
+        return _graftsan().transfer.guard(label)
+    return contextlib.nullcontext()
+
+
+def transfer_check(what, shape=None):
+    """d2h choke-point hook (NDArray.asnumpy).  The caller guards on
+    :data:`_transfer_possible` so the off-path cost is one module
+    attribute read."""
+    _graftsan().transfer.check(what, shape)
+
+
+def _transfer_active():
+    """Is the calling thread inside a transfer-guarded region?"""
+    if not enabled("transfer"):
+        return False
+    return _graftsan().transfer.active()

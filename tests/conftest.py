@@ -29,6 +29,44 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_addoption(parser):
+    parser.addoption(
+        "--graftsan", action="store", nargs="?", const="all",
+        default=None, metavar="COMPONENTS",
+        help="enable the graftsan runtime sanitizers for the whole "
+             "run (sets MXNET_SAN before tests import mxnet_tpu): "
+             "comma list of race,recompile,donation,transfer, or "
+             "'all' when given bare.  Any sanitizer report fails the "
+             "session at the end.")
+
+
+def pytest_configure(config):
+    spec = config.getoption("--graftsan")
+    if spec:
+        # before collection imports mxnet_tpu, so module-level locks
+        # are created through the instrumented factories
+        os.environ["MXNET_SAN"] = spec
+
+
+@pytest.fixture(autouse=True)
+def _graftsan_reports(request):
+    """With --graftsan, any sanitizer report left behind by a test
+    fails THAT test (tests that deliberately provoke reports consume
+    them with graftsan.clear())."""
+    if not request.config.getoption("--graftsan"):
+        yield
+        return
+    import tools.graftsan as graftsan
+    before = len(graftsan.reports())
+    yield
+    found = graftsan.reports()[before:]
+    if found:
+        msgs = "\n".join(graftsan.format_report(r) for r in found)
+        graftsan.clear()
+        pytest.fail("graftsan: %d sanitizer report(s) during this "
+                    "test:\n%s" % (len(found), msgs), pytrace=False)
+
+
 @pytest.fixture(autouse=True)
 def _seed_rng():
     """Reproducible per-test seeding (reference:

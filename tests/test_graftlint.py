@@ -443,6 +443,183 @@ def test_jg009_exempts_the_atomic_writer_itself(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+def test_jg010_positive(tmp_path):
+    """An attribute written under self.lock in one method and bare in
+    another: the bare write is the finding."""
+    fs = lint(tmp_path, """\
+        import threading
+
+        class Server:
+            def __init__(self):
+                self.lock = threading.Lock()
+                self.updater = None      # construction: exempt
+
+            def apply(self, fn):
+                with self.lock:
+                    self.updater = fn    # guarded write
+
+            def set_opt(self, fn):
+                self.updater = fn        # bare write -> JG010
+        """, rules=["JG010"])
+    assert rule_ids(fs) == ["JG010"]
+    assert "updater" in fs[0].message and "self.lock" in fs[0].message
+
+
+def test_jg010_positive_sanitizer_factory_and_subscript(tmp_path):
+    """Locks created via the sanitizer bridge count, and subscript
+    writes (self.store[k] = v) are writes."""
+    fs = lint(tmp_path, """\
+        from mxnet_tpu import sanitizer as _san
+
+        class Store:
+            def __init__(self):
+                self.mu = _san.rlock()
+                self.store = {}
+
+            def put(self, k, v):
+                with self.mu:
+                    self.store[k] = v
+
+            def drop(self, k):
+                self.store[k] = None     # bare subscript write -> JG010
+        """, rules=["JG010"])
+    assert rule_ids(fs) == ["JG010"]
+
+
+def test_jg010_negative(tmp_path):
+    fs = lint(tmp_path, """\
+        import threading
+
+        class Clean:
+            def __init__(self):
+                self.lock = threading.Lock()
+                self.cv = threading.Condition()
+                self.state = 0
+                self.rounds = {}
+                self.solo = None
+
+            def bump(self):
+                with self.lock:
+                    self.state += 1      # always guarded
+
+            def arrive(self, r):
+                with self.cv:
+                    self.rounds = {r: 1}  # guarded by the condition
+
+            def rebind(self, v):
+                self.solo = v            # never guarded anywhere: no
+                                         # lock claims this attr
+
+        class NoLocks:
+            def __init__(self):
+                self.x = 0
+
+            def set(self, v):
+                self.x = v               # class has no locks at all
+        """, rules=["JG010"])
+    assert fs == []
+
+
+def test_jg011_positive_unowned_thread(tmp_path):
+    fs = lint(tmp_path, """\
+        import threading
+
+        def fire_and_forget(fn):
+            t = threading.Thread(target=fn)
+            t.start()                    # no daemon, never joined
+        """, rules=["JG011"])
+    assert rule_ids(fs) == ["JG011"]
+    assert "daemon" in fs[0].message
+
+
+def test_jg011_positive_shared_mutable_args(tmp_path):
+    fs = lint(tmp_path, """\
+        import threading
+
+        RESULTS = []
+
+        def collect(fn):
+            t = threading.Thread(target=fn, args=(RESULTS,),
+                                 daemon=True)
+            t.start()
+        """, rules=["JG011"])
+    assert rule_ids(fs) == ["JG011"]
+    assert "RESULTS" in fs[0].message
+
+
+def test_jg011_unrelated_join_does_not_count_as_ownership(tmp_path):
+    """os.path.join / str.join in the same scope must not satisfy the
+    join-ownership check — it is anchored to the thread's bound name."""
+    fs = lint(tmp_path, """\
+        import os
+        import threading
+
+        def spawn(fn, a, b):
+            p = os.path.join(a, b)
+            parts = ",".join([a, b])
+            t = threading.Thread(target=fn)
+            t.start()
+            return p, parts
+        """, rules=["JG011"])
+    assert rule_ids(fs) == ["JG011"]
+
+
+def test_jg010_acquire_release_counts_as_guarded(tmp_path):
+    """The acquire()/try/finally/release() idiom guards its writes just
+    like a with-block — no false positive."""
+    fs = lint(tmp_path, """\
+        import threading
+
+        class Disciplined:
+            def __init__(self):
+                self.lock = threading.Lock()
+                self.state = 0
+
+            def with_style(self, v):
+                with self.lock:
+                    self.state = v
+
+            def acquire_style(self, v):
+                self.lock.acquire()
+                try:
+                    self.state = v
+                finally:
+                    self.lock.release()
+        """, rules=["JG010"])
+    assert fs == []
+
+
+def test_jg011_negative(tmp_path):
+    fs = lint(tmp_path, """\
+        import threading
+        from mxnet_tpu import sanitizer as _san
+
+        def daemonized(fn):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+
+        def joined(fn):
+            t = threading.Thread(target=fn)
+            t.start()
+            t.join()
+
+        class Owner:
+            def start(self, fn):
+                self._t = _san.thread(target=fn)
+                self._t.start()
+
+            def stop(self):
+                self._t.join()           # class-scope ownership
+
+        def local_args(fn):
+            items = [1, 2]               # function-local, not shared
+            t = threading.Thread(target=fn, args=(items,), daemon=True)
+            t.start()
+        """, rules=["JG011"])
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
 # suppression + baseline workflow
 # ---------------------------------------------------------------------------
 

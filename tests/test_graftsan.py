@@ -1,0 +1,572 @@
+"""graftsan runtime sanitizer suite (tools/graftsan + the
+mxnet_tpu.sanitizer bridge).
+
+Covers: the race detector (deterministic barrier-choreographed lockset
+race, consistent-lock negative, lock-order cycle), the donation
+sanitizer (use-after-donate raises at the touch site through the real
+fused step), the transfer guard (.item()/asnumpy trip inside a guarded
+region, clean fused steps), the recompile sanitizer (dtype-flip blame,
+fused-path warmup stays one compile — pinning the committedness fix it
+found), zero-overhead-when-off, and regression tests for the real
+kvstore-server races the detector surfaced (updater/sync rebinding now
+locked).
+"""
+
+import socket
+import threading
+import warnings
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu import sanitizer as san
+from mxnet_tpu.io import DataBatch
+
+import tools.graftsan as graftsan
+from tools.graftsan import race as g_race
+from tools.graftsan.donation import UseAfterDonateError
+from tools.graftsan.transfer import HostTransferError
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    graftsan.clear()
+    g_race.reset()
+    yield
+    graftsan.clear()
+    g_race.reset()
+
+
+@pytest.fixture
+def race_on(monkeypatch):
+    monkeypatch.setenv("MXNET_SAN", "race")
+
+
+def _small_module():
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    net = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = sym.SoftmaxOutput(net, label, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind([("data", (4, 6))], [("softmax_label", (4,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd")
+    batch = DataBatch(data=[nd.ones((4, 6))], label=[nd.zeros((4,))])
+    return mod, batch
+
+
+# ---------------------------------------------------------------------------
+# spec parsing / activation plumbing
+# ---------------------------------------------------------------------------
+
+def test_parse_spec():
+    assert graftsan.parse_spec("") == frozenset()
+    assert graftsan.parse_spec("off") == frozenset()
+    assert graftsan.parse_spec("all") == frozenset(graftsan.COMPONENTS)
+    assert graftsan.parse_spec("on") == frozenset(graftsan.COMPONENTS)
+    assert graftsan.parse_spec("race, transfer") == {"race", "transfer"}
+    with pytest.raises(ValueError):
+        graftsan.parse_spec("race,typo")
+
+
+def test_bridge_enabled_reads_env(monkeypatch):
+    monkeypatch.delenv("MXNET_SAN", raising=False)
+    assert not san.enabled("race")
+    monkeypatch.setenv("MXNET_SAN", "race,donation")
+    assert san.enabled("race") and san.enabled("donation")
+    assert not san.enabled("transfer")
+    monkeypatch.setenv("MXNET_SAN", "all")
+    assert san.enabled("transfer")
+
+
+# ---------------------------------------------------------------------------
+# race detector
+# ---------------------------------------------------------------------------
+
+class _RacyFixture:
+    """The deliberately-racy class: counter written under DIFFERENT
+    locks from two threads."""
+
+    def __init__(self):
+        self.counter = 0
+
+
+def test_race_detector_fires_deterministically(race_on):
+    """Barrier-choreographed lockset race: t1 writes under lock A,
+    t2 writes under lock B, t1 writes under A again — the candidate
+    lockset drains to empty on the third access, deterministically."""
+    obj = g_race.track_object(_RacyFixture(), ("counter",), "RacyFixture")
+    la, lb = san.lock("A"), san.lock("B")
+    b1, b2 = threading.Barrier(2), threading.Barrier(2)
+
+    def t1():
+        with la:
+            obj.counter = 1
+        b1.wait()
+        b2.wait()
+        with la:
+            obj.counter = 3
+
+    def t2():
+        b1.wait()
+        with lb:
+            obj.counter = 2
+        b2.wait()
+
+    ts = [threading.Thread(target=t1), threading.Thread(target=t2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    rs = graftsan.reports("race")
+    assert len(rs) == 1, rs
+    assert rs[0].kind == "lockset"
+    assert "RacyFixture.counter" in rs[0].message
+    assert len(rs[0].stacks) == 2      # both threads' access stacks
+    # the report is emitted once, not per further access
+    with lb:
+        obj.counter = 4
+    assert len(graftsan.reports("race")) == 1
+
+
+def test_race_report_includes_offending_access(race_on):
+    """With 3+ threads, the report must contain the stack of the
+    access that drained the candidate lockset (dict insertion order
+    alone would keep two innocent threads' slots)."""
+    obj = g_race.track_object(_RacyFixture(), ("counter",), "ThreeWay")
+    la, lb = san.lock("A3"), san.lock("B3")
+    b1, b2 = threading.Barrier(2), threading.Barrier(2)
+
+    def t1_locked():
+        with la:
+            obj.counter = 1
+        b1.wait()
+        b2.wait()
+
+    def t2_then_offender():
+        b1.wait()
+        with lb:
+            obj.counter = 2
+        _offending_unlocked_write(obj)
+        b2.wait()
+
+    def _offending_unlocked_write(o):
+        o.counter = 3                  # no lock: drains the lockset
+
+    ts = [threading.Thread(target=t1_locked),
+          threading.Thread(target=t2_then_offender)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    rs = graftsan.reports("race")
+    assert len(rs) == 1
+    all_stacks = "".join(s for _, s in rs[0].stacks)
+    assert "_offending_unlocked_write" in all_stacks
+    # and stacks come from THIS test file, not filtered away
+    assert "test_graftsan.py" in all_stacks
+    graftsan.clear()
+
+
+def test_race_detector_quiet_under_consistent_lock(race_on):
+    obj = g_race.track_object(_RacyFixture(), ("counter",), "Consistent")
+    lk = san.lock("C")
+
+    def worker():
+        for _ in range(25):
+            with lk:
+                obj.counter += 1
+
+    ts = [threading.Thread(target=worker) for _ in range(3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    # the detector is strict: even this post-join read must hold the
+    # attribute's lock (an unlocked read from a fresh thread drains
+    # the candidate lockset — Eraser semantics)
+    with lk:
+        assert obj.counter == 75
+    assert graftsan.reports() == []
+
+
+def test_race_detector_quiet_on_single_thread_handoff(race_on):
+    """Construction + single-owner mutation then a clean handoff to
+    one other thread that takes a lock: no report (exclusive phase is
+    exempt; one locked access cannot drain the candidate set)."""
+    obj = g_race.track_object(_RacyFixture(), ("counter",), "Handoff")
+    obj.counter = 10                   # owner thread, no lock
+    lk = san.lock("H")
+
+    def consumer():
+        with lk:
+            obj.counter += 1
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    t.join()
+    assert graftsan.reports() == []
+
+
+def test_lock_order_cycle_detected(race_on):
+    """A->B in one code path, B->A in another: reported from the order
+    history alone — no actual deadlock schedule needed."""
+    l1, l2 = san.lock("L1"), san.lock("L2")
+    with l1:
+        with l2:
+            pass
+    assert graftsan.reports() == []    # one order alone is fine
+    with l2:
+        with l1:
+            pass
+    rs = graftsan.reports("race")
+    assert len(rs) == 1 and rs[0].kind == "lock-order"
+    assert "L1" in rs[0].message and "L2" in rs[0].message
+    graftsan.clear()
+
+
+def test_instrumented_primitives_behave(race_on):
+    """Wrappers keep threading semantics: reentrant RLock, condition
+    wait/notify, with-statement."""
+    rl = san.rlock("R")
+    with rl:
+        with rl:                      # reentrant
+            pass
+    cv = san.condition(label="CV")
+    hits = []
+
+    def waiter():
+        with cv:
+            cv.wait(timeout=5)
+            hits.append(1)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    import time
+    time.sleep(0.1)
+    with cv:
+        cv.notify_all()
+    t.join(timeout=5)
+    assert hits == [1]
+    assert graftsan.reports() == []
+
+
+# ---------------------------------------------------------------------------
+# donation sanitizer
+# ---------------------------------------------------------------------------
+
+def test_use_after_donate_raises_at_touch_site(monkeypatch):
+    """A stale NDArray alias of a donated param buffer raises
+    UseAfterDonateError naming the donation site; live handles and
+    updater interop stay valid."""
+    monkeypatch.setenv("MXNET_SAN", "donation")
+    from mxnet_tpu.ops import registry as reg
+    monkeypatch.setattr(reg, "supports_donation", lambda: True)
+    with warnings.catch_warnings():
+        # the CPU backend ignores donation with a UserWarning
+        warnings.simplefilter("ignore")
+        mod, batch = _small_module()
+        mod.forward_backward_update(batch)
+        ex = mod._exec_group.execs[0]
+        stale = mx.nd.NDArray(ex.arg_dict["fc1_weight"]._data)
+        mod.forward_backward_update(batch)   # donates the aliased buffer
+    with pytest.raises(UseAfterDonateError, match="fused train step"):
+        stale.asnumpy()
+    assert len(graftsan.reports("donation")) == 1
+    # the rebound container sees the new buffer, never the poison
+    assert ex.arg_dict["fc1_weight"].asnumpy().shape == (8, 6)
+    mod._sync_fused_to_updater()             # copied interop unaffected
+    graftsan.clear()
+
+
+def test_no_poison_without_donation(monkeypatch):
+    """On a backend without donation (plain CPU), aliases stay valid —
+    the sanitizer mirrors the declared donation, not a guess."""
+    monkeypatch.setenv("MXNET_SAN", "donation")
+    mod, batch = _small_module()
+    mod.forward_backward_update(batch)
+    ex = mod._exec_group.execs[0]
+    stale = mx.nd.NDArray(ex.arg_dict["fc1_weight"]._data)
+    mod.forward_backward_update(batch)
+    stale.asnumpy()                          # no donation -> no poison
+    assert graftsan.reports() == []
+
+
+# ---------------------------------------------------------------------------
+# transfer guard
+# ---------------------------------------------------------------------------
+
+def test_transfer_guard_trips_on_item(monkeypatch):
+    monkeypatch.setenv("MXNET_SAN", "transfer")
+    x = nd.ones((1,))
+    with san.transfer_guard("unit test region"):
+        with pytest.raises(HostTransferError, match="unit test region"):
+            x.item()
+    # outside the region the same read is fine
+    assert x.item() == 1.0
+    assert len(graftsan.reports("transfer")) == 1
+    graftsan.clear()
+
+
+def test_transfer_guard_nested_labels_restore(monkeypatch):
+    """After a nested guard exits, a trip in the still-active outer
+    region must blame the OUTER label."""
+    monkeypatch.setenv("MXNET_SAN", "transfer")
+    x = nd.ones((1,))
+    with san.transfer_guard("outer"):
+        with san.transfer_guard("inner"):
+            pass
+        with pytest.raises(HostTransferError, match="outer"):
+            x.item()
+    graftsan.clear()
+
+
+def test_transfer_guard_thread_local(monkeypatch):
+    """Another thread's asnumpy is unaffected by this thread's guard."""
+    monkeypatch.setenv("MXNET_SAN", "transfer")
+    x = nd.ones((2,))
+    got = []
+
+    def other():
+        got.append(x.asnumpy().sum())
+
+    with san.transfer_guard("main-thread region"):
+        t = threading.Thread(target=other)
+        t.start()
+        t.join()
+    assert got == [2.0]
+    assert graftsan.reports() == []
+
+
+def test_fused_step_clean_under_transfer_guard(monkeypatch):
+    """The fused hot path itself performs no guarded d2h syncs."""
+    monkeypatch.setenv("MXNET_SAN", "transfer")
+    mod, batch = _small_module()
+    for _ in range(3):
+        mod.forward_backward_update(batch)
+    assert graftsan.reports("transfer") == []
+
+
+# ---------------------------------------------------------------------------
+# recompile sanitizer
+# ---------------------------------------------------------------------------
+
+def test_recompile_blame_on_dtype_flip(monkeypatch):
+    monkeypatch.setenv("MXNET_SAN", "recompile")
+    import jax
+    import jax.numpy as jnp
+    fn = san.wrap_jit(jax.jit(lambda t: t["x"] * 2), "unit_fn")
+    fn({"x": jnp.ones(4, jnp.float32)})
+    fn({"x": jnp.ones(4, jnp.float32)})
+    assert graftsan.reports("recompile") == []
+    fn({"x": jnp.ones(4, jnp.float16)})      # dtype churn
+    rs = graftsan.reports("recompile")
+    assert len(rs) == 1
+    assert "unit_fn" in rs[0].message
+    assert "float32" in rs[0].message and "float16" in rs[0].message
+    assert "'x'" in rs[0].message            # the exact blamed leaf
+    graftsan.clear()
+
+
+def test_recompile_blame_on_shape_churn(monkeypatch):
+    monkeypatch.setenv("MXNET_SAN", "recompile")
+    import jax
+    import jax.numpy as jnp
+    fn = san.wrap_jit(jax.jit(lambda x: x + 1), "shape_fn")
+    fn(jnp.ones((4,)))
+    fn(jnp.ones((5,)))                       # miss, but call 2 = warmup? no:
+    rs = graftsan.reports("recompile")
+    assert len(rs) == 1 and "(4,)" in rs[0].message and \
+        "(5,)" in rs[0].message
+    graftsan.clear()
+
+
+def test_fused_step_one_compile_after_commit_fix(monkeypatch):
+    """Pin the committedness fix the sanitizer surfaced: five fused
+    steps = exactly ONE compile (uncommitted init params used to force
+    a silent full second compile at step 2)."""
+    monkeypatch.setenv("MXNET_SAN", "recompile")
+    mod, batch = _small_module()
+    for _ in range(5):
+        mod.forward_backward_update(batch)
+    assert graftsan.reports("recompile") == []
+    assert mod._fused["fn"]._cache_size() == 1
+
+
+def test_fused_step_one_compile_without_sanitizer(monkeypatch):
+    """The commit fix holds with sanitizers off too (raw jit handle)."""
+    monkeypatch.delenv("MXNET_SAN", raising=False)
+    from mxnet_tpu import profiler
+    mod, batch = _small_module()
+    for _ in range(5):
+        mod.forward_backward_update(batch)
+    assert mod._fused["fn"]._cache_size() == 1
+
+
+# ---------------------------------------------------------------------------
+# off = no wrappers, no overhead
+# ---------------------------------------------------------------------------
+
+def test_unset_means_no_wrappers(monkeypatch):
+    monkeypatch.delenv("MXNET_SAN", raising=False)
+    assert type(san.lock()) is type(threading.Lock())
+    assert type(san.rlock()) is type(threading.RLock())
+    assert isinstance(san.condition(), threading.Condition)
+    import queue as q
+    assert type(san.queue()) is q.Queue
+    assert type(san.thread(target=lambda: None)) is threading.Thread
+    # track() is a no-op: the class is not swapped
+    obj = _RacyFixture()
+    san.track(obj, ("counter",), "x")
+    assert type(obj) is _RacyFixture
+    # wrap_jit is identity
+    f = lambda: None
+    assert san.wrap_jit(f, "f") is f
+    # transfer guard is a nullcontext and the choke point stays silent
+    with san.transfer_guard():
+        assert nd.ones((1,)).item() == 1.0
+    # the fused path keeps a raw jit callable (no JitWatch proxy)
+    mod, batch = _small_module()
+    mod.forward_backward_update(batch)
+    from tools.graftsan.recompile import JitWatch
+    assert not isinstance(mod._fused["fn"], JitWatch)
+
+
+def test_server_primitives_plain_when_off(monkeypatch):
+    monkeypatch.delenv("MXNET_SAN", raising=False)
+    from mxnet_tpu._kvstore_impl import KVStoreServer
+    srv = KVStoreServer(sync_mode=True, num_workers=1)
+    try:
+        assert type(srv.lock) is type(threading.RLock())
+        assert isinstance(srv.cv, threading.Condition)
+        assert type(srv) is KVStoreServer       # no tracked subclass
+    finally:
+        srv.sock.close()
+
+
+# ---------------------------------------------------------------------------
+# the real fixed race: kvstore server updater/sync rebinding
+# ---------------------------------------------------------------------------
+
+def _drive_server(srv, port):
+    """Exercise the server through real sockets from several conn
+    threads: INIT/PUSH from one connection, SET_OPT + mode commands
+    from another, concurrently."""
+    from mxnet_tpu._kvstore_impl import (_rpc_call, _MSG_INIT, _MSG_PUSH,
+                                         _MSG_SET_OPT, _MSG_CMD,
+                                         _MSG_STOP, _MSG_PULL)
+    import pickle
+    run_t = threading.Thread(target=srv.run, daemon=True)
+    run_t.start()
+    try:
+        c1 = socket.create_connection(("127.0.0.1", port), timeout=10)
+        c2 = socket.create_connection(("127.0.0.1", port), timeout=10)
+        try:
+            _rpc_call(c1, _MSG_INIT, {"key": "w"},
+                      (np.zeros(4, np.float32),))
+            blob = np.frombuffer(
+                pickle.dumps(mx.optimizer.create(
+                    "sgd", learning_rate=1.0, rescale_grad=1.0, wd=0.0)),
+                np.uint8)
+            # async mode rejects pushes until an updater exists — set
+            # it once up front so the concurrent workout below only
+            # exercises the REBINDING discipline, not bootstrap order
+            _rpc_call(c2, _MSG_SET_OPT, None, (blob,))
+            barrier = threading.Barrier(2)
+            errs = []
+
+            def pusher():
+                try:
+                    barrier.wait()
+                    for _ in range(10):
+                        _rpc_call(c1, _MSG_PUSH, {"key": "w"},
+                                  (np.ones(4, np.float32) * -1,))
+                except Exception as e:          # surfaced below
+                    errs.append(e)
+
+            def controller():
+                try:
+                    barrier.wait()
+                    for _ in range(10):
+                        _rpc_call(c2, _MSG_SET_OPT, None, (blob,))
+                        _rpc_call(c2, _MSG_CMD,
+                                  {"head": "mode", "body": "dist_async"})
+                except Exception as e:
+                    errs.append(e)
+
+            ts = [threading.Thread(target=pusher),
+                  threading.Thread(target=controller)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert not errs, errs
+            out = _rpc_call(c1, _MSG_PULL, {"key": "w"})[1][0]
+            assert out.shape == (4,)
+            _rpc_call(c1, _MSG_STOP)
+        finally:
+            c1.close()
+            c2.close()
+    finally:
+        run_t.join(timeout=10)
+
+
+def test_server_shared_state_clean_under_race_detector(race_on):
+    """Regression for the unsynchronized updater/sync rebinding the
+    lockset detector surfaced: with the fix (SET_OPT and 'mode' take
+    self.lock; the PUSH-path sync read is locked), a concurrent
+    control-plane/push workout over a tracked server yields ZERO race
+    reports."""
+    from mxnet_tpu._kvstore_impl import KVStoreServer
+    srv = KVStoreServer(sync_mode=False, num_workers=1)
+    assert type(srv).__name__ == "KVStoreServer"
+    assert getattr(type(srv), "__graftsan_tracked__", False)
+    _drive_server(srv, srv.port)
+    races = [r for r in graftsan.reports("race")]
+    assert races == [], "\n".join(graftsan.format_report(r)
+                                  for r in races)
+
+
+def test_detector_catches_pre_fix_updater_pattern(race_on):
+    """The pattern the fix removed — rebinding a tracked attribute
+    WITHOUT the lock that other threads hold to read it — is exactly
+    what the detector reports (i.e. the finding was real, and a
+    regression of the fix would resurface here)."""
+
+    class MiniServer:
+        def __init__(self):
+            self.lock = san.lock("MiniServer.lock")
+            self.updater = None
+            g_race.track_object(self, ("updater",), "MiniServer")
+
+        def apply_locked(self):                # reader path (_apply)
+            with self.lock:
+                return self.updater
+
+        def set_opt_unlocked(self, fn):        # the OLD buggy handler
+            self.updater = fn
+
+    srv = MiniServer()
+    b1, b2 = threading.Barrier(2), threading.Barrier(2)
+
+    def conn1():
+        srv.apply_locked()
+        b1.wait()
+        b2.wait()
+        srv.apply_locked()
+
+    def conn2():
+        b1.wait()
+        srv.set_opt_unlocked(lambda: None)
+        b2.wait()
+
+    ts = [threading.Thread(target=conn1), threading.Thread(target=conn2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    rs = graftsan.reports("race")
+    assert len(rs) == 1 and "MiniServer.updater" in rs[0].message
+    graftsan.clear()

@@ -47,6 +47,53 @@ def test_wire_frame_roundtrip():
         b.close()
 
 
+def test_connect_retry_survives_refused_first_attempt():
+    """Regression for the dist-drill flakiness root cause: the worker's
+    connect-retry loop reused ONE socket across attempts, and on some
+    kernels/sandboxes a socket whose first connect() was REFUSED fails
+    every subsequent connect() with ECONNABORTED — so a worker that
+    started before its server bound could NEVER connect, no matter the
+    deadline.  _connect_retry takes a fresh socket per attempt: a
+    listener that binds 1s late must be reached well before the
+    deadline."""
+    import socket
+    import threading
+    from mxnet_tpu._kvstore_impl import _connect_retry
+
+    port = 9339
+    ready = threading.Event()
+
+    def late_bind():
+        time.sleep(1.0)
+        srv = socket.socket()
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", port))
+        srv.listen(4)
+        ready.set()
+        srv.accept()
+        srv.close()
+
+    t = threading.Thread(target=late_bind, daemon=True)
+    t.start()
+    t0 = time.time()
+    # guaranteed ≥1 refused attempt (nothing listens for the first 1s)
+    sock = _connect_retry("127.0.0.1", port, deadline=time.time() + 30)
+    try:
+        assert ready.is_set()
+        assert time.time() - t0 < 15, "retry should connect promptly"
+    finally:
+        sock.close()
+        t.join(timeout=5)
+
+
+def test_connect_retry_deadline_raises():
+    from mxnet_tpu._kvstore_impl import _connect_retry
+    t0 = time.time()
+    with pytest.raises(OSError):
+        _connect_retry("127.0.0.1", 9341, deadline=time.time() + 1.0)
+    assert time.time() - t0 < 10
+
+
 def test_local_push_pull():
     kv = mx.kv.create("local")
     kv.init(3, nd.ones((2, 3)))
@@ -216,9 +263,6 @@ def _run_dist(kv_type, n_workers, port):
     return outs
 
 
-@pytest.mark.slow   # ~35s multi-process dist drill, failing pre-existing
-# (see ROADMAP open items) — excluded from the budgeted tier-1 sweep; the
-# unfiltered ci/run_tests.sh pytest still runs it
 def test_dist_sync_kvstore():
     """Aggregated values bit-exact across workers (reference:
     tests/nightly/dist_sync_kvstore.py)."""
@@ -230,9 +274,6 @@ def test_dist_sync_kvstore():
         np.testing.assert_allclose(vals, [3.0] * 4)
 
 
-@pytest.mark.slow   # ~35s multi-process dist drill, failing pre-existing
-# (see ROADMAP open items) — excluded from the budgeted tier-1 sweep; the
-# unfiltered ci/run_tests.sh pytest still runs it
 def test_dist_async_kvstore():
     outs = _run_dist("dist_async", 2, 9159)
     total = None
@@ -371,9 +412,6 @@ if rank == 0:
 """
 
 
-@pytest.mark.slow   # ~35s multi-process dist drill, failing pre-existing
-# (see ROADMAP open items) — excluded from the budgeted tier-1 sweep; the
-# unfiltered ci/run_tests.sh pytest still runs it
 def test_dist_multi_server_sharding():
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     port = 9163
@@ -441,15 +479,17 @@ time.sleep(1.0)                      # heartbeats flow while alive
 """
 
 
-@pytest.mark.slow
 def test_dist_dead_node_detection_and_rejoin():
     """Heartbeat failure detection + stateless async rejoin.
 
-    slow-marked: ~60s of subprocess spin-up/teardown (the single most
-    expensive test in the tree), and order-dependent — it only passes
-    after the earlier dist tests in this file have run.  The full CI
-    run (ci/run_tests.sh) still exercises it; the budgeted tier-1
-    sweep (-m 'not slow') skips it."""
+    Previously slow-marked and order-dependent (failed solo): the
+    worker's connect-retry loop reused ONE socket across attempts, and
+    a first connect that lands before the server binds poisons the fd
+    on some kernels/sandboxes (every retry then dies ECONNABORTED
+    until the deadline) — in-suite, warm page caches made the server
+    bind fast enough to win the race.  Fixed by a fresh socket per
+    attempt (_kvstore_impl._connect_retry) plus the top-of-__init__
+    server bootstrap that halves spin-up; runs solo in ~10s now."""
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     port = 9165
     env = dict(os.environ)
@@ -515,15 +555,14 @@ def test_dist_dead_node_detection_and_rejoin():
         server.wait(timeout=30)
 
 
-@pytest.mark.slow
 def test_server_side_profiling():
     """rank-0 drives the profiler inside the server process
     (reference: tests/nightly/test_server_profiling.py,
     include/mxnet/kvstore.h:43-56).
 
-    slow-marked: ~60s of subprocess spin-up/teardown and
-    order-dependent (passes only in-suite) — see
-    test_dist_dead_node_detection_and_rejoin."""
+    Previously slow-marked and order-dependent — same root cause and
+    fix as test_dist_dead_node_detection_and_rejoin (fresh-socket
+    connect retry + fast server bootstrap)."""
     import tempfile
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     port = 9171

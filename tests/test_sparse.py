@@ -210,9 +210,11 @@ def test_sparse_linear_example_converges():
     assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
 
 
-@pytest.mark.slow   # ~35s multi-process dist drill, failing pre-existing
-# (see ROADMAP open items) — excluded from the budgeted tier-1 sweep; the
-# unfiltered ci/run_tests.sh pytest still runs it
+# previously slow-marked + failing: the dist worker's connect retry
+# reused one socket (poisoned after a refused first attempt on some
+# kernels/sandboxes) and server spin-up paid a double package import —
+# both fixed (see _kvstore_impl._connect_retry + top-of-__init__
+# bootstrap); ~25s multi-process drill, green solo and in-suite
 def test_sparse_linear_example_dist_converges():
     """row-sparse gradients + server-side optimizer + row_sparse_pull
     across 2 workers (reference: dist sparse linear_classification)."""

@@ -30,6 +30,12 @@ JG005  register_op contract violations (donate/num_outputs/needs_rng)
 JG006  silent overbroad exception handler in a dispatch path
 JG007  mutable default argument in public API
 JG008  jnp/jax backend-forcing call at module import time
+JG009  non-atomic persistence write (bypasses atomic_write)
+JG010  attribute written both with and without its guarding lock
+JG011  thread without join/daemon ownership or with shared mutable args
+
+JG010/JG011 are the static companions of the graftsan runtime
+sanitizer suite (tools/graftsan, docs/sanitizers.md).
 
 Suppress a single line with ``# graftlint: disable=JG003`` (comma-
 separate multiple IDs, or ``disable=all``).

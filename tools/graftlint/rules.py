@@ -24,6 +24,12 @@ DISPATCH_PREFIXES = (
     "mxnet_tpu/ops/registry.py", "mxnet_tpu/module/",
     "mxnet_tpu/optimizer/", "mxnet_tpu/symbol/", "mxnet_tpu/ndarray/",
     "mxnet_tpu/parallel/",
+    # threaded subsystems: a swallowed exception here doesn't just eat
+    # jax.errors — it eats graftsan sanitizer reports and leaves a
+    # worker blocked on a peer that silently died
+    "mxnet_tpu/_kvstore_impl.py", "mxnet_tpu/kvstore_server.py",
+    "mxnet_tpu/io/io.py", "mxnet_tpu/gluon/data/dataloader.py",
+    "mxnet_tpu/runtime/engine.py",
 )
 
 #: jax top-level calls that force backend/device initialization (JG008)
@@ -670,6 +676,258 @@ def check_jg009(project):
 
 
 # ---------------------------------------------------------------------------
+# JG010 — attribute written both with and without its guarding lock
+# ---------------------------------------------------------------------------
+
+#: calls whose result is a lock-like object when assigned to self.<attr>
+_LOCK_FACTORY_ATTRS = {"Lock", "RLock", "Condition",
+                       "lock", "rlock", "condition"}
+_LOCK_FACTORY_MODULES = ("threading", "mxnet_tpu.sanitizer")
+
+
+def _is_lock_factory(m, call):
+    if not isinstance(call, ast.Call):
+        return False
+    if not isinstance(call.func, ast.Attribute):
+        return False
+    if call.func.attr not in _LOCK_FACTORY_ATTRS:
+        return False
+    return _resolves_to_module(m, call.func, _LOCK_FACTORY_MODULES)
+
+
+def _self_attr(node):
+    """'a' for a ``self.a`` Attribute node, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _attr_writes(func_node, lock_attrs):
+    """(attr, node, frozenset of held self-lock attrs) for every
+    ``self.attr = ...`` / ``self.attr[k] = ...`` / ``self.attr += ...``
+    in *func_node*, tracking lexical ``with self.<lock>:`` nesting."""
+    out = []
+
+    def targets_of(stmt):
+        if isinstance(stmt, ast.Assign):
+            return stmt.targets
+        if isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            return [stmt.target]
+        return []
+
+    def lock_call(stmt):
+        """('acquire'|'release', lockattr) for a bare
+        self.<lock>.acquire()/.release() statement, else None."""
+        if not (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Call)
+                and isinstance(stmt.value.func, ast.Attribute)):
+            return None
+        f = stmt.value.func
+        la = _self_attr(f.value)
+        if la in lock_attrs and f.attr in ("acquire", "release"):
+            return f.attr, la
+        return None
+
+    def scan(body, held):
+        # linear acquire()/release() discipline at this nesting level:
+        # the try/finally idiom (acquire; try: write; finally:
+        # release) guards its try body just like a with-block would
+        cur = list(held)
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            lc = lock_call(stmt)
+            if lc is not None:
+                op, la = lc
+                if op == "acquire":
+                    cur = cur + [la]
+                elif la in cur:
+                    cur = [x for x in cur if x != la]
+                continue
+            for t in targets_of(stmt):
+                base = t.value if isinstance(t, ast.Subscript) else t
+                a = _self_attr(base)
+                if a is not None and a not in lock_attrs:
+                    out.append((a, t, frozenset(cur)))
+            if isinstance(stmt, ast.With):
+                acquired = []
+                for item in stmt.items:
+                    la = _self_attr(item.context_expr)
+                    if la in lock_attrs:
+                        acquired.append(la)
+                scan(stmt.body, cur + acquired)
+            else:
+                for attr in ("body", "orelse", "finalbody"):
+                    scan(getattr(stmt, attr, []) or [], cur)
+                for h in getattr(stmt, "handlers", []) or []:
+                    scan(h.body, cur)
+    scan(func_node.body, [])
+    return out
+
+
+def check_jg010(project):
+    out = []
+    for m in project.modules:
+        for cls, methods in m.classes.items():
+            # 1. the class's lock attributes
+            lock_attrs = set()
+            for fi in methods.values():
+                for n in body_walk(fi.node):
+                    if isinstance(n, ast.Assign) and \
+                            _is_lock_factory(m, n.value):
+                        for t in n.targets:
+                            a = _self_attr(t)
+                            if a is not None:
+                                lock_attrs.add(a)
+            if not lock_attrs:
+                continue
+            # 2. every non-__init__ write, with held-lock context
+            writes = {}   # attr -> [(method, node, heldset)]
+            for name, fi in methods.items():
+                if name == "__init__":
+                    continue    # construction is single-threaded
+                for a, node, held in _attr_writes(fi.node, lock_attrs):
+                    writes.setdefault(a, []).append((name, node, held))
+            # 3. guarded somewhere + bare somewhere else => report bare
+            for a, sites in writes.items():
+                guarded = sorted({l for _, _, held in sites
+                                  for l in held})
+                if not guarded:
+                    continue
+                for name, node, held in sites:
+                    if held:
+                        continue
+                    out.append(Finding(
+                        "JG010", m.relpath, node.lineno, node.col_offset,
+                        "%s.%s is written here without a lock, but "
+                        "other writes in this class hold self.%s — "
+                        "a concurrent reader/writer sees torn state; "
+                        "take the same lock (or document single-thread "
+                        "ownership and suppress)"
+                        % (cls, a, "/self.".join(guarded))))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# JG011 — thread started without join/daemon ownership, or handed
+# shared mutable module state
+# ---------------------------------------------------------------------------
+
+def _is_thread_factory(m, call):
+    if not isinstance(call, ast.Call):
+        return False
+    f = call.func
+    if not isinstance(f, ast.Attribute) or \
+            f.attr not in ("Thread", "thread"):
+        return False
+    return _resolves_to_module(m, f, ("threading", "mxnet_tpu.sanitizer"))
+
+
+def _kw(call, name):
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _module_mutables(m):
+    """Module-level names bound to mutable literals (shared state)."""
+    muts = set()
+    for n in m.tree.body:
+        if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                isinstance(n.targets[0], ast.Name):
+            v = n.value
+            if isinstance(v, (ast.List, ast.Dict, ast.Set)) or (
+                    isinstance(v, ast.Call)
+                    and isinstance(v.func, ast.Name)
+                    and v.func.id in ("list", "dict", "set",
+                                      "bytearray")):
+                muts.add(n.targets[0].id)
+    return muts
+
+
+def _jg011_thread_binding(m, fi, call):
+    """The name the Thread(...) result is bound to in *fi* — a plain
+    name ('t'), a 'self.<attr>' string, or None (unbound/indirect)."""
+    for n in body_walk(fi.node):
+        if isinstance(n, ast.Assign) and n.value is call and \
+                len(n.targets) == 1:
+            t = n.targets[0]
+            if isinstance(t, ast.Name):
+                return t.id
+            a = _self_attr(t)
+            if a is not None:
+                return "self." + a
+    return None
+
+
+def check_jg011(project):
+    out = []
+    for m in project.modules:
+        muts = None
+        for fi in m.functions:
+            for n in body_walk(fi.node):
+                if not (isinstance(n, ast.Call)
+                        and _is_thread_factory(m, n)):
+                    continue
+                # (a) ownership: daemon=True at creation, or a join()/
+                #     daemon=True ON THE BOUND NAME in the same scope
+                #     (or class, for self.<x> = Thread(...)).  The
+                #     match is anchored to the variable — a stray
+                #     os.path.join/str.join must not count as
+                #     ownership.
+                d = _kw(n, "daemon")
+                daemonized = isinstance(d, ast.Constant) and \
+                    d.value is True
+                if not daemonized:
+                    bound = _jg011_thread_binding(m, fi, n)
+                    owned = False
+                    if bound is not None:
+                        scope_fis = [fi]
+                        if bound.startswith("self.") and fi.class_name:
+                            scope_fis = list(m.classes.get(
+                                fi.class_name, {}).values())
+                        pat = re.compile(
+                            r"(?<![\w.])%s\s*\.\s*"
+                            r"(join\s*\(|daemon\s*=\s*True)"
+                            % re.escape(bound))
+                        for sfi in scope_fis:
+                            seg = "\n".join(m.lines[
+                                sfi.node.lineno - 1:
+                                getattr(sfi.node, "end_lineno",
+                                        sfi.node.lineno)])
+                            if pat.search(seg):
+                                owned = True
+                                break
+                    if not owned:
+                        out.append(Finding(
+                            "JG011", m.relpath, n.lineno, n.col_offset,
+                            "thread created in '%s' is neither daemon "
+                            "nor joined in this scope — it outlives "
+                            "its owner, keeps the process alive at "
+                            "exit, and its failures are silently "
+                            "dropped; pass daemon=True or own the "
+                            "join" % fi.qualname))
+                # (b) shared mutable module state passed as args
+                args_kw = _kw(n, "args")
+                if isinstance(args_kw, (ast.Tuple, ast.List)):
+                    if muts is None:
+                        muts = _module_mutables(m)
+                    for el in args_kw.elts:
+                        if isinstance(el, ast.Name) and el.id in muts:
+                            out.append(Finding(
+                                "JG011", m.relpath, el.lineno,
+                                el.col_offset,
+                                "thread target receives module-level "
+                                "mutable '%s' — shared default state "
+                                "mutated off-thread with no lock; "
+                                "pass a copy or guard it" % el.id))
+    return out
+
+
+# ---------------------------------------------------------------------------
 
 ALL_RULES = {
     "JG001": check_jg001,
@@ -681,6 +939,8 @@ ALL_RULES = {
     "JG007": check_jg007,
     "JG008": check_jg008,
     "JG009": check_jg009,
+    "JG010": check_jg010,
+    "JG011": check_jg011,
 }
 
 RULE_DOCS = {
@@ -705,4 +965,11 @@ RULE_DOCS = {
     "JG009": "non-atomic persistence write: open()-for-write/np.save*/"
              "pickle.dump of a checkpoint or optimizer-state path not "
              "routed through resilience.checkpoint.atomic_write",
+    "JG010": "shared attribute written both with and without the lock "
+             "that guards it elsewhere in the class — torn state under "
+             "concurrency (static companion of the graftsan lockset "
+             "race detector)",
+    "JG011": "thread started without join/daemon ownership, or handed "
+             "module-level mutable state through args (static "
+             "companion of the graftsan thread registry)",
 }

@@ -1,0 +1,60 @@
+"""graftsan — opt-in runtime sanitizer suite for the mxnet_tpu tree.
+
+graftlint (tools/graftlint) catches JAX hazards visible in the AST;
+graftsan catches the dynamic ones: unsynchronized shared state in the
+threaded subsystems, unexpected jit-cache churn, use of donated
+buffers, and silent device→host syncs in the training hot path.  The
+pairing mirrors how TVM and Glow back their compilers with
+verification tooling — statically where possible, dynamically where
+the AST can't see.
+
+Activation — zero overhead when off::
+
+    MXNET_SAN=race,recompile,donation,transfer   # or 'all' / 'on'
+    pytest --graftsan                            # tests/conftest.py flag
+
+Components
+----------
+race       instrumented Lock/RLock/Condition wrappers + an
+           Eraser-style per-object per-attribute lockset tracker
+           (empty lockset intersection across ≥2 threads with a write
+           ⇒ report with both stacks) + a lock-order cycle checker
+race.py, recompile.py, donation.py, transfer.py hold the components;
+report.py collects findings.  Production code reaches them only
+through the ``mxnet_tpu.sanitizer`` bridge, which no-ops (and never
+imports this package) unless ``MXNET_SAN`` enables a component.
+
+The static companions are graftlint's JG010 (attribute written both
+with and without the lock that guards it elsewhere) and JG011 (thread
+started without join/daemon ownership) — seeded from the patterns the
+runtime wrappers surfaced.  See docs/sanitizers.md.
+"""
+
+from __future__ import annotations
+
+import os
+
+from . import donation, race, recompile, report, transfer  # noqa: F401
+from .report import clear, format_report, reports  # noqa: F401
+
+__version__ = "1.0"
+
+COMPONENTS = ("race", "recompile", "donation", "transfer")
+
+
+def parse_spec(raw=None):
+    """``MXNET_SAN`` value -> frozenset of enabled components."""
+    if raw is None:
+        raw = os.environ.get("MXNET_SAN", "")
+    raw = (raw or "").strip().lower()
+    if not raw or raw in ("0", "off", "none", "false"):
+        return frozenset()
+    if raw in ("1", "on", "all", "true"):
+        return frozenset(COMPONENTS)
+    comps = frozenset(p.strip() for p in raw.split(",") if p.strip())
+    unknown = comps - frozenset(COMPONENTS)
+    if unknown:
+        raise ValueError(
+            "MXNET_SAN names unknown sanitizer component(s) %s "
+            "(known: %s)" % (sorted(unknown), ", ".join(COMPONENTS)))
+    return comps

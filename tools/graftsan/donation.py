@@ -1,0 +1,109 @@
+"""graftsan donation sanitizer.
+
+The fused train step donates the parameter and optimizer-state buffers
+(``donate_argnums``) — after dispatch, every *other* reference to those
+buffers points at memory XLA has already reused.  jax does raise on a
+deleted buffer eventually, but deep inside XLA with a message that
+names no one.  This component walks the live NDArray wrappers after a
+donating dispatch and **poisons** every stale alias: its ``_data`` is
+replaced with a proxy that raises :class:`UseAfterDonateError` at the
+touch site, naming the donation site and step.
+
+Poisoning keys on the *declared* donation (what was passed at donated
+argnum positions), not on whether the backend honored it — the CPU
+backend ignores donation, but code that aliases a donated buffer is
+already wrong on TPU, and the sanitizer's job is to catch that in CPU
+CI before it ships.
+"""
+
+from __future__ import annotations
+
+import gc
+
+from .report import capture_stack, report
+
+__all__ = ["UseAfterDonateError", "PoisonedBuffer", "poison_stale_aliases",
+           "poison_ndarray"]
+
+
+class UseAfterDonateError(RuntimeError):
+    """A buffer donated to an XLA program was touched afterwards."""
+
+
+class PoisonedBuffer:
+    """Stands in for a donated jax array; any use raises with the
+    donation site."""
+
+    __slots__ = ("_san_msg",)
+
+    def __init__(self, msg):
+        object.__setattr__(self, "_san_msg", msg)
+
+    def _raise(self):
+        msg = object.__getattribute__(self, "_san_msg")
+        report("donation", "use-after-donate", msg,
+               [("touch site", capture_stack())])
+        raise UseAfterDonateError(msg)
+
+    def __getattr__(self, name):
+        self._raise()
+
+    def __repr__(self):
+        return "<graftsan poisoned buffer: %s>" % \
+            object.__getattribute__(self, "_san_msg")
+
+    def __array__(self, *a, **kw):
+        self._raise()
+
+    def __bool__(self):
+        self._raise()
+
+    def __len__(self):
+        self._raise()
+
+    def __getitem__(self, key):
+        self._raise()
+
+    def __iter__(self):
+        self._raise()
+
+    def __float__(self):
+        self._raise()
+
+    def __int__(self):
+        self._raise()
+
+
+def poison_ndarray(arr, site):
+    """Poison one NDArray wrapper in place."""
+    msg = ("buffer of %s NDArray was donated to %s and must not be "
+           "touched afterwards — XLA reuses donated buffers for the "
+           "program's outputs; read the step's RESULT arrays instead, "
+           "or copy before the step" % (
+               getattr(arr, "shape", "?"), site))
+    arr._data = PoisonedBuffer(msg)
+    return arr
+
+
+def poison_stale_aliases(donated_leaves, site, ndarray_cls=None):
+    """Find every live NDArray whose ``_data`` is one of
+    *donated_leaves* (identity match) and poison it.
+
+    Runs only under ``MXNET_SAN=donation``, so the gc sweep's cost is
+    acceptable; the rebinding the framework does for its own containers
+    (arg_dict/aux_dict/updater states) happens BEFORE this call, so
+    anything still holding a donated leaf is a stale alias by
+    construction.  Returns the number of aliases poisoned."""
+    if ndarray_cls is None:
+        from mxnet_tpu.ndarray import NDArray as ndarray_cls
+    ids = {id(l) for l in donated_leaves if l is not None}
+    if not ids:
+        return 0
+    n = 0
+    for obj in gc.get_objects():
+        if isinstance(obj, ndarray_cls):
+            data = getattr(obj, "_data", None)
+            if data is not None and id(data) in ids:
+                poison_ndarray(obj, site)
+                n += 1
+    return n

@@ -1,0 +1,408 @@
+"""graftsan race detector.
+
+Three cooperating pieces:
+
+1. **Instrumented lock primitives** (:func:`lock`, :func:`rlock`,
+   :func:`condition`, :func:`event`, :func:`queue_`, :func:`thread`)
+   that production code creates through the ``mxnet_tpu.sanitizer``
+   bridge.  Each wrapper maintains the calling thread's *held-lock
+   set* and feeds the lock-order graph.
+
+2. **A lockset (Eraser-style) shared-attribute tracker**
+   (:func:`track_object`): production classes whose attributes are
+   touched from several threads register the attribute names; every
+   read/write records ``(thread, currently-held locks)``.  The
+   per-(object, attr) candidate lockset is the intersection of the
+   locksets of all accesses after the attribute became shared; an
+   empty candidate set once a second thread has *written* means no
+   single lock consistently guards the attribute — reported once,
+   with the stacks of both conflicting threads.  The state machine
+   (virgin → exclusive → shared → shared-modified) keeps
+   single-threaded construction and thread handoff quiet.
+
+3. **A lock-order (deadlock-cycle) checker**: acquiring B while
+   holding A records the edge A→B; an acquisition that closes a cycle
+   in the global edge graph is reported with both acquisition stacks,
+   whether or not the schedule actually deadlocked this run.
+
+Everything here is only imported when ``MXNET_SAN`` enables the race
+component — the production bridge falls back to the plain ``threading``
+primitives otherwise, so the off cost is one env check at *creation*
+time and zero per access.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue as _queue_mod
+import threading
+
+from .report import capture_stack, report
+
+__all__ = ["lock", "rlock", "condition", "event", "queue_", "thread",
+           "track_object", "held_locks", "reset"]
+
+_tls = threading.local()
+
+
+def _held():
+    h = getattr(_tls, "held", None)
+    if h is None:
+        h = _tls.held = []
+    return h
+
+
+def held_locks():
+    """Ids of instrumented locks the calling thread currently holds."""
+    return frozenset(l._san_id for l in _held())
+
+
+# ---------------------------------------------------------------------------
+# lock-order graph
+# ---------------------------------------------------------------------------
+
+_graph_lock = threading.Lock()      # deliberately raw: guards the detector
+_edges = {}          # lock id -> {successor lock id}
+_edge_sites = {}     # (a, b) -> (a label, b label, stack at first obs)
+_reported_cycles = set()
+_ids = itertools.count(1)
+
+
+def _note_acquire_order(lk):
+    held = _held()
+    if not held:
+        return
+    bid = lk._san_id
+    with _graph_lock:
+        for h in held:
+            aid = h._san_id
+            if aid == bid:
+                continue
+            succ = _edges.setdefault(aid, set())
+            if bid not in succ:
+                succ.add(bid)
+                _edge_sites[(aid, bid)] = (h._san_label, lk._san_label,
+                                           capture_stack())
+            # does bid already reach aid?  then aid->bid closes a cycle
+            if _reaches(bid, aid):
+                key = frozenset((aid, bid))
+                if key not in _reported_cycles:
+                    _reported_cycles.add(key)
+                    fwd = _edge_sites.get((aid, bid))
+                    rev = _edge_sites.get((bid, aid))
+                    stacks = []
+                    if fwd:
+                        stacks.append(("%s -> %s acquired here"
+                                       % (fwd[0], fwd[1]), fwd[2]))
+                    if rev:
+                        stacks.append(("%s -> %s acquired here"
+                                       % (rev[0], rev[1]), rev[2]))
+                    report(
+                        "race", "lock-order",
+                        "lock-order cycle: '%s' and '%s' are acquired "
+                        "in both orders — two threads interleaving "
+                        "these paths deadlock"
+                        % (h._san_label, lk._san_label), stacks)
+
+
+def _reaches(src, dst, _seen=None):
+    if src == dst:
+        return True
+    seen = _seen if _seen is not None else set()
+    seen.add(src)
+    for nxt in _edges.get(src, ()):
+        if nxt not in seen and _reaches(nxt, dst, seen):
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# instrumented primitives
+# ---------------------------------------------------------------------------
+
+class _InstrumentedLock:
+    """Wraps a real Lock/RLock; context-manager compatible."""
+
+    _reentrant = False
+
+    def __init__(self, label=None):
+        self._real = (threading.RLock() if self._reentrant
+                      else threading.Lock())
+        self._san_id = next(_ids)
+        self._san_label = label or ("%s#%d" % (
+            "RLock" if self._reentrant else "Lock", self._san_id))
+        self._depth = {}        # thread ident -> reentrant depth
+
+    def acquire(self, blocking=True, timeout=-1):
+        tid = threading.get_ident()
+        first = self._depth.get(tid, 0) == 0
+        if first:
+            _note_acquire_order(self)
+        got = self._real.acquire(blocking, timeout)
+        if got:
+            self._depth[tid] = self._depth.get(tid, 0) + 1
+            if first:
+                _held().append(self)
+        return got
+
+    def release(self):
+        tid = threading.get_ident()
+        self._real.release()
+        d = self._depth.get(tid, 1) - 1
+        if d:
+            self._depth[tid] = d
+        else:
+            self._depth.pop(tid, None)
+            held = _held()
+            if self in held:
+                held.remove(self)
+
+    def locked(self):
+        return self._real.locked() if hasattr(self._real, "locked") \
+            else bool(self._depth)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def __repr__(self):
+        return "<graftsan %s>" % self._san_label
+
+
+class _InstrumentedRLock(_InstrumentedLock):
+    _reentrant = True
+
+
+class _InstrumentedCondition:
+    """threading.Condition over an instrumented lock; ``wait`` hands
+    the lock back to the scheduler, so held-tracking pops/pushes
+    around it."""
+
+    def __init__(self, lock=None, label=None):
+        self._lk = lock if lock is not None else _InstrumentedRLock(
+            label=(label or "Condition") + ".lock")
+        self._real = threading.Condition(self._lk._real)
+        self._san_label = label or "Condition#%d" % self._lk._san_id
+
+    def acquire(self, *a, **kw):
+        return self._lk.acquire(*a, **kw)
+
+    def release(self):
+        self._lk.release()
+
+    def __enter__(self):
+        self._lk.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self._lk.release()
+
+    def _unheld(self):
+        held = _held()
+        if self._lk in held:
+            held.remove(self._lk)
+
+    def _reheld(self):
+        _held().append(self._lk)
+
+    def wait(self, timeout=None):
+        self._unheld()
+        try:
+            return self._real.wait(timeout)
+        finally:
+            self._reheld()
+
+    def wait_for(self, predicate, timeout=None):
+        self._unheld()
+        try:
+            return self._real.wait_for(predicate, timeout)
+        finally:
+            self._reheld()
+
+    def notify(self, n=1):
+        self._real.notify(n)
+
+    def notify_all(self):
+        self._real.notify_all()
+
+
+def lock(label=None):
+    return _InstrumentedLock(label)
+
+
+def rlock(label=None):
+    return _InstrumentedRLock(label)
+
+
+def condition(lock=None, label=None):
+    return _InstrumentedCondition(lock, label)
+
+
+def event():
+    # Event is already race-free by contract; returned raw so waiters
+    # are unaffected, kept in the API so the bridge covers the full set
+    return threading.Event()
+
+
+def queue_(maxsize=0):
+    # queue.Queue's internal mutex is a raw allocation (not routed
+    # through the bridge), so its hand-offs never pollute locksets;
+    # the queue itself is the synchronization, nothing to instrument
+    return _queue_mod.Queue(maxsize)
+
+
+_thread_sites = {}   # thread ident -> (name, creation stack)
+_thread_lock = threading.Lock()
+
+
+def thread(group=None, target=None, name=None, args=(), kwargs=None,
+           daemon=None):
+    """threading.Thread that registers its creation stack, so race
+    reports can say where a conflicting thread was started."""
+    site = capture_stack()
+    kwargs = kwargs or {}
+
+    def run(*a, **kw):
+        with _thread_lock:
+            _thread_sites[threading.get_ident()] = (
+                threading.current_thread().name, site)
+        return target(*a, **kw) if target is not None else None
+
+    return threading.Thread(group=group, target=run, name=name,
+                            args=args, kwargs=kwargs, daemon=daemon)
+
+
+def thread_site(ident):
+    with _thread_lock:
+        return _thread_sites.get(ident)
+
+
+# ---------------------------------------------------------------------------
+# lockset shared-attribute tracker (Eraser state machine)
+# ---------------------------------------------------------------------------
+
+VIRGIN, EXCLUSIVE, SHARED, SHARED_MOD = range(4)
+
+_state_lock = threading.Lock()   # raw: guards detector bookkeeping
+_tracked_classes = {}
+
+
+class _AttrState:
+    __slots__ = ("state", "owner", "lockset", "last", "reported")
+
+    def __init__(self):
+        self.state = VIRGIN
+        self.owner = None       # first-owner thread ident
+        self.lockset = None     # frozenset of lock ids, None until shared
+        self.last = {}          # ident -> (op, stack)
+        self.reported = False
+
+
+def _record_access(obj, attr, op):
+    d = object.__getattribute__(obj, "__dict__")
+    label = d.get("_graftsan_label", type(obj).__name__)
+    tid = threading.get_ident()
+    cur = held_locks()
+    stack = capture_stack()
+    with _state_lock:
+        states = d.setdefault("_graftsan_attr_state", {})
+        st = states.get(attr)
+        if st is None:
+            st = states[attr] = _AttrState()
+        st.last[tid] = (op, stack)
+        if st.state == VIRGIN:
+            st.state = EXCLUSIVE
+            st.owner = tid
+            return
+        if st.state == EXCLUSIVE:
+            if tid == st.owner:
+                return
+            # second thread: attribute became shared; candidate lockset
+            # starts from THIS access (the exclusive phase is exempt —
+            # single-threaded construction / clean handoff)
+            st.state = SHARED_MOD if op == "write" else SHARED
+            st.lockset = cur
+        else:
+            st.lockset = st.lockset & cur
+            if op == "write":
+                st.state = SHARED_MOD
+        if (st.state == SHARED_MOD and not st.lockset
+                and not st.reported and len(st.last) >= 2):
+            st.reported = True
+            # the CURRENT access is the one that drained the candidate
+            # lockset — it must be in the report (dict insertion order
+            # would keep an old slot for a re-accessing thread and
+            # could print two innocent threads instead)
+            others = [t for t in reversed(list(st.last)) if t != tid]
+            stacks = []
+            for t in (tid, others[0]):
+                o, s = st.last[t]
+                who = "thread %d (%s)" % (t, o)
+                site = thread_site(t)
+                if site:
+                    who += " started as %r" % site[0]
+                stacks.append((who, s))
+            report(
+                "race", "lockset",
+                "%s.%s is accessed from %d threads with no common "
+                "lock (at least one access is a write) — "
+                "unsynchronized shared state"
+                % (label, attr, len(st.last)), stacks)
+
+
+def _make_tracked_class(cls):
+    tracked = _tracked_classes.get(cls)
+    if tracked is not None:
+        return tracked
+
+    class Tracked(cls):
+        __graftsan_tracked__ = True
+
+        def __getattribute__(self, name):
+            value = super().__getattribute__(name)
+            if name.startswith("_graftsan"):
+                return value
+            attrs = object.__getattribute__(self, "__dict__").get(
+                "_graftsan_attrs")
+            if attrs is not None and name in attrs:
+                _record_access(self, name, "read")
+            return value
+
+        def __setattr__(self, name, value):
+            attrs = object.__getattribute__(self, "__dict__").get(
+                "_graftsan_attrs")
+            if attrs is not None and name in attrs:
+                _record_access(self, name, "write")
+            super().__setattr__(name, value)
+
+    Tracked.__name__ = cls.__name__
+    Tracked.__qualname__ = cls.__qualname__
+    _tracked_classes[cls] = Tracked
+    return Tracked
+
+
+def track_object(obj, attrs, label=None):
+    """Enable lockset tracking of *attrs* on *obj* (its class is
+    swapped for a cached tracked subclass).  Call at the END of
+    ``__init__`` — construction writes stay out of the analysis."""
+    cls = type(obj)
+    if getattr(cls, "__graftsan_tracked__", False):
+        cls = cls.__mro__[1]
+    d = object.__getattribute__(obj, "__dict__")
+    d["_graftsan_attrs"] = frozenset(attrs)
+    d["_graftsan_label"] = label or cls.__name__
+    obj.__class__ = _make_tracked_class(cls)
+    return obj
+
+
+def reset():
+    """Clear detector state (tests)."""
+    with _graph_lock:
+        _edges.clear()
+        _edge_sites.clear()
+        _reported_cycles.clear()
+    with _thread_lock:
+        _thread_sites.clear()

@@ -1,0 +1,120 @@
+"""graftsan recompile sanitizer.
+
+The fused-train-step contract (docs/perf_fused_step.md) is *one jitted
+dispatch and zero compiles per step after warmup*.  The profiler's
+always-on ``fused_step_compiles``/``*_dispatches`` counters observe
+violations, but they can't say WHY a step recompiled.  This component
+wraps a jitted callable, watches its jit cache, and on any cache miss
+after warmup diffs the call signature against the previous call's to
+blame the exact leaf (arg path, shape, dtype, weak-type, or static
+value) that churned.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from .report import capture_stack, report
+
+__all__ = ["JitWatch", "wrap_jit", "signature", "diff_signatures"]
+
+
+def _leaf_sig(x):
+    """Hashable description of one argument leaf.  Includes
+    committedness and device placement: jax keys its jit cache on them,
+    and an uncommitted-at-warmup array silently doubles compilation
+    (the exact bug this sanitizer caught in the fused step)."""
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        weak = getattr(x, "weak_type", False)
+        committed = getattr(x, "_committed", None)
+        sharding = getattr(x, "sharding", None)
+        devs = None
+        if sharding is not None:
+            try:
+                devs = tuple(sorted(d.id for d in sharding.device_set))
+            except Exception:
+                devs = str(sharding)
+        return ("array", tuple(shape), str(dtype), bool(weak),
+                committed, devs)
+    return ("static", type(x).__name__, repr(x)[:80])
+
+
+def signature(args, kwargs=None):
+    """{path: leaf signature} over the flattened call arguments."""
+    from jax.tree_util import tree_flatten_with_path, keystr
+    leaves, _ = tree_flatten_with_path((args, dict(kwargs or {})))
+    return {keystr(path): _leaf_sig(leaf) for path, leaf in leaves}
+
+
+def diff_signatures(prev, cur):
+    """Human-readable lines describing what changed between two call
+    signatures — array-metadata and pytree-structure changes first
+    (those retrace), plain scalar value changes last (those usually
+    don't; they matter only at static_argnums positions)."""
+    likely, unlikely = [], []
+    for path in sorted(set(prev) | set(cur)):
+        a, b = prev.get(path), cur.get(path)
+        if a == b:
+            continue
+        if a is None:
+            likely.append("  + %s: %r (new leaf — pytree structure "
+                          "changed)" % (path, b))
+        elif b is None:
+            likely.append("  - %s: %r (leaf gone — pytree structure "
+                          "changed)" % (path, a))
+        elif a[0] == "static" and b[0] == "static":
+            unlikely.append("  ? %s: %r -> %r (python scalar value — "
+                            "retraces only at a static_argnums "
+                            "position)" % (path, a, b))
+        else:
+            likely.append("  ~ %s: %r -> %r" % (path, a, b))
+    return likely + unlikely
+
+
+class JitWatch:
+    """Callable proxy over a jitted function that reports blamed cache
+    misses.  Transparent otherwise (``__getattr__`` delegates, so
+    ``_cache_size``/``lower``/... remain reachable)."""
+
+    def __init__(self, fn, name):
+        self._fn = fn
+        self._name = name
+        self._lock = threading.Lock()
+        self._last_sig = None
+        self._calls = 0
+
+    def __call__(self, *args, **kwargs):
+        size_of = getattr(self._fn, "_cache_size", None)
+        before = size_of() if size_of else None
+        out = self._fn(*args, **kwargs)
+        after = size_of() if size_of else None
+        sig = signature(args, kwargs)
+        with self._lock:
+            missed = (after is not None and before is not None
+                      and after > before)
+            if missed and self._calls >= 1 and self._last_sig is not None:
+                lines = diff_signatures(self._last_sig, sig)
+                why = "\n".join(lines) if lines else \
+                    "  (signature identical — miss caused by a new " \
+                    "callable identity or a cleared cache)"
+                report(
+                    "recompile", "cache-miss",
+                    "'%s' recompiled at call %d (jit cache %d -> %d). "
+                    "Churned leaves:\n%s"
+                    % (self._name, self._calls + 1, before, after, why),
+                    [("recompiling call", capture_stack())])
+            self._last_sig = sig
+            self._calls += 1
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self._fn, name)
+
+
+def wrap_jit(fn, name):
+    """Wrap *fn* (a jitted callable) in a :class:`JitWatch`."""
+    if isinstance(fn, JitWatch):
+        return fn
+    return JitWatch(fn, name)

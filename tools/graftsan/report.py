@@ -1,0 +1,89 @@
+"""graftsan report collector.
+
+Every sanitizer component funnels its findings through :func:`report`.
+Reports are collected (thread-safely) rather than raised: a sanitizer
+must observe the program, not alter its control flow — the exceptions
+are the donation poison and the transfer guard, which raise *at the
+touch site* by design (the whole point is a loud error where the bug
+is).  The pytest plugin and the CI smoke stage fail the run when
+:func:`reports` is non-empty at the end.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import traceback
+
+log = logging.getLogger("graftsan")
+
+#: frames to drop from report stacks: this package's own files and the
+#: mxnet_tpu.sanitizer bridge — matched by PATH, not substring, so
+#: user code that merely mentions graftsan (tests, the CI smoke
+#: script) keeps its frames
+_OWN_DIR = os.path.dirname(os.path.abspath(__file__)) + os.sep
+_BRIDGE_SUFFIX = os.path.join("mxnet_tpu", "sanitizer.py")
+
+__all__ = ["Report", "report", "reports", "clear", "format_report",
+           "capture_stack"]
+
+
+class Report:
+    """One sanitizer finding."""
+
+    __slots__ = ("component", "kind", "message", "stacks")
+
+    def __init__(self, component, kind, message, stacks=()):
+        self.component = component      # race | recompile | donation | ...
+        self.kind = kind                # e.g. 'lockset', 'lock-order'
+        self.message = message
+        #: list of (label, formatted stack string)
+        self.stacks = list(stacks)
+
+    def __repr__(self):
+        return "graftsan[%s/%s]: %s" % (self.component, self.kind,
+                                        self.message)
+
+
+_reports = []
+_lock = threading.Lock()
+
+
+def capture_stack(limit=14):
+    """A trimmed formatted stack of the calling thread, with graftsan's
+    own frames (and the bridge's) dropped — the report should point at
+    user code."""
+    frames = traceback.extract_stack()
+    frames = [f for f in frames
+              if not f.filename.startswith(_OWN_DIR)
+              and not f.filename.endswith(_BRIDGE_SUFFIX)]
+    return "".join(traceback.format_list(frames[-limit:]))
+
+
+def report(component, kind, message, stacks=()):
+    r = Report(component, kind, message, stacks)
+    with _lock:
+        _reports.append(r)
+    log.warning("%s", format_report(r))
+    return r
+
+
+def reports(component=None):
+    with _lock:
+        if component is None:
+            return list(_reports)
+        return [r for r in _reports if r.component == component]
+
+
+def clear():
+    with _lock:
+        _reports.clear()
+
+
+def format_report(r):
+    out = ["graftsan [%s/%s] %s" % (r.component, r.kind, r.message)]
+    for label, stack in r.stacks:
+        out.append("  -- %s:" % label)
+        out.extend("  | " + ln for ln in stack.rstrip().splitlines())
+    return "\n".join(out)

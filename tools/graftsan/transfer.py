@@ -1,0 +1,75 @@
+"""graftsan host-transfer guard.
+
+Marks a region of the training hot path (the fused/partial-fused step
+dispatch, the tree_opt sweep) as *transfer-free*: any device→host sync
+inside it raises :class:`HostTransferError` at the touch site instead
+of silently serializing the pipeline.
+
+Two layers, because the backends differ:
+
+* ``jax.transfer_guard_device_to_host('disallow')`` — catches raw
+  d2h copies on real device backends (TPU).  On the CPU backend a
+  "transfer" is zero-copy and never engages jax's guard, so this
+  layer alone is untestable in CPU CI.
+* an NDArray-level choke point — ``NDArray.asnumpy`` (which
+  ``asscalar``/``item``/``__float__``/``tolist`` all route through)
+  checks a thread-local depth and raises inside a guarded region.
+  This works on every backend and catches the framework-level sync
+  even when the buffer happens to live on host.
+
+Only the d2h direction is guarded: the fused step legitimately passes
+host scalars (lrs/wds/ts/step) as jit arguments, and a full
+``jax.transfer_guard('disallow')`` would reject those h2d constant
+uploads.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+from .report import capture_stack, report
+
+__all__ = ["HostTransferError", "guard", "check", "active"]
+
+
+class HostTransferError(RuntimeError):
+    """A device→host sync happened inside a transfer-guarded region."""
+
+
+_tls = threading.local()
+
+
+def active():
+    return getattr(_tls, "depth", 0) > 0
+
+
+@contextlib.contextmanager
+def guard(label="hot path"):
+    """Disallow device→host syncs in the dynamic extent."""
+    import jax
+    _tls.depth = getattr(_tls, "depth", 0) + 1
+    prev_label = getattr(_tls, "label", None)
+    _tls.label = label
+    try:
+        with jax.transfer_guard_device_to_host("disallow"):
+            yield
+    finally:
+        _tls.depth -= 1
+        # restore: a report raised later in a still-active OUTER region
+        # must name the outer label, not this exited one
+        _tls.label = prev_label
+
+
+def check(what, shape=None):
+    """Called from the NDArray d2h choke point; raises when guarded."""
+    if not active():
+        return
+    label = getattr(_tls, "label", "hot path")
+    msg = ("%s inside transfer-guarded region '%s' forces a device->host "
+           "sync%s — hot-path host reads serialize the device pipeline; "
+           "move the read outside the step or keep it device-side"
+           % (what, label,
+              " (shape %s)" % (shape,) if shape is not None else ""))
+    report("transfer", "d2h", msg, [("touch site", capture_stack())])
+    raise HostTransferError(msg)
