@@ -176,6 +176,49 @@ def _probe_peak_flops(iters=40, n=8192):
     return 2.0 * n ** 3 / per
 
 
+def _probe_peak_bw(mb=256, iters=16):
+    """Achievable HBM/memory bandwidth (bytes/s): a chained
+    elementwise add over an *mb*-megabyte f32 buffer — each scan step
+    reads and writes the whole buffer (2x its size in traffic) and
+    depends on the previous one, same short-vs-full readback
+    discipline as the flops probe.  This is the roofline denominator
+    the MFU decompose classifies ops against."""
+    import jax
+    import jax.numpy as jnp
+
+    n = max(1, int(mb * 1e6) // 4)
+    x = jnp.ones((n,), jnp.float32)
+
+    def chain(x, length):
+        def body(c, _):
+            return c + jnp.float32(1.0), None
+        c, _ = jax.lax.scan(body, x, None, length=length)
+        return jnp.sum(c)
+
+    short = jax.jit(lambda x: chain(x, iters // 4))
+    full = jax.jit(lambda x: chain(x, iters))
+    float(short(x))  # warm
+    float(full(x))
+    t0 = time.perf_counter()
+    float(short(x))
+    t_short = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    float(full(x))
+    t_full = time.perf_counter() - t0
+    per = (t_full - t_short) / (iters - iters // 4)
+    if per <= 0:
+        # a GC pause / scheduler hiccup during the millisecond-scale
+        # short run can make the delta non-positive; a None denominator
+        # degrades the decompose to flops-share-only (cost_table
+        # accepts it) instead of crashing the run or silently
+        # classifying every op against a negative balance point
+        print("bench: bandwidth probe degenerate (short %.4fs >= full "
+              "%.4fs) — no roofline denominator" % (t_short, t_full),
+              file=sys.stderr)
+        return None
+    return 2.0 * n * 4 / per
+
+
 def timed_resnet_train(batch, image, remat, iters, scan_n, warmup=2,
                        optimizer="lbsgd", multi_precision=True,
                        coalesce_small=None, momentum=0.9, stem=None):
@@ -276,13 +319,22 @@ def timed_train_steps(trainer, x, y, iters, scan_n, warmup=2):
     n = max(1, iters // scan_n) * scan_n
     trainer._params, trainer._opt_state, trainer._aux = p, s, a
 
-    # exact per-step FLOPs from the compiled program when available
+    # exact per-step FLOPs from the compiled program when available;
+    # the lowered StableHLO text rides along for the per-op MFU
+    # decompose (observability.costs — bench --decompose and the
+    # "decompose" key of the round artifact)
     flops = None
+    hlo_text = None
     try:
-        ca = trainer._step_fn.lower(
+        low = trainer._step_fn.lower(
             trainer._params, trainer._opt_state, trainer._aux,
             trainer._device_batch(x._data), y._data,
-            jax.random.PRNGKey(0), lr, t).compile().cost_analysis()
+            jax.random.PRNGKey(0), lr, t)
+        try:
+            hlo_text = low.as_text()
+        except Exception:
+            hlo_text = None
+        ca = low.compile().cost_analysis()
         if isinstance(ca, (list, tuple)):
             ca = ca[0]
         if ca and "flops" in ca:
@@ -290,7 +342,7 @@ def timed_train_steps(trainer, x, y, iters, scan_n, warmup=2):
     except Exception:
         pass
     return {"dt": dt, "iters": n, "flops_per_step": flops,
-            "final_loss": final_loss}
+            "final_loss": final_loss, "hlo_text": hlo_text}
 
 
 def timed_scan_forward(eval_fn, params, aux, xd, extra, scan_n, iters,
@@ -446,7 +498,56 @@ def compare_update_paths(n_layers=30, dim=64, batch=32, steps=30,
     return out
 
 
+def decompose_main():
+    """``--decompose``: lower the north-star train step, attribute its
+    cost per op against probed roofline peaks, print the human table
+    to stderr and ONE JSON line (BENCH schema: metric=mfu_decompose)
+    to stdout.  Runs on whatever platform ``_ensure_platform``
+    selects — CPU (BENCH_ALLOW_CPU=1) uses a small config, so CI can
+    smoke the whole decompose path in seconds."""
+    _ensure_platform()
+    import jax
+    from mxnet_tpu.observability import costs as _costs
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    batch = 128 if on_tpu else 8
+    image = 224 if on_tpu else 32
+    peak = _probe_peak_flops() if on_tpu else \
+        _probe_peak_flops(iters=8, n=1024)
+    bw = _probe_peak_bw() if on_tpu else _probe_peak_bw(mb=32)
+    r = timed_resnet_train(
+        batch, image, remat=None, iters=4 if on_tpu else 2,
+        scan_n=2, warmup=1, optimizer="lbsgd" if on_tpu else "sgd",
+        multi_precision=on_tpu)
+    if not r.get("hlo_text"):
+        print("bench: could not lower the train step for decompose",
+              file=sys.stderr)
+        return 1
+    table = _costs.cost_table(text=r["hlo_text"], peak_flops=peak,
+                              peak_bytes_s=bw, top=20)
+    print(_costs.format_table(table, limit=24), file=sys.stderr)
+    out = {
+        "metric": "mfu_decompose",
+        "batch_size": batch,
+        "image_size": image,
+        "device": getattr(dev, "device_kind", str(dev)),
+        "peak_flops_probe": peak,
+        "peak_bw_probe": bw,
+        "machine_balance": table["machine_balance"],
+        "total_flops": table["total_flops"],
+        "total_bytes": table["total_bytes"],
+        "flops_vs_xla": table.get("flops_vs_xla"),
+        "ms_per_step": round(r["dt"] / r["iters"] * 1e3, 2),
+        "rows": table["rows"],
+    }
+    print(json.dumps(out))
+    return 0
+
+
 def main():
+    if "--decompose" in sys.argv:
+        return decompose_main()
     if "--compare-update-paths" in sys.argv:
         # explicit A/B of the two update paths — a relative dispatch-
         # overhead measurement, so it ALWAYS runs on CPU: the shell's
@@ -483,11 +584,42 @@ def main():
     peak_probe = _probe_peak_flops() if on_tpu else None
     sustained = flops * iters / dt
     mfu = sustained / peak_probe if peak_probe else None
-    if mfu is not None:
-        assert 0.0 < mfu <= 1.0, (
+    mfu_error = None
+    if mfu is not None and not 0.0 < mfu <= 1.0:
+        # a broken probe (half-recovered tunnel, wedged clock) must
+        # not crash the WHOLE bench run and lose the throughput
+        # number with it: record mfu=null + a structured warning and
+        # keep going (the round artifact stays parseable)
+        mfu_error = (
             "MFU %.4f outside (0, 1] — measurement or probe is broken "
             "(sustained %.1f TF/s, probe %.1f TF/s)"
             % (mfu, sustained / 1e12, peak_probe / 1e12))
+        print("bench: " + mfu_error, file=sys.stderr)
+        from mxnet_tpu.observability import events as _obs_events
+        _obs_events.emit("warning", kind="mfu_probe_broken",
+                         mfu=round(mfu, 4), sustained_flops=sustained,
+                         peak_flops_probe=peak_probe)
+        mfu = None
+
+    # per-op cost attribution of the exact step just timed (rows name
+    # the op a round-over-round MFU regression blames; see
+    # docs/observability.md and bench --decompose for the full table)
+    decompose = None
+    if r.get("hlo_text"):
+        try:
+            from mxnet_tpu.observability import costs as _costs
+            peak_bw = _probe_peak_bw() if on_tpu else None
+            table = _costs.cost_table(text=r["hlo_text"],
+                                      peak_flops=peak_probe,
+                                      peak_bytes_s=peak_bw, top=12)
+            decompose = {
+                "machine_balance": table["machine_balance"],
+                "total_flops": table["total_flops"],
+                "total_bytes": table["total_bytes"],
+                "rows": table["rows"],
+            }
+        except Exception as e:
+            print("bench: decompose failed (%r)" % e, file=sys.stderr)
 
     out = {
         "metric": "resnet50_train_throughput",
@@ -503,6 +635,8 @@ def main():
         "device": getattr(dev, "device_kind", str(dev)),
         "flops_per_step": flops,
         "final_loss": final_loss,
+        "mfu_error": mfu_error,
+        "decompose": decompose,
     }
     print(json.dumps(out))
 

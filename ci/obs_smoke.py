@@ -1,0 +1,145 @@
+"""Observability CI smoke (ci/run_tests.sh stage).
+
+A short fused-step training run with ``MXNET_OBS=all``, asserting the
+telemetry contract end to end:
+
+* the expected instruments exist in the metrics registry with sane
+  values (fused dispatches == steps, latency histogram count == steps,
+  host transfers observed, exposition text parses),
+* ``events.jsonl`` exists, every line is well-formed JSON with the
+  required envelope (ts/ev/pid/seq), seq is gapless, and the run's
+  compile event is present,
+* ``profiler.dump()`` carries the registry instruments as chrome-trace
+  Counter events next to the spans.
+
+Seconds, CPU-only.  The last stdout line is the scrapeable summary
+(``obs: instruments=N events=M ok``), mirroring the graftlint and
+graftsan stages.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("MXNET_OBS", "all")
+_tmpdir = tempfile.mkdtemp(prefix="obs_smoke_")
+os.environ.setdefault("MXNET_OBS_PATH",
+                      os.path.join(_tmpdir, "events.jsonl"))
+
+import numpy as np  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import sym, profiler  # noqa: E402
+from mxnet_tpu.io import DataBatch  # noqa: E402
+from mxnet_tpu.observability import events, metrics  # noqa: E402
+
+STEPS = 8
+
+
+def build_module():
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    net = sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=4, name="fc2")
+    net = sym.SoftmaxOutput(net, label, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind([("data", (16, 8))], [("softmax_label", (16,))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    return mod
+
+
+def main():
+    failures = []
+    rng = np.random.RandomState(0)
+    batch = DataBatch(
+        data=[mx.nd.array(rng.randn(16, 8).astype(np.float32))],
+        label=[mx.nd.array(rng.randint(0, 4, (16,)).astype(np.float32))])
+
+    profiler.reset_counters()
+    mod = build_module()
+    for _ in range(STEPS):
+        mod.forward_backward_update(batch)
+    mod.get_outputs()[0].asnumpy()
+
+    # -- instruments ---------------------------------------------------
+    snap = metrics.snapshot()
+    expected = {
+        "fused_step_dispatches": lambda s: s["value"] == STEPS,
+        "fused_step_compiles": lambda s: s["value"] == 1,
+        "fused_step_dispatch_seconds": lambda s: s["count"] == STEPS,
+        "host_transfers_total": lambda s: s["value"] >= 1,
+        "host_transfer_bytes_total": lambda s: s["value"] >= 1,
+        "obs_events_total": lambda s: s["value"] >= 1,
+    }
+    for name, check in expected.items():
+        if name not in snap:
+            failures.append("instrument %r missing from the registry "
+                            "(have: %s)" % (name, sorted(snap)))
+        elif not check(snap[name]):
+            failures.append("instrument %r has unexpected value: %r"
+                            % (name, snap[name]))
+
+    # exposition must render and carry the fused-step counter
+    expo = metrics.exposition()
+    if "mxnet_fused_step_dispatches %d" % STEPS not in expo:
+        failures.append("exposition text lacks the fused-step counter")
+
+    # -- events.jsonl --------------------------------------------------
+    ev_path = events.path()
+    if not os.path.exists(ev_path):
+        failures.append("events.jsonl was not created at %s" % ev_path)
+        evs = []
+    else:
+        try:
+            evs = events.read_events(ev_path)
+        except ValueError as e:
+            failures.append("events.jsonl has a malformed line: %s" % e)
+            evs = []
+    for i, e in enumerate(evs):
+        for k in ("ts", "ev", "pid", "seq"):
+            if k not in e:
+                failures.append("event %d lacks %r: %r" % (i, k, e))
+                break
+    seqs = [e.get("seq") for e in evs]
+    if seqs != list(range(1, len(evs) + 1)):
+        failures.append("event seq is not gapless: %s" % seqs[:20])
+    if not any(e.get("ev") == "compile" and e.get("fn") == "fused_step"
+               for e in evs):
+        failures.append("no compile event for the fused step in %s"
+                        % [e.get("ev") for e in evs])
+
+    # -- profiler.dump carries the instruments -------------------------
+    trace_path = os.path.join(_tmpdir, "trace.json")
+    profiler.set_config(filename=trace_path)
+    profiler.set_state("run")
+    with profiler.scope("obs-smoke"):
+        pass
+    profiler.dump()
+    with open(trace_path) as f:
+        trace = json.load(f)
+    names = {e.get("name") for e in trace["traceEvents"]}
+    if "metrics/fused_step_dispatches" not in names:
+        failures.append("chrome trace lacks the registry Counter "
+                        "events (names: %s)" % sorted(names))
+    if "obs-smoke" not in names:
+        failures.append("chrome trace lost its span events")
+
+    if failures:
+        for f_ in failures:
+            print("obs smoke FAILURE: %s" % f_, file=sys.stderr)
+    print("obs: instruments=%d events=%d %s"
+          % (len(snap), len(evs), "FAIL" if failures else "ok"))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,6 +23,14 @@ echo "== graftsan: sanitizer-enabled smoke train step =="
 # contract.  Seconds, CPU-only (docs/sanitizers.md).
 MXNET_SAN=all python ci/graftsan_smoke.py
 
+echo "== observability: telemetry smoke train step =="
+# Short fused-step run with MXNET_OBS=all: asserts the expected
+# instruments exist with sane values, events.jsonl is well-formed
+# (gapless seq, compile event present), and profiler.dump() carries
+# the registry counters next to its spans.  Seconds, CPU-only; last
+# stdout line is the scrapeable summary ("obs: instruments=.. ...").
+MXNET_OBS=all python ci/obs_smoke.py
+
 echo "== resilience: chaos-injected fault drills =="
 # The resilience suite under the chaos harness: kill-mid-save,
 # corrupt-checkpoint, NaN-step, and preemption drills against the REAL

@@ -36,12 +36,33 @@ from . import ndarray as nd
 from . import sanitizer as _san
 from .ndarray import NDArray
 from .base import MXNetError
+from .observability import metrics as _metrics
 
 __all__ = ["create", "KVStoreBase"]
+
+# push/pull traffic instruments (module-level refs: these sit on the
+# per-step gradient exchange path).  For the local store "bytes" is
+# the logical value size moved through the aggregator; for the dist
+# store it is what actually crosses the wire (compressed/rsp pushes
+# count their packed size)
+_PUSH_BYTES = _metrics.counter(
+    "kvstore_push_bytes_total", "bytes pushed through kvstore")
+_PULL_BYTES = _metrics.counter(
+    "kvstore_pull_bytes_total", "bytes pulled through kvstore")
 
 
 def _as_list(v):
     return v if isinstance(v, (list, tuple)) else [v]
+
+
+def _value_bytes(arr):
+    """Logical payload size of an NDArray/numpy value (metadata only —
+    never forces a device sync)."""
+    data = getattr(arr, "_data", arr)
+    try:
+        return int(getattr(data, "nbytes", 0))
+    except (TypeError, ValueError):
+        return 0     # exotic nbytes (mock/lazy proxy): skip accounting
 
 
 class KVStoreBase:
@@ -164,6 +185,7 @@ class KVStoreLocal(KVStoreBase):
         keys, values = _key_list(key, value)
         for k, vs in zip(keys, values):
             merged = self._reduce(vs)
+            _PUSH_BYTES.inc(_value_bytes(merged))
             if isinstance(merged, _sp.BaseSparseNDArray):
                 merged = merged.todense()
             if self._updater is not None:
@@ -184,6 +206,7 @@ class KVStoreLocal(KVStoreBase):
             src = self._store[k]
             if isinstance(src, _sp.BaseSparseNDArray):
                 src = src.todense()
+            _PULL_BYTES.inc(_value_bytes(src) * len(os_))
             for o in os_:
                 src.copyto(o)
 
@@ -800,7 +823,17 @@ class KVStoreDist(KVStoreBase):
         s = (server if server is not None
              else self._server_for_key(key) if key is not None else 0)
         with self._locks[s]:
-            return _rpc_call(self._socks[s], kind, meta, tensors)
+            reply = _rpc_call(self._socks[s], kind, meta, tensors)
+        # wire-level traffic accounting (payload bytes, post
+        # compression/rsp packing — the number a capacity planner
+        # multiplies by worker count)
+        if kind == _MSG_PUSH and tensors:
+            _PUSH_BYTES.inc(sum(int(getattr(t, "nbytes", 0))
+                                for t in tensors))
+        elif kind in (_MSG_PULL, _MSG_ROWPULL) and reply[1]:
+            _PULL_BYTES.inc(sum(int(getattr(t, "nbytes", 0))
+                                for t in reply[1]))
+        return reply
 
     def _rpc_fanout(self, calls):
         """Round-trip one request per server CONCURRENTLY — sharded

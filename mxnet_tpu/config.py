@@ -91,6 +91,19 @@ register_env("MXNET_SAN", str, "",
              "graftsan runtime sanitizer components to enable: comma "
              "list of race,recompile,donation,transfer, or 'all'; "
              "empty = off (zero overhead; see docs/sanitizers.md)")
+register_env("MXNET_OBS", str, "",
+             "Structured run-event categories to record to "
+             "events.jsonl: comma list of compile,guard,chaos,"
+             "checkpoint,preempt,retry,respawn,warning, or 'all'; "
+             "empty = off (no file, zero per-event cost; see "
+             "docs/observability.md)")
+register_env("MXNET_OBS_PATH", str, "events.jsonl",
+             "Path of the structured run-event log (created lazily on "
+             "the first recorded event)")
+register_env("MXNET_OBS_RATE", int, 200,
+             "Max run events recorded per second; excess events are "
+             "counted and surfaced as 'dropped' on the next admitted "
+             "event (0 = uncapped)")
 register_env("MXNET_KVSTORE_BIGARRAY_BOUND", int, 1000000,
              "Arrays above this many elements shard across all servers "
              "(reference: kvstore_dist.h:58)")

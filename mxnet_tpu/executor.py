@@ -27,9 +27,16 @@ from .base import MXNetError, np_dtype
 from .context import Context, current_context
 from .ndarray import NDArray, zeros as nd_zeros
 from .ndarray.ndarray import _as_nd
+from .observability import metrics as _obs_metrics
 from .symbol.symbol import Symbol, _infer_shapes
 
 __all__ = ["Executor"]
+
+# module-level ref — observed every legacy train step (no registry
+# lookup per dispatch)
+_EXEC_STEP_SECONDS = _obs_metrics.histogram(
+    "executor_step_dispatch_seconds",
+    "host-side latency of one legacy forward+backward dispatch")
 
 # differentiable-leaf suffix for Embedding sparse_grad perturbations
 # (train_step diff keys; see ops/sparse_graph.py SparseGradWeight)
@@ -572,10 +579,16 @@ class Executor:
             key = self._next_key()
         # None cotangents must be materialized as ones for jit
         from . import profiler as _prof
+        import time as _time
         _prof.bump_counter("executor_dispatches")
+        t0 = _time.perf_counter()
         outs, auxu, grads = self._jit_train_step(
             arg_map, aux_map, key,
             _materialize(cots, self, arg_map, aux_map))
+        # host-side latency to issue the legacy (non-fused)
+        # forward+backward program — the fused path's histogram twin,
+        # so an A/B of the two update paths is one scrape away
+        _EXEC_STEP_SECONDS.observe(_time.perf_counter() - t0)
         for n, v in auxu.items():
             self.aux_dict[n]._data = v
         self.outputs = [_wrap_out(o) for o in outs]

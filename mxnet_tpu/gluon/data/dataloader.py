@@ -29,6 +29,12 @@ log = logging.getLogger(__name__)
 
 from ... import ndarray as nd
 from ... import sanitizer as _san
+from ...observability import metrics as _obs_metrics
+
+# module-level ref — sampled once per consumed batch
+_INFLIGHT_BATCHES = _obs_metrics.gauge(
+    "dataloader_inflight_batches",
+    "batches issued to DataLoader workers but not yet consumed")
 from ...ndarray import NDArray
 from .sampler import SequentialSampler, RandomSampler, BatchSampler
 
@@ -213,6 +219,8 @@ class _MultiWorkerIter:
         if self._respawns + len(dead) > self._max_respawns:
             return False
         from ...resilience.retry import retry_call
+        from ...observability import events as _obs_events
+        from ...observability import metrics as _metrics
         for i in dead:
             w = self._workers[i]
             log.warning("DataLoader worker pid=%s died (exitcode=%s); "
@@ -220,6 +228,12 @@ class _MultiWorkerIter:
                         w.exitcode, self._respawns + 1,
                         self._max_respawns)
             self._respawns += 1
+            _metrics.counter("dataloader_worker_respawns_total",
+                             "dead DataLoader workers respawned").inc()
+            _obs_events.emit("respawn", what="dataloader_worker",
+                             slot=i, pid=w.pid, exitcode=w.exitcode,
+                             used=self._respawns,
+                             budget=self._max_respawns)
             # the dead worker's queue may be semaphore-poisoned (killed
             # mid-get) — discard it wholesale
             self._work_qs[i] = self._ctx.Queue()
@@ -245,6 +259,10 @@ class _MultiWorkerIter:
     _STALL_LIMIT_S = 60
 
     def __next__(self):
+        # queue depth = batches issued to workers but not yet consumed
+        # (sampled per batch: a scraper watching this gauge fall to 0
+        # has found an input-bound training loop)
+        _INFLIGHT_BATCHES.set(self._sent - self._rcvd)
         if self._rcvd == self._sent:
             self.shutdown()
             raise StopIteration

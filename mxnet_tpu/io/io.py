@@ -26,6 +26,12 @@ from ..base import np_dtype
 from .. import ndarray as nd
 from .. import sanitizer as _san
 from ..ndarray import NDArray
+from ..observability import metrics as _obs_metrics
+
+# module-level ref — sampled once per consumed batch
+_PREFETCH_DEPTH = _obs_metrics.gauge(
+    "prefetch_queue_depth",
+    "batches buffered in the PrefetchingIter producer queue")
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "MNISTIter", "CSVIter", "LibSVMIter"]
@@ -396,6 +402,9 @@ class PrefetchingIter(DataIter):
             batch, self._peek = self._peek, None
             self.current_batch = batch
             return batch
+        # depth sampled per batch; 0 here = consumer outrunning the
+        # producer thread (input-bound step)
+        _PREFETCH_DEPTH.set(self._queue.qsize())
         item = self._queue.get()
         if item is None:
             raise StopIteration

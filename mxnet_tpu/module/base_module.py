@@ -205,6 +205,10 @@ class BaseModule:
                 if resilience.preemption_requested(tick=True):
                     # finish-the-batch semantics: the step and its
                     # callbacks completed; checkpoint and exit cleanly
+                    from ..observability import events as _obs_events
+                    _obs_events.emit(
+                        "preempt", epoch=epoch, batch=nbatch,
+                        checkpointing=checkpoint_manager is not None)
                     self.logger.warning(
                         "preemption requested: checkpointing after "
                         "epoch %d batch %d and exiting fit", epoch,

@@ -26,8 +26,19 @@ from ..ndarray import NDArray
 from .. import optimizer as opt
 from ..initializer import InitDesc
 from ..model import load_checkpoint
+from ..observability import metrics as _obs_metrics
 
 __all__ = ["Module"]
+
+# module-level instrument refs: these observe every train step, so
+# they must not pay a registry lookup per dispatch (same discipline as
+# the asnumpy counters in ndarray.py)
+_FUSED_STEP_SECONDS = _obs_metrics.histogram(
+    "fused_step_dispatch_seconds",
+    "host-side latency of one full-fused train-step dispatch")
+_TREE_APPLY_SECONDS = _obs_metrics.histogram(
+    "tree_apply_dispatch_seconds",
+    "host-side latency of one partial-fused tree-update dispatch")
 
 
 class _ExecGroup:
@@ -681,9 +692,13 @@ class Module(BaseModule):
             self._guard_consec = 0
             return
         from .. import profiler as _prof
+        from ..observability import events as _obs_events
         self._guard_skipped += 1
         self._guard_consec += 1
         _prof.bump_counter("guard_skipped_steps")
+        _obs_events.emit("guard", step=self._step_seq,
+                         consecutive=self._guard_consec,
+                         total_skipped=self._guard_skipped)
         self.logger.warning(
             "non-finite loss/gradients: optimizer update skipped "
             "(%d consecutive, %d total)", self._guard_consec,
@@ -695,7 +710,12 @@ class Module(BaseModule):
 
     def _on_divergence(self, guard):
         from ..resilience import DivergenceError
+        from ..observability import events as _obs_events
         action = guard.get("action", "raise")
+        _obs_events.emit(
+            "guard", divergence=True, step=self._step_seq,
+            action=action if isinstance(action, str) else "callable",
+            total_skipped=self._guard_skipped)
         if callable(action):
             action(self)
             return
@@ -883,9 +903,10 @@ class Module(BaseModule):
             from ..ops.registry import supports_donation
             ctx["mode"] = "full"
             ctx["donates"] = supports_donation()
-            ctx["fn"] = _sanitizer.wrap_jit(
+            from ..observability import events as _obs_events
+            ctx["fn"] = _obs_events.watch_jit(_sanitizer.wrap_jit(
                 ex0.init_fused_step(tree_update, guard_nonfinite=guard),
-                "fused_step")
+                "fused_step"), "fused_step")
         else:
             import jax
             from .. import profiler as _prof
@@ -903,8 +924,10 @@ class Module(BaseModule):
             donate = (1, 2) if supports_donation() else ()
             ctx["mode"] = "partial"
             ctx["donates"] = bool(donate)
-            ctx["fn"] = _sanitizer.wrap_jit(
-                jax.jit(tree_apply, donate_argnums=donate), "tree_apply")
+            from ..observability import events as _obs_events
+            ctx["fn"] = _obs_events.watch_jit(_sanitizer.wrap_jit(
+                jax.jit(tree_apply, donate_argnums=donate),
+                "tree_apply"), "tree_apply")
         self._fused = ctx
 
     def _import_fused_state(self):
@@ -982,10 +1005,16 @@ class Module(BaseModule):
         # advances every step — num_update only ratchets via max() and
         # can stall when the optimizer is shared with a module trained
         # further, which would replay the same dropout masks
+        import time as _time
+        t0 = _time.perf_counter()
         with _sanitizer.transfer_guard("fused train step"):
             res = ctx["fn"](
                 params, rest, ex._aux_map(), ex._key, self._fused_state,
                 lrs, wds, ts, max(ts.values()))
+        # async dispatch latency: host time to ISSUE the one donated
+        # program (execution completes on-device; a blow-up here means
+        # tracing/recompiling snuck into the step)
+        _FUSED_STEP_SECONDS.observe(_time.perf_counter() - t0)
         if ctx["guard"]:
             outs, new_aux, new_params, new_state, skipped = res
         else:
@@ -1043,9 +1072,12 @@ class Module(BaseModule):
             import jax as _jax
             donated = list(params.values()) + \
                 _jax.tree_util.tree_leaves(self._fused_state)
+        import time as _time
+        t0 = _time.perf_counter()
         with _sanitizer.transfer_guard("partial-fused tree update"):
             res = ctx["fn"](grads, params, self._fused_state, lrs, wds,
                             ts)
+        _TREE_APPLY_SECONDS.observe(_time.perf_counter() - t0)
         if ctx["guard"]:
             new_params, new_state, skipped = res
         else:

@@ -24,6 +24,17 @@ from ..context import Context, current_context
 from ..ops import registry as _reg
 from .. import autograd as _ag
 from .. import sanitizer as _sanitizer
+from ..observability import metrics as _metrics
+
+# module-level instrument refs: asnumpy is the framework's d2h choke
+# point (asscalar/item/tolist/__float__ route through it), so the
+# counters it bumps must not pay a registry lookup per call
+_HOST_TRANSFERS = _metrics.counter(
+    "host_transfers_total",
+    "device->host syncs through the asnumpy choke point")
+_HOST_TRANSFER_BYTES = _metrics.counter(
+    "host_transfer_bytes_total",
+    "bytes moved device->host through asnumpy")
 
 __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
            "zeros_like", "ones_like", "concatenate", "imperative_invoke",
@@ -116,6 +127,10 @@ class NDArray:
         sync — the check is one env read, invisible next to the copy."""
         if _sanitizer._transfer_active():
             _sanitizer.transfer_check("asnumpy()", self._data.shape)
+        # same choke point feeds the always-on transfer telemetry:
+        # count + bytes (shape metadata only — no extra sync)
+        _HOST_TRANSFERS.inc()
+        _HOST_TRANSFER_BYTES.inc(int(getattr(self._data, "nbytes", 0)))
         return _np.asarray(self._data)
 
     def asscalar(self):

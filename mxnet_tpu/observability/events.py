@@ -1,0 +1,301 @@
+"""Structured run-event log — ``events.jsonl``.
+
+A durable, append-only record of the events that explain a failed or
+slow run after the fact: compiles (with blame when derivable), guard
+trips, chaos injections, preemptions, retries, dataloader respawns and
+checkpoint commits.  One JSON object per line::
+
+    {"ts": 1722700000.123, "ev": "guard", "pid": 4242, "seq": 17, ...}
+
+**Off by default, zero per-event cost when off.**  The ``MXNET_OBS``
+env knob mirrors ``MXNET_SAN``: unset/``0``/``off`` disables
+everything (``emit`` is one cached-env check and returns); ``all``/
+``1``/``on`` records every category; a comma list
+(``MXNET_OBS=compile,guard,checkpoint``) records only those.  The
+writer is created lazily on the first recorded event — with the knob
+unset no file is ever opened.
+
+Categories: ``compile``, ``guard``, ``chaos``, ``checkpoint``,
+``preempt``, ``retry``, ``respawn``, ``warning`` (plus anything a
+caller passes — unknown categories are recorded when ``all`` is on).
+
+Durability discipline (the same machinery family as
+``resilience.checkpoint``): each line is ONE ``os.write`` on an
+``O_APPEND`` fd — the kernel serializes appends, so concurrent
+threads and even a second process on the same path never interleave
+bytes mid-line — and the directory is fsynced once when the file is
+created (``resilience.checkpoint.fsync_dir``).  A crash can lose at
+most the final unflushed line, never tear an earlier one.
+
+Rate cap: at most ``MXNET_OBS_RATE`` events per second (default 200;
+0 = uncapped).  Excess events are counted, not queued, and the next
+admitted event carries ``"dropped": N`` so the gap is visible in the
+log itself.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from .. import sanitizer as _san
+from . import metrics as _metrics
+
+__all__ = ["enabled", "emit", "emitter", "watch_jit", "configure",
+           "path", "read_events"]
+
+_CATEGORIES = ("compile", "guard", "chaos", "checkpoint", "preempt",
+               "retry", "respawn", "warning")
+
+
+def _spec():
+    raw = os.environ.get("MXNET_OBS", "").strip().lower()
+    if not raw or raw in ("0", "off", "none", "false"):
+        return None
+    if raw in ("1", "on", "all", "true"):
+        return "all"
+    return frozenset(p.strip() for p in raw.split(",") if p.strip())
+
+
+def enabled(category=None):
+    """Is event recording on (for *category*, or at all)?  Read from
+    the environment each call, like ``sanitizer.enabled`` — tests and
+    the pytest harness monkeypatch ``MXNET_OBS`` freely."""
+    spec = _spec()
+    if spec is None:
+        return False
+    if spec == "all" or category is None:
+        return True
+    return category in spec
+
+
+class _Writer:
+    """Appending JSONL writer: O_APPEND single-write lines, creation
+    fsync, token-bucket rate cap, monotonically increasing ``seq``."""
+
+    def __init__(self, path, rate):
+        self._path = path
+        self._rate = rate
+        self._fd = None
+        self._lock = _san.lock(label="obs.events.writer")
+        self._seq = 0
+        self._dropped = 0
+        self._window_start = 0.0
+        self._window_count = 0
+
+    def _open(self):
+        # only reached from write() with self._lock held
+        dirname = os.path.dirname(os.path.abspath(self._path))
+        created = not os.path.exists(self._path)
+        self._fd = os.open(  # graftlint: disable=JG010
+            self._path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        if created:
+            from ..resilience.checkpoint import fsync_dir
+            fsync_dir(dirname)
+
+    def write(self, category, fields):
+        now = time.time()
+        # the rate window runs on the monotonic clock: an NTP step
+        # backward must not freeze a saturated window (only the ts
+        # FIELD wants wall time)
+        mono = time.monotonic()
+        with self._lock:
+            if self._rate > 0:
+                if mono - self._window_start >= 1.0:
+                    self._window_start = mono
+                    self._window_count = 0
+                if self._window_count >= self._rate:
+                    self._dropped += 1
+                    _metrics.counter(
+                        "obs_events_dropped_total",
+                        "events over the MXNET_OBS_RATE cap").inc()
+                    return False
+                self._window_count += 1
+            if self._fd is None:
+                self._open()
+            self._seq += 1
+            rec = {"ts": round(now, 6), "ev": category,
+                   "pid": os.getpid(), "seq": self._seq}
+            if self._dropped:
+                rec["dropped"] = self._dropped
+                self._dropped = 0
+            rec.update(fields)
+            line = json.dumps(rec, default=_json_fallback,
+                              separators=(",", ":")) + "\n"
+            os.write(self._fd, line.encode("utf-8"))
+        _metrics.counter("obs_events_total",
+                         "structured run events written").inc()
+        return True
+
+    def close(self):
+        with self._lock:
+            if self._fd is not None:
+                os.close(self._fd)
+                self._fd = None
+
+
+def _json_fallback(obj):
+    """Events must never fail to serialize — degrade to repr."""
+    try:
+        return repr(obj)[:200]
+    except Exception:
+        return "<unrepresentable>"
+
+
+_writer = None
+_writer_lock = _san.lock(label="obs.events.singleton")
+
+
+def path():
+    """The configured event-log path (the file may not exist yet)."""
+    if _writer is not None:
+        return _writer._path
+    from ..config import get_env
+    return get_env("MXNET_OBS_PATH")
+
+
+def _get_writer():
+    global _writer
+    if _writer is None:
+        with _writer_lock:
+            if _writer is None:
+                from ..config import get_env
+                _writer = _Writer(path(),
+                                  int(get_env("MXNET_OBS_RATE")))
+    return _writer
+
+
+def configure(path=None, rate=None):
+    """Rebind the writer (tests; call before the first emit of the new
+    run segment).  ``configure()`` with no args closes and resets so
+    the next emit re-reads the environment."""
+    global _writer
+    with _writer_lock:
+        if _writer is not None:
+            _writer.close()
+        _writer = None
+        if path is not None:
+            os.environ["MXNET_OBS_PATH"] = path
+        if rate is not None:
+            os.environ["MXNET_OBS_RATE"] = str(rate)
+
+
+def emit(category, **fields):
+    """Record one event if *category* is enabled.  Returns True when a
+    line was written (False: disabled or rate-capped).  Never raises
+    on IO problems — telemetry must not take down training — but does
+    count failures."""
+    if not enabled(category):
+        return False
+    try:
+        return _get_writer().write(category, fields)
+    except Exception:
+        _metrics.counter("obs_events_errors_total",
+                         "event-log write failures").inc()
+        return False
+
+
+def emitter(category):
+    """Partial application of :func:`emit` for call sites that fire
+    the same category repeatedly."""
+    def _emit(**fields):
+        return emit(category, **fields)
+    return _emit
+
+
+def read_events(p=None):
+    """Parse an events.jsonl file back into dicts (tests, post-mortem
+    tooling).  Raises on malformed lines — a torn log is a bug."""
+    out = []
+    with open(p or path(), encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+# -- compile events with blame ----------------------------------------------
+
+class _CompileWatch:
+    """Host-side jit-cache watcher emitting ``compile`` events.
+
+    The graftsan recompile sanitizer reports blamed cache misses when
+    a developer opts in; this wrapper bridges the same signature-diff
+    machinery into always-available telemetry: every compile (warmup
+    included) is an event, and post-warmup misses carry the churned
+    leaves.  Transparent proxy otherwise (``lower``/``_cache_size``
+    stay reachable).
+
+    Deliberately NOT unified with graftsan's JitWatch core: this
+    module must work when ``tools/`` is absent (installed package),
+    so graftsan is only a soft import for the blame diff — a shared
+    watcher would make the dev-tooling tree load-bearing for
+    production telemetry."""
+
+    def __init__(self, fn, name):
+        self._fn = fn
+        self._name = name
+        self._lock = _san.lock(label="obs.compilewatch.%s" % name)
+        self._last_sig = None
+        self._calls = 0
+
+    def _signature(self, args, kwargs):
+        try:
+            from tools.graftsan.recompile import signature
+            return signature(args, kwargs)
+        except Exception:
+            return None
+
+    def _blame(self, prev, cur):
+        if prev is None or cur is None:
+            return []
+        try:
+            from tools.graftsan.recompile import diff_signatures
+            return diff_signatures(prev, cur)
+        except Exception:
+            return []
+
+    def __call__(self, *args, **kwargs):
+        size_of = getattr(self._fn, "_cache_size", None)
+        before = size_of() if size_of else None
+        t0 = time.perf_counter()
+        out = self._fn(*args, **kwargs)
+        dt = time.perf_counter() - t0
+        after = size_of() if size_of else None
+        missed = (before is not None and after is not None
+                  and after > before)
+        if missed:
+            sig = self._signature(args, kwargs)
+            with self._lock:
+                calls = self._calls
+                blame = self._blame(self._last_sig, sig) if calls \
+                    else []
+                self._last_sig = sig
+                self._calls += 1
+            emit("compile", fn=self._name, call=calls + 1,
+                 cache_size=after, seconds=round(dt, 4),
+                 warmup=calls == 0,
+                 **({"blame": blame[:8]} if blame else {}))
+        else:
+            with self._lock:
+                if after is not None:
+                    sig = self._signature(args, kwargs)
+                    self._last_sig = sig
+                self._calls += 1
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self._fn, name)
+
+
+def watch_jit(fn, name):
+    """Wrap a jitted callable so its compiles become ``compile``
+    events.  Identity when the ``compile`` category is off at wrap
+    time (same created-while-off semantics as the sanitizer bridge)."""
+    if not enabled("compile"):
+        return fn
+    if isinstance(fn, _CompileWatch):
+        return fn
+    return _CompileWatch(fn, name)

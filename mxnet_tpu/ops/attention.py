@@ -31,6 +31,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# the TPU compiler-params dataclass was renamed across jax releases
+# (TPUCompilerParams -> CompilerParams); accept either spelling
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    getattr(pltpu, "TPUCompilerParams")
+
 from .registry import register_op
 
 __all__ = ["flash_attention", "attention_reference"]
@@ -285,7 +290,7 @@ def _flash_fwd_pallas(q, k, v, causal, sm_scale, blk_q=1024, blk_k=1024,
             pltpu.VMEM((blk_q,), jnp.float32),
             pltpu.VMEM((blk_q,), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qp, kp, vp)
@@ -443,7 +448,7 @@ def _flash_bwd_pallas(q, k, v, out, lse, dout, causal, sm_scale,
                    jax.ShapeDtypeStruct((bh, sk + sk_pad, dp), v.dtype)],
         scratch_shapes=[pltpu.VMEM((blk_k, dp), jnp.float32),
                         pltpu.VMEM((blk_k, dp), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qp, kp, vp, dop, lse, delta)
@@ -457,7 +462,7 @@ def _flash_bwd_pallas(q, k, v, out, lse, dout, causal, sm_scale,
         out_specs=q_spec_q,
         out_shape=jax.ShapeDtypeStruct((bh, sq + sq_pad, dp), q.dtype),
         scratch_shapes=[pltpu.VMEM((blk_q, dp), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qp, kp, vp, dop, lse, delta)
@@ -518,7 +523,19 @@ def flash_attention(q, k, v, causal=False, sm_scale=None, interpret=False,
                                   int(chunk)).astype(q.dtype)
 
     # decided at LOWERING time per platform (not by the process-default
-    # backend, which is wrong in a mixed cpu+tpu session)
+    # backend, which is wrong in a mixed cpu+tpu session).  On this
+    # jax release platform_dependent still LOWERS every branch for the
+    # target platform, and the Mosaic pallas_call has no CPU lowering
+    # rule at all — so in a process with no TPU devices (where the tpu
+    # branch could never be taken anyway) skip straight to the XLA
+    # chunked path instead of tripping "Only interpret mode is
+    # supported on CPU backend" at compile time.
+    try:
+        have_tpu = any(d.platform == "tpu" for d in jax.devices())
+    except Exception:
+        have_tpu = False
+    if not have_tpu:
+        return _other(q, k, v)
     return jax.lax.platform_dependent(q, k, v, tpu=_tpu, default=_other)
 
 

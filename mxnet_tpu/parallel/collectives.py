@@ -18,16 +18,27 @@ from jax.sharding import PartitionSpec as P
 from jax.experimental.shard_map import shard_map
 
 __all__ = ["allreduce", "allgather", "reduce_scatter", "broadcast",
-           "psum", "pmean", "ppermute_ring"]
+           "psum", "pmean", "ppermute_ring", "axis_size"]
 
 # in-shard_map primitives (axis_name bound by caller)
 psum = jax.lax.psum
 pmean = jax.lax.pmean
 
 
+def axis_size(axis_name):
+    """Static size of a mapped axis.  ``jax.lax.axis_size`` only exists
+    in newer jax releases; ``psum(1, axis)`` is the classic idiom and
+    constant-folds to a Python int, so callers can use the result in
+    Python control flow either way."""
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
 def ppermute_ring(x, axis_name, shift=1):
     """Rotate shards around the ring (ring-attention building block)."""
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return jax.lax.ppermute(x, axis_name, perm)
 

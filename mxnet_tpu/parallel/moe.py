@@ -38,7 +38,8 @@ def moe_apply(expert_fn, expert_params, x, gate_w, axis_name="ep",
     def shard_fn(params, xs, gw):
         from ..ops.nn import top1_route
         params = jax.tree.map(lambda a: a[0], params)
-        e = jax.lax.axis_size(axis_name)
+        from .collectives import axis_size
+        e = axis_size(axis_name)
         nloc, d = xs.shape
         cap = max(1, int(capacity_factor * nloc / e))
         _, gate, expert_idx, slot, keep = top1_route(xs, gw, cap)

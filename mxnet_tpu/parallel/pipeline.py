@@ -46,7 +46,8 @@ def pipeline_apply(stage_fn, stage_params, x_micro, axis_name="pp",
         # params leaves arrive with leading dim 1 (this stage's slice)
         params = jax.tree.map(lambda a: a[0], params)
         s = jax.lax.axis_index(axis_name)
-        n_stage = jax.lax.axis_size(axis_name)
+        from .collectives import axis_size
+        n_stage = axis_size(axis_name)
         m = xm.shape[0]
         ticks = m + n_stage - 1
         out_shape = xm.shape[1:]

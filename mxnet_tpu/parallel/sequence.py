@@ -38,7 +38,8 @@ def ring_attention_shard(q, k, v, axis_name, causal=False, sm_scale=None):
     """
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
-    n = jax.lax.axis_size(axis_name)
+    from .collectives import axis_size
+    n = axis_size(axis_name)
     me = jax.lax.axis_index(axis_name)
     b, h, s_loc, d = q.shape
     s_loc_k = k.shape[2]

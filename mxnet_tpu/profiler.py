@@ -23,6 +23,7 @@ import threading
 import time
 
 from . import sanitizer as _san
+from .observability import metrics as _metrics
 
 __all__ = ["set_config", "set_state", "pause", "resume", "dump", "dumps",
            "profiler_set_config", "profiler_set_state",
@@ -55,28 +56,47 @@ _agg = {}               # name -> [count, total_us, min_us, max_us]
 #         tree_apply_compiles    tree-update trace-time
 #         parallel_step_dispatches / parallel_step_compiles
 #                                ParallelTrainer fit_batch step
-_counts = {}
+#
+# Historically these lived in a private lock-free dict here; they are
+# now Counter instruments in observability.metrics.REGISTRY (one
+# uncontended per-counter lock — built from the sanitizer factories,
+# so graftsan audits it — instead of the contended profiler RLock this
+# comment used to justify avoiding), and this module keeps the
+# original bump/value/snapshot surface as the compatibility layer.
+# The same numbers the fused-step tests assert are what a scraper
+# reads from metrics.exposition().
+
+#: names bumped through this layer (so counters()/reset_counters keep
+#: their historical "only the dispatch counters" scope even though the
+#: registry also holds latency histograms and subsystem instruments)
+_count_names = set()
+_instruments = {}           # name -> Counter (lookup-free hot path)
 
 
 def bump_counter(name, n=1):
-    """Increment a named dispatch/compile counter.  Deliberately
-    lock-free: this sits on the per-op eager dispatch hot path, and a
-    rare lost increment under free-threading beats taking the profiler
-    RLock on every dispatch (readers tolerate racy snapshots)."""
-    _counts[name] = _counts.get(name, 0) + n
+    """Increment a named dispatch/compile counter (registry-backed)."""
+    inst = _instruments.get(name)
+    if inst is None:
+        inst = _instruments[name] = _metrics.counter(
+            name, "profiler dispatch/compile counter")
+        _count_names.add(name)
+    inst.inc(n)
 
 
 def counter_value(name):
-    return _counts.get(name, 0)
+    inst = _instruments.get(name)
+    return inst.value if inst is not None else 0
 
 
 def counters():
     """Snapshot of all dispatch/compile counters."""
-    return dict(_counts)
+    return {name: _instruments[name].value
+            for name in list(_count_names)}
 
 
 def reset_counters():
-    _counts.clear()
+    for name in list(_count_names):
+        _instruments[name]._reset()
 _config = {
     "filename": "profile.json",
     "profile_all": False,
@@ -196,11 +216,16 @@ def record_span(name, cat, t0_s, t1_s, tid=0, args=None):
 
 
 def record_counter(name, value):
+    # perf_counter, NOT time.time(): spans are stamped on the
+    # monotonic base (record_span t0/t1 come from perf_counter), and a
+    # trace mixing clock bases scatters counters decades away from the
+    # spans in Perfetto
     if not is_running():
         return
     with _lock:
-        _events.append({"name": name, "ph": "C", "ts": time.time() * 1e6,
-                       "pid": os.getpid(), "tid": 0,
+        _events.append({"name": name, "ph": "C",
+                        "ts": time.perf_counter() * 1e6,
+                        "pid": os.getpid(), "tid": 0,
                         "args": {name: value}})
 
 
@@ -209,8 +234,8 @@ def record_marker(name, cat="marker"):
         return
     with _lock:
         _events.append({"name": name, "cat": cat, "ph": "i",
-                        "ts": time.time() * 1e6, "pid": os.getpid(),
-                        "tid": 0, "s": "p"})
+                        "ts": time.perf_counter() * 1e6,
+                        "pid": os.getpid(), "tid": 0, "s": "p"})
 
 
 def dump(finished=True, profile_process="worker"):
@@ -221,8 +246,26 @@ def dump(finished=True, profile_process="worker"):
         return None
     if finished:
         set_state("stop")
+    # flush the metrics-registry instruments as chrome-trace Counter
+    # ('C') events at dump time, so ONE trace file carries both the
+    # spans and the final instrument values (histograms flatten to
+    # their count/sum pair — enough to spot "4000 host transfers
+    # inside this window" next to the spans that caused them).
+    # perf_counter base to land ON the spans' timeline (see
+    # record_counter)
+    now_us = time.perf_counter() * 1e6
+    pid = os.getpid()
+    counter_events = []
+    for name, snap in _metrics.snapshot().items():
+        if snap["kind"] == "histogram":
+            args = {"count": snap["count"], "sum": snap["sum"]}
+        else:
+            args = {name: snap["value"]}
+        counter_events.append({"name": "metrics/" + name, "ph": "C",
+                               "ts": now_us, "pid": pid, "tid": 0,
+                               "args": args})
     with _lock:
-        data = {"traceEvents": list(_events),
+        data = {"traceEvents": list(_events) + counter_events,
                 "displayTimeUnit": "ms"}
         with open(_config["filename"], "w") as f:
             json.dump(data, f)

@@ -136,8 +136,17 @@ def _consume(key):
         fired = _used.get(key, 0)
         if fired < budget:
             _used[key] = fired + 1
-            return True
-    return False
+            hit = fired + 1
+        else:
+            return False
+    # outside the lock: the event log + counter are observability, the
+    # fire accounting above is correctness
+    from ..observability import events as _obs_events
+    from ..observability import metrics as _metrics
+    _metrics.counter("chaos_injections_total",
+                     "chaos faults actually fired").inc()
+    _obs_events.emit("chaos", injection=key, fire=hit, budget=budget)
+    return True
 
 
 def fired(key):
@@ -203,6 +212,11 @@ def maybe_poison_batch(batch, step):
     if k is None or step != k:
         return batch
     import copy
+    from ..observability import events as _obs_events
+    from ..observability import metrics as _metrics
+    _metrics.counter("chaos_injections_total",
+                     "chaos faults actually fired").inc()
+    _obs_events.emit("chaos", injection="nan_grads_at_step", step=step)
     log.warning("chaos: poisoning batch at step %d with NaN", step)
     poisoned = copy.copy(batch)
     poisoned.data = [d * float("nan") for d in batch.data]

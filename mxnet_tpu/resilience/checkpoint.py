@@ -51,6 +51,8 @@ import threading
 
 from . import chaos
 from .. import sanitizer as _san
+from ..observability import events as _obs_events
+from ..observability import metrics as _metrics
 
 __all__ = ["atomic_write", "atomic_write_stream", "fsync_dir",
            "CheckpointManager", "CheckpointRecord", "MANIFEST_VERSION"]
@@ -339,6 +341,8 @@ class CheckpointManager:
             self._bg_error = exc
 
     def _write_and_commit(self, files, entry):
+        import time
+        t0 = time.perf_counter()
         dirname = self.dirname
         if dirname:
             os.makedirs(dirname, exist_ok=True)
@@ -369,6 +373,20 @@ class CheckpointManager:
             for name, data in files.items():
                 if name.endswith("-symbol.json"):
                     atomic_write("%s-symbol.json" % self.prefix, data)
+        elapsed = time.perf_counter() - t0
+        total_bytes = sum(len(d) for d in files.values())
+        _metrics.counter("checkpoint_saves_total",
+                         "committed checkpoint saves").inc()
+        _metrics.counter("checkpoint_bytes_total",
+                         "bytes durably written by committed "
+                         "checkpoint saves").inc(total_bytes)
+        _metrics.histogram("checkpoint_save_seconds",
+                           "write+fsync+commit latency of one "
+                           "checkpoint save").observe(elapsed)
+        _obs_events.emit("checkpoint", action="commit",
+                         epoch=entry["epoch"], prefix=self.prefix,
+                         files=len(files), bytes=total_bytes,
+                         seconds=round(elapsed, 4))
 
     def _delete_orphans(self, dropped, kept):
         still_referenced = set()
@@ -442,8 +460,14 @@ class CheckpointManager:
             if not reason:
                 files = {name: os.path.join(self.dirname, name)
                          for name in entry["files"]}
+                _obs_events.emit("checkpoint", action="restore",
+                                 epoch=entry["epoch"],
+                                 prefix=self.prefix)
                 return CheckpointRecord(entry["epoch"], self.dirname,
                                         files)
+            _obs_events.emit("checkpoint", action="skip_corrupt",
+                             epoch=entry["epoch"], prefix=self.prefix,
+                             reason=reason)
             self.logger.warning(
                 "checkpoint epoch %d is corrupt (%s); falling back to "
                 "the previous one", entry["epoch"], reason)

@@ -72,6 +72,18 @@ def retry_call(fn, args=(), kwargs=None, *, attempts=5, base_delay=0.05,
             lg.debug("retry: attempt %d/%d failed (%s: %s); backing off "
                      "%.3fs", attempt, attempts, type(exc).__name__, exc,
                      delay)
+            from ..observability import events as _obs_events
+            from ..observability import metrics as _metrics
+            _metrics.counter(
+                "retry_attempts_total",
+                "retried (failed-then-backed-off) attempts across "
+                "every retry_call site").inc()
+            _obs_events.emit("retry", fn=getattr(fn, "__name__",
+                                                 repr(fn)[:80]),
+                             attempt=attempt, of=attempts,
+                             error="%s: %s" % (type(exc).__name__,
+                                               str(exc)[:200]),
+                             backoff_s=round(delay, 4))
             if on_retry is not None:
                 on_retry(attempt, exc, delay)
             sleep(delay)

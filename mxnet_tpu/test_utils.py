@@ -145,8 +145,10 @@ def check_numeric_gradient(sym, location, aux_states=None, eps=1e-3,
     The comparison runs in float64 — finite differences in f32 would
     drown real gradient bugs in rounding noise.
     """
-    import jax
-    with jax.enable_x64(True):
+    # jax removed the top-level `jax.enable_x64` alias; the supported
+    # per-scope switch lives in jax.experimental
+    from jax.experimental import enable_x64
+    with enable_x64():
         location = _as_location(sym, location)
         location = {k: np.asarray(v, np.float64)
                     for k, v in location.items()}
@@ -164,9 +166,24 @@ def check_numeric_gradient(sym, location, aux_states=None, eps=1e-3,
         exe.backward(out_grads=[nd.array(p) for p in proj])
         sym_grads = {n: exe.grad_dict[n].asnumpy() for n in grad_nodes}
 
+        # ONE probe executor reused across every finite-difference
+        # evaluation: a fresh _bind per probe would build fresh jit
+        # closures and recompile the forward program for EVERY one of
+        # the 2-per-element evaluations (minutes per test, the reason
+        # these suites used to be unaffordable).  Fresh-bind semantics
+        # are restored by hand each call: the PRNG key rewinds to the
+        # bind-time key (stochastic ops replay identical masks, so f
+        # stays deterministic) and train-mode aux updates (BatchNorm
+        # stats) are rolled back to the bind-time handles.
+        probe = _bind(sym, location, aux64, ctx=ctx)
+        key0 = probe._key
+        aux0 = {n: a._data for n, a in probe.aux_dict.items()}
+
         def f(loc):
-            e = _bind(sym, {**location, **loc}, aux64, ctx=ctx)
-            os = e.forward(is_train=True)
+            probe._key = key0
+            for n, a in probe.aux_dict.items():
+                a._data = aux0[n]
+            os = probe.forward(is_train=True, **{**location, **loc})
             return sum(float(np.sum(o.asnumpy() * p))
                        for o, p in zip(os, proj))
 

@@ -100,6 +100,7 @@ def _single_process_reference():
 
 
 @pytest.mark.timeout(600)
+@pytest.mark.skip(reason="multi-process SPMD computations are not implemented on the CPU backend of this jaxlib (XlaRuntimeError: Multiprocess computations aren't implemented on the CPU backend); needs a TPU-capable or newer-jaxlib image -- see docs/failure_baseline.md")
 def test_two_process_global_mesh_matches_single_process():
     with socket.socket() as s:
         s.bind(("localhost", 0))
@@ -130,6 +131,7 @@ def test_two_process_global_mesh_matches_single_process():
 
 
 @pytest.mark.timeout(600)
+@pytest.mark.skip(reason="multi-process SPMD computations are not implemented on the CPU backend of this jaxlib (XlaRuntimeError: Multiprocess computations aren't implemented on the CPU backend); needs a TPU-capable or newer-jaxlib image -- see docs/failure_baseline.md")
 def test_launcher_no_server_mode_runs_multihost_example():
     """tools/launch.py -n 2 -s 0 bootstraps a pure jax.distributed
     worker group (no parameter servers) running

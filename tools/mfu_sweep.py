@@ -28,7 +28,10 @@ import bench
 def _decompose(peak, batch, iters):
     """Time the step's constituent configurations: fwd-only, then full
     steps with increasing optimizer machinery.  Differences between
-    rows locate the non-conv time (PERF_NOTES 'remaining gap' list)."""
+    rows locate the non-conv time (PERF_NOTES 'remaining gap' list).
+    Ends with the PER-OP cost table of the ship config's lowered step
+    (observability.costs): flops, bytes, roofline class, % of step —
+    the row an MFU regression blames (ROADMAP item 3)."""
     rows = [
         ("fwd_only", dict(fwd=True)),
         ("sgd_plain_f32", dict(optimizer="sgd", multi_precision=False,
@@ -47,6 +50,11 @@ def _decompose(peak, batch, iters):
                                    multi_precision=True,
                                    coalesce_small=True, stem="s2d")),
     ]
+    # per-op attribution target: the LAST successful full-step variant
+    # (the rows run cheapest->ship config, so later = closer to ship);
+    # the emitted JSON names which variant the HLO actually came from
+    ship_hlo = None
+    ship_variant = None
     for name, kw in rows:
         try:
             if kw.pop("fwd", False):
@@ -56,6 +64,9 @@ def _decompose(peak, batch, iters):
                 r = bench.timed_resnet_train(batch, 224, None,
                                              iters=iters, scan_n=5,
                                              warmup=2, **kw)
+                if r.get("hlo_text"):
+                    ship_hlo = r["hlo_text"]
+                    ship_variant = name
             tf_s = r["flops_per_step"] * r["iters"] / r["dt"] / 1e12
             print(json.dumps({
                 "variant": name, "batch": batch,
@@ -67,6 +78,28 @@ def _decompose(peak, batch, iters):
         except Exception as e:
             print(json.dumps({"variant": name,
                               "error": repr(e)[:300]}), flush=True)
+
+    if ship_hlo:
+        try:
+            from mxnet_tpu.observability import costs as _costs
+            bw = bench._probe_peak_bw()
+            table = _costs.cost_table(text=ship_hlo, peak_flops=peak,
+                                      peak_bytes_s=bw, top=20)
+            print("per-op attribution (variant=%s)" % ship_variant,
+                  file=sys.stderr, flush=True)
+            print(_costs.format_table(table, limit=24),
+                  file=sys.stderr, flush=True)
+            print(json.dumps({
+                "per_op": table["rows"],
+                "per_op_variant": ship_variant,
+                "machine_balance": table["machine_balance"],
+                "peak_bw_probe": bw,
+                "total_flops": table["total_flops"],
+                "total_bytes": table["total_bytes"],
+            }), flush=True)
+        except Exception as e:
+            print(json.dumps({"per_op_error": repr(e)[:300]}),
+                  flush=True)
 
 
 def main():
