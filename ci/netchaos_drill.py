@@ -1,0 +1,304 @@
+#!/usr/bin/env python
+"""Network-chaos drill: real 2-worker x 2-server dist_sync training
+jobs driven through every injected network fault class, asserting
+convergence-equivalent results and exactly-once push application.
+
+Fault classes (deterministic counter-armed injections; the only
+sleeps are the injected delays — see mxnet_tpu/resilience/netchaos.py
+and docs/resilience.md "Distributed fault tolerance"):
+
+  baseline       no faults — the reference pull values
+  worker_faults  net_partition + net_dup_request + net_delay_request
+  drop_reply     server computes the push, drops the reply: the
+                 worker's RPC timeout + retried request id must dedup
+  delay_reply    reply delayed BEYOND the worker RPC timeout: full
+                 timeout -> reconnect -> retry -> dedup path
+  torn           half-frames in both directions (request + reply)
+  server_kill    server 0 hard-killed (os._exit 137) mid-run, then
+                 restarted: must restore its state snapshot; retried
+                 pushes apply exactly once across incarnations
+  eviction       worker 1 dies without ceremony: its stale heartbeat
+                 gets it evicted and worker 0 finishes alone
+
+Every class asserts: worker exit 0, the expected per-step pull values,
+and per-server ``applies == steps * keys-on-server`` — the server-side
+apply counter equaling the logical rounds IS the exactly-once proof
+(a double-applied retry or duplicate breaks it).
+
+Scrapeable last stdout line:  netchaos: faults=N recovered=M ok
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PY = sys.executable
+STEPS = 3
+N_WORKERS = 2
+N_SERVERS = 2
+BIG_BOUND = 10          # "big" has 24 elements -> sharded over both
+
+WORKER = r'''
+import os, sys, json
+sys.path.insert(0, os.environ["NC_REPO"])
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.resilience import chaos
+
+rank = int(os.environ["DMLC_WORKER_RANK"])
+steps = int(os.environ["NC_STEPS"])
+die_after = int(os.environ.get("NC_DIE_AFTER_STEP", "0"))
+kv = mx.kv.create("dist_sync")
+kv.init("w", nd.zeros((4,)))
+kv.init("big", nd.zeros((24,)))      # > bound -> sharded, both servers
+results = []
+for step in range(1, steps + 1):
+    kv.push("w", nd.ones((4,)) * (rank + 1))
+    kv.push("big", nd.ones((24,)) * (rank + 1))
+    kv.barrier()
+    out_w = nd.zeros((4,))
+    out_b = nd.zeros((24,))
+    kv.pull("w", out=out_w)
+    kv.pull("big", out=out_b)
+    results.append([float(out_w.asnumpy()[0]),
+                    float(out_b.asnumpy()[0])])
+    if die_after and rank == 1 and step >= die_after:
+        os._exit(0)    # crash: no barrier, no stop, heartbeats cease
+    kv.barrier()
+print("RESULT", rank, json.dumps(results), flush=True)
+print("CHAOSFIRED", rank, json.dumps({k: chaos.fired(k) for k in
+      ("net_partition", "net_delay_request", "net_dup_request",
+       "net_torn_request")}), flush=True)
+if rank == 0:
+    stats = [kv.server_stats(server=s)
+             for s in range(int(os.environ.get("DMLC_NUM_SERVER", "1")))]
+    print("STATS", json.dumps(stats), flush=True)
+kv.barrier()
+if rank == 0:
+    kv.stop_server()
+'''
+
+SERVER = r'''
+import os, sys, json
+sys.path.insert(0, os.environ["NC_REPO"])
+from mxnet_tpu.kvstore_server import run_server
+from mxnet_tpu.resilience import chaos
+run_server("dist_sync")
+print("CHAOSFIRED", json.dumps({k: chaos.fired(k) for k in
+      ("net_drop_reply", "net_delay_reply", "net_torn_reply")}),
+      flush=True)
+'''
+
+
+def _spec(d):
+    return ",".join("%s=%d" % (k, v) for k, v in sorted(d.items()))
+
+
+def _spawn_server(env, sid, server_chaos):
+    senv = dict(env, DMLC_ROLE="server", DMLC_SERVER_ID=str(sid),
+                # suppress the package's server re-exec bootstrap: this
+                # wrapper must regain control after run_server returns
+                # to report which injections actually fired
+                _MXTPU_SERVER_BOOT="1")
+    if server_chaos:
+        senv["MXNET_CHAOS"] = _spec(server_chaos)
+    return subprocess.Popen([PY, "-c", SERVER], env=senv,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE)
+
+
+def run_class(name, **kw):
+    """One 2x2 dist_sync job under a fault class; returns the number
+    of injections observed fired across all processes.  Never leaks
+    children: a failed assertion kills every spawned process so later
+    classes' ports stay free."""
+    procs = []
+    try:
+        return _run_class(name, procs, **kw)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
+def _run_class(name, procs, worker_chaos=None, server_chaos=None,
+               worker_env=None, server_env=None, die_after=0,
+               kill_server0=False, port=9610):
+    snapdir = tempfile.mkdtemp(prefix="netchaos_%s_" % name)
+    env = dict(os.environ)
+    env.pop("MXNET_CHAOS", None)
+    env.update({
+        "NC_REPO": REPO,
+        "NC_STEPS": str(STEPS),
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(port),
+        "DMLC_NUM_WORKER": str(N_WORKERS),
+        "DMLC_NUM_SERVER": str(N_SERVERS),
+        "MXNET_KVSTORE_BIGARRAY_BOUND": str(BIG_BOUND),
+        "MXNET_KVSTORE_SNAPSHOT_PREFIX": os.path.join(snapdir, "snap"),
+        "MXNET_KVSTORE_SNAPSHOT_EVERY": "1",
+        "MXNET_KVSTORE_HEARTBEAT_INTERVAL": "0.2",
+        "JAX_PLATFORMS": "cpu",
+    })
+    env.update(server_env or {})
+    servers = []
+    for sid in range(N_SERVERS):
+        chaos_for = dict(server_chaos or {})
+        if kill_server0 and sid == 0:
+            # the kill switch arms ONLY server 0's first incarnation
+            chaos_for["net_kill_server_at"] = 3
+        servers.append(_spawn_server(env, sid, chaos_for))
+    procs.extend(servers)
+    wenv_base = dict(env)
+    wenv_base.update(worker_env or {})
+    wenv_base.setdefault("MXNET_KVSTORE_RPC_TIMEOUT", "4")
+    wenv_base.setdefault("MXNET_KVSTORE_RPC_RETRIES", "8")
+    if worker_chaos:
+        wenv_base["MXNET_CHAOS"] = _spec(worker_chaos)
+    workers = []
+    for rank in range(N_WORKERS):
+        wenv = dict(wenv_base, DMLC_ROLE="worker",
+                    DMLC_WORKER_RANK=str(rank))
+        if die_after:
+            wenv["NC_DIE_AFTER_STEP"] = str(die_after)
+        workers.append(subprocess.Popen([PY, "-c", WORKER], env=wenv,
+                                        stdout=subprocess.PIPE,
+                                        stderr=subprocess.PIPE))
+    procs.extend(workers)
+    fired = 0
+    if kill_server0:
+        # wait for the injected hard kill, then restart the server on
+        # the same port + snapshot prefix WITHOUT the kill switch
+        deadline = time.time() + 90
+        while servers[0].poll() is None and time.time() < deadline:
+            time.sleep(0.1)
+        rc = servers[0].poll()
+        assert rc == 137, \
+            "server 0 should have been hard-killed, rc=%r" % (rc,)
+        fired += 1
+        servers[0] = _spawn_server(env, 0, server_chaos or {})
+        procs.append(servers[0])
+        print("  server 0 killed (rc=137) and restarted", flush=True)
+
+    outs = []
+    for w in workers:
+        stdout, stderr = w.communicate(timeout=180)
+        assert w.returncode == 0, \
+            "[%s] worker failed:\n%s" % (name, stderr.decode()[-3000:])
+        outs.append(stdout.decode())
+
+    # -- value assertions: convergence-equivalent pulls ------------------
+    # sync + no updater => pulled value = the round's aggregated sum
+    both = float(sum(r + 1 for r in range(N_WORKERS)))     # 3.0
+    for out in outs:
+        lines = out.splitlines()
+        res = [l for l in lines if l.startswith("RESULT")]
+        if not res:
+            assert die_after, "[%s] missing RESULT:\n%s" % (name, out)
+            continue            # the deliberately-dead worker
+        rank = int(res[0].split(" ", 2)[1])
+        vals = json.loads(res[0].split(" ", 2)[2])
+        for step, (w_val, b_val) in enumerate(vals, 1):
+            if die_after and step > die_after:
+                want = 1.0      # only worker 0 contributes post-evict
+            else:
+                want = both
+            assert w_val == want and b_val == want, \
+                "[%s] rank %d step %d: got (%s, %s), want %s" \
+                % (name, rank, step, w_val, b_val, want)
+        for l in lines:
+            if l.startswith("CHAOSFIRED"):
+                fired += sum(json.loads(l.split(" ", 2)[2]).values())
+
+    # -- exactly-once: server apply counters match logical rounds --------
+    stats_line = [l for o in outs for l in o.splitlines()
+                  if l.startswith("STATS")]
+    assert stats_line, "[%s] rank 0 printed no STATS" % name
+    stats = json.loads(stats_line[0].split(" ", 1)[1])
+    for st in stats:
+        nkeys = len(st["keys"])
+        assert nkeys >= 1, "[%s] server %s lost every key: %s" \
+            % (name, st["server_id"], st)
+        assert st["applies"] == STEPS * nkeys, \
+            "[%s] server %s: applies=%d != steps*keys=%d (%s) — " \
+            "retry/duplicate was NOT exactly-once" \
+            % (name, st["server_id"], st["applies"], STEPS * nkeys, st)
+        if die_after:
+            assert 1 in st["evicted"], \
+                "[%s] server %s never evicted dead rank 1: %s" \
+                % (name, st["server_id"], st)
+    if kill_server0:
+        assert stats[0]["snapshots"] >= 1, \
+            "[%s] restarted server 0 never snapshotted: %s" \
+            % (name, stats[0])
+
+    for i, s in enumerate(servers):
+        try:
+            sout, serr = s.communicate(timeout=30)
+        except subprocess.TimeoutExpired:
+            s.kill()
+            raise AssertionError("[%s] server %d did not stop" % (name, i))
+        assert s.returncode == 0, \
+            "[%s] server %d rc=%d:\n%s" % (name, i, s.returncode,
+                                           serr.decode()[-2000:])
+        for l in sout.decode().splitlines():
+            if l.startswith("CHAOSFIRED"):
+                fired += sum(json.loads(l.split(" ", 1)[1]).values())
+    if die_after:
+        fired += 1              # the real worker death is the fault
+    return fired
+
+
+def main():
+    classes = [
+        ("baseline", {}),
+        ("worker_faults", dict(
+            worker_chaos={"net_partition": 2, "net_dup_request": 2,
+                          "net_delay_request": 2, "net_delay_ms": 100})),
+        ("drop_reply", dict(
+            server_chaos={"net_drop_reply": 2},
+            worker_env={"MXNET_KVSTORE_RPC_TIMEOUT": "2"})),
+        ("delay_reply", dict(
+            # delay > RPC timeout: the worker must ride the full
+            # timeout -> reconnect -> retry -> dedup path
+            server_chaos={"net_delay_reply": 1, "net_delay_ms": 3500},
+            worker_env={"MXNET_KVSTORE_RPC_TIMEOUT": "2"})),
+        ("torn", dict(
+            worker_chaos={"net_torn_request": 2},
+            server_chaos={"net_torn_reply": 1},
+            worker_env={"MXNET_KVSTORE_RPC_TIMEOUT": "2"})),
+        ("server_kill", dict(kill_server0=True)),
+        ("eviction", dict(
+            die_after=1,
+            worker_env={"MXNET_KVSTORE_RPC_TIMEOUT": "10"},
+            server_env={"MXNET_KVSTORE_SYNC_TIMEOUT": "3",
+                        "MXNET_KVSTORE_EVICT_TIMEOUT": "1.0"})),
+    ]
+    total_fired = 0
+    recovered = 0
+    for i, (name, kw) in enumerate(classes):
+        t0 = time.time()
+        print("== netchaos class: %s ==" % name, flush=True)
+        fired = run_class(name, port=9610 + 10 * i, **kw)
+        if name != "baseline":
+            assert fired > 0, \
+                "[%s] armed faults never fired — the drill is inert" \
+                % name
+            recovered += 1
+        total_fired += fired
+        print("  ok (%d injections, %.1fs)" % (fired, time.time() - t0),
+              flush=True)
+    print("netchaos: faults=%d recovered=%d ok"
+          % (total_fired, recovered), flush=True)
+
+
+if __name__ == "__main__":
+    main()

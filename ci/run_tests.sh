@@ -39,6 +39,17 @@ echo "== resilience: chaos-injected fault drills =="
 MXNET_CHAOS=on python -m pytest tests/test_resilience.py -q \
     -p no:cacheprovider
 
+echo "== resilience: network chaos drill (dist kvstore) =="
+# Real 2-worker x 2-server dist_sync jobs through every injected
+# network fault class — drop / delay / duplicate / torn-frame /
+# partition / server-kill / dead-worker: asserts convergence-
+# equivalent pulls, exactly-once apply counters, snapshot-restore
+# after a hard kill, and eviction unblocking the survivors.
+# Deterministic counter-armed injections; the only sleeps are the
+# injected delays (docs/resilience.md).  Last stdout line is the
+# scrapeable summary ("netchaos: faults=.. recovered=.. ok").
+python ci/netchaos_drill.py
+
 echo "== native: C predict ABI + RecordIO reader =="
 if command -v g++ >/dev/null; then
     make -C src/capi

@@ -23,12 +23,14 @@ TPU-native mapping (SURVEY.md §5.8):
 from __future__ import annotations
 
 import json
+import logging
 import os
 import pickle
 import socket
 import struct
 import threading
 import time
+from collections import OrderedDict as _OrderedDict
 
 import numpy as _np
 
@@ -36,9 +38,29 @@ from . import ndarray as nd
 from . import sanitizer as _san
 from .ndarray import NDArray
 from .base import MXNetError
+from .observability import events as _obs_events
 from .observability import metrics as _metrics
+from .resilience import netchaos as _netchaos
 
-__all__ = ["create", "KVStoreBase"]
+__all__ = ["create", "KVStoreBase", "RPCTimeoutError", "SyncTimeoutError"]
+
+log = logging.getLogger(__name__)
+
+
+class RPCTimeoutError(MXNetError):
+    """A bulk KVStore RPC hit its per-call socket timeout
+    (``MXNET_KVSTORE_RPC_TIMEOUT``) — the server died mid-reply or the
+    network stalled.  The worker transport treats this as retryable:
+    it reconnects and resends the SAME ``(rank, seq)`` request id, and
+    the server's dedup window keeps the retried mutation
+    exactly-once."""
+
+
+class SyncTimeoutError(MXNetError):
+    """A dist_sync push or barrier round expired with contributors
+    still missing whose heartbeats are FRESH — an alive-but-slow
+    straggler (provably-dead ranks are evicted instead, and the
+    survivors proceed).  The message names the laggard rank(s)."""
 
 # push/pull traffic instruments (module-level refs: these sit on the
 # per-step gradient exchange path).  For the local store "bytes" is
@@ -49,6 +71,38 @@ _PUSH_BYTES = _metrics.counter(
     "kvstore_push_bytes_total", "bytes pushed through kvstore")
 _PULL_BYTES = _metrics.counter(
     "kvstore_pull_bytes_total", "bytes pulled through kvstore")
+
+# distributed fault-tolerance instruments (module-level refs — the
+# RPC/heartbeat paths must not pay a registry lookup per call)
+_RPC_RETRIES = _metrics.counter(
+    "kvstore_rpc_retries_total",
+    "bulk RPC transport retries (timeout/connection failure; the same "
+    "request id is resent and deduped server-side)")
+_HB_FAILURES = _metrics.counter(
+    "kvstore_heartbeat_failures_total",
+    "failed worker->server heartbeat attempts")
+_SYNC_TIMEOUTS = _metrics.counter(
+    "kvstore_sync_timeouts_total",
+    "dist_sync push/barrier rounds that hit the sync deadline")
+_EVICTIONS = _metrics.counter(
+    "kvstore_evictions_total",
+    "provably-dead ranks evicted from the expected-contributor set")
+_DEDUP_HITS = _metrics.counter(
+    "kvstore_dedup_hits_total",
+    "duplicate mutating RPCs answered from the server dedup window "
+    "instead of re-applied (exactly-once)")
+_SERVER_RESTARTS = _metrics.counter(
+    "kvstore_server_restarts_detected_total",
+    "server restarts detected via a heartbeat epoch-token change")
+_APPLIES = _metrics.counter(
+    "kvstore_server_applies_total",
+    "server-side state mutations (aggregated sync applies + async "
+    "per-push applies + first-push creates)")
+
+# after this many consecutive heartbeat failures to one server: one
+# WARN (not a log line per beat) and a backed-off cadence
+_HB_FAIL_WARN_AFTER = 3
+_HB_BACKOFF = 5.0
 
 
 def _as_list(v):
@@ -333,7 +387,10 @@ def _pack_tensor(arr):
 _COALESCE_BYTES = 1 << 16  # parts under this are copied+batched
 
 
-def _send_frame(sock, kind, meta=None, tensors=()):
+def _frame_parts(kind, meta, tensors):
+    """The body parts of one wire frame (shared by the zero-copy
+    sender and the netchaos torn-frame path — one wire format, two
+    consumers, no drift)."""
     meta_b = json.dumps(meta).encode() if meta else b"{}"
     parts = [struct.pack("<BI", kind, len(meta_b)), meta_b,
              struct.pack("<B", len(tensors))]
@@ -341,6 +398,19 @@ def _send_frame(sock, kind, meta=None, tensors=()):
         hdr, body = _pack_tensor(t)
         parts.append(hdr)
         parts.append(body)
+    return parts
+
+
+def _frame_bytes(kind, meta=None, tensors=()):
+    """One frame fully materialized (length prefix included) — used
+    only by the torn-frame injections, never the hot path."""
+    parts = _frame_parts(kind, meta, tensors)
+    return (struct.pack("<Q", sum(len(p) for p in parts))
+            + b"".join(bytes(p) for p in parts))
+
+
+def _send_frame(sock, kind, meta=None, tensors=()):
+    parts = _frame_parts(kind, meta, tensors)
     # coalesce the length prefix + small parts into single writes so a
     # control frame is ONE TCP segment (a write-write-read pattern would
     # hit Nagle + delayed-ACK ~40ms stalls); large tensor bodies still go
@@ -424,30 +494,110 @@ def _connect_retry(host, port, deadline):
             time.sleep(0.1)
 
 
-def _rpc_call(sock, kind, meta=None, tensors=()):
-    """Round-trip one request on *sock*; raises on an 'err' reply."""
-    _send_frame(sock, kind, meta, tensors)
-    rkind, rmeta, rtensors = _recv_frame(sock)
+def _rpc_call(sock, kind, meta=None, tensors=(), inject=False):
+    """Round-trip one request on *sock*; raises on an 'err' reply.
+
+    ``inject=True`` consults the netchaos worker-side fault points
+    (the bulk data-plane RPCs of ``KVStoreDist``; control sockets and
+    raw test callers opt out).  A socket timeout surfaces as the typed
+    :class:`RPCTimeoutError` so callers can distinguish "server died
+    mid-reply" from a server-reported error."""
+    dup = False
+    if inject:
+        directives = _netchaos.on_worker_send(kind)
+        if directives.get("torn"):
+            payload = _frame_bytes(kind, meta, tensors)
+            try:
+                sock.sendall(payload[:max(9, len(payload) // 2)])
+            finally:
+                sock.close()
+            raise ConnectionError("netchaos: torn request frame")
+        dup = bool(directives.get("dup"))
+    try:
+        _send_frame(sock, kind, meta, tensors)
+        if dup:
+            # identical bytes, same request id: the server handles the
+            # first copy and answers the second from its dedup window
+            _send_frame(sock, kind, meta, tensors)
+        rkind, rmeta, rtensors = _recv_frame(sock)
+        if dup:
+            rkind, rmeta, rtensors = _recv_frame(sock)
+    except socket.timeout as exc:
+        raise RPCTimeoutError(
+            "kvstore RPC (kind %d) timed out after %.1fs waiting for "
+            "the server's reply (MXNET_KVSTORE_RPC_TIMEOUT)"
+            % (kind, sock.gettimeout() or -1.0)) from exc
     if rkind != _MSG_REPLY:
         raise ConnectionError("protocol desync: reply kind %d" % rkind)
     if rmeta.get("status") != "ok":
+        if rmeta.get("code") == "sync_timeout":
+            raise SyncTimeoutError(
+                "kvstore server error: %s" % rmeta.get("msg"))
         raise MXNetError("kvstore server error: %s" % rmeta.get("msg"))
     return rmeta, rtensors
 
 
+def _node_rank(node):
+    """The worker rank encoded in a heartbeat node id ('worker3' ->
+    3); None for foreign node ids."""
+    if isinstance(node, str) and node.startswith("worker"):
+        try:
+            return int(node[len("worker"):])
+        except ValueError:
+            return None
+    return None
+
+
+# mutating RPCs carry a ``(rank, seq, incarnation)`` request id (seq
+# per-worker monotonic, incarnation per-process); the server's dedup
+# window answers a retried id from cache so the mutation applies
+# exactly once
+_MUTATING_KINDS = frozenset((_MSG_INIT, _MSG_PUSH, _MSG_BARRIER,
+                             _MSG_SET_OPT))
+# data-plane kinds eligible for netchaos server-side reply faults
+# (control/failure-detection traffic stays clean: injected heartbeat
+# faults would just retest the heartbeat-failure counter)
+_BULK_KINDS = frozenset((_MSG_INIT, _MSG_PUSH, _MSG_PULL, _MSG_ROWPULL,
+                         _MSG_BARRIER, _MSG_SET_OPT, _MSG_CMD))
+
+
+class _InFlight:
+    """One dedup-window entry: the first arrival of a ``(rank, seq)``
+    owns it and publishes the reply through ``event``; duplicates wait
+    on the event and answer from ``result`` instead of re-applying."""
+
+    __slots__ = ("event", "result")
+
+    def __init__(self, done=False, result=None):
+        self.event = threading.Event()
+        self.result = result
+        if done:
+            self.event.set()
+
+
 class KVStoreServer:
     """Server process body (reference: kvstore_dist_server.h:155 —
-    DataHandleEx:325, sync-mode ApplyUpdates:346, async immediate apply)."""
+    DataHandleEx:325, sync-mode ApplyUpdates:346, async immediate
+    apply; ps-lite-grade fault tolerance: request-id dedup, heartbeat
+    eviction, snapshot recovery — see docs/resilience.md)."""
 
     def __init__(self, sync_mode, num_workers, host="127.0.0.1",
-                 port=None, server_id=0):
+                 port=None, server_id=0, snapshot_prefix=None):
         self.sync = sync_mode
         self.num_workers = num_workers
         self.server_id = int(server_id)
         self.store = {}
-        self.pending = {}       # key -> [accum numpy, count]
+        self.pending = {}       # key -> [accum, rank set, req-id set]
+        # key -> ranks whose contribution was DROPPED when a sync
+        # round was abandoned on timeout: their conn threads, still in
+        # cv.wait, must raise too — 'key left pending' alone cannot
+        # distinguish round-applied from round-abandoned, and an 'ok'
+        # for a discarded gradient is exactly the silent failure this
+        # subsystem exists to kill
+        self.aborted_rounds = {}
         self._str_idx = {}      # deterministic string-key -> int index
         self.updater = None
+        self._opt_blob = None   # pickled optimizer (snapshot restore)
         # barrier round-tracking by (round, worker rank) — robust to
         # overlapping rounds under worker skew, unlike a modulo counter
         self.barrier_rounds = {}   # round -> set of ranks arrived
@@ -455,8 +605,20 @@ class KVStoreServer:
         # heartbeat-based failure detection (reference: ps-lite
         # Postoffice::GetDeadNodes, kvstore_dist.h:119-128)
         self.heartbeats = {}       # node id -> last heartbeat walltime
+        self.evicted = set()       # ranks removed from the expected set
+        self.dedup = {}    # (rank, inc) -> OrderedDict(seq -> _InFlight)
+        # request ids whose MUTATION is committed to the store but
+        # whose reply is not yet sent: a snapshot taken inside the
+        # apply must record them as done, or a post-restart retry of
+        # the very push that triggered the snapshot double-applies
+        self._applied_inflight = set()
+        self.applies = 0           # state mutations (exactly-once proof)
+        self.pushes_received = 0
         from .config import get_env as _get_env
         self.sync_timeout = _get_env("MXNET_KVSTORE_SYNC_TIMEOUT")
+        self.evict_timeout = _get_env("MXNET_KVSTORE_EVICT_TIMEOUT")
+        self.dedup_window = max(8, _get_env("MXNET_KVSTORE_DEDUP_WINDOW"))
+        self.snapshot_every = _get_env("MXNET_KVSTORE_SNAPSHOT_EVERY")
         self.cv = _san.condition(label="KVStoreServer.cv")
         self.lock = _san.rlock(label="KVStoreServer.lock")
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -477,19 +639,54 @@ class KVStoreServer:
         self._opt_mod = _opt_mod
         self._quant_mod = _quant_mod
         self._prof_mod = _prof_mod
+        # epoch token: changes on every incarnation so workers detect a
+        # restart through the heartbeat reply.  With a snapshot the
+        # restored token + 1 keeps it monotonic; without one,
+        # ms-resolution wall time makes a bounce distinguishable.
+        self.epoch_token = int(time.time() * 1000) & 0x7FFFFFFFFFFF
+        self._snap_seq = 0
+        self._ckpt = None
+        prefix = (snapshot_prefix if snapshot_prefix is not None
+                  else _get_env("MXNET_KVSTORE_SNAPSHOT_PREFIX"))
+        if prefix:
+            if self.server_id:
+                # each server of a group snapshots its own shard
+                prefix = "%s-s%d" % (prefix, self.server_id)
+            from .resilience.checkpoint import CheckpointManager
+            # synchronous on purpose: the reply to a push must leave
+            # AFTER the snapshot covering its apply is durable, or a
+            # hard kill loses state a client was already told is
+            # committed (and its dedup entry with it — the retried
+            # push would then double-apply or, worse, never come)
+            self._ckpt = CheckpointManager(prefix, keep_last=2,
+                                           background=False)
+            try:
+                self._restore_snapshot()
+            except Exception as exc:
+                # a snapshot too corrupt for restore_latest's manifest
+                # walk must not keep the parameter server down — start
+                # fresh but say so loudly
+                log.error("kvstore server %d: snapshot restore failed "
+                          "(%s: %s); starting with an empty store",
+                          self.server_id, type(exc).__name__, exc)
         # attributes conn-handler threads share; every one of these
-        # must be consistently guarded (store/pending/heartbeats by
-        # self.lock or self.cv; updater/sync rebinding by self.lock —
-        # the SET_OPT/'mode' handlers race _apply's reads otherwise,
-        # which is exactly what the lockset detector reports)
+        # must be consistently guarded (store/heartbeats/evicted/dedup/
+        # applies by self.lock; pending/barrier_* by self.cv;
+        # updater/sync rebinding by self.lock — the SET_OPT/'mode'
+        # handlers race _apply's reads otherwise, which is exactly what
+        # the lockset detector reports)
         _san.track(self, ("store", "pending", "updater", "sync",
                           "heartbeats", "barrier_rounds",
-                          "barrier_done"), "KVStoreServer")
+                          "barrier_done", "evicted", "dedup",
+                          "applies", "pushes_received", "_opt_blob",
+                          "_applied_inflight", "aborted_rounds"),
+                   "KVStoreServer")
 
     def run(self):
         """Serve until a STOP message (reference: RunServer blocks the
         server process, python/mxnet/kvstore_server.py)."""
         threads = []
+        conns = []
         self.sock.settimeout(0.5)
         while not self._stop.is_set():
             try:
@@ -503,16 +700,35 @@ class KVStoreServer:
                             daemon=True)
             t.start()
             threads.append(t)
+            conns.append(conn)
+        # shut every accepted connection so blocked conn threads wake
+        # and peers see a dead server — a process kill closes these
+        # fds implicitly; an in-process stop (tests, embedded servers)
+        # must behave identically or workers keep heartbeating a ghost
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
         for t in threads:
             t.join(timeout=1)
 
-    def _apply(self, key, grad_np):
+    def _apply(self, key, grad_np, applied_reqs=()):
+        """Mutate the stored value; *applied_reqs* are the request ids
+        whose mutation this apply commits (recorded so a snapshot
+        taken right here already covers them — the reply hasn't been
+        sent yet, but the state change is durable)."""
         grad = nd.array(grad_np)
         with self.lock:
+            self.applies += 1
+            _APPLIES.inc()
             if key not in self.store:
                 self.store[key] = grad.copy()
-                return
-            if self.updater is not None:
+            elif self.updater is not None:
                 self.updater(_str_key_index(self._str_idx, key), grad,
                              self.store[key])
             elif self.sync:
@@ -528,6 +744,113 @@ class KVStoreServer:
                     "dist_async push for key %r before an optimizer was "
                     "set — call kv.set_optimizer() first (async mode "
                     "requires the server-side updater)" % (key,))
+            if applied_reqs:
+                self._applied_inflight.update(applied_reqs)
+            self._maybe_snapshot()
+
+    # -- state snapshots (recovery after a server kill) --------------------
+    def _maybe_snapshot(self):
+        """self.lock held.  Counter-based: every Nth apply commits a
+        snapshot synchronously, so the caller's reply cannot leave
+        before the state it acknowledges is durable."""
+        if self._ckpt is None or self.snapshot_every <= 0:
+            return
+        if self.applies % self.snapshot_every:
+            return
+        self._snapshot_locked()
+
+    def _snapshot_locked(self):
+        # callers hold self.lock already; it is an RLock, and taking
+        # it here keeps the write discipline lexically checkable
+        with self.lock:
+            self._snap_seq += 1
+            return self._snapshot_body()
+
+    def _snapshot_body(self):
+        completed = {}
+        for (rank, inc), per_client in self.dedup.items():
+            seqs = [s for s, e in per_client.items()
+                    if e.event.is_set()
+                    or (rank, inc, s) in self._applied_inflight]
+            if seqs:
+                # the tail of the window is what a post-restart retry
+                # can realistically replay
+                completed["%d:%d" % (rank, inc)] = sorted(seqs)[-64:]
+        snap_meta = {"epoch_token": self.epoch_token,
+                     "applies": self.applies,
+                     "str_idx": dict(self._str_idx),
+                     "dedup": completed,
+                     "evicted": sorted(self.evicted)}
+        # store keys may be ints or strings; json round-trips both
+        # exactly (a raw str(key) would fold 3 and "3" together)
+        params = {json.dumps(k): v for k, v in self.store.items()}
+        params[json.dumps("__kvmeta__")] = nd.array(_np.frombuffer(
+            json.dumps(snap_meta).encode("utf-8"), _np.uint8).copy())
+        states = None
+        if self.updater is not None:
+            states = pickle.dumps((self._opt_blob,
+                                   self.updater.get_states(False)))
+        self._ckpt.save_checkpoint(self._snap_seq, arg_params=params,
+                                   optimizer_states=states)
+        _obs_events.emit("kvstore", action="snapshot",
+                         server=self.server_id, seq=self._snap_seq,
+                         applies=self.applies, keys=len(self.store))
+
+    def _restore_snapshot(self):
+        """Restore the newest intact snapshot.  Runs on the
+        constructor thread before any conn thread exists; the lock is
+        held anyway so the write discipline is uniform."""
+        rec = self._ckpt.restore_latest()
+        if rec is None:
+            return False
+        from .ndarray import utils as nd_utils
+        from .model import _split_save_dict
+        arg_params, _aux = _split_save_dict(
+            nd_utils.load(rec.params_path),
+            context="kvstore snapshot %r" % rec.params_path)
+        meta_arr = arg_params.pop(json.dumps("__kvmeta__"), None)
+        snap_meta = {}
+        if meta_arr is not None:
+            snap_meta = json.loads(
+                meta_arr.asnumpy().astype(_np.uint8).tobytes().decode(
+                    "utf-8"))
+        with self.lock:
+            self.store = {json.loads(name): v
+                          for name, v in arg_params.items()}
+            self._str_idx = dict(snap_meta.get("str_idx") or {})
+            self.applies = int(snap_meta.get("applies", 0))
+            self.epoch_token = int(snap_meta.get(
+                "epoch_token", self.epoch_token - 1)) + 1
+            self.evicted = set(int(r)
+                               for r in snap_meta.get("evicted", ()))
+            for client_s, seqs in (snap_meta.get("dedup") or {}).items():
+                rank_s, _, inc_s = client_s.partition(":")
+                client = (int(rank_s), int(inc_s or 0))
+                per_client = self.dedup.setdefault(client,
+                                                   _OrderedDict())
+                for s in seqs:
+                    per_client[int(s)] = _InFlight(
+                        done=True, result=({"restored": True}, ()))
+            if rec.states_path is not None:
+                with open(rec.states_path, "rb") as f:
+                    opt_blob, states = pickle.loads(f.read())
+                self._opt_blob = opt_blob
+                if opt_blob is not None:
+                    self.updater = self._opt_mod.get_updater(
+                        pickle.loads(opt_blob))
+                    self.updater.set_states(states)
+            self._snap_seq = max(self._ckpt.epochs() or [0])
+        log.warning(
+            "kvstore server %d: restored snapshot seq %d (%d keys, "
+            "%d applies committed); epoch token now %d — workers will "
+            "re-init anything newer than the snapshot",
+            self.server_id, self._snap_seq, len(self.store),
+            self.applies, self.epoch_token)
+        _obs_events.emit("kvstore", action="restore",
+                         server=self.server_id, seq=self._snap_seq,
+                         keys=len(self.store), applies=self.applies,
+                         epoch=self.epoch_token)
+        return True
 
     def _serve_conn(self, conn):
         try:
@@ -541,16 +864,95 @@ class KVStoreServer:
                 # exception becomes an 'err' reply instead of killing
                 # this thread and leaving the worker blocked in recv
                 try:
-                    rmeta, rtensors = self._dispatch(kind, meta, tensors)
+                    rmeta, rtensors = self._handle(kind, meta, tensors)
+                except SyncTimeoutError as e:
+                    # typed on the wire: the worker re-raises the same
+                    # class instead of a generic server error
+                    rmeta, rtensors = {"status": "err",
+                                       "code": "sync_timeout",
+                                       "msg": str(e)}, ()
                 except MXNetError as e:
                     rmeta, rtensors = {"status": "err", "msg": str(e)}, ()
                 except Exception as e:
                     rmeta, rtensors = {"status": "err", "msg": "%s: %s"
                                        % (type(e).__name__, e)}, ()
                 rmeta.setdefault("status", "ok")
+                if kind in _BULK_KINDS:
+                    action = _netchaos.on_server_reply(kind)
+                    if action == "drop":
+                        # state already mutated; the worker's retried
+                        # request id answers from the dedup window
+                        continue
+                    if action == "torn":
+                        payload = _frame_bytes(_MSG_REPLY, rmeta,
+                                               rtensors)
+                        conn.sendall(payload[:max(9, len(payload) // 2)])
+                        conn.close()
+                        return
                 _send_frame(conn, _MSG_REPLY, rmeta, rtensors)
         except (ConnectionError, OSError):
             return
+
+    def _handle(self, kind, meta, tensors):
+        """Dedup wrapper around :meth:`_dispatch`: the first arrival
+        of a mutating ``(rank, seq)`` executes and caches its reply;
+        duplicates (worker retries, netchaos dup injections) wait for
+        the original and answer from cache — exactly-once."""
+        req = meta.get("req") if isinstance(meta, dict) else None
+        if req is None or kind not in _MUTATING_KINDS:
+            return self._dispatch(kind, meta, tensors)
+        rank, seq = int(req[0]), int(req[1])
+        inc = int(req[2]) if len(req) > 2 else 0
+        client = (rank, inc)
+        with self.lock:
+            per_client = self.dedup.get(client)
+            if per_client is None:
+                # a fresh incarnation of this rank: keep only a few
+                # dead incarnations' windows around (their retries can
+                # still arrive for a short while after a rejoin)
+                stale = [c for c in self.dedup if c[0] == rank]
+                if len(stale) >= 4:
+                    self.dedup.pop(stale[0], None)
+                per_client = self.dedup[client] = _OrderedDict()
+            entry = per_client.get(seq)
+            owner = entry is None
+            if owner:
+                entry = _InFlight()
+                per_client[seq] = entry
+                while len(per_client) > self.dedup_window:
+                    oldest = next(iter(per_client))
+                    if not per_client[oldest].event.is_set():
+                        break       # never drop an in-flight entry
+                    per_client.pop(oldest)
+        if not owner:
+            _DEDUP_HITS.inc()
+            entry.event.wait(timeout=self.sync_timeout + 5.0)
+            result = entry.result
+            if result is None:
+                raise MXNetError(
+                    "duplicate request (%d, %d) whose original attempt "
+                    "failed or is still in flight" % (rank, seq))
+            rmeta = dict(result[0])
+            rmeta["dup"] = True
+            return rmeta, result[1]
+        try:
+            rmeta, rtensors = self._dispatch(kind, meta, tensors)
+        except Exception:
+            # no partial state survives a failed mutating RPC (sync
+            # timeouts drop their accumulator), so let a future retry
+            # re-execute instead of replaying the failure from cache
+            with self.lock:
+                self.dedup.get(client, {}).pop(seq, None)
+                self._applied_inflight.discard((rank, inc, seq))
+            entry.event.set()
+            raise
+        entry.result = (dict(rmeta), tuple(rtensors))
+        entry.event.set()
+        # the set event now records completion; the applied-in-flight
+        # marker (set if a snapshot-covered apply ran) is redundant
+        with self.lock:
+            self._applied_inflight.discard((rank, inc, seq))
+        return rmeta, rtensors
 
     def _dispatch(self, kind, meta, tensors):
         """Handle one request; returns (reply_meta, reply_tensors)."""
@@ -561,7 +963,10 @@ class KVStoreServer:
                     self.store[key] = nd.array(tensors[0])
             return {}, ()
         if kind == _MSG_PUSH:
+            _netchaos.on_server_push()   # hard-kill drill point
             key = meta["key"]
+            with self.lock:
+                self.pushes_received += 1
             if meta.get("compressed"):
                 codes = self._quant_mod.unpack_2bit(
                     tensors[0], meta["n"]).astype(
@@ -584,10 +989,22 @@ class KVStoreServer:
             # consistency path
             with self.lock:
                 sync = self.sync
-            if sync:
-                self._push_sync(key, val)
+            # the pusher's rank comes from the request id (every
+            # KVStoreDist push carries one); raw legacy pushers may
+            # declare it as meta['rank'] instead
+            req = meta.get("req")
+            req_id = None
+            if req:
+                rank = int(req[0])
+                req_id = (rank, int(req[2]) if len(req) > 2 else 0,
+                          int(req[1]))
             else:
-                self._apply(key, val)
+                rank = int(meta.get("rank", 0))
+            if sync:
+                self._push_sync(key, val, rank, req_id)
+            else:
+                self._apply(key, val,
+                            applied_reqs=(req_id,) if req_id else ())
             return {}, ()
         if kind == _MSG_PULL:
             with self.lock:
@@ -609,15 +1026,31 @@ class KVStoreServer:
             self._barrier(meta.get("rank", 0), meta.get("round", 0))
             return {}, ()
         if kind == _MSG_HEARTBEAT:
+            node = meta["node"]
             with self.lock:
-                self.heartbeats[meta["node"]] = time.time()
-            return {}, ()
+                self.heartbeats[node] = time.time()
+                # a fresh heartbeat from an evicted rank is a rejoin:
+                # restore it to the expected-contributor set
+                rank = _node_rank(node)
+                unevicted = rank is not None and rank in self.evicted
+                if unevicted:
+                    self.evicted.discard(rank)
+            if unevicted:
+                log.warning("kvstore server %d: rank %d heartbeating "
+                            "again — un-evicted (rejoin)",
+                            self.server_id, rank)
+                _obs_events.emit("kvstore", action="rejoin", rank=rank,
+                                 server=self.server_id)
+            # the epoch token lets workers detect a server restart and
+            # re-init only the keys the new incarnation lost
+            return {"epoch": self.epoch_token}, ()
         if kind == _MSG_DEADQUERY:
             now = time.time()
             with self.lock:
                 dead = [n for n, ts in self.heartbeats.items()
                         if now - ts > meta["timeout"]]
-            return {"dead": dead}, ()
+                evicted = sorted(self.evicted)
+            return {"dead": dead, "evicted": evicted}, ()
         if kind == _MSG_SET_OPT:
             # control plane: optimizer ships pickled from rank 0, same
             # trust stance as the reference's set_optimizer.  The
@@ -625,10 +1058,12 @@ class KVStoreServer:
             # under it from other conn threads (an unlocked write here
             # raced a concurrent async push — the lockset detector's
             # first real finding)
-            optimizer = pickle.loads(tensors[0].tobytes())
+            blob = tensors[0].tobytes()
+            optimizer = pickle.loads(blob)
             updater = self._opt_mod.get_updater(optimizer)
             with self.lock:
                 self.updater = updater
+                self._opt_blob = blob   # snapshots re-create the updater
             return {}, ()
         if kind == _MSG_CMD:
             # rank-0 command channel (reference: kvstore.h
@@ -641,6 +1076,18 @@ class KVStoreServer:
             if head == "mode":
                 with self.lock:
                     self.sync = "async" not in str(body)
+            elif head == "stats":
+                # consistency/health introspection: restart detection
+                # (which keys survived), exactly-once drills (applies),
+                # eviction state — one locked snapshot of the counters
+                with self.lock:
+                    return {"applies": self.applies,
+                            "pushes": self.pushes_received,
+                            "epoch": self.epoch_token,
+                            "keys": sorted(self.store, key=repr),
+                            "evicted": sorted(self.evicted),
+                            "snapshots": self._snap_seq,
+                            "server_id": self.server_id}, ()
             elif head == "profiler:set_config":
                 cfg = dict(body)
                 if "filename" in cfg and self.server_id:
@@ -657,61 +1104,194 @@ class KVStoreServer:
             return {}, ()
         raise MXNetError("unknown kvstore message kind %d" % kind)
 
-    def _push_sync(self, key, val):
-        """Aggregate until all workers pushed, then apply once
-        (reference: ApplyUpdates:346-358)."""
+    # -- straggler tolerance ----------------------------------------------
+    def _expected_ranks(self):
+        """The ranks a sync round must hear from (self.lock taken
+        inside; callers may hold self.cv — cv-before-lock is the one
+        ordering this class uses)."""
+        with self.lock:
+            return set(range(self.num_workers)) - self.evicted
+
+    def _evict_dead(self, missing, context):
+        """self.cv held.  Split *missing* ranks into provably-dead
+        (heartbeat stale beyond the evict timeout — evicted, so the
+        survivors make progress) and alive-but-slow laggards (the
+        caller raises loudly, naming them)."""
+        now = time.time()
+        evicted_now, laggards = [], []
+        with self.lock:
+            for r in sorted(missing):
+                ts = self.heartbeats.get("worker%d" % r)
+                if ts is not None and now - ts > self.evict_timeout:
+                    self.evicted.add(r)
+                    # the dead-node listing shrinks too: an evicted
+                    # rank is no longer an expected cluster member
+                    self.heartbeats.pop("worker%d" % r, None)
+                    evicted_now.append(r)
+                else:
+                    laggards.append(r)
+        for r in evicted_now:
+            _EVICTIONS.inc()
+            log.warning(
+                "kvstore server %d: evicted dead worker rank %d (%s; "
+                "last heartbeat > %.1fs ago); expected contributors "
+                "now %d", self.server_id, r, context,
+                self.evict_timeout,
+                self.num_workers - len(self.evicted))
+            _obs_events.emit("kvstore", action="evict", rank=r,
+                             server=self.server_id, reason=context)
+        return evicted_now, laggards
+
+    def _try_apply_pending(self, key):
+        """self.cv held: apply *key*'s accumulator if every currently
+        expected rank contributed; True when the round is finished."""
+        acc = self.pending.get(key)
+        if acc is None:
+            return True
+        expected = self._expected_ranks()
+        if not expected or not expected <= acc[1]:
+            return False
+        self.pending.pop(key)
+        # every contributor's request id is committed by this apply —
+        # a snapshot inside it must cover the whole round
+        self._apply(key, acc[0], applied_reqs=acc[2])
+        self.cv.notify_all()
+        return True
+
+    def _try_complete_barrier(self, rnd):
+        """self.cv held: complete barrier *rnd* if every currently
+        expected rank arrived; True when the round is done."""
+        if rnd in self.barrier_done:
+            return True
+        arrived = self.barrier_rounds.get(rnd)
+        if arrived is None:
+            return False
+        expected = self._expected_ranks()
+        if not expected or not expected <= arrived:
+            return False
+        self.barrier_done.add(rnd)
+        del self.barrier_rounds[rnd]
+        # prune: done rounds older than any pending round
+        if len(self.barrier_done) > 1024:
+            keep = max(self.barrier_done)
+            self.barrier_done = {r for r in self.barrier_done
+                                 if r > keep - 1024}
+        self.cv.notify_all()
+        return True
+
+    def _sweep_after_eviction(self):
+        """self.cv held: an eviction shrank the expected set — every
+        pending sync key and barrier round must be re-checked, not
+        just the one whose deadline noticed the death."""
+        for key in list(self.pending):
+            self._try_apply_pending(key)
+        for rnd in list(self.barrier_rounds):
+            self._try_complete_barrier(rnd)
+
+    def _push_sync(self, key, val, rank, req_id=None):
+        """Aggregate until all expected workers pushed, then apply once
+        (reference: ApplyUpdates:346-358).  On deadline expiry the
+        heartbeat table decides: provably-dead ranks are evicted and
+        the round completes for the survivors; an alive-but-slow
+        laggard raises a loud typed error naming it."""
         with self.cv:
             if key in self.pending:
                 self.pending[key][0] = self.pending[key][0] + val
-                self.pending[key][1] += 1
+                self.pending[key][1].add(rank)
+                if req_id is not None:
+                    self.pending[key][2].add(req_id)
             else:
-                self.pending[key] = [val, 1]
-            if self.pending[key][1] >= self.num_workers:
-                acc = self.pending.pop(key)[0]
-                self._apply(key, acc)
-                self.cv.notify_all()
+                self.pending[key] = [val, {rank},
+                                     {req_id} if req_id else set()]
+            if self._try_apply_pending(key):
                 return
             deadline = time.time() + self.sync_timeout
             while key in self.pending and time.time() < deadline:
                 self.cv.wait(timeout=0.1)
-            if key in self.pending:
-                # drop the stale accumulator so a late worker cannot mix
-                # gradients across rounds after the failure
-                got = self.pending.pop(key)[1]
-                self.cv.notify_all()
-                raise MXNetError(
-                    "dist_sync push for key %r timed out waiting for "
-                    "%d workers (got %d) — worker desync or crash"
-                    % (key, self.num_workers, got))
+            if key not in self.pending:
+                self._raise_if_aborted(key, rank)
+                return
+            arrived = set(self.pending[key][1])
+            missing = self._expected_ranks() - arrived
+            evicted_now, laggards = self._evict_dead(
+                missing, "sync push key=%r" % (key,))
+            if evicted_now:
+                self._sweep_after_eviction()
+            if self._try_apply_pending(key):
+                return
+            # drop the stale accumulator so a late worker cannot mix
+            # gradients across rounds after the failure; the OTHER
+            # contributors still in cv.wait find their rank here and
+            # raise the same typed error instead of a false 'ok'
+            dropped = self.pending.pop(key)[1]
+            got = len(dropped)
+            dropped.discard(rank)      # this thread raises directly
+            if dropped:
+                self.aborted_rounds[key] = dropped
+            self.cv.notify_all()
+            _SYNC_TIMEOUTS.inc()
+            _obs_events.emit("kvstore", action="sync_timeout",
+                             key=str(key), got=got,
+                             expected=self.num_workers,
+                             laggards=laggards, server=self.server_id)
+            raise SyncTimeoutError(
+                "dist_sync push for key %r timed out after %.1fs: got "
+                "%d contributor(s), still waiting on alive-but-slow "
+                "rank(s) %s — straggling worker, not a crash (dead "
+                "ranks would have been evicted)"
+                % (key, self.sync_timeout, got, laggards))
+
+    def _raise_if_aborted(self, key, rank):
+        """self.cv held: a waiter whose round vanished from pending
+        checks whether it was APPLIED (fine — return ok) or ABANDONED
+        with its gradient dropped (raise the same typed error the
+        abandoning thread raised)."""
+        aborted = self.aborted_rounds.get(key)
+        if not aborted or rank not in aborted:
+            return
+        aborted.discard(rank)
+        if not aborted:
+            del self.aborted_rounds[key]
+        raise SyncTimeoutError(
+            "dist_sync push for key %r was abandoned after a sync "
+            "timeout — rank %d's gradient was dropped with the round"
+            % (key, rank))
 
     def _barrier(self, rank, rnd):
         """Round-aware barrier: each worker reports (rank, its own round
-        number); a round completes when every rank has arrived.  Immune
-        to overlapping rounds under skew (a fast worker in round r+1
-        cannot be miscounted into round r)."""
+        number); a round completes when every expected rank has arrived.
+        Immune to overlapping rounds under skew (a fast worker in round
+        r+1 cannot be miscounted into round r); deadline expiry evicts
+        provably-dead ranks exactly like :meth:`_push_sync`."""
         with self.cv:
             if rnd in self.barrier_done:
                 return
-            arrived = self.barrier_rounds.setdefault(rnd, set())
-            arrived.add(rank)
-            if len(arrived) >= self.num_workers:
-                self.barrier_done.add(rnd)
-                del self.barrier_rounds[rnd]
-                # prune: done rounds older than any pending round
-                if len(self.barrier_done) > 1024:
-                    keep = max(self.barrier_done)
-                    self.barrier_done = {r for r in self.barrier_done
-                                         if r > keep - 1024}
-                self.cv.notify_all()
+            self.barrier_rounds.setdefault(rnd, set()).add(rank)
+            if self._try_complete_barrier(rnd):
                 return
             deadline = time.time() + self.sync_timeout
             while rnd not in self.barrier_done and time.time() < deadline:
                 self.cv.wait(timeout=0.1)
-            if rnd not in self.barrier_done:
-                got = len(self.barrier_rounds.get(rnd, ()))
-                raise MXNetError(
-                    "kvstore barrier timed out: %d/%d workers arrived "
-                    "for round %d" % (got, self.num_workers, rnd))
+            if rnd in self.barrier_done:
+                return
+            arrived = set(self.barrier_rounds.get(rnd, ()))
+            missing = self._expected_ranks() - arrived
+            evicted_now, laggards = self._evict_dead(
+                missing, "barrier round=%d" % rnd)
+            if evicted_now:
+                self._sweep_after_eviction()
+            if self._try_complete_barrier(rnd):
+                return
+            got = len(self.barrier_rounds.get(rnd, ()))
+            _SYNC_TIMEOUTS.inc()
+            _obs_events.emit("kvstore", action="barrier_timeout",
+                             round=rnd, got=got,
+                             expected=self.num_workers,
+                             laggards=laggards, server=self.server_id)
+            raise SyncTimeoutError(
+                "kvstore barrier timed out: %d/%d workers arrived for "
+                "round %d; alive-but-slow rank(s): %s"
+                % (got, self.num_workers, rnd, laggards))
 
 
 class KVStoreDist(KVStoreBase):
@@ -729,20 +1309,37 @@ class KVStoreDist(KVStoreBase):
     def __init__(self, name="dist_sync"):
         super().__init__()
         self.name = name
-        host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
-        port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+        self._host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+        self._root_port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
         self._rank = int(os.environ.get("DMLC_WORKER_RANK",
                                         os.environ.get("DMLC_RANK", "0")))
         self._num_workers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
         self._num_servers = int(os.environ.get("DMLC_NUM_SERVER", "1"))
         from .config import get_env as _get_env
         self._big_bound = _get_env("MXNET_KVSTORE_BIGARRAY_BOUND")
+        self._rpc_timeout = _get_env("MXNET_KVSTORE_RPC_TIMEOUT")
+        self._rpc_attempts = max(1, _get_env("MXNET_KVSTORE_RPC_RETRIES"))
+        self._connect_timeout = _get_env("MXNET_KVSTORE_CONNECT_TIMEOUT")
+        # mutating RPCs carry (rank, seq, incarnation): one id per
+        # logical request, reused verbatim across transport retries.
+        # The incarnation token distinguishes a RESTARTED worker with
+        # the same rank (async rejoin, reference is_recovery) whose
+        # fresh seq counter would otherwise collide with — and be
+        # wrongly deduped against — its previous life's request ids.
+        self._req_seq = 0
+        self._incarnation = ((int(time.time() * 1000) << 16)
+                             ^ os.getpid()) & 0x7FFFFFFFFFFF
+        self._seq_lock = _san.lock(label="KVStoreDist.seq")
+        # init-time values, kept so a restarted server's lost keys can
+        # be re-initialized (only what the snapshot didn't cover)
+        self._init_cache = {}
+        self._cache_lock = _san.lock(label="KVStoreDist.init_cache")
+        self._server_epochs = {}   # heartbeat thread only
         # server s listens on root port + s (tools/launch.py convention)
         self._socks = []
         self._locks = []
-        deadline = time.time() + _get_env("MXNET_KVSTORE_CONNECT_TIMEOUT")
         for s in range(self._num_servers):
-            self._socks.append(_connect_retry(host, port + s, deadline))
+            self._socks.append(self._connect(s))
             self._locks.append(_san.lock())
         self._residual = {}
         self._sharded_keys = set()
@@ -756,18 +1353,33 @@ class KVStoreDist(KVStoreBase):
         from . import profiler as _prof
         _prof.set_kvstore_handle(self)
 
+    def _connect(self, s):
+        """Fresh bulk-RPC socket to server *s*: connect-with-retry up
+        to the connect deadline, then the per-call RPC timeout so a
+        server dying mid-reply can never hang a worker in recv."""
+        sock = _connect_retry(self._host, self._root_port + s,
+                              time.time() + self._connect_timeout)
+        if self._rpc_timeout > 0:
+            sock.settimeout(self._rpc_timeout)
+        return sock
+
     def _start_heartbeat(self):
         from .config import get_env as _get_env
         interval = _get_env("MXNET_KVSTORE_HEARTBEAT_INTERVAL")
         node = "worker%d" % self._rank
         # dedicated sockets: heartbeats must not contend with bulk RPCs
-        host = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
-        port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+        host, port = self._host, self._root_port
 
         def beat():
             socks = {}
+            fails = {}   # server -> consecutive failures (bounded noise)
+            defer = {}   # server -> monotonic time to retry after
             while not getattr(self, "_closed", False):
                 for s in range(self._num_servers):
+                    if time.monotonic() < defer.get(s, 0.0):
+                        continue    # backed-off: THIS server only —
+                        # healthy peers must keep seeing us at full
+                        # cadence or they'd evict a live worker
                     try:
                         if s not in socks:
                             hs = socket.socket(socket.AF_INET,
@@ -777,21 +1389,122 @@ class KVStoreDist(KVStoreBase):
                             hs.settimeout(5)
                             hs.connect((host, port + s))
                             socks[s] = hs
-                        _rpc_call(socks[s], _MSG_HEARTBEAT,
-                                  {"node": node})
-                    except (ConnectionError, OSError):
-                        # transient: server restarting; retry next beat
-                        socks.pop(s, None)
+                        rmeta, _ = _rpc_call(socks[s], _MSG_HEARTBEAT,
+                                             {"node": node})
+                    except (RPCTimeoutError, ConnectionError, OSError) \
+                            as exc:
+                        # transient (server restarting): retry next
+                        # beat — but visibly and boundedly, not a
+                        # silent forever-loop: count every failure,
+                        # WARN once per outage, back off the cadence
+                        hs = socks.pop(s, None)
+                        if hs is not None:
+                            try:
+                                hs.close()
+                            except OSError:
+                                pass
+                        _HB_FAILURES.inc()
+                        fails[s] = fails.get(s, 0) + 1
+                        if fails[s] == _HB_FAIL_WARN_AFTER:
+                            log.warning(
+                                "kvstore heartbeat to server %d failed "
+                                "%d consecutive times (%s: %s); "
+                                "failure detection degraded — backing "
+                                "off to %.1fs between attempts",
+                                s, fails[s], type(exc).__name__, exc,
+                                interval * _HB_BACKOFF)
+                        if fails[s] >= _HB_FAIL_WARN_AFTER:
+                            defer[s] = (time.monotonic()
+                                        + interval * _HB_BACKOFF)
+                        continue
                     except Exception as e:
                         # unexpected: surface at the next engine sync
                         # point (reference: exception chain rethrow)
                         from .runtime import engine as _engine
                         _engine.record_exception(e)
                         return
+                    if fails.get(s, 0) >= _HB_FAIL_WARN_AFTER:
+                        log.info("kvstore heartbeat to server %d "
+                                 "recovered after %d failures",
+                                 s, fails[s])
+                    fails[s] = 0
+                    defer.pop(s, None)
+                    # restart detection: the server stamps every
+                    # heartbeat reply with its incarnation's epoch token
+                    epoch = rmeta.get("epoch")
+                    if epoch is not None:
+                        last = self._server_epochs.get(s)
+                        self._server_epochs[s] = epoch
+                        if last is not None and epoch != last:
+                            self._on_server_restart(s, last, epoch)
                 time.sleep(interval)
 
         self._hb_thread = _san.thread(target=beat, daemon=True)
         self._hb_thread.start()
+
+    def _on_server_restart(self, s, old_epoch, new_epoch):
+        """Heartbeat thread: server *s*'s epoch token changed — it
+        restarted.  The re-init work runs on its OWN daemon thread:
+        it issues blocking bulk RPCs (shared per-server socket locks),
+        and stalling the beat loop on them would stop proving this
+        worker's liveness to every OTHER server — long enough, the
+        worker itself gets evicted as 'provably dead'."""
+        _SERVER_RESTARTS.inc()
+        log.warning("kvstore server %d restarted (epoch %s -> %s); "
+                    "checking for lost keys", s, old_epoch, new_epoch)
+        _obs_events.emit("kvstore", action="server_restart_detected",
+                         server=s, old_epoch=old_epoch,
+                         new_epoch=new_epoch, rank=self._rank)
+        _san.thread(target=self._reinit_lost_keys, args=(s,),
+                    daemon=True).start()
+
+    def _reinit_lost_keys(self, s):
+        """Re-init ONLY the keys restarted server *s* lost (a
+        snapshot-restored server reports survivors in 'stats'), so
+        rejoin pulls resume from committed state instead of zeros or
+        KeyErrors.  Rank 0 holds the init-time cache (it is also the
+        rank that sent the INITs originally); INIT is idempotent, so
+        racing a concurrent snapshot-restored key is harmless."""
+        try:
+            have = set(self._rpc(_MSG_CMD, {"head": "stats"},
+                                 server=s)[0].get("keys", ()))
+            with self._cache_lock:
+                cached = list(self._init_cache.items())
+            sent = 0
+            for k, arr in cached:
+                for wire_key, value in self._wire_entries(k, arr, s):
+                    if wire_key not in have:
+                        self._rpc(_MSG_INIT, {"key": wire_key},
+                                  (value,), server=s)
+                        sent += 1
+            if sent:
+                log.warning(
+                    "kvstore: re-initialized %d lost key(s) on "
+                    "restarted server %d from their init-time values "
+                    "(training state for those keys reset to init)",
+                    sent, s)
+                _obs_events.emit("kvstore", action="reinit", server=s,
+                                 keys=sent, rank=self._rank)
+        except (MXNetError, ConnectionError, OSError) as exc:
+            # best effort from a daemon thread: a failed re-init must
+            # not kill heartbeating — a later pull of a lost key will
+            # fail loudly anyway
+            log.warning("kvstore: re-init after server %d restart "
+                        "failed (%s: %s)", s, type(exc).__name__, exc)
+
+    def _wire_entries(self, k, arr, server):
+        """(wire key, numpy value) pairs of key *k* that live on
+        *server* — one per shard for sharded keys, the key itself when
+        the stable hash picks this server."""
+        if k in self._sharded_keys:
+            flat = arr.ravel()
+            off = 0
+            for s2, ln in enumerate(self._shard_splits(arr.size)):
+                if s2 == server:
+                    yield "%s#shard%d" % (k, s2), flat[off:off + ln]
+                off += ln
+        elif self._server_for_key(k) == server:
+            yield k, arr
 
     def _server_for_key(self, k):
         import zlib
@@ -806,6 +1519,12 @@ class KVStoreDist(KVStoreBase):
             return len(dead)
         return int(("worker%d" % node_id) in dead)
 
+    def server_stats(self, server=0):
+        """One server's health/consistency counters: ``applies`` (the
+        exactly-once proof), ``pushes``, ``epoch`` (incarnation
+        token), ``keys``, ``evicted``, ``snapshots``."""
+        return self._rpc(_MSG_CMD, {"head": "stats"}, server=server)[0]
+
     @property
     def type(self):
         return self.name
@@ -819,11 +1538,22 @@ class KVStoreDist(KVStoreBase):
         return self._num_workers
 
     def _rpc(self, kind, meta=None, tensors=(), server=None, key=None):
-        """One framed round-trip; returns (reply_meta, reply_tensors)."""
+        """One framed round-trip; returns (reply_meta, reply_tensors).
+
+        Mutating kinds get a ``(rank, seq)`` request id; every kind
+        gets transport retries: a timeout or broken connection closes
+        the socket, reconnects, and resends the SAME request — the
+        server's dedup window makes retried mutations exactly-once."""
         s = (server if server is not None
              else self._server_for_key(key) if key is not None else 0)
+        if kind in _MUTATING_KINDS:
+            with self._seq_lock:
+                self._req_seq += 1
+                seq = self._req_seq
+            meta = dict(meta or {})
+            meta["req"] = [self._rank, seq, self._incarnation]
         with self._locks[s]:
-            reply = _rpc_call(self._socks[s], kind, meta, tensors)
+            reply = self._rpc_with_retry(s, kind, meta, tensors)
         # wire-level traffic accounting (payload bytes, post
         # compression/rsp packing — the number a capacity planner
         # multiplies by worker count)
@@ -834,6 +1564,36 @@ class KVStoreDist(KVStoreBase):
             _PULL_BYTES.inc(sum(int(getattr(t, "nbytes", 0))
                                 for t in reply[1]))
         return reply
+
+    def _rpc_with_retry(self, s, kind, meta, tensors):
+        """self._locks[s] held.  One request id, up to
+        ``MXNET_KVSTORE_RPC_RETRIES`` transport attempts with jittered
+        backoff (resilience.retry).  Server-reported errors (MXNetError
+        that is not a transport timeout) propagate immediately — only
+        the transport retries, never the semantics."""
+        def attempt():
+            if self._socks[s] is None:
+                self._socks[s] = self._connect(s)
+            try:
+                return _rpc_call(self._socks[s], kind, meta, tensors,
+                                 inject=True)
+            except (RPCTimeoutError, ConnectionError, OSError):
+                # the stream is unusable (half-read reply, torn frame,
+                # dead peer): drop it; the next attempt reconnects
+                sock, self._socks[s] = self._socks[s], None
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                raise
+        from .resilience.retry import retry_call
+        return retry_call(
+            attempt, attempts=self._rpc_attempts, base_delay=0.05,
+            max_delay=1.0, jitter=0.5,
+            retry_on=(RPCTimeoutError, ConnectionError, OSError),
+            logger=log,
+            on_retry=lambda _a, _e, _d: _RPC_RETRIES.inc())
 
     def _rpc_fanout(self, calls):
         """Round-trip one request per server CONCURRENTLY — sharded
@@ -880,6 +1640,14 @@ class KVStoreDist(KVStoreBase):
         keys, values = _key_list(key, value)
         for k, vs in zip(keys, values):
             arr = vs[0].asnumpy()
+            # only rank 0 caches the init-time values (it is the rank
+            # that sends INITs, so restart re-init mirrors the same
+            # authority) — the cache is a full host-side parameter
+            # copy, and paying that on every worker would double host
+            # memory for a restart-only path
+            if self._rank == 0:
+                with self._cache_lock:
+                    self._init_cache[k] = arr
             # the sharding decision is taken ONCE at init and recorded:
             # later compression toggles must not change a key's layout
             # (every worker runs init, so every worker records it).
@@ -1039,10 +1807,17 @@ class KVStoreDist(KVStoreBase):
         from . import profiler as _prof
         if _prof._kvstore_handle is self:
             _prof.set_kvstore_handle(None)
+        # deliberately NOT routed through the retry transport: a dead
+        # server must not cost reconnect deadlines at shutdown — one
+        # best-effort STOP per live socket
         for s in range(self._num_servers):
             try:
-                self._rpc(_MSG_STOP, server=s)
-            except ConnectionError:
+                with self._locks[s]:
+                    sock = self._socks[s]
+                    if sock is None:
+                        continue
+                    _rpc_call(sock, _MSG_STOP)
+            except (RPCTimeoutError, ConnectionError, OSError):
                 pass
 
 

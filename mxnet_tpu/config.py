@@ -87,6 +87,41 @@ register_env("MXNET_KVSTORE_CONNECT_TIMEOUT", float, 120.0,
              "(fresh socket per attempt) before raising — covers "
              "server-process spin-up, which includes a full package "
              "import")
+register_env("MXNET_KVSTORE_RPC_TIMEOUT", float, 150.0,
+             "Per-call socket timeout (seconds) on dist bulk RPC "
+             "sockets: a server that dies mid-reply surfaces as a "
+             "typed RPCTimeoutError instead of hanging the worker "
+             "forever in recv; must exceed MXNET_KVSTORE_SYNC_TIMEOUT "
+             "(sync pushes block server-side until the round "
+             "completes); 0 = no timeout (legacy hang behavior)")
+register_env("MXNET_KVSTORE_RPC_RETRIES", int, 5,
+             "Transport attempts per dist bulk RPC: a timed-out or "
+             "connection-broken call reconnects and resends the SAME "
+             "(rank, seq) request id with jittered backoff; the "
+             "server dedup window makes retried mutations apply "
+             "exactly once")
+register_env("MXNET_KVSTORE_DEDUP_WINDOW", int, 256,
+             "Per-rank server-side idempotency window: how many "
+             "recent mutating request ids (push/init/barrier) the "
+             "server remembers so a retried RPC is answered from "
+             "cache instead of re-applied")
+register_env("MXNET_KVSTORE_EVICT_TIMEOUT", float, 10.0,
+             "Seconds without a heartbeat before a sync-mode server "
+             "treats a missing contributor as provably dead on "
+             "sync/barrier deadline expiry and evicts it (survivors "
+             "make progress); an alive-but-slow laggard instead "
+             "raises a loud SyncTimeoutError naming it")
+register_env("MXNET_KVSTORE_SNAPSHOT_PREFIX", str, "",
+             "Checkpoint prefix for periodic KVStore server state "
+             "snapshots (store + optimizer state + dedup window via "
+             "resilience.CheckpointManager); a restarted server "
+             "restores the snapshot so worker rejoin pulls resume "
+             "from committed state; empty = snapshots off; server s "
+             "of a group appends '-s<id>'")
+register_env("MXNET_KVSTORE_SNAPSHOT_EVERY", int, 1,
+             "Applies between server state snapshots (counter-based, "
+             "deterministic); only consulted when "
+             "MXNET_KVSTORE_SNAPSHOT_PREFIX is set; 0 = never")
 register_env("MXNET_SAN", str, "",
              "graftsan runtime sanitizer components to enable: comma "
              "list of race,recompile,donation,transfer, or 'all'; "
@@ -94,7 +129,8 @@ register_env("MXNET_SAN", str, "",
 register_env("MXNET_OBS", str, "",
              "Structured run-event categories to record to "
              "events.jsonl: comma list of compile,guard,chaos,"
-             "checkpoint,preempt,retry,respawn,warning, or 'all'; "
+             "checkpoint,preempt,retry,respawn,warning,kvstore, or "
+             "'all'; "
              "empty = off (no file, zero per-event cost; see "
              "docs/observability.md)")
 register_env("MXNET_OBS_PATH", str, "events.jsonl",

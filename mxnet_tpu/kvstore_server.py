@@ -13,7 +13,8 @@ import sys
 from ._kvstore_impl import KVStoreServer
 
 
-def run_server(kv_type="dist_sync", host=None, port=None, num_workers=None):
+def run_server(kv_type="dist_sync", host=None, port=None, num_workers=None,
+               snapshot_prefix=None):
     # The parameter server is a host-side service: aggregation and the
     # server-side optimizer run on CPU (the reference's ps-lite servers
     # are CPU processes), never on the accelerator.
@@ -40,7 +41,12 @@ def run_server(kv_type="dist_sync", host=None, port=None, num_workers=None):
         host=host or os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1"),
         port=port if port is not None else
         int(os.environ.get("DMLC_PS_ROOT_PORT", "9091")) + server_id,
-        server_id=server_id)
+        server_id=server_id,
+        # snapshot_prefix=None defers to MXNET_KVSTORE_SNAPSHOT_PREFIX;
+        # with either set, the constructor restores the newest intact
+        # snapshot before serving, so worker rejoin pulls resume from
+        # committed state after a kill (docs/resilience.md)
+        snapshot_prefix=snapshot_prefix)
     server.run()
     return server
 

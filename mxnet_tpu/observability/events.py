@@ -16,8 +16,9 @@ writer is created lazily on the first recorded event — with the knob
 unset no file is ever opened.
 
 Categories: ``compile``, ``guard``, ``chaos``, ``checkpoint``,
-``preempt``, ``retry``, ``respawn``, ``warning`` (plus anything a
-caller passes — unknown categories are recorded when ``all`` is on).
+``preempt``, ``retry``, ``respawn``, ``warning``, ``kvstore`` (plus
+anything a caller passes — unknown categories are recorded when
+``all`` is on).
 
 Durability discipline (the same machinery family as
 ``resilience.checkpoint``): each line is ONE ``os.write`` on an
@@ -46,7 +47,7 @@ __all__ = ["enabled", "emit", "emitter", "watch_jit", "configure",
            "path", "read_events"]
 
 _CATEGORIES = ("compile", "guard", "chaos", "checkpoint", "preempt",
-               "retry", "respawn", "warning")
+               "retry", "respawn", "warning", "kvstore")
 
 
 def _spec():

@@ -13,6 +13,9 @@ ROADMAP's production stance needs on preemptible hardware:
   with a deadline and an injectable clock;
 * :mod:`~mxnet_tpu.resilience.chaos` — deterministic fault injection
   driving the same code paths in CI;
+* :mod:`~mxnet_tpu.resilience.netchaos` — the network-layer injection
+  points (drop / delay / duplicate / torn-frame / partition /
+  server-kill) the distributed KVStore's socket choke points consult;
 * the in-graph non-finite guard lives device-side (see
   ``optimizer/tree_opt.py`` and ``Executor.init_fused_step``); this
   package supplies its host-side :class:`DivergenceError`;
@@ -30,12 +33,14 @@ import threading
 
 from ..base import MXNetError
 from . import chaos  # noqa: F401
+from . import netchaos  # noqa: F401
 from .checkpoint import (CheckpointManager, CheckpointRecord,  # noqa: F401
                          atomic_write)
 from .retry import retry, retry_call  # noqa: F401
 
 __all__ = ["CheckpointManager", "CheckpointRecord", "atomic_write",
-           "retry", "retry_call", "chaos", "DivergenceError",
+           "retry", "retry_call", "chaos", "netchaos",
+           "DivergenceError",
            "request_preemption", "clear_preemption",
            "preemption_requested", "install_preemption_handler"]
 

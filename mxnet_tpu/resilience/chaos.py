@@ -39,6 +39,11 @@ Spec keys (all integers):
 ``preempt_at_batch=N``
     ``preemption_requested()`` turns true once the fit loop has
     ticked N batch boundaries.
+
+Network-layer keys (``net_*``) ride the same spec and are consulted
+by the distributed KVStore's socket choke points — see
+:mod:`~mxnet_tpu.resilience.netchaos` for the catalogue
+(drop / delay / duplicate / torn-frame / partition / server-kill).
 """
 
 from __future__ import annotations
@@ -49,9 +54,9 @@ import threading
 from .. import sanitizer as _san
 
 __all__ = ["SimulatedCrash", "configure", "reset", "active", "enabled",
-           "on_file_write", "on_pre_replace", "on_commit",
-           "on_post_replace", "maybe_poison_batch", "tick", "counter",
-           "preemption_requested"]
+           "consume", "fired", "on_file_write", "on_pre_replace",
+           "on_commit", "on_post_replace", "maybe_poison_batch", "tick",
+           "counter", "preemption_requested"]
 
 log = logging.getLogger(__name__)
 
@@ -147,6 +152,11 @@ def _consume(key):
                      "chaos faults actually fired").inc()
     _obs_events.emit("chaos", injection=key, fire=hit, budget=budget)
     return True
+
+
+# public name: injection points outside this module (netchaos, tests)
+# consume budgets through the same accounting
+consume = _consume
 
 
 def fired(key):

@@ -612,3 +612,384 @@ def test_server_side_profiling():
             else:
                 os.environ[k] = v
         server.wait(timeout=30)
+
+
+# --- distributed fault tolerance: RPC idempotency, typed timeouts,
+# straggler eviction, server snapshot recovery, restart re-init
+# (reference: ps-lite resender/heartbeats; docs/resilience.md
+# "Distributed fault tolerance") -------------------------------------------
+
+import threading
+
+from mxnet_tpu._kvstore_impl import (_rpc_call, _MSG_INIT, _MSG_PUSH,
+                                     _MSG_PULL, _MSG_BARRIER,
+                                     _MSG_HEARTBEAT, _MSG_DEADQUERY,
+                                     _MSG_SET_OPT, RPCTimeoutError,
+                                     SyncTimeoutError)
+
+
+def _sgd_blob():
+    import pickle
+    return np.frombuffer(pickle.dumps(mx.optimizer.create(
+        "sgd", learning_rate=1.0, rescale_grad=1.0, wd=0.0)), np.uint8)
+
+
+def _spawn_server(sync_mode, num_workers, **kw):
+    from mxnet_tpu._kvstore_impl import KVStoreServer
+    srv = KVStoreServer(sync_mode=sync_mode, num_workers=num_workers,
+                        **kw)
+    t = threading.Thread(target=srv.run, daemon=True)
+    t.start()
+    return srv, t
+
+
+def _stop_inproc_server(srv, t):
+    srv._stop.set()
+    try:
+        srv.sock.close()
+    except OSError:
+        pass
+    t.join(timeout=10)
+
+
+def _cli(port):
+    import socket
+    return socket.create_connection(("127.0.0.1", port), timeout=30)
+
+
+def test_push_dedup_applies_exactly_once():
+    """A retried push with a duplicate (rank, seq, incarnation) id is
+    answered from the dedup window, not re-applied; a DIFFERENT
+    incarnation with the same (rank, seq) — a restarted worker — is a
+    fresh request and does apply."""
+    from mxnet_tpu.observability import metrics
+    srv, t = _spawn_server(False, 1)
+    c = _cli(srv.port)
+    try:
+        _rpc_call(c, _MSG_SET_OPT, None, (_sgd_blob(),))
+        _rpc_call(c, _MSG_INIT, {"key": "w"},
+                  (np.zeros(4, np.float32),))
+        grad = np.ones(4, np.float32) * -1     # sgd lr=1: w += 1
+        hits0 = metrics.counter("kvstore_dedup_hits_total").value
+        m1, _ = _rpc_call(c, _MSG_PUSH,
+                          {"key": "w", "req": [0, 1, 77]}, (grad,))
+        m2, _ = _rpc_call(c, _MSG_PUSH,
+                          {"key": "w", "req": [0, 1, 77]}, (grad,))
+        assert "dup" not in m1 and m2.get("dup") is True
+        out = _rpc_call(c, _MSG_PULL, {"key": "w"})[1][0]
+        np.testing.assert_allclose(out, np.ones(4))   # applied ONCE
+        with srv.lock:
+            assert srv.applies == 1
+        assert metrics.counter(
+            "kvstore_dedup_hits_total").value == hits0 + 1
+        # new incarnation, same (rank, seq): NOT a duplicate
+        m3, _ = _rpc_call(c, _MSG_PUSH,
+                          {"key": "w", "req": [0, 1, 88]}, (grad,))
+        assert "dup" not in m3
+        out = _rpc_call(c, _MSG_PULL, {"key": "w"})[1][0]
+        np.testing.assert_allclose(out, np.full(4, 2.0))
+        with srv.lock:
+            assert srv.applies == 2
+    finally:
+        c.close()
+        _stop_inproc_server(srv, t)
+
+
+def test_dedup_window_bounded(monkeypatch):
+    monkeypatch.setenv("MXNET_KVSTORE_DEDUP_WINDOW", "8")
+    srv, t = _spawn_server(False, 1)
+    c = _cli(srv.port)
+    try:
+        _rpc_call(c, _MSG_SET_OPT, None, (_sgd_blob(),))
+        _rpc_call(c, _MSG_INIT, {"key": "w"},
+                  (np.zeros(2, np.float32),))
+        for seq in range(1, 30):
+            _rpc_call(c, _MSG_PUSH, {"key": "w", "req": [0, seq, 5]},
+                      (np.ones(2, np.float32),))
+        with srv.lock:
+            assert len(srv.dedup[(0, 5)]) <= 8
+    finally:
+        c.close()
+        _stop_inproc_server(srv, t)
+
+
+def test_sync_timeout_typed_error_names_laggard(monkeypatch):
+    """An alive-but-slow straggler (fresh heartbeat, no push) makes
+    the round fail LOUDLY: typed SyncTimeoutError naming the rank,
+    plus the kvstore_sync_timeouts_total counter — never a silent
+    fall-through."""
+    monkeypatch.setenv("MXNET_KVSTORE_SYNC_TIMEOUT", "0.6")
+    monkeypatch.setenv("MXNET_KVSTORE_EVICT_TIMEOUT", "60")
+    from mxnet_tpu.observability import metrics
+    srv, t = _spawn_server(True, 2)
+    c = _cli(srv.port)
+    try:
+        before = metrics.counter("kvstore_sync_timeouts_total").value
+        _rpc_call(c, _MSG_HEARTBEAT, {"node": "worker1"})  # alive...
+        with pytest.raises(SyncTimeoutError) as ei:
+            _rpc_call(c, _MSG_PUSH, {"key": "w", "req": [0, 1, 1]},
+                      (np.ones(2, np.float32),))
+        assert "[1]" in str(ei.value)          # names the laggard
+        assert metrics.counter(
+            "kvstore_sync_timeouts_total").value == before + 1
+    finally:
+        c.close()
+        _stop_inproc_server(srv, t)
+
+
+def test_eviction_unblocks_survivors_and_shrinks_dead_listing(
+        monkeypatch):
+    """A contributor whose heartbeat went stale past the evict timeout
+    is provably dead: on sync-deadline expiry it is evicted, the
+    surviving worker's round completes, the dead-node listing shrinks,
+    and a fresh heartbeat from the same rank rejoins (un-evicts)."""
+    monkeypatch.setenv("MXNET_KVSTORE_SYNC_TIMEOUT", "1.0")
+    monkeypatch.setenv("MXNET_KVSTORE_EVICT_TIMEOUT", "0.3")
+    from mxnet_tpu.observability import metrics
+    srv, t = _spawn_server(True, 2)
+    c = _cli(srv.port)
+    try:
+        ev0 = metrics.counter("kvstore_evictions_total").value
+        _rpc_call(c, _MSG_HEARTBEAT, {"node": "worker1"})  # then dies
+        time.sleep(0.5)                    # heartbeat now stale
+        _rpc_call(c, _MSG_HEARTBEAT, {"node": "worker0"})  # survivor
+        t0 = time.time()
+        m, _ = _rpc_call(c, _MSG_PUSH, {"key": "w", "req": [0, 1, 1]},
+                         (np.full(3, 5.0, np.float32),))
+        assert m["status"] == "ok"
+        assert time.time() - t0 < 6        # did not hang forever
+        with srv.lock:
+            assert srv.evicted == {1}
+        out = _rpc_call(c, _MSG_PULL, {"key": "w"})[1][0]
+        np.testing.assert_allclose(out, 5.0)   # survivor's round applied
+        assert metrics.counter(
+            "kvstore_evictions_total").value == ev0 + 1
+        dq, _ = _rpc_call(c, _MSG_DEADQUERY, {"timeout": 0.2})
+        assert dq["evicted"] == [1]
+        assert "worker1" not in dq["dead"]     # listing shrank
+        # barrier also completes against the shrunk expected set
+        _rpc_call(c, _MSG_BARRIER,
+                  {"rank": 0, "round": 1, "req": [0, 2, 1]})
+        # rejoin: a fresh heartbeat un-evicts the rank
+        _rpc_call(c, _MSG_HEARTBEAT, {"node": "worker1"})
+        with srv.lock:
+            assert srv.evicted == set()
+    finally:
+        c.close()
+        _stop_inproc_server(srv, t)
+
+
+def test_server_snapshot_restore_and_dedup_persistence(tmp_path,
+                                                       monkeypatch):
+    """A killed-and-restarted server restores store + optimizer state
+    + dedup window from its snapshot: pulls resume from committed
+    state (not zeros), a pre-kill request id still dedups, and the
+    restored updater keeps applying."""
+    monkeypatch.setenv("MXNET_KVSTORE_SNAPSHOT_PREFIX",
+                       str(tmp_path / "kvsnap"))
+    monkeypatch.setenv("MXNET_KVSTORE_SNAPSHOT_EVERY", "1")
+    srv, t = _spawn_server(False, 1)
+    c = _cli(srv.port)
+    try:
+        _rpc_call(c, _MSG_SET_OPT, None, (_sgd_blob(),))
+        _rpc_call(c, _MSG_INIT, {"key": "w"},
+                  (np.zeros(4, np.float32),))
+        grad = np.ones(4, np.float32) * -1
+        _rpc_call(c, _MSG_PUSH, {"key": "w", "req": [0, 1, 7]}, (grad,))
+        _rpc_call(c, _MSG_PUSH, {"key": "w", "req": [0, 2, 7]}, (grad,))
+        srv._ckpt.wait()                  # background writes committed
+        tok_a = srv.epoch_token
+    finally:
+        c.close()
+        _stop_inproc_server(srv, t)
+    srv2, t2 = _spawn_server(False, 1)    # same prefix -> restores
+    c2 = _cli(srv2.port)
+    try:
+        out = _rpc_call(c2, _MSG_PULL, {"key": "w"})[1][0]
+        np.testing.assert_allclose(out, np.full(4, 2.0))  # not zeros
+        with srv2.lock:
+            assert srv2.applies == 2
+            assert srv2.epoch_token == tok_a + 1   # restart detectable
+        # a retried pre-kill request id dedups against the RESTORED window
+        m, _ = _rpc_call(c2, _MSG_PUSH, {"key": "w", "req": [0, 2, 7]},
+                         (grad,))
+        assert m.get("dup") is True
+        out = _rpc_call(c2, _MSG_PULL, {"key": "w"})[1][0]
+        np.testing.assert_allclose(out, np.full(4, 2.0))
+        # the restored updater (SET_OPT blob survived) keeps applying
+        _rpc_call(c2, _MSG_PUSH, {"key": "w", "req": [0, 3, 7]}, (grad,))
+        out = _rpc_call(c2, _MSG_PULL, {"key": "w"})[1][0]
+        np.testing.assert_allclose(out, np.full(4, 3.0))
+    finally:
+        c2.close()
+        _stop_inproc_server(srv2, t2)
+
+
+def test_rpc_timeout_typed_error():
+    """A server that accepts but never replies surfaces as the typed
+    RPCTimeoutError (satellite: no more hanging forever in recv)."""
+    import socket
+    lst = socket.socket()
+    lst.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(1)
+    c = socket.create_connection(("127.0.0.1",
+                                  lst.getsockname()[1]), timeout=5)
+    c.settimeout(0.5)
+    try:
+        t0 = time.time()
+        with pytest.raises(RPCTimeoutError):
+            _rpc_call(c, _MSG_PULL, {"key": "x"})
+        assert time.time() - t0 < 5
+    finally:
+        c.close()
+        lst.close()
+
+
+def test_rpc_retry_resends_same_id_after_dropped_reply(monkeypatch):
+    """End-to-end drop drill in one process: the server computes the
+    push, netchaos drops the reply, the worker times out, reconnects,
+    resends the SAME request id, and the dedup window answers from
+    cache — the push applies exactly once."""
+    from mxnet_tpu.resilience import chaos
+    port = 9351
+    monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+    monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(port))
+    monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+    monkeypatch.setenv("MXNET_KVSTORE_RPC_TIMEOUT", "1")
+    monkeypatch.setenv("MXNET_KVSTORE_CONNECT_TIMEOUT", "20")
+    srv, t = _spawn_server(False, 1, port=port)
+    kv = mx.kv.create("dist_async")
+    try:
+        kv.set_optimizer(mx.optimizer.create(
+            "sgd", learning_rate=1.0, rescale_grad=1.0, wd=0.0))
+        kv.init("w", mx.nd.zeros((4,)))
+        chaos.configure(net_drop_reply=1)
+        try:
+            kv.push("w", mx.nd.ones((4,)) * -1)
+            assert chaos.fired("net_drop_reply") == 1
+        finally:
+            chaos.reset()
+        out = mx.nd.zeros((4,))
+        kv.pull("w", out=out)
+        np.testing.assert_allclose(out.asnumpy(), np.ones(4))
+        with srv.lock:
+            assert srv.applies == 1       # exactly once despite retry
+    finally:
+        kv.stop_server()
+        _stop_inproc_server(srv, t)
+
+
+def test_worker_reinit_after_server_restart(monkeypatch):
+    """Heartbeat epoch-token change -> the worker detects the restart
+    and re-inits the keys the new incarnation lost, so an async-mode
+    rejoin pull returns the init-time value instead of a KeyError."""
+    from mxnet_tpu.observability import metrics
+    port = 9353
+    monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+    monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(port))
+    monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+    monkeypatch.setenv("MXNET_KVSTORE_HEARTBEAT_INTERVAL", "0.1")
+    monkeypatch.setenv("MXNET_KVSTORE_RPC_TIMEOUT", "5")
+    monkeypatch.setenv("MXNET_KVSTORE_CONNECT_TIMEOUT", "30")
+    srv, t = _spawn_server(False, 1, port=port)
+    kv = None
+    srv2 = t2 = None
+    try:
+        kv = mx.kv.create("dist_async")
+        kv.init("w", mx.nd.full((4,), 3.0))
+        restarts0 = metrics.counter(
+            "kvstore_server_restarts_detected_total").value
+        _stop_inproc_server(srv, t)
+        srv2, t2 = _spawn_server(False, 1, port=port)
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            with srv2.lock:
+                if "w" in srv2.store:
+                    break
+            time.sleep(0.1)
+        with srv2.lock:
+            assert "w" in srv2.store, "worker never re-inited lost key"
+        out = mx.nd.zeros((4,))
+        kv.pull("w", out=out)
+        np.testing.assert_allclose(out.asnumpy(), np.full(4, 3.0))
+        assert metrics.counter(
+            "kvstore_server_restarts_detected_total").value > restarts0
+    finally:
+        if kv is not None:
+            kv.stop_server()
+        if srv2 is not None:
+            _stop_inproc_server(srv2, t2)
+
+
+def test_heartbeat_failures_counted_and_bounded(monkeypatch, caplog):
+    """Heartbeats to a dead server are counted (satellite 2) and WARN
+    exactly once per outage instead of spamming or staying silent."""
+    import logging as _logging
+    from mxnet_tpu.observability import metrics
+    port = 9355
+    monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+    monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(port))
+    monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+    monkeypatch.setenv("MXNET_KVSTORE_HEARTBEAT_INTERVAL", "0.05")
+    monkeypatch.setenv("MXNET_KVSTORE_RPC_TIMEOUT", "2")
+    monkeypatch.setenv("MXNET_KVSTORE_CONNECT_TIMEOUT", "10")
+    srv, t = _spawn_server(False, 1, port=port)
+    kv = mx.kv.create("dist_async")
+    try:
+        before = metrics.counter(
+            "kvstore_heartbeat_failures_total").value
+        with caplog.at_level(_logging.WARNING,
+                             logger="mxnet_tpu._kvstore_impl"):
+            _stop_inproc_server(srv, t)
+            deadline = time.time() + 10
+            while time.time() < deadline and metrics.counter(
+                    "kvstore_heartbeat_failures_total").value \
+                    < before + 3:
+                time.sleep(0.05)
+        assert metrics.counter(
+            "kvstore_heartbeat_failures_total").value >= before + 3
+        warns = [r for r in caplog.records
+                 if "heartbeat to server" in r.getMessage()
+                 and r.levelno == _logging.WARNING]
+        assert len(warns) == 1, warns     # once per outage, not per beat
+    finally:
+        kv.stop_server()
+
+
+def test_abandoned_sync_round_fails_every_contributor(monkeypatch):
+    """When a sync round is abandoned on timeout, EVERY contributor
+    whose gradient was dropped gets the typed error — not just the
+    conn thread that noticed the deadline (the others used to see the
+    key vanish from pending and return a false 'ok')."""
+    monkeypatch.setenv("MXNET_KVSTORE_SYNC_TIMEOUT", "0.8")
+    monkeypatch.setenv("MXNET_KVSTORE_EVICT_TIMEOUT", "60")
+    srv, t = _spawn_server(True, 3)     # rank 2 never pushes
+    c0, c1 = _cli(srv.port), _cli(srv.port)
+    results = {}
+
+    def push(rank, conn):
+        try:
+            _rpc_call(conn, _MSG_PUSH,
+                      {"key": "w", "req": [rank, 1, 1]},
+                      (np.ones(2, np.float32),))
+            results[rank] = "ok"
+        except SyncTimeoutError:
+            results[rank] = "timeout"
+        except Exception as e:          # surfaced in the assert below
+            results[rank] = repr(e)
+    try:
+        _rpc_call(c0, _MSG_HEARTBEAT, {"node": "worker2"})  # alive
+        ts = [threading.Thread(target=push, args=(0, c0)),
+              threading.Thread(target=push, args=(1, c1))]
+        for th in ts:
+            th.start()
+        for th in ts:
+            th.join(timeout=30)
+        assert results == {0: "timeout", 1: "timeout"}, results
+        with srv.lock:
+            assert srv.applies == 0     # nothing half-applied
+    finally:
+        c0.close()
+        c1.close()
+        _stop_inproc_server(srv, t)
