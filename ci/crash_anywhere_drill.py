@@ -1,0 +1,266 @@
+#!/usr/bin/env python
+"""Crash-anywhere drill: a supervised training job killed at ARBITRARY
+steps (plus one hang) must auto-recover and finish **bit-identical** to
+an uninterrupted run, with zero replayed or skipped batches.
+
+Proof structure (docs/resilience.md "Job-level fault tolerance"):
+
+1. **Baseline** — one unsupervised child trains N steps end-to-end and
+   records (a) sha256 of its final params + optimizer state, (b) the
+   final metric value, (c) a per-batch sequence log (step -> batch
+   content hash).
+2. **Supervised** — the same child runs under
+   ``resilience.supervisor`` with per-batch resumable checkpoints
+   (``checkpoint_every_n_batches=1`` + ``resume_from='latest'``).
+   Each incarnation is armed with a different seeded fault:
+
+   * attempts 0..K-1: ``chaos.kill_at_step=<seeded step>`` —
+     ``os._exit(137)`` at the start of that global step;
+   * attempt K: ``chaos.hang_at_step=<seeded step>`` — the loop
+     wedges, the heartbeat stalls, and the WATCHDOG must detect it
+     (dead vs hung), dump a flight record, and kill;
+   * final attempt: no faults — runs to completion.
+
+3. **Assertions** — supervisor reports exactly the expected deaths +
+   one hang; final params/opt-state/metric sha-identical to baseline;
+   the merged sequence log (later incarnations own the trajectory
+   from their resume point) covers steps 0..N-1 exactly once with the
+   baseline's batch hashes — no replay, no skip; the hang produced a
+   flight record with thread stacks and an events tail; events.jsonl
+   is well-formed with a monotone seq across every restart.
+
+Scrapeable last stdout line:
+    crash_anywhere: kills=K hangs=1 steps=N bitexact=yes ok
+"""
+
+import json
+import os
+import random
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+PY = sys.executable
+
+EPOCHS = 2
+BATCHES = 6                      # per epoch
+STEPS = EPOCHS * BATCHES
+N_KILLS = 3
+SEED = 20260803
+
+CHILD = r'''
+import hashlib, json, os, sys
+sys.path.insert(0, os.environ["CA_REPO"])
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+from mxnet_tpu.io import NDArrayIter
+from mxnet_tpu.resilience import CheckpointManager
+
+workdir = os.environ["CA_DIR"]
+epochs = int(os.environ["CA_EPOCHS"])
+batches = int(os.environ["CA_BATCHES"])
+batch_size = 16
+
+def mlp():
+    data = sym.var("data")
+    net = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.Dropout(net, p=0.5, name="drop")   # proves RNG resume
+    net = sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return sym.SoftmaxOutput(net, name="softmax")
+
+rng = np.random.RandomState(0)
+X = rng.randn(batches * batch_size, 8).astype(np.float32)
+Y = rng.randint(0, 4, batches * batch_size).astype(np.float32)
+train = NDArrayIter(X, Y, batch_size=batch_size)
+
+mx.random.seed(7)            # the framework's functional PRNG stream
+mod = mx.Module(mlp(), context=mx.cpu())
+mgr = CheckpointManager(os.path.join(workdir, "ckpt"), keep_last=3)
+
+seq_fd = os.open(os.path.join(workdir, "seqlog.jsonl"),
+                 os.O_WRONLY | os.O_CREAT | os.O_APPEND)
+attempt = int(os.environ.get("MXNET_SUPERVISOR_ATTEMPT", "-1"))
+
+def log_batch(param):
+    step = param.epoch * batches + param.nbatch
+    batch = param.locals["data_batch"]
+    h = hashlib.sha256(
+        np.ascontiguousarray(batch.data[0].asnumpy()).tobytes()
+    ).hexdigest()[:16]
+    line = json.dumps({"run": attempt, "step": step, "h": h}) + "\n"
+    os.write(seq_fd, line.encode())
+
+mod.fit(train, num_epoch=epochs, optimizer="sgd", eval_metric="acc",
+        optimizer_params={"learning_rate": 0.1},
+        checkpoint_manager=mgr, checkpoint_every_n_batches=1,
+        resume_from="latest", batch_end_callback=log_batch)
+
+# ran to completion: fingerprint the full trained state
+args, auxs = mod.get_params()
+h = hashlib.sha256()
+for name in sorted(args):
+    h.update(np.ascontiguousarray(args[name].asnumpy()).tobytes())
+for name in sorted(auxs):
+    h.update(np.ascontiguousarray(auxs[name].asnumpy()).tobytes())
+opt_h = hashlib.sha256(mod._optimizer_states_bytes() or b"").hexdigest()
+final = {"params_sha": h.hexdigest(), "opt_sha": opt_h,
+         "steps": mod._step_seq, "acc": None}
+# the epoch's metric is reported through the job state machinery; for
+# the drill fingerprint, rescore on the training set (deterministic)
+m = mx.metric.create("acc")
+train.reset()
+mod.score(train, m)
+final["acc"] = m.get()[1]
+with open(os.path.join(workdir, "final.json"), "w") as f:
+    json.dump(final, f)
+'''
+
+
+def run_child(workdir, extra_env=None):
+    env = dict(os.environ)
+    env.update({"CA_REPO": REPO, "CA_DIR": workdir,
+                "CA_EPOCHS": str(EPOCHS), "CA_BATCHES": str(BATCHES)})
+    env.update(extra_env or {})
+    return subprocess.run([PY, "-c", CHILD], env=env, cwd=workdir,
+                          capture_output=True, timeout=300)
+
+
+def merged_trajectory(seqlog_path):
+    """Replay the sequence log with resume semantics: when a new
+    incarnation appears, it owns the trajectory from its first step
+    onward (earlier incarnations' entries at >= that step were never
+    committed — checkpoints are per-batch, so there are none to drop
+    in the kill-at-step-start case, but the merge is general)."""
+    final = {}
+    last_run = None
+    with open(seqlog_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if rec["run"] != last_run:
+                last_run = rec["run"]
+                for step in [s for s in final if s >= rec["step"]]:
+                    del final[step]
+            final[rec["step"]] = rec["h"]
+    return final
+
+
+def main():
+    t0 = time.time()
+    rng = random.Random(SEED)
+    # seeded, arbitrary, distinct fault steps (never step 0: the first
+    # checkpoint must exist for a resume to be exercised... actually
+    # resume-from-nothing is also legal, but kills mid-run are the
+    # interesting case) — ascending so every armed fault actually fires
+    fault_steps = sorted(rng.sample(range(1, STEPS - 1), N_KILLS + 1))
+    kill_steps, hang_step = fault_steps[:N_KILLS], fault_steps[-1]
+    print("== crash_anywhere: %d steps, kills at %s, hang at %d =="
+          % (STEPS, kill_steps, hang_step), flush=True)
+
+    # -- 1. uninterrupted baseline ---------------------------------------
+    base_dir = tempfile.mkdtemp(prefix="ca_base_")
+    res = run_child(base_dir)
+    assert res.returncode == 0, \
+        "baseline child failed:\n%s" % res.stderr.decode()[-3000:]
+    with open(os.path.join(base_dir, "final.json")) as f:
+        baseline = json.load(f)
+    base_traj = merged_trajectory(os.path.join(base_dir, "seqlog.jsonl"))
+    assert sorted(base_traj) == list(range(STEPS)), \
+        "baseline trajectory incomplete: %s" % sorted(base_traj)
+    print("  baseline: params=%s acc=%.4f" % (baseline["params_sha"][:12],
+                                              baseline["acc"]), flush=True)
+
+    # -- 2. supervised run with seeded faults ----------------------------
+    sup_dir = tempfile.mkdtemp(prefix="ca_sup_")
+    os.environ["MXNET_OBS"] = "all"
+    os.environ["MXNET_OBS_PATH"] = os.path.join(sup_dir, "events.jsonl")
+
+    def env_for_attempt(attempt):
+        env = {"CA_REPO": REPO, "CA_DIR": sup_dir,
+               "CA_EPOCHS": str(EPOCHS), "CA_BATCHES": str(BATCHES),
+               "MXNET_OBS": "all",
+               "MXNET_OBS_PATH": os.environ["MXNET_OBS_PATH"]}
+        if attempt < len(kill_steps):
+            env["MXNET_CHAOS"] = "kill_at_step=%d" % kill_steps[attempt]
+        elif attempt == len(kill_steps):
+            env["MXNET_CHAOS"] = "hang_at_step=%d" % hang_step
+        else:
+            env["MXNET_CHAOS"] = ""
+        return env
+
+    from mxnet_tpu.resilience.supervisor import Supervisor
+    sup = Supervisor([PY, "-c", CHILD], workdir=sup_dir,
+                     timeout=4.0, max_restarts=N_KILLS + 2,
+                     env_for_attempt=env_for_attempt)
+    result = sup.run()
+    assert result.ok, "supervised job never finished: %r" % result
+    assert result.deaths == N_KILLS, \
+        "expected %d kill-deaths, saw %d" % (N_KILLS, result.deaths)
+    assert result.hangs == 1, \
+        "expected exactly one hang, saw %d" % result.hangs
+    print("  supervised: %d attempts, %d deaths, %d hang"
+          % (result.attempts, result.deaths, result.hangs), flush=True)
+
+    # -- 3a. bit-identical final state -----------------------------------
+    with open(os.path.join(sup_dir, "final.json")) as f:
+        sup_final = json.load(f)
+    assert sup_final["params_sha"] == baseline["params_sha"], \
+        "final params DIVERGED: %s vs %s" % (sup_final["params_sha"],
+                                             baseline["params_sha"])
+    assert sup_final["opt_sha"] == baseline["opt_sha"], \
+        "final optimizer state diverged"
+    assert sup_final["acc"] == baseline["acc"], \
+        "final metric diverged: %r vs %r" % (sup_final["acc"],
+                                             baseline["acc"])
+
+    # -- 3b. no batch replayed or skipped --------------------------------
+    traj = merged_trajectory(os.path.join(sup_dir, "seqlog.jsonl"))
+    missing = [s for s in range(STEPS) if s not in traj]
+    extra = [s for s in traj if not 0 <= s < STEPS]
+    assert not missing and not extra, \
+        "trajectory holes=%s extras=%s" % (missing, extra)
+    wrong = [s for s in range(STEPS) if traj[s] != base_traj[s]]
+    assert not wrong, \
+        "replayed/reordered batches at steps %s" % wrong
+
+    # -- 3c. flight record for the hang ----------------------------------
+    assert len(result.flight_records) == 1, result.flight_records
+    with open(result.flight_records[0]) as f:
+        flight = json.load(f)
+    assert flight["reason"] == "hang"
+    assert flight["stacks_path"] and \
+        os.path.getsize(flight["stacks_path"]) > 0, \
+        "flight record has no thread stacks"
+    assert flight["events_tail"], "flight record has no events tail"
+    print("  flight record: %s (stacks %d bytes)"
+          % (os.path.basename(result.flight_records[0]),
+             os.path.getsize(flight["stacks_path"])), flush=True)
+
+    # -- 3d. events.jsonl monotone seq across restarts -------------------
+    seqs, cats = [], set()
+    with open(os.environ["MXNET_OBS_PATH"]) as f:
+        for line in f:
+            rec = json.loads(line)      # raises on a torn line
+            seqs.append(rec["seq"])
+            cats.add(rec["ev"])
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs), \
+        "events.jsonl seq not strictly monotone across restarts"
+    assert {"supervisor", "watchdog"} <= cats, \
+        "missing supervisor/watchdog events: %s" % sorted(cats)
+
+    print("crash_anywhere: kills=%d hangs=1 steps=%d bitexact=yes ok"
+          % (N_KILLS, STEPS), flush=True)
+    print("  (%.1fs)" % (time.time() - t0), file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

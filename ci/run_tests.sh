@@ -50,6 +50,16 @@ echo "== resilience: network chaos drill (dist kvstore) =="
 # scrapeable summary ("netchaos: faults=.. recovered=.. ok").
 python ci/netchaos_drill.py
 
+echo "== resilience: crash-anywhere drill (supervisor + watchdog) =="
+# A supervised training job hard-killed at seeded ARBITRARY steps
+# (plus one injected hang the watchdog must catch and flight-record)
+# auto-resumes from per-batch job-state checkpoints and finishes
+# BIT-IDENTICAL to an uninterrupted run — params, optimizer state,
+# metric — with zero replayed or skipped batches (per-batch sequence
+# log), and events.jsonl keeps a monotone seq across every restart.
+# Last stdout line: "crash_anywhere: kills=.. hangs=.. ... ok".
+python ci/crash_anywhere_drill.py
+
 echo "== native: C predict ABI + RecordIO reader =="
 if command -v g++ >/dev/null; then
     make -C src/capi

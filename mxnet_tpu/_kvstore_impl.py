@@ -472,7 +472,9 @@ def _recv_frame(sock):
 
 
 def _connect_retry(host, port, deadline):
-    """Connect with retry until *deadline*, a FRESH socket per attempt.
+    """Connect with retry until *deadline* (a ``time.monotonic()``
+    instant — wall-clock deadlines die to NTP steps, graftlint JG012),
+    a FRESH socket per attempt.
 
     Reusing one socket across attempts is not portable: after a
     ``connect`` fails with ECONNREFUSED (server still importing/binding),
@@ -489,7 +491,7 @@ def _connect_retry(host, port, deadline):
             return sock
         except (ConnectionRefusedError, OSError):
             sock.close()
-            if time.time() > deadline:
+            if time.monotonic() > deadline:
                 raise
             time.sleep(0.1)
 
@@ -604,7 +606,7 @@ class KVStoreServer:
         self.barrier_done = set()  # completed rounds (pruned)
         # heartbeat-based failure detection (reference: ps-lite
         # Postoffice::GetDeadNodes, kvstore_dist.h:119-128)
-        self.heartbeats = {}       # node id -> last heartbeat walltime
+        self.heartbeats = {}       # node id -> last beat (monotonic)
         self.evicted = set()       # ranks removed from the expected set
         self.dedup = {}    # (rank, inc) -> OrderedDict(seq -> _InFlight)
         # request ids whose MUTATION is committed to the store but
@@ -1028,7 +1030,10 @@ class KVStoreServer:
         if kind == _MSG_HEARTBEAT:
             node = meta["node"]
             with self.lock:
-                self.heartbeats[node] = time.time()
+                # monotonic: heartbeat staleness is an ELAPSED-time
+                # comparison within this process — an NTP step must not
+                # spuriously evict a healthy worker (graftlint JG012)
+                self.heartbeats[node] = time.monotonic()
                 # a fresh heartbeat from an evicted rank is a rejoin:
                 # restore it to the expected-contributor set
                 rank = _node_rank(node)
@@ -1045,7 +1050,7 @@ class KVStoreServer:
             # re-init only the keys the new incarnation lost
             return {"epoch": self.epoch_token}, ()
         if kind == _MSG_DEADQUERY:
-            now = time.time()
+            now = time.monotonic()
             with self.lock:
                 dead = [n for n, ts in self.heartbeats.items()
                         if now - ts > meta["timeout"]]
@@ -1117,7 +1122,7 @@ class KVStoreServer:
         (heartbeat stale beyond the evict timeout — evicted, so the
         survivors make progress) and alive-but-slow laggards (the
         caller raises loudly, naming them)."""
-        now = time.time()
+        now = time.monotonic()
         evicted_now, laggards = [], []
         with self.lock:
             for r in sorted(missing):
@@ -1205,8 +1210,8 @@ class KVStoreServer:
                                      {req_id} if req_id else set()]
             if self._try_apply_pending(key):
                 return
-            deadline = time.time() + self.sync_timeout
-            while key in self.pending and time.time() < deadline:
+            deadline = time.monotonic() + self.sync_timeout
+            while key in self.pending and time.monotonic() < deadline:
                 self.cv.wait(timeout=0.1)
             if key not in self.pending:
                 self._raise_if_aborted(key, rank)
@@ -1269,8 +1274,9 @@ class KVStoreServer:
             self.barrier_rounds.setdefault(rnd, set()).add(rank)
             if self._try_complete_barrier(rnd):
                 return
-            deadline = time.time() + self.sync_timeout
-            while rnd not in self.barrier_done and time.time() < deadline:
+            deadline = time.monotonic() + self.sync_timeout
+            while rnd not in self.barrier_done and \
+                    time.monotonic() < deadline:
                 self.cv.wait(timeout=0.1)
             if rnd in self.barrier_done:
                 return
@@ -1358,7 +1364,7 @@ class KVStoreDist(KVStoreBase):
         to the connect deadline, then the per-call RPC timeout so a
         server dying mid-reply can never hang a worker in recv."""
         sock = _connect_retry(self._host, self._root_port + s,
-                              time.time() + self._connect_timeout)
+                              time.monotonic() + self._connect_timeout)
         if self._rpc_timeout > 0:
             sock.settimeout(self._rpc_timeout)
         return sock

@@ -129,8 +129,8 @@ register_env("MXNET_SAN", str, "",
 register_env("MXNET_OBS", str, "",
              "Structured run-event categories to record to "
              "events.jsonl: comma list of compile,guard,chaos,"
-             "checkpoint,preempt,retry,respawn,warning,kvstore, or "
-             "'all'; "
+             "checkpoint,preempt,retry,respawn,warning,kvstore,"
+             "supervisor,watchdog, or 'all'; "
              "empty = off (no file, zero per-event cost; see "
              "docs/observability.md)")
 register_env("MXNET_OBS_PATH", str, "events.jsonl",
@@ -176,6 +176,35 @@ register_env("MXNET_CHECKPOINT_KEEP_LAST", int, 0,
              "Default keep-last-K rotation for CheckpointManager "
              "(older epochs' files are deleted once unreferenced); "
              "0 = keep every checkpoint")
+register_env("MXNET_WATCHDOG_TIMEOUT", float, 300.0,
+             "Seconds the supervisor's watchdog tolerates a stalled "
+             "heartbeat (no batch-boundary tick) from a live child "
+             "before declaring it HUNG — wedged collective, "
+             "deadlocked dataloader — dumping a flight record, and "
+             "killing/restarting it; measured on the monotonic clock")
+register_env("MXNET_SUPERVISOR_RESTARTS", int, 3,
+             "Restart budget of resilience.supervisor: how many child "
+             "deaths + hang-kills are restarted (with jittered "
+             "backoff) from the latest checkpoint before the "
+             "supervisor gives up and surfaces the failure")
+register_env("MXNET_HEARTBEAT_FILE", str, "",
+             "Path of the supervised-job heartbeat file; set by the "
+             "supervisor for its child — when present, fit()-style "
+             "training loops tick it once per batch (empty = "
+             "unsupervised, zero overhead)")
+register_env("MXNET_FLIGHT_STACKS", str, "",
+             "Path where a supervised child's faulthandler dumps "
+             "all-thread stacks on SIGUSR1 (set by the supervisor; "
+             "part of the hang flight record)")
+register_env("MXNET_FLIGHT_SNAPSHOT", str, "",
+             "Path where a supervised child writes a metrics "
+             "snapshot on SIGUSR2 (best-effort: Python-level handler, "
+             "so only sleep-style hangs can honor it)")
+register_env("MXNET_OPTSTATE_MISMATCH", str, "raise",
+             "What load_optimizer_states does when the blob was "
+             "written by a different optimizer class or hyper-param "
+             "signature: 'raise' (typed StateMismatchError) or "
+             "'reinit' (warn and start from fresh optimizer state)")
 register_env("MXNET_DATALOADER_RESPAWNS", int, 2,
              "How many crashed DataLoader worker processes are "
              "respawned (with backoff, lost batches resubmitted) "

@@ -494,6 +494,26 @@ class Executor:
     def _aux_map(self):
         return {n: a._data for n, a in self.aux_dict.items()}
 
+    def rng_state(self):
+        """The executor's PRNG base key as plain ints (JSON-safe).
+
+        This is the key the fused step folds the update count into
+        in-graph (``fold_in(base_key, step)``), and the key the eager
+        paths split per call — restoring it (plus the optimizer's
+        update counts) makes dropout masks after a resume bit-identical
+        to the uninterrupted run."""
+        import numpy as _onp
+        raw = _onp.asarray(jax.device_get(self._key))
+        return {"shape": list(raw.shape),
+                "data": [int(v) for v in raw.ravel().tolist()]}
+
+    def set_rng_state(self, state):
+        import numpy as _onp
+        raw = _onp.asarray(state["data"], dtype=_onp.uint32).reshape(
+            state["shape"])
+        self._key = jax.device_put(jnp.asarray(raw),
+                                   self._ctx.jax_device)
+
     def _next_key(self):
         self._key, sub = jax.random.split(self._key)
         return sub

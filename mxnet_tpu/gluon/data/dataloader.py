@@ -393,7 +393,13 @@ class DataLoader:
                                  "batch_sampler is specified")
             if sampler is None:
                 if shuffle:
-                    sampler = RandomSampler(len(dataset))
+                    # a private, captured seed (drawn once from the
+                    # global stream, so np.random.seed reproducibility
+                    # is preserved) makes the shuffle order resumable
+                    # through state_dict() — see docs/resilience.md
+                    sampler = RandomSampler(
+                        len(dataset),
+                        seed=int(_np.random.randint(0, 2 ** 31 - 1)))
                 else:
                     sampler = SequentialSampler(len(dataset))
             elif shuffle:
@@ -412,6 +418,9 @@ class DataLoader:
         self._thread_workers = thread_workers
         self._prefetch = max(0, prefetch if prefetch is not None
                              else 2 * self._num_workers)
+        self._cursor = 0        # batches delivered this epoch
+        self._resume_skip = 0   # pending load_state fast-forward
+        self._worker_iter = None  # live _MultiWorkerIter, if any
         self._mp_ok = None
         if self._num_workers > 0 and not thread_workers:
             # probe once (not per epoch): spawn needs picklable
@@ -443,9 +452,59 @@ class DataLoader:
     def _make_batch(self, indices):
         return self._batchify_fn([self._dataset[i] for i in indices])
 
+    # -- resumable position (resilience subsystem) -------------------------
+    def state_dict(self):
+        """Mid-epoch resume position: the batch cursor (batches
+        DELIVERED to the consumer this epoch — the worker-respawn
+        machinery below this level resubmits crashed workers' batches,
+        so issued-but-unconsumed work is deliberately not counted)
+        plus the sampler's shuffle-order state."""
+        st = {"type": "DataLoader", "cursor": int(self._cursor)}
+        sd = getattr(self._batch_sampler, "state_dict", None)
+        if sd is not None:
+            st["batch_sampler"] = sd()
+        return st
+
+    def load_state(self, state):
+        """Restore a :meth:`state_dict` position: the next ``iter()``
+        regenerates the in-progress epoch (the sampler rewinds and
+        re-draws its exact permutation, rollover leftovers included)
+        and skips the already-consumed batches — index skipping only,
+        no decode work is replayed."""
+        if state.get("type") not in (None, "DataLoader"):
+            raise ValueError("not a DataLoader state: %r"
+                             % (state.get("type"),))
+        bs = state.get("batch_sampler")
+        cursor = int(state["cursor"])
+        if bs is not None and \
+                getattr(self._batch_sampler, "load_state", None):
+            self._batch_sampler.load_state(bs, in_progress=cursor > 0)
+        self._resume_skip = cursor
+
     def __iter__(self):
+        skip = self._resume_skip
+        self._resume_skip = 0
+        self._cursor = skip
+        for batch in self._iter_batches(skip):
+            self._cursor += 1
+            yield batch
+
+    def _skip_batches(self, skip):
+        """Iterator over the epoch's index lists minus the first
+        *skip* (cheap: indices only, nothing is decoded)."""
+        it = iter(self._batch_sampler)
+        for _ in range(skip):
+            try:
+                next(it)
+            except StopIteration:
+                return iter(())
+        return it
+
+    def _iter_batches(self, skip):
+        batches_src = self._skip_batches(skip) if skip else \
+            iter(self._batch_sampler)
         if self._num_workers == 0:
-            for indices in self._batch_sampler:
+            for indices in batches_src:
                 yield self._make_batch(indices)
             return
         if not self._thread_workers and self._mp_ok:
@@ -454,19 +513,23 @@ class DataLoader:
                         if self._batchify_fn is not default_batchify_fn
                         else _np_batchify)
             it = _MultiWorkerIter(
-                self._dataset, batchify, self._batch_sampler,
+                self._dataset, batchify, batches_src,
                 self._num_workers,
                 prefetch=max(self._prefetch, self._num_workers))
+            # exposed for respawn-bookkeeping introspection (tests,
+            # job-state capture coordination)
+            self._worker_iter = it
             try:
                 yield from it
             finally:
                 # early break from the consuming loop must still reap
                 # workers and unlink prefetched shm segments
                 it.shutdown()
+                self._worker_iter = None
             return
         # threaded prefetch: submit up to `prefetch` batch jobs ahead
         with ThreadPoolExecutor(max_workers=self._num_workers) as pool:
-            batches = iter(self._batch_sampler)
+            batches = batches_src
             futures = []
             try:
                 for _ in range(self._prefetch or self._num_workers * 2):

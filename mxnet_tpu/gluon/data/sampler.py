@@ -27,15 +27,41 @@ class SequentialSampler(Sampler):
 
 
 class RandomSampler(Sampler):
-    def __init__(self, length):
+    """Uniform shuffle.  With *seed*, each epoch's permutation is
+    drawn from a PRIVATE ``RandomState([seed, epoch])`` stream, which
+    makes the shuffle order resumable: ``state_dict()`` records
+    ``(seed, epochs drawn)`` and a restored sampler re-draws the
+    in-progress epoch's exact permutation.  Without a seed the legacy
+    global-``np.random`` behavior is kept (order not capturable)."""
+
+    def __init__(self, length, seed=None):
         self._length = length
+        self._seed = seed
+        self._drawn = 0         # permutations handed out so far
 
     def __iter__(self):
-        indices = _np.random.permutation(self._length)
+        if self._seed is None:
+            indices = _np.random.permutation(self._length)
+        else:
+            rs = _np.random.RandomState([self._seed, self._drawn])
+            indices = rs.permutation(self._length)
+        self._drawn += 1
         return iter(indices.tolist())
 
     def __len__(self):
         return self._length
+
+    def state_dict(self):
+        return {"seed": self._seed, "drawn": self._drawn}
+
+    def load_state(self, state, in_progress=False):
+        """Restore the stream position.  *in_progress* = the captured
+        state was taken mid-epoch: rewind one draw so the next
+        ``iter()`` regenerates that epoch's exact permutation."""
+        self._seed = state["seed"]
+        self._drawn = int(state["drawn"])
+        if in_progress and self._drawn > 0:
+            self._drawn -= 1
 
 
 class BatchSampler(Sampler):
@@ -47,9 +73,14 @@ class BatchSampler(Sampler):
         self._batch_size = batch_size
         self._last_batch = last_batch
         self._prev = []
+        self._epoch_prev = []   # leftovers the CURRENT epoch started with
 
     def __iter__(self):
         batch, self._prev = self._prev, []
+        # remember what this epoch consumed from the previous one: a
+        # mid-epoch resume must regenerate the SAME epoch stream,
+        # leftovers included (rollover semantics)
+        self._epoch_prev = list(batch)
         for i in self._sampler:
             batch.append(i)
             if len(batch) == self._batch_size:
@@ -78,3 +109,28 @@ class BatchSampler(Sampler):
                 self._batch_size
         raise ValueError("last_batch must be one of 'keep', 'discard', or "
                          "'rollover', but got %s" % self._last_batch)
+
+    def state_dict(self):
+        st = {"prev": list(self._prev),
+              "epoch_prev": list(self._epoch_prev)}
+        sd = getattr(self._sampler, "state_dict", None)
+        if sd is not None:
+            st["sampler"] = sd()
+        return st
+
+    def load_state(self, state, in_progress=False):
+        """Restore; *in_progress* = the state was captured mid-epoch,
+        so the next ``iter()`` must REGENERATE that epoch — it starts
+        from the leftovers that epoch consumed, and the inner sampler
+        rewinds to re-draw its permutation."""
+        if in_progress:
+            self._prev = list(state.get("epoch_prev") or [])
+        else:
+            self._prev = list(state.get("prev") or [])
+        inner = state.get("sampler")
+        if inner is not None:
+            try:
+                self._sampler.load_state(inner, in_progress=in_progress)
+            except TypeError:
+                # custom sampler without the flag: positional restore
+                self._sampler.load_state(inner)

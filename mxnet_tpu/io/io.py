@@ -79,7 +79,15 @@ class DataBatch:
 
 
 class DataIter:
-    """Iterator protocol (reference: io.py DataIter:178)."""
+    """Iterator protocol (reference: io.py DataIter:178).
+
+    Resumable position (resilience subsystem): ``state_dict()``
+    captures the iterator's mid-epoch cursor — including any
+    shuffle order already drawn — and ``load_state()`` restores it,
+    so a preempted job's ``TrainJobState`` resumes the data pipeline
+    at the exact next batch instead of silently replaying or
+    skipping.  The base implementation handles stateless iterators;
+    every stateful subclass in this module overrides both."""
 
     def __init__(self, batch_size=0):
         self.batch_size = batch_size
@@ -89,6 +97,21 @@ class DataIter:
 
     def reset(self):
         pass
+
+    def state_dict(self):
+        """Serializable (JSON-safe) resume position."""
+        return {"type": type(self).__name__}
+
+    def _check_state_type(self, state):
+        got = state.get("type")
+        if got is not None and got != type(self).__name__:
+            raise ValueError(
+                "data-iterator state was captured from %r but is being "
+                "restored into %r — the resumed job must rebuild the "
+                "same pipeline" % (got, type(self).__name__))
+
+    def load_state(self, state):
+        self._check_state_type(state)
 
     def next(self):
         if self.iter_next():
@@ -155,6 +178,15 @@ class NDArrayIter(DataIter):
                                 default_name=label_name)
         self.idx = _np.arange(self.data[0][1].shape[0])
         self.shuffle = shuffle
+        # permutations come from a PRIVATE seeded stream (seed drawn
+        # once from global np.random, so np.random.seed reproducibility
+        # is preserved): a mid-epoch resume restores (seed, drawn) and
+        # every LATER epoch's reset() re-draws in lockstep with the
+        # uninterrupted run — global-np.random shuffles could restore
+        # the current order but not realign the stream position
+        self._shuffle_seed = int(_np.random.randint(0, 2 ** 31 - 1)) \
+            if shuffle else None
+        self._shuffle_drawn = 0
         self.last_batch_handle = last_batch_handle
         self.num_data = self.idx.shape[0]
         assert self.num_data >= batch_size, \
@@ -174,14 +206,20 @@ class NDArrayIter(DataIter):
         return [DataDesc(k, tuple([self.batch_size] + list(v.shape[1:])),
                          v.dtype) for k, v in self.label]
 
+    def _reshuffle(self):
+        rs = _np.random.RandomState([self._shuffle_seed,
+                                     self._shuffle_drawn])
+        self._shuffle_drawn += 1
+        rs.shuffle(self.idx)
+
     def hard_reset(self):
         if self.shuffle:
-            _np.random.shuffle(self.idx)
+            self._reshuffle()
         self.cursor = -self.batch_size
 
     def reset(self):
         if self.shuffle:
-            _np.random.shuffle(self.idx)
+            self._reshuffle()
         if self.last_batch_handle == "roll_over" and \
                 self.num_data - self.batch_size < self.cursor < \
                 self.num_data:
@@ -229,6 +267,32 @@ class NDArrayIter(DataIter):
             return self.cursor + self.batch_size - self.num_data
         return 0
 
+    def state_dict(self):
+        """Cursor + the epoch's shuffle order + the private shuffle
+        stream position: restoring all three makes a mid-epoch resume
+        replay the EXACT remaining batches AND keeps every later
+        epoch's re-shuffle in lockstep with the uninterrupted run."""
+        return {"type": type(self).__name__,
+                "cursor": int(self.cursor),
+                "idx": self.idx.tolist() if self.shuffle else None,
+                "shuffle_seed": self._shuffle_seed,
+                "shuffle_drawn": self._shuffle_drawn}
+
+    def load_state(self, state):
+        self._check_state_type(state)
+        if state.get("idx") is not None:
+            idx = _np.asarray(state["idx"], dtype=self.idx.dtype)
+            if idx.shape != self.idx.shape:
+                raise ValueError(
+                    "restored shuffle order has %d indices, dataset "
+                    "has %d" % (idx.shape[0], self.idx.shape[0]))
+            self.idx = idx
+        if state.get("shuffle_seed") is not None:
+            self._shuffle_seed = int(state["shuffle_seed"])
+            self._shuffle_drawn = int(state.get("shuffle_drawn", 0))
+        self.cursor = int(state["cursor"])
+        self._cache_data = None
+
 
 class ResizeIter(DataIter):
     """Resize an iterator to a fixed number of batches
@@ -273,6 +337,16 @@ class ResizeIter(DataIter):
     def getpad(self):
         return self.current_batch.pad
 
+    def state_dict(self):
+        return {"type": type(self).__name__, "cur": int(self.cur),
+                "inner": self.data_iter.state_dict()}
+
+    def load_state(self, state):
+        self._check_state_type(state)
+        self.cur = int(state["cur"])
+        self.current_batch = None
+        self.data_iter.load_state(state["inner"])
+
 
 class PrefetchingIter(DataIter):
     """Background-thread prefetch (reference: io.py PrefetchingIter:345,
@@ -308,6 +382,13 @@ class PrefetchingIter(DataIter):
         self._thread = None
         self._peek = None
         self.current_batch = None
+        # resume bookkeeping: the inner iterator's state at epoch
+        # start + how many batches the CONSUMER has taken.  The
+        # producer thread runs AHEAD of the consumer, so the inner
+        # iterator's live cursor is useless for resume — the pair
+        # (epoch-start state, consumed count) is the exact position.
+        self._consumed = 0
+        self._epoch_state = self._inner_state()
         self._start()
 
     @property
@@ -368,7 +449,11 @@ class PrefetchingIter(DataIter):
             daemon=True)
         self._thread.start()
 
-    def reset(self):
+    def _inner_state(self):
+        sd = getattr(self.iters[0], "state_dict", None)
+        return sd() if sd is not None else None
+
+    def _stop_producer(self):
         import logging
         import time as _time
         self._stop.set()
@@ -377,7 +462,7 @@ class PrefetchingIter(DataIter):
         # unwedges it (a producer mid-put refills what we drain, hence
         # the loop rather than a single drain).  Bounded: a producer
         # wedged inside the INNER iterator's next() is abandoned — the
-        # fresh queue below detaches it either way
+        # fresh queue started next detaches it either way
         deadline = _time.monotonic() + 10.0
         while self._thread is not None and self._thread.is_alive():
             try:
@@ -388,13 +473,48 @@ class PrefetchingIter(DataIter):
             self._thread.join(timeout=0.1)
             if _time.monotonic() > deadline:
                 logging.getLogger(__name__).warning(
-                    "PrefetchingIter.reset: producer thread did not "
-                    "exit within 10s (inner iterator wedged?); "
-                    "detaching it")
+                    "PrefetchingIter: producer thread did not exit "
+                    "within 10s (inner iterator wedged?); detaching it")
                 break
+
+    def reset(self):
+        self._stop_producer()
         self.iters[0].reset()
         self._peek = None
         self.current_batch = None
+        self._consumed = 0
+        self._epoch_state = self._inner_state()
+        self._start()
+
+    def state_dict(self):
+        """Pass-through position: the inner iterator's state at epoch
+        start plus the number of batches actually DELIVERED to the
+        consumer (prefetched-but-unconsumed batches belong to the
+        resumed run, not this one)."""
+        return {"type": type(self).__name__,
+                "epoch_start": self._epoch_state,
+                "consumed": self._consumed}
+
+    def load_state(self, state):
+        self._check_state_type(state)
+        if state.get("epoch_start") is None:
+            raise ValueError(
+                "PrefetchingIter state is not resumable: the wrapped "
+                "iterator (%s) has no state_dict()"
+                % type(self.iters[0]).__name__)
+        self._stop_producer()
+        inner = self.iters[0]
+        inner.load_state(state["epoch_start"])
+        # fast-forward through the already-consumed batches on the
+        # CALLER's thread (deterministic inner iterators re-decode the
+        # skipped range; no producer races with the skipping)
+        consumed = int(state["consumed"])
+        for _ in range(consumed):
+            inner.next()
+        self._peek = None
+        self.current_batch = None
+        self._consumed = consumed
+        self._epoch_state = state["epoch_start"]
         self._start()
 
     def next(self):
@@ -410,6 +530,7 @@ class PrefetchingIter(DataIter):
             raise StopIteration
         if isinstance(item, Exception):
             raise item
+        self._consumed += 1
         self.current_batch = item
         return item
 
@@ -475,6 +596,14 @@ class MNISTIter(DataIter):
     def iter_next(self):
         return self._inner.iter_next()
 
+    def state_dict(self):
+        return {"type": type(self).__name__,
+                "inner": self._inner.state_dict()}
+
+    def load_state(self, state):
+        self._check_state_type(state)
+        self._inner.load_state(state["inner"])
+
 
 def _open_maybe_gz(path):
     if path.endswith(".gz"):
@@ -533,6 +662,14 @@ class CSVIter(DataIter):
 
     def iter_next(self):
         return self._inner.iter_next()
+
+    def state_dict(self):
+        return {"type": type(self).__name__,
+                "inner": self._inner.state_dict()}
+
+    def load_state(self, state):
+        self._check_state_type(state)
+        self._inner.load_state(state["inner"])
 
 
 class LibSVMIter(DataIter):
@@ -615,3 +752,11 @@ class LibSVMIter(DataIter):
         if self._round:
             return self._cursor < self._num
         return self._cursor + self.batch_size <= self._num
+
+    def state_dict(self):
+        return {"type": type(self).__name__,
+                "cursor": int(self._cursor)}
+
+    def load_state(self, state):
+        self._check_state_type(state)
+        self._cursor = int(state["cursor"])

@@ -94,6 +94,47 @@ class EvalMetric:
         self.num_inst = 0
         self.sum_metric = 0.0
 
+    # -- resumable accumulator state (resilience subsystem) ----------------
+    _STATE_SKIP = frozenset(["name", "output_names", "label_names",
+                             "_kwargs"])
+
+    def _is_plain(self, v, depth=0):
+        if isinstance(v, (bool, int, float, str)) or v is None:
+            return True
+        if depth >= 4:
+            return False
+        if isinstance(v, (list, tuple)):
+            return all(self._is_plain(x, depth + 1) for x in v)
+        if isinstance(v, dict):
+            return all(isinstance(k, (bool, int, float, str))
+                       and self._is_plain(x, depth + 1)
+                       for k, x in v.items())
+        return False
+
+    def state_dict(self):
+        """Every plain-data accumulator attribute (num_inst,
+        sum_metric, confusion counts, per-key tallies — anything a
+        subclass accumulates in its ``__dict__``), excluding the
+        construction config.  Generic on purpose: a subclass with a
+        new counter is resumable without opting in.  Dict keys keep
+        their types through ``TrainJobState``'s key-encoding layer."""
+        state = {}
+        for k, v in vars(self).items():
+            if k in self._STATE_SKIP:
+                continue
+            if self._is_plain(v):
+                state[k] = v
+        return {"metric": type(self).__name__, "state": state}
+
+    def load_state(self, st):
+        if st.get("metric") != type(self).__name__:
+            raise ValueError(
+                "metric state was captured from %r but is being "
+                "restored into %r" % (st.get("metric"),
+                                      type(self).__name__))
+        for k, v in st["state"].items():
+            setattr(self, k, v)
+
     def get(self):
         value = (self.sum_metric / self.num_inst if self.num_inst
                  else float("nan"))
@@ -146,6 +187,21 @@ class CompositeEvalMetric(EvalMetric):
     def reset(self):
         for metric in getattr(self, "metrics", []):
             metric.reset()
+
+    def state_dict(self):
+        st = super().state_dict()
+        st["children"] = [m.state_dict() for m in self.metrics]
+        return st
+
+    def load_state(self, st):
+        children = st.get("children") or []
+        if len(children) != len(self.metrics):
+            raise ValueError(
+                "composite metric state has %d children, metric has %d"
+                % (len(children), len(self.metrics)))
+        super().load_state(st)
+        for m, child in zip(self.metrics, children):
+            m.load_state(child)
 
     def get(self):
         names, values = [], []

@@ -22,7 +22,7 @@ from . import ndarray as nd
 from . import symbol as sym_mod
 from .base import MXNetError
 
-__all__ = ["BatchEndParam", "save_checkpoint", "load_checkpoint"]
+__all__ = ["BatchEndParam", "save_checkpoint", "load_checkpoint", "fit"]
 
 BatchEndParam = namedtuple("BatchEndParams",
                            ["epoch", "nbatch", "eval_metric", "locals"])
@@ -36,6 +36,31 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
     CheckpointManager(prefix).save_checkpoint(
         epoch, symbol=symbol, arg_params=arg_params,
         aux_params=aux_params)
+
+
+def fit(symbol, train_data, eval_data=None, num_epoch=None, ctx=None,
+        eval_metric="acc", optimizer="sgd",
+        optimizer_params=(("learning_rate", 0.01),), kvstore="local",
+        data_names=("data",), label_names=("softmax_label",),
+        logger=None, **kwargs):
+    """Legacy one-call training entry (the reference's deprecated
+    ``FeedForward.fit`` shape): build a Module over *symbol* and run
+    its full ``fit`` loop.  Delegating keeps this entry point
+    preemption-safe and job-state-resumable for free — the batch
+    boundary honors SIGTERM / ``chaos.preempt_at_batch``, ticks the
+    supervisor heartbeat, and accepts the same ``checkpoint_manager``
+    / ``resume_from`` / ``checkpoint_every_n_batches`` kwargs as
+    ``Module.fit`` (see docs/resilience.md).  Returns the trained
+    Module."""
+    from .module import Module
+    module = Module(symbol, data_names=data_names,
+                    label_names=label_names,
+                    logger=logger or logging, context=ctx)
+    module.fit(train_data, eval_data=eval_data,
+               eval_metric=eval_metric, kvstore=kvstore,
+               optimizer=optimizer, optimizer_params=optimizer_params,
+               num_epoch=num_epoch, **kwargs)
+    return module
 
 
 def _split_save_dict(save_dict, context="params file"):

@@ -148,6 +148,21 @@ class BaseModule:
             return merged[0]
         return merged
 
+    def _capture_job_state(self, epoch, nbatch, eval_metric, train_data):
+        """Assemble the resumable TrainJobState for a checkpoint taken
+        at a batch boundary (``nbatch`` = last completed batch; ``-1``
+        = epoch boundary, data/metric start fresh next epoch)."""
+        from ..resilience.jobstate import TrainJobState
+        frag = self.job_state() if hasattr(self, "job_state") else {}
+        metric_st = data_st = None
+        if nbatch >= 0:
+            sd = getattr(eval_metric, "state_dict", None)
+            metric_st = sd() if sd is not None else None
+            sd = getattr(train_data, "state_dict", None)
+            data_st = sd() if sd is not None else None
+        return TrainJobState(epoch=epoch, nbatch=nbatch, module=frag,
+                             metric=metric_st, data=data_st)
+
     def fit(self, train_data, eval_data=None, eval_metric="acc",
             epoch_end_callback=None, batch_end_callback=None,
             kvstore="local", optimizer="sgd",
@@ -157,7 +172,8 @@ class BaseModule:
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None, sparse_row_id_fn=None,
-            checkpoint_manager=None):
+            checkpoint_manager=None, resume_from=None,
+            checkpoint_every_n_batches=None):
         """Full training loop (reference: base_module.py fit:410).
 
         With a :class:`~mxnet_tpu.resilience.CheckpointManager`, each
@@ -166,12 +182,56 @@ class BaseModule:
         installed SIGTERM handler, or the chaos harness) is honored at
         the next batch boundary: the in-flight batch finishes, a
         checkpoint is committed, and fit returns cleanly — the job
-        resumes from ``checkpoint_manager.restore_latest()``."""
+        resumes from ``checkpoint_manager.restore_latest()``.
+
+        Job-level fault tolerance (see docs/resilience.md):
+
+        * every checkpoint carries a
+          :class:`~mxnet_tpu.resilience.TrainJobState` — epoch/batch
+          cursor, PRNG + update counts, guard counters, metric and
+          data-pipeline position;
+        * ``resume_from`` (a ``CheckpointRecord``, or ``"latest"`` to
+          take ``checkpoint_manager.restore_latest()``) restores ALL
+          of it and continues **mid-epoch, bit-exactly**: no batch is
+          replayed or skipped, dropout masks and metric values match
+          the uninterrupted run;
+        * ``checkpoint_every_n_batches=N`` additionally commits a
+          full resumable checkpoint every N batches, bounding the
+          work a kill at ANY step can lose;
+        * each batch boundary ticks the supervisor heartbeat
+          (``resilience.supervisor``) so a hung step is distinguishable
+          from a dead process.
+        """
         assert num_epoch is not None, "please specify number of epochs"
         from .. import initializer as init_mod
         from .. import resilience
+        from ..resilience import supervisor as _sup
         if initializer is None:
             initializer = init_mod.Uniform(0.01)
+
+        job = None
+        record = None
+        if resume_from is not None:
+            record = resume_from
+            if record in (True, "latest"):
+                assert checkpoint_manager is not None, \
+                    "resume_from='latest' needs a checkpoint_manager"
+                record = checkpoint_manager.restore_latest()
+            if record is not None:
+                _, arg_params, aux_params = record.load()
+                job = record.load_job_state()
+                if job is None:
+                    # params-only checkpoint (pre-job-state, or a raw
+                    # save_module): the record's epoch completed —
+                    # resume at the NEXT epoch, never re-train epoch 0
+                    # over the restored weights
+                    begin_epoch = max(begin_epoch, record.epoch + 1)
+                self.logger.info(
+                    "resuming from checkpoint epoch %d (%s)",
+                    record.epoch,
+                    "mid-epoch job state" if job is not None
+                    else "params only; starting at epoch %d"
+                    % begin_epoch)
 
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
@@ -180,19 +240,56 @@ class BaseModule:
             self.install_monitor(monitor)
         self.init_params(initializer=initializer, arg_params=arg_params,
                          aux_params=aux_params, allow_missing=allow_missing,
-                         force_init=force_init)
+                         force_init=force_init or record is not None)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
+        if record is not None and record.states_path is not None and \
+                self.optimizer_initialized:
+            self.load_optimizer_states(record.states_path)
 
         if validation_metric is None:
             validation_metric = eval_metric
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
 
+        resume_epoch = resume_nbatch = None
+        if job is not None:
+            if job.module and hasattr(self, "load_job_state"):
+                self.load_job_state(job.module)
+            resume_epoch, resume_nbatch = job.epoch, job.nbatch
+            begin_epoch = max(begin_epoch, resume_epoch)
+            if job.nbatch >= 0:
+                if job.metric is not None and \
+                        hasattr(eval_metric, "load_state"):
+                    eval_metric.load_state(job.metric)
+                if job.data is not None and \
+                        hasattr(train_data, "load_state"):
+                    train_data.load_state(job.data)
+                else:
+                    self.logger.warning(
+                        "resume: the data-pipeline position cannot be "
+                        "restored (%s) — the resumed epoch restarts "
+                        "its iterator and batches may be replayed",
+                        "checkpoint carries no iterator state"
+                        if job.data is None else
+                        "%s has no load_state"
+                        % type(train_data).__name__)
+            elif resume_epoch > 0:
+                # epoch-boundary resume: the iterator starts the next
+                # epoch fresh (mirrors the end-of-epoch reset below)
+                train_data.reset()
+
         for epoch in range(begin_epoch, num_epoch):
+            resumed_mid_epoch = (job is not None and
+                                 epoch == resume_epoch and
+                                 resume_nbatch is not None and
+                                 resume_nbatch >= 0)
             epoch_start = time.perf_counter()
-            eval_metric.reset()
-            for nbatch, data_batch in enumerate(train_data):
+            if not resumed_mid_epoch:
+                eval_metric.reset()
+            nbatch_offset = resume_nbatch + 1 if resumed_mid_epoch else 0
+            for nbatch, data_batch in enumerate(train_data,
+                                                start=nbatch_offset):
                 if monitor is not None:
                     monitor.tic()
                 self.forward_backward_update(data_batch)
@@ -202,6 +299,14 @@ class BaseModule:
                 self._fire(batch_end_callback, BatchEndParam(
                     epoch=epoch, nbatch=nbatch,
                     eval_metric=eval_metric, locals=locals()))
+                _sup.heartbeat()
+                if checkpoint_every_n_batches and \
+                        checkpoint_manager is not None and \
+                        (nbatch + 1) % checkpoint_every_n_batches == 0:
+                    checkpoint_manager.save_module(
+                        self, epoch,
+                        job_state=self._capture_job_state(
+                            epoch, nbatch, eval_metric, train_data))
                 if resilience.preemption_requested(tick=True):
                     # finish-the-batch semantics: the step and its
                     # callbacks completed; checkpoint and exit cleanly
@@ -214,7 +319,10 @@ class BaseModule:
                         "epoch %d batch %d and exiting fit", epoch,
                         nbatch)
                     if checkpoint_manager is not None:
-                        checkpoint_manager.save_module(self, epoch)
+                        checkpoint_manager.save_module(
+                            self, epoch,
+                            job_state=self._capture_job_state(
+                                epoch, nbatch, eval_metric, train_data))
                         checkpoint_manager.wait()
                     # consume the request: a later fit() in this
                     # process (in-process resume) must actually train
@@ -232,7 +340,10 @@ class BaseModule:
             for cb in _as_list(epoch_end_callback):
                 cb(epoch, self.symbol, *snapshot)
             if checkpoint_manager is not None:
-                checkpoint_manager.save_module(self, epoch)
+                checkpoint_manager.save_module(
+                    self, epoch,
+                    job_state=self._capture_job_state(
+                        epoch + 1, -1, eval_metric, train_data))
 
             if eval_data is not None:
                 res = self.score(eval_data, validation_metric,

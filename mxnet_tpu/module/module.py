@@ -291,14 +291,107 @@ class Module(BaseModule):
 
     def load_optimizer_states(self, fname):
         """Load optimizer state saved by :meth:`save_optimizer_states`;
-        the fused path re-imports it on its next step."""
+        the fused path re-imports it on its next step.
+
+        The blob is validated against the CURRENT optimizer (class +
+        baked hyper-param signature) before it is applied: a stale or
+        foreign file raises a typed
+        :class:`~mxnet_tpu.resilience.StateMismatchError` instead of
+        silently training with the wrong momenta after a resume
+        (``MXNET_OPTSTATE_MISMATCH=reinit`` downgrades to
+        warn-and-reinit)."""
         assert self.optimizer_initialized
         if self._updater is not None:
             with open(fname, "rb") as f:
-                self._updater.set_states(f.read())
-            self._fused_state = None
+                blob = f.read()
+            if self._apply_updater_states(blob):
+                self._fused_state = None
         elif self._kvstore is not None and self._update_on_kvstore:
             self._kvstore.load_optimizer_states(fname)
+
+    def _apply_updater_states(self, blob):
+        """Validate + apply an optimizer-state blob to the local
+        Updater; False = mismatched and re-initialized instead."""
+        import pickle
+        try:
+            # parse ONCE: validation reads the header, set_states the
+            # payload — a large model's momenta must not deserialize
+            # twice per resume
+            blob = pickle.loads(blob)
+        except Exception as exc:
+            # keep the raw bytes: states_mismatch re-attempts the load
+            # and reports the blob as unreadable with its own reason
+            self.logger.debug("optimizer-state blob pre-parse failed "
+                              "(%s: %s); deferring to validation",
+                              type(exc).__name__, exc)
+        reason = opt.states_mismatch(blob, self._optimizer)
+        if reason:
+            from ..config import get_env
+            from ..resilience import StateMismatchError
+            if get_env("MXNET_OPTSTATE_MISMATCH").lower() == "reinit":
+                self.logger.warning(
+                    "optimizer state blob does not match the current "
+                    "optimizer (%s); re-initializing optimizer state "
+                    "fresh (MXNET_OPTSTATE_MISMATCH=reinit)", reason)
+                self._updater.states.clear()
+                self._updater.states_synced.clear()
+                self._fused_state = None
+                return False
+            raise StateMismatchError(
+                "refusing to load optimizer state: %s (set "
+                "MXNET_OPTSTATE_MISMATCH=reinit to warn and start "
+                "from fresh state instead)" % reason)
+        self._updater.set_states(blob)
+        return True
+
+    # -- job state (mid-epoch bit-exact resume) ----------------------------
+    def job_state(self):
+        """The module's resumable non-parameter fragment for
+        :class:`~mxnet_tpu.resilience.TrainJobState`:
+
+        * ``step_seq`` — the global forward_backward_update count
+          (chaos step indexing, guard event stamps);
+        * guard counters (``guard_skipped`` / ``guard_consec``) so a
+          restart does not forget how close the job was to its
+          divergence limit;
+        * the executor's PRNG base key, and
+        * the optimizer's per-index update counts — the fused step's
+          in-graph ``fold_in(key, step)`` makes RNG resume exact
+          precisely iff BOTH of those are restored (``.states`` blobs
+          carry momenta, not counts)."""
+        assert self.binded
+        frag = {"step_seq": self._step_seq,
+                "guard_skipped": self._guard_skipped,
+                "guard_consec": self._guard_consec,
+                "rng": self._exec_group.execs[0].rng_state()}
+        if self._optimizer is not None:
+            frag["opt_counts"] = dict(self._optimizer._index_update_count)
+            frag["num_update"] = int(self._optimizer.num_update)
+            frag["begin_num_update"] = \
+                int(self._optimizer.begin_num_update)
+        return frag
+
+    def load_job_state(self, frag):
+        """Restore a :meth:`job_state` fragment (after bind +
+        init_optimizer; pairs with ``load_optimizer_states``)."""
+        assert self.binded
+        self._step_seq = int(frag.get("step_seq", 0))
+        self._guard_skipped = int(frag.get("guard_skipped", 0))
+        self._guard_consec = int(frag.get("guard_consec", 0))
+        rng = frag.get("rng")
+        if rng is not None:
+            # every exec starts from the same constructed key, so the
+            # restored key rebinds them all identically
+            for ex in self._exec_group.execs:
+                ex.set_rng_state(rng)
+        if self._optimizer is not None and "opt_counts" in frag:
+            self._optimizer._index_update_count = {
+                int(k): int(v) for k, v in frag["opt_counts"].items()}
+            self._optimizer.num_update = int(
+                frag.get("num_update", self._optimizer.num_update))
+            self._optimizer.begin_num_update = int(
+                frag.get("begin_num_update",
+                         self._optimizer.begin_num_update))
 
     # -- properties --------------------------------------------------------
     @property
@@ -517,7 +610,7 @@ class Module(BaseModule):
         if getattr(self, "_preload_opt_states", None):
             if self._updater is not None:
                 with open(self._preload_opt_states, "rb") as f:
-                    self._updater.set_states(f.read())
+                    self._apply_updater_states(f.read())
             else:
                 # updater lives in the kvstore (update_on_kvstore);
                 # reference routes this through
@@ -773,6 +866,9 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized and \
             self.optimizer_initialized
         from ..resilience import chaos
+        # crash-anywhere drill hooks: kill_at_step / hang_at_step fire
+        # at the START of the (resumable) global step
+        chaos.on_train_step(self._step_seq)
         data_batch = chaos.maybe_poison_batch(data_batch, self._step_seq)
         self._step_seq += 1
         guard = self._guard_cfg()

@@ -44,10 +44,11 @@ from .. import sanitizer as _san
 from . import metrics as _metrics
 
 __all__ = ["enabled", "emit", "emitter", "watch_jit", "configure",
-           "path", "read_events"]
+           "reopen", "path", "read_events", "tail_records"]
 
 _CATEGORIES = ("compile", "guard", "chaos", "checkpoint", "preempt",
-               "retry", "respawn", "warning", "kvstore")
+               "retry", "respawn", "warning", "kvstore", "supervisor",
+               "watchdog")
 
 
 def _spec():
@@ -94,6 +95,23 @@ class _Writer:
         if created:
             from ..resilience.checkpoint import fsync_dir
             fsync_dir(dirname)
+        else:
+            # resuming an existing log (a supervisor-restarted job, or
+            # the parent writing between child incarnations): continue
+            # from the last recorded seq so the combined file stays
+            # monotone across the restart boundary — restart points are
+            # still attributable via the per-line pid.  _open only runs
+            # from write() with self._lock held (same as _fd above).
+            self._seq = max(  # graftlint: disable=JG010
+                self._seq, _last_seq(self._path))
+
+    def reset_fd(self):
+        """Close the fd and forget the cached seq: the next write
+        re-opens and re-reads the tail (multi-process seq handoff)."""
+        with self._lock:
+            if self._fd is not None:
+                os.close(self._fd)
+                self._fd = None
 
     def write(self, category, fields):
         now = time.time()
@@ -134,6 +152,42 @@ class _Writer:
             if self._fd is not None:
                 os.close(self._fd)
                 self._fd = None
+
+
+def tail_records(path, max_bytes=1 << 16):
+    """Parsed JSON records from the last *max_bytes* of an events
+    file, oldest first.  The first line of a mid-file seek is usually
+    torn — unparseable lines are skipped, an unreadable file yields
+    [].  Shared by the writer's seq handoff and the supervisor's
+    flight-record tail."""
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - max_bytes))
+            lines = f.read().decode("utf-8", "replace").splitlines()
+    except OSError:
+        return []
+    out = []
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            out.append(json.loads(line))
+        except ValueError:
+            continue
+    return out
+
+
+def _last_seq(path):
+    """The last record's ``seq`` in an existing events file; 0 when
+    unreadable or seq-less."""
+    for rec in reversed(tail_records(path)):
+        seq = rec.get("seq") if isinstance(rec, dict) else None
+        if isinstance(seq, int):
+            return seq
+    return 0
 
 
 def _json_fallback(obj):
@@ -180,6 +234,16 @@ def configure(path=None, rate=None):
             os.environ["MXNET_OBS_PATH"] = path
         if rate is not None:
             os.environ["MXNET_OBS_RATE"] = str(rate)
+
+
+def reopen():
+    """Force the writer to re-open (and re-read the tail seq) on its
+    next emit.  The supervisor calls this between child incarnations:
+    parent and children share one ``events.jsonl``, and a cached seq
+    from before a child's lifetime would break the monotone-seq
+    contract the file otherwise keeps."""
+    if _writer is not None:
+        _writer.reset_fd()
 
 
 def emit(category, **fields):

@@ -28,7 +28,7 @@ _reg = _registry("optimizer")
 __all__ = ["Optimizer", "SGD", "Signum", "SignSGD", "FTML", "LBSGD",
            "DCASGD", "NAG", "SGLD", "Adam", "AdaGrad", "RMSProp",
            "AdaDelta", "Ftrl", "Adamax", "Nadam", "Test", "Updater",
-           "create", "register", "get_updater"]
+           "create", "register", "get_updater", "states_mismatch"]
 
 
 def register(klass):
@@ -625,15 +625,32 @@ class Updater:
                 return ("tuple", [to_np(x) for x in s])
             return ("raw", s)
         payload = {k: to_np(v) for k, v in self.states.items()}
+        # format 2: the payload travels with the writing optimizer's
+        # identity (class + baked hyper-param signature) so a resumed
+        # job can detect stale/foreign state instead of silently
+        # applying it — see states_mismatch().  The marker key cannot
+        # collide with the legacy payload's int indices.
+        blob = {"__format__": 2, "states": payload,
+                "opt_class": type(self.optimizer).__name__,
+                "hyper_sig": _hyper_sig_list(self.optimizer)}
         if dump_optimizer:
-            return pickle.dumps((payload, self.optimizer))
-        return pickle.dumps(payload)
+            blob["optimizer"] = self.optimizer
+        return pickle.dumps(blob)
 
     def set_states(self, states):
-        data = pickle.loads(states)
-        if isinstance(data, tuple):
+        # accepts the raw bytes, or an already-unpickled blob — a
+        # validated load (states_mismatch) must not deserialize the
+        # full momenta payload twice
+        data = pickle.loads(states) \
+            if isinstance(states, (bytes, bytearray, memoryview)) \
+            else states
+        if isinstance(data, dict) and data.get("__format__") == 2:
+            payload = data["states"]
+            if "optimizer" in data:
+                self.optimizer = data["optimizer"]
+        elif isinstance(data, tuple):        # legacy (payload, optimizer)
             payload, self.optimizer = data
-        else:
+        else:                                 # legacy bare payload
             payload = data
 
         def from_np(s):
@@ -649,3 +666,50 @@ class Updater:
 
 def get_updater(optimizer):
     return Updater(optimizer)
+
+
+def _hyper_sig_list(optimizer):
+    """tree_opt.hyper_sig as a list (late import: tree_opt pulls
+    jax.numpy, this module must stay importable in jax-light
+    processes like kvstore servers mid-bootstrap)."""
+    from .tree_opt import hyper_sig
+    return list(hyper_sig(optimizer))
+
+
+def states_mismatch(blob, optimizer):
+    """'' when *blob* (``Updater.get_states`` bytes, or the
+    already-unpickled object) belongs to *optimizer*; otherwise a
+    human-readable reason.
+
+    Format-2 blobs carry the writing optimizer's class name and baked
+    hyper-param signature (``tree_opt._HYPER_ATTRS``: rescale_grad,
+    momentum, betas, ...).  Restoring momentum buffers into an Adam,
+    or state written under a different rescale_grad, silently trains
+    wrong after a resume — the caller turns a non-empty reason into a
+    typed :class:`~mxnet_tpu.resilience.StateMismatchError` (or
+    warn-and-reinit under ``MXNET_OPTSTATE_MISMATCH=reinit``).
+    Legacy header-less blobs validate vacuously ('' — nothing to
+    check against)."""
+    try:
+        data = pickle.loads(blob) \
+            if isinstance(blob, (bytes, bytearray, memoryview)) \
+            else blob
+    except Exception as exc:
+        return "unreadable optimizer-state blob (%s: %s)" % (
+            type(exc).__name__, exc)
+    if not (isinstance(data, dict) and data.get("__format__") == 2):
+        return ""
+    want_cls = type(optimizer).__name__
+    got_cls = data.get("opt_class")
+    if got_cls != want_cls:
+        return ("blob was written by optimizer class %r, current "
+                "optimizer is %r" % (got_cls, want_cls))
+    cur = _hyper_sig_list(optimizer)
+    saved = data.get("hyper_sig")
+    if saved is not None and list(saved) != cur:
+        from .tree_opt import _HYPER_ATTRS
+        diffs = [a for a, s, c in zip(_HYPER_ATTRS, saved, cur)
+                 if s != c]
+        return ("hyper-param signature changed since the blob was "
+                "written: %s" % ", ".join(diffs or ["<layout>"]))
+    return ""

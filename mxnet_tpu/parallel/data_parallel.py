@@ -590,12 +590,56 @@ class ParallelTrainer:
             y = y._data
         return self._put(y, P("dp"))
 
+    def fit(self, train_data, num_epoch=1, checkpoint_prefix=None,
+            batch_end_callback=None, logger=None):
+        """Epoch/batch loop over a ``DataIter`` — the trainer-level
+        peer of ``Module.fit``, with the SAME batch-boundary
+        resilience contract: a preemption request (SIGTERM flag,
+        ``chaos.preempt_at_batch``) finishes the in-flight batch,
+        writes a full-state checkpoint (params + optimizer state +
+        aux + update counter, when *checkpoint_prefix* is given) and
+        returns cleanly; every batch ticks the supervisor heartbeat.
+        Returns the last batch's loss per epoch."""
+        import logging as _logging
+        from .. import resilience
+        from ..resilience import supervisor as _sup
+        log = logger or _logging.getLogger(__name__)
+        losses = []
+        for epoch in range(num_epoch):
+            loss = None
+            for nbatch, batch in enumerate(train_data):
+                loss = self.fit_batch(batch.data[0], batch.label[0])
+                if batch_end_callback is not None:
+                    batch_end_callback(epoch, nbatch, loss)
+                _sup.heartbeat()
+                if resilience.preemption_requested(tick=True):
+                    from ..observability import events as _obs_events
+                    _obs_events.emit(
+                        "preempt", epoch=epoch, batch=nbatch,
+                        trainer="ParallelTrainer",
+                        checkpointing=checkpoint_prefix is not None)
+                    log.warning(
+                        "preemption requested: checkpointing after "
+                        "epoch %d batch %d and exiting ParallelTrainer"
+                        ".fit", epoch, nbatch)
+                    if checkpoint_prefix is not None:
+                        self.save_checkpoint(checkpoint_prefix, epoch)
+                    resilience.clear_preemption()
+                    return losses
+            losses.append(loss)
+            if checkpoint_prefix is not None:
+                self.save_checkpoint(checkpoint_prefix, epoch)
+            train_data.reset()
+        return losses
+
     def fit_batch(self, x, y):
         """Run one training step; returns the (replicated) mean loss."""
         if isinstance(x, NDArray):
             x = x._data
         if isinstance(y, NDArray):
             y = y._data
+        from ..resilience import chaos
+        chaos.on_train_step(self._num_update)
         self._ensure_built(x, y)
         self._refresh_frozen(x.shape, y.shape)
         xd = self._device_batch(x)

@@ -16,6 +16,13 @@ ROADMAP's production stance needs on preemptible hardware:
 * :mod:`~mxnet_tpu.resilience.netchaos` — the network-layer injection
   points (drop / delay / duplicate / torn-frame / partition /
   server-kill) the distributed KVStore's socket choke points consult;
+* :mod:`~mxnet_tpu.resilience.jobstate` — :class:`TrainJobState`, the
+  mid-epoch-resume snapshot (epoch/batch cursor, RNG + step counters,
+  metric + data-pipeline state) checkpoints carry next to params;
+* :mod:`~mxnet_tpu.resilience.supervisor` — heartbeat + hang
+  watchdog + flight records + bounded auto-restart: run the training
+  loop as a supervised child and a kill or hang at ANY step resumes
+  from the latest checkpoint (see docs/resilience.md);
 * the in-graph non-finite guard lives device-side (see
   ``optimizer/tree_opt.py`` and ``Executor.init_fused_step``); this
   package supplies its host-side :class:`DivergenceError`;
@@ -34,13 +41,15 @@ import threading
 from ..base import MXNetError
 from . import chaos  # noqa: F401
 from . import netchaos  # noqa: F401
+from . import supervisor  # noqa: F401
 from .checkpoint import (CheckpointManager, CheckpointRecord,  # noqa: F401
                          atomic_write)
+from .jobstate import TrainJobState  # noqa: F401
 from .retry import retry, retry_call  # noqa: F401
 
 __all__ = ["CheckpointManager", "CheckpointRecord", "atomic_write",
-           "retry", "retry_call", "chaos", "netchaos",
-           "DivergenceError",
+           "retry", "retry_call", "chaos", "netchaos", "supervisor",
+           "TrainJobState", "DivergenceError", "StateMismatchError",
            "request_preemption", "clear_preemption",
            "preemption_requested", "install_preemption_handler"]
 
@@ -49,6 +58,15 @@ class DivergenceError(MXNetError):
     """Raised when the non-finite guard saw N consecutive bad steps
     and the configured divergence action is 'raise' (or a rollback
     found no intact checkpoint)."""
+
+
+class StateMismatchError(MXNetError):
+    """Raised when a restored optimizer-state blob was written by a
+    different optimizer class or with different baked hyper-params
+    than the one about to consume it — silently applying the stale
+    state after a resume is exactly the bug this turns loud.  Set
+    ``MXNET_OPTSTATE_MISMATCH=reinit`` to warn and re-initialize
+    instead."""
 
 
 _preempt_flag = threading.Event()

@@ -39,6 +39,20 @@ Spec keys (all integers):
 ``preempt_at_batch=N``
     ``preemption_requested()`` turns true once the fit loop has
     ticked N batch boundaries.
+``kill_at_step=K``
+    The process hard-exits (``os._exit(137)``, same code as SIGKILL)
+    at the START of global training step K (0-based, the module's
+    resumable ``step_seq``).  NOTE: a job killed at K resumes AT K —
+    the same static spec re-kills every incarnation, so supervised
+    drills must arm a different spec per attempt (the supervisor's
+    ``env_for_attempt`` hook exists for exactly this; see
+    ci/crash_anywhere_drill.py).
+``hang_at_step=K``
+    The training step wedges in an interruptible sleep loop at global
+    step K — a stand-in for a wedged collective or deadlocked
+    dataloader.  The heartbeat stops ticking and the supervisor's
+    watchdog must detect it (``MXNET_WATCHDOG_TIMEOUT``), dump a
+    flight record, and kill/restart.
 
 Network-layer keys (``net_*``) ride the same spec and are consulted
 by the distributed KVStore's socket choke points — see
@@ -49,6 +63,7 @@ by the distributed KVStore's socket choke points — see
 from __future__ import annotations
 
 import logging
+import os
 import threading
 
 from .. import sanitizer as _san
@@ -56,7 +71,7 @@ from .. import sanitizer as _san
 __all__ = ["SimulatedCrash", "configure", "reset", "active", "enabled",
            "consume", "fired", "on_file_write", "on_pre_replace",
            "on_commit", "on_post_replace", "maybe_poison_batch", "tick",
-           "counter", "preemption_requested"]
+           "counter", "preemption_requested", "on_train_step"]
 
 log = logging.getLogger(__name__)
 
@@ -231,6 +246,53 @@ def maybe_poison_batch(batch, step):
     poisoned = copy.copy(batch)
     poisoned.data = [d * float("nan") for d in batch.data]
     return poisoned
+
+
+def _note_step_injection(key, step):
+    """Account a step-indexed injection (``*_at_step`` keys compare
+    against the step index, so the budgeted ``_consume`` accounting
+    does not apply)."""
+    with _lock:
+        _used[key] = _used.get(key, 0) + 1
+    from ..observability import events as _obs_events
+    from ..observability import metrics as _metrics
+    _metrics.counter("chaos_injections_total",
+                     "chaos faults actually fired").inc()
+    _obs_events.emit("chaos", injection=key, step=step)
+
+
+# patchable seam (tests assert the kill without dying; mirrors
+# netchaos._exit)
+_exit = os._exit
+_hang_sleep = None      # tests swap in a raising sleep to bound the hang
+
+
+def on_train_step(step):
+    """``kill_at_step=K`` / ``hang_at_step=K``: consulted by every
+    training entry point at the START of global (resumable, 0-based)
+    step *step*.  A kill is a hard ``os._exit(137)`` — no Python
+    unwinding, exactly like SIGKILL; a hang is an interruptible sleep
+    loop the watchdog must catch.  The resumable step index means a
+    spec can target steps a previous incarnation never reached, but a
+    job killed at K resumes AT K — re-arm a different spec per
+    incarnation (supervisor ``env_for_attempt``) or the same fault
+    re-fires."""
+    spec = active()
+    k = spec.get("kill_at_step")
+    if k is not None and step == k:
+        _note_step_injection("kill_at_step", step)
+        log.warning("chaos: hard-killing the process at train step %d",
+                    step)
+        _exit(137)
+    h = spec.get("hang_at_step")
+    if h is not None and step == h:
+        _note_step_injection("hang_at_step", step)
+        log.warning("chaos: hanging the training loop at step %d "
+                    "(watchdog bait)", step)
+        import time as _time
+        sleep = _hang_sleep or _time.sleep
+        while True:
+            sleep(0.25)
 
 
 def preemption_requested():

@@ -13,6 +13,7 @@ Layout::
     <prefix>-NNNN-symbol.json     graph, per epoch (manifest-tracked)
     <prefix>-NNNN.params          tensors  (``arg:<n>`` / ``aux:<n>``)
     <prefix>-NNNN.states          optimizer state (legacy Updater bytes)
+    <prefix>-NNNN.jobstate.json   TrainJobState (mid-epoch resume)
     <prefix>.manifest.json        commit ledger (written last)
     <prefix>-symbol.json          convenience copy at the reference's
                                   legacy name (NOT manifest-tracked)
@@ -194,6 +195,21 @@ class CheckpointRecord:
     def states_path(self):
         return self._path_with_suffix(".states")
 
+    @property
+    def jobstate_path(self):
+        return self._path_with_suffix(".jobstate.json")
+
+    def load_job_state(self):
+        """The :class:`~mxnet_tpu.resilience.jobstate.TrainJobState`
+        stored with this checkpoint, or None for a params-only entry
+        (pre-job-state checkpoints resume at the epoch boundary)."""
+        path = self.jobstate_path
+        if path is None:
+            return None
+        from .jobstate import TrainJobState
+        with open(path, "rb") as f:
+            return TrainJobState.from_bytes(f.read())
+
     def load(self):
         """Deserialize to ``(symbol_or_None, arg_params, aux_params)``
         — same split as ``model.load_checkpoint``."""
@@ -279,14 +295,22 @@ class CheckpointManager:
     # -- saving ------------------------------------------------------------
     def save_checkpoint(self, epoch, symbol=None, arg_params=None,
                         aux_params=None, optimizer_states=None,
-                        background=None):
+                        background=None, job_state=None):
         """Persist one checkpoint.  Serialization happens before this
         returns (the caller may keep training and mutating parameters);
         with *background*, the disk writes + manifest commit run on a
-        daemon thread."""
+        daemon thread.  *job_state* (a
+        :class:`~mxnet_tpu.resilience.jobstate.TrainJobState` or raw
+        bytes) rides along as one more manifest-tracked file, so a
+        mid-epoch resume is covered by the same checksum commit as the
+        params it belongs to."""
         self._raise_pending()
         from ..ndarray import utils as nd_utils
         files = {}
+        if job_state is not None:
+            data = job_state.to_bytes() \
+                if hasattr(job_state, "to_bytes") else bytes(job_state)
+            files["%s-%04d.jobstate.json" % (self.basename, epoch)] = data
         if symbol is not None:
             # per-epoch symbol file: every manifest entry stays
             # self-contained (see module docstring)
@@ -318,9 +342,10 @@ class CheckpointManager:
         return entry
 
     def save_module(self, module, epoch, save_optimizer_states=True,
-                    background=None):
+                    background=None, job_state=None):
         """Checkpoint a bound Module (params + aux + optimizer state
-        when available) through this manager."""
+        when available, plus an optional ``TrainJobState``) through
+        this manager."""
         arg_params, aux_params = module.get_params()
         states = None
         if save_optimizer_states and \
@@ -331,7 +356,8 @@ class CheckpointManager:
         return self.save_checkpoint(
             epoch, symbol=getattr(module, "symbol", None),
             arg_params=arg_params, aux_params=aux_params,
-            optimizer_states=states, background=background)
+            optimizer_states=states, background=background,
+            job_state=job_state)
 
     def _write_and_commit_guarded(self, files, entry):
         try:

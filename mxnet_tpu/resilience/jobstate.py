@@ -1,0 +1,112 @@
+"""TrainJobState — everything a killed training job needs beyond
+params/optimizer state to resume *bit-exactly* mid-epoch.
+
+A checkpoint of params + optimizer state alone resumes to the right
+weights but the wrong JOB: the data iterator restarts at batch 0
+(batches silently replayed), the PRNG key replays old dropout masks,
+the metric forgets the epoch so far, and the guard counters reset.
+``TrainJobState`` captures the rest — epoch, batch index, the
+module's resumable RNG/step/guard fragment, the ``EvalMetric``
+accumulator, and the data pipeline position (``DataIter.state_dict``
+/ ``gluon.data.DataLoader.state_dict``) — and rides through
+:class:`~mxnet_tpu.resilience.checkpoint.CheckpointManager` as one
+more manifest-tracked (checksummed) file next to the ``.params`` /
+``.states`` pair.
+
+Serialization is JSON with an explicit key-encoding layer: every dict
+is stored as a ``{"__jmap__": [[json(key), value], ...]}`` wrapper,
+so int-keyed tables (optimizer per-index update counts, per-index
+metric tallies) round-trip with their key TYPES intact — plain JSON
+would silently stringify them and the resumed optimizer would start
+fresh counts beside orphaned ``"0"``/``"1"`` entries.
+
+Import-light on purpose (no jax): the jax-touching capture/restore
+code lives in ``Module.job_state()`` / ``Executor.rng_state()``.
+"""
+
+from __future__ import annotations
+
+import json
+
+__all__ = ["TrainJobState", "encode_keyed", "decode_keyed"]
+
+_WRAP = "__jmap__"
+
+
+def encode_keyed(obj):
+    """Recursively wrap dicts so non-string keys survive JSON."""
+    if isinstance(obj, dict):
+        return {_WRAP: [[json.dumps(k), encode_keyed(v)]
+                        for k, v in obj.items()]}
+    if isinstance(obj, (list, tuple)):
+        return [encode_keyed(v) for v in obj]
+    return obj
+
+
+def decode_keyed(obj):
+    if isinstance(obj, dict):
+        if set(obj) == {_WRAP}:
+            return {json.loads(k): decode_keyed(v) for k, v in obj[_WRAP]}
+        # foreign plain dict (hand-written state): keys stay as-is
+        return {k: decode_keyed(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [decode_keyed(v) for v in obj]
+    return obj
+
+
+class TrainJobState:
+    """One resumable snapshot of a training job at a batch boundary.
+
+    ``epoch``/``nbatch`` locate the boundary: ``nbatch`` is the LAST
+    COMPLETED batch of ``epoch`` (``-1`` = the state was captured at
+    an epoch boundary and ``epoch`` is the next epoch to run).
+    ``module`` is ``Module.job_state()``'s fragment (step_seq, guard
+    counters, RNG key, optimizer update counts); ``metric`` is
+    ``EvalMetric.state_dict()``; ``data`` is the iterator's
+    ``state_dict()`` (None = position not capturable — resume replays
+    the epoch's earlier batches into the void, which is loud in the
+    drill's sequence log, not silent)."""
+
+    VERSION = 1
+
+    __slots__ = ("epoch", "nbatch", "module", "metric", "data", "extra")
+
+    def __init__(self, epoch, nbatch, module=None, metric=None,
+                 data=None, extra=None):
+        self.epoch = int(epoch)
+        self.nbatch = int(nbatch)
+        self.module = module or {}
+        self.metric = metric
+        self.data = data
+        self.extra = extra or {}
+
+    def to_bytes(self):
+        payload = {"version": self.VERSION,
+                   "epoch": self.epoch,
+                   "nbatch": self.nbatch,
+                   "module": encode_keyed(self.module),
+                   "metric": encode_keyed(self.metric),
+                   "data": encode_keyed(self.data),
+                   "extra": encode_keyed(self.extra)}
+        return (json.dumps(payload, sort_keys=True) + "\n").encode("utf-8")
+
+    @classmethod
+    def from_bytes(cls, data):
+        payload = json.loads(bytes(data).decode("utf-8"))
+        version = payload.get("version")
+        if version != cls.VERSION:
+            raise ValueError(
+                "TrainJobState version %r is not supported (this build "
+                "reads version %d)" % (version, cls.VERSION))
+        return cls(epoch=payload["epoch"], nbatch=payload["nbatch"],
+                   module=decode_keyed(payload.get("module")) or {},
+                   metric=decode_keyed(payload.get("metric")),
+                   data=decode_keyed(payload.get("data")),
+                   extra=decode_keyed(payload.get("extra")) or {})
+
+    def __repr__(self):
+        return ("TrainJobState(epoch=%d, nbatch=%d, module_keys=%s, "
+                "metric=%s, data=%s)"
+                % (self.epoch, self.nbatch, sorted(self.module),
+                   "yes" if self.metric is not None else "no",
+                   "yes" if self.data is not None else "no"))

@@ -1,0 +1,421 @@
+"""Job supervisor: heartbeat, hang watchdog, flight records, and
+bounded auto-restart — the process-level layer of the resilience
+subsystem.
+
+PR 3 made *checkpoints* crash-safe and the fit loop preemption-aware;
+this module makes the JOB survive: the training loop runs as a
+supervised child that ticks a heartbeat file once per batch, and the
+parent's watchdog distinguishes
+
+* **dead** — ``waitpid`` reaped the child (preemption, OOM-kill,
+  segfault, a chaos ``kill_at_step``): restart from the latest
+  checkpoint with bounded, jitter-backed-off attempts;
+* **hung** — the child is alive but the heartbeat has not advanced
+  within ``MXNET_WATCHDOG_TIMEOUT`` (a wedged collective, a
+  deadlocked dataloader, a chaos ``hang_at_step``): dump a **flight
+  record** first (all-thread stacks via the child's ``faulthandler``
+  SIGUSR1 hook, a metrics ``snapshot()`` via its SIGUSR2 hook, the
+  tail of ``events.jsonl`` and the last compile-blame event), then
+  kill and restart the same way.
+
+Everything timing-related runs on ``time.monotonic`` — a watchdog
+that dies to an NTP step is worse than no watchdog (graftlint JG012
+exists because of exactly this hazard).
+
+Child-side contract: call :func:`heartbeat` once per batch
+(``fit()`` and ``ParallelTrainer.fit()`` do this automatically).  The
+first tick lazily opens the file named by ``MXNET_HEARTBEAT_FILE``
+and arms the SIGUSR1/SIGUSR2 flight hooks when
+``MXNET_FLIGHT_STACKS``/``MXNET_FLIGHT_SNAPSHOT`` name their dump
+paths — with none of the env knobs set every call is one dict lookup
+and a return.
+
+Import-light like the rest of the package: no jax anywhere here.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+
+from .retry import backoff_delays
+
+__all__ = ["heartbeat", "reset_heartbeat", "read_heartbeat",
+           "Supervisor", "SupervisorResult", "run_supervised"]
+
+log = logging.getLogger(__name__)
+
+_TICK_WIDTH = 20        # fixed-width counter: a reader never sees a
+#                         torn number (single small pwrite at offset 0)
+
+# child-side heartbeat state: path -> (fd, count)
+_hb_state = {}
+
+
+def _install_flight_hooks():
+    """Arm the child-side flight-record hooks (idempotent).
+
+    SIGUSR1 -> ``faulthandler`` all-thread stack dump (C-level: works
+    even when every Python thread is wedged); SIGUSR2 -> best-effort
+    Python-level metrics snapshot (works for sleep-style hangs, where
+    the interpreter still runs signal handlers)."""
+    stacks = os.environ.get("MXNET_FLIGHT_STACKS")
+    if stacks:
+        try:
+            import faulthandler
+            f = open(stacks, "w")
+            faulthandler.register(signal.SIGUSR1, file=f,
+                                  all_threads=True)
+        except (OSError, ValueError, AttributeError) as exc:
+            log.debug("flight stacks hook not installed: %s", exc)
+    snap = os.environ.get("MXNET_FLIGHT_SNAPSHOT")
+    if snap:
+        def _dump_snapshot(signum, frame):
+            try:
+                from ..observability import metrics as _metrics
+                payload = {"metrics": _metrics.snapshot(),
+                           "pid": os.getpid()}
+                with open(snap, "w", encoding="utf-8") as f:
+                    json.dump(payload, f, default=repr)
+            except Exception:   # signal context: never propagate
+                pass
+        try:
+            signal.signal(signal.SIGUSR2, _dump_snapshot)
+        except (ValueError, OSError) as exc:
+            # not the main thread / platform without SIGUSR2
+            log.debug("flight snapshot hook not installed: %s", exc)
+
+
+def heartbeat():
+    """Tick the supervised-job heartbeat (one per batch).  No-op
+    unless ``MXNET_HEARTBEAT_FILE`` is set.  Returns the tick count
+    (0 = unsupervised)."""
+    path = os.environ.get("MXNET_HEARTBEAT_FILE")
+    if not path:
+        return 0
+    state = _hb_state.get(path)
+    if state is None:
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT, 0o644)
+        state = _hb_state[path] = [fd, 0]
+        _install_flight_hooks()
+    state[1] += 1
+    os.pwrite(state[0], b"%0*d" % (_TICK_WIDTH, state[1]), 0)
+    return state[1]
+
+
+def reset_heartbeat():
+    """Close cached heartbeat fds (tests that swap env paths)."""
+    for fd, _ in _hb_state.values():
+        try:
+            os.close(fd)
+        except OSError:
+            pass
+    _hb_state.clear()
+
+
+def read_heartbeat(path):
+    """Parent-side: the child's tick count, or None before the first
+    tick."""
+    try:
+        with open(path, "rb") as f:
+            raw = f.read(_TICK_WIDTH)
+    except OSError:
+        return None
+    raw = raw.strip()
+    if not raw:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        return None
+
+
+class SupervisorResult:
+    """Outcome of one supervised job."""
+
+    __slots__ = ("exit_code", "attempts", "deaths", "hangs",
+                 "flight_records")
+
+    def __init__(self, exit_code, attempts, deaths, hangs,
+                 flight_records):
+        self.exit_code = exit_code
+        self.attempts = attempts
+        self.deaths = deaths
+        self.hangs = hangs
+        self.flight_records = list(flight_records)
+
+    @property
+    def ok(self):
+        return self.exit_code == 0
+
+    def __repr__(self):
+        return ("SupervisorResult(exit_code=%r, attempts=%d, deaths=%d, "
+                "hangs=%d, flight_records=%d)"
+                % (self.exit_code, self.attempts, self.deaths,
+                   self.hangs, len(self.flight_records)))
+
+
+class Supervisor:
+    """Run *cmd* (an argv list) as a supervised, auto-restarted child.
+
+    Parameters
+    ----------
+    cmd : list of str
+        The child process argv (typically ``[sys.executable, script]``);
+        the child must resume from its own latest checkpoint on start
+        (``fit(resume_from=...)``) — the supervisor restarts, it does
+        not re-plan.
+    workdir : str
+        Where the heartbeat file and flight records live.
+    timeout : float
+        Hang threshold in seconds (default ``MXNET_WATCHDOG_TIMEOUT``):
+        a child that is alive but has not ticked for this long is
+        declared hung.  Measured on the monotonic clock.
+    max_restarts : int
+        Restart budget (default ``MXNET_SUPERVISOR_RESTARTS``); the
+        first attempt is free, so up to ``max_restarts + 1`` runs.
+    env / env_for_attempt :
+        Base environment overrides, plus an optional
+        ``env_for_attempt(attempt) -> dict`` hook so drills can arm a
+        different chaos spec per incarnation.
+    sleep / rng :
+        Injectable (tests run deterministic schedules with no real
+        sleeping); backoff is the shared ``resilience.retry`` policy.
+    """
+
+    def __init__(self, cmd, workdir, timeout=None, max_restarts=None,
+                 env=None, env_for_attempt=None, poll_interval=0.1,
+                 grace=2.0, base_delay=0.1, max_delay=5.0, jitter=0.5,
+                 sleep=time.sleep, rng=None, logger=None):
+        from ..config import get_env
+        self.cmd = list(cmd)
+        # absolute: the child runs with cwd=workdir and resolves the
+        # heartbeat/flight env paths against THAT — a relative workdir
+        # would double up (workdir/workdir/heartbeat) and kill every
+        # incarnation on its first tick
+        self.workdir = os.path.abspath(workdir)
+        self.timeout = float(timeout if timeout is not None
+                             else get_env("MXNET_WATCHDOG_TIMEOUT"))
+        self.max_restarts = int(
+            max_restarts if max_restarts is not None
+            else get_env("MXNET_SUPERVISOR_RESTARTS"))
+        self.env = dict(env or {})
+        self.env_for_attempt = env_for_attempt
+        self.poll_interval = poll_interval
+        self.grace = grace
+        self._backoff = dict(base_delay=base_delay, max_delay=max_delay,
+                             multiplier=2.0, jitter=jitter)
+        self._sleep = sleep
+        self._rng = rng if rng is not None else random.Random()
+        self.logger = logger or log
+        self.heartbeat_path = os.path.join(self.workdir, "heartbeat")
+        os.makedirs(self.workdir, exist_ok=True)
+
+    # -- child lifecycle ---------------------------------------------------
+    def _child_env(self, attempt):
+        env = dict(os.environ)
+        env.update(self.env)
+        if self.env_for_attempt is not None:
+            env.update(self.env_for_attempt(attempt) or {})
+        env["MXNET_HEARTBEAT_FILE"] = self.heartbeat_path
+        env["MXNET_FLIGHT_STACKS"] = self._stacks_path(attempt)
+        env["MXNET_FLIGHT_SNAPSHOT"] = self._snapshot_path(attempt)
+        env["MXNET_SUPERVISOR_ATTEMPT"] = str(attempt)
+        return env
+
+    def _stacks_path(self, attempt):
+        return os.path.join(self.workdir, "flight-%d-stacks.txt" % attempt)
+
+    def _snapshot_path(self, attempt):
+        return os.path.join(self.workdir, "flight-%d-snapshot.json"
+                            % attempt)
+
+    def _spawn(self, attempt):
+        # a fresh heartbeat file per attempt: a stale tick count from
+        # the previous incarnation must not look like progress
+        try:
+            os.unlink(self.heartbeat_path)
+        except OSError:
+            pass
+        return subprocess.Popen(self.cmd, env=self._child_env(attempt),
+                                cwd=self.workdir)
+
+    # -- flight record -----------------------------------------------------
+    def _events_tail(self, limit=50):
+        """Last *limit* events of the job's events.jsonl (parsed), and
+        the newest compile event among them (the blame trail for "it
+        hung right after that recompile")."""
+        from ..observability import events as _events
+        path = self.env.get("MXNET_OBS_PATH") or _events.path()
+        if not os.path.isabs(path):
+            path = os.path.join(self.workdir, path)
+        tail = _events.tail_records(path, max_bytes=1 << 18)[-limit:]
+        last_compile = None
+        for rec in tail:
+            if rec.get("ev") == "compile":
+                last_compile = rec
+        return tail, last_compile
+
+    def _dump_flight_record(self, attempt, proc, reason, last_tick):
+        """Assemble the flight record BEFORE killing a hung child:
+        poke its faulthandler (SIGUSR1) and snapshot (SIGUSR2) hooks,
+        give them a moment, then write one JSON next to the dumps."""
+        path = os.path.join(self.workdir, "flight-%d.json" % attempt)
+        stacks = self._stacks_path(attempt)
+        snapshot = self._snapshot_path(attempt)
+        if proc.poll() is None:
+            for sig in (signal.SIGUSR1, signal.SIGUSR2):
+                try:
+                    proc.send_signal(sig)
+                except OSError:
+                    break
+            deadline = time.monotonic() + self.grace
+            while time.monotonic() < deadline:
+                if os.path.exists(stacks) and \
+                        os.path.getsize(stacks) > 0:
+                    break
+                self._sleep(0.05)
+        tail, last_compile = self._events_tail()
+        record = {
+            "reason": reason,
+            "attempt": attempt,
+            "pid": proc.pid,
+            "cmd": self.cmd,
+            "last_heartbeat_tick": last_tick,
+            "watchdog_timeout_s": self.timeout,
+            "stacks_path": stacks if os.path.exists(stacks) else None,
+            "snapshot_path": (snapshot if os.path.exists(snapshot)
+                              else None),
+            "events_tail": tail,
+            "last_compile": last_compile,
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(record, f, indent=1, default=repr)
+        os.replace(tmp, path)
+        self.logger.warning("supervisor: flight record written to %s "
+                            "(%s)", path, reason)
+        return path
+
+    # -- events / counters -------------------------------------------------
+    def _emit(self, category, **fields):
+        from ..observability import events as _events
+        from ..observability import metrics as _metrics
+        # the child appends to the same events.jsonl: reopen so this
+        # writer re-reads the last seq and the combined log stays
+        # monotone across the restart boundary
+        _events.reopen()
+        _events.emit(category, **fields)
+        return _metrics
+
+    # -- main loop ---------------------------------------------------------
+    def run(self):
+        """Supervise until the child exits 0, or the restart budget is
+        spent (returns the last exit code; 124 stands in for a
+        hang-kill)."""
+        deaths = hangs = 0
+        flight_records = []
+        delays = backoff_delays(self.max_restarts + 1,
+                                rng=self._rng, **self._backoff)
+        attempt = 0
+        while True:
+            self._emit("supervisor", action="start", attempt=attempt,
+                       restarts_used=deaths + hangs,
+                       budget=self.max_restarts)
+            proc = self._spawn(attempt)
+            rc, reason, last_tick = self._watch(proc, attempt)
+            if rc == 0:
+                m = self._emit("supervisor", action="exit", attempt=attempt,
+                               exit_code=0, deaths=deaths, hangs=hangs)
+                return SupervisorResult(0, attempt + 1, deaths, hangs,
+                                        flight_records)
+            if reason == "hang":
+                hangs += 1
+                flight_records.append(
+                    self._dump_flight_record(attempt, proc, "hang",
+                                             last_tick))
+                self._kill(proc)
+                rc = 124
+                m = self._emit("watchdog", action="hang_killed",
+                               attempt=attempt, last_tick=last_tick,
+                               timeout_s=self.timeout)
+                m.counter("watchdog_hangs_total",
+                          "supervised children killed for a stalled "
+                          "heartbeat").inc()
+            else:
+                deaths += 1
+                m = self._emit("supervisor", action="child_died",
+                               attempt=attempt, exit_code=rc,
+                               last_tick=last_tick)
+                m.counter("supervisor_child_deaths_total",
+                          "supervised children reaped with a nonzero "
+                          "exit").inc()
+            if deaths + hangs > self.max_restarts:
+                self._emit("supervisor", action="gave_up",
+                           attempt=attempt, exit_code=rc,
+                           deaths=deaths, hangs=hangs)
+                self.logger.error(
+                    "supervisor: restart budget exhausted (%d deaths + "
+                    "%d hangs > %d restarts); giving up with exit code "
+                    "%s", deaths, hangs, self.max_restarts, rc)
+                return SupervisorResult(rc, attempt + 1, deaths, hangs,
+                                        flight_records)
+            delay = next(delays)
+            m.counter("supervisor_restarts_total",
+                      "supervised children restarted after a death or "
+                      "hang-kill").inc()
+            self._emit("supervisor", action="restart",
+                       attempt=attempt + 1, backoff_s=round(delay, 3),
+                       reason=reason, exit_code=rc)
+            self.logger.warning(
+                "supervisor: child %s (rc=%s, attempt %d); restarting "
+                "from the latest checkpoint in %.2fs [%d/%d restarts]",
+                reason, rc, attempt, delay, deaths + hangs,
+                self.max_restarts)
+            self._sleep(delay)
+            attempt += 1
+
+    def _watch(self, proc, attempt):
+        """Poll until the child exits or hangs.  Returns
+        ``(exit_code_or_None, reason, last_tick)`` where reason is
+        'exit' or 'hang'."""
+        last_tick = None
+        last_change = time.monotonic()
+        while True:
+            rc = proc.poll()
+            if rc is not None:
+                return rc, "exit", last_tick
+            tick = read_heartbeat(self.heartbeat_path)
+            now = time.monotonic()
+            if tick != last_tick:
+                last_tick = tick
+                last_change = now
+            elif tick is not None and now - last_change > self.timeout:
+                return None, "hang", last_tick
+            elif tick is None and now - last_change > 4 * self.timeout:
+                # never ticked at all: likely wedged before the first
+                # batch (import deadlock, stuck compile) — startup gets
+                # 4x slack, then it is the same hang
+                return None, "hang", last_tick
+            self._sleep(self.poll_interval)
+
+    def _kill(self, proc):
+        if proc.poll() is not None:
+            return
+        proc.kill()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            self.logger.error("supervisor: child %d survived SIGKILL "
+                              "wait window", proc.pid)
+
+
+def run_supervised(cmd, workdir, **kwargs):
+    """One-call form: ``Supervisor(cmd, workdir, **kwargs).run()``."""
+    return Supervisor(cmd, workdir, **kwargs).run()

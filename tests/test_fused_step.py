@@ -250,8 +250,11 @@ def test_fused_states_serialize_in_legacy_format(tmp_path):
     f = str(tmp_path / "o.states")
     fused.save_optimizer_states(f)
     with open(f, "rb") as fh:
-        payload = pickle.loads(fh.read())
-    # legacy per-index format: {index: ("tuple", [("nd", arr), ...])}
+        blob = pickle.loads(fh.read())
+    # format-2 envelope (resume validation header) around the exact
+    # legacy per-index payload: {index: ("tuple", [("nd", arr), ...])}
+    assert blob["__format__"] == 2 and blob["opt_class"] == "Adam"
+    payload = blob["states"]
     assert set(payload) == set(legacy._updater.states)
     for i, s in legacy._updater.states.items():
         kind, entries = payload[i]

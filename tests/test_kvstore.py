@@ -77,7 +77,8 @@ def test_connect_retry_survives_refused_first_attempt():
     t.start()
     t0 = time.time()
     # guaranteed ≥1 refused attempt (nothing listens for the first 1s)
-    sock = _connect_retry("127.0.0.1", port, deadline=time.time() + 30)
+    sock = _connect_retry("127.0.0.1", port,
+                           deadline=time.monotonic() + 30)
     try:
         assert ready.is_set()
         assert time.time() - t0 < 15, "retry should connect promptly"
@@ -90,7 +91,8 @@ def test_connect_retry_deadline_raises():
     from mxnet_tpu._kvstore_impl import _connect_retry
     t0 = time.time()
     with pytest.raises(OSError):
-        _connect_retry("127.0.0.1", 9341, deadline=time.time() + 1.0)
+        _connect_retry("127.0.0.1", 9341,
+                       deadline=time.monotonic() + 1.0)
     assert time.time() - t0 < 10
 
 

@@ -720,7 +720,7 @@ def test_dataloader_respawns_killed_worker():
     it = iter(loader)
     first = next(it)
     # reach into the worker iter and hard-kill the process mid-epoch
-    inner = it.gi_frame.f_locals["it"]
+    inner = loader._worker_iter
     for w in inner._workers:
         w.terminate()
         w.join()

@@ -928,6 +928,80 @@ def check_jg011(project):
 
 
 # ---------------------------------------------------------------------------
+# JG012 — wall-clock deadline hazard: time.time() feeding an
+# elapsed/deadline comparison
+# ---------------------------------------------------------------------------
+
+def _is_walltime(m, call):
+    """A bare ``time.time()`` call (alias-resolved; no-arg only —
+    ``time.monotonic``/``perf_counter`` never match)."""
+    if not isinstance(call, ast.Call) or call.args or call.keywords:
+        return False
+    d = dotted_name(call.func)
+    if d is None:
+        return False
+    head, _, tail = d.partition(".")
+    return tail == "time" and m.imports.get(head) == "time"
+
+
+def check_jg012(project):
+    """``time.time()`` used to compute a timeout/deadline that is then
+    compared against elapsed time: an NTP step (or leap smear) moves
+    the wall clock and the watchdog/timeout fires years early or never
+    — heartbeat eviction and hang detection die to exactly this.  Wall
+    time is for TIMESTAMPS (log fields, tokens); durations and
+    deadlines belong on ``time.monotonic()``.  Flagged: a comparison
+    whose operand contains ``time.time()`` (directly or through a
+    name assigned from it / from ``time.time() ± x``)."""
+    out = []
+    for m in project.modules:
+        # cheap source prefilter: wall time is always an attribute
+        # call, so a module whose text never says ".time(" has nothing
+        # to scan (the AST walk below is the expensive part)
+        if not any(".time(" in line for line in m.lines):
+            continue
+        for fi in m.functions:
+            nodes = list(body_walk(fi.node))
+            if not any(_is_walltime(m, n) for n in nodes):
+                continue
+            tainted = set()     # names holding wall stamps/deadlines
+            for n in nodes:
+                if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                        and isinstance(n.targets[0], ast.Name):
+                    v = n.value
+                    direct = _is_walltime(m, v)
+                    arith = (isinstance(v, ast.BinOp)
+                             and isinstance(v.op, (ast.Add, ast.Sub))
+                             and any(_is_walltime(m, c)
+                                     for c in ast.walk(v)))
+                    if direct or arith:
+                        tainted.add(n.targets[0].id)
+
+            def _op_tainted(op):
+                for c in ast.walk(op):
+                    if _is_walltime(m, c):
+                        return True
+                    if isinstance(c, ast.Name) and \
+                            isinstance(c.ctx, ast.Load) and \
+                            c.id in tainted:
+                        return True
+                return False
+
+            for n in nodes:
+                if isinstance(n, ast.Compare) and (
+                        _op_tainted(n.left) or
+                        any(_op_tainted(c) for c in n.comparators)):
+                    out.append(_f(
+                        "JG012", fi, n,
+                        "wall-clock deadline in '%s': time.time() "
+                        "feeds an elapsed/deadline comparison — an NTP "
+                        "step breaks it; use time.monotonic() for "
+                        "durations (wall time is for timestamps only)"
+                        % fi.qualname))
+    return out
+
+
+# ---------------------------------------------------------------------------
 
 ALL_RULES = {
     "JG001": check_jg001,
@@ -941,6 +1015,7 @@ ALL_RULES = {
     "JG009": check_jg009,
     "JG010": check_jg010,
     "JG011": check_jg011,
+    "JG012": check_jg012,
 }
 
 RULE_DOCS = {
@@ -972,4 +1047,7 @@ RULE_DOCS = {
     "JG011": "thread started without join/daemon ownership, or handed "
              "module-level mutable state through args (static "
              "companion of the graftsan thread registry)",
+    "JG012": "wall-clock deadline hazard: time.time() used to compute "
+             "a timeout/deadline compared against elapsed time (NTP "
+             "steps break watchdogs; use time.monotonic())",
 }
