@@ -24,6 +24,7 @@ Measurement notes (learned the hard way on this image):
 from __future__ import annotations
 
 import json
+import math
 import os
 import sys
 import time
@@ -498,6 +499,143 @@ def compare_update_paths(n_layers=30, dim=64, batch=32, steps=30,
     return out
 
 
+def _percentile(sorted_vals, q):
+    """Nearest-rank percentile of an ascending list (exact — serving
+    SLOs are quoted on real request latencies, not histogram bounds)."""
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1,
+              max(0, math.ceil(q / 100.0 * len(sorted_vals)) - 1))
+    return sorted_vals[idx]
+
+
+def serve_bench(hidden=256, dim=64, classes=16,
+                closed_threads=8, closed_requests=40,
+                open_rate=150.0, open_seconds=2.0, max_wait_ms=1.0):
+    """``--serve``: load test of the compiled serving subsystem
+    (mxnet_tpu/serve): one warm-compiled model behind the dynamic
+    batcher, driven closed-loop (N threads, back-to-back requests —
+    the throughput ceiling) and open-loop (fixed arrival rate — the
+    latency distribution under load, which closed-loop hides by
+    coordinated omission).  Mixed request sizes (1-4 rows) exercise
+    the coalescing + padding path.  Prints ONE BENCH-schema JSON line
+    with p50/p99 latency and throughput and returns the dict."""
+    import threading
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import serve, sym
+
+    data = sym.var("data")
+    net = sym.FullyConnected(data, num_hidden=hidden, name="sfc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=classes, name="sfc2")
+    net = sym.softmax(net)
+    rs = np.random.RandomState(0)
+    arg_shapes, _, _ = net.infer_shape(data=(1, dim))
+    params = {n: mx.nd.array(rs.randn(*s).astype(np.float32) * 0.05)
+              for n, s in zip(net.list_arguments(), arg_shapes)
+              if n != "data"}
+
+    registry = serve.ModelRegistry()
+    ladder = serve.BucketLadder(batches=(1, 2, 4, 8, 16))
+    t0 = time.perf_counter()
+    pred = registry.load("bench", net, params,
+                         data_shapes={"data": (1, dim)}, ladder=ladder)
+    warm_s = time.perf_counter() - t0
+    batcher = registry.batcher("bench", max_wait_ms=max_wait_ms)
+    compiles_after_warm = pred.compile_count
+
+    reqs = [rs.randn(rs.randint(1, 5), dim).astype(np.float32)
+            for _ in range(64)]
+
+    # -- closed loop: threads issue back-to-back ------------------------
+    lat_closed = []
+    worker_errors = []
+    lat_lock = threading.Lock()
+
+    def worker(tid):
+        mine = []
+        try:
+            for i in range(closed_requests):
+                x = reqs[(tid * closed_requests + i) % len(reqs)]
+                t0 = time.monotonic()
+                batcher.submit(x).result(60)
+                mine.append(time.monotonic() - t0)
+        except Exception as exc:
+            with lat_lock:
+                worker_errors.append("worker %d: %r" % (tid, exc))
+        finally:
+            with lat_lock:
+                lat_closed.extend(mine)
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(closed_threads)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    closed_dt = time.monotonic() - t0
+    if worker_errors:
+        # a failed/timed-out request would silently skew the report
+        raise RuntimeError("serve bench closed loop failed: %s"
+                           % "; ".join(worker_errors[:3]))
+    closed_n = closed_threads * closed_requests
+
+    # -- open loop: fixed arrival rate ----------------------------------
+    futures = []
+    period = 1.0 / open_rate
+    t_start = time.monotonic()
+    n_open = int(open_rate * open_seconds)
+    for i in range(n_open):
+        slot = t_start + i * period
+        delay = slot - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        x = reqs[i % len(reqs)]
+        futures.append((time.monotonic(), batcher.submit(x)))
+    for _, fut in futures:
+        fut.result(60)
+    open_dt = time.monotonic() - t_start
+    # each future stamps its own resolution time — submit->resolve is
+    # the true per-request latency even though collection is serial
+    lat_open = [fut._t_resolved - t_sub for t_sub, fut in futures]
+
+    lat_closed.sort()
+    lat_open.sort()
+    out = {
+        "metric": "serve_load",
+        "value": round(closed_n / closed_dt, 2),
+        "unit": "requests/sec",
+        "model": {"hidden": hidden, "dim": dim,
+                  "buckets": list(ladder.batches)},
+        "warm_compile_seconds": round(warm_s, 3),
+        "programs_compiled": compiles_after_warm,
+        "request_path_compiles": pred.compile_count - compiles_after_warm,
+        "closed_loop": {
+            "threads": closed_threads,
+            "requests": closed_n,
+            "throughput_rps": round(closed_n / closed_dt, 2),
+            "p50_ms": round(_percentile(lat_closed, 50) * 1e3, 3),
+            "p99_ms": round(_percentile(lat_closed, 99) * 1e3, 3),
+        },
+        "open_loop": {
+            "offered_rps": open_rate,
+            "requests": n_open,
+            "achieved_rps": round(len(lat_open) / open_dt, 2),
+            "p50_ms": round(_percentile(lat_open, 50) * 1e3, 3)
+            if lat_open else None,
+            "p99_ms": round(_percentile(lat_open, 99) * 1e3, 3)
+            if lat_open else None,
+        },
+        "batches": batcher.batch_count,
+        "requests": batcher.request_count,
+    }
+    registry.close()
+    print(json.dumps(out))
+    return out
+
+
 def decompose_main():
     """``--decompose``: lower the north-star train step, attribute its
     cost per op against probed roofline peaks, print the human table
@@ -546,6 +684,14 @@ def decompose_main():
 
 
 def main():
+    if "--serve" in sys.argv:
+        # serving load test: throughput + latency of the compiled
+        # inference subsystem under concurrent traffic.  Platform
+        # rules match the training bench (_ensure_platform): a TPU
+        # target is health-probed, CPU needs BENCH_ALLOW_CPU=1.
+        _ensure_platform()
+        serve_bench()
+        return
     if "--decompose" in sys.argv:
         return decompose_main()
     if "--compare-update-paths" in sys.argv:

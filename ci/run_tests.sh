@@ -31,6 +31,16 @@ echo "== observability: telemetry smoke train step =="
 # stdout line is the scrapeable summary ("obs: instruments=.. ...").
 MXNET_OBS=all python ci/obs_smoke.py
 
+echo "== serve: compiled-inference smoke (registry + dynamic batcher) =="
+# Two-model registry under concurrent mixed-size traffic through the
+# dynamic batcher, sanitizers on: asserts one AOT compile per bucket
+# and ZERO compiles/traces in the request path, every caller's rows
+# bit-equal to the eager forward at some rung, p50/p99 emitted from
+# the request histogram, and no graftsan reports from the batcher's
+# locks/threads.  Seconds, CPU-only (docs/serving.md).  Last stdout
+# line is the scrapeable summary ("serve: reqs=.. batches=.. ...").
+MXNET_SAN=all python ci/serve_smoke.py
+
 echo "== resilience: chaos-injected fault drills =="
 # The resilience suite under the chaos harness: kill-mid-save,
 # corrupt-checkpoint, NaN-step, and preemption drills against the REAL

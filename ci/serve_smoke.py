@@ -1,0 +1,252 @@
+"""Serving-subsystem CI smoke (ci/run_tests.sh stage).
+
+A two-model registry serving concurrent mixed-size traffic through
+the dynamic batcher, with the graftsan sanitizers on (the stage
+exports MXNET_SAN=all) and serve events recorded.  Fails on:
+
+* any compile after warmup — the request path must dispatch only
+  AOT programs (``compile_count`` pinned at one per bucket, and the
+  underlying jit's trace cache pinned at ZERO);
+* a wrong answer — every future's rows are checked bit-exact against
+  the eager single-shot forward of the same model;
+* any graftsan report (the batcher's locks/queues/threads all come
+  from the sanitizer factories — a race or lock-order cycle in the
+  dispatcher shows up here, in seconds);
+* missing latency accounting (p50/p99 come out of the
+  ``serve_request_seconds`` histogram).
+
+Last stdout line is the scrapeable summary::
+
+    serve: reqs=N batches=M compiles=K ok
+"""
+
+import os
+import sys
+import tempfile
+import threading
+
+os.environ.setdefault("MXNET_SAN", "all")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("MXNET_OBS", "serve")
+os.environ.setdefault(
+    "MXNET_OBS_PATH",
+    os.path.join(tempfile.mkdtemp(prefix="serve_smoke_"),
+                 "events.jsonl"))
+
+import numpy as np  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import serve, sym  # noqa: E402
+from mxnet_tpu.observability import events as obs_events  # noqa: E402
+from mxnet_tpu.observability import metrics as obs_metrics  # noqa: E402
+import tools.graftsan as graftsan  # noqa: E402
+
+THREADS = 6
+REQS_PER_THREAD = 25
+BUCKETS = (1, 2, 4, 8)
+
+
+def build_model(dim, hidden, classes, seed):
+    data = sym.var("data")
+    net = sym.FullyConnected(data, num_hidden=hidden, name="h")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=classes, name="o")
+    net = sym.softmax(net)
+    rs = np.random.RandomState(seed)
+    arg_shapes, _, _ = net.infer_shape(data=(1, dim))
+    params = {n: mx.nd.array(rs.randn(*s).astype(np.float32) * 0.1)
+              for n, s in zip(net.list_arguments(), arg_shapes)
+              if n != "data"}
+    return net, params
+
+
+class EagerRungRefs:
+    """Bit-exact references for a request under dynamic batching.
+
+    A coalesced request's rows run at whatever rung the batch landed
+    on, so the exact baseline is 'the same rows, zero-padded, through
+    the EAGER executor at rung B' for each rung B >= rows — serving
+    must reproduce one of those bit-for-bit (anything else means
+    coalescing/padding/splitting corrupted the rows).  One eager
+    executor per rung, reused across requests (tests/test_serve.py
+    separately proves natural-batch bit-equality and pad-invariance)."""
+
+    def __init__(self, net, params, dim):
+        self._net = net
+        self._params = params
+        self._dim = dim
+        self._execs = {}
+
+    def _exec_at(self, b):
+        ex = self._execs.get(b)
+        if ex is None:
+            args = dict(self._params)
+            args["data"] = mx.nd.array(np.zeros((b, self._dim),
+                                                np.float32))
+            ex = self._net.bind(mx.cpu(), args)
+            self._execs[b] = ex
+        return ex
+
+    def refs(self, x):
+        rows = x.shape[0]
+        out = []
+        for b in BUCKETS:
+            if b < rows:
+                continue
+            buf = np.zeros((b, self._dim), np.float32)
+            buf[:rows] = x
+            ex = self._exec_at(b)
+            out.append(ex.forward(data=mx.nd.array(buf))[0]
+                       .asnumpy()[:rows])
+        return out
+
+
+def hist_quantile(snap, q):
+    """Upper-bound estimate of quantile *q* from a histogram
+    snapshot (cumulative Prometheus buckets)."""
+    total = snap["count"]
+    if not total:
+        return None
+    target = q * total
+    for le, cum in snap["buckets"].items():
+        if le != "+Inf" and cum >= target:
+            return float(le)
+    return float("inf")
+
+
+def main():
+    failures = []
+    models = {}
+    registry = serve.ModelRegistry()
+    ladder = serve.BucketLadder(batches=BUCKETS)
+    # fixed integer seeds: hash(name) varies per interpreter
+    # (PYTHONHASHSEED), which would make a bit-equality failure
+    # unreproducible across runs
+    for name, dims, seed in (("alpha", (12, 32, 4), 11),
+                             ("beta", (7, 16, 3), 23)):
+        net, params = build_model(*dims, seed=seed)
+        pred = registry.load(name, net, params,
+                             data_shapes={"data": (1, dims[0])},
+                             ladder=ladder)
+        if pred.compile_count != len(BUCKETS):
+            failures.append(
+                "%s: warm built %d programs for %d buckets"
+                % (name, pred.compile_count, len(BUCKETS)))
+        models[name] = (net, params, pred, dims[0])
+    registry.alias("stable", "alpha")
+
+    # deterministic request schedule; per-rung eager references
+    # computed SERIALLY before any traffic flows
+    rs = np.random.RandomState(7)
+    pools, rung_refs = {}, {}
+    for name, (net, params, _, dim) in models.items():
+        pools[name] = rs.randn(32, dim).astype(np.float32)
+        rung_refs[name] = EagerRungRefs(net, params, dim)
+    schedule = {}
+    for tid in range(THREADS):
+        rw = np.random.RandomState(tid)
+        plan = []
+        for i in range(REQS_PER_THREAD):
+            name = ("alpha", "beta", "stable")[(tid + i) % 3]
+            resolved = "alpha" if name == "stable" else name
+            rows = int(rw.randint(1, 5))
+            lo = int(rw.randint(0, 32 - rows))
+            x = pools[resolved][lo:lo + rows]
+            plan.append((name, x, rung_refs[resolved].refs(x)))
+        schedule[tid] = plan
+
+    warm_compiles = {n: m[2].compile_count for n, m in models.items()}
+    errors = []
+
+    def worker(tid):
+        for i, (name, x, refs) in enumerate(schedule[tid]):
+            fut = registry.submit(name, x)
+            out = fut.result(60)[0]
+            if out.shape != refs[0].shape:
+                errors.append("%s: got shape %s for %s" %
+                              (name, out.shape, refs[0].shape))
+            elif not any(np.array_equal(out, r) for r in refs):
+                errors.append(
+                    "%s req %d/%d: rows are not bit-equal to the "
+                    "eager forward at ANY rung — coalescing/padding "
+                    "corrupted them" % (name, tid, i))
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(THREADS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    failures.extend(errors[:5])
+
+    total_reqs = sum(registry.batcher(n).request_count for n in models)
+    total_batches = sum(registry.batcher(n).batch_count for n in models)
+    total_compiles = sum(m[2].compile_count for m in models.values())
+    expect_reqs = THREADS * REQS_PER_THREAD
+    if total_reqs != expect_reqs:
+        failures.append("request accounting: %d submitted, %d counted"
+                        % (expect_reqs, total_reqs))
+    if total_batches >= total_reqs:
+        failures.append(
+            "dynamic batching inert: %d batches for %d requests "
+            "(no coalescing happened)" % (total_batches, total_reqs))
+    for name, (_, _, pred, _) in models.items():
+        if pred.compile_count != warm_compiles[name]:
+            failures.append(
+                "%s: %d compiles happened in the REQUEST PATH"
+                % (name, pred.compile_count - warm_compiles[name]))
+        if pred.jit_cache_size() != 0:
+            failures.append(
+                "%s: jit trace cache is %d (something traced instead "
+                "of dispatching an AOT program)"
+                % (name, pred.jit_cache_size()))
+
+    # latency accounting: p50/p99 out of the request histogram
+    snap = obs_metrics.snapshot().get("serve_request_seconds")
+    if not snap or snap["count"] < expect_reqs:
+        failures.append("serve_request_seconds histogram missing or "
+                        "short: %r" % (snap,))
+        p50 = p99 = None
+    else:
+        p50 = hist_quantile(snap, 0.50)
+        p99 = hist_quantile(snap, 0.99)
+        print("serve smoke: p50<=%.4fs p99<=%.4fs (n=%d)"
+              % (p50, p99, snap["count"]))
+
+    # serve events recorded (load + one compile event per program)
+    try:
+        evs = [e for e in obs_events.read_events() if e["ev"] == "serve"]
+    except OSError:
+        evs = []
+    loads = [e for e in evs if e.get("kind") == "load"]
+    compiles = [e for e in evs if e.get("kind") == "compile"]
+    if len(loads) < 2 or len(compiles) < total_compiles:
+        failures.append(
+            "serve events incomplete: %d loads, %d compile events for "
+            "%d programs" % (len(loads), len(compiles), total_compiles))
+
+    # registry lifecycle under traffic already done; unload must close
+    registry.unload("beta")
+    if "beta" in registry.names():
+        failures.append("unload left beta resident")
+
+    reports = graftsan.reports()
+    failures.extend(graftsan.format_report(r) for r in reports)
+
+    if failures:
+        print("\n".join(failures), file=sys.stderr)
+        print("serve smoke: FAIL", file=sys.stderr)
+        print("serve: reqs=%d batches=%d compiles=%d FAIL"
+              % (total_reqs, total_batches, total_compiles))
+        return 1
+    print("serve: reqs=%d batches=%d compiles=%d ok"
+          % (total_reqs, total_batches, total_compiles))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

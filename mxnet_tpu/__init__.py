@@ -99,8 +99,15 @@ from . import subgraph  # noqa: F401
 from . import resilience  # noqa: F401
 from . import config  # noqa: F401
 from . import sanitizer  # noqa: F401  (graftsan bridge — see MXNET_SAN)
+from . import serve  # noqa: F401  (compiled inference subsystem)
 from . import rtc  # noqa: F401
 from .runtime import engine  # noqa: F401
+
+# Persistent XLA compilation cache (MXNET_COMPILE_CACHE_DIR): applied
+# at import so EVERY compile in the process — fused train steps, AOT
+# serve buckets, dist-drill child processes — can hit the on-disk
+# cache.  No-op when the knob is unset; does not initialize a backend.
+config.enable_compile_cache()
 
 
 def waitall():

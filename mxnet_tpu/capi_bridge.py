@@ -3,24 +3,43 @@
 ``src/capi/mxtpu_predict.cc`` embeds CPython and calls into this module;
 each ``MXPred*`` C function maps onto one method here.  The C++ layer only
 marshals raw float buffers and shape tuples — all framework logic
-(symbol JSON parsing, param loading, executor bind, forward) stays on this
-side of the boundary, exactly like the reference routes its predict API
-through the graph executor (c_predict_api.cc:106 MXPredCreatePartialOut).
+(symbol JSON parsing, param loading, program compilation, forward) stays
+on this side of the boundary.  Where the reference routes its predict
+API through the eager graph executor (c_predict_api.cc:106
+MXPredCreatePartialOut), this surface is a thin client of the serving
+subsystem: MXPredCreate loads the model into ``serve.c_registry()``
+and MXPredForward dispatches the registry's AOT-compiled bucket
+program (mxnet_tpu/serve/, docs/serving.md).
 """
 
 from __future__ import annotations
 
 import functools
+import itertools
 
 import numpy as np
 
 
+#: MXPredCreate handle sequence (registry model names must be unique
+#: per live handle)
+_PRED_SEQ = itertools.count()
+
+
 class Predictor(object):
-    """One MXPredCreate handle: a bound single-batch forward executor."""
+    """One MXPredCreate handle — a thin client of the serve registry.
+
+    The symbol + params are loaded into the process-wide
+    :func:`mxnet_tpu.serve.c_registry` as a model whose bucket ladder
+    is pinned to the create-time batch, so ``MXPredForward`` runs the
+    registry's AOT-compiled bucket program: after create, no trace or
+    compile can happen on the C request path (the same contract the
+    Python serving surface gives — see docs/serving.md for the full
+    C-ABI mapping against the reference ``c_predict_api.cc``)."""
 
     def __init__(self, symbol_json, param_bytes, dev_type, dev_id,
                  input_keys, input_shapes):
         import mxnet_tpu as mx
+        from mxnet_tpu import serve
         from mxnet_tpu import symbol as sym_mod
 
         sym = sym_mod.load_json(symbol_json)
@@ -45,30 +64,40 @@ class Predictor(object):
         shapes = dict(zip(input_keys, [tuple(s) for s in input_shapes]))
         arg_shapes, out_shapes, aux_shapes = sym.infer_shape(**shapes)
         self._out_shapes = [tuple(s) for s in out_shapes]
-        self._inputs = {}
+        # unset params predict from zeros, like the reference
         args = {}
         for name, shp in zip(sym.list_arguments(), arg_shapes):
             if name in shapes:
-                arr = mx.nd.zeros(shapes[name], ctx=ctx)
-                self._inputs[name] = arr
-                args[name] = arr
-            elif name in arg_params:
-                args[name] = arg_params[name].copyto(ctx)
-            else:
-                args[name] = mx.nd.zeros(shp, ctx=ctx)
+                continue
+            args[name] = arg_params[name] if name in arg_params \
+                else mx.nd.zeros(shp, ctx=ctx)
         aux = {}
         for name, shp in zip(sym.list_auxiliary_states(), aux_shapes):
-            if name in aux_params:
-                aux[name] = aux_params[name].copyto(ctx)
-            else:
-                aux[name] = mx.nd.zeros(shp, ctx=ctx)
-        self._exec = sym.bind(ctx, args, aux_states=aux, grad_req="null")
+            aux[name] = aux_params[name] if name in aux_params \
+                else mx.nd.zeros(shp, ctx=ctx)
+        self._inputs = {k: np.zeros(s, np.float32)
+                        for k, s in shapes.items()}
+        self._name = "c_pred_%d" % next(_PRED_SEQ)
+        self._registry = serve.c_registry()
+        batch = shapes[input_keys[0]][0] if input_keys else 1
+        # inputs that share the lead input's batch dim ride the (single
+        # -rung) ladder; any other input is fixed-shape — multi-input
+        # models need not share a leading dim (reference bind semantics)
+        bucket = tuple(k for k in input_keys if shapes[k][0] == batch)
+        self._pred = self._registry.load(
+            self._name, sym, args, aux_params=aux, data_shapes=shapes,
+            ladder=serve.BucketLadder(batches=(batch,)), ctx=ctx,
+            bucket_inputs=bucket)
         self._outputs = []
 
     def set_input(self, key, data_bytes, shape):
-        import mxnet_tpu as mx
         arr = np.frombuffer(data_bytes, np.float32).reshape(shape)
-        self._inputs[key][:] = mx.nd.array(arr, ctx=self._ctx)
+        if tuple(shape) != tuple(self._inputs[key].shape):
+            raise ValueError(
+                "input %r shape %s does not match the bound %s (the "
+                "compiled predict program is shape-specialized)"
+                % (key, tuple(shape), tuple(self._inputs[key].shape)))
+        self._inputs[key] = arr.copy()
 
     def set_input_flat(self, key, data_bytes):
         """MXPredSetInput: flat float32 buffer, reshaped to the bound
@@ -76,10 +105,10 @@ class Predictor(object):
         self.set_input(key, data_bytes, tuple(self._inputs[key].shape))
 
     def forward(self):
-        self._outputs = self._exec.forward(is_train=False)
+        self._outputs = self._pred.predict(dict(self._inputs))
 
     def num_outputs(self):
-        return len(self._exec.outputs)
+        return len(self._out_shapes)
 
     def get_output_shape(self, index):
         if self._outputs:
@@ -89,6 +118,23 @@ class Predictor(object):
     def get_output(self, index):
         out = self._outputs[index].asnumpy().astype(np.float32)
         return out.tobytes()
+
+    def close(self):
+        """MXPredFree: drop the registry model this handle loaded."""
+        from mxnet_tpu.serve import ServeError
+        if self._name is not None:
+            try:
+                self._registry.unload(self._name)
+            except ServeError:
+                pass    # already unloaded (double free)
+            self._name = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # graftlint: disable=JG006
+            pass  # interpreter teardown: registry may be gone already
+            #      (finalizers must never raise; not a dispatch path)
 
 
 def create(symbol_json, param_bytes, dev_type, dev_id, input_keys,

@@ -13,7 +13,8 @@ from __future__ import annotations
 
 import os
 
-__all__ = ["register_env", "get_env", "list_env", "describe"]
+__all__ = ["register_env", "get_env", "list_env", "describe",
+           "enable_compile_cache"]
 
 _REGISTRY = {}
 
@@ -130,7 +131,7 @@ register_env("MXNET_OBS", str, "",
              "Structured run-event categories to record to "
              "events.jsonl: comma list of compile,guard,chaos,"
              "checkpoint,preempt,retry,respawn,warning,kvstore,"
-             "supervisor,watchdog, or 'all'; "
+             "supervisor,watchdog,serve, or 'all'; "
              "empty = off (no file, zero per-event cost; see "
              "docs/observability.md)")
 register_env("MXNET_OBS_PATH", str, "events.jsonl",
@@ -222,3 +223,45 @@ register_env("MXNET_USE_NATIVE_RECORDIO", bool, True,
 register_env("MXNET_ENGINE_INFO", bool, False,
              "Verbose engine scheduling debug output "
              "(reference: threaded_engine.h:302)")
+register_env("MXNET_COMPILE_CACHE_DIR", str, "",
+             "Directory for jax's persistent XLA compilation cache "
+             "(jax_compilation_cache_dir): cold starts — serving "
+             "fleets, multi-process dist drills, supervisor restarts "
+             "— reload compiled programs from disk instead of paying "
+             "a full compile; empty = off (see docs/serving.md and "
+             "docs/perf_fused_step.md)")
+register_env("MXNET_COMPILE_CACHE_MIN_SECS", float, 0.0,
+             "Minimum compile time (seconds) for a program to be "
+             "written to the persistent compilation cache "
+             "(jax_persistent_cache_min_compile_time_secs); 0 caches "
+             "everything — serving ladders are many small programs")
+register_env("MXNET_SERVE_MAX_WAIT_MS", float, 2.0,
+             "How long the serve DynamicBatcher holds a non-full "
+             "batch open for more arrivals, measured from the oldest "
+             "queued request (milliseconds, monotonic clock); 0 = "
+             "dispatch immediately, no coalescing window")
+register_env("MXNET_SERVE_MAX_BATCH", int, 0,
+             "Row cap per coalesced serve batch; 0 = the model's "
+             "bucket-ladder top rung")
+
+
+def enable_compile_cache():
+    """Apply the ``MXNET_COMPILE_CACHE_DIR`` knob: point jax's
+    persistent compilation cache at the directory (created if
+    missing) so every process sharing it — a serving fleet, the
+    multi-process dist drills, supervisor-restarted jobs — pays each
+    distinct program's compile once, ever.  Returns True when the
+    cache was enabled.  Called at package import; safe to call again
+    after mutating the environment (tests)."""
+    path = get_env("MXNET_COMPILE_CACHE_DIR")
+    if not path:
+        return False
+    import jax
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      get_env("MXNET_COMPILE_CACHE_MIN_SECS"))
+    # tiny programs matter for the serve ladder: do not skip them on
+    # size either
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    return True

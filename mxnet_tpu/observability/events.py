@@ -16,9 +16,9 @@ writer is created lazily on the first recorded event — with the knob
 unset no file is ever opened.
 
 Categories: ``compile``, ``guard``, ``chaos``, ``checkpoint``,
-``preempt``, ``retry``, ``respawn``, ``warning``, ``kvstore`` (plus
-anything a caller passes — unknown categories are recorded when
-``all`` is on).
+``preempt``, ``retry``, ``respawn``, ``warning``, ``kvstore``,
+``serve`` (plus anything a caller passes — unknown categories are
+recorded when ``all`` is on).
 
 Durability discipline (the same machinery family as
 ``resilience.checkpoint``): each line is ONE ``os.write`` on an
@@ -48,7 +48,7 @@ __all__ = ["enabled", "emit", "emitter", "watch_jit", "configure",
 
 _CATEGORIES = ("compile", "guard", "chaos", "checkpoint", "preempt",
                "retry", "respawn", "warning", "kvstore", "supervisor",
-               "watchdog")
+               "watchdog", "serve")
 
 
 def _spec():

@@ -1,0 +1,27 @@
+"""``mxnet_tpu.serve`` — the compiled inference subsystem.
+
+Production serving for models built with this framework:
+
+* :class:`BucketLadder` — the finite set of padded shapes a model may
+  run at (buckets.py);
+* :class:`CompiledPredictor` — one AOT-compiled XLA program per
+  bucket, built with ``jit(...).lower().compile()`` at load time so
+  no trace or compile ever happens in the request path, plus donated
+  KV-cache decode sessions (predictor.py);
+* :class:`DynamicBatcher` / :class:`ServeFuture` — continuous
+  batching: many callers, one padded dispatch (batcher.py);
+* :class:`ModelRegistry` — multi-model load/unload/alias with a warm
+  program cache; :func:`c_registry` is the process-wide instance the
+  C predict ABI routes through (registry.py).
+
+See docs/serving.md for the architecture, knobs and metrics catalog.
+"""
+
+from .buckets import BucketLadder, ServeError  # noqa: F401
+from .predictor import CompiledPredictor, DecodeSession  # noqa: F401
+from .batcher import DynamicBatcher, ServeFuture  # noqa: F401
+from .registry import ModelRegistry, c_registry  # noqa: F401
+
+__all__ = ["BucketLadder", "ServeError", "CompiledPredictor",
+           "DecodeSession", "DynamicBatcher", "ServeFuture",
+           "ModelRegistry", "c_registry"]

@@ -1,0 +1,308 @@
+"""DynamicBatcher — continuous batching over AOT bucket programs.
+
+Callers submit single examples (or small batches) and get a future;
+a dispatcher thread coalesces whatever is queued up to the bucket
+capacity or a max-wait deadline, runs ONE padded-bucket XLA dispatch
+for the whole group, and resolves each caller's future with its own
+row slice.  One program execution serves many callers — the
+throughput side of the serving story, with the ladder keeping the
+latency side (no compiles) honest.
+
+Concurrency discipline: every lock/condition/thread comes from the
+:mod:`..sanitizer` factories, so a ``pytest --graftsan`` run audits
+the batcher's locking like any other subsystem, and all deadlines run
+on ``time.monotonic`` (graftlint JG012).
+"""
+
+from __future__ import annotations
+
+import collections
+import time as _time
+
+from .buckets import ServeError
+from .. import sanitizer as _san
+from ..observability import metrics as _obs_metrics
+
+__all__ = ["ServeFuture", "DynamicBatcher"]
+
+# module-level instrument refs (hot path discipline, see metrics.py)
+_REQUEST_SECONDS = _obs_metrics.histogram(
+    "serve_request_seconds",
+    "end-to-end request latency: submit to future resolution "
+    "(queue wait + batching + dispatch)")
+_QUEUE_DEPTH = _obs_metrics.gauge(
+    "serve_queue_depth",
+    "requests waiting across all dynamic batchers (delta-maintained)")
+_BATCH_OCCUPANCY = _obs_metrics.histogram(
+    "serve_batch_occupancy",
+    "real rows / bucket capacity per dispatched batch",
+    buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0))
+_BATCHES_TOTAL = _obs_metrics.counter(
+    "serve_batches_total", "coalesced batches dispatched")
+_REQUESTS_TOTAL = _obs_metrics.counter(
+    "serve_requests_total", "requests submitted to dynamic batchers")
+
+
+class ServeFuture:
+    """Per-caller handle for one submitted request.
+
+    Single-writer (the dispatcher resolves it exactly once); readers
+    synchronize through the event, so result/exception fields need no
+    extra lock."""
+
+    __slots__ = ("_event", "_result", "_exc", "_t_enq", "_t_resolved")
+
+    def __init__(self):
+        self._event = _san.event()
+        self._result = None
+        self._exc = None
+        self._t_enq = _time.monotonic()
+        self._t_resolved = None
+
+    def done(self):
+        return self._event.is_set()
+
+    def result(self, timeout=None):
+        """The request's outputs as a list of host numpy arrays (rows
+        = what was submitted) — results cross the service boundary, so
+        the batcher reads each batch back once and hands out row
+        views.  Blocks up to *timeout* seconds; raises the dispatch
+        error if the batch failed."""
+        if not self._event.wait(timeout):
+            raise TimeoutError("serve request still pending after %ss"
+                               % timeout)
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+    def _resolve(self, result=None, exc=None):
+        if self._event.is_set():
+            return
+        self._result = result
+        self._exc = exc
+        self._t_resolved = _time.monotonic()
+        _REQUEST_SECONDS.observe(self._t_resolved - self._t_enq)
+        self._event.set()
+
+
+class _Request:
+    __slots__ = ("data", "rows", "future")
+
+    def __init__(self, data, rows, future):
+        self.data = data
+        self.rows = rows
+        self.future = future
+
+
+class DynamicBatcher:
+    """Continuous/dynamic request batching in front of one
+    :class:`~mxnet_tpu.serve.predictor.CompiledPredictor`.
+
+    Parameters
+    ----------
+    predictor : CompiledPredictor
+    max_wait_ms : float, optional
+        How long the dispatcher holds a non-full batch open for more
+        arrivals, measured from the OLDEST queued request (default:
+        the ``MXNET_SERVE_MAX_WAIT_MS`` knob).
+    max_batch : int, optional
+        Coalescing cap in rows (default: the ``MXNET_SERVE_MAX_BATCH``
+        knob, 0 = the ladder's top rung).
+    """
+
+    def __init__(self, predictor, max_wait_ms=None, max_batch=None,
+                 name=None):
+        from ..config import get_env
+        self._predictor = predictor
+        self.name = name or predictor.name
+        if max_wait_ms is None:
+            max_wait_ms = get_env("MXNET_SERVE_MAX_WAIT_MS")
+        self._max_wait = max(0.0, float(max_wait_ms)) / 1e3
+        if max_batch is None:
+            max_batch = get_env("MXNET_SERVE_MAX_BATCH")
+        self._max_batch = int(max_batch) or predictor.ladder.max_batch
+        if self._max_batch > predictor.ladder.max_batch:
+            raise ServeError(
+                "max_batch %d exceeds the ladder's top rung %d"
+                % (self._max_batch, predictor.ladder.max_batch))
+        fixed = set(predictor._data_shapes) - predictor._bucket_inputs
+        if fixed:
+            raise ServeError(
+                "model %r has fixed-shape inputs %s — dynamic batching "
+                "concatenates every input along the batch axis; call "
+                "predictor.predict directly"
+                % (predictor.name, sorted(fixed)))
+        self._lock = _san.lock(label="serve.batcher.%s" % self.name)
+        self._cond = _san.condition(self._lock,
+                                    label="serve.batcher.%s" % self.name)
+        self._pending = collections.deque()
+        self._rows_pending = 0
+        self._stopped = False
+        self._batches = 0
+        self._requests = 0
+        self._thread = _san.thread(
+            target=self._loop, name="serve-batcher-%s" % self.name,
+            daemon=True)
+        _san.track(self, ("_pending", "_rows_pending", "_stopped",
+                          "_batches", "_requests"),
+                   label="serve.batcher.%s" % self.name)
+        self._thread.start()
+
+    # -- stats -------------------------------------------------------------
+    @property
+    def batch_count(self):
+        with self._lock:
+            return self._batches
+
+    @property
+    def request_count(self):
+        with self._lock:
+            return self._requests
+
+    # -- client side -------------------------------------------------------
+    def submit(self, data):
+        """Queue one request ({input: array}, or a bare array for
+        single-input models; arrays may be single examples or small
+        row batches up to the coalescing cap).  Returns a
+        :class:`ServeFuture`."""
+        pred = self._predictor
+        if not isinstance(data, dict):
+            if len(pred._data_shapes) != 1:
+                raise ServeError(
+                    "model %r has %d inputs — submit a dict"
+                    % (pred.name, len(pred._data_shapes)))
+            data = {next(iter(pred._data_shapes)): data}
+        arrays = {}
+        rows = None
+        from .predictor import _as_jnp
+        for n, spec in pred._data_shapes.items():
+            if n not in data:
+                raise ServeError("request is missing input %r" % n)
+            a = _as_jnp(data[n])
+            if a.ndim == len(spec) - 1:
+                a = a[None]
+            if a.ndim != len(spec):
+                raise ServeError(
+                    "input %r: rank %d does not match the bound "
+                    "example rank %d" % (n, a.ndim, len(spec)))
+            if rows is None:
+                rows = a.shape[0]
+            elif a.shape[0] != rows:
+                raise ServeError("request inputs disagree on rows "
+                                 "(%d vs %d)" % (a.shape[0], rows))
+            arrays[n] = a
+        if rows < 1:
+            raise ServeError("request has no rows")
+        if rows > self._max_batch:
+            raise ServeError(
+                "request of %d rows exceeds the batcher cap %d — "
+                "split it, or call predictor.predict directly"
+                % (rows, self._max_batch))
+        fut = ServeFuture()
+        with self._lock:
+            if self._stopped:
+                raise ServeError("batcher %r is closed" % self.name)
+            self._pending.append(_Request(arrays, rows, fut))
+            self._rows_pending += rows
+            self._requests += 1
+            # delta accounting: the gauge aggregates across batchers
+            _QUEUE_DEPTH.inc()
+            self._cond.notify()
+        _REQUESTS_TOTAL.inc()
+        return fut
+
+    def __call__(self, data, timeout=None):
+        """Synchronous convenience: submit + wait."""
+        return self.submit(data).result(timeout)
+
+    # -- dispatcher --------------------------------------------------------
+    def _take_locked(self):
+        """Pop the next coalesced group (caller holds the lock)."""
+        taken = []
+        rows = 0
+        while self._pending and \
+                rows + self._pending[0].rows <= self._max_batch:
+            req = self._pending.popleft()
+            # both callers hold self._lock (submit-side writes do too)
+            self._rows_pending -= req.rows  # graftlint: disable=JG010
+            rows += req.rows
+            taken.append(req)
+        if taken:
+            _QUEUE_DEPTH.dec(len(taken))
+        return taken, rows
+
+    def _loop(self):
+        import numpy as np
+        pred = self._predictor
+        while True:
+            with self._cond:
+                while not self._pending and not self._stopped:
+                    self._cond.wait()
+                if self._stopped and not self._pending:
+                    return
+                # hold the batch open for late arrivals until either
+                # the rows fill the cap or the OLDEST request's
+                # deadline passes (monotonic clock only)
+                deadline = self._pending[0].future._t_enq + \
+                    self._max_wait
+                while self._rows_pending < self._max_batch and \
+                        not self._stopped:
+                    remaining = deadline - _time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(timeout=remaining)
+                    if not self._pending:
+                        break
+                taken, rows = self._take_locked()
+            if not taken:
+                continue
+            try:
+                stacked = {
+                    n: np.concatenate([r.data[n] for r in taken], axis=0)
+                    if len(taken) > 1 else taken[0].data[n]
+                    for n in pred._data_shapes}
+                outs = pred.predict(stacked)
+                # ONE device->host readback per coalesced batch; the
+                # per-caller row splits below are numpy views.  (Lazy
+                # per-request device slices would dispatch — and on
+                # first use COMPILE — a tiny XLA program per distinct
+                # row range; results are leaving the process anyway.)
+                host = [np.asarray(o._data) for o in outs]
+                # count successful dispatches only, in lockstep with
+                # the serve_batches_total instrument
+                with self._lock:
+                    self._batches += 1
+                _BATCHES_TOTAL.inc()
+                _BATCH_OCCUPANCY.observe(
+                    rows / float(pred.ladder.batch_for(rows)))
+                lo = 0
+                for req in taken:
+                    hi = lo + req.rows
+                    req.future._resolve(result=[
+                        h[lo:hi] if h.ndim and h.shape[0] == rows
+                        else h for h in host])
+                    lo = hi
+            except Exception as exc:
+                for req in taken:
+                    req.future._resolve(exc=exc)
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self, timeout=5.0):
+        """Stop the dispatcher.  Queued-but-undispatched requests fail
+        with a :class:`ServeError`; the in-flight batch (if any)
+        completes."""
+        with self._lock:
+            if self._stopped:
+                return
+            self._stopped = True
+            orphans = list(self._pending)
+            self._pending.clear()
+            self._rows_pending = 0
+            if orphans:
+                _QUEUE_DEPTH.dec(len(orphans))
+            self._cond.notify_all()
+        for req in orphans:
+            req.future._resolve(
+                exc=ServeError("batcher %r closed before dispatch"
+                               % self.name))
+        self._thread.join(timeout)

@@ -1,0 +1,129 @@
+"""Padding-bucket ladder — the static-shape contract of the serving path.
+
+XLA programs are shape-specialized: every distinct input shape is a
+fresh trace + compile, and a compile in the request path is a latency
+cliff three orders of magnitude above a dispatch.  The serving
+subsystem therefore never runs a request at its natural shape — it
+pads up to the nearest rung of a small, finite ladder of shapes, each
+of which has an AOT-compiled program (see predictor.py).  This is the
+CUDA-graph-bucket idea of the "Hybrid JIT-CUDA Graph Optimization for
+Low-Latency LLM Inference" paper applied at the XLA level: capture a
+handful of programs once, route every request through one of them.
+
+Two padding dimensions:
+
+* **batch** — rung ladder, default powers of two (``1,2,4,...,32``);
+  a request of n rows runs at the smallest rung >= n, extra rows are
+  zero-padding that the caller trims off (mask-off semantics);
+* **sequence-style axes** — any non-batch axis can carry a round-up
+  rule (``seq_axes={1: 64}``: axis 1 rounds up to the next multiple
+  of 64), bounding the program count for variable-length inputs.
+
+The ladder is deliberately dumb and explicit: ``batch_for(n)`` and
+``pad_shape(shape)`` are pure functions of the configuration, so the
+set of programs a model can ever compile is enumerable up front —
+that is what makes one-compile-per-bucket assertable in CI
+(ci/serve_smoke.py).
+"""
+
+from __future__ import annotations
+
+__all__ = ["BucketLadder", "ServeError"]
+
+
+class ServeError(RuntimeError):
+    """Typed failure of the serving subsystem (bad shapes, closed
+    batchers, unknown models)."""
+
+
+#: default batch rungs: powers of two through 32
+DEFAULT_BATCHES = (1, 2, 4, 8, 16, 32)
+
+
+class BucketLadder:
+    """The finite set of padded shapes the serving path may run at.
+
+    Parameters
+    ----------
+    batches : sequence of int
+        Batch rungs, ascending after dedup.  A request of n rows maps
+        to the smallest rung >= n; n larger than the top rung is the
+        caller's problem (the batcher splits, direct callers get a
+        :class:`ServeError`).
+    seq_axes : dict axis -> multiple, optional
+        Non-batch axes rounded UP to the next multiple.  Axis numbers
+        are into the full input shape (batch is axis 0, so the first
+        sequence-ish axis is 1).
+    seq_max : dict axis -> cap, optional
+        Hard upper bound per rounded axis — a longer input raises
+        instead of compiling an unplanned program.
+    """
+
+    def __init__(self, batches=DEFAULT_BATCHES, seq_axes=None,
+                 seq_max=None):
+        rungs = sorted({int(b) for b in batches})
+        if not rungs or rungs[0] < 1:
+            raise ServeError("bucket ladder needs positive batch rungs, "
+                             "got %r" % (batches,))
+        self.batches = tuple(rungs)
+        self.seq_axes = {int(a): int(m)
+                         for a, m in (seq_axes or {}).items()}
+        for a, m in self.seq_axes.items():
+            if a == 0 or m < 1:
+                raise ServeError(
+                    "seq_axes rounds non-batch axes up to a positive "
+                    "multiple (got axis %d multiple %d)" % (a, m))
+        self.seq_max = {int(a): int(m) for a, m in (seq_max or {}).items()}
+
+    @property
+    def max_batch(self):
+        return self.batches[-1]
+
+    def batch_for(self, n):
+        """Smallest batch rung >= *n*."""
+        n = int(n)
+        if n < 1:
+            raise ServeError("batch size must be >= 1, got %d" % n)
+        for b in self.batches:
+            if b >= n:
+                return b
+        raise ServeError(
+            "request batch %d exceeds the ladder's top rung %d — split "
+            "the request or extend the ladder" % (n, self.max_batch))
+
+    def round_axis(self, axis, size):
+        """*size* rounded up per this ladder's rule for *axis* (identity
+        when the axis carries no rule)."""
+        mult = self.seq_axes.get(int(axis))
+        if mult is None:
+            return int(size)
+        rounded = ((int(size) + mult - 1) // mult) * mult
+        cap = self.seq_max.get(int(axis))
+        if cap is not None and rounded > cap:
+            raise ServeError(
+                "axis %d size %d rounds to %d, over the ladder cap %d"
+                % (axis, size, rounded, cap))
+        return rounded
+
+    def pad_shape(self, shape):
+        """The bucketed (padded) full shape for a natural input
+        *shape*: batch to its rung, rounded axes up to their multiple,
+        everything else unchanged."""
+        shape = tuple(int(s) for s in shape)
+        if not shape:
+            return shape
+        out = [self.batch_for(shape[0])]
+        for ax in range(1, len(shape)):
+            out.append(self.round_axis(ax, shape[ax]))
+        return tuple(out)
+
+    def bucket_key(self, shapes):
+        """Canonical hashable key for a {name: padded_shape} dict —
+        what the predictor's program cache is keyed on."""
+        return tuple(sorted((n, tuple(s)) for n, s in shapes.items()))
+
+    def __repr__(self):
+        extra = ""
+        if self.seq_axes:
+            extra = ", seq_axes=%r" % (self.seq_axes,)
+        return "BucketLadder(batches=%r%s)" % (list(self.batches), extra)

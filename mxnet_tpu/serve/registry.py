@@ -1,0 +1,198 @@
+"""ModelRegistry — multi-model serving with a warm compiled-program cache.
+
+The registry is the process's serving control plane (the TVM lesson:
+compiled programs are first-class, keyed artifacts, not an implicit
+jit side effect):
+
+* ``load`` builds a :class:`CompiledPredictor` and — by default —
+  warms every bucket program up front, so the first request is as fast
+  as the thousandth;
+* ``alias`` gives one compiled model several routable names
+  (``"resnet" -> "resnet-v3"`` style traffic cutovers without a
+  recompile);
+* ``unload`` tears the model, its aliases and its batcher down;
+* ``batcher``/``submit`` attach the dynamic batcher to a model by
+  name.
+
+Every load/unload/alias is a ``serve`` event, every program build is
+counted and blamed (see predictor.py), and the C predict ABI
+(capi_bridge.py) is a thin client of the process-wide
+:func:`c_registry` instance.
+"""
+
+from __future__ import annotations
+
+from .batcher import DynamicBatcher
+from .buckets import BucketLadder, ServeError
+from .predictor import CompiledPredictor
+from .. import sanitizer as _san
+from ..observability import events as _obs_events
+from ..observability import metrics as _obs_metrics
+
+__all__ = ["ModelRegistry", "c_registry"]
+
+_MODELS_GAUGE = _obs_metrics.gauge(
+    "serve_models_loaded",
+    "models resident across all serve registries (delta-maintained)")
+
+
+class ModelRegistry:
+    """Named, warm-cached compiled models."""
+
+    def __init__(self):
+        self._lock = _san.rlock(label="serve.registry")
+        self._models = {}     # name -> CompiledPredictor
+        self._aliases = {}    # alias -> canonical name
+        self._batchers = {}   # canonical name -> DynamicBatcher
+        _san.track(self, ("_models", "_aliases", "_batchers"),
+                   label="serve.registry")
+
+    # -- loading -----------------------------------------------------------
+    def load(self, name, symbol, arg_params, aux_params=None,
+             data_shapes=None, ladder=None, data_dtypes=None, ctx=None,
+             warm=True, bucket_inputs=None):
+        """Register and (by default) warm-compile a model.  Returns
+        the :class:`CompiledPredictor`.  Re-loading a live name
+        replaces it atomically (aliases keep pointing at the name)."""
+
+        def _check_not_alias():
+            if name in self._aliases:
+                raise ServeError(
+                    "%r is an alias (for %r) — unalias it before "
+                    "loading a model under that name"
+                    % (name, self._aliases[name]))
+
+        with self._lock:
+            _check_not_alias()      # before paying the warm compiles
+        pred = CompiledPredictor(
+            symbol, arg_params, aux_params=aux_params,
+            data_shapes=data_shapes, ladder=ladder,
+            data_dtypes=data_dtypes, ctx=ctx, name=name,
+            bucket_inputs=bucket_inputs)
+        built = pred.warm() if warm else 0
+        with self._lock:
+            _check_not_alias()      # racing alias() may have won
+            old_batcher = self._batchers.pop(name, None)
+            if name not in self._models:
+                _MODELS_GAUGE.inc()  # delta: aggregates across registries
+            self._models[name] = pred
+        if old_batcher is not None:
+            old_batcher.close()
+        _obs_events.emit("serve", kind="load", model=name,
+                         programs=built, warm=bool(warm),
+                         buckets=list(pred.ladder.batches))
+        return pred
+
+    def load_checkpoint(self, name, prefix, epoch, data_shapes,
+                        **kwargs):
+        """Load a reference-layout checkpoint (``prefix-symbol.json`` +
+        ``prefix-NNNN.params``) straight into the registry."""
+        from ..model import load_checkpoint
+        sym, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return self.load(name, sym, arg_params, aux_params=aux_params,
+                         data_shapes=data_shapes, **kwargs)
+
+    # -- naming ------------------------------------------------------------
+    def _resolve(self, name):
+        return self._aliases.get(name, name)
+
+    def get(self, name):
+        """The predictor for *name* (aliases resolved)."""
+        with self._lock:
+            pred = self._models.get(self._resolve(name))
+        if pred is None:
+            raise ServeError("no model %r is loaded (have %s)"
+                             % (name, self.names()))
+        return pred
+
+    def alias(self, alias, name):
+        """Route *alias* to model *name* (repoint allowed — this is
+        the traffic-cutover primitive)."""
+        with self._lock:
+            target = self._resolve(name)
+            if target not in self._models:
+                raise ServeError("cannot alias %r to unknown model %r"
+                                 % (alias, name))
+            if alias in self._models:
+                raise ServeError(
+                    "%r names a loaded model — unload it before "
+                    "turning the name into an alias" % alias)
+            self._aliases[alias] = target
+        _obs_events.emit("serve", kind="alias", alias=alias,
+                         model=target)
+
+    def unload(self, name):
+        """Drop a model (or just an alias).  Unloading a model also
+        drops every alias pointing at it and closes its batcher."""
+        with self._lock:
+            if name in self._aliases and name not in self._models:
+                del self._aliases[name]
+                _obs_events.emit("serve", kind="unalias", alias=name)
+                return
+            if name not in self._models:
+                raise ServeError("no model %r to unload" % name)
+            del self._models[name]
+            dropped = [a for a, t in self._aliases.items() if t == name]
+            for a in dropped:
+                del self._aliases[a]
+            batcher = self._batchers.pop(name, None)
+            _MODELS_GAUGE.dec()
+        if batcher is not None:
+            batcher.close()
+        _obs_events.emit("serve", kind="unload", model=name,
+                         aliases_dropped=dropped)
+
+    def names(self):
+        with self._lock:
+            return sorted(self._models)
+
+    def aliases(self):
+        with self._lock:
+            return dict(self._aliases)
+
+    # -- request routing ---------------------------------------------------
+    def batcher(self, name, **kwargs):
+        """Get-or-create the dynamic batcher for a model (aliases
+        resolved; knob overrides only apply on creation)."""
+        with self._lock:
+            target = self._resolve(name)
+            if target not in self._models:
+                raise ServeError("no model %r is loaded" % name)
+            b = self._batchers.get(target)
+            if b is None:
+                b = DynamicBatcher(self._models[target], name=target,
+                                   **kwargs)
+                self._batchers[target] = b
+            return b
+
+    def submit(self, name, data):
+        """Submit one request to *name*'s dynamic batcher; returns a
+        :class:`~mxnet_tpu.serve.batcher.ServeFuture`."""
+        return self.batcher(name).submit(data)
+
+    def predict(self, name, data, key=None):
+        """Direct (unbatched) predict on *name* — bypasses the
+        batcher; still padded-bucket, still AOT."""
+        return self.get(name).predict(data, key=key)
+
+    def close(self):
+        """Unload everything (batchers closed, futures failed)."""
+        for name in self.names():
+            self.unload(name)
+
+
+# -- process-wide registry behind the C predict ABI --------------------------
+
+_c_registry = None
+_c_registry_lock = _san.lock(label="serve.c_registry")
+
+
+def c_registry():
+    """The process-wide registry the C-ABI predict surface
+    (capi_bridge.py MXPredCreate*) routes through."""
+    global _c_registry
+    if _c_registry is None:
+        with _c_registry_lock:
+            if _c_registry is None:
+                _c_registry = ModelRegistry()
+    return _c_registry

@@ -1,0 +1,686 @@
+"""mxnet_tpu.serve — compiled inference subsystem tests.
+
+Covers the bucket ladder, AOT-per-bucket CompiledPredictor (padded
+outputs bit-equal to unpadded eager predict, fp32 + bf16; pad
+invariance; one-compile-per-bucket pinning), the donated KV-cache
+decode path, the dynamic batcher's coalescing/deadline/error/close
+semantics, the multi-model registry, the C-ABI thin client and the
+persistent-compilation-cache knob."""
+
+import os
+import threading
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import serve, sym
+from mxnet_tpu.serve import (BucketLadder, CompiledPredictor,
+                             DynamicBatcher, ModelRegistry, ServeError,
+                             ServeFuture)
+
+
+def _mlp(dim=12, hidden=32, classes=4, batchnorm=False):
+    data = sym.var("data")
+    net = sym.FullyConnected(data, num_hidden=hidden, name="h")
+    net = sym.Activation(net, act_type="relu")
+    if batchnorm:
+        net = sym.BatchNorm(net, name="bn")
+    net = sym.FullyConnected(net, num_hidden=classes, name="o")
+    return sym.softmax(net)
+
+
+def _params_for(net, dim, dtype="float32", seed=0, batch=1):
+    rs = np.random.RandomState(seed)
+    arg_shapes, _, aux_shapes = net.infer_shape(data=(batch, dim))
+    params = {n: mx.nd.array(rs.randn(*s).astype(np.float32) * 0.1)
+              .astype(dtype)
+              for n, s in zip(net.list_arguments(), arg_shapes)
+              if n != "data"}
+    aux = {n: mx.nd.array(np.abs(rs.randn(*s)).astype(np.float32))
+           .astype(dtype)
+           for n, s in zip(net.list_auxiliary_states(), aux_shapes)}
+    return params, aux
+
+
+def _eager(net, params, aux, x_nd):
+    args = dict(params)
+    args["data"] = x_nd
+    ex = net.bind(mx.cpu(), args, aux_states=aux or None)
+    return ex.forward()[0]
+
+
+# ---------------------------------------------------------------------------
+# bucket ladder
+# ---------------------------------------------------------------------------
+
+class TestBucketLadder:
+    def test_batch_for(self):
+        lad = BucketLadder(batches=(1, 2, 4, 8))
+        assert [lad.batch_for(n) for n in (1, 2, 3, 5, 8)] == \
+            [1, 2, 4, 8, 8]
+
+    def test_batch_over_top_rung_raises(self):
+        with pytest.raises(ServeError, match="top rung"):
+            BucketLadder(batches=(1, 2)).batch_for(3)
+
+    def test_pad_shape_rounds_seq_axes(self):
+        lad = BucketLadder(batches=(2, 4), seq_axes={1: 16})
+        assert lad.pad_shape((3, 17, 5)) == (4, 32, 5)
+        assert lad.pad_shape((2, 16, 5)) == (2, 16, 5)
+
+    def test_seq_max_cap(self):
+        lad = BucketLadder(batches=(1,), seq_axes={1: 8},
+                           seq_max={1: 16})
+        assert lad.pad_shape((1, 9)) == (1, 16)
+        with pytest.raises(ServeError, match="cap"):
+            lad.pad_shape((1, 17))
+
+    def test_bad_config_raises(self):
+        with pytest.raises(ServeError):
+            BucketLadder(batches=())
+        with pytest.raises(ServeError):
+            BucketLadder(batches=(0, 2))
+        with pytest.raises(ServeError):
+            BucketLadder(seq_axes={0: 8})
+
+    def test_bucket_key_canonical(self):
+        lad = BucketLadder()
+        k1 = lad.bucket_key({"a": (1, 2), "b": (1, 3)})
+        k2 = lad.bucket_key({"b": (1, 3), "a": (1, 2)})
+        assert k1 == k2 and hash(k1) == hash(k2)
+
+
+# ---------------------------------------------------------------------------
+# compiled predictor — bucketing correctness
+# ---------------------------------------------------------------------------
+
+class TestCompiledPredictor:
+    @pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+    @pytest.mark.parametrize("n", [1, 2, 3, 5, 7, 8])
+    def test_padded_bit_equal_unpadded_eager(self, dtype, n):
+        """The tentpole contract: predict on inputs padded up to the
+        bucket is BIT-identical to the unpadded eager forward at the
+        natural batch — across dtypes, through BatchNorm aux."""
+        import jax.numpy as jnp
+        net = _mlp(batchnorm=True)
+        params, aux = _params_for(net, 12, dtype=dtype)
+        pred = CompiledPredictor(
+            net, params, aux_params=aux, data_shapes={"data": (1, 12)},
+            ladder=BucketLadder(batches=(1, 2, 4, 8)),
+            data_dtypes={"data": dtype})
+        rs = np.random.RandomState(n)
+        x = jnp.asarray(rs.randn(n, 12).astype(np.float32)).astype(dtype)
+        ref = _eager(net, params, aux, mx.nd.NDArray(x))
+        out = pred.predict(np.asarray(x))[0]
+        assert tuple(out.shape) == tuple(ref.shape)
+        assert bool(jnp.array_equal(out._data, ref._data))
+
+    def test_pad_invariance(self):
+        """Mask-off is exact: the co-batch content (zero padding vs
+        other requests' garbage rows) cannot change a row's result at
+        a fixed bucket."""
+        net = _mlp()
+        params, aux = _params_for(net, 12)
+        pred = CompiledPredictor(
+            net, params, aux_params=aux, data_shapes={"data": (1, 12)},
+            ladder=BucketLadder(batches=(8,)))
+        rs = np.random.RandomState(3)
+        x = rs.randn(3, 12).astype(np.float32)
+        alone = pred.predict(x)[0].asnumpy()
+        stacked = np.concatenate(
+            [x, 100.0 * rs.randn(5, 12).astype(np.float32)], axis=0)
+        together = pred.predict(stacked)[0].asnumpy()[:3]
+        assert np.array_equal(alone, together)
+
+    def test_one_compile_per_bucket_pinned(self):
+        net = _mlp()
+        params, aux = _params_for(net, 12)
+        pred = CompiledPredictor(
+            net, params, aux_params=aux, data_shapes={"data": (1, 12)},
+            ladder=BucketLadder(batches=(1, 2, 4)))
+        assert pred.warm() == 3
+        assert pred.compile_count == 3
+        rs = np.random.RandomState(0)
+        for n in (1, 2, 3, 4, 1, 3, 2, 4):
+            pred.predict(rs.randn(n, 12).astype(np.float32))
+        assert pred.compile_count == 3          # request path never compiles
+        assert pred.jit_cache_size() == 0       # nothing ever traced a call
+        assert pred.dispatch_count == 8
+
+    def test_unplanned_seq_shape_compiles_once_on_demand(self):
+        net = _mlp()
+        params, aux = _params_for(net, 12)
+        # no warm: every bucket is demand-compiled, but only ONCE each
+        pred = CompiledPredictor(
+            net, params, aux_params=aux, data_shapes={"data": (1, 12)},
+            ladder=BucketLadder(batches=(2,)))
+        rs = np.random.RandomState(0)
+        pred.predict(rs.randn(2, 12).astype(np.float32))
+        pred.predict(rs.randn(1, 12).astype(np.float32))
+        assert pred.compile_count == 1
+
+    def test_seq_axis_bucketing(self):
+        """Variable-length axis rounds to its multiple; the padded
+        program is bit-identical to the eager forward of the same
+        zero-padded input (zero rows are identity for sum-of-relu —
+        only the numerically-equivalent reduction order could differ,
+        and it must not), values match numpy up to float reassociation,
+        and the program count is one per (batch, seq) bucket."""
+        data = sym.var("data")
+        net = sym.sum(sym.Activation(data, act_type="relu"), axis=1)
+        lad = BucketLadder(batches=(2,), seq_axes={1: 4})
+        pred = CompiledPredictor(
+            net, {}, data_shapes={"data": (1, 4, 6)}, ladder=lad)
+        rs = np.random.RandomState(0)
+        for seq in (3, 4, 6, 7):
+            x = rs.randn(2, seq, 6).astype(np.float32)
+            out = pred.predict(x)[0].asnumpy()
+            buf = np.zeros((2, lad.round_axis(1, seq), 6), np.float32)
+            buf[:, :seq] = x
+            ref = _eager(net, {}, {}, mx.nd.array(buf)).asnumpy()
+            assert np.array_equal(out, ref)
+            assert np.allclose(out, np.maximum(x, 0).sum(axis=1),
+                               rtol=1e-6, atol=1e-6)
+        # seq 3,4 -> bucket 4; seq 6,7 -> bucket 8: two programs
+        assert pred.compile_count == 2
+
+    def test_input_validation(self):
+        net = _mlp()
+        params, aux = _params_for(net, 12)
+        pred = CompiledPredictor(
+            net, params, aux_params=aux, data_shapes={"data": (1, 12)},
+            ladder=BucketLadder(batches=(2,)))
+        with pytest.raises(ServeError, match="rank"):
+            pred.predict(np.zeros((1, 1, 12), np.float32))
+        with pytest.raises(ServeError, match="top rung"):
+            pred.predict(np.zeros((3, 12), np.float32))
+        single = pred.predict(np.zeros((12,), np.float32))[0]
+        assert single.shape == (1, 4)           # example -> batch of 1
+
+    def test_fixed_shape_inputs_not_bucketed(self):
+        """bucket_inputs: inputs left out are fixed-shape — no batch
+        padding, exact-match enforced — so multi-input models whose
+        inputs do not share a leading dim still serve (the C-ABI
+        client's contract)."""
+        data = sym.var("data")
+        scale = sym.var("scale")
+        net = sym.broadcast_mul(data, scale)
+        pred = CompiledPredictor(
+            net, {}, data_shapes={"data": (1, 4), "scale": (1, 4)},
+            ladder=BucketLadder(batches=(1, 2, 4)),
+            bucket_inputs=("data",))
+        rs = np.random.RandomState(0)
+        x = rs.randn(3, 4).astype(np.float32)
+        s = rs.randn(1, 4).astype(np.float32)
+        out = pred.predict({"data": x, "scale": s})[0].asnumpy()
+        assert out.shape == (3, 4)              # trimmed from rung 4
+        assert np.array_equal(out, x * s)
+        assert pred.compile_count == 1
+        with pytest.raises(ServeError, match="fixed-shape"):
+            pred.predict({"data": x,
+                          "scale": np.ones((2, 4), np.float32)})
+        with pytest.raises(ServeError, match="fixed-shape"):
+            DynamicBatcher(pred)                # cannot coalesce these
+        with pytest.raises(ServeError, match="not data inputs"):
+            CompiledPredictor(
+                net, {}, data_shapes={"data": (1, 4), "scale": (1, 4)},
+                bucket_inputs=("ghost",))
+
+    def test_missing_param_raises(self):
+        net = _mlp()
+        with pytest.raises(ServeError, match="neither data inputs"):
+            CompiledPredictor(net, {}, data_shapes={"data": (1, 12)})
+
+    def test_set_params_refreshes_without_recompile(self):
+        import jax.numpy as jnp
+        net = _mlp()
+        params, aux = _params_for(net, 12)
+        pred = CompiledPredictor(
+            net, params, aux_params=aux, data_shapes={"data": (1, 12)},
+            ladder=BucketLadder(batches=(2,)))
+        pred.warm()
+        x = np.ones((2, 12), np.float32)
+        before = pred.predict(x)[0].asnumpy()
+        params2, _ = _params_for(net, 12, seed=9)
+        pred.set_params(params2)
+        after = pred.predict(x)[0].asnumpy()
+        assert pred.compile_count == 1
+        assert not np.array_equal(before, after)
+        ref = _eager(net, params2, aux, mx.nd.array(x))
+        assert bool(jnp.array_equal(pred.predict(x)[0]._data, ref._data))
+        with pytest.raises(ServeError, match="shape-specialized"):
+            pred.set_params({"h_weight": mx.nd.zeros((2, 2))})
+
+
+# ---------------------------------------------------------------------------
+# donated decode
+# ---------------------------------------------------------------------------
+
+def _decode_pred():
+    net = _mlp()
+    params, aux = _params_for(net, 12)
+    return CompiledPredictor(
+        net, params, aux_params=aux, data_shapes={"data": (1, 12)},
+        ladder=BucketLadder(batches=(1,)))
+
+
+def _append_step(p, cache, inputs, t):
+    """Toy KV-cache decode: write this step's token column, emit the
+    running row sums."""
+    import jax
+    import jax.numpy as jnp
+    new = jax.lax.dynamic_update_slice(
+        cache["kv"], inputs["tok"][:, None], (0, t))
+    return jnp.sum(new, axis=1), {"kv": new}
+
+
+class TestDecode:
+    def test_decode_matches_eager_loop_cache_never_copied(self):
+        import jax.numpy as jnp
+        pred = _decode_pred()
+        steps = 6
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")     # cpu ignores donation
+            sess = pred.make_decoder(
+                _append_step, {"kv": jnp.zeros((2, steps), jnp.float32)},
+                {"tok": (2,)}, donate=True)
+            compiles = pred.compile_count
+            ref = np.zeros((2, steps), np.float32)
+            for t in range(steps):
+                tok = np.full((2,), float(t + 1), np.float32)
+                out = np.asarray(sess.step({"tok": tok}))
+                ref[:, t] = tok
+                assert np.array_equal(out, ref.sum(axis=1))
+        assert sess.step_count == steps
+        assert pred.compile_count == compiles   # one program, N steps
+        assert np.array_equal(np.asarray(sess.cache["kv"]), ref)
+
+    def test_decode_donation_declared_in_program(self):
+        import jax.numpy as jnp
+        pred = _decode_pred()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            sess = pred.make_decoder(
+                _append_step, {"kv": jnp.zeros((1, 4), jnp.float32)},
+                {"tok": (1,)}, donate=True)
+        txt = sess.lowered_text()
+        assert "jax.buffer_donor" in txt or "tf.aliasing_output" in txt
+        sess_off = pred.make_decoder(
+            _append_step, {"kv": jnp.zeros((1, 4), jnp.float32)},
+            {"tok": (1,)}, donate=False)
+        txt_off = sess_off.lowered_text()
+        assert "jax.buffer_donor" not in txt_off
+
+    def test_decode_stale_cache_alias_poisoned(self, monkeypatch):
+        """The fused-step donation discipline applies: with the
+        graftsan donation component on, an NDArray still aliasing a
+        donated cache buffer raises at the touch site."""
+        import jax.numpy as jnp
+        from tools.graftsan.donation import UseAfterDonateError
+        import tools.graftsan as graftsan
+        pred = _decode_pred()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            sess = pred.make_decoder(
+                _append_step, {"kv": jnp.zeros((1, 4), jnp.float32)},
+                {"tok": (1,)}, donate=True)
+            monkeypatch.setenv("MXNET_SAN", "donation")
+            stale = mx.nd.NDArray(sess.cache["kv"])
+            sess.step({"tok": np.ones((1,), np.float32)})
+            with pytest.raises(UseAfterDonateError):
+                stale.asnumpy()
+        # drop the deliberate report so later tests see a clean slate
+        graftsan.clear()
+
+    def test_decode_shape_validation(self):
+        import jax.numpy as jnp
+        pred = _decode_pred()
+        sess = pred.make_decoder(
+            _append_step, {"kv": jnp.zeros((1, 4), jnp.float32)},
+            {"tok": (1,)}, donate=False)
+        with pytest.raises(ServeError, match="fixed-shape"):
+            sess.step({"tok": np.ones((2,), np.float32)})
+        with pytest.raises(ServeError, match="missing input"):
+            sess.step({})
+
+
+# ---------------------------------------------------------------------------
+# dynamic batcher
+# ---------------------------------------------------------------------------
+
+def _batcher_pred(batches=(1, 2, 4, 8)):
+    net = _mlp()
+    params, aux = _params_for(net, 12)
+    pred = CompiledPredictor(
+        net, params, aux_params=aux, data_shapes={"data": (1, 12)},
+        ladder=BucketLadder(batches=batches))
+    pred.warm()
+    return net, params, aux, pred
+
+
+class TestDynamicBatcher:
+    def test_coalesces_and_splits_bit_exact(self):
+        net, params, aux, pred = _batcher_pred()
+        b = DynamicBatcher(pred, max_wait_ms=250)
+        try:
+            rs = np.random.RandomState(0)
+            xs = [rs.randn(n, 12).astype(np.float32) for n in (1, 2, 1)]
+            futs = [b.submit(x) for x in xs]
+            outs = [f.result(30)[0] for f in futs]
+            assert b.batch_count == 1           # one dispatch, 3 callers
+            # 4 rows coalesced -> rung 4: the exact reference is the
+            # eager forward of the stacked batch at that rung
+            stacked = np.concatenate(xs, axis=0)
+            ref = _eager(net, params, aux,
+                         mx.nd.array(stacked)).asnumpy()
+            got = np.concatenate(outs, axis=0)
+            assert np.array_equal(got, ref)
+        finally:
+            b.close()
+
+    def test_full_batch_dispatches_before_deadline(self):
+        _, _, _, pred = _batcher_pred(batches=(1, 2, 4))
+        b = DynamicBatcher(pred, max_wait_ms=30000, max_batch=4)
+        try:
+            t0 = time.monotonic()
+            fut = b.submit(np.zeros((4, 12), np.float32))
+            fut.result(10)
+            assert time.monotonic() - t0 < 5.0  # did not sit out 30s
+        finally:
+            b.close()
+
+    def test_single_request_resolves_after_deadline(self):
+        _, _, _, pred = _batcher_pred(batches=(1, 2))
+        b = DynamicBatcher(pred, max_wait_ms=50)
+        try:
+            out = b(np.zeros((1, 12), np.float32), timeout=10)
+            assert out[0].shape == (1, 4)
+        finally:
+            b.close()
+
+    def test_submit_validation(self):
+        _, _, _, pred = _batcher_pred(batches=(1, 2))
+        b = DynamicBatcher(pred, max_wait_ms=1)
+        try:
+            with pytest.raises(ServeError, match="cap"):
+                b.submit(np.zeros((3, 12), np.float32))
+            with pytest.raises(ServeError, match="rank"):
+                b.submit(np.zeros((1, 1, 12), np.float32))
+            with pytest.raises(ServeError, match="no rows"):
+                b.submit(np.zeros((0, 12), np.float32))
+        finally:
+            b.close()
+
+    def test_dispatch_error_fails_only_that_batch(self):
+        _, _, _, pred = _batcher_pred(batches=(1, 2))
+        b = DynamicBatcher(pred, max_wait_ms=20)
+        try:
+            real = pred.predict
+            boom = {"armed": True}
+
+            def flaky(data, key=None):
+                if boom.pop("armed", False):
+                    raise RuntimeError("injected dispatch failure")
+                return real(data, key=key)
+
+            pred.predict = flaky
+            with pytest.raises(RuntimeError, match="injected"):
+                b(np.zeros((1, 12), np.float32), timeout=10)
+            out = b(np.zeros((1, 12), np.float32), timeout=10)
+            assert out[0].shape == (1, 4)
+        finally:
+            pred.predict = real
+            b.close()
+
+    def test_close_fails_pending_and_rejects_new(self):
+        _, _, _, pred = _batcher_pred(batches=(1,))
+        b = DynamicBatcher(pred, max_wait_ms=60000, max_batch=1)
+        # saturate: first request dispatches, hold the queue with more
+        real = pred.predict
+
+        def slow(data, key=None):
+            time.sleep(0.2)
+            return real(data, key=key)
+
+        pred.predict = slow
+        try:
+            futs = [b.submit(np.zeros((1, 12), np.float32))
+                    for _ in range(3)]
+            b.close()
+            with pytest.raises(ServeError, match="closed"):
+                b.submit(np.zeros((1, 12), np.float32))
+            failures = 0
+            for f in futs:
+                try:
+                    f.result(10)
+                except ServeError:
+                    failures += 1
+            assert failures >= 1                # undispatched ones failed
+        finally:
+            pred.predict = real
+
+    def test_future_timeout(self):
+        fut = ServeFuture()
+        with pytest.raises(TimeoutError):
+            fut.result(0.05)
+
+    def test_metrics_accounting(self):
+        from mxnet_tpu.observability import metrics as obs_metrics
+        _, _, _, pred = _batcher_pred(batches=(1, 2))
+        b = DynamicBatcher(pred, max_wait_ms=10)
+        try:
+            before = obs_metrics.snapshot()
+            for _ in range(4):
+                b(np.zeros((1, 12), np.float32), timeout=10)
+            after = obs_metrics.snapshot()
+            delta = (after["serve_requests_total"]["value"]
+                     - before["serve_requests_total"]["value"])
+            assert delta == 4
+            assert after["serve_request_seconds"]["count"] >= \
+                before["serve_request_seconds"]["count"] + 4
+            assert after["serve_queue_depth"]["value"] == 0
+        finally:
+            b.close()
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+class TestModelRegistry:
+    def _load(self, reg, name, seed=0):
+        net = _mlp()
+        params, aux = _params_for(net, 12, seed=seed)
+        pred = reg.load(name, net, params, aux_params=aux,
+                        data_shapes={"data": (1, 12)},
+                        ladder=BucketLadder(batches=(1, 2)))
+        return net, params, aux, pred
+
+    def test_load_get_alias_unload(self):
+        reg = ModelRegistry()
+        try:
+            _, _, _, pred = self._load(reg, "m1")
+            assert reg.get("m1") is pred
+            reg.alias("prod", "m1")
+            assert reg.get("prod") is pred
+            self._load(reg, "m2", seed=5)
+            reg.alias("prod", "m2")             # traffic cutover
+            assert reg.get("prod") is reg.get("m2")
+            reg.unload("m2")
+            assert reg.names() == ["m1"]
+            with pytest.raises(ServeError, match="no model"):
+                reg.get("prod")                 # alias died with m2
+            with pytest.raises(ServeError, match="no model"):
+                reg.get("m2")
+        finally:
+            reg.close()
+
+    def test_alias_and_name_collisions(self):
+        reg = ModelRegistry()
+        try:
+            self._load(reg, "m1")
+            reg.alias("a", "m1")
+            with pytest.raises(ServeError, match="alias"):
+                self._load(reg, "a")
+            with pytest.raises(ServeError, match="unknown model"):
+                reg.alias("b", "ghost")
+            with pytest.raises(ServeError, match="loaded model"):
+                reg.alias("m1", "m1")
+            reg.unload("a")                     # unalias only
+            assert reg.names() == ["m1"]
+        finally:
+            reg.close()
+
+    def test_submit_routes_through_batcher_and_unload_closes(self):
+        reg = ModelRegistry()
+        try:
+            net, params, aux, _ = self._load(reg, "m1")
+            x = np.ones((1, 12), np.float32)
+            out = reg.submit("m1", x).result(10)[0]
+            ref = _eager(net, params, aux, mx.nd.array(x)).asnumpy()
+            assert np.array_equal(out, ref)
+            batcher = reg.batcher("m1")
+            reg.unload("m1")
+            with pytest.raises(ServeError, match="closed"):
+                batcher.submit(x)
+        finally:
+            reg.close()
+
+    def test_load_checkpoint(self, tmp_path):
+        from mxnet_tpu import model as model_mod
+        net = _mlp()
+        params, aux = _params_for(net, 12)
+        prefix = str(tmp_path / "ckpt")
+        model_mod.save_checkpoint(
+            prefix, 3, net,
+            {k: v for k, v in params.items()}, dict(aux))
+        reg = ModelRegistry()
+        try:
+            reg.load_checkpoint("ck", prefix, 3,
+                                data_shapes={"data": (1, 12)},
+                                ladder=BucketLadder(batches=(2,)))
+            x = np.ones((2, 12), np.float32)
+            out = reg.predict("ck", x)[0].asnumpy()
+            ref = _eager(net, params, aux, mx.nd.array(x)).asnumpy()
+            assert np.array_equal(out, ref)
+        finally:
+            reg.close()
+
+    def test_serve_events_emitted(self, tmp_path, monkeypatch):
+        from mxnet_tpu.observability import events as obs_events
+        monkeypatch.setenv("MXNET_OBS", "serve")
+        obs_events.configure(path=str(tmp_path / "events.jsonl"))
+        try:
+            reg = ModelRegistry()
+            self._load(reg, "evm")
+            reg.alias("ev-alias", "evm")
+            reg.unload("evm")
+            evs = obs_events.read_events()
+            kinds = [e.get("kind") for e in evs if e["ev"] == "serve"]
+            assert "load" in kinds and "alias" in kinds and \
+                "unload" in kinds
+            assert kinds.count("compile") == 2  # one per bucket rung
+        finally:
+            obs_events.configure()
+
+
+# ---------------------------------------------------------------------------
+# C-ABI thin client
+# ---------------------------------------------------------------------------
+
+class TestCApiBridgeServes:
+    def test_predictor_routes_through_registry(self):
+        from mxnet_tpu import capi_bridge
+        net = _mlp()
+        params, _ = _params_for(net, 12)
+        x = np.random.RandomState(0).randn(2, 12).astype(np.float32)
+        save = {"arg:%s" % k: v for k, v in params.items()}
+        param_bytes = mx.nd.save_bytes(save) \
+            if hasattr(mx.nd, "save_bytes") else None
+        if param_bytes is None:
+            import tempfile
+            with tempfile.NamedTemporaryFile(suffix=".params") as f:
+                mx.nd.save(f.name, save)
+                param_bytes = open(f.name, "rb").read()
+        handle = capi_bridge.create(net.tojson(), param_bytes, 1, 0,
+                                    ["data"], [(2, 12)])
+        reg = serve.c_registry()
+        assert handle._name in reg.names()
+        handle.set_input("data", x.astype(np.float32).tobytes(), (2, 12))
+        handle.forward()
+        got = np.frombuffer(handle.get_output(0),
+                            np.float32).reshape(handle.get_output_shape(0))
+        ref = _eager(net, params, {}, mx.nd.array(x)).asnumpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-7)
+        name = handle._name
+        handle.close()
+        assert name not in reg.names()
+        handle.close()                          # double free is safe
+
+    def test_multi_input_distinct_leading_dims(self):
+        """Reference bind semantics preserved: a C predictor whose
+        inputs do not share a leading dim (data batch 4, a (1, 6)
+        broadcast vector) still creates and forwards — the non-batch
+        input rides as fixed-shape outside the bucket ladder."""
+        from mxnet_tpu import capi_bridge
+        data = sym.var("data")
+        wvec = sym.var("wvec")
+        net = sym.broadcast_mul(data, wvec)
+        handle = capi_bridge.Predictor(net.tojson(), b"", 1, 0,
+                                       ["data", "wvec"],
+                                       [(4, 6), (1, 6)])
+        try:
+            rs = np.random.RandomState(1)
+            x = rs.randn(4, 6).astype(np.float32)
+            v = rs.randn(1, 6).astype(np.float32)
+            handle.set_input("data", x.tobytes(), (4, 6))
+            handle.set_input("wvec", v.tobytes(), (1, 6))
+            handle.forward()
+            got = np.frombuffer(handle.get_output(0), np.float32) \
+                .reshape(handle.get_output_shape(0))
+            assert np.array_equal(got, x * v)
+        finally:
+            handle.close()
+
+    def test_set_input_shape_mismatch_raises(self):
+        from mxnet_tpu import capi_bridge
+        net = _mlp()
+        params, _ = _params_for(net, 12)
+        handle = capi_bridge.Predictor(net.tojson(), b"", 1, 0,
+                                       ["data"], [(2, 12)])
+        try:
+            with pytest.raises(ValueError, match="shape-specialized"):
+                handle.set_input("data",
+                                 np.zeros((3, 12), np.float32).tobytes(),
+                                 (3, 12))
+        finally:
+            handle.close()
+
+
+# ---------------------------------------------------------------------------
+# persistent compilation cache knob
+# ---------------------------------------------------------------------------
+
+class TestCompileCacheKnob:
+    def test_env_knob_applies_and_restores(self, tmp_path, monkeypatch):
+        import jax
+        from mxnet_tpu import config
+        prior_dir = jax.config.jax_compilation_cache_dir
+        prior_min = jax.config.jax_persistent_cache_min_compile_time_secs
+        try:
+            monkeypatch.delenv("MXNET_COMPILE_CACHE_DIR", raising=False)
+            assert config.enable_compile_cache() is False
+            cache_dir = str(tmp_path / "xla-cache")
+            monkeypatch.setenv("MXNET_COMPILE_CACHE_DIR", cache_dir)
+            assert config.enable_compile_cache() is True
+            assert jax.config.jax_compilation_cache_dir == cache_dir
+            assert os.path.isdir(cache_dir)
+            assert jax.config.jax_persistent_cache_min_compile_time_secs \
+                == 0.0
+        finally:
+            jax.config.update("jax_compilation_cache_dir", prior_dir)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", prior_min)
