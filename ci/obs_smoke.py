@@ -88,6 +88,23 @@ def main():
             failures.append("instrument %r has unexpected value: %r"
                             % (name, snap[name]))
 
+    # the serving fault-tolerance instruments register on import and
+    # must be in the catalog (values are exercised by
+    # ci/serve_chaos_drill.py; here the contract is presence — a
+    # scraper provisioning dashboards sees them from process start)
+    import mxnet_tpu.serve  # noqa: F401
+    snap = metrics.snapshot()
+    for name in ("serve_requests_shed_total",
+                 "serve_requests_expired_total",
+                 "serve_requests_cancelled_total",
+                 "serve_dispatcher_restarts_total",
+                 "serve_drains_total",
+                 "serve_batcher_dirty_closes_total",
+                 "serve_queue_age_seconds"):
+        if name not in snap:
+            failures.append("serve instrument %r missing from the "
+                            "registry catalog" % name)
+
     # exposition must render and carry the fused-step counter
     expo = metrics.exposition()
     if "mxnet_fused_step_dispatches %d" % STEPS not in expo:

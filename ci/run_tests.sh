@@ -41,6 +41,20 @@ echo "== serve: compiled-inference smoke (registry + dynamic batcher) =="
 # line is the scrapeable summary ("serve: reqs=.. batches=.. ...").
 MXNET_SAN=all python ci/serve_smoke.py
 
+echo "== serve: request-path chaos drill (shedding/supervision/drain) =="
+# The serving request path through every injected fault class —
+# overload (slow dispatches vs a bounded queue), deadline expiry
+# under a wedged dispatcher, dispatcher crash + restart, restart-
+# budget exhaustion to unhealthy, stale-liveness detection, drain-
+# under-load, and a failed warm compile: asserts typed errors only,
+# zero stranded futures, expired payloads provably never dispatched,
+# drained requests bit-equal to eager at some rung, and the health
+# state machine replayable from events.jsonl (docs/serving.md).
+# Deterministic counter-armed injections; the only sleeps are the
+# injected delays/hangs.  Last stdout line is the scrapeable summary
+# ("servechaos: faults=.. recovered=.. ok").
+python ci/serve_chaos_drill.py
+
 echo "== resilience: chaos-injected fault drills =="
 # The resilience suite under the chaos harness: kill-mid-save,
 # corrupt-checkpoint, NaN-step, and preemption drills against the REAL

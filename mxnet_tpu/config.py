@@ -243,6 +243,32 @@ register_env("MXNET_SERVE_MAX_WAIT_MS", float, 2.0,
 register_env("MXNET_SERVE_MAX_BATCH", int, 0,
              "Row cap per coalesced serve batch; 0 = the model's "
              "bucket-ladder top rung")
+register_env("MXNET_SERVE_MAX_QUEUE", int, 1024,
+             "Admission control: max requests waiting in one serve "
+             "DynamicBatcher — submit past the cap raises a typed "
+             "OverloadError (load shedding) instead of queueing "
+             "unboundedly; 0 = unbounded (legacy)")
+register_env("MXNET_SERVE_MAX_QUEUE_BYTES", int, 1 << 28,
+             "Admission control: max payload bytes waiting in one "
+             "serve DynamicBatcher (the byte-sided overload cap "
+             "alongside MXNET_SERVE_MAX_QUEUE); 0 = unbounded")
+register_env("MXNET_SERVE_DEFAULT_DEADLINE_MS", float, 0.0,
+             "Default per-request serving deadline (milliseconds, "
+             "monotonic clock) applied when submit() passes none: an "
+             "expired request is shed BEFORE padding/dispatch and its "
+             "future resolves with a typed DeadlineExceededError; "
+             "0 = no deadline")
+register_env("MXNET_SERVE_DISPATCHER_RESTARTS", int, 3,
+             "How many serve dispatcher-thread crashes (an exception "
+             "escaping the batching loop, not a per-batch dispatch "
+             "failure) are restarted with jittered backoff before the "
+             "batcher declares itself unhealthy and fails every "
+             "queued future loudly")
+register_env("MXNET_SERVE_DRAIN_TIMEOUT", float, 30.0,
+             "Default bound (seconds) on graceful drain: how long "
+             "Registry.drain / unload(drain=True) / an alias-cutover "
+             "flush waits for accepted serve requests to finish "
+             "before proceeding anyway")
 
 
 def enable_compile_cache():

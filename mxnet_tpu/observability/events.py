@@ -20,6 +20,15 @@ Categories: ``compile``, ``guard``, ``chaos``, ``checkpoint``,
 ``serve`` (plus anything a caller passes — unknown categories are
 recorded when ``all`` is on).
 
+The ``serve`` category carries the serving control trail as ``kind``
+fields: ``load`` / ``load_failed`` / ``unload`` / ``alias`` /
+``unalias`` / ``compile`` (bucket blame) plus the fault-tolerance
+kinds — ``shed`` (admission rejected), ``expired`` (deadline passed
+before dispatch), ``cancelled`` (caller reclaimed its slot),
+``dispatcher_restart`` / ``unhealthy`` (supervision), ``drain`` /
+``cutover_flush`` (graceful teardown) and ``health`` (state-machine
+transitions; see docs/serving.md).
+
 Durability discipline (the same machinery family as
 ``resilience.checkpoint``): each line is ONE ``os.write`` on an
 ``O_APPEND`` fd — the kernel serializes appends, so concurrent

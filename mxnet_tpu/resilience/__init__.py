@@ -16,6 +16,9 @@ ROADMAP's production stance needs on preemptible hardware:
 * :mod:`~mxnet_tpu.resilience.netchaos` — the network-layer injection
   points (drop / delay / duplicate / torn-frame / partition /
   server-kill) the distributed KVStore's socket choke points consult;
+* :mod:`~mxnet_tpu.resilience.servechaos` — the serving-path injection
+  points (dispatch raise / hang / slow, warm-compile reject) the
+  serve dispatcher and predictor consult;
 * :mod:`~mxnet_tpu.resilience.jobstate` — :class:`TrainJobState`, the
   mid-epoch-resume snapshot (epoch/batch cursor, RNG + step counters,
   metric + data-pipeline state) checkpoints carry next to params;
@@ -41,6 +44,7 @@ import threading
 from ..base import MXNetError
 from . import chaos  # noqa: F401
 from . import netchaos  # noqa: F401
+from . import servechaos  # noqa: F401
 from . import supervisor  # noqa: F401
 from .checkpoint import (CheckpointManager, CheckpointRecord,  # noqa: F401
                          atomic_write)
@@ -48,7 +52,8 @@ from .jobstate import TrainJobState  # noqa: F401
 from .retry import retry, retry_call  # noqa: F401
 
 __all__ = ["CheckpointManager", "CheckpointRecord", "atomic_write",
-           "retry", "retry_call", "chaos", "netchaos", "supervisor",
+           "retry", "retry_call", "chaos", "netchaos", "servechaos",
+           "supervisor",
            "TrainJobState", "DivergenceError", "StateMismatchError",
            "request_preemption", "clear_preemption",
            "preemption_requested", "install_preemption_handler"]

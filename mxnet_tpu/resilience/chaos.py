@@ -69,9 +69,10 @@ import threading
 from .. import sanitizer as _san
 
 __all__ = ["SimulatedCrash", "configure", "reset", "active", "enabled",
-           "consume", "fired", "on_file_write", "on_pre_replace",
-           "on_commit", "on_post_replace", "maybe_poison_batch", "tick",
-           "counter", "preemption_requested", "on_train_step"]
+           "consume", "fired", "note_injection", "on_file_write",
+           "on_pre_replace", "on_commit", "on_post_replace",
+           "maybe_poison_batch", "tick", "counter",
+           "preemption_requested", "on_train_step"]
 
 log = logging.getLogger(__name__)
 
@@ -248,17 +249,22 @@ def maybe_poison_batch(batch, step):
     return poisoned
 
 
-def _note_step_injection(key, step):
-    """Account a step-indexed injection (``*_at_step`` keys compare
-    against the step index, so the budgeted ``_consume`` accounting
-    does not apply)."""
+def note_injection(key, **fields):
+    """Account an injection that fired through index comparison rather
+    than the budgeted :func:`consume` path (``*_at_step`` keys, the
+    servechaos tick-indexed keys): bumps the fired table, the
+    ``chaos_injections_total`` counter and the chaos event trail."""
     with _lock:
         _used[key] = _used.get(key, 0) + 1
     from ..observability import events as _obs_events
     from ..observability import metrics as _metrics
     _metrics.counter("chaos_injections_total",
                      "chaos faults actually fired").inc()
-    _obs_events.emit("chaos", injection=key, step=step)
+    _obs_events.emit("chaos", injection=key, **fields)
+
+
+def _note_step_injection(key, step):
+    note_injection(key, step=step)
 
 
 # patchable seam (tests assert the kill without dying; mirrors

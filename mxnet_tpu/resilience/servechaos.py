@@ -1,0 +1,126 @@
+"""Deterministic fault injection for the serving request path.
+
+:mod:`~mxnet_tpu.resilience.chaos` covers process/filesystem faults
+and :mod:`~mxnet_tpu.resilience.netchaos` the distributed transport;
+this module covers the serve choke points.  The injection points are
+consulted by the PRODUCTION serving code — the
+:class:`~mxnet_tpu.serve.batcher.DynamicBatcher` dispatcher right
+before it runs a coalesced batch, and
+:meth:`~mxnet_tpu.serve.predictor.CompiledPredictor.ensure_program`
+before an AOT build — so a chaos-enabled drill drives the exact
+supervision / shedding / drain code a real serving outage exercises.
+
+Everything rides the same counter-based ``MXNET_CHAOS`` spec (or
+programmatic ``chaos.configure``).  Spec keys (all integers):
+
+``dispatch_raise_at=K`` (+ optional ``dispatch_raise_for=N``)
+    Raise ``RuntimeError`` on the K-th coalesced dispatch (1-based
+    tick, process-wide until ``chaos.configure``/``reset``), and —
+    with ``dispatch_raise_for=N`` — on the following N-1 dispatches
+    too.  The raise happens OUTSIDE the batcher's per-batch error
+    isolation, so it escapes the dispatcher loop: supervision must
+    fail exactly that batch's futures and restart the thread
+    (bounded by ``MXNET_SERVE_DISPATCHER_RESTARTS``).
+``dispatch_hang_at=K``
+    The K-th dispatch wedges in an interruptible sleep loop — a
+    stand-in for a wedged device or deadlocked runtime.  The
+    dispatcher's liveness tick goes stale (the health surface must
+    flag it); :func:`release_hangs` lets the drill un-wedge it.
+``slow_dispatch_ms=X``
+    Every dispatch sleeps X milliseconds first while armed — backs
+    the queue up so overload shedding and deadline expiry trigger
+    deterministically without real load.
+``reject_warm_at=K``
+    The K-th AOT program build (warm or on-demand) raises a typed
+    :class:`~mxnet_tpu.serve.buckets.ServeError` — a model whose
+    load/warm fails must never half-register.
+
+See ci/serve_chaos_drill.py for the drill that exercises every class.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from . import chaos
+from .. import sanitizer as _san
+
+__all__ = ["on_dispatch", "on_warm", "release_hangs", "reset_hangs"]
+
+log = logging.getLogger(__name__)
+
+# drills wedge a dispatcher with dispatch_hang_at, observe the stale
+# liveness tick, then release it — a plain event, settable from any
+# thread (cleared again by reset_hangs for the next scenario)
+_hang_release = _san.event()
+
+# patchable seam so unit tests can bound the hang without the event
+_hang_sleep = None
+
+
+def release_hangs():
+    """Un-wedge every dispatcher currently wedged by
+    ``dispatch_hang_at`` (and any future hang until
+    :func:`reset_hangs`)."""
+    _hang_release.set()
+
+
+def reset_hangs():
+    """Re-arm the hang gate (the next ``dispatch_hang_at`` injection
+    wedges again)."""
+    _hang_release.clear()
+
+
+def on_dispatch(name):
+    """Serve dispatch choke point, consulted by the batcher's
+    dispatcher thread for every coalesced batch BEFORE padding/
+    dispatch and outside its per-batch error isolation.  May sleep
+    (``slow_dispatch_ms``), wedge (``dispatch_hang_at``) or raise
+    (``dispatch_raise_at``)."""
+    if not chaos.enabled():
+        return
+    spec = chaos.active()
+    slow = spec.get("slow_dispatch_ms")
+    if slow:
+        time.sleep(slow / 1000.0)
+    raise_at = spec.get("dispatch_raise_at")
+    hang_at = spec.get("dispatch_hang_at")
+    if raise_at is None and hang_at is None:
+        return
+    n = chaos.tick("serve_dispatch")
+    if raise_at is not None and \
+            raise_at <= n < raise_at + spec.get("dispatch_raise_for", 1):
+        chaos.note_injection("dispatch_raise_at", at=n, batcher=name)
+        log.warning("servechaos: raising on dispatch %d of batcher %r",
+                    n, name)
+        raise RuntimeError(
+            "servechaos: injected dispatch failure (batch %d, "
+            "batcher %r)" % (n, name))
+    if hang_at is not None and n == hang_at:
+        chaos.note_injection("dispatch_hang_at", at=n, batcher=name)
+        log.warning("servechaos: hanging dispatcher of batcher %r at "
+                    "dispatch %d (health-surface bait)", name, n)
+        sleep = _hang_sleep or (lambda s: _hang_release.wait(s))
+        while not _hang_release.is_set():
+            sleep(0.02)
+
+
+def on_warm(model):
+    """AOT-build choke point (``CompiledPredictor.ensure_program``):
+    ``reject_warm_at=K`` fails the K-th program build with a typed
+    ServeError."""
+    if not chaos.enabled():
+        return
+    k = chaos.active().get("reject_warm_at")
+    if not k:
+        return
+    n = chaos.tick("serve_warm")
+    if n == k:
+        chaos.note_injection("reject_warm_at", at=n, model=model)
+        log.warning("servechaos: failing program build %d of model %r",
+                    n, model)
+        from ..serve.buckets import ServeError
+        raise ServeError(
+            "servechaos: injected warm-compile failure (build %d, "
+            "model %r)" % (n, model))

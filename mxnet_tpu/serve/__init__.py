@@ -9,19 +9,30 @@ Production serving for models built with this framework:
   no trace or compile ever happens in the request path, plus donated
   KV-cache decode sessions (predictor.py);
 * :class:`DynamicBatcher` / :class:`ServeFuture` — continuous
-  batching: many callers, one padded dispatch (batcher.py);
+  batching: many callers, one padded dispatch — with admission
+  control (:class:`OverloadError`), per-request deadlines
+  (:class:`DeadlineExceededError`), caller-side cancellation
+  (:class:`RequestCancelled`), supervised dispatcher restarts and
+  graceful drain (batcher.py);
 * :class:`ModelRegistry` — multi-model load/unload/alias with a warm
-  program cache; :func:`c_registry` is the process-wide instance the
-  C predict ABI routes through (registry.py).
+  program cache, drain-before-teardown, and the
+  ``health``/``ready``/``live`` probe surface backed by
+  :class:`HealthBoard` (registry.py, health.py); :func:`c_registry`
+  is the process-wide instance the C predict ABI routes through.
 
-See docs/serving.md for the architecture, knobs and metrics catalog.
+See docs/serving.md for the architecture, fault-tolerance semantics,
+knobs and metrics catalog.
 """
 
-from .buckets import BucketLadder, ServeError  # noqa: F401
+from .buckets import (BucketLadder, DeadlineExceededError,  # noqa: F401
+                      OverloadError, RequestCancelled, ServeError)
+from .health import STATES, HealthBoard  # noqa: F401
 from .predictor import CompiledPredictor, DecodeSession  # noqa: F401
 from .batcher import DynamicBatcher, ServeFuture  # noqa: F401
 from .registry import ModelRegistry, c_registry  # noqa: F401
 
-__all__ = ["BucketLadder", "ServeError", "CompiledPredictor",
-           "DecodeSession", "DynamicBatcher", "ServeFuture",
-           "ModelRegistry", "c_registry"]
+__all__ = ["BucketLadder", "ServeError", "OverloadError",
+           "DeadlineExceededError", "RequestCancelled",
+           "CompiledPredictor", "DecodeSession", "DynamicBatcher",
+           "ServeFuture", "ModelRegistry", "c_registry", "HealthBoard",
+           "STATES"]

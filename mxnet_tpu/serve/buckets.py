@@ -28,12 +28,31 @@ that is what makes one-compile-per-bucket assertable in CI
 
 from __future__ import annotations
 
-__all__ = ["BucketLadder", "ServeError"]
+__all__ = ["BucketLadder", "ServeError", "OverloadError",
+           "DeadlineExceededError", "RequestCancelled"]
 
 
 class ServeError(RuntimeError):
     """Typed failure of the serving subsystem (bad shapes, closed
     batchers, unknown models)."""
+
+
+class OverloadError(ServeError):
+    """Admission rejected: the batcher queue is at its request-count
+    or byte cap (``MXNET_SERVE_MAX_QUEUE`` / ``_BYTES``).  Shedding at
+    submit time is deliberate — an unbounded queue turns overload into
+    OOM and every queued caller's tail latency into the backlog's."""
+
+
+class DeadlineExceededError(ServeError):
+    """The request's deadline passed before it was dispatched.  The
+    dispatcher sheds expired requests *before* padding/dispatch, so an
+    expired row never rides through XLA."""
+
+
+class RequestCancelled(ServeError):
+    """The caller abandoned the request (:meth:`ServeFuture.cancel`)
+    and its queue slot was reclaimed before dispatch."""
 
 
 #: default batch rungs: powers of two through 32

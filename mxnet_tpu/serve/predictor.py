@@ -36,6 +36,7 @@ from .buckets import BucketLadder, ServeError
 from .. import sanitizer as _san
 from ..observability import events as _obs_events
 from ..observability import metrics as _obs_metrics
+from ..resilience import servechaos as _servechaos
 
 __all__ = ["CompiledPredictor", "DecodeSession"]
 
@@ -252,6 +253,9 @@ class CompiledPredictor:
             prog = self._programs.get(key)
             if prog is not None:
                 return prog
+            # chaos choke point (reject_warm_at): a failed build must
+            # propagate as a typed error, never half-register a model
+            _servechaos.on_warm(self.name)
             pa, aa, da, ka = self._avals(shapes)
             t0 = _time.perf_counter()
             prog = self._jit.lower(pa, aa, da, ka).compile()

@@ -9,14 +9,20 @@ jit side effect):
   as the thousandth;
 * ``alias`` gives one compiled model several routable names
   (``"resnet" -> "resnet-v3"`` style traffic cutovers without a
-  recompile);
-* ``unload`` tears the model, its aliases and its batcher down;
+  recompile); repointing an alias flushes the old target's accepted
+  requests so a deploy never drops work it admitted;
+* ``drain`` stops a model's admissions and waits (bounded) for its
+  accepted requests; ``unload`` drains by default, then tears the
+  model, its aliases and its batcher down;
 * ``batcher``/``submit`` attach the dynamic batcher to a model by
-  name.
+  name;
+* ``health``/``ready``/``live`` expose the per-model state machine
+  (see health.py) plus queue depth and dispatcher liveness — the
+  readiness/liveness surface a fleet scheduler probes.
 
-Every load/unload/alias is a ``serve`` event, every program build is
-counted and blamed (see predictor.py), and the C predict ABI
-(capi_bridge.py) is a thin client of the process-wide
+Every load/unload/alias/drain/health transition is a ``serve`` event,
+every program build is counted and blamed (see predictor.py), and the
+C predict ABI (capi_bridge.py) is a thin client of the process-wide
 :func:`c_registry` instance.
 """
 
@@ -24,6 +30,7 @@ from __future__ import annotations
 
 from .batcher import DynamicBatcher
 from .buckets import BucketLadder, ServeError
+from .health import HealthBoard
 from .predictor import CompiledPredictor
 from .. import sanitizer as _san
 from ..observability import events as _obs_events
@@ -34,6 +41,9 @@ __all__ = ["ModelRegistry", "c_registry"]
 _MODELS_GAUGE = _obs_metrics.gauge(
     "serve_models_loaded",
     "models resident across all serve registries (delta-maintained)")
+_DRAINS_TOTAL = _obs_metrics.counter(
+    "serve_drains_total",
+    "graceful drains started (Registry.drain + unload(drain=True))")
 
 
 class ModelRegistry:
@@ -44,6 +54,7 @@ class ModelRegistry:
         self._models = {}     # name -> CompiledPredictor
         self._aliases = {}    # alias -> canonical name
         self._batchers = {}   # canonical name -> DynamicBatcher
+        self._board = HealthBoard()
         _san.track(self, ("_models", "_aliases", "_batchers"),
                    label="serve.registry")
 
@@ -53,7 +64,10 @@ class ModelRegistry:
              warm=True, bucket_inputs=None):
         """Register and (by default) warm-compile a model.  Returns
         the :class:`CompiledPredictor`.  Re-loading a live name
-        replaces it atomically (aliases keep pointing at the name)."""
+        replaces it atomically (aliases keep pointing at the name; the
+        displaced predictor's batcher is drained, then closed).  A
+        build/warm failure never half-registers: the name is dropped
+        from the health board and the error propagates."""
 
         def _check_not_alias():
             if name in self._aliases:
@@ -64,19 +78,47 @@ class ModelRegistry:
 
         with self._lock:
             _check_not_alias()      # before paying the warm compiles
-        pred = CompiledPredictor(
-            symbol, arg_params, aux_params=aux_params,
-            data_shapes=data_shapes, ladder=ladder,
-            data_dtypes=data_dtypes, ctx=ctx, name=name,
-            bucket_inputs=bucket_inputs)
-        built = pred.warm() if warm else 0
+            replacing = name in self._models
+        if not replacing:
+            self._board.transition(name, "loading")
+        try:
+            pred = CompiledPredictor(
+                symbol, arg_params, aux_params=aux_params,
+                data_shapes=data_shapes, ladder=ladder,
+                data_dtypes=data_dtypes, ctx=ctx, name=name,
+                bucket_inputs=bucket_inputs)
+            if warm:
+                if not replacing:
+                    self._board.transition(name, "warming")
+                built = pred.warm()
+            else:
+                built = 0
+        except Exception as exc:
+            if not replacing:
+                self._board.drop(name)
+            _obs_events.emit("serve", kind="load_failed", model=name,
+                             error="%s: %s" % (type(exc).__name__,
+                                               str(exc)[:200]))
+            raise
         with self._lock:
             _check_not_alias()      # racing alias() may have won
             old_batcher = self._batchers.pop(name, None)
             if name not in self._models:
                 _MODELS_GAUGE.inc()  # delta: aggregates across registries
             self._models[name] = pred
+            # ready-mark INSIDE the install lock: marking after release
+            # let a fully-completed concurrent unload drop the board
+            # first, then this write resurrected a ghost 'ready' entry
+            # for a model that no longer exists
+            self._board.transition(name, "ready")
         if old_batcher is not None:
+            # the displaced predictor's accepted requests finish
+            # before teardown (deploys must not drop admitted work);
+            # unwire its health hook first — a crash-past-budget while
+            # draining leftovers must not mark the REPLACEMENT
+            # unhealthy on the board
+            old_batcher.detach_state_hook()
+            old_batcher.drain()
             old_batcher.close()
         _obs_events.emit("serve", kind="load", model=name,
                          programs=built, warm=bool(warm),
@@ -107,7 +149,10 @@ class ModelRegistry:
 
     def alias(self, alias, name):
         """Route *alias* to model *name* (repoint allowed — this is
-        the traffic-cutover primitive)."""
+        the traffic-cutover primitive).  On a repoint, the OLD
+        target's already-accepted requests are flushed (bounded by
+        ``MXNET_SERVE_DRAIN_TIMEOUT``) before returning, so a cutover
+        followed by a teardown never drops admitted work."""
         with self._lock:
             target = self._resolve(name)
             if target not in self._models:
@@ -117,30 +162,90 @@ class ModelRegistry:
                 raise ServeError(
                     "%r names a loaded model — unload it before "
                     "turning the name into an alias" % alias)
+            old = self._aliases.get(alias)
             self._aliases[alias] = target
+            old_batcher = self._batchers.get(old) \
+                if old is not None and old != target else None
         _obs_events.emit("serve", kind="alias", alias=alias,
                          model=target)
+        if old_batcher is not None:
+            complete = old_batcher.flush()
+            _obs_events.emit("serve", kind="cutover_flush", alias=alias,
+                             model=old, complete=bool(complete))
 
-    def unload(self, name):
+    # -- graceful drain / teardown -----------------------------------------
+    def drain(self, name, timeout=None):
+        """Stop admissions to *name*'s batcher (submits raise a typed
+        ServeError) and wait up to *timeout* seconds (default the
+        ``MXNET_SERVE_DRAIN_TIMEOUT`` knob) for every accepted request
+        to resolve.  The model stays loaded (direct ``predict`` still
+        works); ``unload`` completes the teardown.  Returns True when
+        the queue fully drained."""
+        with self._lock:
+            target = self._resolve(name)
+            if target not in self._models:
+                raise ServeError("no model %r to drain" % name)
+            batcher = self._batchers.get(target)
+        self._board.transition(target, "draining")
+        _DRAINS_TOTAL.inc()
+        _obs_events.emit("serve", kind="drain", model=target,
+                         mode="drain")
+        if batcher is None:
+            return True
+        return batcher.drain(timeout)
+
+    def unload(self, name, drain=True, timeout=None):
         """Drop a model (or just an alias).  Unloading a model also
-        drops every alias pointing at it and closes its batcher."""
+        drops every alias pointing at it and closes its batcher.  With
+        *drain* (the default) admissions stop first and accepted
+        requests get up to *timeout* seconds to finish — a clean
+        deploy completes everything it admitted; ``drain=False`` is
+        the fast teardown that fails queued futures with a typed
+        ServeError."""
         with self._lock:
             if name in self._aliases and name not in self._models:
                 del self._aliases[name]
                 _obs_events.emit("serve", kind="unalias", alias=name)
                 return
-            if name not in self._models:
+            pred = self._models.get(name)
+            if pred is None:
                 raise ServeError("no model %r to unload" % name)
+            batcher = self._batchers.get(name)
+        drained = None
+        marked_draining = False
+        if drain and batcher is not None:
+            self._board.transition(name, "draining")
+            marked_draining = True
+            _DRAINS_TOTAL.inc()
+            _obs_events.emit("serve", kind="drain", model=name,
+                             mode="unload")
+            drained = batcher.drain(timeout)
+        with self._lock:
+            if self._models.get(name) is not pred:
+                # lost the race to a concurrent load/unload.  If OUR
+                # draining mark is still on the board over a live
+                # replacement, lift it — the new model must serve.
+                if marked_draining and name in self._models and \
+                        self._board.state(name) == "draining":
+                    self._board.transition(name, "ready")
+                return
             del self._models[name]
             dropped = [a for a, t in self._aliases.items() if t == name]
             for a in dropped:
                 del self._aliases[a]
-            batcher = self._batchers.pop(name, None)
+            b = self._batchers.pop(name, None)
+            batcher = b or batcher
             _MODELS_GAUGE.dec()
         if batcher is not None:
+            # the board entry dies below — a late dispatcher crash must
+            # not resurrect it under the dropped name
+            batcher.detach_state_hook()
             batcher.close()
+        self._board.drop(name)
         _obs_events.emit("serve", kind="unload", model=name,
-                         aliases_dropped=dropped)
+                         aliases_dropped=dropped,
+                         **({} if drained is None
+                            else {"drained": bool(drained)}))
 
     def names(self):
         with self._lock:
@@ -149,6 +254,83 @@ class ModelRegistry:
     def aliases(self):
         with self._lock:
             return dict(self._aliases)
+
+    # -- health ------------------------------------------------------------
+    def health(self, name=None):
+        """The readiness/liveness view.  With *name*: one model's
+        state dict — health-board state (batcher unhealthy/draining
+        overrides a stale ``ready``), queue depth, dispatcher
+        liveness + tick age, restart count, dirty-close flag and
+        traffic counters.  Without: ``{model: state dict}`` for every
+        loaded model."""
+        if name is None:
+            with self._lock:
+                known = sorted(set(self._models) |
+                               set(self._board.snapshot()))
+            out = {}
+            for n in known:
+                try:
+                    out[n] = self.health(n)
+                except ServeError:
+                    # unloaded between the name snapshot and the
+                    # per-model read (a deploy racing the probe) —
+                    # omit it rather than failing the fleet view
+                    continue
+            return out
+        with self._lock:
+            target = self._resolve(name)
+            pred = self._models.get(target)
+            batcher = self._batchers.get(target)
+        state = self._board.state(target)
+        if pred is None and state is None:
+            raise ServeError("no model %r is loaded (have %s)"
+                             % (name, self.names()))
+        info = {
+            "model": target,
+            "state": state or "ready",
+            "queue_depth": 0,
+            "dispatcher_alive": None,
+            "tick_age_s": None,
+            "restarts": 0,
+            "closed_dirty": False,
+            "requests": 0,
+            "batches": 0,
+            "programs": pred.compile_count if pred is not None else 0,
+        }
+        if batcher is not None:
+            bstate = batcher.health_state()
+            if bstate != "ready" and info["state"] == "ready":
+                info["state"] = bstate
+            info.update(
+                queue_depth=batcher.queue_depth,
+                dispatcher_alive=batcher.dispatcher_alive(),
+                tick_age_s=round(batcher.last_tick_age(), 3),
+                restarts=batcher.restart_count,
+                closed_dirty=batcher.closed_dirty,
+                requests=batcher.request_count,
+                batches=batcher.batch_count)
+        return info
+
+    def ready(self, name):
+        """Readiness probe: does *name* accept new requests?"""
+        try:
+            return self.health(name)["state"] == "ready"
+        except ServeError:
+            return False
+
+    def live(self, max_tick_age=5.0):
+        """Liveness probe: every dispatcher thread is running and —
+        when it has work queued — has ticked within *max_tick_age*
+        seconds (a stale tick with pending work is a wedged dispatch,
+        not an idle queue)."""
+        with self._lock:
+            batchers = list(self._batchers.values())
+        for b in batchers:
+            if b.unhealthy or not b.dispatcher_alive():
+                return False
+            if b.queue_depth > 0 and b.last_tick_age() > max_tick_age:
+                return False
+        return True
 
     # -- request routing ---------------------------------------------------
     def batcher(self, name, **kwargs):
@@ -160,15 +342,25 @@ class ModelRegistry:
                 raise ServeError("no model %r is loaded" % name)
             b = self._batchers.get(target)
             if b is None:
+                kwargs.setdefault(
+                    "on_state",
+                    lambda state, _t=target:
+                        self._board.transition(_t, state))
                 b = DynamicBatcher(self._models[target], name=target,
                                    **kwargs)
+                if self._board.state(target) == "draining":
+                    # drain() ran before any traffic created a batcher:
+                    # the new one must come up with admissions already
+                    # stopped, or a post-drain submit would resurrect
+                    # the model behind the health surface's back
+                    b.drain(timeout=0)
                 self._batchers[target] = b
             return b
 
-    def submit(self, name, data):
+    def submit(self, name, data, deadline_ms=None):
         """Submit one request to *name*'s dynamic batcher; returns a
         :class:`~mxnet_tpu.serve.batcher.ServeFuture`."""
-        return self.batcher(name).submit(data)
+        return self.batcher(name).submit(data, deadline_ms=deadline_ms)
 
     def predict(self, name, data, key=None):
         """Direct (unbatched) predict on *name* — bypasses the
@@ -176,9 +368,10 @@ class ModelRegistry:
         return self.get(name).predict(data, key=key)
 
     def close(self):
-        """Unload everything (batchers closed, futures failed)."""
+        """Unload everything, fast (no drain: batchers closed, queued
+        futures failed with a typed ServeError)."""
         for name in self.names():
-            self.unload(name)
+            self.unload(name, drain=False)
 
 
 # -- process-wide registry behind the C predict ABI --------------------------
