@@ -499,6 +499,203 @@ def compare_update_paths(n_layers=30, dim=64, batch=32, steps=30,
     return out
 
 
+class _SlowDecodeIter:
+    """Host-bound iterator simulator for ``--compare-input-paths``: a
+    DataIter-shaped source whose ``next()`` burns *decode_s* seconds
+    of host time (the stand-in for jpeg decode / augmentation) and
+    hands out HOST numpy batches — exactly what a decode pipeline
+    produces.  The serial path then pays the host→device transfer
+    inside the step loop; the pipelined path pays it on the
+    DevicePrefetcher's producer thread."""
+
+    def __init__(self, data, label, batch_size, decode_s):
+        self.batch_size = batch_size
+        self.decode_s = decode_s
+        n = (data.shape[0] // batch_size) * batch_size
+        self._data = [data[i:i + batch_size]
+                      for i in range(0, n, batch_size)]
+        self._label = [label[i:i + batch_size]
+                       for i in range(0, n, batch_size)]
+        self._cursor = 0
+
+    @property
+    def provide_data(self):
+        from mxnet_tpu.io import DataDesc
+        return [DataDesc("data", self._data[0].shape,
+                         self._data[0].dtype)]
+
+    @property
+    def provide_label(self):
+        from mxnet_tpu.io import DataDesc
+        return [DataDesc("softmax_label", self._label[0].shape,
+                         self._label[0].dtype)]
+
+    def reset(self):
+        self._cursor = 0
+
+    def next(self):
+        from mxnet_tpu.io import DataBatch
+        if self._cursor >= len(self._data):
+            raise StopIteration
+        time.sleep(self.decode_s)
+        i = self._cursor
+        self._cursor += 1
+        return DataBatch(data=[self._data[i]], label=[self._label[i]],
+                         pad=0)
+
+    def __next__(self):
+        return self.next()
+
+    def __iter__(self):
+        return self
+
+    def state_dict(self):
+        return {"type": type(self).__name__, "cursor": self._cursor}
+
+    def load_state(self, state):
+        self._cursor = int(state["cursor"])
+
+
+def compare_input_paths(batch=128, dim=128, hidden=768, n_layers=8,
+                        steps=16, depth=3, lag=2):
+    """``--compare-input-paths``: serial input path (host decode +
+    device_put inside the step loop, guard readback blocking every
+    step) vs the pipelined path (``DevicePrefetcher`` ring +
+    ``MXNET_GUARD_READBACK_LAG`` async guard accounting), on a
+    synthetic host-bound iterator whose decode time X is calibrated to
+    the measured device step time Y.  Serial pays ≈ X+Y per step; the
+    pipelined steady state pays ≈ max(X, Y) — decode and transfer run
+    on the producer thread while the device computes, and the host
+    dispatches step N+1 while step N runs.  Runs on CPU by design (a
+    dispatch-overlap measurement, like --compare-update-paths).
+    Prints one BENCH-schema JSON line (with ``input_stall_share``) and
+    returns the dict; ``overlap_ok`` asserts pipelined < 0.7×serial."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import sym
+    from mxnet_tpu.io import DevicePrefetcher
+    from mxnet_tpu.observability import metrics as _obs_metrics
+
+    rng = np.random.RandomState(0)
+    n = batch * (steps + depth + 12)
+    X_data = rng.randn(n, dim).astype(np.float32)
+    Y_data = rng.randint(0, 8, (n,)).astype(np.float32)
+
+    def build():
+        mx.random.seed(7)
+        data = sym.var("data")
+        net = data
+        for i in range(n_layers):
+            net = sym.FullyConnected(net, num_hidden=hidden,
+                                     name="l%d" % i)
+            net = sym.Activation(net, act_type="relu")
+        net = sym.FullyConnected(net, num_hidden=8, name="out")
+        net = sym.SoftmaxOutput(net, name="softmax")
+        mod = mx.Module(net, context=mx.cpu())
+        mod.bind([("data", (batch, dim))], [("softmax_label", (batch,))])
+        mod.init_params(mx.init.Xavier())
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.05})
+        # the guard's skip-counter readback is the per-step host sync
+        # the async path amortizes (see docs/perf_input_pipeline.md)
+        mod.set_nonfinite_guard(max_consecutive=0)
+        return mod
+
+    def fresh_iter(decode_s):
+        return _SlowDecodeIter(X_data, Y_data, batch, decode_s)
+
+    prior = os.environ.get("MXNET_GUARD_READBACK_LAG")
+
+    def set_lag(v):
+        if v:
+            os.environ["MXNET_GUARD_READBACK_LAG"] = str(v)
+        else:
+            os.environ.pop("MXNET_GUARD_READBACK_LAG", None)
+
+    try:
+        # -- calibrate Y: the serial loop at ZERO decode time --------
+        # Y here is everything the serial consumer pays per step
+        # besides the simulated decode: the iterator's host batch
+        # conversion + the guarded step with its synchronous readback.
+        # Calibrating on the REAL loop (not a warm reused batch, whose
+        # puts are elided) makes X track what the machine actually
+        # does under its current CPU shares.
+        set_lag(0)
+        mod = build()
+        it0 = fresh_iter(0.0)
+        for _ in range(3):
+            mod.forward_backward_update(it0.next())   # compile + settle
+        ys = []
+        for _ in range(7):
+            t0 = time.perf_counter()
+            mod.forward_backward_update(it0.next())
+            ys.append(time.perf_counter() - t0)
+        step_s = sorted(ys)[len(ys) // 2]
+        # X ≈ 1.3Y: the sleep dominates the producer's period (its
+        # conversion work contends with XLA's compute threads on
+        # small-core hosts), while max(X,Y)/(X+Y) stays near its 0.5
+        # floor; the 10 ms floor keeps scheduler jitter second-order
+        decode_s = max(1.3 * step_s, 0.010)
+
+        # -- serial: decode + put + blocking readback per step -------
+        mod = build()
+        it = fresh_iter(decode_s)
+        for _ in range(3):
+            mod.forward_backward_update(it.next())   # compile + settle
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            mod.forward_backward_update(it.next())
+        serial_dt = time.perf_counter() - t0         # guard drains each
+
+        # -- pipelined: device ring + bounded-lag readback -----------
+        set_lag(lag)
+        mod = build()
+        pf = DevicePrefetcher(fresh_iter(decode_s), depth=depth)
+        try:
+            for _ in range(3 + depth):               # compile + fill ring
+                mod.forward_backward_update(pf.next())
+            wait_hist = _obs_metrics.REGISTRY.get("input_wait_seconds")
+            wait0 = wait_hist.sum
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                mod.forward_backward_update(pf.next())
+            # the timed window is only honest once the in-flight lag
+            # steps have drained on-device
+            mod.drain_guard_readbacks()
+            pipe_dt = time.perf_counter() - t0
+            stall_share = (wait_hist.sum - wait0) / pipe_dt
+        finally:
+            pf.close()
+    finally:
+        if prior is None:
+            os.environ.pop("MXNET_GUARD_READBACK_LAG", None)
+        else:
+            os.environ["MXNET_GUARD_READBACK_LAG"] = prior
+
+    serial_per = serial_dt / steps
+    pipe_per = pipe_dt / steps
+    out = {
+        "metric": "input_pipeline_overlap",
+        "value": round(steps / pipe_dt, 2),
+        "unit": "steps/sec",
+        "serial_steps_per_s": round(steps / serial_dt, 2),
+        "pipelined_steps_per_s": round(steps / pipe_dt, 2),
+        "speedup": round(serial_per / pipe_per, 3),
+        "decode_ms": round(decode_s * 1e3, 3),
+        "step_ms": round(step_s * 1e3, 3),
+        "serial_ms_per_step": round(serial_per * 1e3, 3),
+        "pipelined_ms_per_step": round(pipe_per * 1e3, 3),
+        "input_stall_share": round(stall_share, 4),
+        "prefetch_depth": depth,
+        "guard_readback_lag": lag,
+        "batch_size": batch,
+        # serial ≈ X+Y, pipelined steady state ≈ max(X,Y): the overlap
+        # proof the CI smoke stage asserts
+        "overlap_ok": pipe_per < 0.7 * serial_per,
+    }
+    print(json.dumps(out))
+    return out
+
+
 def _percentile(sorted_vals, q):
     """Nearest-rank percentile of an ascending list (exact — serving
     SLOs are quoted on real request latencies, not histogram bounds)."""
@@ -694,6 +891,20 @@ def main():
         return
     if "--decompose" in sys.argv:
         return decompose_main()
+    if "--compare-input-paths" in sys.argv:
+        # serial vs device-prefetched input path — a host/device
+        # overlap measurement, so it ALWAYS runs on CPU (same tunnel
+        # rationale as --compare-update-paths below)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        out = compare_input_paths()
+        if not out["overlap_ok"]:
+            print("bench: input pipelining failed the overlap bar "
+                  "(pipelined %.2f ms/step vs serial %.2f — want "
+                  "< 0.7x)" % (out["pipelined_ms_per_step"],
+                               out["serial_ms_per_step"]),
+                  file=sys.stderr)
+            return 1
+        return 0
     if "--compare-update-paths" in sys.argv:
         # explicit A/B of the two update paths — a relative dispatch-
         # overhead measurement, so it ALWAYS runs on CPU: the shell's
@@ -716,6 +927,15 @@ def main():
     # short scan — it multiplies compile time)
     scan_n = 10 if on_tpu else 2
 
+    # input-stall accounting across the timed window: share of wall
+    # time the step loop spent blocked on the input pipeline
+    # (input_wait_seconds histogram — 0.0 here because the bench feeds
+    # a device-resident batch, the pipelined ideal the real input path
+    # is measured against via --compare-input-paths)
+    from mxnet_tpu.observability import metrics as _obs_metrics
+    _wait_hist = _obs_metrics.REGISTRY.get("input_wait_seconds")
+    _wait0 = _wait_hist.sum if _wait_hist is not None else 0.0
+
     r = timed_resnet_train(
         batch, image,
         # BENCH_REMAT=dots|full selects a jax.checkpoint policy for the
@@ -726,6 +946,9 @@ def main():
         multi_precision=on_tpu)
     img_s, dt, iters = r["img_s"], r["dt"], r["iters"]
     flops, final_loss = r["flops_per_step"], r["final_loss"]
+    input_stall_share = round(
+        ((_wait_hist.sum - _wait0) if _wait_hist is not None else 0.0)
+        / dt, 4)
 
     peak_probe = _probe_peak_flops() if on_tpu else None
     sustained = flops * iters / dt
@@ -782,6 +1005,7 @@ def main():
         "flops_per_step": flops,
         "final_loss": final_loss,
         "mfu_error": mfu_error,
+        "input_stall_share": input_stall_share,
         "decompose": decompose,
     }
     print(json.dumps(out))

@@ -110,6 +110,42 @@ def main():
     if "mxnet_fused_step_dispatches %d" % STEPS not in expo:
         failures.append("exposition text lacks the fused-step counter")
 
+    # -- device-prefetched input path ----------------------------------
+    # a short prefetched epoch exercises the input-pipeline telemetry
+    # (docs/perf_input_pipeline.md): one wait observation per consumed
+    # batch, the stall counter + ring-occupancy gauge live, and the
+    # step loop's elided device_puts counted.  Runs AFTER the exact
+    # fused-step count assertions above (these are extra steps).
+    from mxnet_tpu.io import DevicePrefetcher, NDArrayIter
+    pf = DevicePrefetcher(
+        NDArrayIter(rng.randn(64, 8).astype(np.float32),
+                    rng.randint(0, 4, 64).astype(np.float32),
+                    batch_size=16, last_batch_handle="discard"),
+        depth=2)
+    try:
+        pf_steps = 0
+        for b in pf:
+            mod.forward_backward_update(b)
+            pf_steps += 1
+        mod.get_outputs()[0].asnumpy()
+    finally:
+        pf.close()
+    snap = metrics.snapshot()
+    input_expected = {
+        "input_wait_seconds": lambda s: s["count"] >= pf_steps,
+        "steps_input_stalled_total": lambda s: s["value"] >= 0,
+        "device_prefetch_ring_occupancy": lambda s: True,
+        "device_put_elided_total":
+            lambda s: s["value"] >= 2 * pf_steps,
+    }
+    for name, check in input_expected.items():
+        if name not in snap:
+            failures.append("input instrument %r missing from the "
+                            "registry (have: %s)" % (name, sorted(snap)))
+        elif not check(snap[name]):
+            failures.append("input instrument %r has unexpected value: "
+                            "%r" % (name, snap[name]))
+
     # -- events.jsonl --------------------------------------------------
     ev_path = events.path()
     if not os.path.exists(ev_path):

@@ -31,6 +31,16 @@ echo "== observability: telemetry smoke train step =="
 # stdout line is the scrapeable summary ("obs: instruments=.. ...").
 MXNET_OBS=all python ci/obs_smoke.py
 
+echo "== perf: input-pipeline overlap smoke (device prefetch + async guard) =="
+# Host-bound iterator (X ms decode) + real fused steps (Y ms): the
+# DevicePrefetcher ring + MXNET_GUARD_READBACK_LAG async guard
+# accounting must reach a steady state of ~max(X,Y) per step vs the
+# serial path's X+Y (asserted < 0.7x serial), with zero graftsan
+# reports from the ring's threads/locks and the input-wait/stall
+# instruments live.  Seconds, CPU-only (docs/perf_input_pipeline.md).
+# Last stdout line is the scrapeable summary ("inputperf: ... ok").
+MXNET_SAN=all python ci/input_overlap_smoke.py
+
 echo "== serve: compiled-inference smoke (registry + dynamic batcher) =="
 # Two-model registry under concurrent mixed-size traffic through the
 # dynamic batcher, sanitizers on: asserts one AOT compile per bucket

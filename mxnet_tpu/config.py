@@ -163,6 +163,27 @@ register_env("MXNET_GUARD_NONFINITE", bool, False,
              "fused train step selects the unchanged params/state, so "
              "a diverged step costs no extra dispatch and no "
              "recompile (TPU-native knob; see docs/resilience.md)")
+register_env("MXNET_GUARD_READBACK_LAG", int, 0,
+             "Async non-finite-guard accounting on the FULL-fused "
+             "step: defer the guard counter's scalar device->host "
+             "readback by up to this many steps, so the host "
+             "dispatches step N+1 while the device still runs step N "
+             "(params/opt-state/aux stay protected in-graph by the "
+             "where-select regardless).  Deferred readbacks resolve "
+             "FIFO, so max_consecutive divergence actions fire within "
+             "this many steps of the real divergence; the backlog is "
+             "drained at epoch end, on preemption, and whenever job "
+             "state is captured.  0 = synchronous (legacy, one "
+             "blocking readback per step); see "
+             "docs/perf_input_pipeline.md")
+register_env("MXNET_DEVICE_PREFETCH", int, 0,
+             "Ring depth for the fit()-level DevicePrefetcher wrap: "
+             "training loops wrap their data iterator so host decode "
+             "AND jax.device_put run on a background thread into a "
+             "ring of this many device-resident batches (device "
+             "memory: depth x batch bytes); 0 = off; "
+             "fit(device_prefetch=...) overrides in both directions "
+             "(see docs/perf_input_pipeline.md)")
 register_env("MXNET_GUARD_MAX_BAD_STEPS", int, 0,
              "With the non-finite guard on, this many CONSECUTIVE "
              "skipped steps trigger the divergence action (raise, or "

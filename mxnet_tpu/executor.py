@@ -26,7 +26,8 @@ import jax.numpy as jnp
 from .base import MXNetError, np_dtype
 from .context import Context, current_context
 from .ndarray import NDArray, zeros as nd_zeros
-from .ndarray.ndarray import _as_nd
+from .ndarray.ndarray import (_as_nd, _already_placed,
+                              _DEVICE_PUT_ELIDED)
 from .observability import metrics as _obs_metrics
 from .symbol.symbol import Symbol, _infer_shapes
 
@@ -521,12 +522,18 @@ class Executor:
     def _place(self, arr):
         """Move an incoming array onto this executor's device (the
         reference's executor_group copies batch slices per ctx,
-        executor_group.py:436)."""
+        executor_group.py:436).  An array already COMMITTED here — a
+        DevicePrefetcher ring batch, or a slice of one — skips the put
+        entirely (counted via ``device_put_elided_total``); an
+        uncommitted on-device array still routes through device_put so
+        its committedness can't flip the fused program's jit cache key
+        between steps (the graftsan recompile lesson)."""
         import jax as _jax
         dev = self._ctx.jax_device
-        if dev not in arr.devices():
-            return _jax.device_put(arr, dev)
-        return arr
+        if _already_placed(arr, dev):
+            _DEVICE_PUT_ELIDED.inc()
+            return arr
+        return _jax.device_put(arr, dev)
 
     def forward(self, is_train=False, **kwargs):
         """Run the graph (reference: executor.py forward:114)."""

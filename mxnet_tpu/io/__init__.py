@@ -2,4 +2,5 @@
 
 from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
                  PrefetchingIter, MNISTIter, CSVIter, LibSVMIter)  # noqa
+from .device_prefetch import DevicePrefetcher  # noqa: F401
 from .image_record import ImageRecordIter  # noqa: F401

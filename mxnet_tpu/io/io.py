@@ -19,6 +19,7 @@ import os
 import queue
 import struct
 import threading
+import time
 
 import numpy as _np
 
@@ -421,13 +422,21 @@ class PrefetchingIter(DataIter):
                               **cfg)
         return self.iters[0].next()
 
+    def _transform(self, batch):
+        """Producer-side per-batch hook (runs on the prefetch thread,
+        BEFORE the batch enters the ring).  The base class passes
+        batches through; :class:`DevicePrefetcher` overrides it to run
+        ``jax.device_put`` here so host decode AND the host→device
+        transfer overlap device compute."""
+        return batch
+
     def _producer(self, q, stop):
         # q/stop are bound per-thread: a producer abandoned by reset()
         # keeps talking to ITS queue and stop event, never the
         # replacement epoch's
         while not stop.is_set():
             try:
-                batch = self._next_inner()
+                batch = self._transform(self._next_inner())
             except StopIteration:
                 self._put(q, stop, None)
                 return
@@ -442,6 +451,7 @@ class PrefetchingIter(DataIter):
                 return
 
     def _start(self):
+        self._closed = False
         self._queue = _san.queue(maxsize=self._depth)
         self._stop = _san.event()
         self._thread = _san.thread(
@@ -486,6 +496,16 @@ class PrefetchingIter(DataIter):
         self._epoch_state = self._inner_state()
         self._start()
 
+    def close(self):
+        """Stop the producer thread and drop buffered batches (a ring
+        of device-resident buffers holds depth×batch bytes of device
+        memory until released).  The iterator stays resumable:
+        ``reset()`` or ``load_state()`` starts a fresh producer."""
+        self._stop_producer()
+        self._closed = True
+        self._peek = None
+        self.current_batch = None
+
     def state_dict(self):
         """Pass-through position: the inner iterator's state at epoch
         start plus the number of batches actually DELIVERED to the
@@ -517,19 +537,38 @@ class PrefetchingIter(DataIter):
         self._epoch_state = state["epoch_start"]
         self._start()
 
+    def _note_occupancy(self, occupancy):
+        """Consumer-side hook, called with the ring occupancy right
+        before popping (0 = the consumer is about to block on input).
+        Subclasses override to feed their own instruments."""
+        _PREFETCH_DEPTH.set(occupancy)
+
+    def _note_delivery(self, occupancy, wait_s):
+        """Consumer-side hook, called after a REAL batch (not the
+        end-of-epoch sentinel or a producer exception) was popped:
+        *wait_s* is how long the consumer blocked on the ring."""
+
     def next(self):
         if self._peek is not None:
             batch, self._peek = self._peek, None
             self.current_batch = batch
             return batch
-        # depth sampled per batch; 0 here = consumer outrunning the
-        # producer thread (input-bound step)
-        _PREFETCH_DEPTH.set(self._queue.qsize())
+        if self._closed:
+            # the drained queue has no producer — blocking on it would
+            # hang forever, so fail loudly instead
+            raise RuntimeError(
+                "%s.next() after close(): the producer is stopped and "
+                "the ring drained; reset() or load_state() starts a "
+                "fresh producer" % type(self).__name__)
+        occupancy = self._queue.qsize()
+        self._note_occupancy(occupancy)
+        t0 = time.perf_counter()
         item = self._queue.get()
         if item is None:
             raise StopIteration
         if isinstance(item, Exception):
             raise item
+        self._note_delivery(occupancy, time.perf_counter() - t0)
         self._consumed += 1
         self.current_batch = item
         return item

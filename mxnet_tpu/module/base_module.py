@@ -173,8 +173,18 @@ class BaseModule:
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None, sparse_row_id_fn=None,
             checkpoint_manager=None, resume_from=None,
-            checkpoint_every_n_batches=None):
+            checkpoint_every_n_batches=None, device_prefetch=None):
         """Full training loop (reference: base_module.py fit:410).
+
+        ``device_prefetch=K`` (or the ``MXNET_DEVICE_PREFETCH`` env
+        knob) wraps *train_data* in a
+        :class:`~mxnet_tpu.io.DevicePrefetcher`: host decode and the
+        host→device transfer run on a background thread into a ring of
+        K device-resident batches, so the fused step never waits on
+        input (see docs/perf_input_pipeline.md).  Job-state capture
+        and mid-epoch resume go THROUGH the wrapper — checkpoint and
+        resume with the same wrapping, or the restored data-pipeline
+        state will name the wrong iterator type.
 
         With a :class:`~mxnet_tpu.resilience.CheckpointManager`, each
         epoch end writes a crash-safe checkpoint through it, and a
@@ -203,6 +213,34 @@ class BaseModule:
           from a dead process.
         """
         assert num_epoch is not None, "please specify number of epochs"
+        from ..io.device_prefetch import maybe_wrap
+        ctxs = getattr(self, "_context", None)
+        train_data, created_prefetcher = maybe_wrap(
+            train_data, device_prefetch,
+            device=ctxs[0] if ctxs else None)
+        try:
+            return self._fit_loop(
+                train_data, eval_data, eval_metric, epoch_end_callback,
+                batch_end_callback, kvstore, optimizer, optimizer_params,
+                eval_end_callback, eval_batch_end_callback, initializer,
+                arg_params, aux_params, allow_missing, force_rebind,
+                force_init, begin_epoch, num_epoch, validation_metric,
+                monitor, checkpoint_manager, resume_from,
+                checkpoint_every_n_batches)
+        finally:
+            if created_prefetcher:
+                # release the ring (depth x batch bytes of device
+                # memory) and its producer thread with the loop
+                train_data.close()
+
+    def _fit_loop(self, train_data, eval_data, eval_metric,
+                  epoch_end_callback, batch_end_callback, kvstore,
+                  optimizer, optimizer_params, eval_end_callback,
+                  eval_batch_end_callback, initializer, arg_params,
+                  aux_params, allow_missing, force_rebind, force_init,
+                  begin_epoch, num_epoch, validation_metric, monitor,
+                  checkpoint_manager, resume_from,
+                  checkpoint_every_n_batches):
         from .. import initializer as init_mod
         from .. import resilience
         from ..resilience import supervisor as _sup
@@ -328,6 +366,13 @@ class BaseModule:
                     # process (in-process resume) must actually train
                     resilience.clear_preemption()
                     return
+
+            # epoch boundary: settle any deferred async-guard
+            # readbacks so divergence actions and counters never
+            # cross an epoch (MXNET_GUARD_READBACK_LAG)
+            drain = getattr(self, "drain_guard_readbacks", None)
+            if drain is not None:
+                drain()
 
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)

@@ -238,6 +238,14 @@ class Module(BaseModule):
         self._guard = None
         self._guard_skipped = 0     # total skipped steps
         self._guard_consec = 0      # consecutive skipped steps
+        # async guard accounting (MXNET_GUARD_READBACK_LAG): deferred
+        # skipped-flag device scalars, resolved FIFO with bounded lag
+        # so the host never blocks on step N's readback before
+        # dispatching step N+1 (full-fused path only — the partial
+        # path needs the flag synchronously for its host-side aux
+        # restore).  See docs/perf_input_pipeline.md.
+        import collections
+        self._guard_pending = collections.deque()
         self._step_seq = 0          # forward_backward_update calls
         #                             (chaos nan-injection index)
         self._forward_pad = 0       # rows the last inference forward
@@ -351,6 +359,11 @@ class Module(BaseModule):
         """The module's resumable non-parameter fragment for
         :class:`~mxnet_tpu.resilience.TrainJobState`:
 
+        Deferred guard readbacks are drained first — the guard
+        counters captured here must cover every step already
+        dispatched, or a resumed job would forget skipped steps whose
+        readbacks were still in flight.
+
         * ``step_seq`` — the global forward_backward_update count
           (chaos step indexing, guard event stamps);
         * guard counters (``guard_skipped`` / ``guard_consec``) so a
@@ -362,6 +375,7 @@ class Module(BaseModule):
           precisely iff BOTH of those are restored (``.states`` blobs
           carry momenta, not counts)."""
         assert self.binded
+        self.drain_guard_readbacks()
         frag = {"step_seq": self._step_seq,
                 "guard_skipped": self._guard_skipped,
                 "guard_consec": self._guard_consec,
@@ -761,6 +775,9 @@ class Module(BaseModule):
         the ``MXNET_GUARD_MAX_BAD_STEPS`` env default (0 = skip and
         count only).  Explicit configuration overrides the
         ``MXNET_GUARD_NONFINITE`` env knob in both directions."""
+        # reconfiguring must not orphan readbacks deferred under the
+        # OLD config — account them against it first
+        self.drain_guard_readbacks(_cfg=self._guard_cfg())
         if enabled:
             if max_consecutive is None:
                 from ..config import get_env
@@ -779,8 +796,54 @@ class Module(BaseModule):
     @property
     def nonfinite_skipped(self):
         """Total training steps the guard skipped for non-finite
-        loss/gradients."""
+        loss/gradients (drains any deferred readbacks first, so the
+        count covers every step already dispatched)."""
+        self.drain_guard_readbacks()
         return self._guard_skipped
+
+    def _guard_lag(self):
+        """Allowed guard-readback lag in steps (0 = synchronous)."""
+        from ..config import get_env
+        return max(0, get_env("MXNET_GUARD_READBACK_LAG"))
+
+    def _account_guard(self, skipped_scalar, guard):
+        """Account one full-fused step's guard flag: synchronously at
+        lag 0, else parked in the FIFO and resolved once it is more
+        than *lag* steps old — the host dispatches ahead while the
+        device finishes, and divergence actions still fire within the
+        documented lag bound (FIFO order preserves the consecutive-bad
+        counting exactly)."""
+        lag = self._guard_lag()
+        if lag <= 0:
+            # one scalar device->host read per step — the price of a
+            # synchronous host-visible skip counter
+            self._note_guard(int(skipped_scalar), guard)
+            return
+        # park the dispatch-time step with the scalar: events and
+        # divergence actions must blame the step that DIVERGED, not
+        # the later step whose dispatch resolved the readback
+        self._guard_pending.append((skipped_scalar, self._step_seq))
+        while len(self._guard_pending) > lag:
+            scalar, step = self._guard_pending.popleft()
+            self._note_guard(int(scalar), guard, step=step)
+
+    def drain_guard_readbacks(self, _cfg=None):
+        """Resolve every deferred guard readback NOW (blocks on the
+        device).  Called at epoch end, on preemption, before job-state
+        capture, and on guard reconfiguration — the points where the
+        counters must be exact.  A pending divergence action fires
+        here (FIFO, same counting as the synchronous path)."""
+        if not self._guard_pending:
+            return
+        cfg = _cfg or self._guard_cfg()
+        if cfg is None:
+            # the guard was turned off (env knob flip) with readbacks
+            # in flight: still count the skips, with no action armed
+            cfg = {"enabled": True, "max_consecutive": 0,
+                   "action": "raise", "manager": None}
+        while self._guard_pending:
+            scalar, step = self._guard_pending.popleft()
+            self._note_guard(int(scalar), cfg, step=step)
 
     def _guard_cfg(self):
         """Active guard config dict, or None when the guard is off
@@ -818,9 +881,14 @@ class Module(BaseModule):
                     return True
         return False
 
-    def _note_guard(self, skipped, guard):
+    def _note_guard(self, skipped, guard, step=None):
         """Account one guarded step; fire the divergence action after
-        max_consecutive bad steps in a row."""
+        max_consecutive bad steps in a row.  *step* is the step_seq
+        the flag belongs to — deferred readbacks
+        (MXNET_GUARD_READBACK_LAG) resolve after later steps have
+        dispatched, so the event must carry the dispatch-time stamp."""
+        if step is None:
+            step = self._step_seq
         if not skipped:
             self._guard_consec = 0
             return
@@ -829,7 +897,7 @@ class Module(BaseModule):
         self._guard_skipped += 1
         self._guard_consec += 1
         _prof.bump_counter("guard_skipped_steps")
-        _obs_events.emit("guard", step=self._step_seq,
+        _obs_events.emit("guard", step=step,
                          consecutive=self._guard_consec,
                          total_skipped=self._guard_skipped)
         self.logger.warning(
@@ -839,14 +907,15 @@ class Module(BaseModule):
         limit = guard.get("max_consecutive") or 0
         if limit and self._guard_consec >= limit:
             self._guard_consec = 0
-            self._on_divergence(guard)
+            self._on_divergence(guard, step=step)
 
-    def _on_divergence(self, guard):
+    def _on_divergence(self, guard, step=None):
         from ..resilience import DivergenceError
         from ..observability import events as _obs_events
         action = guard.get("action", "raise")
         _obs_events.emit(
-            "guard", divergence=True, step=self._step_seq,
+            "guard", divergence=True,
+            step=self._step_seq if step is None else step,
             action=action if isinstance(action, str) else "callable",
             total_skipped=self._guard_skipped)
         if callable(action):
@@ -931,7 +1000,9 @@ class Module(BaseModule):
             self._setup_fused()
         if self._fused["guard"] != (guard is not None):
             # guard toggled mid-run (set_nonfinite_guard or the env
-            # knob): the guard is compiled into the program
+            # knob): the guard is compiled into the program; deferred
+            # readbacks from the old program settle first
+            self.drain_guard_readbacks()
             self._fused = None
             self._setup_fused()
         if self._fused_state is None:
@@ -945,6 +1016,9 @@ class Module(BaseModule):
         """forward_backward + update, with the host-side mirror of the
         in-graph guard when one is configured (the composed path keeps
         subclass overrides live, so the check must stay outside)."""
+        # a path switch (fused -> legacy mid-run) settles any deferred
+        # fused-path readbacks before this step's synchronous check
+        self.drain_guard_readbacks()
         aux_snap = self._snapshot_aux() if guard is not None else None
         self.forward_backward(data_batch)
         if guard is not None and self._grads_nonfinite():
@@ -1174,9 +1248,11 @@ class Module(BaseModule):
                 % self._step_seq)
         self._params_dirty = True
         if ctx["guard"]:
-            # one scalar device->host read per step — the price of a
-            # host-visible skip counter (see docs/resilience.md)
-            self._note_guard(int(skipped), self._guard_cfg())
+            # sync (lag 0) or bounded-lag async accounting of the
+            # in-graph skip flag — the param-protecting where-select
+            # already ran on-device either way (docs/resilience.md,
+            # docs/perf_input_pipeline.md)
+            self._account_guard(skipped, self._guard_cfg())
 
     def _run_fused_partial(self, data_batch):
         from ..optimizer import tree_opt

@@ -35,6 +35,10 @@ _HOST_TRANSFERS = _metrics.counter(
 _HOST_TRANSFER_BYTES = _metrics.counter(
     "host_transfer_bytes_total",
     "bytes moved device->host through asnumpy")
+_DEVICE_PUT_ELIDED = _metrics.counter(
+    "device_put_elided_total",
+    "host->device transfers skipped because the array was already "
+    "committed to its target device/sharding (device-resident input)")
 
 __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
            "zeros_like", "ones_like", "concatenate", "imperative_invoke",
@@ -597,14 +601,54 @@ def imperative_invoke(op_name, *nd_inputs, out=None, **params):
 # ---------------------------------------------------------------------------
 
 
+def _already_placed(arr, dev):
+    """Is *arr* a live jax array COMMITTED to exactly *dev*?  Only a
+    committed array may skip ``device_put``: committedness is part of
+    the jit cache key (the graftsan recompile lesson — see
+    Module._setup_fused), so eliding for an uncommitted array would
+    flip it between steps and silently recompile the fused program."""
+    if not isinstance(arr, jax.Array) or \
+            not getattr(arr, "_committed", False):
+        return False
+    try:
+        return arr.devices() == {dev}
+    except RuntimeError as e:
+        # a donated/deleted buffer: let device_put raise the real
+        # use-after-donate error at the transfer site
+        import logging
+        logging.getLogger(__name__).debug(
+            "_already_placed probe failed (%s); routing through "
+            "device_put", e)
+        return False
+
+
 def _place(arr, ctx):
     ctx = Context(ctx) if ctx is not None else current_context()
-    return jax.device_put(arr, ctx.jax_device)
+    dev = ctx.jax_device
+    if _already_placed(arr, dev):
+        # device-resident input (e.g. a DevicePrefetcher ring batch):
+        # the put would be a committed->same-device no-op — skip it
+        # and count the skip (docs/perf_input_pipeline.md)
+        _DEVICE_PUT_ELIDED.inc()
+        return arr
+    return jax.device_put(arr, dev)
 
 
 def array(source_array, ctx=None, dtype=None):
     if isinstance(source_array, NDArray):
-        source_array = source_array.asnumpy()
+        if getattr(source_array, "_aux", None) is not None:
+            # sparse: _data is values-only; array() densifies
+            source_array = source_array.asnumpy()
+        else:
+            # dense: share the (immutable) device buffer instead of a
+            # device->host->device round-trip; mutation rebinds
+            # handles, so copy semantics are preserved
+            arr = source_array._data
+            if dtype is None and arr.dtype == _np.float64:
+                dtype = "float32"  # reference float-array default
+            if dtype is not None and arr.dtype != np_dtype(dtype):
+                arr = arr.astype(np_dtype(dtype))
+            return NDArray(_place(arr, ctx))
     np_arr = _np.asarray(source_array)
     if dtype is None and np_arr.dtype == _np.float64:
         dtype = "float32"  # reference defaults float arrays to float32

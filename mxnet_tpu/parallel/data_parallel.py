@@ -30,8 +30,16 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .mesh import make_mesh
 from ..ndarray import NDArray
+from ..observability import metrics as _obs_metrics
 
 __all__ = ["ParallelTrainer"]
+
+# module-level instrument ref (hot path: consulted per fit_batch) —
+# same registry instrument the ndarray/executor placement paths bump
+_DEVICE_PUT_ELIDED = _obs_metrics.counter(
+    "device_put_elided_total",
+    "host->device transfers skipped because the array was already "
+    "committed to its target device/sharding (device-resident input)")
 
 
 # optimizer name -> (update op, number of zero-init states).
@@ -574,9 +582,12 @@ class ParallelTrainer:
                                                    jnp.floating):
             x = x.astype(jnp.bfloat16)
         sh = NamedSharding(self.mesh, P("dp"))
-        # already resident with the right layout (e.g. the caller reuses
-        # the batch array a previous step produced) — skip the transfer
+        # already resident with the right layout (a DevicePrefetcher
+        # ring batch, or the caller reusing an array a previous step
+        # produced) — skip the transfer (counted, see
+        # docs/perf_input_pipeline.md)
         if isinstance(x, jax.Array) and getattr(x, "sharding", None) == sh:
+            _DEVICE_PUT_ELIDED.inc()
             return x
         # on a multihost mesh each process feeds only ITS rows and
         # _put assembles the global batch (multihost feeding contract)
@@ -588,10 +599,14 @@ class ParallelTrainer:
     def _label_batch(self, y):
         if isinstance(y, NDArray):
             y = y._data
+        sh = NamedSharding(self.mesh, P("dp"))
+        if isinstance(y, jax.Array) and getattr(y, "sharding", None) == sh:
+            _DEVICE_PUT_ELIDED.inc()
+            return y
         return self._put(y, P("dp"))
 
     def fit(self, train_data, num_epoch=1, checkpoint_prefix=None,
-            batch_end_callback=None, logger=None):
+            batch_end_callback=None, logger=None, device_prefetch=None):
         """Epoch/batch loop over a ``DataIter`` — the trainer-level
         peer of ``Module.fit``, with the SAME batch-boundary
         resilience contract: a preemption request (SIGTERM flag,
@@ -599,7 +614,32 @@ class ParallelTrainer:
         writes a full-state checkpoint (params + optimizer state +
         aux + update counter, when *checkpoint_prefix* is given) and
         returns cleanly; every batch ticks the supervisor heartbeat.
-        Returns the last batch's loss per epoch."""
+        Returns the last batch's loss per epoch.
+
+        ``device_prefetch=K`` (or ``MXNET_DEVICE_PREFETCH``) wraps
+        *train_data* in a ``DevicePrefetcher`` bound to this trainer's
+        MESH: batches arrive as ``NamedSharding(mesh, P('dp'))``
+        arrays, so ``fit_batch``'s ``_device_batch`` skips its
+        transfer entirely (docs/perf_input_pipeline.md)."""
+        from ..io.device_prefetch import maybe_wrap
+        # on a multi-host mesh device_put cannot place a global batch
+        # (host_local_to_global owns that path in _device_batch) — the
+        # wrap degrades to host-side decode overlap so batches reach
+        # _device_batch unplaced and its multihost path runs once, not
+        # after a wasted single-device transfer
+        train_data, created_prefetcher = maybe_wrap(
+            train_data, device_prefetch, mesh=self.mesh,
+            decode_only=self._multihost)
+        try:
+            return self._fit_loop(train_data, num_epoch,
+                                  checkpoint_prefix, batch_end_callback,
+                                  logger)
+        finally:
+            if created_prefetcher:
+                train_data.close()
+
+    def _fit_loop(self, train_data, num_epoch, checkpoint_prefix,
+                  batch_end_callback, logger):
         import logging as _logging
         from .. import resilience
         from ..resilience import supervisor as _sup

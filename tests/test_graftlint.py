@@ -1,6 +1,6 @@
 """graftlint static analyzer (tools/graftlint).
 
-Covers: a positive and a negative fixture per rule (JG001–JG009),
+Covers: a positive and a negative fixture per rule (JG001–JG013),
 suppression syntax, the baseline workflow, the CLI (exit codes, JSON,
 scrapeable summary line), the guarantee that the shipped mxnet_tpu
 tree is clean, the runtime registry cross-check (every register_op
@@ -678,6 +678,60 @@ def test_jg012_negative(tmp_path):
             t0 = time.perf_counter()
             return time.perf_counter() - t0 > 1.0
         """, rules=["JG012"])
+    assert fs == []
+
+
+def test_jg013_positive_sync_in_step_loop(tmp_path):
+    fs = lint(tmp_path, """\
+        def train(mod, it, metric):
+            for batch in it:
+                mod.forward_backward_update(batch)
+                loss = mod.get_outputs()[0].asnumpy()   # per-step sync
+                metric.update(loss)
+
+        def serve(predictor, reqs):
+            while reqs:
+                out = predictor.predict_batch(reqs.pop())
+                print(out.item())                       # per-step sync
+        """, rules=["JG013"])
+    assert len(fs) == 2, fs
+    assert rule_ids(fs) == ["JG013"] * 2
+    assert "dispatches steps" in fs[0].message
+    assert "MXNET_GUARD_READBACK_LAG" in fs[0].message
+
+
+def test_jg013_positive_block_until_ready(tmp_path):
+    fs = lint(tmp_path, """\
+        def fit_epoch(trainer, batches):
+            for x, y in batches:
+                loss = trainer.fit_batch(x, y)
+                loss.block_until_ready()
+        """, rules=["JG013"])
+    assert len(fs) == 1
+    assert ".block_until_ready()" in fs[0].message
+
+
+def test_jg013_negative(tmp_path):
+    fs = lint(tmp_path, """\
+        def train_overlapped(mod, it, metric):
+            losses = []
+            for batch in it:
+                mod.forward_backward_update(batch)
+                losses.append(mod.get_outputs()[0])
+            # sync hoisted out of the loop: one drain at the end
+            return [l.asnumpy() for l in losses]
+
+        def decode_loop(batches):
+            # syncs in a loop that dispatches no steps: fine
+            return [b.asnumpy() for b in batches]
+
+        def launcher(mod, it):
+            # a def inside the loop runs when CALLED, not per step
+            for batch in it:
+                def flush():
+                    return mod.get_outputs()[0].asnumpy()
+                mod.forward_backward_update(batch)
+        """, rules=["JG013"])
     assert fs == []
 
 

@@ -154,13 +154,35 @@ def test_ndarrayiter_state_roundtrip_shuffled():
         np.testing.assert_array_equal(a, b)
 
 
-def test_prefetchingiter_state_counts_consumed_not_prefetched():
-    it = PrefetchingIter(_toy_iter())
+@pytest.mark.parametrize("depth", [1, 2, 4])
+def test_prefetchingiter_state_counts_consumed_not_prefetched(depth):
+    # consumed-batch accounting must be ring-depth invariant: a deeper
+    # ring runs the producer further AHEAD of the consumer, but the
+    # resume cursor counts only batches DELIVERED
+    it = PrefetchingIter(_toy_iter(), prefetch_depth=depth)
     _collect(it, 2)
     st = it.state_dict()
     assert st["consumed"] == 2
     rest = _collect(it, 2)
-    it2 = PrefetchingIter(_toy_iter())
+    it2 = PrefetchingIter(_toy_iter(), prefetch_depth=depth)
+    it2.load_state(st)
+    rest2 = _collect(it2, 2)
+    for a, b in zip(rest, rest2):
+        np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.parametrize("depth", [1, 2, 4])
+def test_device_prefetcher_state_counts_consumed_not_prefetched(depth):
+    # same contract one layer lower: the device-resident ring holds
+    # depth prefetched-and-transferred batches, none of which may leak
+    # into the resume cursor
+    from mxnet_tpu.io import DevicePrefetcher
+    it = DevicePrefetcher(_toy_iter(), depth=depth)
+    _collect(it, 2)
+    st = it.state_dict()
+    assert st["consumed"] == 2
+    rest = _collect(it, 2)
+    it2 = DevicePrefetcher(_toy_iter(), depth=depth)
     it2.load_state(st)
     rest2 = _collect(it2, 2)
     for a, b in zip(rest, rest2):

@@ -1002,6 +1002,88 @@ def check_jg012(project):
 
 
 # ---------------------------------------------------------------------------
+# JG013 — blocking host sync inside a step-dispatch loop
+# ---------------------------------------------------------------------------
+
+#: attribute calls that dispatch a train/predict step (the loop bodies
+#: whose throughput the async dispatch pipeline protects)
+_JG013_STEP_CALLS = {
+    "forward_backward_update", "forward_backward", "fit_batch",
+    "evaluate_batch", "predict_batch", "train_step",
+}
+#: attribute calls that block the host on the device (a per-step sync
+#: serializes the loop: step N+1 cannot dispatch until N drains)
+_JG013_SYNC_CALLS = {
+    "asnumpy", "asscalar", "item", "tolist", "block_until_ready",
+    "wait_to_read", "waitall",
+}
+
+
+def check_jg013(project):
+    """A loop that dispatches train/predict steps AND blocks on a
+    device→host sync every iteration: jax dispatch is async, so the
+    loop's steady-state throughput should be the device step time —
+    one ``.asnumpy()``/``.item()``/``.block_until_ready()`` per
+    iteration re-serializes it to (host work + device step) per step
+    (the PR-3 guard readback was exactly this; see
+    docs/perf_input_pipeline.md).  Move the sync out of the loop
+    (read back once at the end), batch it with a bounded lag (the
+    ``MXNET_GUARD_READBACK_LAG`` pattern), or suppress with a comment
+    when the readback IS the point (metrics flush, debugging)."""
+    out = []
+    seen = set()
+    for m in project.modules:
+        for loop in _jg013_loops(m.tree):
+            body_calls = [n for n in _jg013_loop_body_walk(loop)
+                          if isinstance(n, ast.Call)
+                          and isinstance(n.func, ast.Attribute)]
+            dispatches = [c for c in body_calls
+                          if c.func.attr in _JG013_STEP_CALLS]
+            if not dispatches:
+                continue
+            for c in body_calls:
+                if c.func.attr not in _JG013_SYNC_CALLS:
+                    continue
+                key = (m.relpath, c.lineno, c.col_offset)
+                if key in seen:
+                    continue   # nested loops: report each sync once
+                seen.add(key)
+                out.append(Finding(
+                    "JG013", m.relpath, c.lineno, c.col_offset,
+                    ".%s() blocks the host inside a loop that "
+                    "dispatches steps (.%s() at line %d): every "
+                    "iteration now waits for the device to drain, so "
+                    "step N+1 cannot overlap step N — hoist the sync "
+                    "out of the loop or give it a bounded lag (the "
+                    "MXNET_GUARD_READBACK_LAG pattern, "
+                    "docs/perf_input_pipeline.md)"
+                    % (c.func.attr, dispatches[0].func.attr,
+                       dispatches[0].lineno)))
+    return out
+
+
+def _jg013_loops(tree):
+    """Every for/while node in *tree* (nested defs included — a loop
+    is a loop wherever it lives)."""
+    return [n for n in ast.walk(tree)
+            if isinstance(n, (ast.For, ast.While))]
+
+
+def _jg013_loop_body_walk(loop):
+    """Walk a loop's body stopping at nested function/class defs: a
+    def inside the loop runs when CALLED, not per iteration, so its
+    syncs are not this loop's per-step syncs."""
+    stack = list(loop.body) + list(loop.orelse)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# ---------------------------------------------------------------------------
 
 ALL_RULES = {
     "JG001": check_jg001,
@@ -1016,6 +1098,7 @@ ALL_RULES = {
     "JG010": check_jg010,
     "JG011": check_jg011,
     "JG012": check_jg012,
+    "JG013": check_jg013,
 }
 
 RULE_DOCS = {
@@ -1050,4 +1133,9 @@ RULE_DOCS = {
     "JG012": "wall-clock deadline hazard: time.time() used to compute "
              "a timeout/deadline compared against elapsed time (NTP "
              "steps break watchdogs; use time.monotonic())",
+    "JG013": "blocking host sync (.asnumpy()/.item()/"
+             ".block_until_ready()/...) inside a loop that dispatches "
+             "train/predict steps — re-serializes the async dispatch "
+             "pipeline to host+device per step; hoist the sync or "
+             "bound its lag",
 }
