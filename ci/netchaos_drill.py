@@ -36,6 +36,8 @@ import tempfile
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)    # the elastic driver imports mxnet_tpu
 PY = sys.executable
 STEPS = 3
 N_WORKERS = 2
@@ -97,6 +99,155 @@ print("CHAOSFIRED", json.dumps({k: chaos.fired(k) for k in
       flush=True)
 '''
 
+
+# ---------------------------------------------------------------------------
+# Elastic scenarios (ROADMAP item 7 / docs/resilience.md "Elastic
+# training"): real dist_sync SGD jobs (linear regression, two param
+# keys sharded across the servers' hash space) that shrink, grow, and
+# resize N->M under load WITHOUT a restart.  Asserts per scenario:
+#   * exactly-once sample coverage per epoch across every resize
+#     (the workers log the global indices they consumed; the driver
+#     unions them) — skipped only where a worker is hard-killed,
+#   * zero lost accepted pushes: per-server applies == completed
+#     rounds x keys-on-server,
+#   * every completing worker pulled the SAME final weights,
+#   * convergence equivalence: the elastic run's final MSE within
+#     tolerance of the fixed-size baseline's,
+#   * retired ranks exit rc 0 printing RETIRED; joiners are admitted
+#     and consume their shard.
+# Scrapeable: "elastic: resizes=N joins=M evictions=K ok" before the
+# final netchaos summary line.
+# ---------------------------------------------------------------------------
+
+ELASTIC_WORKER = r'''
+import os, sys, json, time
+sys.path.insert(0, os.environ["NC_REPO"])
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.io import NDArrayIter
+
+rank = int(os.environ["DMLC_WORKER_RANK"])
+EPOCHS = int(os.environ.get("EW_EPOCHS", "3"))
+joiner = os.environ.get("EW_JOINER") == "1"
+die_rank = int(os.environ.get("EW_DIE_RANK", "-1"))
+die_round = int(os.environ.get("EW_DIE_AFTER_ROUND", "0"))
+round_sleep = float(os.environ.get("EW_ROUND_SLEEP", "0"))
+N, D, B, LR, SEED = 48, 4, 2, 0.12, 13
+rs = np.random.RandomState(7)
+X = rs.randn(N, D)
+w_true = rs.randn(D)
+y = X @ w_true
+
+def make_iter(pos, active):
+    return NDArrayIter({"data": X.astype(np.float32)},
+                       {"label": y.astype(np.float32)}, batch_size=B,
+                       shuffle=True, shuffle_seed=SEED,
+                       last_batch_handle="pad",
+                       part_index=pos, num_parts=active)
+
+kv = mx.kv.create("dist_sync")
+if not joiner:
+    kv.init("wa", nd.zeros((2,)))
+    kv.init("wb", nd.zeros((2,)))
+    kv.set_optimizer(mx.optimizer.create(
+        "sgd", learning_rate=1.0, rescale_grad=1.0, wd=0.0))
+    view = kv.membership()
+    members, mep = sorted(view["members"]), view["mep"]
+    pos, active = members.index(rank), len(members)
+    it = make_iter(pos, active)
+    epoch = 0
+else:
+    kv.wait_admission()
+    admitted_round = kv._barrier_round
+    # take the shard assignment from the job metadata the survivors
+    # published at (or after) the admission round: that is the EXACT
+    # member list they re-sharded under — a fresh stats read could
+    # already include a later admission they have not re-sharded for
+    deadline = time.monotonic() + 90
+    while True:
+        meta = kv.get_job_meta()
+        if meta and meta.get("round", -1) >= admitted_round \
+                and rank in meta.get("members", ()):
+            break
+        assert time.monotonic() < deadline, "joiner: no job metadata"
+        time.sleep(0.1)
+    members, mep = sorted(meta["members"]), meta["mep"]
+    pos, active = members.index(rank), len(members)
+    it = make_iter(0, 1)
+    it.load_state(meta["data"])
+    it.repartition(pos, active)
+    epoch = int(meta["epoch"])
+    print("JOINED", rank, json.dumps({"round": admitted_round,
+                                      "epoch": epoch}), flush=True)
+
+out_a, out_b = nd.zeros((2,)), nd.zeros((2,))
+kv.pull("wa", out=out_a)
+kv.pull("wb", out=out_b)
+w = np.concatenate([out_a.asnumpy(), out_b.asnumpy()]).astype(np.float64)
+consumed = []          # [epoch, [global indices]] per batch
+accepted = 0           # pushes acknowledged (rounds participated)
+retired = False
+while epoch < EPOCHS and not retired:
+    while True:
+        try:
+            batch = it.next()
+        except StopIteration:
+            break
+        sel = np.asarray(batch.index, np.int64)
+        real = sel[:len(sel) - batch.pad]
+        consumed.append([epoch, [int(i) for i in real]])
+        xb, yb = X[real], y[real]
+        g = xb.T @ (xb @ w - yb) * (LR / (B * active))
+        kv.push("wa", nd.array(g[:2].astype(np.float32)))
+        kv.push("wb", nd.array(g[2:].astype(np.float32)))
+        accepted += 1
+        if die_round and rank == die_rank and accepted >= die_round:
+            os._exit(0)   # crash: no barrier, no stop, heartbeats cease
+        kv.barrier()
+        view = kv.membership()
+        if view["mep"] != mep:
+            mep = view["mep"]
+            members = sorted(view["members"])
+            if rank not in members:
+                print("RETIRED", rank, json.dumps(
+                    {"epoch": epoch, "consumed": consumed,
+                     "accepted": accepted}), flush=True)
+                retired = True
+                break
+            pos, active = members.index(rank), len(members)
+            it.repartition(pos, active)
+        if rank == min(members):
+            kv.put_job_meta({"round": kv._barrier_round, "epoch": epoch,
+                             "mep": mep, "members": members,
+                             "data": it.state_dict()})
+        kv.pull("wa", out=out_a)
+        kv.pull("wb", out=out_b)
+        w = np.concatenate([out_a.asnumpy(),
+                            out_b.asnumpy()]).astype(np.float64)
+        if round_sleep:
+            time.sleep(round_sleep)
+    epoch += 1
+    if epoch < EPOCHS and not retired:
+        it.reset()
+
+if not retired:
+    mse = float(np.mean((X @ w - y) ** 2))
+    print("RESULT", rank, json.dumps(
+        {"consumed": consumed, "final_w": [float(v) for v in w],
+         "mse": mse, "accepted": accepted}), flush=True)
+    kv.barrier()
+    if rank == 0:
+        stats = [kv.server_stats(server=s) for s in
+                 range(int(os.environ.get("DMLC_NUM_SERVER", "1")))]
+        print("STATS", json.dumps(stats), flush=True)
+    kv.barrier()
+    if rank == 0:
+        kv.stop_server()
+'''
 
 def _spec(d):
     return ",".join("%s=%d" % (k, v) for k, v in sorted(d.items()))
@@ -257,6 +408,226 @@ def _run_class(name, procs, worker_chaos=None, server_chaos=None,
     return fired
 
 
+# ---------------------------------------------------------------------------
+# Elastic driver
+# ---------------------------------------------------------------------------
+
+N_SAMPLES = 48          # must match ELASTIC_WORKER's N
+ELASTIC_SERVERS = 2
+
+
+def _server0_keys():
+    """How many of the two param keys the crc32 shard map puts on
+    server 0 (the server the driver polls for round progress)."""
+    import zlib
+    return sum(1 for k in ("wa", "wb")
+               if zlib.crc32(k.encode()) % ELASTIC_SERVERS == 0)
+
+
+def _elastic_stats(port, server=0):
+    import socket
+    from mxnet_tpu._kvstore_impl import _rpc_call, _MSG_CMD
+    s = socket.create_connection(("127.0.0.1", port + server),
+                                 timeout=10)
+    try:
+        return _rpc_call(s, _MSG_CMD, {"head": "stats"})[0]
+    finally:
+        s.close()
+
+
+def _wait_stats(port, cond, what, deadline_s=120):
+    """Poll server 0's stats until *cond(stats)* holds — the drill's
+    'under load' trigger points are expressed in observable training/
+    membership progress, not wall-clock guesses (a joiner's python+jax
+    import alone can take seconds under CI load)."""
+    deadline = time.time() + deadline_s
+    while True:
+        try:
+            st = _elastic_stats(port)
+            if cond(st):
+                return st
+        except (ConnectionError, OSError):
+            pass
+        assert time.time() < deadline, "timed out waiting for " + what
+        time.sleep(0.1)
+
+
+def _wait_rounds(port, rounds, deadline_s=120):
+    per_round = max(1, _server0_keys())
+    return _wait_stats(
+        port, lambda st: st["applies"] >= rounds * per_round,
+        "%d completed rounds" % rounds, deadline_s)
+
+
+def _spawn_elastic_worker(env, rank, joiner=False, die_after=0):
+    wenv = dict(env, DMLC_ROLE="worker", DMLC_WORKER_RANK=str(rank))
+    if joiner:
+        wenv["EW_JOINER"] = "1"
+    if die_after:
+        wenv["EW_DIE_RANK"] = str(rank)
+        wenv["EW_DIE_AFTER_ROUND"] = str(die_after)
+    return subprocess.Popen([PY, "-c", ELASTIC_WORKER], env=wenv,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE)
+
+
+def run_elastic(name, port, init_world, ops=(), die=None,
+                expect_cover=True, epochs=3):
+    procs = []
+    try:
+        return _run_elastic(name, procs, port, init_world, ops, die,
+                            expect_cover, epochs)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
+def _run_elastic(name, procs, port, init_world, ops, die,
+                 expect_cover, epochs):
+    """One elastic scenario.  *ops* is a timeline of
+    ``(after_rounds, action, arg)`` with action in:
+      'resize'  — operator_resize(arg) against the live job,
+      'spawn'   — start a joiner worker with rank *arg*.
+    *die* = (rank, after_its_round_k): that worker hard-exits with no
+    ceremony (eviction path).  Returns the scenario's summary dict."""
+    from mxnet_tpu.resilience.elastic import operator_resize
+    env = dict(os.environ)
+    env.pop("MXNET_CHAOS", None)
+    env.update({
+        "NC_REPO": REPO,
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(port),
+        "DMLC_NUM_WORKER": str(init_world),
+        "DMLC_NUM_SERVER": str(ELASTIC_SERVERS),
+        "MXNET_KVSTORE_HEARTBEAT_INTERVAL": "0.2",
+        "MXNET_KVSTORE_SYNC_TIMEOUT": "4",
+        "MXNET_KVSTORE_EVICT_TIMEOUT": "1.0",
+        "MXNET_KVSTORE_RPC_TIMEOUT": "30",
+        "MXNET_KVSTORE_RPC_RETRIES": "4",
+        "MXNET_KVSTORE_JOIN_TIMEOUT": "90",
+        "MXNET_KVSTORE_ADMIT_POLL": "0.1",
+        "EW_EPOCHS": str(epochs),
+        "EW_ROUND_SLEEP": "0.12",
+        "JAX_PLATFORMS": "cpu",
+    })
+    env.pop("MXNET_KVSTORE_SNAPSHOT_PREFIX", None)
+    servers = [_spawn_server(env, sid, {})
+               for sid in range(ELASTIC_SERVERS)]
+    procs.extend(servers)
+    workers = []          # [(rank, proc)] — a retired rank's process
+    for rank in range(init_world):   # and its later replacement both
+        d = die[1] if die and die[0] == rank else 0   # get collected
+        workers.append((rank, _spawn_elastic_worker(env, rank,
+                                                    die_after=d)))
+    procs.extend(p for _, p in workers)
+
+    resizes = joins_spawned = 0
+    for after_rounds, action, arg in ops:
+        if after_rounds:
+            _wait_rounds(port, after_rounds)
+        if action == "resize":
+            operator_resize(arg, host="127.0.0.1", root_port=port,
+                            num_servers=ELASTIC_SERVERS)
+            resizes += 1
+            print("  [%s] resize -> %d after >=%d rounds"
+                  % (name, arg, after_rounds), flush=True)
+        elif action == "spawn":
+            joiner = _spawn_elastic_worker(env, arg, joiner=True)
+            workers.append((arg, joiner))
+            procs.append(joiner)
+            joins_spawned += 1
+            print("  [%s] joiner rank %d spawned after >=%d rounds"
+                  % (name, arg, after_rounds), flush=True)
+        elif action == "await_members":
+            # gate the timeline on the applied transition, so e.g. a
+            # grow only fires once the shrink retired the old rank,
+            # and the drill only proceeds once joiners are admitted
+            _wait_stats(port,
+                        lambda st: st["members"] == sorted(arg),
+                        "%s membership %s" % (name, sorted(arg)))
+            print("  [%s] membership now %s" % (name, sorted(arg)),
+                  flush=True)
+        elif action == "await_pending":
+            # the joiners' heartbeats prove their processes finished
+            # importing — only then is commanding the grow meaningful
+            _wait_stats(
+                port,
+                lambda st: set(arg) <= set(st["pending_join"])
+                | set(st["members"]),
+                "%s ranks %s announcing themselves" % (name,
+                                                       sorted(arg)))
+            print("  [%s] ranks %s announced" % (name, sorted(arg)),
+                  flush=True)
+
+    results, retireds, joined, stats = {}, [], {}, None
+    for rank, w in workers:
+        stdout, stderr = w.communicate(timeout=240)
+        assert w.returncode == 0, \
+            "[%s] worker %d rc=%r:\n%s" % (name, rank, w.returncode,
+                                           stderr.decode()[-3000:])
+        for line in stdout.decode().splitlines():
+            tag, _, rest = line.partition(" ")
+            if tag == "RESULT":
+                results[rank] = json.loads(rest.split(" ", 1)[1])
+            elif tag == "RETIRED":
+                retireds.append((rank, json.loads(rest.split(" ", 1)[1])))
+            elif tag == "JOINED":
+                joined[rank] = json.loads(rest.split(" ", 1)[1])
+            elif tag == "STATS":
+                stats = json.loads(rest)
+    victim = die[0] if die else None
+
+    # -- exactly-once sample coverage per epoch --------------------------
+    if expect_cover:
+        per_epoch = {}
+        for blob in list(results.values()) + [b for _, b in retireds]:
+            for epoch, idxs in blob["consumed"]:
+                per_epoch.setdefault(epoch, []).extend(idxs)
+        for epoch in range(epochs):
+            counts = {}
+            for i in per_epoch.get(epoch, ()):
+                counts[i] = counts.get(i, 0) + 1
+            missing = [i for i in range(N_SAMPLES) if i not in counts]
+            dupes = {i: c for i, c in counts.items() if c != 1}
+            assert not missing and not dupes, \
+                "[%s] epoch %d coverage not exactly-once: missing=%s " \
+                "dupes=%s" % (name, epoch, missing[:10],
+                              dict(list(dupes.items())[:10]))
+
+    # -- all completing workers pulled the SAME final weights ------------
+    finals = {r: tuple(b["final_w"]) for r, b in results.items()}
+    assert len(set(finals.values())) == 1, \
+        "[%s] divergent final weights: %s" % (name, finals)
+
+    # -- zero lost accepted pushes: applies == rounds x keys -------------
+    assert stats is not None, "[%s] rank 0 printed no STATS" % name
+    rounds = results[0]["accepted"]
+    for st in stats:
+        nkeys = len(st["keys"])
+        assert st["applies"] == rounds * nkeys, \
+            "[%s] server %s: applies=%d != rounds(%d) * keys(%d) — " \
+            "an accepted push was lost or double-applied (%s)" \
+            % (name, st["server_id"], st["applies"], rounds, nkeys, st)
+
+    mse = results[0]["mse"]
+    summary = {"resizes": resizes, "joins": len(joined),
+               "retired": sorted(r for r, _ in retireds), "mse": mse,
+               "rounds": rounds, "mep": stats[0].get("mep"),
+               "members": stats[0].get("members"),
+               "evictions": 1 if victim is not None else 0,
+               "evicted": stats[0].get("evicted")}
+    assert len(joined) == joins_spawned, \
+        "[%s] %d joiners spawned but %d admitted" \
+        % (name, joins_spawned, len(joined))
+    if victim is not None:
+        assert victim in stats[0].get("evicted", ()) or \
+            victim in stats[0].get("members", ()), \
+            "[%s] victim %d neither evicted nor re-admitted: %s" \
+            % (name, victim, stats[0])
+    return summary
+
+
 def main():
     classes = [
         ("baseline", {}),
@@ -296,6 +667,76 @@ def main():
         total_fired += fired
         print("  ok (%d injections, %.1fs)" % (fired, time.time() - t0),
               flush=True)
+
+    # -- elastic scenarios (grow/shrink/resize under load) ---------------
+    scenarios = [
+        # fixed-size reference run: its MSE is the convergence-
+        # equivalence yardstick for every elastic run
+        ("elastic_baseline3", dict(init_world=3)),
+        # operator shrink 3->2 under load: rank 2 retires cleanly,
+        # survivors re-shard the remaining epoch
+        ("elastic_shrink", dict(init_world=3,
+                                ops=[(4, "resize", 2)])),
+        # operator grow 2->3 under load: the joiner is admitted at a
+        # round boundary and takes over its shard mid-epoch.  Spawn
+        # first, command the grow once its heartbeats prove it is up
+        # (imports take seconds under CI load), then gate on the
+        # admission actually landing
+        ("elastic_grow", dict(init_world=2,
+                              ops=[(1, "spawn", 2),
+                                   (0, "await_pending", [2]),
+                                   (0, "resize", 3),
+                                   (0, "await_members", [0, 1, 2])],
+                              epochs=4)),
+        # a worker dies without ceremony (evicted; its in-flight
+        # batch is lost, so coverage is not exactly-once) and a
+        # REPLACEMENT with the same rank rejoins mid-epoch
+        ("elastic_evict_replace", dict(init_world=3, die=(2, 4),
+                                       ops=[(7, "spawn", 2),
+                                            (0, "await_members",
+                                             [0, 1, 2])],
+                                       expect_cover=False, epochs=5)),
+        # the acceptance gate: operator-commanded 3 -> 2 -> 4 chain
+        # under load, exactly-once coverage throughout
+        ("elastic_resize_chain", dict(init_world=3,
+                                      ops=[(4, "resize", 2),
+                                           (0, "await_members",
+                                            [0, 1]),
+                                           (0, "spawn", 2),
+                                           (0, "spawn", 3),
+                                           (0, "await_pending",
+                                            [2, 3]),
+                                           (0, "resize", 4),
+                                           (0, "await_members",
+                                            [0, 1, 2, 3])],
+                                      epochs=6)),
+    ]
+    totals = {"resizes": 0, "joins": 0, "evictions": 0}
+    baseline_mse = None
+    for i, (name, kw) in enumerate(scenarios):
+        t0 = time.time()
+        print("== elastic scenario: %s ==" % name, flush=True)
+        summary = run_elastic(name, port=9710 + 20 * i, **kw)
+        if name == "elastic_baseline3":
+            baseline_mse = summary["mse"]
+        else:
+            # convergence equivalence: same data, same epochs — the
+            # elastic trajectory differs (round grouping changes with
+            # the world size) but must land in the same basin
+            assert summary["mse"] < max(5e-3, 4.0 * baseline_mse), \
+                "[%s] final mse %.5f vs baseline %.5f — elastic run " \
+                "did not converge equivalently" \
+                % (name, summary["mse"], baseline_mse)
+        totals["resizes"] += summary["resizes"]
+        totals["joins"] += summary["joins"]
+        totals["evictions"] += summary["evictions"]
+        print("  ok (%s, %.1fs)" % (
+            ", ".join("%s=%s" % kv for kv in sorted(summary.items())),
+            time.time() - t0), flush=True)
+
+    print("elastic: resizes=%d joins=%d evictions=%d ok"
+          % (totals["resizes"], totals["joins"], totals["evictions"]),
+          flush=True)
     print("netchaos: faults=%d recovered=%d ok"
           % (total_fired, recovered), flush=True)
 

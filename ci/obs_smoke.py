@@ -146,6 +146,48 @@ def main():
             failures.append("input instrument %r has unexpected value: "
                             "%r" % (name, snap[name]))
 
+    # -- elastic membership telemetry ----------------------------------
+    # an in-process server walks join + resize: the active-workers
+    # gauge must track the expected-contributor set and the
+    # 'membership' event kind must record the transition with old/new
+    # epochs (docs/observability.md)
+    import socket
+    import threading
+    from mxnet_tpu._kvstore_impl import (
+        KVStoreServer, _rpc_call, _MSG_HEARTBEAT, _MSG_BARRIER,
+        _MSG_CMD)
+    srv = KVStoreServer(sync_mode=True, num_workers=1)
+    st = threading.Thread(target=srv.run, daemon=True)
+    st.start()
+    conn = socket.create_connection(("127.0.0.1", srv.port), timeout=30)
+    try:
+        _rpc_call(conn, _MSG_CMD, {"head": "resize", "body": 2,
+                                   "req": [0, 1, 1]})
+        _rpc_call(conn, _MSG_HEARTBEAT, {"node": "worker1"})
+        # the grow + admission apply at the barrier boundary
+        _rpc_call(conn, _MSG_BARRIER, {"rank": 0, "round": 1,
+                                       "req": [0, 2, 1]})
+        stats = _rpc_call(conn, _MSG_CMD, {"head": "stats"})[0]
+        if stats.get("members") != [0, 1]:
+            failures.append("membership workout: expected members "
+                            "[0, 1], got %r" % (stats.get("members"),))
+    finally:
+        conn.close()
+        srv._stop.set()
+        try:
+            srv.sock.close()
+        except OSError:
+            pass
+        st.join(timeout=10)
+    snap = metrics.snapshot()
+    if "kvstore_active_workers" not in snap:
+        failures.append("kvstore_active_workers gauge missing from the "
+                        "registry")
+    elif snap["kvstore_active_workers"]["value"] != 2:
+        failures.append("kvstore_active_workers should read 2 after "
+                        "the grow, got %r"
+                        % (snap["kvstore_active_workers"],))
+
     # -- events.jsonl --------------------------------------------------
     ev_path = events.path()
     if not os.path.exists(ev_path):
@@ -169,6 +211,17 @@ def main():
                for e in evs):
         failures.append("no compile event for the fused step in %s"
                         % [e.get("ev") for e in evs])
+    memb = [e for e in evs if e.get("ev") == "membership"]
+    actions = {e.get("action") for e in memb}
+    if not {"resize", "join"} <= actions:
+        failures.append("membership workout should have recorded "
+                        "'resize' and 'join' events, got actions %s"
+                        % sorted(actions))
+    for e in memb:
+        if e.get("action") in ("resize", "join", "rejoin", "evict") \
+                and ("old_epoch" not in e or "new_epoch" not in e):
+            failures.append("membership event lacks old/new epoch: %r"
+                            % (e,))
 
     # -- profiler.dump carries the instruments -------------------------
     trace_path = os.path.join(_tmpdir, "trace.json")

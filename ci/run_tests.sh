@@ -80,8 +80,13 @@ echo "== resilience: network chaos drill (dist kvstore) =="
 # equivalent pulls, exactly-once apply counters, snapshot-restore
 # after a hard kill, and eviction unblocking the survivors.
 # Deterministic counter-armed injections; the only sleeps are the
-# injected delays (docs/resilience.md).  Last stdout line is the
-# scrapeable summary ("netchaos: faults=.. recovered=.. ok").
+# injected delays (docs/resilience.md).  The elastic scenarios follow
+# (grow/shrink/evict+replace/3->2->4 resize chain under load:
+# exactly-once coverage, zero lost accepted pushes, convergence
+# equivalence vs the fixed-size baseline — docs/resilience.md
+# "Elastic training").  Last stdout lines are the scrapeable
+# summaries ("elastic: resizes=.. joins=.. evictions=.. ok" then
+# "netchaos: faults=.. recovered=.. ok").
 python ci/netchaos_drill.py
 
 echo "== resilience: crash-anywhere drill (supervisor + watchdog) =="

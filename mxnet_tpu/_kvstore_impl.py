@@ -42,7 +42,8 @@ from .observability import events as _obs_events
 from .observability import metrics as _metrics
 from .resilience import netchaos as _netchaos
 
-__all__ = ["create", "KVStoreBase", "RPCTimeoutError", "SyncTimeoutError"]
+__all__ = ["create", "KVStoreBase", "RPCTimeoutError", "SyncTimeoutError",
+           "EvictedWorkerError"]
 
 log = logging.getLogger(__name__)
 
@@ -61,6 +62,20 @@ class SyncTimeoutError(MXNetError):
     still missing whose heartbeats are FRESH — an alive-but-slow
     straggler (provably-dead ranks are evicted instead, and the
     survivors proceed).  The message names the laggard rank(s)."""
+
+
+class EvictedWorkerError(MXNetError):
+    """A dist_sync contribution arrived from a worker that is not a
+    CURRENT member of the expected-contributor set — it was evicted
+    (heartbeat went provably stale and the surviving ranks completed
+    rounds without it), retired by an operator ``kv.resize()``, or is
+    a joiner that has not been admitted yet.  Silently merging such a
+    gradient into a later round is exactly the stale-contributor
+    corruption the membership epoch exists to kill, so the server
+    rejects the push with this typed error instead; the worker must
+    re-sync (pull current params through the reinit path, refresh its
+    membership view) before contributing again — or exit cleanly if
+    its rank was resized away."""
 
 # push/pull traffic instruments (module-level refs: these sit on the
 # per-step gradient exchange path).  For the local store "bytes" is
@@ -98,6 +113,16 @@ _APPLIES = _metrics.counter(
     "kvstore_server_applies_total",
     "server-side state mutations (aggregated sync applies + async "
     "per-push applies + first-push creates)")
+_ACTIVE_WORKERS = _metrics.gauge(
+    "kvstore_active_workers",
+    "workers currently admitted to the dist expected-contributor set "
+    "(server-side live membership view; moves on evict/join/rejoin/"
+    "resize)")
+_STALE_REJECTS = _metrics.counter(
+    "kvstore_stale_contributions_rejected_total",
+    "sync pushes rejected with EvictedWorkerError because the pusher "
+    "is not a current member (evicted/retired/unadmitted) or its "
+    "membership view predates its eviction fence")
 
 # after this many consecutive heartbeat failures to one server: one
 # WARN (not a log line per beat) and a backed-off cadence
@@ -535,6 +560,9 @@ def _rpc_call(sock, kind, meta=None, tensors=(), inject=False):
         if rmeta.get("code") == "sync_timeout":
             raise SyncTimeoutError(
                 "kvstore server error: %s" % rmeta.get("msg"))
+        if rmeta.get("code") == "evicted":
+            raise EvictedWorkerError(
+                "kvstore server error: %s" % rmeta.get("msg"))
         raise MXNetError("kvstore server error: %s" % rmeta.get("msg"))
     return rmeta, rtensors
 
@@ -586,8 +614,27 @@ class KVStoreServer:
     def __init__(self, sync_mode, num_workers, host="127.0.0.1",
                  port=None, server_id=0, snapshot_prefix=None):
         self.sync = sync_mode
-        self.num_workers = num_workers
         self.server_id = int(server_id)
+        # -- live membership (elastic distributed training) -------------
+        # The launch-time DMLC_NUM_WORKER is only the INITIAL world: the
+        # expected-contributor set is versioned dynamic state.  Every
+        # change (evict / join / rejoin / operator resize) bumps
+        # ``membership_epoch``, which rides every heartbeat and sync
+        # reply so workers re-shard at the next batch boundary.
+        self.world = int(num_workers)     # operator-commanded target size
+        self.joined = set(range(self.world))   # admitted members
+        self.pending_join = set()   # heartbeating, admitted at a barrier
+        self._rejoining = set()     # pending_join ranks that are rejoins
+        self.pending_world = None   # resize target, applied at a barrier
+        self.membership_epoch = 0
+        # rank -> minimum membership epoch a sync push must declare:
+        # set at eviction/retirement/admission so a push SENT before
+        # the rank lost (or regained) membership can never merge into
+        # a later round (the stale-contributor corruption)
+        self.rank_fence = {}
+        self.admitted_round = {r: 0 for r in range(self.world)}
+        self.barrier_membership = {}   # completed round -> snapshot
+        self.jobmeta = None    # opaque worker-published join metadata
         self.store = {}
         self.pending = {}       # key -> [accum, rank set, req-id set]
         # key -> ranks whose contribution was DROPPED when a sync
@@ -681,8 +728,50 @@ class KVStoreServer:
                           "heartbeats", "barrier_rounds",
                           "barrier_done", "evicted", "dedup",
                           "applies", "pushes_received", "_opt_blob",
-                          "_applied_inflight", "aborted_rounds"),
+                          "_applied_inflight", "aborted_rounds",
+                          "world", "joined", "pending_join",
+                          "pending_world", "membership_epoch",
+                          "rank_fence", "admitted_round", "jobmeta",
+                          "_rejoining"),
                    "KVStoreServer")
+        _ACTIVE_WORKERS.set(len(self.joined))
+
+    @property
+    def num_workers(self):
+        """The CURRENT world size (operator-commanded target).  Kept
+        as a property so legacy readers of the once-frozen constructor
+        value see the live membership view; the expected-contributor
+        set itself is :meth:`_expected_ranks`."""
+        with self.lock:
+            return self.world
+
+    def _membership_snapshot(self):
+        """One consistent view of the live membership (self.lock taken
+        inside) — the payload attached to heartbeat and barrier
+        replies and recorded per completed barrier round."""
+        with self.lock:
+            return {"mep": self.membership_epoch,
+                    "members": sorted(self.joined),
+                    "world": self.world}
+
+    def _bump_membership_locked(self, action, ranks=(), **extra):
+        """Callers hold self.lock already; it is an RLock, and taking
+        it here keeps the write discipline lexically checkable.  One
+        membership transition — bump the epoch, refresh the
+        active-workers gauge, emit the ``membership`` event (old/new
+        epoch + member list, the satellite contract)."""
+        with self.lock:
+            old = self.membership_epoch
+            self.membership_epoch = old + 1
+            members = sorted(self.joined)
+            new = self.membership_epoch
+            world = self.world
+        _ACTIVE_WORKERS.set(len(members))
+        _obs_events.emit("membership", action=action,
+                         ranks=sorted(ranks), old_epoch=old,
+                         new_epoch=new, members=members, world=world,
+                         server=self.server_id, **extra)
+        return old, new
 
     def run(self):
         """Serve until a STOP message (reference: RunServer blocks the
@@ -782,7 +871,14 @@ class KVStoreServer:
                      "applies": self.applies,
                      "str_idx": dict(self._str_idx),
                      "dedup": completed,
-                     "evicted": sorted(self.evicted)}
+                     "evicted": sorted(self.evicted),
+                     "world": self.world,
+                     "joined": sorted(self.joined),
+                     "membership_epoch": self.membership_epoch,
+                     "rank_fence": {str(r): f for r, f in
+                                    self.rank_fence.items()},
+                     "admitted_round": {str(r): rnd for r, rnd in
+                                        self.admitted_round.items()}}
         # store keys may be ints or strings; json round-trips both
         # exactly (a raw str(key) would fold 3 and "3" together)
         params = {json.dumps(k): v for k, v in self.store.items()}
@@ -825,6 +921,19 @@ class KVStoreServer:
                 "epoch_token", self.epoch_token - 1)) + 1
             self.evicted = set(int(r)
                                for r in snap_meta.get("evicted", ()))
+            if "world" in snap_meta:
+                self.world = int(snap_meta["world"])
+                self.joined = set(int(r)
+                                  for r in snap_meta.get("joined", ()))
+                self.membership_epoch = int(
+                    snap_meta.get("membership_epoch", 0))
+                self.rank_fence = {
+                    int(r): int(f) for r, f in
+                    (snap_meta.get("rank_fence") or {}).items()}
+                self.admitted_round = {
+                    int(r): int(rnd) for r, rnd in
+                    (snap_meta.get("admitted_round") or {}).items()}
+                _ACTIVE_WORKERS.set(len(self.joined))
             for client_s, seqs in (snap_meta.get("dedup") or {}).items():
                 rank_s, _, inc_s = client_s.partition(":")
                 client = (int(rank_s), int(inc_s or 0))
@@ -873,12 +982,27 @@ class KVStoreServer:
                     rmeta, rtensors = {"status": "err",
                                        "code": "sync_timeout",
                                        "msg": str(e)}, ()
+                except EvictedWorkerError as e:
+                    rmeta, rtensors = {"status": "err",
+                                       "code": "evicted",
+                                       "msg": str(e)}, ()
                 except MXNetError as e:
                     rmeta, rtensors = {"status": "err", "msg": str(e)}, ()
                 except Exception as e:
                     rmeta, rtensors = {"status": "err", "msg": "%s: %s"
                                        % (type(e).__name__, e)}, ()
                 rmeta.setdefault("status", "ok")
+                if kind in (_MSG_PUSH, _MSG_BARRIER, _MSG_HEARTBEAT):
+                    # the membership epoch rides EVERY heartbeat/sync
+                    # reply so a worker notices a resize/evict/join
+                    # within one sync round and re-shards at the batch
+                    # boundary.  setdefault: a barrier reply already
+                    # carries its completed round's CONSISTENT snapshot
+                    # (mep + members together) — never mix in a newer
+                    # epoch without its member list
+                    if "mep" not in rmeta:
+                        with self.lock:
+                            rmeta["mep"] = self.membership_epoch
                 if kind in _BULK_KINDS:
                     action = _netchaos.on_server_reply(kind)
                     if action == "drop":
@@ -1003,6 +1127,8 @@ class KVStoreServer:
             else:
                 rank = int(meta.get("rank", 0))
             if sync:
+                self._reject_stale_contributor(rank, meta.get("mep"),
+                                               key)
                 self._push_sync(key, val, rank, req_id)
             else:
                 self._apply(key, val,
@@ -1025,8 +1151,13 @@ class KVStoreServer:
             rows[~valid] = 0
             return {}, (rows,)
         if kind == _MSG_BARRIER:
-            self._barrier(meta.get("rank", 0), meta.get("round", 0))
-            return {}, ()
+            snap = self._barrier(meta.get("rank", 0),
+                                 meta.get("round", 0))
+            # the completed round's membership snapshot rides the
+            # reply: every waiter of round r receives the SAME
+            # (epoch, members, world) triple, so all survivors apply
+            # a resize/join/evict at the same batch boundary
+            return dict(snap or {}), ()
         if kind == _MSG_HEARTBEAT:
             node = meta["node"]
             with self.lock:
@@ -1034,21 +1165,48 @@ class KVStoreServer:
                 # comparison within this process — an NTP step must not
                 # spuriously evict a healthy worker (graftlint JG012)
                 self.heartbeats[node] = time.monotonic()
-                # a fresh heartbeat from an evicted rank is a rejoin:
-                # restore it to the expected-contributor set
+                # a fresh heartbeat from an evicted rank is a rejoin,
+                # and one from an unknown rank inside the (possibly
+                # pending-resize) world is a join — both become
+                # join-PENDING: admission happens at the next barrier
+                # completion, the only point with no sync push in
+                # flight, so every survivor re-shards at the same
+                # round boundary
                 rank = _node_rank(node)
                 unevicted = rank is not None and rank in self.evicted
+                joining = False
                 if unevicted:
                     self.evicted.discard(rank)
+                    self.pending_join.add(rank)
+                    self._rejoining.add(rank)
+                elif (rank is not None
+                        and rank not in self.joined
+                        and rank not in self.pending_join):
+                    # any heartbeating non-member is join-PENDING
+                    # (visible in stats) — admission itself is gated
+                    # by rank < world at the barrier boundary, so a
+                    # rank beyond the (possibly pending-resize) world
+                    # just waits for the operator to grow it in
+                    self.pending_join.add(rank)
+                    joining = True
+                reply = {"epoch": self.epoch_token,
+                         "mep": self.membership_epoch,
+                         "members": sorted(self.joined),
+                         "world": self.world}
             if unevicted:
                 log.warning("kvstore server %d: rank %d heartbeating "
-                            "again — un-evicted (rejoin)",
+                            "again — rejoin pending (admitted at the "
+                            "next sync-round boundary)",
                             self.server_id, rank)
                 _obs_events.emit("kvstore", action="rejoin", rank=rank,
                                  server=self.server_id)
+            elif joining:
+                log.info("kvstore server %d: rank %d announced itself "
+                         "— join pending admission", self.server_id,
+                         rank)
             # the epoch token lets workers detect a server restart and
             # re-init only the keys the new incarnation lost
-            return {"epoch": self.epoch_token}, ()
+            return reply, ()
         if kind == _MSG_DEADQUERY:
             now = time.monotonic()
             with self.lock:
@@ -1084,7 +1242,8 @@ class KVStoreServer:
             elif head == "stats":
                 # consistency/health introspection: restart detection
                 # (which keys survived), exactly-once drills (applies),
-                # eviction state — one locked snapshot of the counters
+                # eviction + live membership state — one locked
+                # snapshot of the counters
                 with self.lock:
                     return {"applies": self.applies,
                             "pushes": self.pushes_received,
@@ -1092,7 +1251,47 @@ class KVStoreServer:
                             "keys": sorted(self.store, key=repr),
                             "evicted": sorted(self.evicted),
                             "snapshots": self._snap_seq,
-                            "server_id": self.server_id}, ()
+                            "server_id": self.server_id,
+                            "mep": self.membership_epoch,
+                            "members": sorted(self.joined),
+                            "world": self.world,
+                            "pending_world": self.pending_world,
+                            "pending_join": sorted(self.pending_join),
+                            "admitted_round":
+                                {str(r): rnd for r, rnd in
+                                 self.admitted_round.items()}}, ()
+            elif head == "resize":
+                # operator-commanded scale: N -> M in either direction
+                # WITHOUT a restart.  Recorded as pending and applied
+                # at the next barrier completion — the only instant a
+                # dist_sync job provably has no push in flight — so
+                # the transition lands on a batch boundary for every
+                # worker at once.
+                m = int(body)
+                if m < 1:
+                    raise MXNetError(
+                        "resize target must be >= 1 worker, got %d" % m)
+                with self.lock:
+                    reply = {"world": self.world, "pending_world": m,
+                             "mep": self.membership_epoch}
+                    self.pending_world = m
+                log.warning("kvstore server %d: operator resize to %d "
+                            "worker(s) requested (world now %d); "
+                            "applies at the next sync-round boundary",
+                            self.server_id, m, reply["world"])
+                _obs_events.emit("membership", action="resize_requested",
+                                 world=reply["world"], target=m,
+                                 server=self.server_id)
+                return reply, ()
+            elif head == "jobmeta":
+                # opaque JSON blob the surviving workers publish (data
+                # cursor, sampler state, round number): a mid-epoch
+                # joiner fetches it to take over its shard assignment
+                with self.lock:
+                    self.jobmeta = body
+            elif head == "jobmeta_get":
+                with self.lock:
+                    return {"meta": self.jobmeta}, ()
             elif head == "profiler:set_config":
                 cfg = dict(body)
                 if "filename" in cfg and self.server_id:
@@ -1109,13 +1308,155 @@ class KVStoreServer:
             return {}, ()
         raise MXNetError("unknown kvstore message kind %d" % kind)
 
-    # -- straggler tolerance ----------------------------------------------
+    # -- straggler tolerance / live membership ------------------------------
     def _expected_ranks(self):
-        """The ranks a sync round must hear from (self.lock taken
-        inside; callers may hold self.cv — cv-before-lock is the one
-        ordering this class uses)."""
+        """THE accessor for the ranks a sync round must hear from —
+        the live membership view (self.lock taken inside; callers may
+        hold self.cv — cv-before-lock is the one ordering this class
+        uses).  Everything that used to derive an expected set or
+        count from the frozen constructor ``num_workers`` routes
+        through here (or :meth:`expected_count`)."""
         with self.lock:
-            return set(range(self.num_workers)) - self.evicted
+            return set(self.joined)
+
+    def expected_count(self):
+        with self.lock:
+            return len(self.joined)
+
+    def _reject_stale_contributor(self, rank, mep, key):
+        """A sync push from a non-member must fail TYPED, never merge
+        into a later round (silent apply) or answer from the dedup
+        cache: evicted ranks, ranks retired by a resize, and joiners
+        not yet admitted all get :class:`EvictedWorkerError`.  The
+        per-rank fence additionally rejects a push whose declared
+        membership view predates the rank's own eviction — the push
+        that was already on the wire when the round completed without
+        it."""
+        with self.lock:
+            if rank in self.joined:
+                fence = self.rank_fence.get(rank)
+                if mep is None or fence is None or mep >= fence:
+                    return
+                reason = ("its membership view (epoch %d) predates its "
+                          "eviction fence (epoch %d)" % (mep, fence))
+            elif rank in self.pending_join and rank < self.world \
+                    and mep is not None \
+                    and mep >= self.rank_fence.get(rank, 0):
+                # admit on first post-fence contribution: in a server
+                # GROUP the barrier boundary lands a beat apart per
+                # server, so a joiner admitted by server 0's round may
+                # reach a sibling before that sibling's own barrier
+                # completes.  The fence proves the pusher has already
+                # observed a post-eviction membership view of THIS
+                # server, so this is a fresh contribution, not a stale
+                # one.
+                self.joined.add(rank)
+                self.pending_join.discard(rank)
+                action = ("rejoin" if rank in self._rejoining
+                          else "join")
+                self._rejoining.discard(rank)
+                self._bump_membership_locked(action, ranks=[rank],
+                                             on_push=True)
+                log.warning("kvstore server %d: admitted rank %d (%s) "
+                            "on its first post-fence push",
+                            self.server_id, rank, action)
+                return
+            elif rank in self.evicted:
+                reason = "it was evicted from the expected set"
+            elif rank >= self.world:
+                reason = ("its rank was retired by an operator resize "
+                          "to %d worker(s)" % self.world)
+            else:
+                reason = ("it has not been admitted yet (join pending "
+                          "until the next sync-round boundary)")
+            epoch = self.membership_epoch
+        _STALE_REJECTS.inc()
+        _obs_events.emit("membership", action="stale_reject", rank=rank,
+                         key=str(key), epoch=epoch,
+                         server=self.server_id)
+        raise EvictedWorkerError(
+            "sync push for key %r from rank %d rejected: %s "
+            "(membership epoch %d) — re-sync params and refresh the "
+            "membership view before contributing again"
+            % (key, rank, reason, epoch))
+
+    def _apply_membership_at_barrier(self, rnd):
+        """self.cv held, called when barrier round *rnd* completes:
+        apply every pending membership transition (operator resize,
+        join/rejoin admissions).  A completed barrier is the one
+        instant a dist_sync job provably has no push in flight — every
+        worker's round-``rnd`` pushes returned before it arrived here —
+        so the transition lands on the same batch boundary for all
+        survivors, and the round's reply snapshot tells them about it."""
+        with self.lock:
+            resized = retired = None
+            if self.pending_world is not None and \
+                    self.pending_world != self.world:
+                old_world, self.world = self.world, self.pending_world
+                retired = sorted(r for r in self.joined
+                                 if r >= self.world)
+                for r in retired:
+                    self.joined.discard(r)
+                    self.rank_fence[r] = self.membership_epoch + 1
+                self.pending_join = {r for r in self.pending_join
+                                     if r < self.world}
+                self.evicted = {r for r in self.evicted
+                                if r < self.world}
+                resized = old_world
+            self.pending_world = None
+            # only admit ranks whose heartbeat is FRESH: a retired/dead
+            # process's last beats can leave a ghost pending entry, and
+            # admitting it would stall rounds until it is re-evicted
+            now = time.monotonic()
+            stale = {r for r in self.pending_join
+                     if r < self.world
+                     and now - self.heartbeats.get("worker%d" % r,
+                                                   -1e18)
+                     > self.evict_timeout}
+            self.pending_join -= stale
+            self._rejoining -= stale
+            admitted = sorted(r for r in self.pending_join
+                              if r < self.world and r not in self.joined)
+            for r in admitted:
+                self.pending_join.discard(r)
+                self.joined.add(r)
+                self.admitted_round[r] = rnd
+                # deliberately NOT re-fencing at admission: the fence
+                # set at EVICTION time already rejects any push born
+                # before the rank lost membership, while an
+                # admission-epoch fence would falsely reject the
+                # joiner's first post-admission push to a server whose
+                # heartbeat reply it has not seen since the admission
+                # bump (sub-second window in a server group)
+            if resized is not None:
+                old, new = self._bump_membership_locked(
+                    "resize", ranks=retired, from_world=resized,
+                    round=rnd)
+                log.warning(
+                    "kvstore server %d: resize %d -> %d applied at "
+                    "round %d (membership epoch %d -> %d; retired "
+                    "ranks %s)", self.server_id, resized, self.world,
+                    rnd, old, new, retired)
+            if admitted:
+                rejoins = [r for r in admitted if r in self._rejoining]
+                joins = [r for r in admitted if r not in self._rejoining]
+                self._rejoining.difference_update(admitted)
+                for action, ranks in (("rejoin", rejoins),
+                                      ("join", joins)):
+                    if not ranks:
+                        continue
+                    old, new = self._bump_membership_locked(
+                        action, ranks=ranks, round=rnd)
+                    log.warning(
+                        "kvstore server %d: admitted rank(s) %s (%s) "
+                        "at round %d (membership epoch %d -> %d; "
+                        "expected contributors now %d)",
+                        self.server_id, ranks, action, rnd, old, new,
+                        len(self.joined))
+        if resized is not None:
+            # a shrink can complete rounds that were waiting on the
+            # retired ranks — re-check everything pending (cv held)
+            self._sweep_after_eviction()
 
     def _evict_dead(self, missing, context):
         """self.cv held.  Split *missing* ranks into provably-dead
@@ -1129,20 +1470,32 @@ class KVStoreServer:
                 ts = self.heartbeats.get("worker%d" % r)
                 if ts is not None and now - ts > self.evict_timeout:
                     self.evicted.add(r)
+                    self.joined.discard(r)
+                    self.pending_join.discard(r)
+                    # any push of this rank's already on the wire was
+                    # born before the eviction: fence it out until the
+                    # rank observes a post-eviction membership view
+                    self.rank_fence[r] = self.membership_epoch + 1
                     # the dead-node listing shrinks too: an evicted
                     # rank is no longer an expected cluster member
                     self.heartbeats.pop("worker%d" % r, None)
                     evicted_now.append(r)
                 else:
                     laggards.append(r)
+            if evicted_now:
+                # eviction takes effect IMMEDIATELY (it is what
+                # unblocks the waiting survivors), unlike join/resize
+                # which defer to a barrier boundary
+                self._bump_membership_locked("evict", ranks=evicted_now,
+                                             reason=context)
+            expected_now = len(self.joined)
         for r in evicted_now:
             _EVICTIONS.inc()
             log.warning(
                 "kvstore server %d: evicted dead worker rank %d (%s; "
                 "last heartbeat > %.1fs ago); expected contributors "
                 "now %d", self.server_id, r, context,
-                self.evict_timeout,
-                self.num_workers - len(self.evicted))
+                self.evict_timeout, expected_now)
             _obs_events.emit("kvstore", action="evict", rank=r,
                              server=self.server_id, reason=context)
         return evicted_now, laggards
@@ -1176,11 +1529,18 @@ class KVStoreServer:
             return False
         self.barrier_done.add(rnd)
         del self.barrier_rounds[rnd]
+        # the round boundary: apply pending membership transitions,
+        # then record the round's consistent snapshot for its waiters
+        self._apply_membership_at_barrier(rnd)
+        self.barrier_membership[rnd] = self._membership_snapshot()
         # prune: done rounds older than any pending round
         if len(self.barrier_done) > 1024:
             keep = max(self.barrier_done)
             self.barrier_done = {r for r in self.barrier_done
                                  if r > keep - 1024}
+            self.barrier_membership = {
+                r: s for r, s in self.barrier_membership.items()
+                if r in self.barrier_done}
         self.cv.notify_all()
         return True
 
@@ -1237,7 +1597,7 @@ class KVStoreServer:
             _SYNC_TIMEOUTS.inc()
             _obs_events.emit("kvstore", action="sync_timeout",
                              key=str(key), got=got,
-                             expected=self.num_workers,
+                             expected=self.expected_count(),
                              laggards=laggards, server=self.server_id)
             raise SyncTimeoutError(
                 "dist_sync push for key %r timed out after %.1fs: got "
@@ -1267,19 +1627,23 @@ class KVStoreServer:
         number); a round completes when every expected rank has arrived.
         Immune to overlapping rounds under skew (a fast worker in round
         r+1 cannot be miscounted into round r); deadline expiry evicts
-        provably-dead ranks exactly like :meth:`_push_sync`."""
+        provably-dead ranks exactly like :meth:`_push_sync`.  Returns
+        the completed round's membership snapshot — the same
+        (epoch, members, world) triple for every waiter of the round."""
         with self.cv:
             if rnd in self.barrier_done:
-                return
+                return (self.barrier_membership.get(rnd)
+                        or self._membership_snapshot())
             self.barrier_rounds.setdefault(rnd, set()).add(rank)
             if self._try_complete_barrier(rnd):
-                return
+                return self.barrier_membership.get(rnd)
             deadline = time.monotonic() + self.sync_timeout
             while rnd not in self.barrier_done and \
                     time.monotonic() < deadline:
                 self.cv.wait(timeout=0.1)
             if rnd in self.barrier_done:
-                return
+                return (self.barrier_membership.get(rnd)
+                        or self._membership_snapshot())
             arrived = set(self.barrier_rounds.get(rnd, ()))
             missing = self._expected_ranks() - arrived
             evicted_now, laggards = self._evict_dead(
@@ -1287,17 +1651,17 @@ class KVStoreServer:
             if evicted_now:
                 self._sweep_after_eviction()
             if self._try_complete_barrier(rnd):
-                return
+                return self.barrier_membership.get(rnd)
             got = len(self.barrier_rounds.get(rnd, ()))
+            expected = self.expected_count()
             _SYNC_TIMEOUTS.inc()
             _obs_events.emit("kvstore", action="barrier_timeout",
-                             round=rnd, got=got,
-                             expected=self.num_workers,
+                             round=rnd, got=got, expected=expected,
                              laggards=laggards, server=self.server_id)
             raise SyncTimeoutError(
                 "kvstore barrier timed out: %d/%d workers arrived for "
                 "round %d; alive-but-slow rank(s): %s"
-                % (got, self.num_workers, rnd, laggards))
+                % (got, expected, rnd, laggards))
 
 
 class KVStoreDist(KVStoreBase):
@@ -1336,6 +1700,20 @@ class KVStoreDist(KVStoreBase):
         self._incarnation = ((int(time.time() * 1000) << 16)
                              ^ os.getpid()) & 0x7FFFFFFFFFFF
         self._seq_lock = _san.lock(label="KVStoreDist.seq")
+        # live membership view (elastic training): seeded from the
+        # launch env, then updated from every heartbeat reply, every
+        # sync reply's membership epoch, and each barrier's completed-
+        # round snapshot.  ``num_workers`` reads THIS, never the
+        # frozen env value.
+        self._mview_lock = _san.lock(label="KVStoreDist.mview")
+        self._mview = {"mep": 0,
+                       "members": list(range(self._num_workers)),
+                       "world": self._num_workers}
+        # membership epochs are PER-SERVER counters: pushes declare
+        # the last epoch seen from the server they go to (the fence
+        # comparison must be same-server), while the partitioning
+        # view above follows server 0 alone
+        self._server_meps = {}
         # init-time values, kept so a restarted server's lost keys can
         # be re-initialized (only what the snapshot didn't cover)
         self._init_cache = {}
@@ -1435,6 +1813,10 @@ class KVStoreDist(KVStoreBase):
                                  s, fails[s])
                     fails[s] = 0
                     defer.pop(s, None)
+                    # heartbeat replies carry the live membership
+                    # snapshot (per-server epoch tracked for the push
+                    # fence; server 0 is the partitioning authority)
+                    self._update_mview(rmeta, server=s)
                     # restart detection: the server stamps every
                     # heartbeat reply with its incarnation's epoch token
                     epoch = rmeta.get("epoch")
@@ -1541,7 +1923,138 @@ class KVStoreDist(KVStoreBase):
 
     @property
     def num_workers(self):
-        return self._num_workers
+        """The number of CURRENTLY active workers — the live
+        membership view, not the launch-time ``DMLC_NUM_WORKER``
+        (which only seeds it).  Moves on evict/join/rejoin/resize."""
+        with self._mview_lock:
+            return max(1, len(self._mview["members"]))
+
+    # -- live membership (elastic training) ---------------------------------
+    def _update_mview(self, rmeta, server=0):
+        """Fold a reply's membership payload into the local view.
+        Every server's epoch is tracked (pushes declare the last epoch
+        seen from THAT server — the fence comparison is same-server);
+        the partitioning view follows server 0, the authority.  A bare
+        ``mep`` (sync replies) advances the epoch only; a full
+        snapshot (heartbeat replies, barrier completed-round
+        snapshots) replaces members/world atomically with its epoch —
+        never mix a newer epoch with an older member list."""
+        mep = rmeta.get("mep")
+        if mep is None:
+            return
+        with self._mview_lock:
+            if mep > self._server_meps.get(server, -1):
+                self._server_meps[server] = int(mep)
+            if server != 0:
+                return
+            if "members" in rmeta:
+                if mep >= self._mview["mep"]:
+                    self._mview = {
+                        "mep": int(mep),
+                        "members": [int(r) for r in rmeta["members"]],
+                        "world": int(rmeta.get(
+                            "world", self._mview["world"]))}
+            elif mep > self._mview["mep"]:
+                self._mview["mep"] = int(mep)
+
+    def membership(self):
+        """The worker's current view: ``{"mep", "members", "world"}``.
+        After :meth:`barrier` this is the completed round's consistent
+        server-0 snapshot — every worker of the round sees the same
+        triple, so re-sharding decisions land on the same batch
+        boundary everywhere."""
+        with self._mview_lock:
+            return {"mep": self._mview["mep"],
+                    "members": list(self._mview["members"]),
+                    "world": self._mview["world"]}
+
+    def refresh_membership(self):
+        """Force-refresh the view from server 0's stats (the
+        authoritative membership for data partitioning) and return it."""
+        st = self.server_stats(server=0)
+        self._update_mview({"mep": st.get("mep", 0),
+                            "members": st.get("members", []),
+                            "world": st.get("world", 1)})
+        return self.membership()
+
+    def my_position(self):
+        """This rank's index in the sorted member list (its shard
+        assignment), or None when the rank is not currently a member
+        (evicted / retired / not yet admitted)."""
+        with self._mview_lock:
+            members = sorted(self._mview["members"])
+        try:
+            return members.index(self._rank)
+        except ValueError:
+            return None
+
+    def resize(self, world):
+        """Operator-commanded rescale to *world* workers, in either
+        direction, without a restart.  The target is recorded on every
+        server and APPLIED at the next sync-round boundary (barrier
+        completion): shrunk-away ranks see themselves retired in that
+        round's membership snapshot and exit cleanly; grown slots are
+        filled as new workers heartbeat in and are admitted.  Returns
+        server 0's acknowledgement."""
+        replies = [self._rpc(_MSG_CMD, {"head": "resize",
+                                        "body": int(world)}, server=s)[0]
+                   for s in range(self._num_servers)]
+        _obs_events.emit("membership", action="resize_requested",
+                         target=int(world), rank=self._rank)
+        return replies[0]
+
+    def put_job_meta(self, meta):
+        """Publish the opaque job-state blob (JSON-able: data cursor,
+        sampler state, round number) a mid-epoch joiner needs to take
+        over its shard; kept on server 0."""
+        self._rpc(_MSG_CMD, {"head": "jobmeta", "body": meta}, server=0)
+
+    def get_job_meta(self):
+        return self._rpc(_MSG_CMD, {"head": "jobmeta_get"},
+                         server=0)[0].get("meta")
+
+    def wait_admission(self, timeout=None, poll=None):
+        """Block until this rank is ADMITTED to the expected set (a
+        joiner/rejoiner becomes a member at a barrier completion), then
+        align the local barrier-round counter with the round the server
+        admitted it at — the joiner's next ``barrier()`` lands on the
+        same round number as the survivors'.  Returns the refreshed
+        membership view."""
+        from .config import get_env as _get_env
+        if timeout is None:
+            timeout = _get_env("MXNET_KVSTORE_JOIN_TIMEOUT")
+        if poll is None:
+            poll = _get_env("MXNET_KVSTORE_ADMIT_POLL")
+        deadline = time.monotonic() + timeout
+        while True:
+            st = self.server_stats(server=0)
+            if self._rank in st.get("members", ()):
+                self._update_mview({"mep": st.get("mep", 0),
+                                    "members": st["members"],
+                                    "world": st.get("world", 1)})
+                admitted = (st.get("admitted_round") or {}).get(
+                    str(self._rank))
+                if admitted is not None:
+                    self._barrier_round = int(admitted)
+                _obs_events.emit("membership", action="admitted",
+                                 rank=self._rank,
+                                 round=self._barrier_round,
+                                 mep=st.get("mep"))
+                log.warning(
+                    "kvstore rank %d admitted at round %d (membership "
+                    "epoch %s, members %s)", self._rank,
+                    self._barrier_round, st.get("mep"),
+                    st.get("members"))
+                return self.membership()
+            if time.monotonic() > deadline:
+                raise MXNetError(
+                    "rank %d was not admitted within %.1fs "
+                    "(members=%s, pending=%s, world=%s) — is a sync "
+                    "round/barrier actually completing? admission "
+                    "happens at barrier boundaries"
+                    % (self._rank, timeout, st.get("members"),
+                       st.get("pending_join"), st.get("world")))
+            time.sleep(poll)
 
     def _rpc(self, kind, meta=None, tensors=(), server=None, key=None):
         """One framed round-trip; returns (reply_meta, reply_tensors).
@@ -1558,8 +2071,17 @@ class KVStoreDist(KVStoreBase):
                 seq = self._req_seq
             meta = dict(meta or {})
             meta["req"] = [self._rank, seq, self._incarnation]
+            if kind == _MSG_PUSH:
+                # declare the membership view this contribution was
+                # computed under (per-server epoch): the server's
+                # per-rank fence uses it to reject a push born before
+                # this rank's eviction
+                with self._mview_lock:
+                    meta["mep"] = self._server_meps.get(s, 0)
         with self._locks[s]:
             reply = self._rpc_with_retry(s, kind, meta, tensors)
+        if isinstance(reply[0], dict):
+            self._update_mview(reply[0], server=s)
         # wire-level traffic accounting (payload bytes, post
         # compression/rsp packing — the number a capacity planner
         # multiplies by worker count)
@@ -1797,12 +2319,21 @@ class KVStoreDist(KVStoreBase):
         self.barrier()
 
     def barrier(self):
-        # server 0 coordinates; the round number makes overlapping
-        # barriers under worker skew unambiguous
+        # every server coordinates its own copy of the round (the
+        # round number makes overlapping barriers under worker skew
+        # unambiguous): membership transitions apply at barrier
+        # completion, and they must land on EVERY server at the same
+        # round boundary or a resize would split one logical step's
+        # expected sets across the key shards.  Server 0's completed-
+        # round snapshot (folded into the view by _rpc) stays the
+        # authoritative membership for data partitioning.
         self._barrier_round += 1
-        self._rpc(_MSG_BARRIER,
-                  {"rank": self._rank, "round": self._barrier_round},
-                  server=0)
+        meta = {"rank": self._rank, "round": self._barrier_round}
+        if self._num_servers == 1:
+            self._rpc(_MSG_BARRIER, meta, server=0)
+        else:
+            self._rpc_fanout([(s, _MSG_BARRIER, meta, ())
+                              for s in range(self._num_servers)])
 
     def _send_command_to_servers(self, head, body):
         for s in range(self._num_servers):

@@ -123,6 +123,15 @@ register_env("MXNET_KVSTORE_SNAPSHOT_EVERY", int, 1,
              "Applies between server state snapshots (counter-based, "
              "deterministic); only consulted when "
              "MXNET_KVSTORE_SNAPSHOT_PREFIX is set; 0 = never")
+register_env("MXNET_KVSTORE_JOIN_TIMEOUT", float, 120.0,
+             "Seconds a joining/rejoining worker's wait_admission() "
+             "polls for its admission to the expected-contributor set "
+             "(admission happens at sync-round boundaries, so a "
+             "stalled job admits nobody) before raising")
+register_env("MXNET_KVSTORE_ADMIT_POLL", float, 0.2,
+             "Poll interval (seconds) of wait_admission() and the "
+             "joiner-side job-metadata fetch during mid-epoch "
+             "admission")
 register_env("MXNET_SAN", str, "",
              "graftsan runtime sanitizer components to enable: comma "
              "list of race,recompile,donation,transfer, or 'all'; "
@@ -131,7 +140,7 @@ register_env("MXNET_OBS", str, "",
              "Structured run-event categories to record to "
              "events.jsonl: comma list of compile,guard,chaos,"
              "checkpoint,preempt,retry,respawn,warning,kvstore,"
-             "supervisor,watchdog,serve, or 'all'; "
+             "membership,supervisor,watchdog,serve, or 'all'; "
              "empty = off (no file, zero per-event cost; see "
              "docs/observability.md)")
 register_env("MXNET_OBS_PATH", str, "events.jsonl",

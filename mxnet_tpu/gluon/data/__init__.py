@@ -3,6 +3,6 @@
 from .dataset import Dataset, SimpleDataset, ArrayDataset, \
     RecordFileDataset  # noqa: F401
 from .sampler import Sampler, SequentialSampler, RandomSampler, \
-    BatchSampler  # noqa: F401
+    BatchSampler, ElasticBatchSampler  # noqa: F401
 from .dataloader import DataLoader  # noqa: F401
 from . import vision  # noqa: F401

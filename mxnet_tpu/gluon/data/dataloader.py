@@ -479,7 +479,43 @@ class DataLoader:
         if bs is not None and \
                 getattr(self._batch_sampler, "load_state", None):
             self._batch_sampler.load_state(bs, in_progress=cursor > 0)
+            if getattr(self._batch_sampler, "exact_resume", False):
+                # the sampler resumes at its own exact (global) cursor
+                # — e.g. ElasticBatchSampler, whose batch->sample
+                # mapping changes across resizes, so fast-forwarding
+                # by delivered-batch count would skip the wrong work
+                self._resume_skip = 0
+                return
         self._resume_skip = cursor
+
+    def repartition(self, part_index, num_parts):
+        """Elastic re-shard (docs/resilience.md "Elastic training"):
+        delegate to the batch sampler — with an
+        :class:`~mxnet_tpu.gluon.data.ElasticBatchSampler` the change
+        takes effect at the next yielded batch, mid-epoch included.
+
+        Mid-epoch re-sharding requires the synchronous
+        ``num_workers=0`` path: a worker-prefetched loader has already
+        issued indices prefetch-depth batches past the consumer, and
+        that skew differs per rank — the fleet would switch layouts at
+        different global rounds, consuming some samples twice and
+        others never.  A live multi-process iteration therefore
+        refuses; repartition between epochs (no live iterator) is fine
+        in any mode."""
+        rp = getattr(self._batch_sampler, "repartition", None)
+        if rp is None:
+            raise AttributeError(
+                "DataLoader.repartition needs a batch sampler with "
+                "repartition() (e.g. ElasticBatchSampler); got %s"
+                % type(self._batch_sampler).__name__)
+        if self._worker_iter is not None:
+            raise RuntimeError(
+                "DataLoader.repartition mid-epoch over process workers "
+                "would re-shard prefetch-depth batches late (and by a "
+                "per-rank amount — exactly-once coverage breaks): use "
+                "num_workers=0 for elastic training, or repartition "
+                "between epochs")
+        rp(part_index, num_parts)
 
     def __iter__(self):
         skip = self._resume_skip

@@ -167,11 +167,23 @@ def _init_data(data, allow_empty, default_name):
 
 
 class NDArrayIter(DataIter):
-    """Iterate over in-memory arrays (reference: io.py NDArrayIter)."""
+    """Iterate over in-memory arrays (reference: io.py NDArrayIter).
+
+    Elastic partitioning (docs/resilience.md "Elastic training"):
+    with ``num_parts > 1`` the iterator walks GLOBAL rounds of
+    ``batch_size * num_parts`` samples and yields only this worker's
+    ``part_index``-th slice of each round.  All workers share the
+    permutation (pass the same ``shuffle_seed``), so the union of all
+    parts covers each epoch index exactly once.  ``repartition()``
+    changes the layout at a batch boundary — the global cursor is
+    preserved, so a dist_sync job that shrinks or grows mid-epoch
+    keeps exactly-once coverage, and a mid-epoch joiner restores a
+    survivor's ``state_dict()`` and repartitions to its own slot."""
 
     def __init__(self, data, label=None, batch_size=1, shuffle=False,
                  last_batch_handle="pad", data_name="data",
-                 label_name="softmax_label"):
+                 label_name="softmax_label", part_index=0, num_parts=1,
+                 shuffle_seed=None):
         super().__init__(batch_size)
         self.data = _init_data(data, allow_empty=False,
                                default_name=data_name)
@@ -184,18 +196,62 @@ class NDArrayIter(DataIter):
         # is preserved): a mid-epoch resume restores (seed, drawn) and
         # every LATER epoch's reset() re-draws in lockstep with the
         # uninterrupted run — global-np.random shuffles could restore
-        # the current order but not realign the stream position
-        self._shuffle_seed = int(_np.random.randint(0, 2 ** 31 - 1)) \
-            if shuffle else None
+        # the current order but not realign the stream position.  An
+        # explicit shuffle_seed makes the order REPRODUCIBLE ACROSS
+        # WORKERS — the elastic-partition contract.
+        if shuffle:
+            self._shuffle_seed = (int(shuffle_seed)
+                                  if shuffle_seed is not None
+                                  else int(_np.random.randint(
+                                      0, 2 ** 31 - 1)))
+        else:
+            self._shuffle_seed = None
         self._shuffle_drawn = 0
         self.last_batch_handle = last_batch_handle
         self.num_data = self.idx.shape[0]
-        assert self.num_data >= batch_size, \
-            "batch_size needs to be smaller than data size."
-        self.cursor = -batch_size
+        self.part_index = int(part_index)
+        self.num_parts = max(1, int(num_parts))
+        self._check_partition(self.part_index, self.num_parts)
+        self.cursor = -self._round
         self.num_source = len(self.data)
         self._cache_data = None
         self.reset()
+
+    @property
+    def _round(self):
+        """Samples one GLOBAL step consumes across all partitions."""
+        return self.batch_size * self.num_parts
+
+    def _check_partition(self, part_index, num_parts):
+        if not 0 <= part_index < num_parts:
+            raise ValueError("part_index %d not in [0, %d)"
+                             % (part_index, num_parts))
+        if num_parts > 1 and self.last_batch_handle not in ("pad",
+                                                            "discard"):
+            raise ValueError(
+                "partitioned iteration supports last_batch_handle "
+                "'pad' or 'discard', not %r" % self.last_batch_handle)
+        if self.num_data < self.batch_size * num_parts:
+            raise ValueError(
+                "global batch (batch_size %d * num_parts %d) must not "
+                "exceed the data size %d"
+                % (self.batch_size, num_parts, self.num_data))
+
+    def repartition(self, part_index, num_parts):
+        """Re-shard at a batch boundary: this worker becomes slice
+        *part_index* of *num_parts*.  The GLOBAL consumed cursor is
+        preserved, so across a shrink/grow every remaining sample of
+        the epoch is still consumed exactly once (all workers must
+        repartition at the same global cursor — the membership
+        snapshot of a completed sync round gives them that boundary)."""
+        part_index, num_parts = int(part_index), int(num_parts)
+        consumed = self.cursor + self._round
+        self._check_partition(part_index, num_parts)
+        self.part_index, self.num_parts = part_index, num_parts
+        self.cursor = consumed - self._round
+        self._cache_data = None
+
+    set_partition = repartition
 
     @property
     def provide_data(self):
@@ -216,7 +272,7 @@ class NDArrayIter(DataIter):
     def hard_reset(self):
         if self.shuffle:
             self._reshuffle()
-        self.cursor = -self.batch_size
+        self.cursor = -self._round
 
     def reset(self):
         if self.shuffle:
@@ -227,31 +283,41 @@ class NDArrayIter(DataIter):
             self.cursor = -self.batch_size + \
                 (self.cursor - self.num_data)
         else:
-            self.cursor = -self.batch_size
+            self.cursor = -self._round
 
     def iter_next(self):
-        self.cursor += self.batch_size
+        self.cursor += self._round
         return self.cursor < self.num_data
 
     def next(self):
         if not self.iter_next():
             raise StopIteration
         if self.last_batch_handle == "discard" and \
-                self.cursor + self.batch_size > self.num_data:
+                self.cursor + self._round > self.num_data:
             raise StopIteration
         return DataBatch(data=self.getdata(), label=self.getlabel(),
-                         pad=self.getpad(), index=None)
+                         pad=self.getpad(), index=self.getindex())
+
+    def _sel(self):
+        """The dataset indices of THIS worker's slice of the current
+        global round: positions ``[part*b, (part+1)*b)`` of the round
+        window starting at ``cursor``; a window past the end wraps to
+        the epoch's start (the reference's pad-by-wrapping, extended
+        to the partitioned layout — ``getpad()`` names how many of
+        this worker's rows are wrap-padding)."""
+        lo = self.cursor + self.part_index * self.batch_size
+        hi = lo + self.batch_size
+        if hi <= self.num_data:
+            return self.idx[lo:hi]
+        if lo >= self.num_data:
+            wrap = _np.arange(lo - self.num_data, hi - self.num_data)
+            return self.idx[wrap % self.num_data]
+        return _np.concatenate(
+            [self.idx[lo:],
+             self.idx[_np.arange(hi - self.num_data) % self.num_data]])
 
     def _getdata(self, data_source):
-        end = self.cursor + self.batch_size
-        if end <= self.num_data:
-            sel = self.idx[self.cursor:end]
-            return [nd.array(v[sel], dtype=str(v[sel].dtype)
-                             if v.dtype != _np.float64 else "float32")
-                    for _, v in data_source]
-        # pad by wrapping
-        pad = end - self.num_data
-        sel = _np.concatenate([self.idx[self.cursor:], self.idx[:pad]])
+        sel = self._sel()
         return [nd.array(v[sel], dtype=str(v[sel].dtype)
                          if v.dtype != _np.float64 else "float32")
                 for _, v in data_source]
@@ -262,11 +328,24 @@ class NDArrayIter(DataIter):
     def getlabel(self):
         return self._getdata(self.label) if self.label else []
 
+    def getindex(self):
+        """The GLOBAL dataset indices of this worker's current slice
+        (elastic drills assert exactly-once epoch coverage from these;
+        wrap-padded rows repeat indices — trim with getpad())."""
+        if self.num_parts == 1:
+            return None     # legacy contract: plain batches carry None
+        return self._sel()
+
     def getpad(self):
-        if self.last_batch_handle == "pad" and \
-                self.cursor + self.batch_size > self.num_data:
-            return self.cursor + self.batch_size - self.num_data
-        return 0
+        """How many TRAILING rows of this worker's slice are wrap
+        padding (only the final global round of a 'pad' epoch)."""
+        if self.last_batch_handle != "pad":
+            return 0
+        lo = self.cursor + self.part_index * self.batch_size
+        hi = lo + self.batch_size
+        if hi <= self.num_data:
+            return 0
+        return min(hi - self.num_data, self.batch_size)
 
     def state_dict(self):
         """Cursor + the epoch's shuffle order + the private shuffle
@@ -277,9 +356,16 @@ class NDArrayIter(DataIter):
                 "cursor": int(self.cursor),
                 "idx": self.idx.tolist() if self.shuffle else None,
                 "shuffle_seed": self._shuffle_seed,
-                "shuffle_drawn": self._shuffle_drawn}
+                "shuffle_drawn": self._shuffle_drawn,
+                "part_index": self.part_index,
+                "num_parts": self.num_parts}
 
     def load_state(self, state):
+        """Restore a captured position.  A mid-epoch JOINER restores a
+        survivor's state (same permutation + global cursor + the
+        survivor's partition layout), then calls ``repartition()``
+        with its own slot — the post-resize stream is bit-reproducible
+        from jobstate alone."""
         self._check_state_type(state)
         if state.get("idx") is not None:
             idx = _np.asarray(state["idx"], dtype=self.idx.dtype)
@@ -291,6 +377,11 @@ class NDArrayIter(DataIter):
         if state.get("shuffle_seed") is not None:
             self._shuffle_seed = int(state["shuffle_seed"])
             self._shuffle_drawn = int(state.get("shuffle_drawn", 0))
+        if state.get("num_parts") is not None:
+            part = int(state.get("part_index", 0))
+            parts = int(state["num_parts"])
+            self._check_partition(part, parts)
+            self.part_index, self.num_parts = part, parts
         self.cursor = int(state["cursor"])
         self._cache_data = None
 
@@ -535,6 +626,37 @@ class PrefetchingIter(DataIter):
         self.current_batch = None
         self._consumed = consumed
         self._epoch_state = state["epoch_start"]
+        self._start()
+
+    def repartition(self, part_index, num_parts):
+        """Elastic re-shard THROUGH the prefetch ring: the producer
+        runs ahead of the consumer, so simply delegating would either
+        skip the prefetched-but-undelivered batches or replay ones
+        already handed out.  Instead the inner iterator is rewound to
+        the exact delivered position (epoch-start state + consumed
+        fast-forward, the same protocol as :meth:`load_state`),
+        repartitioned there, and a fresh producer started — no sample
+        is lost or duplicated across the resize."""
+        inner = self.iters[0]
+        rp = getattr(inner, "repartition", None)
+        if rp is None:
+            raise AttributeError(
+                "wrapped iterator %s has no repartition()"
+                % type(inner).__name__)
+        if self._epoch_state is None:
+            raise ValueError(
+                "cannot repartition through %s: the wrapped iterator "
+                "(%s) has no state_dict()" % (
+                    type(self).__name__, type(inner).__name__))
+        self._stop_producer()
+        inner.load_state(self._epoch_state)
+        for _ in range(self._consumed):
+            inner.next()
+        rp(part_index, num_parts)
+        self._peek = None
+        self.current_batch = None
+        self._consumed = 0
+        self._epoch_state = self._inner_state()
         self._start()
 
     def _note_occupancy(self, occupancy):

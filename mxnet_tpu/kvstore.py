@@ -4,4 +4,5 @@ src/kvstore/; see _kvstore_impl.py for the TPU-native backends)."""
 from ._kvstore_impl import create, KVStoreBase  # noqa: F401
 from ._kvstore_impl import (KVStoreLocal, KVStoreTPU, KVStoreDist,  # noqa
                             KVStoreServer)
-from ._kvstore_impl import RPCTimeoutError, SyncTimeoutError  # noqa: F401
+from ._kvstore_impl import (RPCTimeoutError, SyncTimeoutError,  # noqa
+                            EvictedWorkerError)

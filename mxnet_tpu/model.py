@@ -50,8 +50,12 @@ def fit(symbol, train_data, eval_data=None, num_epoch=None, ctx=None,
     boundary honors SIGTERM / ``chaos.preempt_at_batch``, ticks the
     supervisor heartbeat, and accepts the same ``checkpoint_manager``
     / ``resume_from`` / ``checkpoint_every_n_batches`` kwargs as
-    ``Module.fit`` (see docs/resilience.md).  Returns the trained
-    Module."""
+    ``Module.fit`` (see docs/resilience.md).  Against a ``dist_sync``
+    store the loop is also elastic: membership changes re-shard the
+    data and rescale the step at batch boundaries, an evicted rank
+    re-syncs and rejoins, and a rank retired by ``kv.resize()``
+    returns cleanly (docs/resilience.md "Elastic training").  Returns
+    the trained Module."""
     from .module import Module
     module = Module(symbol, data_names=data_names,
                     label_names=label_names,

@@ -14,6 +14,7 @@ from .. import metric as metric_mod
 from .. import ndarray as nd
 from ..model import BatchEndParam
 from ..base import MXNetError
+from .._kvstore_impl import EvictedWorkerError
 
 __all__ = ["BaseModule"]
 
@@ -330,10 +331,46 @@ class BaseModule:
                                                 start=nbatch_offset):
                 if monitor is not None:
                     monitor.tic()
-                self.forward_backward_update(data_batch)
+                try:
+                    self.forward_backward_update(data_batch)
+                except EvictedWorkerError as exc:
+                    # this rank contributed to a round that completed
+                    # without it (evicted while partitioned/stalled):
+                    # its gradient was rejected TYPED, never merged.
+                    # Re-sync params from the store, refresh the
+                    # membership view, and rejoin at this boundary —
+                    # the batch's update is lost, training is not.
+                    self.logger.warning(
+                        "evicted from the sync round (%s); re-syncing "
+                        "params and rejoining", exc)
+                    refresh = getattr(self._kvstore,
+                                      "refresh_membership", None) \
+                        if getattr(self, "_kvstore", None) is not None \
+                        else None
+                    if refresh is not None:
+                        refresh()
+                    resync = getattr(self, "resync_from_kvstore", None)
+                    if resync is not None:
+                        resync()
+                    tick = getattr(self, "elastic_tick", None)
+                    if tick is not None and not tick(train_data):
+                        self.logger.warning(
+                            "rank no longer a member after re-sync; "
+                            "exiting fit cleanly")
+                        return
+                    continue
                 self.update_metric(eval_metric, data_batch.label)
                 if monitor is not None:
                     monitor.toc_print()
+                tick = getattr(self, "elastic_tick", None)
+                if tick is not None and not tick(train_data):
+                    # membership resize retired this rank: finish at
+                    # the batch boundary and return cleanly (the
+                    # survivors re-sharded the remaining epoch)
+                    self.logger.warning(
+                        "rank retired by an elastic resize at epoch %d "
+                        "batch %d; exiting fit cleanly", epoch, nbatch)
+                    return
                 self._fire(batch_end_callback, BatchEndParam(
                     epoch=epoch, nbatch=nbatch,
                     eval_metric=eval_metric, locals=locals()))

@@ -634,7 +634,106 @@ class Module(BaseModule):
                 self._kvstore.load_optimizer_states(
                     self._preload_opt_states)
             self._preload_opt_states = None
+        # elastic membership bookkeeping (dist stores only): the view
+        # the optimizer hyper-state was last scaled for
+        self._elastic_grad_scale = 1.0
+        view = self._elastic_view()
+        self._elastic_mep = view["mep"] if view else None
+        self._elastic_active = (max(1, len(view["members"]))
+                                if view else 1)
         self.optimizer_initialized = True
+
+    # -- elastic membership (dist_sync; docs/resilience.md) ----------------
+    # class-level defaults: elastic_tick() is safe to call before
+    # init_optimizer has stamped the instance state
+    _elastic_mep = None
+    _elastic_active = 1
+    _elastic_grad_scale = 1.0
+
+    def _elastic_view(self):
+        """The kvstore's live membership view, or None when this
+        module is not training against an elastic (dist) store."""
+        kv = getattr(self, "_kvstore", None)
+        if kv is None or "dist" not in getattr(kv, "type", ""):
+            return None
+        mv = getattr(kv, "membership", None)
+        return mv() if callable(mv) else None
+
+    def resync_from_kvstore(self):
+        """Pull current params from the store into every executor —
+        the re-sync an evicted-then-rejoining worker must do before
+        contributing again (the server rejects its stale pushes with
+        a typed EvictedWorkerError until it does)."""
+        assert self._kvstore is not None
+        group = self._exec_group
+        for i, name in enumerate(group.param_names):
+            self._kvstore.pull(
+                i, out=[ex.arg_dict[name] for ex in group.execs])
+        self._params_dirty = True
+
+    def elastic_tick(self, train_data=None):
+        """Batch-boundary elasticity hook (called by ``fit``): notice
+        a membership-epoch change and apply the whole transition at
+        this boundary — re-shard *train_data* to this rank's slot,
+        and rescale the gradient contribution for the new effective
+        global batch (per-worker batch is fixed, so N→M workers moves
+        the global batch by M/N).  The rescale goes through the
+        optimizer's ``rescale_grad`` when the updater is local (a
+        hyper mutation the fused step's hyper_sig rebuild picks up),
+        or through a worker-side pre-scale of pushed gradients when
+        the updater runs server-side.  Returns False when this rank
+        is no longer a member (retired by a resize / evicted) — the
+        caller should stop training cleanly."""
+        view = self._elastic_view()
+        if view is None or view["mep"] == self._elastic_mep:
+            return True
+        members = sorted(view["members"])
+        active = max(1, len(members))
+        old_active = self._elastic_active
+        self._elastic_mep = view["mep"]
+        self._elastic_active = active
+        rank = self._kvstore.rank
+        from ..observability import events as _obs_events
+        if rank not in members:
+            if rank < view.get("world", 0):
+                # evicted but NOT resized away: re-admission is one
+                # barrier (or one post-fence push) away — keep
+                # training; the admission bumps the epoch again and
+                # the next tick re-shards to this rank's slot
+                # keep _elastic_active at its pre-eviction value: the
+                # rescale factor must net out to 1 across the
+                # evict→readmit round trip
+                _obs_events.emit("membership",
+                                 action="awaiting_readmission",
+                                 rank=rank, mep=view["mep"],
+                                 members=members)
+                self._elastic_active = old_active
+                return True
+            _obs_events.emit("membership", action="retired", rank=rank,
+                             mep=view["mep"], members=members)
+            return False
+        if active != old_active:
+            factor = old_active / float(active)
+            if self._updater is not None and \
+                    getattr(self._optimizer, "rescale_grad", None) \
+                    is not None:
+                self._optimizer.rescale_grad *= factor
+            else:
+                self._elastic_grad_scale *= factor
+        if train_data is not None:
+            rp = getattr(train_data, "repartition", None)
+            if rp is not None:
+                rp(members.index(rank), active)
+            else:
+                logging.getLogger(__name__).warning(
+                    "elastic membership changed (epoch %s, %d active) "
+                    "but %s has no repartition() — the data pipeline "
+                    "keeps its old sharding", view["mep"], active,
+                    type(train_data).__name__)
+        _obs_events.emit("membership", action="rescale", rank=rank,
+                         mep=view["mep"], members=members,
+                         old_active=old_active, active=active)
+        return True
 
     # -- execution ---------------------------------------------------------
     def forward(self, data_batch, is_train=None):
@@ -716,11 +815,18 @@ class Module(BaseModule):
         ex0 = group.execs[0]
         if self._kvstore is not None and self._update_on_kvstore:
             # push grads -> (server/store applies updater) -> pull weights
+            scale = getattr(self, "_elastic_grad_scale", 1.0)
             for i, name in enumerate(group.param_names):
                 if group.grad_req[name] == "null":
                     continue
-                self._kvstore.push(
-                    i, [ex.grad_dict[name] for ex in group.execs])
+                grads = [ex.grad_dict[name] for ex in group.execs]
+                if scale != 1.0:
+                    # elastic rescale for a server-side updater: the
+                    # server's optimizer keeps its launch-time
+                    # rescale_grad, so the effective-batch change of a
+                    # resize is applied to the contribution itself
+                    grads = [g * scale for g in grads]
+                self._kvstore.push(i, grads)
             if "dist" in getattr(self._kvstore, "type", ""):
                 self._kvstore.barrier()
             for i, name in enumerate(group.param_names):

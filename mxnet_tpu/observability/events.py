@@ -56,8 +56,8 @@ __all__ = ["enabled", "emit", "emitter", "watch_jit", "configure",
            "reopen", "path", "read_events", "tail_records"]
 
 _CATEGORIES = ("compile", "guard", "chaos", "checkpoint", "preempt",
-               "retry", "respawn", "warning", "kvstore", "supervisor",
-               "watchdog", "serve")
+               "retry", "respawn", "warning", "kvstore", "membership",
+               "supervisor", "watchdog", "serve")
 
 
 def _spec():

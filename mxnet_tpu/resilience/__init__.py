@@ -26,6 +26,10 @@ ROADMAP's production stance needs on preemptible hardware:
   watchdog + flight records + bounded auto-restart: run the training
   loop as a supervised child and a kill or hang at ANY step resumes
   from the latest checkpoint (see docs/resilience.md);
+* :mod:`~mxnet_tpu.resilience.elastic` — the operator control plane
+  for elastic dist_sync training: :func:`~elastic.operator_resize`
+  rescales a RUNNING job N→M without a restart (the kvstore's live
+  membership layer applies it at a sync-round boundary);
 * the in-graph non-finite guard lives device-side (see
   ``optimizer/tree_opt.py`` and ``Executor.init_fused_step``); this
   package supplies its host-side :class:`DivergenceError`;
@@ -43,6 +47,7 @@ import threading
 
 from ..base import MXNetError
 from . import chaos  # noqa: F401
+from . import elastic  # noqa: F401
 from . import netchaos  # noqa: F401
 from . import servechaos  # noqa: F401
 from . import supervisor  # noqa: F401
@@ -52,8 +57,8 @@ from .jobstate import TrainJobState  # noqa: F401
 from .retry import retry, retry_call  # noqa: F401
 
 __all__ = ["CheckpointManager", "CheckpointRecord", "atomic_write",
-           "retry", "retry_call", "chaos", "netchaos", "servechaos",
-           "supervisor",
+           "retry", "retry_call", "chaos", "elastic", "netchaos",
+           "servechaos", "supervisor",
            "TrainJobState", "DivergenceError", "StateMismatchError",
            "request_preemption", "clear_preemption",
            "preemption_requested", "install_preemption_handler"]

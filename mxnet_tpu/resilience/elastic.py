@@ -1,0 +1,104 @@
+"""Elastic distributed training — the operator's control plane.
+
+PR 7/8 built the primitives (straggler eviction, rejoin with
+incarnation tokens, bit-exact mid-epoch resume); the kvstore's live
+membership layer (``_kvstore_impl``: membership epochs, barrier-
+boundary transitions, typed stale-contributor rejection) composes
+them into elasticity.  This module is the thin operator-side entry
+point: resize a RUNNING dist_sync job from any process — a
+supervisor, a maintenance hook, a shell — without constructing a
+full :class:`~mxnet_tpu.kvstore.KVStoreDist` (which would claim a
+worker rank).
+
+The protocol (docs/resilience.md "Elastic training"):
+
+* every server versions its expected-contributor set with a
+  **membership epoch**, carried on every heartbeat and sync reply;
+* ``resize(M)`` records a pending world size on every server; it is
+  APPLIED at the next barrier completion — the one instant a
+  dist_sync job provably has no push in flight — so all workers see
+  the transition in the same completed round's snapshot and re-shard
+  at the same batch boundary;
+* shrunk-away ranks find themselves outside the snapshot's member
+  list and exit cleanly; any straggling push they still had on the
+  wire is rejected with a typed
+  :class:`~mxnet_tpu.kvstore.EvictedWorkerError`;
+* grown slots fill as new workers heartbeat in: they are admitted at
+  a barrier completion, learn their admission round via
+  ``kv.wait_admission()``, and take over their shard from the
+  job metadata the survivors publish (``kv.put_job_meta``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+__all__ = ["operator_resize", "server_endpoints"]
+
+log = logging.getLogger(__name__)
+
+
+def server_endpoints(host=None, root_port=None, num_servers=None):
+    """The (host, port) of every server of the launch, resolved from
+    the standard ``DMLC_*`` env names when not given explicitly."""
+    host = host or os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+    root_port = int(root_port if root_port is not None
+                    else os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+    num_servers = int(num_servers if num_servers is not None
+                      else os.environ.get("DMLC_NUM_SERVER", "1"))
+    return [(host, root_port + s) for s in range(num_servers)]
+
+
+def operator_resize(world, host=None, root_port=None, num_servers=None,
+                    timeout=30.0):
+    """Command a running dist_sync job to rescale to *world* workers
+    (either direction) without a restart-from-checkpoint.
+
+    Sends the ``resize`` command to every server of the group; each
+    records the target and applies it at its next sync-round boundary.
+    Returns server 0's acknowledgement (``{"world": current,
+    "pending_world": target, "mep": epoch}``).  Growing past the
+    launch size additionally needs the new worker processes started
+    (with ``DMLC_WORKER_RANK`` = the new ranks); they announce
+    themselves by heartbeating and are admitted at the next boundary.
+    """
+    from .._kvstore_impl import _connect_retry, _rpc_call, _MSG_CMD
+    world = int(world)
+    if world < 1:
+        raise ValueError("resize target must be >= 1 worker, got %d"
+                         % world)
+    replies, failures = [], []
+    for host_, port in server_endpoints(host, root_port, num_servers):
+        # attempt EVERY server even after a failure: aborting midway
+        # would leave the group with divergent resize targets and
+        # nothing telling the operator which half recorded the command
+        try:
+            sock = _connect_retry(host_, port,
+                                  time.monotonic() + timeout)
+            try:
+                sock.settimeout(timeout)
+                replies.append(_rpc_call(
+                    sock, _MSG_CMD,
+                    {"head": "resize", "body": world})[0])
+            finally:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+        except (ConnectionError, OSError) as exc:
+            failures.append(("%s:%d" % (host_, port), exc))
+    if failures:
+        detail = ", ".join("%s (%s: %s)" % (ep, type(e).__name__, e)
+                           for ep, e in failures)
+        raise RuntimeError(
+            "resize to %d acknowledged by %d/%d server(s); FAILED on "
+            "%s — the group now has divergent resize targets: re-run "
+            "operator_resize(%d) until every server acknowledges"
+            % (world, len(replies), len(replies) + len(failures),
+               detail, world))
+    log.warning("operator resize to %d worker(s) acknowledged by %d "
+                "server(s) (world was %s)", world, len(replies),
+                replies[0].get("world") if replies else None)
+    return replies[0] if replies else None

@@ -217,6 +217,32 @@ class Supervisor:
         self.heartbeat_path = os.path.join(self.workdir, "heartbeat")
         os.makedirs(self.workdir, exist_ok=True)
 
+    # -- elastic scale hook ------------------------------------------------
+    def resize_workers(self, world, host=None, root_port=None,
+                       num_servers=None, timeout=30.0):
+        """Drive an elastic rescale of the supervised dist_sync job to
+        *world* workers (either direction) without restarting it: the
+        operator-commanded path of docs/resilience.md "Elastic
+        training".  Endpoints default to the supervised child's
+        ``DMLC_*`` environment (``self.env`` first, then this
+        process's).  Growing additionally needs the new worker
+        processes started; shrunk-away ranks exit cleanly on their
+        own."""
+        from .elastic import operator_resize
+        env = dict(os.environ)
+        env.update(self.env)
+        reply = operator_resize(
+            world,
+            host=host or env.get("DMLC_PS_ROOT_URI"),
+            root_port=root_port if root_port is not None
+            else env.get("DMLC_PS_ROOT_PORT"),
+            num_servers=num_servers if num_servers is not None
+            else env.get("DMLC_NUM_SERVER"),
+            timeout=timeout)
+        self.logger.warning("supervisor: commanded elastic resize to "
+                            "%d worker(s): %s", world, reply)
+        return reply
+
     # -- child lifecycle ---------------------------------------------------
     def _child_env(self, attempt):
         env = dict(os.environ)

@@ -1,0 +1,879 @@
+"""Elastic distributed training — membership epochs, live re-sharding,
+mid-epoch admission, operator resize (docs/resilience.md "Elastic
+training"; the multi-process end-to-end proof is
+ci/netchaos_drill.py's elastic scenarios)."""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu._kvstore_impl import (
+    KVStoreServer, KVStoreBase, _rpc_call, _MSG_INIT, _MSG_PUSH,
+    _MSG_PULL, _MSG_BARRIER, _MSG_HEARTBEAT, _MSG_CMD,
+    EvictedWorkerError, SyncTimeoutError)
+from mxnet_tpu.io import NDArrayIter, PrefetchingIter
+from mxnet_tpu.gluon.data import (ArrayDataset, DataLoader,
+                                  ElasticBatchSampler)
+
+
+# ---------------------------------------------------------------------------
+# in-process server helpers (same idiom as tests/test_kvstore.py)
+# ---------------------------------------------------------------------------
+
+def _spawn_server(sync_mode, num_workers, **kw):
+    srv = KVStoreServer(sync_mode=sync_mode, num_workers=num_workers,
+                        **kw)
+    t = threading.Thread(target=srv.run, daemon=True)
+    t.start()
+    return srv, t
+
+
+def _stop_server(srv, t):
+    srv._stop.set()
+    try:
+        srv.sock.close()
+    except OSError:
+        pass
+    t.join(timeout=10)
+
+
+def _cli(port):
+    return socket.create_connection(("127.0.0.1", port), timeout=30)
+
+
+def _barrier_all(conns, rnd, seq, inc=1):
+    """Arrive at barrier *rnd* from every (rank, conn); returns the
+    reply snapshots in rank order."""
+    out = [None] * len(conns)
+    errs = []
+
+    def go(i, rank, c):
+        try:
+            out[i] = _rpc_call(c, _MSG_BARRIER,
+                               {"rank": rank, "round": rnd,
+                                "req": [rank, seq, inc]})[0]
+        except BaseException as e:
+            errs.append(e)
+
+    ths = [threading.Thread(target=go, args=(i, rank, c))
+           for i, (rank, c) in enumerate(conns)]
+    for th in ths:
+        th.start()
+    for th in ths:
+        th.join(timeout=60)
+    if errs:
+        raise errs[0]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# membership epochs + resize on the server
+# ---------------------------------------------------------------------------
+
+def test_resize_shrink_applies_at_barrier_and_rejects_retired():
+    """Operator resize 3->2: pending until the barrier boundary, then
+    the round's snapshot carries the SAME (epoch, members, world) to
+    every waiter; the retired rank's later sync push fails typed."""
+    from mxnet_tpu.observability import metrics
+    srv, t = _spawn_server(True, 3)
+    conns = [(r, _cli(srv.port)) for r in range(3)]
+    try:
+        snaps = _barrier_all(conns, 1, 1)
+        assert all(s["members"] == [0, 1, 2] and s["mep"] == 0
+                   for s in snaps)
+        r = _rpc_call(conns[0][1], _MSG_CMD,
+                      {"head": "resize", "body": 2, "req": [0, 2, 1]})[0]
+        assert r["pending_world"] == 2 and r["world"] == 3
+        # not applied yet: pushes from rank 2 still fine mid-round
+        with srv.lock:
+            assert srv.world == 3 and 2 in srv.joined
+        snaps = _barrier_all(conns, 2, 3)
+        assert all(s["members"] == [0, 1] and s["world"] == 2 and
+                   s["mep"] == 1 for s in snaps)
+        assert metrics.gauge("kvstore_active_workers").value == 2
+        _rpc_call(conns[0][1], _MSG_INIT,
+                  {"key": "w", "req": [0, 4, 1]},
+                  (np.zeros(2, np.float32),))
+        before = metrics.counter(
+            "kvstore_stale_contributions_rejected_total").value
+        with pytest.raises(EvictedWorkerError):
+            _rpc_call(conns[2][1], _MSG_PUSH,
+                      {"key": "w", "req": [2, 5, 1], "mep": 1},
+                      (np.ones(2, np.float32),))
+        assert metrics.counter(
+            "kvstore_stale_contributions_rejected_total").value == \
+            before + 1
+        with srv.lock:
+            assert "w" not in srv.pending     # nothing accumulated
+    finally:
+        for _, c in conns:
+            c.close()
+        _stop_server(srv, t)
+
+
+def test_resize_grow_admits_heartbeating_ranks_at_barrier():
+    """Grow 1->3: new ranks announce themselves by heartbeat (join
+    PENDING), and both the resize and the admissions land at the next
+    barrier completion, recorded with the admission round."""
+    srv, t = _spawn_server(True, 1)
+    c = _cli(srv.port)
+    try:
+        _rpc_call(c, _MSG_CMD, {"head": "resize", "body": 3,
+                                "req": [0, 1, 1]})
+        _rpc_call(c, _MSG_HEARTBEAT, {"node": "worker1"})
+        _rpc_call(c, _MSG_HEARTBEAT, {"node": "worker2"})
+        with srv.lock:
+            assert srv.joined == {0}
+            assert srv.pending_join == {1, 2}
+        snap = _rpc_call(c, _MSG_BARRIER,
+                         {"rank": 0, "round": 1, "req": [0, 2, 1]})[0]
+        assert snap["members"] == [0, 1, 2] and snap["world"] == 3
+        st = _rpc_call(c, _MSG_CMD, {"head": "stats"})[0]
+        assert st["members"] == [0, 1, 2]
+        assert st["admitted_round"]["1"] == 1
+        assert st["admitted_round"]["2"] == 1
+        assert st["mep"] >= 2     # resize bump + join bump
+    finally:
+        c.close()
+        _stop_server(srv, t)
+
+
+def test_membership_epoch_rides_push_and_heartbeat_replies():
+    srv, t = _spawn_server(False, 2)
+    c = _cli(srv.port)
+    try:
+        hb = _rpc_call(c, _MSG_HEARTBEAT, {"node": "worker0"})[0]
+        assert hb["mep"] == 0 and hb["members"] == [0, 1] \
+            and hb["world"] == 2
+        _rpc_call(c, _MSG_INIT, {"key": "w", "req": [0, 1, 1]},
+                  (np.zeros(2, np.float32),))
+        import pickle
+        blob = np.frombuffer(pickle.dumps(mx.optimizer.create(
+            "sgd", learning_rate=1.0, rescale_grad=1.0, wd=0.0)),
+            np.uint8)
+        _rpc_call(c, 6, None, (blob,))      # SET_OPT
+        m = _rpc_call(c, _MSG_PUSH, {"key": "w", "req": [0, 2, 1]},
+                      (np.ones(2, np.float32),))[0]
+        assert "mep" in m
+    finally:
+        c.close()
+        _stop_server(srv, t)
+
+
+# ---------------------------------------------------------------------------
+# stale-contributor rejection (satellite regression)
+# ---------------------------------------------------------------------------
+
+def test_stale_contributor_rejection_regression(monkeypatch):
+    """The pre-fix corruption: an evicted-but-alive worker's push for
+    a round that completed without it would silently merge into the
+    NEXT round's accumulator.  Post-fix it gets a typed
+    EvictedWorkerError (never a silent apply, never a dedup-cache
+    'ok'), and after re-observing the membership (fresh mep) it is
+    re-admitted and contributes again."""
+    monkeypatch.setenv("MXNET_KVSTORE_SYNC_TIMEOUT", "1.0")
+    monkeypatch.setenv("MXNET_KVSTORE_EVICT_TIMEOUT", "0.3")
+    srv, t = _spawn_server(True, 2)
+    c0, c1 = _cli(srv.port), _cli(srv.port)
+    try:
+        _rpc_call(c0, _MSG_INIT, {"key": "w", "req": [0, 1, 1]},
+                  (np.zeros(2, np.float32),))
+        _rpc_call(c1, _MSG_HEARTBEAT, {"node": "worker1"})  # then stalls
+        time.sleep(0.5)                       # heartbeat now stale
+        _rpc_call(c0, _MSG_HEARTBEAT, {"node": "worker0"})
+        # worker 0's round completes by evicting the dead rank 1
+        _rpc_call(c0, _MSG_PUSH, {"key": "w", "req": [0, 2, 1],
+                                  "mep": 0},
+                  (np.full(2, 5.0, np.float32),))
+        out = _rpc_call(c0, _MSG_PULL, {"key": "w"})[1][0]
+        np.testing.assert_allclose(out, 5.0)
+        with srv.lock:
+            assert srv.evicted == {1}
+            fence = srv.rank_fence[1]
+        assert fence >= 1
+        # rank 1 is alive after all: its push, computed under the OLD
+        # membership view, arrives late -> typed rejection, store
+        # untouched, round accumulator untouched
+        with pytest.raises(EvictedWorkerError):
+            _rpc_call(c1, _MSG_PUSH, {"key": "w", "req": [1, 1, 1],
+                                      "mep": 0},
+                      (np.full(2, 100.0, np.float32),))
+        out = _rpc_call(c0, _MSG_PULL, {"key": "w"})[1][0]
+        np.testing.assert_allclose(out, 5.0)   # NOT polluted
+        with srv.lock:
+            assert "w" not in srv.pending
+        # the failed push's request id was NOT cached: a retry is
+        # re-executed (and re-rejected while still stale), never
+        # answered 'ok' from the dedup window
+        with pytest.raises(EvictedWorkerError) as ei:
+            _rpc_call(c1, _MSG_PUSH, {"key": "w", "req": [1, 1, 1],
+                                      "mep": 0},
+                      (np.full(2, 100.0, np.float32),))
+        assert "dup" not in str(ei.value)
+        # recovery: heartbeat (rejoin-pending) + a push declaring a
+        # post-eviction membership view -> implicit re-admission, and
+        # the round completes with both contributors
+        _rpc_call(c1, _MSG_HEARTBEAT, {"node": "worker1"})
+        with srv.lock:
+            assert srv.evicted == set() and 1 in srv.pending_join
+        # keep rank 0 provably alive for the joint round (its one
+        # heartbeat above is seconds old by now — the evict timeout
+        # in this test is 0.3s)
+        _rpc_call(c0, _MSG_HEARTBEAT, {"node": "worker0"})
+        res = {}
+
+        def w1_push():
+            res["w1"] = _rpc_call(c1, _MSG_PUSH,
+                                  {"key": "w", "req": [1, 2, 1],
+                                   "mep": fence},
+                                  (np.full(2, 2.0, np.float32),))[0]
+
+        # worker 1 pushes FIRST: implicit re-admission happens at push
+        # entry (before it blocks on the round), so worker 0's push
+        # deterministically joins the same round instead of completing
+        # one alone against the pre-admission expected set
+        th = threading.Thread(target=w1_push)
+        th.start()
+        deadline = time.monotonic() + 10
+        while True:
+            with srv.lock:
+                if 1 in srv.joined:
+                    break
+            assert time.monotonic() < deadline, "re-admission never ran"
+            time.sleep(0.01)
+        m0 = _rpc_call(c0, _MSG_PUSH, {"key": "w", "req": [0, 3, 1],
+                                       "mep": 0},
+                       (np.full(2, 1.0, np.float32),))[0]
+        th.join(timeout=30)
+        assert m0["status"] == "ok" and res["w1"]["status"] == "ok"
+        out = _rpc_call(c0, _MSG_PULL, {"key": "w"})[1][0]
+        np.testing.assert_allclose(out, 3.0)   # 1 + 2 aggregated
+        with srv.lock:
+            assert 1 in srv.joined
+    finally:
+        c0.close()
+        c1.close()
+        _stop_server(srv, t)
+
+
+def test_raw_push_from_nonmember_rejected_without_mep():
+    """A legacy pusher (no membership view declared) from a rank that
+    is not a member is still rejected typed — pending admission is
+    only granted to pushes that PROVE a fresh view via their mep."""
+    srv, t = _spawn_server(True, 1)
+    c = _cli(srv.port)
+    try:
+        _rpc_call(c, _MSG_CMD, {"head": "resize", "body": 2,
+                                "req": [0, 1, 1]})
+        _rpc_call(c, _MSG_HEARTBEAT, {"node": "worker1"})  # pending
+        with pytest.raises(EvictedWorkerError):
+            _rpc_call(c, _MSG_PUSH, {"key": "w", "req": [1, 1, 7]},
+                      (np.ones(2, np.float32),))
+    finally:
+        c.close()
+        _stop_server(srv, t)
+
+
+def test_snapshot_restores_membership(tmp_path, monkeypatch):
+    """world/joined/membership_epoch/rank fences survive a server
+    kill+restart through the state snapshot."""
+    monkeypatch.setenv("MXNET_KVSTORE_SNAPSHOT_EVERY", "1")
+    import pickle
+    blob = np.frombuffer(pickle.dumps(mx.optimizer.create(
+        "sgd", learning_rate=1.0, rescale_grad=1.0, wd=0.0)), np.uint8)
+    prefix = str(tmp_path / "snap")
+    srv, t = _spawn_server(False, 3, snapshot_prefix=prefix)
+    conns = [(r, _cli(srv.port)) for r in range(3)]
+    c = conns[0][1]
+    try:
+        _rpc_call(c, 6, None, (blob,), )           # SET_OPT
+        _rpc_call(c, _MSG_INIT, {"key": "w", "req": [0, 1, 1]},
+                  (np.zeros(2, np.float32),))
+        _rpc_call(c, _MSG_CMD, {"head": "resize", "body": 2,
+                                "req": [0, 2, 1]})
+        # async mode: the barrier still gates membership application;
+        # the initial joined set {0,1,2} shrinks to {0,1}
+        snaps = _barrier_all(conns, 1, 3)
+        assert snaps[0]["world"] == 2 and snaps[0]["members"] == [0, 1]
+        mep = snaps[0]["mep"]
+        _rpc_call(c, _MSG_PUSH, {"key": "w", "req": [0, 4, 1]},
+                  (np.ones(2, np.float32),))      # apply -> snapshot
+    finally:
+        for _, cc in conns:
+            cc.close()
+        _stop_server(srv, t)
+    srv2, t2 = _spawn_server(False, 3, snapshot_prefix=prefix)
+    try:
+        with srv2.lock:
+            assert srv2.world == 2
+            assert srv2.joined == {0, 1}
+            assert srv2.membership_epoch == mep
+            assert srv2.rank_fence.get(2) == mep
+    finally:
+        _stop_server(srv2, t2)
+
+
+# ---------------------------------------------------------------------------
+# operator control plane + worker live view
+# ---------------------------------------------------------------------------
+
+def test_operator_resize_helper():
+    from mxnet_tpu.resilience.elastic import operator_resize
+    srv, t = _spawn_server(True, 3)
+    try:
+        reply = operator_resize(2, host="127.0.0.1",
+                                root_port=srv.port, num_servers=1)
+        assert reply["pending_world"] == 2 and reply["world"] == 3
+        with srv.lock:
+            assert srv.pending_world == 2
+    finally:
+        _stop_server(srv, t)
+
+
+def test_supervisor_resize_hook(tmp_path):
+    from mxnet_tpu.resilience.supervisor import Supervisor
+    srv, t = _spawn_server(True, 3)
+    try:
+        sup = Supervisor(["true"], workdir=str(tmp_path / "sup"),
+                         env={"DMLC_PS_ROOT_URI": "127.0.0.1",
+                              "DMLC_PS_ROOT_PORT": str(srv.port),
+                              "DMLC_NUM_SERVER": "1"})
+        reply = sup.resize_workers(2)
+        assert reply["pending_world"] == 2
+        with srv.lock:
+            assert srv.pending_world == 2
+    finally:
+        _stop_server(srv, t)
+
+
+def test_worker_live_membership_view(monkeypatch):
+    """KVStoreDist.num_workers reads the LIVE membership view: a grow
+    admitted at a barrier moves it without any restart, and the
+    completed-round snapshot gives position/member info."""
+    monkeypatch.setenv("MXNET_KVSTORE_SYNC_TIMEOUT", "3")
+    monkeypatch.setenv("MXNET_KVSTORE_EVICT_TIMEOUT", "0.5")
+    monkeypatch.setenv("MXNET_KVSTORE_HEARTBEAT_INTERVAL", "0.2")
+    srv = KVStoreServer(sync_mode=True, num_workers=1)
+    t = threading.Thread(target=srv.run, daemon=True)
+    t.start()
+    monkeypatch.setenv("DMLC_PS_ROOT_URI", "127.0.0.1")
+    monkeypatch.setenv("DMLC_PS_ROOT_PORT", str(srv.port))
+    monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+    monkeypatch.setenv("DMLC_NUM_SERVER", "1")
+    monkeypatch.setenv("DMLC_WORKER_RANK", "0")
+    kv = None
+    raw = None
+    try:
+        kv = mx.kv.KVStoreDist("dist_sync")
+        assert kv.num_workers == 1
+        assert kv.my_position() == 0
+        kv.resize(2)
+        raw = _cli(srv.port)
+        _rpc_call(raw, _MSG_HEARTBEAT, {"node": "worker1"})
+        kv.barrier()        # resize + admission apply here
+        view = kv.membership()
+        assert kv.num_workers == 2
+        assert view["members"] == [0, 1] and view["world"] == 2
+        # shrink back: rank 1 (a raw socket that never barriers again)
+        # goes provably dead and the next barrier both evicts it and
+        # applies the pending world
+        kv.resize(1)
+        time.sleep(0.8)     # rank 1's heartbeat goes stale
+        kv.barrier()
+        assert kv.num_workers == 1
+        assert kv.membership()["world"] == 1
+    finally:
+        if raw is not None:
+            raw.close()
+        if kv is not None:
+            kv._closed = True
+        _stop_server(srv, t)
+
+
+# ---------------------------------------------------------------------------
+# deterministic re-partition: NDArrayIter
+# ---------------------------------------------------------------------------
+
+def _consume_round(iters, seen):
+    """One global round across all partitioned iterators; returns
+    False when the epoch ended."""
+    for it in iters:
+        try:
+            b = it.next()
+        except StopIteration:
+            return False
+        sel = np.asarray(b.index)
+        real = sel[:len(sel) - b.pad]
+        seen.extend(int(i) for i in real)
+    return True
+
+
+@pytest.mark.parametrize("start,mid,end", [(3, 2, 2), (2, 3, 3),
+                                           (3, 2, 4)])
+def test_ndarrayiter_repartition_exactly_once(start, mid, end):
+    """A mid-epoch shrink AND grow together consume each epoch index
+    exactly once — the satellite contract, parametrized over resize
+    directions including the 3->2->4 chain."""
+    N, B = 48, 2
+    X = np.arange(N, dtype=np.float32).reshape(N, 1)
+
+    def mk(p, k):
+        return NDArrayIter({"data": X}, batch_size=B, shuffle=True,
+                           shuffle_seed=17, last_batch_handle="pad",
+                           part_index=p, num_parts=k)
+
+    for epoch in range(2):      # second epoch: permutations in lockstep
+        iters = [mk(0, start) for _ in range(start)]
+        for i, it in enumerate(iters):
+            it.repartition(i, start)
+            if epoch:
+                it.reset()
+        seen = []
+        for _ in range(3):
+            assert _consume_round(iters, seen)
+        iters = iters[:mid] if mid < start else \
+            iters + [mk(0, start) for _ in range(mid - start)]
+        if mid > start:
+            # joiners take over from a survivor's jobstate
+            st = iters[0].state_dict()
+            for it in iters[start:]:
+                it.load_state(st)
+        for i, it in enumerate(iters):
+            it.repartition(i, mid)
+        for _ in range(3):
+            assert _consume_round(iters, seen)
+        iters = iters[:end] if end < mid else \
+            iters + [mk(0, mid) for _ in range(end - mid)]
+        if end > mid:
+            st = iters[0].state_dict()
+            for it in iters[mid:]:
+                it.load_state(st)
+        for i, it in enumerate(iters):
+            it.repartition(i, end)
+        while _consume_round(iters, seen):
+            pass
+        counts = {}
+        for i in seen:
+            counts[i] = counts.get(i, 0) + 1
+        assert sorted(counts) == list(range(N))
+        assert all(v == 1 for v in counts.values()), \
+            {i: c for i, c in counts.items() if c != 1}
+
+
+def test_ndarrayiter_joiner_stream_bit_reproducible():
+    """A joiner that restores a survivor's state_dict and repartitions
+    to its own slot yields the BIT-identical remaining stream a
+    survivor repartitioned in place does."""
+    N, B = 24, 2
+    X = np.arange(N, dtype=np.float32)
+
+    def mk(p, k):
+        return NDArrayIter(X, batch_size=B, shuffle=True,
+                           shuffle_seed=5, last_batch_handle="pad",
+                           part_index=p, num_parts=k)
+
+    a = mk(1, 3)
+    for _ in range(3):
+        a.next()
+    st = a.state_dict()
+    a.repartition(1, 2)
+    j = mk(0, 3)
+    j.load_state(st)
+    j.repartition(1, 2)
+    sa = [tuple(a.next().index) for _ in range(2)]
+    sj = [tuple(j.next().index) for _ in range(2)]
+    assert sa == sj
+    # and the NEXT epoch's permutation stays in lockstep too
+    a.reset()
+    j.reset()
+    assert [tuple(a.next().index) for _ in range(2)] == \
+        [tuple(j.next().index) for _ in range(2)]
+
+
+def test_ndarrayiter_partition_validation():
+    X = np.arange(8, dtype=np.float32)
+    with pytest.raises(ValueError):
+        NDArrayIter(X, batch_size=2, num_parts=5)   # 10 > 8
+    with pytest.raises(ValueError):
+        NDArrayIter(X, batch_size=2, num_parts=2, part_index=2)
+    with pytest.raises(ValueError):
+        NDArrayIter(X, batch_size=2, num_parts=2,
+                    last_batch_handle="roll_over")
+    it = NDArrayIter(X, batch_size=2, num_parts=2)
+    with pytest.raises(ValueError):
+        it.repartition(0, 5)
+
+
+def test_prefetching_iter_repartition_no_loss_no_dup():
+    """Repartition THROUGH the prefetch ring: prefetched-but-
+    undelivered batches are rewound into the new layout — nothing
+    skipped, nothing replayed."""
+    N, B = 24, 2
+    X = np.arange(N, dtype=np.float32)
+
+    def mk(p, k):
+        return PrefetchingIter(
+            NDArrayIter(X, batch_size=B, shuffle=True, shuffle_seed=3,
+                        last_batch_handle="pad", part_index=p,
+                        num_parts=k))
+
+    its = [mk(p, 3) for p in range(3)]
+    seen = []
+    try:
+        for _ in range(2):
+            assert _consume_round(its, seen)
+        time.sleep(0.1)     # let producers run ahead (ring fills)
+        its = its[:2]
+        for i, it in enumerate(its):
+            it.repartition(i, 2)
+        while _consume_round(its, seen):
+            pass
+        counts = {}
+        for i in seen:
+            counts[i] = counts.get(i, 0) + 1
+        assert sorted(counts) == list(range(N))
+        assert all(v == 1 for v in counts.values())
+    finally:
+        for it in its:
+            it.close()
+
+
+# ---------------------------------------------------------------------------
+# deterministic re-partition: gluon sampler + DataLoader
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("start,mid", [(3, 2), (2, 3)])
+def test_elastic_batch_sampler_exactly_once(start, mid):
+    N, B = 24, 2
+    samplers = [ElasticBatchSampler(N, B, part_index=p,
+                                    num_parts=start, seed=11,
+                                    last_batch="keep")
+                for p in range(start)]
+    its = [iter(s) for s in samplers]
+    seen = []
+    for _ in range(3):
+        for it in its:
+            seen.extend(next(it))
+    if mid < start:
+        samplers, its = samplers[:mid], its[:mid]
+    else:
+        st = samplers[0].state_dict()
+        for p in range(start, mid):
+            s = ElasticBatchSampler(N, B, seed=11, last_batch="keep")
+            s.load_state(st, in_progress=True)
+            samplers.append(s)
+            its.append(iter(s))
+    for i, s in enumerate(samplers):
+        s.repartition(i, mid)
+    while True:
+        done = False
+        for it in its:
+            try:
+                seen.extend(next(it))
+            except StopIteration:
+                done = True
+        if done:
+            break
+    counts = {}
+    for i in seen:
+        counts[i] = counts.get(i, 0) + 1
+    assert sorted(counts) == list(range(N))
+    assert all(v == 1 for v in counts.values())
+
+
+def test_elastic_batch_sampler_keep_tail_and_state():
+    """'keep' splits a ragged tail contiguously (exactly-once without
+    padding) and a restored sampler resumes at the exact global
+    cursor (exact_resume contract — bit-reproducible)."""
+    N, B = 22, 2
+    samplers = [ElasticBatchSampler(N, B, part_index=p, num_parts=3,
+                                    seed=2, last_batch="keep")
+                for p in range(3)]
+    seen = []
+    for s in samplers:
+        for b in s:
+            seen.extend(b)
+    counts = {}
+    for i in seen:
+        counts[i] = counts.get(i, 0) + 1
+    assert sorted(counts) == list(range(N))
+    assert all(v == 1 for v in counts.values())
+
+    a = ElasticBatchSampler(N, B, part_index=1, num_parts=2, seed=9)
+    ia = iter(a)
+    consumed = [next(ia), next(ia)]
+    st = a.state_dict()
+    rest_a = list(ia)
+    b2 = ElasticBatchSampler(N, B, seed=9)
+    b2.load_state(st, in_progress=True)
+    b2.repartition(1, 2)
+    assert list(iter(b2)) == rest_a
+    assert consumed[0] != consumed[1]
+
+
+def test_dataloader_elastic_repartition_and_resume():
+    N, B = 24, 2
+    ds = ArrayDataset(np.arange(N).astype(np.float32))
+
+    def mk(p, k):
+        return DataLoader(ds, batch_sampler=ElasticBatchSampler(
+            N, B, part_index=p, num_parts=k, seed=21))
+
+    loaders = [mk(p, 2) for p in range(2)]
+    its = [iter(dl) for dl in loaders]
+    seen = []
+    for _ in range(3):
+        for it in its:
+            seen.extend(int(v) for v in next(it).asnumpy())
+    # grow to 3: joiner loads a survivor's DataLoader state
+    st = loaders[0].state_dict()
+    j = mk(0, 1)
+    j.load_state(st)
+    j.repartition(2, 3)
+    for i, dl in enumerate(loaders):
+        dl.repartition(i, 3)
+    its.append(iter(j))
+    while True:
+        done = False
+        for it in its:
+            try:
+                seen.extend(int(v) for v in next(it).asnumpy())
+            except StopIteration:
+                done = True
+        if done:
+            break
+    counts = {}
+    for i in seen:
+        counts[i] = counts.get(i, 0) + 1
+    assert sorted(counts) == list(range(N))
+    assert all(v == 1 for v in counts.values())
+
+
+# ---------------------------------------------------------------------------
+# Module wiring: elastic_tick / evicted-recovery in fit
+# ---------------------------------------------------------------------------
+
+class _FakeDistKV(KVStoreBase):
+    """Duck-typed dist store: a dict of arrays, a scriptable
+    membership view, and programmable push failures."""
+
+    def __init__(self, members=(0, 1, 2), rank=0):
+        super().__init__()
+        self.name = "dist_sync"
+        self._store = {}
+        self._rank = rank
+        self._view = {"mep": 0, "members": list(members),
+                      "world": len(members)}
+        self.pushed = []
+        self.pulls = 0
+        self.fail_next_pushes = 0
+        self.resyncs = 0
+
+    type = property(lambda self: self.name)
+    rank = property(lambda self: self._rank)
+
+    @property
+    def num_workers(self):
+        return max(1, len(self._view["members"]))
+
+    def membership(self):
+        return {k: (list(v) if isinstance(v, list) else v)
+                for k, v in self._view.items()}
+
+    def set_membership(self, members, mep, world=None):
+        self._view = {"mep": mep, "members": list(members),
+                      "world": (len(members) if world is None
+                                else world)}
+
+    def refresh_membership(self):
+        return self.membership()
+
+    def init(self, key, value):
+        self._store[key] = value.copy()
+
+    def push(self, key, value, priority=0):
+        if self.fail_next_pushes > 0:
+            self.fail_next_pushes -= 1
+            raise EvictedWorkerError("fake: stale contribution")
+        vals = value if isinstance(value, (list, tuple)) else [value]
+        self.pushed.append((key, vals[0].asnumpy().copy()))
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        self.pulls += 1
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for o in outs:
+            self._store[key].copyto(o)
+
+    def barrier(self):
+        pass
+
+
+def _bind_module(kv, update_on_kvstore=True, monkeypatch=None):
+    if monkeypatch is not None:
+        monkeypatch.setenv("MXNET_UPDATE_ON_KVSTORE",
+                           "1" if update_on_kvstore else "0")
+    from mxnet_tpu import sym
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    net = sym.FullyConnected(data, num_hidden=4, name="fc")
+    net = sym.SoftmaxOutput(net, label, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind([("data", (4, 6))], [("softmax_label", (4,))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(kvstore=kv, optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    return mod
+
+
+def test_module_elastic_tick_rescales_and_repartitions(monkeypatch):
+    kv = _FakeDistKV(members=(0, 1, 2))
+    mod = _bind_module(kv, update_on_kvstore=True,
+                       monkeypatch=monkeypatch)
+    assert mod._elastic_active == 3
+
+    class _Iter:
+        calls = []
+
+        def repartition(self, p, k):
+            self.calls.append((p, k))
+
+    it = _Iter()
+    assert mod.elastic_tick(it) is True        # no change: no-op
+    assert it.calls == []
+    kv.set_membership([0, 1], mep=1)
+    assert mod.elastic_tick(it) is True
+    assert it.calls == [(0, 2)]
+    # server-side updater: the rescale pre-scales pushed grads
+    assert mod._elastic_grad_scale == pytest.approx(3 / 2)
+    batch = mx.io.DataBatch(
+        data=[nd.array(np.random.RandomState(0).randn(4, 6)
+                       .astype(np.float32))],
+        label=[nd.array(np.zeros(4, np.float32))])
+    mod.forward_backward(batch)
+    n0 = len(kv.pushed)
+    mod.update()
+    assert len(kv.pushed) > n0      # scaled pushes went through
+
+
+def test_module_elastic_tick_local_updater_rescales_hyper(monkeypatch):
+    kv = _FakeDistKV(members=(0, 1))
+    mod = _bind_module(kv, update_on_kvstore=False,
+                       monkeypatch=monkeypatch)
+    base = mod._optimizer.rescale_grad
+    kv.set_membership([0, 1, 2], mep=1)
+    assert mod.elastic_tick(None) is True
+    assert mod._optimizer.rescale_grad == pytest.approx(base * 2 / 3)
+    assert mod._elastic_grad_scale == 1.0
+
+
+def test_module_elastic_tick_retire_vs_awaiting(monkeypatch):
+    kv = _FakeDistKV(members=(0, 1), rank=1)
+    mod = _bind_module(kv, monkeypatch=monkeypatch)
+    # evicted but still inside the world: re-admission is pending —
+    # keep training (and keep the rescale factor untouched so the
+    # evict→readmit round trip nets to 1)
+    kv.set_membership([0], mep=3, world=2)
+    scale0 = mod._elastic_grad_scale
+    assert mod.elastic_tick(None) is True
+    assert mod._elastic_grad_scale == scale0
+    kv.set_membership([0, 1], mep=4, world=2)
+    assert mod.elastic_tick(None) is True
+    assert mod._elastic_grad_scale == scale0     # netted out
+    # resized away: permanent — retire cleanly
+    kv.set_membership([0], mep=5, world=1)
+    assert mod.elastic_tick(None) is False
+
+
+def test_elastic_batch_sampler_len_matches_yields_keep():
+    """'keep' tail: only parts whose slice the tail reaches yield the
+    ragged final batch — __len__ must agree per part."""
+    for part in range(2):
+        s = ElasticBatchSampler(10, 4, part_index=part, num_parts=2,
+                                seed=1, last_batch="keep")
+        assert len(list(iter(s))) == len(s), "part %d" % part
+    assert len(ElasticBatchSampler(10, 4, part_index=0, num_parts=2,
+                                   seed=1, last_batch="keep")) == 2
+    assert len(ElasticBatchSampler(10, 4, part_index=1, num_parts=2,
+                                   seed=1, last_batch="keep")) == 1
+
+
+def test_operator_resize_partial_failure_is_loud():
+    """A server group where one member is unreachable: every server is
+    still attempted, and the error names the split instead of leaving
+    half the group silently diverged."""
+    from mxnet_tpu.resilience.elastic import operator_resize
+    srv, t = _spawn_server(True, 3)
+    try:
+        # num_servers=2 claims a sibling at port+1 where nothing
+        # listens
+        with pytest.raises(RuntimeError) as ei:
+            operator_resize(2, host="127.0.0.1", root_port=srv.port,
+                            num_servers=2, timeout=1.0)
+        assert "1/2" in str(ei.value) and "divergent" in str(ei.value)
+        with srv.lock:
+            assert srv.pending_world == 2    # the live one DID record
+    finally:
+        _stop_server(srv, t)
+
+
+def test_dataloader_repartition_refuses_live_process_workers():
+    N = 24
+    ds = ArrayDataset(np.arange(N).astype(np.float32))
+    dl = DataLoader(ds, batch_sampler=ElasticBatchSampler(
+        N, 2, part_index=0, num_parts=2, seed=4), num_workers=2)
+    it = iter(dl)
+    try:
+        next(it)
+        with pytest.raises(RuntimeError):
+            dl.repartition(1, 2)
+    finally:
+        it.close()
+
+
+def test_fit_retires_cleanly_and_recovers_from_eviction(monkeypatch):
+    """fit() under a dist store: an EvictedWorkerError mid-epoch
+    triggers re-sync + rejoin (training continues), and a membership
+    change that drops this rank returns from fit cleanly at the batch
+    boundary."""
+    rs = np.random.RandomState(0)
+    X = rs.randn(16, 6).astype(np.float32)
+    Y = rs.randint(0, 4, (16,)).astype(np.float32)
+
+    kv = _FakeDistKV(members=(0, 1))
+    mod = _bind_module(kv, monkeypatch=monkeypatch)
+    kv.fail_next_pushes = 1     # first update raises EvictedWorkerError
+    pulls0 = kv.pulls
+    it = NDArrayIter(X, Y, batch_size=4, part_index=0, num_parts=2,
+                     last_batch_handle="discard")
+    mod.fit(it, kvstore=kv, num_epoch=1,
+            optimizer_params={"learning_rate": 0.1},
+            force_init=True, force_rebind=True)
+    assert kv.fail_next_pushes == 0
+    assert kv.pulls > pulls0        # re-synced params after eviction
+
+    # retire: membership drops this rank after the first batch
+    kv2 = _FakeDistKV(members=(0, 1), rank=1)
+    calls = {"n": 0}
+    orig = _FakeDistKV.push
+
+    def push_then_shrink(self, key, value, priority=0):
+        orig(self, key, value, priority)
+        calls["n"] += 1
+        if calls["n"] >= 2:
+            self.set_membership([0], mep=9)
+
+    monkeypatch.setattr(_FakeDistKV, "push", push_then_shrink)
+    mod2 = _bind_module(kv2, monkeypatch=monkeypatch)
+    it2 = NDArrayIter(X, Y, batch_size=4, last_batch_handle="discard")
+    mod2.fit(it2, kvstore=kv2, num_epoch=3,
+             optimizer_params={"learning_rate": 0.1},
+             force_init=True, force_rebind=True)
+    # returned after the retire, long before 3 epochs' worth of pushes
+    assert calls["n"] < 6
