@@ -833,6 +833,232 @@ def serve_bench(hidden=256, dim=64, classes=16,
     return out
 
 
+def _decode_toy(vocab=48, dim=24, seed=0):
+    from mxnet_tpu.test_utils import tiny_attention_lm
+    return tiny_attention_lm(vocab=vocab, dim=dim, seed=seed)
+
+
+def compare_decode_paths(sessions=16, prompt_len=16, new_tokens=32,
+                         block_size=8, vocab=48, dim=16):
+    """``--compare-decode-paths``: batched decode ticks (paged pool,
+    one dispatch serves every session's next token) vs SERIAL
+    per-session dense decode (the PR-9 DecodeSession discipline: one
+    dense worst-case cache and one dispatch per session per token).
+    Both paths run the SAME step function and their token streams are
+    checked bit-equal, so the speedup is pure dispatch/batching, not
+    a different model.  Prints ONE BENCH-schema JSON line with
+    aggregate tokens/sec for both paths and the speedup."""
+    import warnings
+
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.serve.decode import DecodeBatcher, DecodeEngine
+
+    params, step_fn, prefill_fn, token_spec, input_spec = _decode_toy(
+        vocab=vocab, dim=dim)
+    max_len = prompt_len + new_tokens + 1
+    rs = np.random.RandomState(3)
+    prompts = [rs.randint(0, vocab, size=prompt_len).astype(np.int32)
+               for _ in range(sessions)]
+
+    # -- serial baseline: one dense program, per-session caches, one
+    # dispatch per session per token (prompt fed token by token — the
+    # dense path has no prefill program) -------------------------------
+    padded_len = -(-max_len // block_size) * block_size
+    dense = jax.jit(step_fn)
+    cache_zero = {"k": jnp.zeros((1, padded_len, dim), jnp.float32),
+                  "v": jnp.zeros((1, padded_len, dim), jnp.float32)}
+    lowered = dense.lower(
+        jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params),
+        jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+            cache_zero),
+        {"tok": jax.ShapeDtypeStruct((1,), jnp.int32)},
+        jax.ShapeDtypeStruct((1,), jnp.int32))
+    dense_prog = lowered.compile()
+    del lowered
+
+    def serial_decode(prompt):
+        cache = dict(cache_zero)
+        stream = []
+        cur = None
+        t = 0
+        for tok in prompt:
+            out, cache = dense_prog(
+                params, cache, {"tok": np.asarray([tok], np.int32)},
+                np.asarray([t], np.int32))
+            t += 1
+            cur = int(np.asarray(out)[0])   # d2h readback per token
+        for _ in range(new_tokens):
+            stream.append(cur)
+            if len(stream) >= new_tokens:
+                break
+            out, cache = dense_prog(
+                params, cache, {"tok": np.asarray([cur], np.int32)},
+                np.asarray([t], np.int32))
+            t += 1
+            cur = int(np.asarray(out)[0])
+        return stream
+
+    t0 = time.monotonic()
+    serial_streams = [serial_decode(p) for p in prompts]
+    serial_dt = time.monotonic() - t0
+    total_tokens = sessions * new_tokens
+    serial_tps = total_tokens / serial_dt
+
+    # -- batched ticks over the paged pool ------------------------------
+    rungs = [1]
+    while rungs[-1] < sessions:
+        rungs.append(rungs[-1] * 2)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")     # CPU ignores donation
+        engine = DecodeEngine(
+            step_fn, prefill_fn, token_spec, input_spec, params=params,
+            max_len=max_len, block_size=block_size,
+            num_blocks=sessions * (-(-max_len // block_size)) + 2,
+            session_rungs=rungs, donate=True, label="bench")
+        warm_compiles = engine.compile_count
+        batcher = DecodeBatcher(engine, max_wait_ms=1.0)
+        t0 = time.monotonic()
+        sess = [batcher.start({"tok": p}, max_new_tokens=new_tokens)
+                for p in prompts]
+        batched_streams = [[int(o) for o in s.result(120)]
+                           for s in sess]
+        batched_dt = time.monotonic() - t0
+        request_path_compiles = engine.compile_count - warm_compiles
+        ticks = batcher.tick_count
+        batcher.close()
+        engine.close()
+    batched_tps = total_tokens / batched_dt
+
+    if batched_streams != serial_streams:
+        raise RuntimeError(
+            "decode bench: batched token streams are not bit-equal "
+            "to the serial dense decode — the comparison is void")
+
+    speedup = batched_tps / serial_tps
+    out = {
+        "metric": "serve_decode_compare",
+        "value": round(speedup, 3),
+        "unit": "x_tokens_per_sec",
+        "sessions": sessions,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "total_tokens": total_tokens,
+        "serial_tokens_per_sec": round(serial_tps, 2),
+        "batched_tokens_per_sec": round(batched_tps, 2),
+        "serial_seconds": round(serial_dt, 4),
+        "batched_seconds": round(batched_dt, 4),
+        "decode_ticks": ticks,
+        "request_path_compiles": request_path_compiles,
+        "streams_bit_equal": True,
+        # the acceptance bar: batched ticks must at least double the
+        # aggregate token throughput at >= 8 concurrent sessions
+        "speedup_ok": speedup >= 2.0 and request_path_compiles == 0,
+    }
+    print(json.dumps(out))
+    return out
+
+
+def serve_decode_bench(rate=12.0, seconds=3.0, prompt_lo=4,
+                       prompt_hi=24, new_tokens=24, vocab=48, dim=24,
+                       block_size=8):
+    """``--serve-decode``: open-loop many-session decode load — new
+    sessions arrive on a fixed schedule (no coordinated omission: the
+    arrival grid never waits for the system), each decodes
+    *new_tokens* greedily through the continuous-batching tick loop.
+    Per-token latencies come from the batcher's delivery stamps (each
+    token is stamped when its tick resolves, not when the client gets
+    scheduled).  Prints ONE BENCH-schema JSON line with p50/p99 token
+    latency, p50/p99 time-to-first-token, aggregate tokens/sec and
+    request_path_compiles."""
+    import warnings
+
+    from mxnet_tpu.serve.decode import DecodeBatcher, DecodeEngine
+
+    params, step_fn, prefill_fn, token_spec, input_spec = _decode_toy(
+        vocab=vocab, dim=dim)
+    max_len = prompt_hi + new_tokens + 1
+    n_sessions = int(rate * seconds)
+    rs = np.random.RandomState(5)
+    prompts = [rs.randint(0, vocab,
+                          size=rs.randint(prompt_lo, prompt_hi + 1))
+               .astype(np.int32) for _ in range(n_sessions)]
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        engine = DecodeEngine(
+            step_fn, prefill_fn, token_spec, input_spec, params=params,
+            max_len=max_len, block_size=block_size,
+            num_blocks=n_sessions * (-(-max_len // block_size)) + 2,
+            session_rungs=(1, 2, 4, 8, 16, 32), donate=True,
+            label="bench-open")
+        warm_compiles = engine.compile_count
+        batcher = DecodeBatcher(engine, max_wait_ms=1.0)
+
+        period = 1.0 / rate
+        t_start = time.monotonic()
+        arrivals = []     # (submit stamp, session)
+        shed = 0
+        for i in range(n_sessions):
+            slot = t_start + i * period
+            delay = slot - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            t_sub = time.monotonic()
+            try:
+                s = batcher.start({"tok": prompts[i]},
+                                  max_new_tokens=new_tokens)
+            except Exception:
+                shed += 1
+                continue
+            arrivals.append((t_sub, s))
+        for _, s in arrivals:
+            s.result(120)
+        wall = time.monotonic() - t_start
+        request_path_compiles = engine.compile_count - warm_compiles
+        ticks = batcher.tick_count
+        batcher.close()
+        engine.close()
+
+    ttft, token_lat = [], []
+    total_tokens = 0
+    for t_sub, s in arrivals:
+        stamps = s.stamps()
+        total_tokens += len(stamps)
+        if not stamps:
+            continue
+        ttft.append(stamps[0] - t_sub)
+        token_lat.append(stamps[0] - t_sub)
+        token_lat.extend(b - a for a, b in zip(stamps, stamps[1:]))
+    token_lat.sort()
+    ttft.sort()
+    out = {
+        "metric": "serve_decode_load",
+        "value": round(total_tokens / wall, 2),
+        "unit": "tokens/sec",
+        "offered_sessions_per_sec": rate,
+        "sessions": len(arrivals),
+        "sessions_shed": shed,
+        "new_tokens": new_tokens,
+        "total_tokens": total_tokens,
+        "decode_ticks": ticks,
+        "token_p50_ms": round(_percentile(token_lat, 50) * 1e3, 3)
+        if token_lat else None,
+        "token_p99_ms": round(_percentile(token_lat, 99) * 1e3, 3)
+        if token_lat else None,
+        "ttft_p50_ms": round(_percentile(ttft, 50) * 1e3, 3)
+        if ttft else None,
+        "ttft_p99_ms": round(_percentile(ttft, 99) * 1e3, 3)
+        if ttft else None,
+        "request_path_compiles": request_path_compiles,
+    }
+    print(json.dumps(out))
+    return out
+
+
 def decompose_main():
     """``--decompose``: lower the north-star train step, attribute its
     cost per op against probed roofline peaks, print the human table
@@ -891,6 +1117,26 @@ def main():
         return
     if "--decompose" in sys.argv:
         return decompose_main()
+    if "--compare-decode-paths" in sys.argv:
+        # batched decode ticks vs serial per-session dense decode — a
+        # relative dispatch-count measurement, so it ALWAYS runs on
+        # CPU (same tunnel rationale as --compare-update-paths)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        out = compare_decode_paths()
+        if not out["speedup_ok"]:
+            print("bench: batched decode failed the bar (%.2fx "
+                  "tokens/sec vs serial at %d sessions, "
+                  "request_path_compiles=%d — want >= 2x with 0)"
+                  % (out["value"], out["sessions"],
+                     out["request_path_compiles"]), file=sys.stderr)
+            return 1
+        return 0
+    if "--serve-decode" in sys.argv:
+        # open-loop many-session continuous-batching decode load;
+        # latency distribution + aggregate tokens/sec
+        _ensure_platform()
+        serve_decode_bench()
+        return
     if "--compare-input-paths" in sys.argv:
         # serial vs device-prefetched input path — a host/device
         # overlap measurement, so it ALWAYS runs on CPU (same tunnel

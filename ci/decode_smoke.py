@@ -1,0 +1,254 @@
+"""Continuous-batching decode CI drill (ci/run_tests.sh stage).
+
+Sixteen decode sessions with staggered prompt lengths run through the
+paged KV pool and the continuous-batching tick loop (MXNET_SAN=all —
+the sanitizers audit every lock/thread in the path), joining and
+leaving mid-stream, with one session cancelled mid-decode and a
+deliberate pool-exhaustion + recovery phase.  Gates:
+
+* **bit-equality** — every session's generated token stream equals
+  its SOLO dense-cache decode (the same step function, one dense
+  worst-case cache, one dispatch per token — the PR-9 DecodeSession
+  discipline).  Block-table gather/scatter, co-tenant garbage, rung
+  padding and join/leave churn must be invisible in the tokens;
+* **one compile per rung** — tick programs = session rungs, prefill
+  programs = sequence rungs, all built at warm; ZERO compiles in the
+  request path;
+* **typed shedding** — admission past the pool's capacity raises
+  KVPoolExhausted; after sessions release their blocks the same
+  admission succeeds (exhaust -> recover);
+* **zero leaks** — every pool block is free and the active-session
+  gauge is back to zero at the end;
+* **zero graftsan reports**; decode events (session_start/session_end,
+  tick, pool_exhausted) recorded and consistent.
+
+Last stdout line is the scrapeable summary::
+
+    decode: sessions=N ticks=M compiles=K ok
+"""
+
+import os
+import sys
+import tempfile
+import time
+import warnings
+
+os.environ.setdefault("MXNET_SAN", "all")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("MXNET_OBS", "decode")
+os.environ.setdefault(
+    "MXNET_OBS_PATH",
+    os.path.join(tempfile.mkdtemp(prefix="decode_smoke_"),
+                 "events.jsonl"))
+
+import numpy as np  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from mxnet_tpu.observability import events as obs_events  # noqa: E402
+from mxnet_tpu.observability import metrics as obs_metrics  # noqa: E402
+from mxnet_tpu.serve.buckets import RequestCancelled  # noqa: E402
+from mxnet_tpu.serve.decode import (DecodeBatcher,  # noqa: E402
+                                    DecodeEngine)
+from mxnet_tpu.serve.kvpool import KVPoolExhausted  # noqa: E402
+from mxnet_tpu.test_utils import (dense_decode_reference,  # noqa: E402
+                                  tiny_attention_lm)
+import tools.graftsan as graftsan  # noqa: E402
+
+VOCAB, DIM = 32, 16
+BLOCK = 4
+MAX_LEN = 48
+SESSIONS = 16
+LATE_JOINS = 4
+RUNGS = (1, 2, 4, 8, 16)
+
+
+def dense_reference(params, step_fn, prompt, n_new, padded_len):
+    """Solo dense-cache decode — the shared oracle from test_utils
+    (what a lone PR-9 DecodeSession computes: one dense worst-case
+    cache, one dispatch per token)."""
+    return dense_decode_reference(params, step_fn, prompt, n_new,
+                                  padded_len, DIM)
+
+
+def main():
+    failures = []
+    params, step_fn, prefill_fn, token_spec, input_spec = \
+        tiny_attention_lm(vocab=VOCAB, dim=DIM, seed=17)
+
+    rs = np.random.RandomState(29)
+    prompts = [rs.randint(0, VOCAB, size=int(ln)).astype(np.int32)
+               for ln in rs.randint(1, 17, size=SESSIONS + LATE_JOINS)]
+    n_new = [int(n) for n in rs.randint(4, 21,
+                                        size=SESSIONS + LATE_JOINS)]
+    # pool sized for every session's full growth plus a little slack
+    # (phase 3 exhausts it deliberately, phase 1 must never)
+    blocks_full = sum(-(-(len(p) + n) // BLOCK)
+                      for p, n in zip(prompts, n_new))
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")      # CPU XLA ignores donation
+        engine = DecodeEngine(
+            step_fn, prefill_fn, token_spec, input_spec, params=params,
+            max_len=MAX_LEN, block_size=BLOCK,
+            num_blocks=blocks_full + 4, session_rungs=RUNGS,
+            donate=True, label="drill")
+        warm_compiles = engine.compile_count
+        expect_compiles = len(RUNGS) + len(engine.prefill_rungs)
+        if warm_compiles != expect_compiles:
+            failures.append(
+                "warm built %d programs, expected %d (one per tick "
+                "rung %s + one per prefill rung %s)"
+                % (warm_compiles, expect_compiles, RUNGS,
+                   engine.prefill_rungs))
+        batcher = DecodeBatcher(engine, max_wait_ms=2.0)
+
+        # -- phase 1: staggered join/leave + one mid-decode cancel ----
+        sessions = []
+        for i in range(SESSIONS):
+            sessions.append(batcher.start({"tok": prompts[i]},
+                                          max_new_tokens=n_new[i]))
+            if i % 5 == 4:
+                time.sleep(0.002)    # joins land between ticks
+        # a session with an unbounded budget, cancelled mid-decode
+        victim = batcher.start({"tok": prompts[0][:2]},
+                               max_new_tokens=10 ** 6)
+        while victim.token_count < 3 and not victim.done():
+            time.sleep(0.001)
+        victim.cancel()
+        # late joins while the first wave is mid-stream
+        for i in range(SESSIONS, SESSIONS + LATE_JOINS):
+            sessions.append(batcher.start({"tok": prompts[i]},
+                                          max_new_tokens=n_new[i]))
+        streams = []
+        for s in sessions:
+            try:
+                streams.append([int(o) for o in s.result(60)])
+            except Exception as exc:
+                failures.append("session %d failed: %r" % (s.sid, exc))
+                streams.append(None)
+        try:
+            victim.result(60)
+            failures.append("cancelled session resolved cleanly")
+        except RequestCancelled:
+            pass
+        except Exception as exc:
+            failures.append("cancel resolved wrong: %r" % (exc,))
+        victim_tokens = [int(o) for o in victim.outputs()]
+        if len(victim_tokens) < 3:
+            failures.append("cancel lost accepted steps: %d delivered"
+                            % len(victim_tokens))
+
+        # bit-equality: every stream vs its solo dense-cache decode
+        mismatches = 0
+        for i, (s, stream) in enumerate(zip(sessions, streams)):
+            if stream is None:
+                continue
+            ref = dense_reference(params, step_fn, prompts[i],
+                                  n_new[i], engine.padded_len)
+            if stream != ref:
+                mismatches += 1
+                if mismatches <= 3:
+                    failures.append(
+                        "session %d stream != solo dense decode "
+                        "(prompt len %d): %s vs %s"
+                        % (s.sid, len(prompts[i]), stream, ref))
+        ref_v = dense_reference(params, step_fn, prompts[0][:2],
+                                len(victim_tokens), engine.padded_len)
+        if victim_tokens != ref_v:
+            failures.append("cancelled session's delivered prefix is "
+                            "not bit-equal to its dense decode")
+
+        # -- phase 2: drain the batcher, keep the engine --------------
+        if not batcher.drain(30.0):
+            failures.append("drain timed out with finished sessions")
+        batcher.close()
+
+        # -- phase 3: exhaust then recover the pool (direct mode) -----
+        fillers = []
+        exhausted = False
+        for _ in range(engine.pool.blocks_total + 2):
+            try:
+                fillers.append(engine.admit(
+                    {"tok": prompts[0][:4]}, max_new_tokens=1))
+            except KVPoolExhausted:
+                exhausted = True
+                break
+        if not exhausted:
+            failures.append("pool never exhausted after %d admissions"
+                            % len(fillers))
+        for f in fillers:
+            engine.release(f, "finished", None)
+        try:
+            recovered = engine.admit({"tok": prompts[1]},
+                                     max_new_tokens=n_new[1])
+            engine.prefill(recovered)
+            while not recovered.done():
+                engine.tick([recovered])
+            rec_stream = [int(o) for o in recovered.result(10)]
+            ref = dense_reference(params, step_fn, prompts[1],
+                                  n_new[1], engine.padded_len)
+            if rec_stream != ref:
+                failures.append("post-recovery stream is not "
+                                "bit-equal to its dense decode")
+        except KVPoolExhausted:
+            failures.append("pool did not recover after release")
+
+        # -- gates ----------------------------------------------------
+        if engine.compile_count != warm_compiles:
+            failures.append(
+                "%d compiles happened in the REQUEST PATH"
+                % (engine.compile_count - warm_compiles))
+        if engine.pool.blocks_in_use != 0:
+            failures.append("leaked %d pool blocks"
+                            % engine.pool.blocks_in_use)
+        snap = obs_metrics.snapshot()
+        gauge = snap.get("serve_decode_active_sessions", {})
+        if gauge.get("value") != 0:
+            failures.append("active-session gauge did not return to "
+                            "zero: %r" % (gauge,))
+        ticks = engine.dispatch_count
+        total_compiles = engine.compile_count
+        engine.close()
+
+    # decode events: starts == ends, tick + pool_exhausted present
+    try:
+        evs = [e for e in obs_events.read_events()
+               if e.get("ev") == "decode"]
+    except OSError:
+        evs = []
+    kinds = {}
+    for e in evs:
+        kinds[e.get("kind")] = kinds.get(e.get("kind"), 0) + 1
+    if kinds.get("session_start", 0) != kinds.get("session_end", 0):
+        failures.append("decode events unbalanced: %d starts vs %d "
+                        "ends" % (kinds.get("session_start", 0),
+                                  kinds.get("session_end", 0)))
+    for kind in ("session_start", "session_end", "tick",
+                 "pool_exhausted"):
+        if not kinds.get(kind):
+            failures.append("no %r decode event recorded (have %s)"
+                            % (kind, sorted(kinds)))
+
+    reports = graftsan.reports()
+    failures.extend(graftsan.format_report(r) for r in reports)
+
+    n_sessions = SESSIONS + LATE_JOINS
+    if failures:
+        print("\n".join(failures), file=sys.stderr)
+        print("decode smoke: FAIL", file=sys.stderr)
+        print("decode: sessions=%d ticks=%d compiles=%d FAIL"
+              % (n_sessions, ticks, total_compiles))
+        return 1
+    print("decode: sessions=%d ticks=%d compiles=%d ok"
+          % (n_sessions, ticks, total_compiles))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

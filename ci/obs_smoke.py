@@ -146,6 +146,57 @@ def main():
             failures.append("input instrument %r has unexpected value: "
                             "%r" % (name, snap[name]))
 
+    # -- continuous-batching decode telemetry --------------------------
+    # a tiny paged-decode workout: the pool gauges must track block
+    # ownership, the decode counters/histogram must record the ticks
+    # and tokens, and the 'decode' event kinds must land in
+    # events.jsonl (docs/observability.md; ci/decode_smoke.py runs
+    # the full drill — here the contract is the telemetry)
+    import warnings as _warnings
+    from mxnet_tpu.serve.decode import DecodeEngine
+    from mxnet_tpu.test_utils import tiny_attention_lm
+    dp, dstep, dprefill, dtok_spec, din_spec = tiny_attention_lm(
+        vocab=16, dim=8, seed=3)
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("ignore")     # CPU XLA ignores donation
+        deng = DecodeEngine(dstep, dprefill, dtok_spec, din_spec,
+                            params=dp, max_len=8, block_size=4,
+                            num_blocks=6, session_rungs=(1, 2),
+                            donate=True, label="obs-smoke")
+        dsess = deng.admit({"tok": np.asarray([1, 2, 3], np.int32)},
+                           max_new_tokens=3)
+        deng.prefill(dsess)
+        snap = metrics.snapshot()
+        if snap.get("serve_kv_blocks_in_use", {}).get("value") != 1:
+            failures.append("serve_kv_blocks_in_use should read 1 "
+                            "after a 3-token admission, got %r"
+                            % (snap.get("serve_kv_blocks_in_use"),))
+        if snap.get("serve_decode_active_sessions",
+                    {}).get("value") != 1:
+            failures.append("serve_decode_active_sessions should "
+                            "read 1, got %r"
+                            % (snap.get("serve_decode_active_sessions"),))
+        while not dsess.done():
+            deng.tick([dsess])
+        deng.close()
+    snap = metrics.snapshot()
+    decode_expected = {
+        "serve_decode_steps_total": lambda s: s["value"] >= 3,
+        "serve_decode_tokens_total": lambda s: s["value"] >= 3,
+        "serve_decode_token_seconds": lambda s: s["count"] >= 3,
+        "serve_decode_active_sessions": lambda s: s["value"] == 0,
+        "serve_kv_blocks_in_use": lambda s: s["value"] == 0,
+        "serve_kv_blocks_total": lambda s: s["value"] == 0,
+    }
+    for name, check in decode_expected.items():
+        if name not in snap:
+            failures.append("decode instrument %r missing from the "
+                            "registry (have: %s)"
+                            % (name, sorted(snap)))
+        elif not check(snap[name]):
+            failures.append("decode instrument %r has unexpected "
+                            "value: %r" % (name, snap[name]))
+
     # -- elastic membership telemetry ----------------------------------
     # an in-process server walks join + resize: the active-workers
     # gauge must track the expected-contributor set and the
@@ -222,6 +273,12 @@ def main():
                 and ("old_epoch" not in e or "new_epoch" not in e):
             failures.append("membership event lacks old/new epoch: %r"
                             % (e,))
+    decode_kinds = {e.get("kind") for e in evs
+                    if e.get("ev") == "decode"}
+    if not {"session_start", "session_end", "tick"} <= decode_kinds:
+        failures.append("decode workout should have recorded "
+                        "session_start/session_end/tick events, got "
+                        "kinds %s" % sorted(decode_kinds))
 
     # -- profiler.dump carries the instruments -------------------------
     trace_path = os.path.join(_tmpdir, "trace.json")

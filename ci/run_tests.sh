@@ -51,6 +51,18 @@ echo "== serve: compiled-inference smoke (registry + dynamic batcher) =="
 # line is the scrapeable summary ("serve: reqs=.. batches=.. ...").
 MXNET_SAN=all python ci/serve_smoke.py
 
+echo "== serve: continuous-batching decode drill (paged KV pool) =="
+# Sixteen staggered decode sessions through the paged KV pool and the
+# continuous-batching tick loop, sanitizers on: every session's token
+# stream bit-equal to its SOLO dense-cache decode (block-table
+# gather/scatter, co-tenant garbage, rung padding and join/leave
+# churn invisible in the tokens), one AOT compile per tick/prefill
+# rung and ZERO in the request path, a mid-decode cancel keeping its
+# accepted tokens, typed KVPoolExhausted shedding + recovery, zero
+# leaked blocks, zero graftsan reports (docs/serving.md).  Last
+# stdout line: "decode: sessions=.. ticks=.. compiles=.. ok".
+MXNET_SAN=all python ci/decode_smoke.py
+
 echo "== serve: request-path chaos drill (shedding/supervision/drain) =="
 # The serving request path through every injected fault class —
 # overload (slow dispatches vs a bounded queue), deadline expiry

@@ -299,6 +299,23 @@ register_env("MXNET_SERVE_DRAIN_TIMEOUT", float, 30.0,
              "Registry.drain / unload(drain=True) / an alias-cutover "
              "flush waits for accepted serve requests to finish "
              "before proceeding anyway")
+register_env("MXNET_SERVE_KV_BLOCK_SIZE", int, 16,
+             "Tokens per paged KV-cache block (serve.kvpool): the "
+             "granularity decode sessions allocate cache memory at — "
+             "smaller blocks waste less tail memory per session, "
+             "larger blocks mean fewer scatter rows per tick")
+register_env("MXNET_SERVE_KV_BLOCKS", int, 256,
+             "Paged KV pool capacity in blocks (per decode engine, "
+             "including the reserved null block): bounds TOTAL cache "
+             "memory across every concurrent decode session; an "
+             "admission that cannot get its blocks sheds with a "
+             "typed KVPoolExhausted")
+register_env("MXNET_SERVE_DECODE_MAX_WAIT_MS", float, 2.0,
+             "How long an IDLE decode batcher holds its first tick "
+             "open for more sessions to arrive (milliseconds, "
+             "monotonic clock) so co-arriving sessions share one "
+             "session-count rung from the start; once decoding, "
+             "ticks run back-to-back and joins land between ticks")
 
 
 def enable_compile_cache():

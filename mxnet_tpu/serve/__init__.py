@@ -14,9 +14,17 @@ Production serving for models built with this framework:
   (:class:`DeadlineExceededError`), caller-side cancellation
   (:class:`RequestCancelled`), supervised dispatcher restarts and
   graceful drain (batcher.py);
+* :class:`KVPool` / :class:`DecodeEngine` / :class:`DecodeBatcher` —
+  continuously-batched LLM decode: a paged KV-cache pool (fixed
+  device blocks, per-session block tables, typed
+  :class:`KVPoolExhausted` shedding), AOT decode-tick programs per
+  session-count rung + bucketed prefill programs, and the tick loop
+  where sessions join/leave between ticks — one dispatch serves
+  every session's next token (kvpool.py, decode.py;
+  :class:`SpeculativeDecoder` is the opt-in draft/verify layer);
 * :class:`ModelRegistry` — multi-model load/unload/alias with a warm
-  program cache, drain-before-teardown, and the
-  ``health``/``ready``/``live`` probe surface backed by
+  program cache, drain-before-teardown (decode sessions included),
+  and the ``health``/``ready``/``live`` probe surface backed by
   :class:`HealthBoard` (registry.py, health.py); :func:`c_registry`
   is the process-wide instance the C predict ABI routes through.
 
@@ -27,12 +35,16 @@ knobs and metrics catalog.
 from .buckets import (BucketLadder, DeadlineExceededError,  # noqa: F401
                       OverloadError, RequestCancelled, ServeError)
 from .health import STATES, HealthBoard  # noqa: F401
+from .kvpool import KVPool, KVPoolExhausted  # noqa: F401
 from .predictor import CompiledPredictor, DecodeSession  # noqa: F401
 from .batcher import DynamicBatcher, ServeFuture  # noqa: F401
+from .decode import (DecodeBatcher, DecodeEngine,  # noqa: F401
+                     PagedSession, SpeculativeDecoder)
 from .registry import ModelRegistry, c_registry  # noqa: F401
 
 __all__ = ["BucketLadder", "ServeError", "OverloadError",
            "DeadlineExceededError", "RequestCancelled",
            "CompiledPredictor", "DecodeSession", "DynamicBatcher",
            "ServeFuture", "ModelRegistry", "c_registry", "HealthBoard",
-           "STATES"]
+           "STATES", "KVPool", "KVPoolExhausted", "DecodeEngine",
+           "DecodeBatcher", "PagedSession", "SpeculativeDecoder"]

@@ -1,0 +1,1420 @@
+"""Continuous-batching LLM decode over the paged KV pool.
+
+The dense :class:`~mxnet_tpu.serve.predictor.DecodeSession` decodes
+one sequence per program: N concurrent sessions pay N dispatches per
+token and N worst-case caches.  This module makes decode a served,
+continuously-batched workload:
+
+* :class:`DecodeEngine` — AOT-compiles one **decode-tick** program per
+  session-count rung of a :class:`~mxnet_tpu.serve.buckets.BucketLadder`
+  and one **prefill** program per sequence rung, all against a shared
+  :class:`~mxnet_tpu.serve.kvpool.KVPool`.  The tick program gathers
+  each session's dense cache view through its block table, runs the
+  model's step, and scatters back only the block the new token landed
+  in; the pool state is donated every call, fused-train-step style.
+  Programs are built at construction (warm) — the request path cannot
+  compile, by construction.
+* :class:`PagedSession` — one live decode: host-side block table,
+  position cursor and delivered-token stream.
+* :class:`DecodeBatcher` — the continuous-batching tick loop (the
+  DynamicBatcher's coalescing/deadline/cancel discipline applied to
+  sessions): sessions join and leave *between* ticks, one dispatch +
+  one device->host readback serves every active session's next token.
+  Prefill dispatches run between ticks through their own bucketed
+  programs, so a long prompt costs one dispatch instead of stalling
+  the tick loop for L rounds.
+* :class:`SpeculativeDecoder` — (stretch, opt-in) a small draft
+  engine proposes K tokens; the target verifies all K in ONE batched
+  verify dispatch, accepting the matched prefix plus one corrected
+  token.  Greedy speculative decode is bit-equal to plain greedy
+  decode, because rejected cache positions are beyond-position
+  garbage the step contract already ignores.
+
+Step contract (what a model plugs in)::
+
+    step_fn(params, view, inputs, pos) -> (out, new_view)
+
+* ``view``: pytree of dense per-session cache views, leaves
+  ``(S, padded_len) + per_token_shape`` gathered from the pool;
+* ``inputs``: ``{name: (S,) + input_shape}`` this tick's per-session
+  inputs; ``pos``: ``(S,) int32`` tokens already cached per session;
+* the step must write **exactly at position** ``pos`` (one token per
+  tick) and must mask everything at positions ``>= pos+1`` out of its
+  outputs — positions beyond a session's cursor hold co-tenant
+  garbage by design (that is what makes block sharing safe; the CI
+  drill proves stream bit-equality with the null block poisoned).
+
+    prefill_fn(params, inputs, length) -> view
+
+* ``inputs``: ``{name: (1, Lr) + input_shape}`` the prompt *prefix*
+  (everything but its last token), zero-padded to the sequence rung
+  ``Lr``; ``length`` is the real prefix length; the returned view
+  (leaves ``(1, Lr) + per_token_shape``) is scattered into the
+  session's blocks.  The prompt's last token then rides the first
+  regular decode tick, so every emitted token comes from the same
+  tick program — the bit-equality anchor.
+
+See docs/serving.md ("Continuous-batching decode") for the pool
+layout, scheduling and knob table.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import time as _time
+
+import numpy as _np
+
+from .buckets import (BucketLadder, DeadlineExceededError,
+                      RequestCancelled, ServeError)
+from .kvpool import KVPool, KVPoolExhausted
+from .. import sanitizer as _san
+from ..observability import events as _obs_events
+from ..observability import metrics as _obs_metrics
+
+__all__ = ["DecodeEngine", "PagedSession", "DecodeBatcher",
+           "SpeculativeDecoder"]
+
+log = logging.getLogger(__name__)
+
+# module-level instrument refs (hot path discipline, see metrics.py);
+# serve_dispatch_seconds / serve_compiles_total are the predictor's
+# instruments — get-or-create returns the shared ones
+_ACTIVE_SESSIONS = _obs_metrics.gauge(
+    "serve_decode_active_sessions",
+    "live paged decode sessions (admitted and not yet finished/"
+    "failed/cancelled) across all decode engines (delta-maintained)")
+_DECODE_STEPS = _obs_metrics.counter(
+    "serve_decode_steps_total",
+    "batched decode-tick dispatches (one serves every active "
+    "session's next token)")
+_DECODE_TOKENS = _obs_metrics.counter(
+    "serve_decode_tokens_total",
+    "tokens delivered to decode sessions")
+_TOKEN_SECONDS = _obs_metrics.histogram(
+    "serve_decode_token_seconds",
+    "per-token latency: time between successive token deliveries of "
+    "a session (first token: admission to delivery)")
+_DISPATCH_SECONDS = _obs_metrics.histogram(
+    "serve_dispatch_seconds",
+    "host-side latency of one compiled-program serve dispatch")
+_COMPILES_TOTAL = _obs_metrics.counter(
+    "serve_compiles_total",
+    "AOT program builds (bucket warmups + decode steps); flat after "
+    "warmup or the request path is compiling")
+
+
+def _ceil_div(a, b):
+    return -(-int(a) // int(b))
+
+
+class PagedSession:
+    """One live paged decode: block table, position cursor, and the
+    delivered token stream.  Engine-owned fields (``pos``, ``blocks``,
+    ``table``, ``pending_input``) are mutated only under the engine
+    lock by the tick/prefill path; readers use the delivery methods,
+    which synchronize on the session's own condition."""
+
+    _NEXT_SID = [0]
+    _SID_LOCK = _san.lock(label="serve.decode.sid")
+
+    def __init__(self, engine, prompt, length, blocks, table,
+                 max_new_tokens, stop_fn, deadline):
+        with self._SID_LOCK:
+            self._NEXT_SID[0] += 1
+            self.sid = self._NEXT_SID[0]
+        self._engine = engine
+        self.prompt = prompt          # {name: (L,) + input_shape} host
+        self.length = int(length)
+        self.blocks = blocks          # pool block ids, growth in ticks
+        self.table = table            # np int32 (max_blocks,)
+        self.pos = 0                  # set by prefill; tokens cached
+        self.pending_input = None     # next tick's {name: host array}
+        self.max_new_tokens = max_new_tokens
+        self.stop_fn = stop_fn
+        self._deadline = deadline     # monotonic; bounds time-to-join
+        self._cond = _san.condition(
+            label="serve.decode.session%d" % self.sid)
+        self._outputs = []
+        self._stamps = []             # monotonic delivery stamp/token
+        self._queue = collections.deque()
+        self._done = False
+        self._released = False
+        self._cancel = False
+        self._error = None
+        self.finish_reason = None
+        self._t_enq = _time.monotonic()
+        self._t_last = None
+        _san.track(self, ("_outputs", "_queue", "_done", "_released",
+                          "_cancel", "_error"),
+                   label="serve.decode.session%d" % self.sid)
+
+    # -- caller side --------------------------------------------------------
+    def done(self):
+        with self._cond:
+            return self._done
+
+    @property
+    def error(self):
+        with self._cond:
+            return self._error
+
+    @property
+    def token_count(self):
+        with self._cond:
+            return len(self._outputs)
+
+    def outputs(self):
+        """Everything delivered so far — readable even after a typed
+        mid-stream failure (accepted steps are never lost)."""
+        with self._cond:
+            return list(self._outputs)
+
+    def stamps(self):
+        """Monotonic delivery timestamp per token (open-loop latency
+        accounting: per-token resolve stamps, no coordinated
+        omission)."""
+        with self._cond:
+            return list(self._stamps)
+
+    def next_output(self, timeout=None):
+        """Block for the next token.  Raises the session's typed
+        error after a failure, ``StopIteration`` after a clean
+        finish, ``TimeoutError`` on *timeout*."""
+        deadline = None if timeout is None \
+            else _time.monotonic() + timeout
+        with self._cond:
+            while not self._queue and not self._done:
+                remaining = None if deadline is None \
+                    else deadline - _time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError(
+                        "decode session %d: no token after %ss"
+                        % (self.sid, timeout))
+                self._cond.wait(remaining)
+            if self._queue:
+                return self._queue.popleft()
+            if self._error is not None:
+                raise self._error
+            raise StopIteration("decode session %d finished (%s)"
+                                % (self.sid, self.finish_reason))
+
+    def result(self, timeout=None):
+        """Wait for the session to finish; returns the full output
+        stream, or raises the typed failure."""
+        deadline = None if timeout is None \
+            else _time.monotonic() + timeout
+        with self._cond:
+            while not self._done:
+                remaining = None if deadline is None \
+                    else deadline - _time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError(
+                        "decode session %d still live after %ss"
+                        % (self.sid, timeout))
+                self._cond.wait(remaining)
+            if self._error is not None:
+                raise self._error
+            return list(self._outputs)
+
+    def cancel(self):
+        """Abandon the session.  The engine releases its blocks at
+        the next tick boundary; pending readers get a typed
+        :class:`RequestCancelled`.  Tokens already delivered stay
+        readable via :meth:`outputs`."""
+        with self._cond:
+            if self._done:
+                return False
+            self._cancel = True
+        return True
+
+    @property
+    def cancelled(self):
+        with self._cond:
+            return self._cancel
+
+    # -- engine side --------------------------------------------------------
+    def _deliver(self, out, now):
+        with self._cond:
+            _TOKEN_SECONDS.observe(
+                now - (self._t_last if self._t_last is not None
+                       else self._t_enq))
+            self._t_last = now
+            self._outputs.append(out)
+            self._stamps.append(now)
+            self._queue.append(out)
+            self._cond.notify_all()
+
+
+class DecodeEngine:
+    """AOT tick/prefill programs over one shared :class:`KVPool`.
+
+    Parameters
+    ----------
+    step_fn, prefill_fn : callables
+        The model's decode step / prompt prefill (module docstring
+        contract).  ``prefill_fn`` may be None when every prompt has
+        length 1 (pure generation).
+    token_spec : pytree of jax.ShapeDtypeStruct
+        One token's cache slice per leaf (the pool layout).
+    input_spec : dict name -> jax.ShapeDtypeStruct
+        Per-session, per-tick inputs (e.g. the previous token id).
+    params : pytree of arrays, optional
+        Model parameters, committed to the pool's device.  Defaults
+        to *predictor*'s parameters when attached.
+    predictor : CompiledPredictor, optional
+        Attach for registry lifecycle (unload/cutover drain this
+        engine) and shared compile accounting.
+    max_len : int
+        Longest sequence a session may reach; rounded up to a whole
+        number of blocks (:attr:`padded_len` — the dense-view length
+        every step program sees).
+    session_rungs : sequence of int
+        Session-count rungs of the tick ladder (one AOT program
+        each).
+    prefill_rungs : sequence of int, optional
+        Sequence rungs of the prefill programs; each must be a
+        multiple of the block size.  Default: block-size
+        powers-of-two up to :attr:`padded_len`.
+    next_input_fn : callable, optional
+        Maps a delivered (host) step output to the next tick's input
+        dict.  Default: identity when the output tree matches
+        ``input_spec``.
+    spec_k : int
+        When > 0, also compile the K-token speculative **verify**
+        program (see :class:`SpeculativeDecoder`).  Off by default —
+        speculative decode is opt-in.
+    donate : bool, optional
+        Donate the pool state to every program call (default
+        ``ops.registry.supports_donation()``; pass True to force the
+        declaration — CPU CI checks declared donation).
+    """
+
+    def __init__(self, step_fn, prefill_fn=None, token_spec=None,
+                 input_spec=None, params=None, predictor=None,
+                 max_len=None, block_size=None, num_blocks=None,
+                 session_rungs=(1, 2, 4, 8, 16), prefill_rungs=None,
+                 next_input_fn=None, spec_k=0, donate=None,
+                 device=None, label="decode", warm=True):
+        import jax
+        import jax.numpy as jnp
+        from ..ops.registry import supports_donation
+
+        if step_fn is None or token_spec is None or not input_spec:
+            raise ServeError("DecodeEngine needs step_fn, token_spec "
+                             "and input_spec")
+        if max_len is None:
+            raise ServeError("DecodeEngine needs max_len (the longest "
+                             "sequence a session may reach)")
+        self.label = label
+        self._step_fn = step_fn
+        self._prefill_fn = prefill_fn
+        self._predictor = predictor
+        if predictor is not None and device is None:
+            device = predictor._dev
+        self._pool = KVPool(token_spec, num_blocks=num_blocks,
+                            block_size=block_size, device=device)
+        bs = self._pool.block_size
+        self.block_size = bs
+        self.padded_len = _ceil_div(max_len, bs) * bs
+        self.max_blocks = self.padded_len // bs
+        if self.max_blocks > self._pool.blocks_total:
+            raise ServeError(
+                "a full-length session needs %d blocks but the pool "
+                "only has %d allocatable — grow MXNET_SERVE_KV_BLOCKS "
+                "or shrink max_len" % (self.max_blocks,
+                                       self._pool.blocks_total))
+        self.ladder = BucketLadder(batches=session_rungs)
+        if prefill_rungs is None:
+            rungs, r = [], bs
+            while r < self.padded_len:
+                rungs.append(r)
+                r *= 2
+            rungs.append(self.padded_len)
+            prefill_rungs = rungs
+        self.prefill_rungs = tuple(sorted({int(r) for r in
+                                           prefill_rungs}))
+        for r in self.prefill_rungs:
+            if r < bs or r % bs or r > self.padded_len:
+                raise ServeError(
+                    "prefill rung %d must be a multiple of the block "
+                    "size %d within padded_len %d"
+                    % (r, bs, self.padded_len))
+        if self.prefill_rungs and \
+                self.prefill_rungs[-1] != self.padded_len:
+            self.prefill_rungs = self.prefill_rungs + (self.padded_len,)
+        self._input_spec = {
+            n: jax.ShapeDtypeStruct(tuple(int(d) for d in s.shape),
+                                    jnp.dtype(s.dtype))
+            for n, s in input_spec.items()}
+        self._next_input_fn = next_input_fn
+        self.spec_k = int(spec_k)
+        if donate is None:
+            donate = supports_donation()
+        self._donate = bool(donate)
+        if params is None:
+            if predictor is None:
+                raise ServeError("DecodeEngine needs params (or an "
+                                 "attached predictor to take them "
+                                 "from)")
+            params = predictor._params
+        put = lambda a: jax.device_put(
+            getattr(a, "_data", None)
+            if getattr(a, "_data", None) is not None
+            else jnp.asarray(a), self._pool.device)
+        self._params = jax.tree_util.tree_map(put, params)
+
+        self._lock = _san.lock(label="serve.decode.%s" % label)
+        self._tick_progs = {}
+        self._tick_text = {}
+        self._prefill_progs = {}
+        self._prefill_text = {}
+        self._verify_prog = None
+        self._verify_text = None
+        self._compiles = 0
+        self._dispatches = 0
+        self._live = []               # admitted, not yet released
+        self._batchers = []
+        self._closed = False
+        _san.track(self, ("_tick_progs", "_prefill_progs", "_compiles",
+                          "_dispatches", "_live", "_closed"),
+                   label="serve.decode.%s" % label)
+        if predictor is not None:
+            predictor._decode_engines.append(self)
+        if warm:
+            self.warm()
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def compile_count(self):
+        return self._compiles
+
+    @property
+    def dispatch_count(self):
+        with self._lock:
+            return self._dispatches
+
+    @property
+    def pool(self):
+        return self._pool
+
+    @property
+    def active_sessions(self):
+        with self._lock:
+            return len(self._live)
+
+    def tick_lowered_text(self, rung):
+        return self._tick_text.get(int(rung), "")
+
+    def prefill_lowered_text(self, rung):
+        return self._prefill_text.get(int(rung), "")
+
+    def verify_lowered_text(self):
+        return self._verify_text or ""
+
+    # -- program builders ----------------------------------------------------
+    def _count_compile(self, kind, key, seconds):
+        self._compiles += 1
+        _COMPILES_TOTAL.inc()
+        if self._predictor is not None:
+            with self._predictor._lock:
+                self._predictor._compiles += 1
+        _obs_events.emit("serve", kind="compile", model=self.label,
+                         decoder=kind, rung=key,
+                         donated=self._donate,
+                         seconds=round(seconds, 4))
+
+    def _pool_avals(self):
+        import jax
+        return jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+            self._pool.arrays)
+
+    def _param_avals(self):
+        import jax
+        return jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+            self._params)
+
+    def _build_tick(self, S):
+        import jax
+        import jax.numpy as jnp
+        bs, nb, L = self.block_size, self.max_blocks, self.padded_len
+        step_fn = self._step_fn
+
+        def _tick(params, pool, table, pos, inputs):
+            idx = jnp.arange(S)
+            view = jax.tree_util.tree_map(
+                lambda p: p[table].reshape((S, L) + p.shape[2:]), pool)
+            out, new_view = step_fn(params, view, inputs, pos)
+            blk = pos // bs                      # (S,) block-in-seq
+            blk_ids = table[idx, blk]            # (S,) pool block ids
+            def scat(p, nv):
+                nvb = nv.reshape((S, nb, bs) + p.shape[2:])
+                return p.at[blk_ids].set(nvb[idx, blk])
+            new_pool = jax.tree_util.tree_map(scat, pool, new_view)
+            return out, new_pool
+
+        jitted = jax.jit(_tick, donate_argnums=(1,)
+                         if self._donate else ())
+        pa, ka = self._param_avals(), self._pool_avals()
+        ta = jax.ShapeDtypeStruct((S, nb), jnp.int32)
+        sa = jax.ShapeDtypeStruct((S,), jnp.int32)
+        ia = {n: jax.ShapeDtypeStruct((S,) + sp.shape, sp.dtype)
+              for n, sp in self._input_spec.items()}
+        t0 = _time.perf_counter()
+        lowered = jitted.lower(pa, ka, ta, sa, ia)
+        text = lowered.as_text()
+        prog = lowered.compile()
+        del lowered
+        # caller (warm) holds self._lock for the whole build pass
+        self._tick_progs[S] = prog  # graftlint: disable=JG010
+        self._tick_text[S] = text
+        self._count_compile("tick", S, _time.perf_counter() - t0)
+        return prog
+
+    def _build_prefill(self, Lr):
+        import jax
+        import jax.numpy as jnp
+        bs, nb = self.block_size, self.max_blocks
+        nbr = Lr // bs
+        prefill_fn = self._prefill_fn
+
+        # prefill_fn returns leaves (1, Lr) + token_shape; drop the
+        # session axis, split into whole blocks and scatter them into
+        # the session's table (tail entries point at the null block —
+        # their garbage lands where no session reads)
+        def _prefill(params, pool, table, inputs, length):
+            view = prefill_fn(params, inputs, length)
+            def scat(p, v):
+                vb = v[0].reshape((nbr, bs) + p.shape[2:])
+                return p.at[table[:nbr]].set(vb)
+            return jax.tree_util.tree_map(scat, pool, view)
+
+        jitted = jax.jit(_prefill, donate_argnums=(1,)
+                         if self._donate else ())
+        pa, ka = self._param_avals(), self._pool_avals()
+        ta = jax.ShapeDtypeStruct((nb,), jnp.int32)
+        ia = {n: jax.ShapeDtypeStruct((1, Lr) + sp.shape, sp.dtype)
+              for n, sp in self._input_spec.items()}
+        la = jax.ShapeDtypeStruct((), jnp.int32)
+        t0 = _time.perf_counter()
+        lowered = jitted.lower(pa, ka, ta, ia, la)
+        text = lowered.as_text()
+        prog = lowered.compile()
+        del lowered
+        # caller (warm) holds self._lock for the whole build pass
+        self._prefill_progs[Lr] = prog  # graftlint: disable=JG010
+        self._prefill_text[Lr] = text
+        self._count_compile("prefill", Lr, _time.perf_counter() - t0)
+        return prog
+
+    def _build_verify(self):
+        import jax
+        import jax.numpy as jnp
+        bs, nb, L, K = (self.block_size, self.max_blocks,
+                        self.padded_len, self.spec_k)
+        step_fn = self._step_fn
+
+        def _verify(params, pool, table, pos0, inputs):
+            view = jax.tree_util.tree_map(
+                lambda p: p[table].reshape((1, L) + p.shape[2:]), pool)
+
+            def body(carry, xs):
+                toks, i = xs
+                inp = jax.tree_util.tree_map(lambda a: a[None], toks)
+                out, new_view = step_fn(params, carry, inp,
+                                        (pos0 + i)[None])
+                return new_view, out
+
+            view, outs = jax.lax.scan(body, view,
+                                      (inputs, jnp.arange(K)))
+            outs = jax.tree_util.tree_map(lambda a: a[:, 0], outs)
+            def scat(p, v):
+                vb = v[0].reshape((nb, bs) + p.shape[2:])
+                return p.at[table].set(vb)
+            new_pool = jax.tree_util.tree_map(scat, pool, view)
+            return outs, new_pool
+
+        jitted = jax.jit(_verify, donate_argnums=(1,)
+                         if self._donate else ())
+        pa, ka = self._param_avals(), self._pool_avals()
+        ta = jax.ShapeDtypeStruct((nb,), jnp.int32)
+        sa = jax.ShapeDtypeStruct((), jnp.int32)
+        ia = {n: jax.ShapeDtypeStruct((K,) + sp.shape, sp.dtype)
+              for n, sp in self._input_spec.items()}
+        t0 = _time.perf_counter()
+        lowered = jitted.lower(pa, ka, ta, sa, ia)
+        self._verify_text = lowered.as_text()
+        prog = lowered.compile()
+        del lowered
+        # caller (warm) holds self._lock for the whole build pass
+        self._verify_prog = prog  # graftlint: disable=JG010
+        self._count_compile("verify", K, _time.perf_counter() - t0)
+        return prog
+
+    def warm(self):
+        """Build every tick/prefill (and verify) program and prime
+        each with one throwaway-pool execution, so the first real
+        session pays no one-time setup.  Returns programs built."""
+        import jax
+        import jax.numpy as jnp
+        before = self._compiles
+        with self._lock:
+            for S in self.ladder.batches:
+                if S not in self._tick_progs:
+                    self._build_tick(S)
+            if self._prefill_fn is not None:
+                for Lr in self.prefill_rungs:
+                    if Lr not in self._prefill_progs:
+                        self._build_prefill(Lr)
+            if self.spec_k > 0 and self._verify_prog is None:
+                self._build_verify()
+            # prime with zeros against a THROWAWAY pool — the real
+            # pool's buffers must not ride a (possibly donating)
+            # warmup call
+            zero_pool = jax.tree_util.tree_map(
+                lambda a: jnp.zeros(a.shape, a.dtype),
+                self._pool.arrays)
+            nb = self.max_blocks
+            for S, prog in self._tick_progs.items():
+                outs, _ = prog(
+                    self._params, zero_pool,
+                    jnp.zeros((S, nb), jnp.int32),
+                    jnp.zeros((S,), jnp.int32),
+                    {n: jnp.zeros((S,) + sp.shape, sp.dtype)
+                     for n, sp in self._input_spec.items()})
+                for leaf in jax.tree_util.tree_leaves(outs):
+                    leaf.block_until_ready()
+                zero_pool = jax.tree_util.tree_map(
+                    lambda a: jnp.zeros(a.shape, a.dtype),
+                    self._pool.arrays)
+            for Lr, prog in self._prefill_progs.items():
+                new = prog(
+                    self._params, zero_pool,
+                    jnp.zeros((nb,), jnp.int32),
+                    {n: jnp.zeros((1, Lr) + sp.shape, sp.dtype)
+                     for n, sp in self._input_spec.items()},
+                    jnp.int32(0))
+                for leaf in jax.tree_util.tree_leaves(new):
+                    leaf.block_until_ready()
+                zero_pool = jax.tree_util.tree_map(
+                    lambda a: jnp.zeros(a.shape, a.dtype),
+                    self._pool.arrays)
+        return self._compiles - before
+
+    # -- session lifecycle ---------------------------------------------------
+    def _normalize_prompt(self, prompt):
+        if not isinstance(prompt, dict):
+            if len(self._input_spec) != 1:
+                raise ServeError(
+                    "decode %r has %d inputs — pass a prompt dict"
+                    % (self.label, len(self._input_spec)))
+            prompt = {next(iter(self._input_spec)): prompt}
+        out, length = {}, None
+        for n, sp in self._input_spec.items():
+            if n not in prompt:
+                raise ServeError("decode %r: prompt is missing input "
+                                 "%r" % (self.label, n))
+            a = _np.asarray(prompt[n])
+            if a.dtype != sp.dtype:
+                a = a.astype(sp.dtype)
+            if a.shape[1:] != sp.shape:
+                raise ServeError(
+                    "decode %r prompt input %r: per-token shape %s "
+                    "does not match the spec %s"
+                    % (self.label, n, a.shape[1:], sp.shape))
+            if length is None:
+                length = a.shape[0]
+            elif a.shape[0] != length:
+                raise ServeError("decode %r: prompt inputs disagree "
+                                 "on length" % self.label)
+            out[n] = a
+        if not length:
+            raise ServeError("decode %r: empty prompt" % self.label)
+        if length > self.padded_len:
+            raise ServeError(
+                "decode %r: prompt length %d exceeds padded_len %d"
+                % (self.label, length, self.padded_len))
+        return out, length
+
+    def admit(self, prompt, max_new_tokens=None, stop_fn=None,
+              deadline_ms=None):
+        """Admission: validate the prompt, allocate its blocks (typed
+        :class:`KVPoolExhausted` when the pool cannot hold it — shed
+        at the front door), register the session.  Prefill/decode
+        have not run yet — call :meth:`prefill` (the batcher does)."""
+        prompt, length = self._normalize_prompt(prompt)
+        with self._lock:
+            if self._closed:
+                raise ServeError("decode engine %r is closed"
+                                 % self.label)
+        n0 = _ceil_div(length, self.block_size)
+        table = _np.zeros((self.max_blocks,), _np.int32)
+        blocks = self._pool.alloc(n0, owner=self.label)
+        table[:n0] = blocks
+        deadline = (_time.monotonic() + float(deadline_ms) / 1e3
+                    if deadline_ms else None)
+        sess = PagedSession(self, prompt, length, blocks, table,
+                            max_new_tokens, stop_fn, deadline)
+        with self._lock:
+            if self._closed:
+                self._pool.free(blocks)
+                raise ServeError("decode engine %r is closed"
+                                 % self.label)
+            self._live.append(sess)
+        _ACTIVE_SESSIONS.inc()
+        _obs_events.emit("decode", kind="session_start", sid=sess.sid,
+                         model=self.label, prompt_len=length,
+                         blocks=n0,
+                         max_new_tokens=max_new_tokens)
+        return sess
+
+    def prefill(self, sess):
+        """Run the session's bucketed prefill dispatch (the prompt
+        prefix, everything but its last token) and arm the first
+        decode tick.  One dispatch regardless of prompt length."""
+        import jax
+        import jax.numpy as jnp
+        with self._lock:
+            if sess.done():
+                return
+            prefix = sess.length - 1
+            if prefix > 0:
+                if self._prefill_fn is None:
+                    raise ServeError(
+                        "decode %r has no prefill_fn but got a "
+                        "prompt of length %d — prompts must be "
+                        "single-token" % (self.label, sess.length))
+                rung = None
+                for r in self.prefill_rungs:
+                    if r >= prefix:
+                        rung = r
+                        break
+                prog = self._prefill_progs[rung]
+                inputs = {}
+                for n, sp in self._input_spec.items():
+                    buf = _np.zeros((1, rung) + sp.shape, sp.dtype)
+                    buf[0, :prefix] = sess.prompt[n][:prefix]
+                    inputs[n] = buf
+                old = jax.tree_util.tree_leaves(self._pool.arrays) \
+                    if self._donate and _san.enabled("donation") \
+                    else None
+                t0 = _time.perf_counter()
+                with _san.transfer_guard("decode prefill (%s)"
+                                         % self.label):
+                    new_pool = prog(self._params, self._pool.arrays,
+                                    jnp.asarray(sess.table),
+                                    inputs, _np.int32(prefix))
+                _DISPATCH_SECONDS.observe(_time.perf_counter() - t0)
+                self._pool.set_arrays(new_pool)
+                self._dispatches += 1
+                if old is not None:
+                    _san.poison_donated(
+                        old, "decode prefill (%s)" % self.label)
+            sess.pos = prefix
+            sess.pending_input = {
+                n: sess.prompt[n][sess.length - 1]
+                for n in self._input_spec}
+
+    def tick(self, sessions):
+        """ONE batched decode step for *sessions*: gather, step,
+        scatter, readback — every live session's next token from one
+        dispatch.  Cancelled sessions are released; a session that
+        needs a block the pool cannot give fails typed and releases
+        its blocks; finished sessions (max tokens, stop_fn, length
+        cap) are released with their reason.  Returns the sessions
+        that actually rode the dispatch."""
+        import jax
+        with self._lock:
+            if self._closed:
+                raise ServeError("decode engine %r is closed"
+                                 % self.label)
+            ready = []
+            for s in sessions:
+                if s.done():
+                    continue
+                if s.cancelled:
+                    self._release_locked(
+                        s, "cancelled", RequestCancelled(
+                            "decode session %d cancelled by its "
+                            "caller" % s.sid))
+                    continue
+                if s.pos >= self.padded_len:
+                    self._release_locked(s, "length_cap", None)
+                    continue
+                need = s.pos // self.block_size + 1
+                failed = False
+                while len(s.blocks) < need:
+                    try:
+                        blk = self._pool.alloc(1, owner=self.label)
+                    except KVPoolExhausted as exc:
+                        self._release_locked(s, "pool_exhausted", exc)
+                        failed = True
+                        break
+                    s.blocks.extend(blk)
+                    s.table[len(s.blocks) - 1] = blk[0]
+                if not failed:
+                    ready.append(s)
+            if not ready:
+                return []
+            n = len(ready)
+            S = self.ladder.batch_for(n)
+            nb = self.max_blocks
+            tables = _np.zeros((S, nb), _np.int32)
+            pos = _np.zeros((S,), _np.int32)
+            inputs = {nm: _np.zeros((S,) + sp.shape, sp.dtype)
+                      for nm, sp in self._input_spec.items()}
+            for i, s in enumerate(ready):
+                tables[i] = s.table
+                pos[i] = s.pos
+                for nm in inputs:
+                    inputs[nm][i] = s.pending_input[nm]
+            prog = self._tick_progs[S]
+            old = jax.tree_util.tree_leaves(self._pool.arrays) \
+                if self._donate and _san.enabled("donation") else None
+            t0 = _time.perf_counter()
+            with _san.transfer_guard("decode tick (%s)" % self.label):
+                outs, new_pool = prog(self._params, self._pool.arrays,
+                                      tables, pos, inputs)
+            _DISPATCH_SECONDS.observe(_time.perf_counter() - t0)
+            self._pool.set_arrays(new_pool)
+            self._dispatches += 1
+            _DECODE_STEPS.inc()
+            if old is not None:
+                _san.poison_donated(old, "decode tick (%s)"
+                                    % self.label)
+            # ONE device->host readback serves every session's token
+            host = jax.device_get(outs)
+            now = _time.monotonic()
+            for i, s in enumerate(ready):
+                out_i = jax.tree_util.tree_map(lambda a: a[i], host)
+                s.pos += 1
+                s._deliver(out_i, now)
+                _DECODE_TOKENS.inc()
+                if self._finished(s, out_i):
+                    self._release_locked(s, "finished", None)
+                else:
+                    s.pending_input = self._feed(out_i)
+            _obs_events.emit("decode", kind="tick", model=self.label,
+                             rung=S, sessions=n)
+            return ready
+
+    def _finished(self, s, out):
+        if s.max_new_tokens is not None and \
+                s.token_count >= s.max_new_tokens:
+            return True
+        if s.stop_fn is not None and s.stop_fn(out):
+            return True
+        return False
+
+    def _feed(self, out):
+        if self._next_input_fn is not None:
+            return self._next_input_fn(out)
+        import jax
+        if isinstance(out, dict) and set(out) == set(self._input_spec):
+            return {n: _np.asarray(out[n]).astype(
+                self._input_spec[n].dtype) for n in out}
+        leaves = jax.tree_util.tree_leaves(out)
+        if len(leaves) == 1 and len(self._input_spec) == 1:
+            name, sp = next(iter(self._input_spec.items()))
+            a = _np.asarray(leaves[0]).astype(sp.dtype)
+            if a.shape != sp.shape:
+                raise ServeError(
+                    "decode %r: step output shape %s does not match "
+                    "input spec %s — pass next_input_fn"
+                    % (self.label, a.shape, sp.shape))
+            return {name: a}
+        raise ServeError(
+            "decode %r: cannot map the step output back to the "
+            "inputs — pass next_input_fn" % self.label)
+
+    # -- speculative verify (stretch) ----------------------------------------
+    def verify(self, sess, tokens):
+        """One K-token verify dispatch (``spec_k`` contract): run the
+        step at positions ``pos .. pos+K-1`` with *tokens* (host
+        arrays, leaves ``(K,) + input_shape``) and return the K step
+        outputs, WITHOUT advancing the session — the caller commits
+        the accepted prefix via :meth:`spec_commit`.  Rejected
+        positions hold beyond-position garbage the next real tick
+        overwrites."""
+        import jax
+        if self._verify_prog is None:
+            raise ServeError("decode %r was built without spec_k — "
+                             "speculative verify is off" % self.label)
+        K = self.spec_k
+        with self._lock:
+            if sess.done():
+                raise ServeError("decode session %d is finished"
+                                 % sess.sid)
+            if sess.pos + K > self.padded_len:
+                raise ServeError(
+                    "verify of %d tokens at pos %d crosses padded_len "
+                    "%d" % (K, sess.pos, self.padded_len))
+            need = (sess.pos + K - 1) // self.block_size + 1
+            while len(sess.blocks) < need:
+                try:
+                    blk = self._pool.alloc(1, owner=self.label)
+                except KVPoolExhausted:
+                    # same typed-fail-and-release rule as tick(): the
+                    # session must not keep its blocks (or the
+                    # active-sessions gauge) after a growth failure
+                    self._release_locked(
+                        sess, "pool_exhausted", KVPoolExhausted(
+                            "decode session %d exhausted the pool "
+                            "growing for a %d-token verify"
+                            % (sess.sid, K)))
+                    raise
+                sess.blocks.extend(blk)
+                sess.table[len(sess.blocks) - 1] = blk[0]
+            inputs = {}
+            for n, sp in self._input_spec.items():
+                a = _np.asarray(tokens[n]).astype(sp.dtype)
+                if a.shape != (K,) + sp.shape:
+                    raise ServeError(
+                        "verify input %r: shape %s != %s"
+                        % (n, a.shape, (K,) + sp.shape))
+                inputs[n] = a
+            old = jax.tree_util.tree_leaves(self._pool.arrays) \
+                if self._donate and _san.enabled("donation") else None
+            t0 = _time.perf_counter()
+            with _san.transfer_guard("decode verify (%s)" % self.label):
+                outs, new_pool = self._verify_prog(
+                    self._params, self._pool.arrays, sess.table,
+                    _np.int32(sess.pos), inputs)
+            _DISPATCH_SECONDS.observe(_time.perf_counter() - t0)
+            self._pool.set_arrays(new_pool)
+            self._dispatches += 1
+            if old is not None:
+                _san.poison_donated(old, "decode verify (%s)"
+                                    % self.label)
+            return jax.device_get(outs)
+
+    def spec_commit(self, sess, accepted_outs):
+        """Commit *accepted_outs* (host per-token output trees, in
+        order) after a :meth:`verify`: deliver each, advance the
+        cursor, arm the next input from the last one."""
+        with self._lock:
+            now = _time.monotonic()
+            for out in accepted_outs:
+                if sess.done():
+                    return
+                sess.pos += 1
+                sess._deliver(out, now)
+                _DECODE_TOKENS.inc()
+                if self._finished(sess, out):
+                    self._release_locked(sess, "finished", None)
+                else:
+                    sess.pending_input = self._feed(out)
+
+    # -- teardown ------------------------------------------------------------
+    def release(self, sess, reason, error=None):
+        """Finish a session: free its blocks, resolve its readers
+        (typed *error*, or a clean finish), drop it from the live
+        set.  Serialized with tick/prefill dispatches — blocks are
+        never freed under a program that still reads them."""
+        with self._lock:
+            self._release_locked(sess, reason, error)
+
+    def _release_locked(self, sess, reason, error):
+        with sess._cond:
+            if sess._released:
+                return
+            sess._released = True
+            blocks, sess.blocks = sess.blocks, []
+        self._pool.free(blocks)
+        try:
+            self._live.remove(sess)
+        except ValueError:
+            pass
+        _ACTIVE_SESSIONS.dec()
+        with sess._cond:
+            sess._done = True
+            sess._error = error
+            sess.finish_reason = reason
+            sess._cond.notify_all()
+        _obs_events.emit("decode", kind="session_end", sid=sess.sid,
+                         model=self.label, reason=reason,
+                         tokens=sess.token_count,
+                         error=None if error is None
+                         else type(error).__name__)
+
+    def close(self):
+        """Tear the engine down: fail live sessions typed, release
+        the pool (gauges drop), drop the programs.  Close batchers
+        first (the registry does)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            for s in list(self._live):
+                self._release_locked(
+                    s, "closed", ServeError(
+                        "decode engine %r closed" % self.label))
+            self._tick_progs = {}
+            self._prefill_progs = {}
+            self._verify_prog = None
+            # inside the engine lock: every other access to the
+            # pool's state handle (tick/prefill gather + rebind)
+            # holds it too — close must share that lockset
+            self._pool.close()
+
+
+class DecodeBatcher:
+    """The continuous-batching decode tick loop.
+
+    One dispatcher thread owns the engine: it admits queued joins
+    (bucketed prefill dispatches), then runs decode ticks over the
+    whole active-session set — one dispatch + one readback per tick
+    serves every session's next token.  Sessions join and leave
+    between ticks; an idle batcher coalesces arrivals for up to
+    ``MXNET_SERVE_DECODE_MAX_WAIT_MS`` before the first tick, exactly
+    like the predict batcher's window.
+
+    Supervision differs from :class:`DynamicBatcher` deliberately: a
+    crash escaping the tick loop marks the batcher unhealthy and
+    fails every session typed WITHOUT a restart — the donated pool
+    state cannot be trusted after a dispatch died mid-donation, and
+    restarting over a corrupt pool would serve wrong tokens instead
+    of a typed error."""
+
+    def __init__(self, engine, max_wait_ms=None, name=None,
+                 on_state=None):
+        from ..config import get_env
+        self._engine = engine
+        self.name = name or engine.label
+        if max_wait_ms is None:
+            max_wait_ms = get_env("MXNET_SERVE_DECODE_MAX_WAIT_MS")
+        self._max_wait = max(0.0, float(max_wait_ms)) / 1e3
+        self._on_state = on_state
+        self._lock = _san.lock(label="serve.decode.batcher.%s"
+                               % self.name)
+        self._cond = _san.condition(self._lock,
+                                    label="serve.decode.batcher.%s"
+                                    % self.name)
+        self._joins = collections.deque()
+        self._sessions = []
+        # sessions/joins the tick loop has popped into its locals but
+        # not yet written back — drain()/close()/_crashed() must see
+        # them or a mid-iteration drain returns early and teardown
+        # closes the engine under a live session (the DynamicBatcher
+        # _inflight discipline)
+        self._inflight = ()
+        self._stopped = False
+        self._draining = False
+        self._unhealthy = False
+        self._ticks = 0
+        self._last_tick = _time.monotonic()
+        _san.track(self, ("_joins", "_sessions", "_inflight",
+                          "_stopped", "_draining", "_unhealthy",
+                          "_ticks"),
+                   label="serve.decode.batcher.%s" % self.name)
+        with engine._lock:
+            engine._batchers.append(self)
+        self._thread = _san.thread(
+            target=self._run, name="serve-decode-%s" % self.name,
+            daemon=True)
+        self._thread.start()
+
+    # -- stats / health ------------------------------------------------------
+    @property
+    def tick_count(self):
+        with self._lock:
+            return self._ticks
+
+    @property
+    def session_count(self):
+        with self._lock:
+            return len(self._sessions) + len(self._joins)
+
+    @property
+    def unhealthy(self):
+        with self._lock:
+            return self._unhealthy
+
+    @property
+    def draining(self):
+        with self._lock:
+            return self._draining
+
+    @property
+    def stopped(self):
+        """True after close(): a retired batcher, not a failed one."""
+        with self._lock:
+            return self._stopped
+
+    def dispatcher_alive(self):
+        with self._lock:
+            thread, unhealthy = self._thread, self._unhealthy
+        return bool(thread.is_alive()) and not unhealthy
+
+    def last_tick_age(self):
+        with self._lock:
+            return _time.monotonic() - self._last_tick
+
+    def health_state(self):
+        with self._lock:
+            if self._unhealthy:
+                return "unhealthy"
+            if self._stopped or self._draining:
+                return "draining"
+            return "ready"
+
+    # -- client side ---------------------------------------------------------
+    def start(self, prompt, max_new_tokens=None, stop_fn=None,
+              deadline_ms=None):
+        """Admit one decode session.  Raises a typed
+        :class:`KVPoolExhausted` when the pool cannot hold the prompt
+        (shed at submit — PR-10 semantics), a :class:`ServeError`
+        when the batcher is draining/closed/unhealthy.
+        *deadline_ms* bounds time-to-join: a session the dispatcher
+        cannot prefill by then is shed typed
+        (:class:`~mxnet_tpu.serve.buckets.DeadlineExceededError`).
+        Returns the :class:`PagedSession`."""
+        with self._lock:
+            if self._stopped:
+                raise ServeError("decode batcher %r is closed"
+                                 % self.name)
+            if self._unhealthy:
+                raise ServeError("decode batcher %r is unhealthy "
+                                 "(tick loop crashed)" % self.name)
+            if self._draining:
+                raise ServeError("decode batcher %r is draining — "
+                                 "admissions are stopped" % self.name)
+        sess = self._engine.admit(prompt,
+                                  max_new_tokens=max_new_tokens,
+                                  stop_fn=stop_fn,
+                                  deadline_ms=deadline_ms)
+        with self._cond:
+            if self._stopped or self._draining:
+                stopped = self._stopped
+                self._cond.notify_all()
+            else:
+                self._joins.append(sess)
+                self._cond.notify()
+                return sess
+        # lost the race to a close/drain: undo the admission, typed
+        self._engine.release(sess, "shed", ServeError(
+            "decode batcher %r %s" % (self.name,
+                                      "closed" if stopped
+                                      else "draining")))
+        raise sess.error
+
+    # -- dispatcher ----------------------------------------------------------
+    def _run(self):
+        try:
+            self._loop()
+        except Exception as exc:
+            self._crashed(exc)
+
+    def _loop(self):
+        eng = self._engine
+        top = eng.ladder.max_batch
+        while True:
+            with self._cond:
+                self._last_tick = _time.monotonic()
+                while not self._stopped and not self._joins and \
+                        not self._sessions:
+                    # bounded idle wait keeps the liveness tick fresh
+                    self._cond.wait(timeout=0.5)
+                    self._last_tick = _time.monotonic()
+                if self._stopped:
+                    return
+                # coalescing window: with nothing decoding yet, hold
+                # the first tick open for more arrivals (oldest-join
+                # clock, monotonic) so co-arriving sessions share one
+                # rung from the start
+                while self._joins and not self._sessions and \
+                        not self._stopped and not self._draining and \
+                        len(self._joins) < top:
+                    now = _time.monotonic()
+                    window = self._joins[0]._t_enq + self._max_wait
+                    if now >= window:
+                        break
+                    self._cond.wait(timeout=window - now)
+                    self._last_tick = _time.monotonic()
+                if self._stopped:
+                    return
+                joins = list(self._joins)
+                self._joins.clear()
+                sessions = list(self._sessions)
+                self._inflight = tuple(joins) + tuple(sessions)
+            for j in joins:
+                if j.cancelled:
+                    eng.release(j, "cancelled", RequestCancelled(
+                        "decode session %d cancelled before its "
+                        "prefill" % j.sid))
+                    continue
+                # fresh clock per join: an earlier join's slow
+                # prefill must not let a stale stamp admit a session
+                # whose deadline has already passed
+                if j._deadline is not None and \
+                        _time.monotonic() >= j._deadline:
+                    eng.release(j, "expired", DeadlineExceededError(
+                        "decode session %d missed its join deadline "
+                        "(%r queue)" % (j.sid, self.name)))
+                    continue
+                try:
+                    eng.prefill(j)
+                except Exception as exc:
+                    # a failed prefill fails exactly this session —
+                    # the error rides its future, typed
+                    eng.release(j, "prefill_failed", exc)
+                    continue
+                sessions.append(j)
+            live = [s for s in sessions if not s.done()]
+            for i in range(0, len(live), top):
+                eng.tick(live[i:i + top])
+            with self._cond:
+                self._inflight = ()
+                self._sessions = [s for s in sessions
+                                  if not s.done()]
+                self._ticks += 1
+                self._last_tick = _time.monotonic()
+                # wake waiters every iteration: a flush() watching a
+                # SUBSET of sessions must see them finish even while
+                # new admissions keep the lists non-empty
+                self._cond.notify_all()
+
+    def _crashed(self, exc):
+        with self._cond:
+            self._unhealthy = True
+            leftovers = list(dict.fromkeys(
+                self._sessions + list(self._joins)
+                + list(self._inflight)))
+            self._sessions = []
+            self._joins.clear()
+            self._inflight = ()
+            self._cond.notify_all()
+        log.error("decode batcher %r: tick loop crashed (%s: %s) — "
+                  "unhealthy, failing %d sessions (no restart: the "
+                  "donated pool state cannot be trusted)", self.name,
+                  type(exc).__name__, exc, len(leftovers))
+        err = ServeError(
+            "decode batcher %r is unhealthy: tick loop crashed "
+            "(%s: %s)" % (self.name, type(exc).__name__, exc))
+        for s in leftovers:
+            self._engine.release(s, "failed", err)
+        _obs_events.emit("decode", kind="unhealthy", model=self.name,
+                         sessions_failed=len(leftovers),
+                         error="%s: %s" % (type(exc).__name__,
+                                           str(exc)[:200]))
+        if self._on_state is not None:
+            try:
+                self._on_state("unhealthy")
+            except Exception:
+                log.exception("decode batcher %r: on_state hook "
+                              "failed", self.name)
+
+    # -- lifecycle -----------------------------------------------------------
+    def drain(self, timeout=None):
+        """Stop admissions (``start`` raises typed) and keep ticking
+        until every live session finishes, bounded by *timeout*
+        (default ``MXNET_SERVE_DRAIN_TIMEOUT``).  Sessions still live
+        at the deadline fail typed and release their pool blocks —
+        a cutover/unload never strands blocks, and tokens already
+        delivered stay readable (zero lost accepted steps).  Returns
+        True when everything finished naturally."""
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+        _obs_events.emit("decode", kind="drain", model=self.name)
+        return self._await_quiesce(timeout, "drained")
+
+    def flush(self, timeout=None):
+        """Wait (bounded) for every session ALREADY accepted to
+        finish WITHOUT stopping admissions — the alias-cutover
+        primitive, mirroring DynamicBatcher.flush: accepted decode
+        work lands (or typed-fails at the deadline, releasing its
+        blocks), and the batcher keeps serving — the model may still
+        be reachable through other aliases or its direct name.
+        Returns True when everything finished in time."""
+        return self._await_quiesce(timeout, "flushed")
+
+    def _await_quiesce(self, timeout, reason):
+        if timeout is None:
+            from ..config import get_env
+            timeout = get_env("MXNET_SERVE_DRAIN_TIMEOUT")
+        deadline = _time.monotonic() + max(0.0, float(timeout))
+        clean = True
+        leftovers = []
+        with self._cond:
+            # snapshot what is accepted NOW — flush must not chase
+            # sessions admitted after it started
+            target = set(self._sessions) | set(self._joins) \
+                | set(self._inflight)
+            while any(not s.done() for s in target):
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    clean = False
+                    leftovers = [s for s in target if not s.done()]
+                    self._sessions = [s for s in self._sessions
+                                      if s not in leftovers]
+                    for s in leftovers:
+                        try:
+                            self._joins.remove(s)
+                        except ValueError:
+                            pass
+                    break
+                self._cond.wait(timeout=remaining)
+        for s in leftovers:
+            self._engine.release(s, reason, ServeError(
+                "decode session %d %s before finishing "
+                "(batcher %r); tokens delivered so far remain "
+                "readable via outputs()" % (s.sid, reason,
+                                            self.name)))
+        return clean
+
+    def close(self, timeout=5.0):
+        """Stop the tick loop; live sessions fail typed (their
+        delivered tokens stay readable).  Returns True on a clean
+        join."""
+        with self._cond:
+            if self._stopped:
+                return True
+            self._stopped = True
+            self._cond.notify_all()
+            thread = self._thread
+        # join FIRST: the loop finishes its in-flight iteration and
+        # writes surviving sessions back, so the sweep below sees
+        # them (failing leftovers before the join would miss the
+        # iteration's local state)
+        thread.join(timeout)
+        clean = not thread.is_alive()
+        with self._cond:
+            leftovers = list(dict.fromkeys(
+                self._sessions + list(self._joins)
+                + list(self._inflight)))
+            self._sessions = []
+            self._joins.clear()
+            self._inflight = ()
+        for s in leftovers:
+            self._engine.release(s, "closed", ServeError(
+                "decode batcher %r closed before session %d "
+                "finished" % (self.name, s.sid)))
+        # a cleanly-retired batcher must not haunt the registry's
+        # live()/health view (its dead thread is not a liveness
+        # failure); a CRASHED batcher stays listed — unhealthy must
+        # surface
+        with self._engine._lock:
+            try:
+                self._engine._batchers.remove(self)
+            except ValueError:
+                pass
+        if not clean:
+            log.warning("decode batcher %r: close could not join the "
+                        "tick loop within %.1fs", self.name, timeout)
+        return clean
+
+
+class SpeculativeDecoder:
+    """Greedy speculative decode (stretch feature, opt-in): a small
+    draft engine proposes K tokens with K cheap rung-1 ticks, the
+    target engine verifies all K in ONE batched verify dispatch and
+    accepts the matched prefix plus one corrected token.  With greedy
+    (argmax) emission this is bit-equal to plain target decode: every
+    emitted token is the target's own step output, and rejected cache
+    positions are beyond-position garbage the step contract already
+    masks.
+
+    Build the target engine with ``spec_k=K`` (that compiles the
+    verify program at warm); the draft engine is any
+    :class:`DecodeEngine` over the same input/output token contract
+    (typically a much smaller model).  This is a single-session
+    driver — the batched tick path stays the default; speculative
+    decode is the latency play for sparse traffic.
+    """
+
+    def __init__(self, target, draft):
+        if target.spec_k < 1:
+            raise ServeError("SpeculativeDecoder needs a target "
+                             "engine built with spec_k >= 1")
+        if set(draft._input_spec) != set(target._input_spec):
+            raise ServeError("draft/target engines disagree on the "
+                             "input contract")
+        self.target = target
+        self.draft = draft
+        self.k = target.spec_k
+        self.stats = {"rounds": 0, "proposed": 0, "accepted": 0,
+                      "target_dispatches": 0}
+
+    def _token_key(self, out):
+        import jax
+        return tuple(_np.asarray(leaf).tobytes()
+                     for leaf in jax.tree_util.tree_leaves(out))
+
+    def run(self, prompt, max_new_tokens):
+        """Decode one session speculatively; returns the finished
+        target :class:`PagedSession` (its ``outputs()`` is the
+        stream)."""
+        t_sess = self.target.admit(prompt,
+                                   max_new_tokens=max_new_tokens)
+        self.target.prefill(t_sess)
+        d_sess = self.draft.admit(prompt)
+        self.draft.prefill(d_sess)
+        try:
+            while not t_sess.done():
+                base_pos = t_sess.pos
+                base_input = dict(t_sess.pending_input)
+                # draft proposes continuations of the pending token.
+                # k draft ticks: the first k-1 proposals ride the
+                # verify (inputs = pending + proposals[:k-1]); the
+                # k-th tick exists only to write draft-cache position
+                # base+k-1, so a FULL accept leaves the draft's cache
+                # complete for the next round (without it the next
+                # proposals would read beyond-position garbage and
+                # acceptance collapses after every clean round)
+                d_sess.pos = base_pos
+                d_sess.pending_input = dict(base_input)
+                proposals = []
+                for _ in range(self.k):
+                    if d_sess.pos >= self.draft.padded_len:
+                        break
+                    before = d_sess.token_count
+                    self.draft.tick([d_sess])
+                    if d_sess.token_count == before:
+                        break
+                    proposals.append(d_sess.outputs()[-1])
+                if len(proposals) < self.k:
+                    # tail of the sequence: fall back to plain ticks
+                    self.target.tick([t_sess])
+                    self.stats["target_dispatches"] += 1
+                    continue
+                proposals = proposals[:self.k - 1]
+                verify_inputs = {}
+                for n, sp in self.target._input_spec.items():
+                    buf = _np.zeros((self.k,) + sp.shape, sp.dtype)
+                    buf[0] = base_input[n]
+                    for i, p in enumerate(proposals):
+                        buf[i + 1] = self.target._feed(p)[n]
+                    verify_inputs[n] = buf
+                outs = self.target.verify(t_sess, verify_inputs)
+                self.stats["target_dispatches"] += 1
+                self.stats["rounds"] += 1
+                self.stats["proposed"] += len(proposals)
+                import jax
+                per_tok = [jax.tree_util.tree_map(lambda a: a[i], outs)
+                           for i in range(self.k)]
+                accepted = [per_tok[0]]
+                for i, p in enumerate(proposals):
+                    if self._token_key(p) == \
+                            self._token_key(per_tok[i]):
+                        accepted.append(per_tok[i + 1])
+                    else:
+                        break
+                self.stats["accepted"] += len(accepted) - 1
+                self.target.spec_commit(t_sess, accepted)
+        except BaseException as exc:
+            # a verify/tick failure must not strand the live target
+            # session: its blocks and the active-sessions gauge have
+            # to come back (delivered tokens stay readable)
+            if not t_sess.done():
+                self.target.release(t_sess, "failed", ServeError(
+                    "speculative decode failed mid-stream "
+                    "(%s: %s)" % (type(exc).__name__, exc)))
+            raise
+        finally:
+            if not d_sess.done():
+                self.draft.release(d_sess, "finished", None)
+        return t_sess

@@ -1,0 +1,222 @@
+"""Paged KV-cache pool — fixed device blocks shared by every decode session.
+
+The dense ``DecodeSession`` (predictor.py) gives each session its own
+worst-case-length cache: N concurrent sessions pay N full caches of
+device memory and N dispatches per token.  This module is the vLLM
+paged-attention idea translated to AOT-compiled XLA programs (the
+Hybrid JIT/CUDA-Graph low-latency-inference paper in PAPERS.md is the
+playbook): allocate ONE fixed pool of cache blocks per model at load
+time, hand each session a *block table* of indices into it, and let
+the compiled decode-tick program gather/scatter through the table.
+Memory is bounded by the pool — thousands of sessions share it, each
+holding only the blocks its sequence has actually reached.
+
+Layout, per cache leaf (e.g. per-layer K and V):
+
+    pool leaf:   (num_blocks, block_size, *per_token_shape)
+    block table: (max_blocks_per_session,) int32 per session
+    dense view:  (S, padded_len, *per_token_shape)   gathered per tick
+
+Block 0 is the reserved **null block**: unused table entries point at
+it, padding rows of a partially-filled session rung write their
+garbage into it, and no session ever owns it — so a co-tenant's
+writes can land there without corrupting anyone (the drill proves
+stream bit-equality with the null block deliberately poisoned).
+
+Admission control follows the PR-10 shedding semantics: an ``alloc``
+that cannot be satisfied raises the typed :class:`KVPoolExhausted`
+(an :class:`~mxnet_tpu.serve.buckets.OverloadError`) instead of
+queueing or OOMing — callers shed at the front door, sessions that
+exhaust the pool mid-stream fail typed and release their blocks.
+
+Knobs: ``MXNET_SERVE_KV_BLOCK_SIZE`` (tokens per block) and
+``MXNET_SERVE_KV_BLOCKS`` (pool capacity).  Gauges
+``serve_kv_blocks_in_use`` / ``serve_kv_blocks_total`` are
+delta-maintained so multiple pools aggregate (docs/observability.md).
+"""
+
+from __future__ import annotations
+
+from .buckets import OverloadError, ServeError
+from .. import sanitizer as _san
+from ..observability import events as _obs_events
+from ..observability import metrics as _obs_metrics
+
+__all__ = ["KVPool", "KVPoolExhausted"]
+
+_BLOCKS_TOTAL = _obs_metrics.gauge(
+    "serve_kv_blocks_total",
+    "allocatable KV-cache blocks across all live paged pools "
+    "(delta-maintained; excludes each pool's reserved null block)")
+_BLOCKS_IN_USE = _obs_metrics.gauge(
+    "serve_kv_blocks_in_use",
+    "KV-cache blocks currently owned by live decode sessions "
+    "(delta-maintained across pools)")
+
+
+class KVPoolExhausted(OverloadError):
+    """The paged KV pool has no free block.  Raised at session
+    admission (shed at the front door, PR-10 semantics) or when a
+    live session's sequence crosses a block boundary with the pool
+    full (that session fails typed and releases its blocks)."""
+
+
+class KVPool:
+    """A fixed pool of device-resident cache blocks + its allocator.
+
+    Parameters
+    ----------
+    token_spec : pytree of jax.ShapeDtypeStruct
+        Shape/dtype of ONE token's cache slice per leaf (e.g.
+        ``{"k": SDS((heads, dim), f32), "v": ...}``).  Pool leaves are
+        allocated as ``(num_blocks, block_size) + leaf.shape``.
+    num_blocks : int, optional
+        Total blocks including the reserved null block (default the
+        ``MXNET_SERVE_KV_BLOCKS`` knob).
+    block_size : int, optional
+        Tokens per block (default ``MXNET_SERVE_KV_BLOCK_SIZE``).
+    device : jax device, optional
+        Where the pool lives (default: current context's device).
+
+    The device arrays are exposed as :attr:`arrays` and re-bound by
+    the decode engine after every donated program call
+    (:meth:`set_arrays`) — the pool object owns the allocator and the
+    *current* state handle; program threading is the engine's job.
+    """
+
+    def __init__(self, token_spec, num_blocks=None, block_size=None,
+                 device=None):
+        import jax
+        import jax.numpy as jnp
+        from ..config import get_env
+        from ..context import current_context
+
+        if num_blocks is None:
+            num_blocks = get_env("MXNET_SERVE_KV_BLOCKS")
+        if block_size is None:
+            block_size = get_env("MXNET_SERVE_KV_BLOCK_SIZE")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        if self.block_size < 1:
+            raise ServeError("KV block size must be >= 1, got %d"
+                             % self.block_size)
+        if self.num_blocks < 2:
+            raise ServeError(
+                "KV pool needs >= 2 blocks (block 0 is the reserved "
+                "null block), got %d" % self.num_blocks)
+        self._device = device if device is not None \
+            else current_context().jax_device
+        self._spec = token_spec
+        leaves = jax.tree_util.tree_leaves(token_spec)
+        if not leaves:
+            raise ServeError("KV pool token_spec has no leaves")
+        self.arrays = jax.tree_util.tree_map(
+            lambda s: jax.device_put(
+                jnp.zeros((self.num_blocks, self.block_size)
+                          + tuple(s.shape), s.dtype), self._device),
+            token_spec)
+        # bytes, for operators sizing the pool
+        self.bytes_per_block = sum(
+            self.block_size * int(jnp.dtype(s.dtype).itemsize)
+            * int(_prod(s.shape)) for s in leaves)
+        self._lock = _san.lock(label="serve.kvpool")
+        # free list: every block except the reserved null block 0
+        self._free = list(range(self.num_blocks - 1, 0, -1))
+        self._in_use = 0
+        self._closed = False
+        _san.track(self, ("_free", "_in_use", "_closed", "arrays"),
+                   label="serve.kvpool")
+        _BLOCKS_TOTAL.inc(self.num_blocks - 1)
+
+    # -- state threading (engine-side) --------------------------------------
+    def set_arrays(self, arrays):
+        """Re-bind the pool state after a donated program call — the
+        outputs become the next call's inputs, fused-step style."""
+        self.arrays = arrays
+
+    @property
+    def device(self):
+        return self._device
+
+    # -- allocator ----------------------------------------------------------
+    @property
+    def blocks_total(self):
+        """Allocatable blocks (the null block is not allocatable)."""
+        return self.num_blocks - 1
+
+    @property
+    def blocks_in_use(self):
+        with self._lock:
+            return self._in_use
+
+    @property
+    def blocks_free(self):
+        with self._lock:
+            return len(self._free)
+
+    def alloc(self, n, owner="?"):
+        """Take *n* blocks; returns their ids.  Raises the typed
+        :class:`KVPoolExhausted` (and emits a ``decode`` event) when
+        fewer than *n* are free — all-or-nothing, so a partially
+        admitted session never strands blocks."""
+        n = int(n)
+        if n < 1:
+            raise ServeError("KV alloc needs n >= 1, got %d" % n)
+        with self._lock:
+            if self._closed:
+                raise ServeError("KV pool is closed")
+            if len(self._free) < n:
+                free = len(self._free)
+                in_use = self._in_use
+            else:
+                blocks = [self._free.pop() for _ in range(n)]
+                self._in_use += n
+                _BLOCKS_IN_USE.inc(n)
+                return blocks
+        _obs_events.emit("decode", kind="pool_exhausted", owner=owner,
+                         requested=n, free=free, in_use=in_use,
+                         total=self.blocks_total)
+        raise KVPoolExhausted(
+            "KV pool exhausted: %d block(s) requested, %d free "
+            "(%d/%d in use) — shed the session or grow "
+            "MXNET_SERVE_KV_BLOCKS" % (n, free, in_use,
+                                       self.blocks_total))
+
+    def free(self, blocks):
+        """Return *blocks* to the pool (session end, any reason)."""
+        if not blocks:
+            return
+        with self._lock:
+            if self._closed:
+                return
+            for b in blocks:
+                b = int(b)
+                if b == 0:
+                    raise ServeError("block 0 is the reserved null "
+                                     "block — it is never allocated")
+                self._free.append(b)
+            self._in_use -= len(blocks)
+            _BLOCKS_IN_USE.dec(len(blocks))
+
+    def close(self):
+        """Release the pool: gauges drop, the device arrays are
+        unreferenced (memory returns when the engine drops its
+        program handles too).  Idempotent."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            in_use = self._in_use
+            self._in_use = 0
+            self._free = []
+        if in_use:
+            _BLOCKS_IN_USE.dec(in_use)
+        _BLOCKS_TOTAL.dec(self.num_blocks - 1)
+        self.arrays = None
+
+
+def _prod(shape):
+    out = 1
+    for s in shape:
+        out *= int(s)
+    return out

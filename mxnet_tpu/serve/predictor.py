@@ -51,6 +51,10 @@ _COMPILES_TOTAL = _obs_metrics.counter(
 _PADDED_ROWS = _obs_metrics.counter(
     "serve_padded_rows_total",
     "zero-padded rows dispatched (bucket size minus real rows)")
+_DEVICE_PUT_ELIDED = _obs_metrics.counter(
+    "device_put_elided_total",
+    "host->device transfers skipped because the array was already "
+    "committed to its target device/sharding (device-resident input)")
 
 
 def _as_jnp(x):
@@ -61,6 +65,23 @@ def _as_jnp(x):
     if data is not None:
         return _np.asarray(data)
     return _np.asarray(x)
+
+
+def _device_resident(arr, dev):
+    """AOT-dispatch flavor of ``ndarray._already_placed``: a compiled
+    executable has no trace cache, so an input's committedness cannot
+    flip a jit cache key here — any live jax array already on *dev*
+    may skip the host round trip.  (Compiled-program outputs on CPU
+    come back uncommitted, which is exactly the chained-decode case.)
+    Deleted/donated buffers fall through to the normal path so the
+    real use-after-donate error surfaces at the transfer site."""
+    import jax
+    if not isinstance(arr, jax.Array):
+        return False
+    try:
+        return arr.devices() == {dev}
+    except (RuntimeError, TypeError, AttributeError):
+        return False
 
 
 class CompiledPredictor:
@@ -173,6 +194,9 @@ class CompiledPredictor:
         self._lock = _san.lock(label="serve.predictor.%s" % name)
         self._compiles = 0
         self._dispatches = 0
+        # paged decode engines attached via make_paged_decoder: the
+        # registry drains/closes them on unload and alias cutover
+        self._decode_engines = []
 
     # -- introspection -----------------------------------------------------
     @property
@@ -447,6 +471,21 @@ class CompiledPredictor:
         return DecodeSession(self, compiled, cache, ia, donate, label,
                              lowered_text=lowered_text)
 
+    def make_paged_decoder(self, step_fn, prefill_fn=None,
+                           token_spec=None, input_spec=None, **kwargs):
+        """Build a continuously-batched paged-KV decode engine bound
+        to this model: shares its parameters/device/compile
+        accounting, and the registry's unload/alias-cutover drains it
+        with the model (docs/serving.md "Continuous-batching
+        decode").  See :class:`~mxnet_tpu.serve.decode.DecodeEngine`
+        for the step/prefill contract and knobs."""
+        from .decode import DecodeEngine
+        kwargs.setdefault("label", "%s.decode" % self.name)
+        return DecodeEngine(step_fn, prefill_fn=prefill_fn,
+                            token_spec=token_spec,
+                            input_spec=input_spec,
+                            predictor=self, **kwargs)
+
 
 class DecodeSession:
     """One live autoregressive decode: holds the donated cache tree
@@ -493,7 +532,19 @@ class DecodeSession:
             if n not in inputs:
                 raise ServeError("decode %r: missing input %r"
                                  % (self._label, n))
-            a = _as_jnp(inputs[n])
+            raw = inputs[n]
+            raw = getattr(raw, "_data", None) \
+                if getattr(raw, "_data", None) is not None else raw
+            if _device_resident(raw, pred._dev):
+                # the previous step's output fed back as this step's
+                # input: already committed to the target device — the
+                # old np.asarray round trip forced a full d2h readback
+                # of every output per token.  Elide it (the PR-11
+                # committedness rule) and count the avoided transfer.
+                a = raw
+                _DEVICE_PUT_ELIDED.inc()
+            else:
+                a = _as_jnp(raw)
             if tuple(a.shape) != tuple(aval.shape):
                 raise ServeError(
                     "decode %r input %r: shape %s does not match the "

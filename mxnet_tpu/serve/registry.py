@@ -103,7 +103,8 @@ class ModelRegistry:
         with self._lock:
             _check_not_alias()      # racing alias() may have won
             old_batcher = self._batchers.pop(name, None)
-            if name not in self._models:
+            displaced = self._models.get(name)
+            if displaced is None:
                 _MODELS_GAUGE.inc()  # delta: aggregates across registries
             self._models[name] = pred
             # ready-mark INSIDE the install lock: marking after release
@@ -120,6 +121,12 @@ class ModelRegistry:
             old_batcher.detach_state_hook()
             old_batcher.drain()
             old_batcher.close()
+        if displaced is not None and displaced is not pred:
+            # the displaced model's decode sessions are accepted work:
+            # finish or typed-fail them, release their pool blocks
+            self._drain_decoders(displaced, name)
+            for eng in list(getattr(displaced, "_decode_engines", ())):
+                eng.close()
         _obs_events.emit("serve", kind="load", model=name,
                          programs=built, warm=bool(warm),
                          buckets=list(pred.ladder.batches))
@@ -166,14 +173,51 @@ class ModelRegistry:
             self._aliases[alias] = target
             old_batcher = self._batchers.get(old) \
                 if old is not None and old != target else None
+            old_pred = self._models.get(old) \
+                if old is not None and old != target else None
         _obs_events.emit("serve", kind="alias", alias=alias,
                          model=target)
         if old_batcher is not None:
             complete = old_batcher.flush()
             _obs_events.emit("serve", kind="cutover_flush", alias=alias,
                              model=old, complete=bool(complete))
+        if old_pred is not None:
+            # decode sessions riding the old target are accepted work
+            # too: let them finish (bounded), typed-fail the rest and
+            # release their pool blocks.  Flush, not close — the old
+            # model may still serve through other aliases or its
+            # direct name (the predict path's cutover rule)
+            self._drain_decoders(old_pred, old, close=False)
 
     # -- graceful drain / teardown -----------------------------------------
+    def _drain_decoders(self, pred, name, timeout=None, drain=True,
+                        close=True):
+        """Decode half of the never-drop-accepted-work deploy
+        contract.  With *close* (unload / load-replace: the model is
+        going away) every decode batcher is drained (bounded) and
+        closed; sessions finish or typed-fail and their pool blocks
+        are released either way.  Without *close* (alias cutover: the
+        model may still be reachable through other aliases or its
+        direct name) accepted sessions are FLUSHED — they land or
+        typed-fail at the deadline — but admissions continue and the
+        batcher keeps serving, mirroring the predict path's
+        flush-not-close cutover semantics."""
+        engines = list(getattr(pred, "_decode_engines", ()))
+        for eng in engines:
+            for db in list(eng._batchers):
+                if not close:
+                    complete = db.flush(timeout)
+                    _obs_events.emit(
+                        "decode", kind="cutover_drain", model=name,
+                        batcher=db.name, complete=bool(complete))
+                    continue
+                if drain:
+                    drained = db.drain(timeout)
+                    _obs_events.emit(
+                        "decode", kind="cutover_drain", model=name,
+                        batcher=db.name, complete=bool(drained))
+                db.close()
+
     def drain(self, name, timeout=None):
         """Stop admissions to *name*'s batcher (submits raise a typed
         ServeError) and wait up to *timeout* seconds (default the
@@ -241,6 +285,13 @@ class ModelRegistry:
             # not resurrect it under the dropped name
             batcher.detach_state_hook()
             batcher.close()
+        # decode sessions drain with the model (satellite of the same
+        # never-drop-accepted-work contract): with drain=True they
+        # finish (bounded) before the typed-fail sweep; either way
+        # every pool block is released before the engine closes
+        self._drain_decoders(pred, name, timeout, drain=drain)
+        for eng in list(getattr(pred, "_decode_engines", ())):
+            eng.close()
         self._board.drop(name)
         _obs_events.emit("serve", kind="unload", model=name,
                          aliases_dropped=dropped,
@@ -309,6 +360,21 @@ class ModelRegistry:
                 closed_dirty=batcher.closed_dirty,
                 requests=batcher.request_count,
                 batches=batcher.batch_count)
+        engines = list(getattr(pred, "_decode_engines", ())) \
+            if pred is not None else []
+        if engines:
+            dbs = [db for eng in engines for db in eng._batchers]
+            info["decode"] = {
+                "sessions": sum(e.active_sessions for e in engines),
+                "kv_blocks_in_use": sum(e.pool.blocks_in_use
+                                        for e in engines),
+                "kv_blocks_total": sum(e.pool.blocks_total
+                                       for e in engines),
+                "batchers": [db.health_state() for db in dbs],
+            }
+            if info["state"] == "ready" and \
+                    any(db.unhealthy for db in dbs):
+                info["state"] = "unhealthy"
         return info
 
     def ready(self, name):
@@ -325,11 +391,22 @@ class ModelRegistry:
         not an idle queue)."""
         with self._lock:
             batchers = list(self._batchers.values())
+            preds = list(self._models.values())
         for b in batchers:
             if b.unhealthy or not b.dispatcher_alive():
                 return False
             if b.queue_depth > 0 and b.last_tick_age() > max_tick_age:
                 return False
+        for pred in preds:
+            for eng in list(getattr(pred, "_decode_engines", ())):
+                for db in list(eng._batchers):
+                    if db.unhealthy:
+                        return False
+                    if not db.stopped and not db.dispatcher_alive():
+                        return False
+                    if db.session_count > 0 and \
+                            db.last_tick_age() > max_tick_age:
+                        return False
         return True
 
     # -- request routing ---------------------------------------------------

@@ -22,7 +22,8 @@ __all__ = [
     "rand_ndarray", "rand_shape_nd", "random_arrays",
     "numeric_grad", "check_numeric_gradient",
     "check_symbolic_forward", "check_symbolic_backward",
-    "check_consistency", "list_backends",
+    "check_consistency", "list_backends", "tiny_attention_lm",
+    "dense_decode_reference",
 ]
 
 _DEFAULT_RTOL = {np.dtype(np.float16): 1e-2, np.dtype(np.float32): 1e-4,
@@ -309,3 +310,108 @@ def check_consistency(sym, location=None, shapes=None, aux_states=None,
                 err_msg="grad %r disagrees between %s and %s"
                         % (n, ref_b, b))
     return results
+
+
+# ---------------------------------------------------------------------------
+# tiny attention LM — the shared decode-workload fixture
+# ---------------------------------------------------------------------------
+
+def tiny_attention_lm(vocab=32, dim=16, seed=0, dtype="float32"):
+    """A single-head attention language model sized for CPU CI — the
+    shared fixture behind the paged-decode tests, ``bench.py
+    --serve-decode`` and ``ci/decode_smoke.py``.
+
+    Returns ``(params, step_fn, prefill_fn, token_spec, input_spec)``
+    matching the :class:`mxnet_tpu.serve.DecodeEngine` contract:
+
+    * ``step_fn(params, view, {"tok": (S,)}, pos)`` embeds the token,
+      writes its K/V **exactly at position pos**, attends causally
+      (everything past ``pos`` masked to -1e30 — positions beyond the
+      cursor hold co-tenant garbage by design) and emits the greedy
+      argmax next token, ``(S,) int32``;
+    * ``prefill_fn`` computes K/V for a whole prompt prefix in one
+      matrix product (row-wise bit-identical to the per-step path).
+
+    The greedy emission makes every decode path — dense solo, paged
+    batched ticks, speculative verify — comparable bit-for-bit on the
+    token stream.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    jdt = jnp.dtype(dtype)
+    rs = np.random.RandomState(seed)
+    params = {
+        name: jnp.asarray(rs.randn(*shape).astype(np.float32) * 0.3,
+                          jdt)
+        for name, shape in (("E", (vocab, dim)), ("Wq", (dim, dim)),
+                            ("Wk", (dim, dim)), ("Wv", (dim, dim)),
+                            ("Wo", (dim, vocab)))}
+    scale = jnp.asarray(1.0 / np.sqrt(dim), jdt)
+
+    def step_fn(p, view, inputs, pos):
+        tok = inputs["tok"]                    # (S,) int32
+        x = p["E"][tok]                        # (S, D)
+        q = x @ p["Wq"]
+        k = x @ p["Wk"]
+        v = x @ p["Wv"]
+        idx = jnp.arange(view["k"].shape[0])
+        nk = view["k"].at[idx, pos].set(k)     # write AT pos only
+        nv = view["v"].at[idx, pos].set(v)
+        seq = view["k"].shape[1]
+        scores = jnp.einsum("sd,sld->sl", q, nk) * scale
+        mask = jnp.arange(seq)[None, :] <= pos[:, None]
+        scores = jnp.where(mask, scores, jnp.asarray(-1e30, jdt))
+        probs = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum("sl,sld->sd", probs, nv)
+        logits = ctx @ p["Wo"]
+        out = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return out, {"k": nk, "v": nv}
+
+    def prefill_fn(p, inputs, length):
+        toks = inputs["tok"][0]                # (Lr,)
+        x = p["E"][toks]
+        return {"k": (x @ p["Wk"])[None], "v": (x @ p["Wv"])[None]}
+
+    token_spec = {"k": jax.ShapeDtypeStruct((dim,), jdt),
+                  "v": jax.ShapeDtypeStruct((dim,), jdt)}
+    input_spec = {"tok": jax.ShapeDtypeStruct((), jnp.int32)}
+    return params, step_fn, prefill_fn, token_spec, input_spec
+
+
+def dense_decode_reference(params, step_fn, prompt, n_new, padded_len,
+                           dim, dtype="float32", input_name="tok",
+                           cache_keys=("k", "v")):
+    """Solo dense-cache greedy decode — THE bit-equality oracle for
+    the paged decode path (tests/test_decode.py, ci/decode_smoke.py):
+    the same ``step_fn`` over ONE dense worst-case cache
+    ``(1, padded_len, dim)``, one dispatch per token.  The prompt is
+    fed token by token at ``pos = t``; the LAST prompt token's output
+    is the first generated token (matching the engine's
+    prefill-prefix + first-tick convention).  Returns the generated
+    token stream as a list of ints."""
+    import jax
+    import jax.numpy as jnp
+
+    jdt = jnp.dtype(dtype)
+    view = {k: jnp.zeros((1, padded_len, dim), jdt)
+            for k in cache_keys}
+    stepped = jax.jit(step_fn)
+    cur, t = None, 0
+    for tok in prompt:
+        out, view = stepped(
+            params, view, {input_name: jnp.asarray([tok], jnp.int32)},
+            jnp.asarray([t], jnp.int32))
+        t += 1
+        cur = int(out[0])
+    stream = []
+    for _ in range(int(n_new)):
+        stream.append(cur)
+        if len(stream) >= int(n_new):
+            break
+        out, view = stepped(
+            params, view, {input_name: jnp.asarray([cur], jnp.int32)},
+            jnp.asarray([t], jnp.int32))
+        t += 1
+        cur = int(out[0])
+    return stream
