@@ -833,6 +833,140 @@ def serve_bench(hidden=256, dim=64, classes=16,
     return out
 
 
+def serve_fleet_bench(hidden=64, dim=16, classes=8, open_rate=60.0,
+                      open_seconds=2.0, replicas=3, pool=16):
+    """``--serve-fleet``: open-loop load through the multi-replica
+    serving fleet's router at 1 vs N replicas — REAL replica
+    processes (mxnet_tpu.serve.replica) sharing one persistent XLA
+    compile cache, so replicas 2..N warm from disk.  Per-request
+    latency is measured from the request's SCHEDULED arrival slot
+    (queue wait included — no coordinated omission).  Prints ONE
+    BENCH-schema JSON line with per-stage p50/p99 + throughput and
+    asserts zero request-path compiles on every replica."""
+    import queue as _queue
+    import tempfile
+    import threading
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import model as model_mod, serve, sym
+
+    data = sym.var("data")
+    net = sym.FullyConnected(data, num_hidden=hidden, name="ffc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=classes, name="ffc2")
+    net = sym.softmax(net)
+    rs = np.random.RandomState(0)
+    arg_shapes, _, _ = net.infer_shape(data=(1, dim))
+    params = {n: mx.nd.array(rs.randn(*s).astype(np.float32) * 0.05)
+              for n, s in zip(net.list_arguments(), arg_shapes)
+              if n != "data"}
+    tmp = tempfile.mkdtemp(prefix="bench_fleet_")
+    prefix = os.path.join(tmp, "m")
+    model_mod.save_checkpoint(prefix, 1, net, params, {})
+    spec = [{"name": "m", "prefix": prefix, "epoch": 1,
+             "data_shapes": {"data": [1, dim]},
+             "batches": [1, 2, 4, 8]}]
+    reqs = [rs.randn(rs.randint(1, 5), dim).astype(np.float32)
+            for _ in range(64)]
+
+    def run_stage(fleet, n_replicas):
+        compiles_before = {k: fleet.stats(k)["compile_count"]
+                           for k in fleet.keys()}
+        n = int(open_rate * open_seconds)
+        slots = _queue.Queue()
+        t_start = time.monotonic() + 0.2
+        for i in range(n):
+            slots.put((t_start + i / open_rate, i))
+        lat = []
+        errors = []
+        lock = threading.Lock()
+
+        def worker():
+            while True:
+                try:
+                    slot, i = slots.get_nowait()
+                except _queue.Empty:
+                    return
+                delay = slot - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
+                try:
+                    fleet.router.predict("m",
+                                         {"data": reqs[i % len(reqs)]})
+                except Exception as exc:
+                    with lock:
+                        errors.append(repr(exc))
+                    return
+                with lock:
+                    # latency from the SCHEDULED arrival: a backed-up
+                    # fleet pays its queue wait here instead of
+                    # silently slowing the offered rate
+                    lat.append(time.monotonic() - slot)
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(pool)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.monotonic() - t0
+        if errors:
+            raise RuntimeError("fleet bench stage failed: %s"
+                               % "; ".join(errors[:3]))
+        request_path = 0
+        for k in fleet.keys():
+            if fleet.stats(k)["compile_count"] != \
+                    compiles_before.get(k, {}):
+                request_path += 1
+        lat.sort()
+        return {
+            "replicas": n_replicas,
+            "offered_rps": open_rate,
+            "requests": len(lat),
+            "achieved_rps": round(len(lat) / dt, 2),
+            "p50_ms": round(_percentile(lat, 50) * 1e3, 3),
+            "p99_ms": round(_percentile(lat, 99) * 1e3, 3),
+            "request_path_compiles": request_path,
+        }
+
+    fleet = serve.Fleet(spec, replicas=1, workdir=tmp, max_wait_ms=1.0,
+                        router_kwargs={"probe_interval": 0.2})
+    try:
+        t0 = time.monotonic()
+        fleet.start()
+        first_up = time.monotonic() - t0
+        stage1 = run_stage(fleet, 1)
+        t0 = time.monotonic()
+        for _ in range(replicas - 1):
+            fleet._spawn()
+        fleet.wait_routable(count=replicas)
+        scale_out = time.monotonic() - t0
+        stageN = run_stage(fleet, replicas)
+        cache_entries = len(os.listdir(fleet.compile_cache_dir))
+    finally:
+        fleet.stop()
+    request_path = stage1["request_path_compiles"] + \
+        stageN["request_path_compiles"]
+    out = {
+        "metric": "serve_fleet",
+        "value": stageN["achieved_rps"],
+        "unit": "requests/sec",
+        "model": {"hidden": hidden, "dim": dim},
+        "first_replica_up_seconds": round(first_up, 2),
+        "scale_out_seconds": round(scale_out, 2),
+        "compile_cache_entries": cache_entries,
+        "request_path_compiles": request_path,
+        "stages": [stage1, stageN],
+    }
+    print(json.dumps(out))
+    if request_path:
+        raise RuntimeError(
+            "fleet bench: %d replica(s) compiled in the request path"
+            % request_path)
+    return out
+
+
 def _decode_toy(vocab=48, dim=24, seed=0):
     from mxnet_tpu.test_utils import tiny_attention_lm
     return tiny_attention_lm(vocab=vocab, dim=dim, seed=seed)
@@ -1136,6 +1270,12 @@ def main():
         # latency distribution + aggregate tokens/sec
         _ensure_platform()
         serve_decode_bench()
+        return
+    if "--serve-fleet" in sys.argv:
+        # open-loop load through the multi-replica fleet router at
+        # 1 vs N replica processes (request_path_compiles=0 asserted)
+        _ensure_platform()
+        serve_fleet_bench()
         return
     if "--compare-input-paths" in sys.argv:
         # serial vs device-prefetched input path — a host/device

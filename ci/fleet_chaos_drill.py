@@ -1,0 +1,425 @@
+#!/usr/bin/env python
+"""Fleet-chaos drill: 3 real replica processes behind the router,
+driven through the fleet fault classes under concurrent load
+(ci/run_tests.sh stage, MXNET_SAN=all).
+
+Scenarios (see mxnet_tpu/resilience/servechaos.py for the injection
+points and docs/serving.md "Serving fleet"):
+
+  baseline   concurrent load over 3 healthy replicas: every answer
+             bit-equal to the eager forward at some rung, and the
+             replicas' dispatch counters SUM to the answered request
+             count with zero dedup hits — the exactly-once proof
+  kill       one replica armed with replica_kill_at=K dies holding a
+             request mid-load: the router fails the request over
+             (same id), every accepted request still lands bit-equal
+             or fails typed, and fleet.replace spawns a successor
+             that warms from the shared persistent compile cache
+             with ZERO new cache entries and ZERO request-path
+             compiles under traffic
+  deploy     fleet.deploy() cycles all 3 replicas onto checkpoint v2
+             under concurrent load: zero dropped/failed requests,
+             every answer bit-equal to v1 or v2, only v2 after the
+             deploy completes, and the drain record reports zero
+             abandoned work per replica
+  partition  fleet_partition_at cuts router<->replica traffic to one
+             replica: requests fail over, staleness ejects it from
+             the rotation, healing the partition rejoins it, and the
+             fleet serves through all of it with zero lost requests
+
+Cross-cutting: every submitter thread joins (nothing hangs), every
+submitted request resolves (nothing is lost), the fleet scrape
+aggregates 3 ready replicas, and the fleet event trail records
+failover/eject/rejoin/deploy.  Bounded child cleanup on any failure.
+
+Scrapeable last stdout line::
+
+    fleet: replicas=N faults=M recovered=K ok
+"""
+
+import os
+import sys
+import tempfile
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("MXNET_OBS", "fleet")
+os.environ.setdefault("MXNET_OBS_RATE", "0")
+os.environ.setdefault(
+    "MXNET_OBS_PATH",
+    os.path.join(tempfile.mkdtemp(prefix="fleet_chaos_"),
+                 "events.jsonl"))
+
+import numpy as np  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import model as model_mod  # noqa: E402
+from mxnet_tpu import sym  # noqa: E402
+from mxnet_tpu.observability import events as obs_events  # noqa: E402
+from mxnet_tpu.observability import metrics as obs_metrics  # noqa: E402
+from mxnet_tpu.resilience import chaos  # noqa: E402
+from mxnet_tpu.serve import Fleet, ServeError  # noqa: E402
+
+DIM = 8
+BATCHES = (1, 2, 4)
+REPLICAS = 3
+
+failures = []
+faults = 0
+recovered = 0
+
+
+def check(ok, msg):
+    if not ok:
+        failures.append(msg)
+    return ok
+
+
+def build_checkpoints(tmp):
+    data = sym.var("data")
+    net = sym.FullyConnected(data, num_hidden=16, name="h")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=4, name="o")
+    net = sym.softmax(net)
+    prefix = os.path.join(tmp, "m")
+    versions = {}
+    for epoch, seed in ((1, 0), (2, 1)):
+        rs = np.random.RandomState(seed)
+        arg_shapes, _, _ = net.infer_shape(data=(1, DIM))
+        params = {n: mx.nd.array(rs.randn(*s).astype(np.float32) * 0.1)
+                  for n, s in zip(net.list_arguments(), arg_shapes)
+                  if n != "data"}
+        model_mod.save_checkpoint(prefix, epoch, net, params, {})
+        versions[epoch] = params
+    return net, prefix, versions
+
+
+def eager_refs(net, params, x):
+    """x zero-padded through the eager forward at every rung it could
+    land on (bit-equality anchor, the serve drill discipline)."""
+    refs = []
+    rows = x.shape[0]
+    for b in BATCHES:
+        if b < rows:
+            continue
+        padded = np.zeros((b, DIM), x.dtype)
+        padded[:rows] = x
+        args = dict(params)
+        args["data"] = mx.nd.array(padded)
+        refs.append(net.bind(mx.cpu(), args).forward()[0]
+                    .asnumpy()[:rows])
+    return refs
+
+
+def drive(fleet, xs, refsets, threads=6, per_thread=12,
+          allow_typed=False, tag=""):
+    """Concurrent load through the router.  Returns answered count.
+    Every submitted request must resolve: bit-equal to SOME ref set,
+    or (when *allow_typed*) fail with a typed ServeError — never an
+    untyped error, never a hang."""
+    answered = [0]
+    lock = threading.Lock()
+
+    def worker(tid):
+        for i in range(per_thread):
+            idx = (tid * per_thread + i) % len(xs)
+            try:
+                out = fleet.router.predict("m", {"data": xs[idx]})
+            except ServeError as exc:
+                if not allow_typed:
+                    with lock:
+                        failures.append(
+                            "%s: worker %d request %d failed typed "
+                            "unexpectedly: %r" % (tag, tid, i, exc))
+                continue
+            except Exception as exc:    # noqa: BLE001 - the gate
+                with lock:
+                    failures.append(
+                        "%s: worker %d request %d UNTYPED failure: %r"
+                        % (tag, tid, i, exc))
+                continue
+            if not any(np.array_equal(out[0], r)
+                       for refs in refsets for r in refs[idx]):
+                with lock:
+                    failures.append(
+                        "%s: worker %d request %d not bit-equal to "
+                        "eager at any rung/version" % (tag, tid, i))
+            with lock:
+                answered[0] += 1
+
+    ts = [threading.Thread(target=worker, args=(t,), daemon=True)
+          for t in range(threads)]
+    t0 = time.monotonic()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120)
+    hung = [t for t in ts if t.is_alive()]
+    check(not hung, "%s: %d submitter thread(s) HUNG" % (tag, len(hung)))
+    return answered[0], time.monotonic() - t0
+
+
+def cache_entries(fleet):
+    try:
+        return len(os.listdir(fleet.compile_cache_dir))
+    except OSError:
+        return 0
+
+
+def scenario_baseline(fleet, xs, refs_v1):
+    global recovered
+    n, dt = drive(fleet, xs, [refs_v1], tag="baseline")
+    check(n == 6 * 12, "baseline: %d/72 answered" % n)
+    # exactly-once: with no faults, the replicas' dispatch counters
+    # sum to the answered count and nothing came from dedup
+    dispatched = 0
+    dups = 0
+    for key in fleet.keys():
+        stats = fleet.stats(key)
+        dispatched += stats["predicts_dispatched"]
+        dups += stats["dup_hits"]
+    check(dispatched == n,
+          "baseline: dispatched %d != answered %d (exactly-once)"
+          % (dispatched, n))
+    check(dups == 0, "baseline: %d unexpected dedup hits" % dups)
+    view = fleet.scrape()
+    check(view["ready"] == REPLICAS,
+          "baseline: scrape sees %d/%d ready" % (view["ready"],
+                                                 REPLICAS))
+    for key, entry in view["replicas"].items():
+        check(entry.get("scraped") and
+              "mxnet_serve_requests_total" in entry.get("metrics", {}),
+              "baseline: replica %s scrape incomplete" % key)
+    if not failures:
+        recovered += 1
+    print("  baseline: %d answered in %.1fs, %d dispatched across %d "
+          "replicas" % (n, dt, dispatched, REPLICAS))
+
+
+def scenario_kill(fleet, xs, refs_v1):
+    global faults, recovered
+    before = len(failures)
+    # replace one replica with one armed to die on its 5th predict
+    victim = fleet.keys()[0]
+    armed = fleet.replace(victim,
+                          extra_env={"MXNET_CHAOS": "replica_kill_at=5"})
+    fleet.wait_routable(count=REPLICAS)
+    n, dt = drive(fleet, xs, [refs_v1], threads=6, per_thread=10,
+                  tag="kill")
+    check(n == 60, "kill: %d/60 answered (failover must cover the "
+                   "killed replica)" % n)
+    rec = fleet.record(armed)
+    deadline = time.monotonic() + 30
+    while rec["proc"].poll() is None and time.monotonic() < deadline:
+        time.sleep(0.1)
+    check(rec["proc"].poll() == 137,
+          "kill: armed replica rc=%r, expected 137"
+          % (rec["proc"].poll(),))
+    faults += 1
+    failed_over = obs_metrics.snapshot().get(
+        "fleet_requests_failed_over_total", {}).get("value", 0)
+    check(failed_over >= 1,
+          "kill: no failover was recorded (counter=%s)" % failed_over)
+    # successor warms from the shared compile cache: zero NEW entries
+    entries_before = cache_entries(fleet)
+    successor = fleet.replace(armed)
+    fleet.wait_routable(count=REPLICAS)
+    check(cache_entries(fleet) == entries_before,
+          "kill: successor added %d compile-cache entries (expected "
+          "0 — warm start)" % (cache_entries(fleet) - entries_before))
+    # zero request-path compiles on the successor under traffic
+    warm_compiles = dict(fleet.stats(successor)["compile_count"])
+    n2, _ = drive(fleet, xs, [refs_v1], threads=4, per_thread=6,
+                  tag="kill-post")
+    check(n2 == 24, "kill: %d/24 post-replace answered" % n2)
+    check(fleet.stats(successor)["compile_count"] == warm_compiles,
+          "kill: successor compiled in the request path (%r -> %r)"
+          % (warm_compiles, fleet.stats(successor)["compile_count"]))
+    if len(failures) == before:
+        recovered += 1
+    print("  kill: %d+%d answered around a 137-kill, successor warm "
+          "from cache in-rotation" % (n, n2))
+
+
+def scenario_deploy(fleet, prefix, xs, refs_v1, refs_v2):
+    global recovered
+    before = len(failures)
+    spec_v2 = [{"name": "m", "prefix": prefix, "epoch": 2,
+                "data_shapes": {"data": [1, DIM]},
+                "batches": list(BATCHES)}]
+    stop = threading.Event()
+    load_failures = []
+    answered = [0]
+    lock = threading.Lock()
+
+    def submitter(tid):
+        n = 0
+        while not stop.is_set():
+            idx = (tid + n) % len(xs)
+            n += 1
+            try:
+                out = fleet.router.predict("m", {"data": xs[idx]})
+            except Exception as exc:    # noqa: BLE001 - the gate
+                with lock:
+                    load_failures.append("deploy: submitter %d: %r"
+                                         % (tid, exc))
+                return
+            ok = any(np.array_equal(out[0], r)
+                     for refs in (refs_v1, refs_v2)
+                     for r in refs[idx])
+            if not ok:
+                with lock:
+                    load_failures.append(
+                        "deploy: submitter %d: request %d not "
+                        "bit-equal to v1 or v2" % (tid, idx))
+                return
+            with lock:
+                answered[0] += 1
+
+    threads = [threading.Thread(target=submitter, args=(t,),
+                                daemon=True) for t in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.5)
+    entries_before = cache_entries(fleet)
+    deploys_before = obs_metrics.snapshot().get(
+        "fleet_deploys_total", {}).get("value", 0)
+    t0 = time.monotonic()
+    fleet.deploy(spec_v2)
+    deploy_dt = time.monotonic() - t0
+    time.sleep(0.5)
+    stop.set()
+    for t in threads:
+        t.join(timeout=120)
+    check(not any(t.is_alive() for t in threads),
+          "deploy: submitter thread hung")
+    failures.extend(load_failures)
+    check(answered[0] > 40,
+          "deploy: only %d requests answered under load" % answered[0])
+    check(cache_entries(fleet) == entries_before,
+          "deploy: successors added %d compile-cache entries "
+          "(expected 0 — warm start)"
+          % (cache_entries(fleet) - entries_before))
+    check(obs_metrics.snapshot()["fleet_deploys_total"]["value"]
+          == deploys_before + 1, "deploy: fleet_deploys_total did "
+          "not advance")
+    # post-deploy: ONLY v2 answers
+    for x, refs in zip(xs[:4], (refs_v2[i] for i in range(4))):
+        out = fleet.router.predict("m", {"data": x})
+        check(any(np.array_equal(out[0], r) for r in refs),
+              "deploy: post-deploy answer is not v2")
+    if len(failures) == before:
+        recovered += 1
+    print("  deploy: rolled 3 replicas to v2 in %.1fs with %d live "
+          "requests answered, 0 new cache entries"
+          % (deploy_dt, answered[0]))
+
+
+def scenario_partition(fleet, xs, refs_v2):
+    global faults, recovered
+    before = len(failures)
+    victim_key = fleet.keys()[0]
+    victim_port = fleet.record(victim_key)["port"]
+    handle = fleet.router.handle(victim_key)
+    chaos.configure(fleet_partition_at=1, fleet_partition_for=1000000,
+                    fleet_partition_port=victim_port)
+    try:
+        n, _ = drive(fleet, xs, [refs_v2], threads=4, per_thread=8,
+                     tag="partition")
+        check(n == 32, "partition: %d/32 answered during the cut" % n)
+        # staleness ejects the cut replica from the rotation
+        deadline = time.monotonic() + 20
+        while not handle.ejected and time.monotonic() < deadline:
+            time.sleep(0.1)
+        check(handle.ejected,
+              "partition: replica was never ejected on staleness")
+    finally:
+        fired = chaos.fired("fleet_partition_at")
+        chaos.reset()       # heal the partition
+    check(fired >= 1, "partition: injection never fired")
+    faults += fired
+    # probes flow again: the replica rejoins
+    deadline = time.monotonic() + 20
+    while handle.ejected and time.monotonic() < deadline:
+        time.sleep(0.1)
+    check(not handle.ejected,
+          "partition: replica did not rejoin after healing")
+    n2, _ = drive(fleet, xs, [refs_v2], threads=4, per_thread=6,
+                  tag="partition-post")
+    check(n2 == 24, "partition: %d/24 answered after rejoin" % n2)
+    # the rejoined replica serves again
+    post = fleet.stats(victim_key)["predicts_dispatched"]
+    check(post >= 1, "partition: rejoined replica served nothing")
+    if len(failures) == before:
+        recovered += 1
+    print("  partition: %d+%d answered across cut/eject/rejoin "
+          "(%d sends cut)" % (n, n2, fired))
+
+
+def check_event_trail():
+    evs = obs_events.read_events(obs_events.path())
+    kinds = {e.get("kind") for e in evs if e.get("ev") == "fleet"}
+    for expected in ("spawn", "reap", "failover", "eject", "rejoin",
+                     "deploy", "deploy_drain", "replica_drain"):
+        check(expected in kinds,
+              "event trail: no fleet %r event (have %s)"
+              % (expected, sorted(kinds)))
+    drains = [e for e in evs if e.get("ev") == "fleet"
+              and e.get("kind") == "deploy_drain"]
+    check(all(e.get("timed_out") is False and
+              e.get("waited_requests") is not None for e in drains),
+          "event trail: deploy_drain events lack the zero-abandoned "
+          "drain record")
+
+
+def main():
+    global recovered
+    tmp = tempfile.mkdtemp(prefix="fleet_drill_")
+    net, prefix, versions = build_checkpoints(tmp)
+    rs = np.random.RandomState(42)
+    xs = [rs.randn(rs.randint(1, 4), DIM).astype(np.float32)
+          for _ in range(12)]
+    refs_v1 = {i: eager_refs(net, versions[1], x)
+               for i, x in enumerate(xs)}
+    refs_v2 = {i: eager_refs(net, versions[2], x)
+               for i, x in enumerate(xs)}
+
+    spec_v1 = [{"name": "m", "prefix": prefix, "epoch": 1,
+                "data_shapes": {"data": [1, DIM]},
+                "batches": list(BATCHES)}]
+    t0 = time.monotonic()
+    fleet = Fleet(spec_v1, replicas=REPLICAS, workdir=tmp,
+                  max_wait_ms=1.0,
+                  router_kwargs={"probe_interval": 0.2,
+                                 "eject_timeout": 1.0,
+                                 "retries": 4})
+    try:
+        fleet.start()
+        print("  fleet: %d replicas up in %.1fs (%d cache entries)"
+              % (REPLICAS, time.monotonic() - t0,
+                 cache_entries(fleet)))
+        scenario_baseline(fleet, xs, refs_v1)
+        scenario_kill(fleet, xs, refs_v1)
+        scenario_deploy(fleet, prefix, xs, refs_v1, refs_v2)
+        scenario_partition(fleet, xs, refs_v2)
+        check_event_trail()
+    finally:
+        chaos.reset()
+        fleet.stop()
+
+    if failures:
+        for f in failures:
+            print("fleet drill FAILURE: %s" % f, file=sys.stderr)
+    print("fleet: replicas=%d faults=%d recovered=%d/4 %s"
+          % (REPLICAS, faults, recovered,
+             "FAIL" if failures else "ok"))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

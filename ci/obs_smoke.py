@@ -239,6 +239,58 @@ def main():
                         "the grow, got %r"
                         % (snap["kvstore_active_workers"],))
 
+    # -- serving-fleet telemetry ---------------------------------------
+    # an in-process fleet workout: one live replica + one dead
+    # address behind the router — the predict must fail over (counter
+    # + event), the probe loop must set the ready gauge, and the
+    # deploy counter must be in the catalog (ci/fleet_chaos_drill.py
+    # exercises its value; docs/observability.md)
+    import socket as _socket
+    from mxnet_tpu import serve as _serve
+    from mxnet_tpu import sym as _sym
+    fdata = _sym.var("data")
+    fnet = _sym.softmax(_sym.FullyConnected(fdata, num_hidden=4,
+                                            name="fh"))
+    fshapes, _, _ = fnet.infer_shape(data=(1, 6))
+    fparams = {n: mx.nd.array(rng.randn(*s).astype(np.float32) * 0.1)
+               for n, s in zip(fnet.list_arguments(), fshapes)
+               if n != "data"}
+    freg = _serve.ModelRegistry()
+    freg.load("fm", fnet, fparams, data_shapes={"data": (1, 6)},
+              ladder=_serve.BucketLadder(batches=(1,)))
+    frep = _serve.ReplicaServer(freg).start()
+    _dead = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+    _dead.bind(("127.0.0.1", 0))
+    dead_port = _dead.getsockname()[1]
+    _dead.close()
+    frouter = _serve.Router([("127.0.0.1", dead_port),
+                             ("127.0.0.1", frep.port)], probe=False)
+    try:
+        frouter.predict("fm", rng.randn(1, 6).astype(np.float32))
+        frouter.probe_once()
+    finally:
+        frouter.close()
+        frep.stop()
+        freg.close()
+    snap = metrics.snapshot()
+    fleet_expected = {
+        "fleet_requests_failed_over_total": lambda s: s["value"] >= 1,
+        "fleet_router_requests_total": lambda s: s["value"] >= 1,
+        "fleet_replica_requests_total": lambda s: s["value"] >= 1,
+        "fleet_replicas_ready": lambda s: s["value"] >= 1,
+        "fleet_deploys_total": lambda s: s["value"] >= 0,
+        "fleet_requests_hedged_total": lambda s: s["value"] >= 0,
+        "fleet_replica_dedup_hits_total": lambda s: s["value"] >= 0,
+    }
+    for name, check in fleet_expected.items():
+        if name not in snap:
+            failures.append("fleet instrument %r missing from the "
+                            "registry (have: %s)"
+                            % (name, sorted(snap)))
+        elif not check(snap[name]):
+            failures.append("fleet instrument %r has unexpected "
+                            "value: %r" % (name, snap[name]))
+
     # -- events.jsonl --------------------------------------------------
     ev_path = events.path()
     if not os.path.exists(ev_path):
@@ -279,6 +331,11 @@ def main():
         failures.append("decode workout should have recorded "
                         "session_start/session_end/tick events, got "
                         "kinds %s" % sorted(decode_kinds))
+    fleet_kinds = {e.get("kind") for e in evs if e.get("ev") == "fleet"}
+    if not {"replica_admit", "failover"} <= fleet_kinds:
+        failures.append("fleet workout should have recorded "
+                        "replica_admit/failover events, got kinds %s"
+                        % sorted(fleet_kinds))
 
     # -- profiler.dump carries the instruments -------------------------
     trace_path = os.path.join(_tmpdir, "trace.json")

@@ -77,6 +77,21 @@ echo "== serve: request-path chaos drill (shedding/supervision/drain) =="
 # ("servechaos: faults=.. recovered=.. ok").
 python ci/serve_chaos_drill.py
 
+echo "== serve: fleet chaos drill (3 replicas, kill/deploy/partition) =="
+# Three REAL replica processes behind the router under concurrent
+# load: a replica hard-killed mid-request (router failover, same
+# request id, dedup window), a drain-aware rolling deploy to a new
+# checkpoint (zero dropped accepted requests, successors warm from
+# the shared persistent XLA compile cache with zero new entries and
+# zero request-path compiles), and a router<->replica partition
+# (breaker opens, staleness ejects, healing rejoins).  Every accepted
+# request is answered bit-equal to the eager forward at some
+# rung/version or fails typed — never lost, never hung; bounded
+# child-process cleanup on failure (docs/serving.md "Serving
+# fleet").  Last stdout line is the scrapeable summary
+# ("fleet: replicas=.. faults=.. recovered=.. ok").
+MXNET_SAN=all python ci/fleet_chaos_drill.py
+
 echo "== resilience: chaos-injected fault drills =="
 # The resilience suite under the chaos harness: kill-mid-save,
 # corrupt-checkpoint, NaN-step, and preemption drills against the REAL

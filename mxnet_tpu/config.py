@@ -140,7 +140,8 @@ register_env("MXNET_OBS", str, "",
              "Structured run-event categories to record to "
              "events.jsonl: comma list of compile,guard,chaos,"
              "checkpoint,preempt,retry,respawn,warning,kvstore,"
-             "membership,supervisor,watchdog,serve, or 'all'; "
+             "membership,supervisor,watchdog,serve,decode,fleet, "
+             "or 'all'; "
              "empty = off (no file, zero per-event cost; see "
              "docs/observability.md)")
 register_env("MXNET_OBS_PATH", str, "events.jsonl",
@@ -316,6 +317,58 @@ register_env("MXNET_SERVE_DECODE_MAX_WAIT_MS", float, 2.0,
              "monotonic clock) so co-arriving sessions share one "
              "session-count rung from the start; once decoding, "
              "ticks run back-to-back and joins land between ticks")
+register_env("MXNET_SERVE_HTTP_PORT", int, 0,
+             "Per-replica HTTP probe port (serve.replica): serves "
+             "/metrics (Prometheus exposition of the process metrics "
+             "registry), /healthz (liveness) and /readyz (readiness "
+             "+ per-model health JSON) over stdlib http.server so "
+             "the fleet router and any external orchestrator can "
+             "scrape it; 0 = probe server off (the fleet passes an "
+             "explicit port when it spawns replicas)")
+register_env("MXNET_SERVE_HEDGE_MS", float, 0.0,
+             "Router-side request hedging: after this many "
+             "milliseconds without an answer, re-issue the still-"
+             "pending predict (SAME request id) to a second replica "
+             "— first typed answer wins, the loser is cancelled "
+             "through the replica's idempotency window so no request "
+             "is ever dispatched twice on one replica or answered "
+             "twice; 0 = hedging off")
+register_env("MXNET_SERVE_RPC_TIMEOUT", float, 60.0,
+             "Per-call socket timeout (seconds) on router->replica "
+             "RPCs: a replica that dies mid-reply surfaces as a "
+             "transport failure the router fails over, instead of "
+             "hanging the caller; 0 = no timeout")
+register_env("MXNET_SERVE_ROUTER_RETRIES", int, 3,
+             "Total transport attempts per routed request (first "
+             "try + failovers): a connection failure retries the "
+             "SAME (client, seq, incarnation) request id on the "
+             "next eligible replica — wrapping around to an "
+             "already-tried replica only when no fresh one is left, "
+             "where the dedup window answers a retried id from "
+             "cache instead of re-dispatching")
+register_env("MXNET_SERVE_BREAKER_FAILURES", int, 3,
+             "Consecutive transport failures that open one "
+             "replica's router-side circuit breaker (no requests "
+             "routed while open)")
+register_env("MXNET_SERVE_BREAKER_COOLDOWN", float, 1.0,
+             "Seconds an open circuit breaker waits before letting "
+             "ONE half-open trial request through; success closes "
+             "the breaker, failure re-opens it for another cooldown")
+register_env("MXNET_SERVE_FLEET_HEARTBEAT", float, 0.5,
+             "Router health-probe cadence (seconds): each replica "
+             "is probed with a HEALTH RPC this often, feeding "
+             "readiness-aware routing and heartbeat-staleness "
+             "ejection")
+register_env("MXNET_SERVE_EJECT_TIMEOUT", float, 5.0,
+             "Seconds without a successful health probe before the "
+             "router ejects a replica from the rotation (breaker "
+             "forced open); the next successful probe rejoins it")
+register_env("MXNET_SERVE_DEDUP_WINDOW", int, 256,
+             "Per-client replica-side idempotency window: how many "
+             "recent predict request ids each replica remembers so "
+             "a retried or hedged RPC is answered from cache "
+             "instead of re-dispatched (in-flight entries are "
+             "never trimmed)")
 
 
 def enable_compile_cache():
@@ -337,4 +390,13 @@ def enable_compile_cache():
     # tiny programs matter for the serve ladder: do not skip them on
     # size either
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    # jax latches cache initialization on the FIRST compile: enabling
+    # the dir after any jax use in the process (tests, a server that
+    # reads config late) would silently cache nothing.  Drop the
+    # latch so the next compile re-initializes against the new dir.
+    try:
+        from jax._src import compilation_cache as _cc
+        _cc.reset_cache()
+    except (ImportError, AttributeError):  # layout drift: import-time
+        pass                               # enablement still works
     return True

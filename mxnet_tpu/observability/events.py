@@ -57,7 +57,7 @@ __all__ = ["enabled", "emit", "emitter", "watch_jit", "configure",
 
 _CATEGORIES = ("compile", "guard", "chaos", "checkpoint", "preempt",
                "retry", "respawn", "warning", "kvstore", "membership",
-               "supervisor", "watchdog", "serve", "decode")
+               "supervisor", "watchdog", "serve", "decode", "fleet")
 
 
 def _spec():

@@ -35,18 +35,48 @@ programmatic ``chaos.configure``).  Spec keys (all integers):
     :class:`~mxnet_tpu.serve.buckets.ServeError` — a model whose
     load/warm fails must never half-register.
 
-See ci/serve_chaos_drill.py for the drill that exercises every class.
+Fleet-scope keys (PR: multi-replica serving).  The replica-side
+points are consulted by :class:`~mxnet_tpu.serve.replica.ReplicaServer`
+connection handlers (arm them through a replica process's own
+``MXNET_CHAOS`` env); the router-side point by
+:meth:`~mxnet_tpu.serve.router.Router` right before a frame goes out
+on a replica socket (arm via ``chaos.configure`` in the router's
+process):
+
+``replica_kill_at=K``
+    The replica process hard-exits (``os._exit(137)``, patchable
+    ``_exit`` seam) on receiving its K-th PREDICT request — BEFORE
+    dispatch, so the router sees the connection die mid-request and
+    must fail the request over to another replica.
+``slow_replica_ms=X`` (+ optional ``slow_replica_for=N``)
+    Every PREDICT (or the first N with ``slow_replica_for``) sleeps
+    X milliseconds before dispatch — the straggling-replica bait for
+    request hedging and breaker drills.
+``fleet_partition_at=K`` (+ optional ``fleet_partition_for=N``,
+``fleet_partition_port=P``)
+    The K-th (through K+N-1-th) router->replica send raises
+    ``ConnectionError`` without touching the wire — a router<->replica
+    network partition; the router must fail over, the breaker must
+    open, and the replica must rejoin once probes get through again.
+    With ``fleet_partition_port=P`` only sends to the replica on port
+    P count (and are cut), so a drill partitions ONE replica
+    deterministically while probes to the others flow.
+
+See ci/serve_chaos_drill.py and ci/fleet_chaos_drill.py for the
+drills that exercise every class.
 """
 
 from __future__ import annotations
 
 import logging
+import os
 import time
 
 from . import chaos
 from .. import sanitizer as _san
 
-__all__ = ["on_dispatch", "on_warm", "release_hangs", "reset_hangs"]
+__all__ = ["on_dispatch", "on_warm", "on_replica_request",
+           "on_router_send", "release_hangs", "reset_hangs"]
 
 log = logging.getLogger(__name__)
 
@@ -104,6 +134,65 @@ def on_dispatch(name):
         sleep = _hang_sleep or (lambda s: _hang_release.wait(s))
         while not _hang_release.is_set():
             sleep(0.02)
+
+
+# patchable seam so unit tests can assert the kill without dying
+# (mirrors chaos._exit / netchaos._exit)
+_exit = os._exit
+
+
+def on_replica_request(replica):
+    """Replica-side fleet choke point, consulted by the replica's
+    connection handler for every PREDICT request BEFORE it reaches
+    the registry.  ``replica_kill_at=K`` hard-exits the process on
+    the K-th request (the router must fail over mid-request);
+    ``slow_replica_ms`` makes this replica a straggler (hedging /
+    breaker bait)."""
+    if not chaos.enabled():
+        return
+    spec = chaos.active()
+    kill_at = spec.get("replica_kill_at")
+    slow = spec.get("slow_replica_ms")
+    if kill_at is None and slow is None:
+        return
+    n = chaos.tick("replica_predict")
+    if slow and n <= spec.get("slow_replica_for", 1 << 62):
+        chaos.note_injection("slow_replica_ms", at=n, replica=replica)
+        time.sleep(slow / 1000.0)
+    if kill_at is not None and n == kill_at:
+        chaos.note_injection("replica_kill_at", at=n, replica=replica)
+        log.warning("servechaos: hard-killing replica %r at predict "
+                    "%d", replica, n)
+        _exit(137)
+
+
+def on_router_send(replica, port=None):
+    """Router-side fleet choke point, consulted right before a frame
+    goes out on a replica socket.  ``fleet_partition_at=K`` (+
+    ``fleet_partition_for=N``) simulates a router<->replica network
+    partition: the send raises ``ConnectionError`` without touching
+    the wire, so the router's failover/breaker path runs exactly as
+    it would on a real partition.  ``fleet_partition_port=P``
+    restricts the cut (and its tick counter) to the replica on port
+    P."""
+    if not chaos.enabled():
+        return
+    spec = chaos.active()
+    at = spec.get("fleet_partition_at")
+    if at is None:
+        return
+    pfilter = spec.get("fleet_partition_port")
+    if pfilter and port != pfilter:
+        return
+    n = chaos.tick("fleet_send")
+    if at <= n < at + spec.get("fleet_partition_for", 1):
+        chaos.note_injection("fleet_partition_at", at=n,
+                             replica=replica)
+        log.warning("servechaos: partitioning router<->replica %r at "
+                    "send %d", replica, n)
+        raise ConnectionError(
+            "servechaos: injected router<->replica partition "
+            "(send %d, replica %r)" % (n, replica))
 
 
 def on_warm(model):

@@ -26,7 +26,17 @@ Production serving for models built with this framework:
   program cache, drain-before-teardown (decode sessions included),
   and the ``health``/``ready``/``live`` probe surface backed by
   :class:`HealthBoard` (registry.py, health.py); :func:`c_registry`
-  is the process-wide instance the C predict ABI routes through.
+  is the process-wide instance the C predict ABI routes through;
+* :class:`ReplicaServer` / :class:`Router` / :class:`Fleet` — the
+  multi-replica fleet: a replica process wraps a registry behind the
+  kvstore wire framing with idempotent ``(client, seq, incarnation)``
+  predicts and an HTTP probe endpoint; the router spreads load with
+  retry-with-failover, per-replica circuit breakers
+  (:class:`CircuitBreaker`), heartbeat-staleness ejection and opt-in
+  request hedging; the fleet spawns/replaces replica processes
+  (warming from the shared persistent XLA compile cache) and runs
+  drain-aware rolling deploys that drop zero accepted requests
+  (replica.py, router.py, fleet.py).
 
 See docs/serving.md for the architecture, fault-tolerance semantics,
 knobs and metrics catalog.
@@ -41,10 +51,16 @@ from .batcher import DynamicBatcher, ServeFuture  # noqa: F401
 from .decode import (DecodeBatcher, DecodeEngine,  # noqa: F401
                      PagedSession, SpeculativeDecoder)
 from .registry import ModelRegistry, c_registry  # noqa: F401
+from .replica import (ReplicaDraining, ReplicaServer,  # noqa: F401
+                      start_http_probe)
+from .router import CircuitBreaker, ReplicaHandle, Router  # noqa: F401
+from .fleet import Fleet  # noqa: F401
 
 __all__ = ["BucketLadder", "ServeError", "OverloadError",
            "DeadlineExceededError", "RequestCancelled",
            "CompiledPredictor", "DecodeSession", "DynamicBatcher",
            "ServeFuture", "ModelRegistry", "c_registry", "HealthBoard",
            "STATES", "KVPool", "KVPoolExhausted", "DecodeEngine",
-           "DecodeBatcher", "PagedSession", "SpeculativeDecoder"]
+           "DecodeBatcher", "PagedSession", "SpeculativeDecoder",
+           "ReplicaServer", "ReplicaDraining", "start_http_probe",
+           "CircuitBreaker", "ReplicaHandle", "Router", "Fleet"]

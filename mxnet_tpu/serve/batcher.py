@@ -267,6 +267,7 @@ class DynamicBatcher:
         self._batches = 0
         self._requests = 0
         self._restarts_used = 0
+        self._last_drain_stats = None
         self._last_tick = _time.monotonic()
         # the shared jittered backoff schedule of resilience.retry;
         # one delay per crash-restart (tests patch _restart_sleep)
@@ -280,7 +281,8 @@ class DynamicBatcher:
         _san.track(self, ("_pending", "_rows_pending", "_bytes_pending",
                           "_flush_horizon", "_inflight", "_stopped",
                           "_draining", "_unhealthy", "_closed_dirty",
-                          "_batches", "_requests", "_restarts_used"),
+                          "_batches", "_requests", "_restarts_used",
+                          "_last_drain_stats"),
                    label="serve.batcher.%s" % self.name)
         self._thread.start()
 
@@ -319,6 +321,29 @@ class DynamicBatcher:
     def closed_dirty(self):
         with self._lock:
             return self._closed_dirty
+
+    def _accepted_locked(self):
+        """Requests the batcher currently OWES an answer: queued (not
+        cancelled) plus the in-flight batch.  Caller holds the lock."""
+        return (sum(1 for r in self._pending if not r.cancelled)
+                + len(self._inflight))
+
+    @property
+    def accepted_count(self):
+        """The work a drain would have to wait on, right now."""
+        with self._lock:
+            return self._accepted_locked()
+
+    @property
+    def last_drain_stats(self):
+        """Machine-readable record of the most recent :meth:`drain`:
+        ``{"waited_requests": N, "timed_out": bool}`` (None before
+        any drain).  The registry's ``drain_complete`` event and the
+        fleet's rolling deploy gate on this instead of inferring
+        'drain completed with zero abandoned work' from counters."""
+        with self._lock:
+            return dict(self._last_drain_stats) \
+                if self._last_drain_stats is not None else None
 
     def dispatcher_alive(self):
         """Is the dispatcher thread running (restarts included)?"""
@@ -775,12 +800,33 @@ class DynamicBatcher:
         deadline = _time.monotonic() + max(0.0, float(timeout))
         with self._cond:
             self._draining = True
+            # the drain's machine-readable record: how many accepted
+            # requests it had to wait on, and whether it timed out —
+            # rolling deploys gate on "zero abandoned work" from this
+            # instead of inferring it from counters
+            waited = self._accepted_locked()
             self._cond.notify_all()
+            timed_out = False
             while self._pending or self._inflight:
                 remaining = deadline - _time.monotonic()
                 if remaining <= 0:
-                    return False
+                    timed_out = True
+                    break
                 self._cond.wait(timeout=remaining)
+            self._last_drain_stats = {"waited_requests": waited,
+                                      "timed_out": timed_out}
+        return not timed_out
+
+    def undrain(self):
+        """Resume admissions after a drain (an aborted rolling deploy
+        must hand the replica back to service, not leave it shedding
+        forever).  No-op on a closed or unhealthy batcher.  Returns
+        True when admissions are open again."""
+        with self._cond:
+            if self._stopped or self._unhealthy:
+                return False
+            self._draining = False
+            self._cond.notify_all()
         return True
 
     def flush(self, timeout=None):

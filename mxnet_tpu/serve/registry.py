@@ -235,8 +235,61 @@ class ModelRegistry:
         _obs_events.emit("serve", kind="drain", model=target,
                          mode="drain")
         if batcher is None:
+            _obs_events.emit("serve", kind="drain_complete",
+                             model=target, mode="drain",
+                             waited_requests=0, timed_out=False)
             return True
-        return batcher.drain(timeout)
+        drained = batcher.drain(timeout)
+        stats = batcher.last_drain_stats or {}
+        # the machine-readable drain record (satellite contract): the
+        # rolling deploy and the fleet drill gate on "drain completed
+        # with zero abandoned work" from this event, not counters
+        _obs_events.emit("serve", kind="drain_complete", model=target,
+                         mode="drain",
+                         waited_requests=stats.get("waited_requests", 0),
+                         timed_out=bool(stats.get("timed_out",
+                                                  not drained)))
+        return drained
+
+    def drain_all(self, timeout=None):
+        """Drain every loaded model (the replica's pre-deploy RPC):
+        stops admissions model by model and waits (bounded) for the
+        accepted requests.  Returns an aggregate machine-readable
+        record ``{"models": N, "waited_requests": total,
+        "timed_out": any}`` — the fleet's rolling deploy proceeds
+        only when ``timed_out`` is False (zero abandoned work)."""
+        waited = 0
+        timed_out = False
+        names = self.names()
+        for name in names:
+            drained = self.drain(name, timeout)
+            with self._lock:
+                batcher = self._batchers.get(self._resolve(name))
+            stats = (batcher.last_drain_stats or {}) \
+                if batcher is not None else {}
+            waited += int(stats.get("waited_requests", 0))
+            timed_out = timed_out or not drained
+        return {"models": len(names), "waited_requests": waited,
+                "timed_out": timed_out}
+
+    def resume_all(self):
+        """Undo :meth:`drain_all`: reopen admissions on every drained
+        model and mark it ready again (the aborted-deploy recovery
+        path — a replica whose drain timed out must return to
+        service, not shed forever).  Models whose batcher is closed
+        or unhealthy are left alone.  Returns the resumed names."""
+        resumed = []
+        for name in self.names():
+            with self._lock:
+                target = self._resolve(name)
+                batcher = self._batchers.get(target)
+            if batcher is not None and not batcher.undrain():
+                continue
+            if self._board.state(target) == "draining":
+                self._board.transition(target, "ready")
+            resumed.append(target)
+            _obs_events.emit("serve", kind="resume", model=target)
+        return resumed
 
     def unload(self, name, drain=True, timeout=None):
         """Drop a model (or just an alias).  Unloading a model also
@@ -264,6 +317,12 @@ class ModelRegistry:
             _obs_events.emit("serve", kind="drain", model=name,
                              mode="unload")
             drained = batcher.drain(timeout)
+            stats = batcher.last_drain_stats or {}
+            _obs_events.emit(
+                "serve", kind="drain_complete", model=name,
+                mode="unload",
+                waited_requests=stats.get("waited_requests", 0),
+                timed_out=bool(stats.get("timed_out", not drained)))
         with self._lock:
             if self._models.get(name) is not pred:
                 # lost the race to a concurrent load/unload.  If OUR
