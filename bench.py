@@ -708,7 +708,8 @@ def _percentile(sorted_vals, q):
 
 def serve_bench(hidden=256, dim=64, classes=16,
                 closed_threads=8, closed_requests=40,
-                open_rate=150.0, open_seconds=2.0, max_wait_ms=1.0):
+                open_rate=150.0, open_seconds=2.0, max_wait_ms=1.0,
+                record_trace=None, trace=None):
     """``--serve``: load test of the compiled serving subsystem
     (mxnet_tpu/serve): one warm-compiled model behind the dynamic
     batcher, driven closed-loop (N threads, back-to-back requests —
@@ -716,11 +717,31 @@ def serve_bench(hidden=256, dim=64, classes=16,
     latency distribution under load, which closed-loop hides by
     coordinated omission).  Mixed request sizes (1-4 rows) exercise
     the coalescing + padding path.  Prints ONE BENCH-schema JSON line
-    with p50/p99 latency and throughput and returns the dict."""
+    with p50/p99 latency and throughput and returns the dict.
+
+    ``--record-trace PATH`` serializes the open-loop arrival schedule
+    (request sizes + offsets) as an autotune trace;
+    ``--trace PATH`` replays a recorded trace as the open loop
+    instead of the synthetic grid — the same load the autotuner
+    scored, so bench numbers and tuning artifacts are comparable.
+    When a ``MXNET_TUNING_STORE`` entry exists for model "bench", the
+    hand-picked ladder/window defaults are NOT passed, so the tuned
+    config applies (precedence: env > store > default) and the
+    ``tuning`` field reports what was picked up."""
     import threading
 
     import mxnet_tpu as mx
     from mxnet_tpu import serve, sym
+    from mxnet_tpu.autotune import trace as _at
+    from mxnet_tpu.autotune.store import lookup as _at_lookup
+
+    tr = None
+    if trace is not None:
+        tr = _at.Trace.load(trace)
+        if tr.kind != "serve":
+            raise ValueError("bench --serve needs a serve trace, got "
+                             "kind=%r" % tr.kind)
+        dim = int(tr.meta.get("dim", dim))
 
     data = sym.var("data")
     net = sym.FullyConnected(data, num_hidden=hidden, name="sfc1")
@@ -734,12 +755,18 @@ def serve_bench(hidden=256, dim=64, classes=16,
               if n != "data"}
 
     registry = serve.ModelRegistry()
-    ladder = serve.BucketLadder(batches=(1, 2, 4, 8, 16))
+    # with a tuned-store entry for "bench", leave ladder/window unset
+    # so the tuning applies (env > store > default); otherwise use the
+    # bench's hand-picked defaults
+    tuned = _at_lookup("bench", "serve")
+    ladder = None if tuned else \
+        serve.BucketLadder(batches=(1, 2, 4, 8, 16))
     t0 = time.perf_counter()
     pred = registry.load("bench", net, params,
                          data_shapes={"data": (1, dim)}, ladder=ladder)
     warm_s = time.perf_counter() - t0
-    batcher = registry.batcher("bench", max_wait_ms=max_wait_ms)
+    batcher = registry.batcher(
+        "bench", max_wait_ms=None if tuned else max_wait_ms)
     compiles_after_warm = pred.compile_count
 
     reqs = [rs.randn(rs.randint(1, 5), dim).astype(np.float32)
@@ -780,23 +807,46 @@ def serve_bench(hidden=256, dim=64, classes=16,
     closed_n = closed_threads * closed_requests
 
     # -- open loop: fixed arrival rate ----------------------------------
-    futures = []
-    period = 1.0 / open_rate
-    t_start = time.monotonic()
-    n_open = int(open_rate * open_seconds)
-    for i in range(n_open):
-        slot = t_start + i * period
-        delay = slot - time.monotonic()
-        if delay > 0:
-            time.sleep(delay)
-        x = reqs[i % len(reqs)]
-        futures.append((time.monotonic(), batcher.submit(x)))
-    for _, fut in futures:
-        fut.result(60)
-    open_dt = time.monotonic() - t_start
-    # each future stamps its own resolution time — submit->resolve is
-    # the true per-request latency even though collection is serial
-    lat_open = [fut._t_resolved - t_sub for t_sub, fut in futures]
+    if tr is not None:
+        # replay the recorded trace — identical offsets + request
+        # sizes the autotuner scored, payloads rematerialized from
+        # the trace seed
+        records, open_dt = _at.replay(
+            tr, lambda x, _i: batcher.submit(x))
+        for _slot, _t_sub, fut in records:
+            fut.result(60)
+        lat_open = [fut._t_resolved - t_sub
+                    for _slot, t_sub, fut in records]
+        n_open = len(records)
+        open_rate = round((n_open - 1) / max(tr.duration(), 1e-9), 2)
+    else:
+        futures = []
+        period = 1.0 / open_rate
+        t_start = time.monotonic()
+        n_open = int(open_rate * open_seconds)
+        for i in range(n_open):
+            slot = t_start + i * period
+            delay = slot - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            x = reqs[i % len(reqs)]
+            futures.append((time.monotonic(), batcher.submit(x)))
+        for _, fut in futures:
+            fut.result(60)
+        open_dt = time.monotonic() - t_start
+        # each future stamps its own resolution time — submit->resolve
+        # is the true per-request latency even though collection is
+        # serial
+        lat_open = [fut._t_resolved - t_sub for t_sub, fut in futures]
+
+    if record_trace:
+        rec = tr if tr is not None else _at.Trace(
+            "serve",
+            [{"t": round(i / open_rate, 6),
+              "rows": int(reqs[i % len(reqs)].shape[0])}
+             for i in range(n_open)],
+            {"dim": dim, "rate": open_rate}, seed=0)
+        rec.save(record_trace)
 
     lat_closed.sort()
     lat_open.sort()
@@ -805,7 +855,9 @@ def serve_bench(hidden=256, dim=64, classes=16,
         "value": round(closed_n / closed_dt, 2),
         "unit": "requests/sec",
         "model": {"hidden": hidden, "dim": dim,
-                  "buckets": list(ladder.batches)},
+                  "buckets": list(pred.ladder.batches)},
+        "tuning": (pred.tuning or {}).get("config"),
+        "trace": tr.summary() if tr is not None else None,
         "warm_compile_seconds": round(warm_s, 3),
         "programs_compiled": compiles_after_warm,
         "request_path_compiles": pred.compile_count - compiles_after_warm,
@@ -1098,7 +1150,7 @@ def compare_decode_paths(sessions=16, prompt_len=16, new_tokens=32,
 
 def serve_decode_bench(rate=12.0, seconds=3.0, prompt_lo=4,
                        prompt_hi=24, new_tokens=24, vocab=48, dim=24,
-                       block_size=8):
+                       block_size=8, record_trace=None, trace=None):
     """``--serve-decode``: open-loop many-session decode load — new
     sessions arrive on a fixed schedule (no coordinated omission: the
     arrival grid never waits for the system), each decodes
@@ -1107,19 +1159,58 @@ def serve_decode_bench(rate=12.0, seconds=3.0, prompt_lo=4,
     token is stamped when its tick resolves, not when the client gets
     scheduled).  Prints ONE BENCH-schema JSON line with p50/p99 token
     latency, p50/p99 time-to-first-token, aggregate tokens/sec and
-    request_path_compiles."""
+    request_path_compiles.
+
+    ``--record-trace PATH`` serializes the session-arrival schedule
+    (prompt lengths + offsets) as an autotune trace; ``--trace PATH``
+    replays one instead of the synthetic grid.  A tuned-store entry
+    for model "bench-open" (workload decode) overrides the
+    hand-picked block size / session rungs / tick window."""
     import warnings
 
+    from mxnet_tpu.autotune import trace as _at
+    from mxnet_tpu.autotune.store import lookup as _at_lookup
     from mxnet_tpu.serve.decode import DecodeBatcher, DecodeEngine
+
+    tr = None
+    if trace is not None:
+        tr = _at.Trace.load(trace)
+        if tr.kind != "decode":
+            raise ValueError("bench --serve-decode needs a decode "
+                             "trace, got kind=%r" % tr.kind)
+        vocab = int(tr.meta.get("vocab", vocab))
+        new_tokens = int(tr.meta.get("new_tokens", new_tokens))
+        prompts = tr.payloads()
+        prompt_hi = max(p.shape[0] for p in prompts)
+        n_sessions = len(prompts)
+        rate = round((n_sessions - 1) / max(tr.duration(), 1e-9), 2)
+    else:
+        n_sessions = int(rate * seconds)
+        rs = np.random.RandomState(5)
+        prompts = [rs.randint(0, vocab,
+                              size=rs.randint(prompt_lo,
+                                              prompt_hi + 1))
+                   .astype(np.int32) for _ in range(n_sessions)]
+    if record_trace:
+        rec = tr if tr is not None else _at.Trace(
+            "decode",
+            [{"t": round(i / rate, 6), "prompt_len": int(p.shape[0])}
+             for i, p in enumerate(prompts)],
+            {"vocab": vocab, "new_tokens": new_tokens, "rate": rate},
+            seed=5)
+        rec.save(record_trace)
 
     params, step_fn, prefill_fn, token_spec, input_spec = _decode_toy(
         vocab=vocab, dim=dim)
     max_len = prompt_hi + new_tokens + 1
-    n_sessions = int(rate * seconds)
-    rs = np.random.RandomState(5)
-    prompts = [rs.randint(0, vocab,
-                          size=rs.randint(prompt_lo, prompt_hi + 1))
-               .astype(np.int32) for _ in range(n_sessions)]
+    # tuned-store pickup (docs/autotuning.md): an entry for
+    # ("bench-open", decode) replaces the hand-picked knobs
+    tuned = _at_lookup("bench-open", "decode")
+    tcfg = (tuned or {}).get("config") or {}
+    if tuned:
+        block_size = int(tcfg.get("MXNET_SERVE_KV_BLOCK_SIZE")
+                         or block_size)
+    session_rungs = tuple(tcfg.get("ladder") or (1, 2, 4, 8, 16, 32))
 
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")
@@ -1127,28 +1218,39 @@ def serve_decode_bench(rate=12.0, seconds=3.0, prompt_lo=4,
             step_fn, prefill_fn, token_spec, input_spec, params=params,
             max_len=max_len, block_size=block_size,
             num_blocks=n_sessions * (-(-max_len // block_size)) + 2,
-            session_rungs=(1, 2, 4, 8, 16, 32), donate=True,
+            session_rungs=session_rungs, donate=True,
             label="bench-open")
         warm_compiles = engine.compile_count
-        batcher = DecodeBatcher(engine, max_wait_ms=1.0)
+        batcher = DecodeBatcher(
+            engine, max_wait_ms=None if tuned else 1.0)
 
-        period = 1.0 / rate
-        t_start = time.monotonic()
-        arrivals = []     # (submit stamp, session)
-        shed = 0
-        for i in range(n_sessions):
-            slot = t_start + i * period
-            delay = slot - time.monotonic()
-            if delay > 0:
-                time.sleep(delay)
-            t_sub = time.monotonic()
+        shed_box = [0]
+
+        def _start(prompt, _i):
             try:
-                s = batcher.start({"tok": prompts[i]},
-                                  max_new_tokens=new_tokens)
+                return batcher.start({"tok": prompt},
+                                     max_new_tokens=new_tokens)
             except Exception:
-                shed += 1
-                continue
-            arrivals.append((t_sub, s))
+                shed_box[0] += 1
+                return None
+
+        t_start = time.monotonic()
+        if tr is not None:
+            records, _replay_wall = _at.replay(tr, _start)
+        else:
+            period = 1.0 / rate
+            records = []
+            for i in range(n_sessions):
+                slot = t_start + i * period
+                delay = slot - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
+                t_sub = time.monotonic()
+                records.append((i * period, t_sub,
+                                _start(prompts[i], i)))
+        arrivals = [(t_sub, s) for _slot, t_sub, s in records
+                    if s is not None]
+        shed = shed_box[0]
         for _, s in arrivals:
             s.result(120)
         wall = time.monotonic() - t_start
@@ -1188,6 +1290,8 @@ def serve_decode_bench(rate=12.0, seconds=3.0, prompt_lo=4,
         "ttft_p99_ms": round(_percentile(ttft, 99) * 1e3, 3)
         if ttft else None,
         "request_path_compiles": request_path_compiles,
+        "tuning": tcfg or None,
+        "trace": tr.summary() if tr is not None else None,
     }
     print(json.dumps(out))
     return out
@@ -1240,6 +1344,17 @@ def decompose_main():
     return 0
 
 
+def _argv_path(flag):
+    """Value of ``flag PATH`` in sys.argv, or None (bench's dispatch
+    is flag-sniffing, not argparse — keep trace flags the same)."""
+    if flag not in sys.argv:
+        return None
+    i = sys.argv.index(flag)
+    if i + 1 >= len(sys.argv) or sys.argv[i + 1].startswith("--"):
+        raise SystemExit("bench: %s needs a path" % flag)
+    return sys.argv[i + 1]
+
+
 def main():
     if "--serve" in sys.argv:
         # serving load test: throughput + latency of the compiled
@@ -1247,7 +1362,8 @@ def main():
         # rules match the training bench (_ensure_platform): a TPU
         # target is health-probed, CPU needs BENCH_ALLOW_CPU=1.
         _ensure_platform()
-        serve_bench()
+        serve_bench(record_trace=_argv_path("--record-trace"),
+                    trace=_argv_path("--trace"))
         return
     if "--decompose" in sys.argv:
         return decompose_main()
@@ -1269,7 +1385,8 @@ def main():
         # open-loop many-session continuous-batching decode load;
         # latency distribution + aggregate tokens/sec
         _ensure_platform()
-        serve_decode_bench()
+        serve_decode_bench(record_trace=_argv_path("--record-trace"),
+                           trace=_argv_path("--trace"))
         return
     if "--serve-fleet" in sys.argv:
         # open-loop load through the multi-replica fleet router at

@@ -291,6 +291,55 @@ def main():
             failures.append("fleet instrument %r has unexpected "
                             "value: %r" % (name, snap[name]))
 
+    # -- autotune telemetry --------------------------------------------
+    # a tiny stubbed search through the REAL tune() loop: the trial /
+    # prune counters must advance and the 'autotune' event kinds must
+    # land in events.jsonl (ci/autotune_smoke.py runs a measured
+    # search against real serving machinery — here the contract is
+    # the telemetry; docs/autotuning.md)
+    from mxnet_tpu.autotune import serve_space, synth_serve_trace, tune
+    from mxnet_tpu.autotune.search import serve_objective
+    at_trace = synth_serve_trace(rate=40, seconds=0.5, dim=4)
+
+    class _ATStub(object):
+        trace = at_trace
+
+        @staticmethod
+        def _est(config):
+            return (float(config["MXNET_SERVE_MAX_WAIT_MS"])
+                    + len(config["ladder"]))
+
+        def measure(self, config, budget_frac=1.0):
+            return {"ok": True, "offered_rps": 40.0,
+                    "achieved_rps": 40.0, "p99_ms": self._est(config),
+                    "request_path_compiles": 0}
+
+        def prior(self, config, budget_frac=1.0):
+            return self._est(config)
+
+    at_result = tune(serve_space(), _ATStub(), serve_objective(),
+                     model="obs-at", workload="serve", trials=8,
+                     neighbor_trials=2, seed=0, prune_ratio=1.2,
+                     min_keep=2, device="cpu")
+    snap = metrics.snapshot()
+    at_expected = {
+        "autotune_trials_total":
+            lambda s: s["value"] == at_result["trials"],
+        "autotune_prune_total":
+            lambda s: s["value"] == at_result["pruned"]
+            and s["value"] >= 1,
+    }
+    for name, check in at_expected.items():
+        if name not in snap:
+            failures.append("autotune instrument %r missing from the "
+                            "registry (have: %s)"
+                            % (name, sorted(snap)))
+        elif not check(snap[name]):
+            failures.append("autotune instrument %r has unexpected "
+                            "value: %r (result trials=%d pruned=%d)"
+                            % (name, snap[name], at_result["trials"],
+                               at_result["pruned"]))
+
     # -- events.jsonl --------------------------------------------------
     ev_path = events.path()
     if not os.path.exists(ev_path):
@@ -336,6 +385,14 @@ def main():
         failures.append("fleet workout should have recorded "
                         "replica_admit/failover events, got kinds %s"
                         % sorted(fleet_kinds))
+    at_kinds = {e.get("kind") for e in evs
+                if e.get("ev") == "autotune"}
+    if not {"trial_start", "trial_result", "pruned", "promoted",
+            "winner"} <= at_kinds:
+        failures.append("autotune workout should have recorded "
+                        "trial_start/trial_result/pruned/promoted/"
+                        "winner events, got kinds %s"
+                        % sorted(at_kinds))
 
     # -- profiler.dump carries the instruments -------------------------
     trace_path = os.path.join(_tmpdir, "trace.json")

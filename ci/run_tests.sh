@@ -63,6 +63,19 @@ echo "== serve: continuous-batching decode drill (paged KV pool) =="
 # stdout line: "decode: sessions=.. ticks=.. compiles=.. ok".
 MXNET_SAN=all python ci/decode_smoke.py
 
+echo "== perf: autotune smoke (measured search + store pickup) =="
+# A real successive-halving search over the serve knob space against
+# a short synthetic trace (tiny FC model, ~8 candidates, analytic-
+# prior pruning), sanitizers on: asserts the search completes, the
+# winner is never worse than the measured default on the same trace
+# (baseline guard), zero request-path compiles in every replay, the
+# TuningStore round-trips with the trace identity + measurement
+# artifact, and a fresh registry under MXNET_TUNING_STORE applies
+# the winning config and serves the same trace with zero request-
+# path compiles (docs/autotuning.md).  Last stdout line is the
+# scrapeable summary ("autotune: trials=.. pruned=.. ...").
+MXNET_SAN=all python ci/autotune_smoke.py
+
 echo "== serve: request-path chaos drill (shedding/supervision/drain) =="
 # The serving request path through every injected fault class —
 # overload (slow dispatches vs a bounded queue), deadline expiry

@@ -7,6 +7,15 @@ every knob this framework consults is declared here with type, default,
 and doc; ``describe()`` prints the env-var reference table the way
 docs/faq/env_var.md documents the reference's.  Values are read at call
 time (not import time) so tests can monkeypatch the environment.
+
+Three layers resolve every read, in precedence order
+(docs/autotuning.md):
+
+1. **explicit env** — the variable is exported in ``os.environ``;
+   an operator's export always wins,
+2. **tuned override** — a value installed by :func:`tuned_override`
+   (the autotuner's ``TuningStore`` applies winning configs here),
+3. **registered default** — the ``register_env`` declaration.
 """
 
 from __future__ import annotations
@@ -14,9 +23,16 @@ from __future__ import annotations
 import os
 
 __all__ = ["register_env", "get_env", "list_env", "describe",
-           "enable_compile_cache"]
+           "tuned_override", "tuned_overrides", "clear_tuned",
+           "resolve_env", "env_is_set", "enable_compile_cache"]
 
 _REGISTRY = {}
+
+# the tuned-override layer: knob name -> typed value.  Sits BETWEEN
+# the environment and the registered default — get_env consults it
+# only when the env var is not exported, so a tuned store can never
+# shadow an operator's explicit setting.
+_TUNED = {}
 
 
 class _Knob(object):
@@ -35,19 +51,68 @@ def register_env(name, typ, default, doc):
     return _REGISTRY[name]
 
 
-def get_env(name):
-    """Read a registered knob from the environment (typed, defaulted)."""
-    knob = _REGISTRY[name]
-    raw = os.environ.get(name)
-    if raw is None:
-        return knob.default
-    if knob.type is bool:
-        return raw.lower() not in ("0", "false", "off", "")
+def _coerce(knob, value):
+    if knob.type is bool and isinstance(value, str):
+        return value.lower() not in ("0", "false", "off", "")
     try:
-        return knob.type(raw)
+        return knob.type(value)
     except (TypeError, ValueError):
         raise ValueError("env %s=%r is not a valid %s"
-                         % (name, raw, knob.type.__name__))
+                         % (knob.name, value, knob.type.__name__))
+
+
+def get_env(name):
+    """Read a registered knob: explicit env > tuned override >
+    registered default (typed at every layer)."""
+    return resolve_env(name)
+
+
+def resolve_env(name, tuned=None):
+    """Read a registered knob with an explicit per-call tuned value.
+
+    Precedence: exported env var > *tuned* argument > the process-wide
+    :func:`tuned_override` layer > registered default.  The *tuned*
+    argument is how per-model tuning records (a registry consulting
+    the ``TuningStore`` for one model) participate without mutating
+    process-wide state; ``None`` means "no per-call tuning"."""
+    knob = _REGISTRY[name]
+    raw = os.environ.get(name)
+    if raw is not None:
+        return _coerce(knob, raw)
+    if tuned is not None:
+        return _coerce(knob, tuned)
+    if name in _TUNED:
+        return _TUNED[name]
+    return knob.default
+
+
+def env_is_set(name):
+    """Is the knob's variable explicitly exported?  (The predicate a
+    store-consulting call site uses to honor env-wins precedence.)"""
+    return os.environ.get(name) is not None
+
+
+def tuned_override(name, value):
+    """Install a tuned value for a registered knob.  It applies to
+    every subsequent :func:`get_env` read UNLESS the env var is
+    exported — explicit env always wins (regression-tested in
+    tests/test_autotune.py).  Returns the typed value installed."""
+    knob = _REGISTRY[name]
+    _TUNED[name] = _coerce(knob, value)
+    return _TUNED[name]
+
+
+def tuned_overrides():
+    """The currently installed tuned layer (copy)."""
+    return dict(_TUNED)
+
+
+def clear_tuned(name=None):
+    """Drop one tuned override (or all of them with no argument)."""
+    if name is None:
+        _TUNED.clear()
+    else:
+        _TUNED.pop(name, None)
 
 
 def list_env():
@@ -140,8 +205,8 @@ register_env("MXNET_OBS", str, "",
              "Structured run-event categories to record to "
              "events.jsonl: comma list of compile,guard,chaos,"
              "checkpoint,preempt,retry,respawn,warning,kvstore,"
-             "membership,supervisor,watchdog,serve,decode,fleet, "
-             "or 'all'; "
+             "membership,supervisor,watchdog,serve,decode,fleet,"
+             "autotune, or 'all'; "
              "empty = off (no file, zero per-event cost; see "
              "docs/observability.md)")
 register_env("MXNET_OBS_PATH", str, "events.jsonl",
@@ -363,6 +428,13 @@ register_env("MXNET_SERVE_EJECT_TIMEOUT", float, 5.0,
              "Seconds without a successful health probe before the "
              "router ejects a replica from the rotation (breaker "
              "forced open); the next successful probe rejoins it")
+register_env("MXNET_TUNING_STORE", str, "",
+             "Path of the autotuner's JSON TuningStore "
+             "(tools/autotune.py output).  When set, ModelRegistry."
+             "load / DynamicBatcher / DecodeEngine consult it for the "
+             "winning config keyed (model_name, device_kind, "
+             "workload); an exported env var still beats a stored "
+             "tuning (see docs/autotuning.md); empty = no store")
 register_env("MXNET_SERVE_DEDUP_WINDOW", int, 256,
              "Per-client replica-side idempotency window: how many "
              "recent predict request ids each replica remembers so "

@@ -29,6 +29,11 @@ before dispatch), ``cancelled`` (caller reclaimed its slot),
 ``cutover_flush`` (graceful teardown) and ``health`` (state-machine
 transitions; see docs/serving.md).
 
+The ``autotune`` category is the measured-cost tuner's trial trail
+(``kind``: ``trial_start`` / ``trial_result`` / ``pruned`` /
+``promoted`` / ``winner``, each carrying the candidate config and its
+score — docs/autotuning.md).
+
 Durability discipline (the same machinery family as
 ``resilience.checkpoint``): each line is ONE ``os.write`` on an
 ``O_APPEND`` fd — the kernel serializes appends, so concurrent
@@ -57,7 +62,8 @@ __all__ = ["enabled", "emit", "emitter", "watch_jit", "configure",
 
 _CATEGORIES = ("compile", "guard", "chaos", "checkpoint", "preempt",
                "retry", "respawn", "warning", "kvstore", "membership",
-               "supervisor", "watchdog", "serve", "decode", "fleet")
+               "supervisor", "watchdog", "serve", "decode", "fleet",
+               "autotune")
 
 
 def _spec():

@@ -58,6 +58,15 @@ class RequestCancelled(ServeError):
 #: default batch rungs: powers of two through 32
 DEFAULT_BATCHES = (1, 2, 4, 8, 16, 32)
 
+#: hard cap on one rung (a tuned store proposing a 10^6-row program
+#: is a corrupt store, not a configuration)
+MAX_BATCH_RUNG = 4096
+
+#: hard cap on the rung COUNT — the ladder's whole point is a small
+#: finite program set; past this the warm cost stops being a load-time
+#: detail
+MAX_RUNGS = 64
+
 
 class BucketLadder:
     """The finite set of padded shapes the serving path may run at.
@@ -65,8 +74,15 @@ class BucketLadder:
     Parameters
     ----------
     batches : sequence of int
-        Batch rungs, ascending after dedup.  A request of n rows maps
-        to the smallest rung >= n; n larger than the top rung is the
+        Batch rungs — ANY strictly ascending list of positive ints,
+        not just powers of two (tuned ladders from the autotune
+        ``TuningStore`` are arbitrary rung lists; bit-equality at
+        non-power-of-two rungs is proven in tests/test_autotune.py).
+        Validated strictly ascending (a duplicate or out-of-order
+        rung is a store/config typo worth failing loudly on) and
+        capped at :data:`MAX_BATCH_RUNG` per rung /
+        :data:`MAX_RUNGS` rungs.  A request of n rows maps to the
+        smallest rung >= n; n larger than the top rung is the
         caller's problem (the batcher splits, direct callers get a
         :class:`ServeError`).
     seq_axes : dict axis -> multiple, optional
@@ -80,10 +96,27 @@ class BucketLadder:
 
     def __init__(self, batches=DEFAULT_BATCHES, seq_axes=None,
                  seq_max=None):
-        rungs = sorted({int(b) for b in batches})
+        rungs = [int(b) for b in batches]
         if not rungs or rungs[0] < 1:
             raise ServeError("bucket ladder needs positive batch rungs, "
                              "got %r" % (batches,))
+        for lo, hi in zip(rungs, rungs[1:]):
+            if hi <= lo:
+                raise ServeError(
+                    "bucket ladder rungs must be strictly ascending "
+                    "(got %r — a duplicate or out-of-order rung is a "
+                    "config typo, not an ordering preference)"
+                    % (list(batches),))
+        if rungs[-1] > MAX_BATCH_RUNG:
+            raise ServeError(
+                "bucket ladder rung %d exceeds the %d cap — each rung "
+                "is one AOT program at that batch size"
+                % (rungs[-1], MAX_BATCH_RUNG))
+        if len(rungs) > MAX_RUNGS:
+            raise ServeError(
+                "bucket ladder has %d rungs, over the %d cap — the "
+                "ladder must stay a small finite program set"
+                % (len(rungs), MAX_RUNGS))
         self.batches = tuple(rungs)
         self.seq_axes = {int(a): int(m)
                          for a, m in (seq_axes or {}).items()}

@@ -270,9 +270,11 @@ class DecodeEngine:
         Longest sequence a session may reach; rounded up to a whole
         number of blocks (:attr:`padded_len` — the dense-view length
         every step program sees).
-    session_rungs : sequence of int
+    session_rungs : sequence of int, optional
         Session-count rungs of the tick ladder (one AOT program
-        each).
+        each).  Default: the autotuner's winning ladder for
+        ``(label, device, "decode")`` when ``MXNET_TUNING_STORE``
+        names a store holding one, else ``(1, 2, 4, 8, 16)``.
     prefill_rungs : sequence of int, optional
         Sequence rungs of the prefill programs; each must be a
         multiple of the block size.  Default: block-size
@@ -294,11 +296,12 @@ class DecodeEngine:
     def __init__(self, step_fn, prefill_fn=None, token_spec=None,
                  input_spec=None, params=None, predictor=None,
                  max_len=None, block_size=None, num_blocks=None,
-                 session_rungs=(1, 2, 4, 8, 16), prefill_rungs=None,
+                 session_rungs=None, prefill_rungs=None,
                  next_input_fn=None, spec_k=0, donate=None,
                  device=None, label="decode", warm=True):
         import jax
         import jax.numpy as jnp
+        from ..config import resolve_env
         from ..ops.registry import supports_donation
 
         if step_fn is None or token_spec is None or not input_spec:
@@ -308,6 +311,23 @@ class DecodeEngine:
             raise ServeError("DecodeEngine needs max_len (the longest "
                              "sequence a session may reach)")
         self.label = label
+        # tuned-store consultation (docs/autotuning.md): an explicit
+        # constructor argument always wins; a knob left None falls to
+        # exported env > tuned entry keyed (label, device, "decode") >
+        # registered default
+        self.tuning = self._tuning_entry(label)
+        tcfg = (self.tuning or {}).get("config") or {}
+        if block_size is None:
+            block_size = resolve_env(
+                "MXNET_SERVE_KV_BLOCK_SIZE",
+                tcfg.get("MXNET_SERVE_KV_BLOCK_SIZE"))
+        if num_blocks is None:
+            num_blocks = resolve_env(
+                "MXNET_SERVE_KV_BLOCKS",
+                tcfg.get("MXNET_SERVE_KV_BLOCKS"))
+        if session_rungs is None:
+            session_rungs = tuple(tcfg.get("ladder")
+                                  or (1, 2, 4, 8, 16))
         self._step_fn = step_fn
         self._prefill_fn = prefill_fn
         self._predictor = predictor
@@ -384,6 +404,11 @@ class DecodeEngine:
             predictor._decode_engines.append(self)
         if warm:
             self.warm()
+
+    @staticmethod
+    def _tuning_entry(label, workload="decode"):
+        from ..autotune.store import lookup
+        return lookup(label, workload)
 
     # -- introspection -------------------------------------------------------
     @property
@@ -981,11 +1006,15 @@ class DecodeBatcher:
 
     def __init__(self, engine, max_wait_ms=None, name=None,
                  on_state=None):
-        from ..config import get_env
+        from ..config import resolve_env
         self._engine = engine
         self.name = name or engine.label
         if max_wait_ms is None:
-            max_wait_ms = get_env("MXNET_SERVE_DECODE_MAX_WAIT_MS")
+            tcfg = (getattr(engine, "tuning", None) or {}) \
+                .get("config") or {}
+            max_wait_ms = resolve_env(
+                "MXNET_SERVE_DECODE_MAX_WAIT_MS",
+                tcfg.get("MXNET_SERVE_DECODE_MAX_WAIT_MS"))
         self._max_wait = max(0.0, float(max_wait_ms)) / 1e3
         self._on_state = on_state
         self._lock = _san.lock(label="serve.decode.batcher.%s"

@@ -197,6 +197,10 @@ class CompiledPredictor:
         # paged decode engines attached via make_paged_decoder: the
         # registry drains/closes them on unload and alias cutover
         self._decode_engines = []
+        # the TuningStore entry the registry attached at load time
+        # (None = untuned); DynamicBatcher reads its scalar knobs,
+        # health() surfaces it (docs/autotuning.md)
+        self.tuning = None
 
     # -- introspection -----------------------------------------------------
     @property

@@ -67,7 +67,21 @@ class ModelRegistry:
         replaces it atomically (aliases keep pointing at the name; the
         displaced predictor's batcher is drained, then closed).  A
         build/warm failure never half-registers: the name is dropped
-        from the health board and the error propagates."""
+        from the health board and the error propagates.
+
+        When ``MXNET_TUNING_STORE`` names an autotune store with an
+        entry for ``(name, device_kind, "serve")``, the load consults
+        it: the tuned ladder applies when no *ladder* argument was
+        passed, the entry rides on the predictor (``pred.tuning``)
+        for the batcher's scalar knobs, and ``health(name)`` surfaces
+        a ``tuning`` section.  Precedence everywhere: explicit
+        argument > exported env var > tuned store > registered
+        default (docs/autotuning.md)."""
+        tuning = self._tuning_entry(name)
+        if ladder is None and tuning:
+            rungs = (tuning.get("config") or {}).get("ladder")
+            if rungs:
+                ladder = BucketLadder(batches=rungs)
 
         def _check_not_alias():
             if name in self._aliases:
@@ -100,6 +114,7 @@ class ModelRegistry:
                              error="%s: %s" % (type(exc).__name__,
                                                str(exc)[:200]))
             raise
+        pred.tuning = tuning
         with self._lock:
             _check_not_alias()      # racing alias() may have won
             old_batcher = self._batchers.pop(name, None)
@@ -129,8 +144,18 @@ class ModelRegistry:
                 eng.close()
         _obs_events.emit("serve", kind="load", model=name,
                          programs=built, warm=bool(warm),
-                         buckets=list(pred.ladder.batches))
+                         buckets=list(pred.ladder.batches),
+                         **({"tuned": True} if tuning else {}))
         return pred
+
+    @staticmethod
+    def _tuning_entry(name, workload="serve"):
+        """The active TuningStore's entry for *name*, or None when no
+        store is configured / no entry matches.  A configured-but-
+        unreadable store propagates loudly — a deploy pointing at a
+        store that is not there must not silently run defaults."""
+        from ..autotune.store import lookup
+        return lookup(name, workload)
 
     def load_checkpoint(self, name, prefix, epoch, data_shapes,
                         **kwargs):
@@ -419,6 +444,26 @@ class ModelRegistry:
                 closed_dirty=batcher.closed_dirty,
                 requests=batcher.request_count,
                 batches=batcher.batch_count)
+        tuning = getattr(pred, "tuning", None)
+        if tuning:
+            from ..config import get_env
+            info["tuning"] = {
+                "workload": tuning.get("workload"),
+                "device_kind": tuning.get("device_kind"),
+                "config": tuning.get("config"),
+                "score": tuning.get("score"),
+                "baseline_score": tuning.get("baseline_score"),
+                "gain_pct": tuning.get("gain_pct"),
+                "source": get_env("MXNET_TUNING_STORE"),
+            }
+            if batcher is not None:
+                # what actually applied after env-wins resolution —
+                # an exported env var makes this differ from config
+                info["tuning"]["applied"] = {
+                    "ladder": list(pred.ladder.batches),
+                    "max_wait_ms": batcher._max_wait * 1e3,
+                    "max_batch": batcher._max_batch,
+                }
         engines = list(getattr(pred, "_decode_engines", ())) \
             if pred is not None else []
         if engines:
