@@ -709,7 +709,7 @@ def _percentile(sorted_vals, q):
 def serve_bench(hidden=256, dim=64, classes=16,
                 closed_threads=8, closed_requests=40,
                 open_rate=150.0, open_seconds=2.0, max_wait_ms=1.0,
-                record_trace=None, trace=None):
+                record_trace=None, trace=None, quantize=None):
     """``--serve``: load test of the compiled serving subsystem
     (mxnet_tpu/serve): one warm-compiled model behind the dynamic
     batcher, driven closed-loop (N threads, back-to-back requests —
@@ -761,9 +761,20 @@ def serve_bench(hidden=256, dim=64, classes=16,
     tuned = _at_lookup("bench", "serve")
     ladder = None if tuned else \
         serve.BucketLadder(batches=(1, 2, 4, 8, 16))
+    # --quantize int8|int8-weight-only: serve the post-training-
+    # quantized model instead (calibrated on traffic-shaped batches,
+    # accuracy-gated at load — docs/quantization.md); the bench line
+    # then reports the quantization section next to the latencies so
+    # fp32 and int8 artifacts are comparable at a glance
+    quant_kw = {}
+    if quantize:
+        quant_kw = {"quantize": quantize,
+                    "calib_batches": [rs.randn(4, dim).astype(np.float32)
+                                      for _ in range(8)]}
     t0 = time.perf_counter()
     pred = registry.load("bench", net, params,
-                         data_shapes={"data": (1, dim)}, ladder=ladder)
+                         data_shapes={"data": (1, dim)}, ladder=ladder,
+                         **quant_kw)
     warm_s = time.perf_counter() - t0
     batcher = registry.batcher(
         "bench", max_wait_ms=None if tuned else max_wait_ms)
@@ -857,6 +868,11 @@ def serve_bench(hidden=256, dim=64, classes=16,
         "model": {"hidden": hidden, "dim": dim,
                   "buckets": list(pred.ladder.batches)},
         "tuning": (pred.tuning or {}).get("config"),
+        "quantization": ({"mode": pred.quantization["mode"],
+                          "calib_sha": pred.quantization["calib_sha"],
+                          "covered": pred.quantization["covered"],
+                          "total": pred.quantization["total"]}
+                         if pred.quantization else None),
         "trace": tr.summary() if tr is not None else None,
         "warm_compile_seconds": round(warm_s, 3),
         "programs_compiled": compiles_after_warm,
@@ -881,6 +897,169 @@ def serve_bench(hidden=256, dim=64, classes=16,
         "requests": batcher.request_count,
     }
     registry.close()
+    print(json.dumps(out))
+    return out
+
+
+def compare_quant_paths(hidden=256, dim=64, classes=16, rungs=(1, 2, 4, 8),
+                        threads=6, requests=30):
+    """``--compare-quant-paths``: fp32 vs post-training-int8 serving
+    A/B on the same model, ladder and traffic — a relative
+    measurement, so it ALWAYS runs on CPU (same tunnel rationale as
+    --compare-update-paths).  Proves, per rung, from the lowered
+    StableHLO via the costs.py per-op table, that the quantized
+    program moves >= 2x fewer weight+activation bytes through its
+    compute ops (dot/conv); and measures what int8 costs in accuracy
+    (max rel err + top-1 agreement vs the fp32 path on identical
+    inputs) and buys/costs in latency under identical closed-loop
+    traffic.  On CPU the byte reduction is the honest headline — XLA's
+    CPU int8 GEMMs are not the MXU path, so wall-clock parity, not
+    speedup, is expected (docs/quantization.md).  Asserts zero
+    request-path compiles on BOTH paths.  Prints ONE BENCH-schema
+    JSON line and returns the dict."""
+    import threading
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import serve, sym
+    from mxnet_tpu.observability import costs
+    from mxnet_tpu.quantize import calibrate, hlo_has_int8_compute
+
+    data = sym.var("data")
+    net = sym.FullyConnected(data, num_hidden=hidden, name="sfc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=classes, name="sfc2")
+    net = sym.softmax(net)
+    rs = np.random.RandomState(0)
+    arg_shapes, _, _ = net.infer_shape(data=(1, dim))
+    params = {n: mx.nd.array(rs.randn(*s).astype(np.float32) * 0.05)
+              for n, s in zip(net.list_arguments(), arg_shapes)
+              if n != "data"}
+
+    calib = calibrate(
+        net, params,
+        [rs.randn(4, dim).astype(np.float32) for _ in range(8)],
+        name="bench")
+
+    registry = serve.ModelRegistry()
+    preds = {}
+    try:
+        for path, kw in (("fp32", {}),
+                         ("int8", {"quantize": "int8", "calib": calib})):
+            t0 = time.perf_counter()
+            preds[path] = (registry.load(
+                "bench-" + path, net, params,
+                data_shapes={"data": (1, dim)},
+                ladder=serve.BucketLadder(batches=rungs), **kw),
+                time.perf_counter() - t0)
+
+        # -- per-rung compute-op byte accounting from the lowered HLO --
+        per_rung = {}
+        byte_ratios = []
+        for b in rungs:
+            row = {}
+            for path, (pred, _) in preds.items():
+                text = pred.lowered_text(pred.rung_shapes(b))
+                if path == "int8" and not hlo_has_int8_compute(text):
+                    raise RuntimeError(
+                        "rung %d of the quantized path lowered with no "
+                        "int8 dot/conv" % b)
+                row[path] = sum(
+                    r["bytes"] for r in costs.parse_hlo_ops(text)
+                    if r["op"] in ("dot_general", "dot", "convolution"))
+            ratio = row["fp32"] / max(row["int8"], 1.0)
+            byte_ratios.append(ratio)
+            per_rung[b] = {
+                "fp32_compute_bytes": int(row["fp32"]),
+                "int8_compute_bytes": int(row["int8"]),
+                "byte_reduction_x": round(ratio, 2),
+            }
+
+        # -- accuracy on identical inputs at every rung ----------------
+        # rel err is gated per rung; top-1 agreement is pooled over
+        # every sample (a per-rung min at rung 1 would let a single
+        # near-tie argmax flip read as 0% agreement)
+        worst_err = 0.0
+        agree, total = 0, 0
+        for b in list(rungs) + [max(rungs)] * 16:
+            x = rs.randn(b, dim).astype(np.float32)
+            ref = preds["fp32"][0].predict(x)[0].asnumpy()
+            out = preds["int8"][0].predict(x)[0].asnumpy()
+            worst_err = max(worst_err, float(
+                np.abs(out - ref).max() / max(np.abs(ref).max(), 1e-20)))
+            agree += int((out.argmax(-1) == ref.argmax(-1)).sum())
+            total += ref.shape[0]
+        worst_top1 = agree / total
+
+        # -- identical closed-loop traffic through both batchers -------
+        reqs = [rs.randn(rs.randint(1, 5), dim).astype(np.float32)
+                for _ in range(64)]
+        perf = {}
+        for path, (pred, warm_s) in preds.items():
+            batcher = registry.batcher("bench-" + path, max_wait_ms=1.0)
+            warm = pred.compile_count
+            lats, errors = [], []
+            lock = threading.Lock()
+
+            def worker(tid, batcher=batcher, lats=lats, errors=errors,
+                       lock=lock):
+                mine = []
+                try:
+                    for i in range(requests):
+                        x = reqs[(tid * requests + i) % len(reqs)]
+                        t0 = time.monotonic()
+                        batcher.submit(x).result(60)
+                        mine.append(time.monotonic() - t0)
+                except Exception as exc:
+                    with lock:
+                        errors.append("worker %d: %r" % (tid, exc))
+                finally:
+                    with lock:
+                        lats.extend(mine)
+
+            ths = [threading.Thread(target=worker, args=(t,))
+                   for t in range(threads)]
+            t0 = time.monotonic()
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join()
+            dt = time.monotonic() - t0
+            if errors:
+                raise RuntimeError("quant A/B %s loop failed: %s"
+                                   % (path, "; ".join(errors[:3])))
+            lats.sort()
+            perf[path] = {
+                "warm_compile_seconds": round(warm_s, 3),
+                "throughput_rps": round(threads * requests / dt, 2),
+                "p50_ms": round(_percentile(lats, 50) * 1e3, 3),
+                "p99_ms": round(_percentile(lats, 99) * 1e3, 3),
+                "request_path_compiles": pred.compile_count - warm,
+            }
+        qreport = preds["int8"][0].quantization
+    finally:
+        registry.close()
+
+    min_ratio = min(byte_ratios)
+    out = {
+        "metric": "quant_paths",
+        "value": round(min_ratio, 2),
+        "unit": "x fewer compute-op bytes (worst rung)",
+        "model": {"hidden": hidden, "dim": dim, "classes": classes,
+                  "rungs": list(rungs)},
+        "quantization": {"mode": qreport["mode"],
+                         "calib_sha": qreport["calib_sha"],
+                         "covered": qreport["covered"],
+                         "total": qreport["total"]},
+        "per_rung": per_rung,
+        "max_rel_err": round(worst_err, 5),
+        "top1_agreement": round(worst_top1, 4),
+        "fp32": perf["fp32"],
+        "int8": perf["int8"],
+        "quant_ok": (min_ratio >= 2.0 and worst_err <= 0.1
+                     and worst_top1 >= 0.95
+                     and perf["fp32"]["request_path_compiles"] == 0
+                     and perf["int8"]["request_path_compiles"] == 0),
+    }
     print(json.dumps(out))
     return out
 
@@ -1363,8 +1542,28 @@ def main():
         # target is health-probed, CPU needs BENCH_ALLOW_CPU=1.
         _ensure_platform()
         serve_bench(record_trace=_argv_path("--record-trace"),
-                    trace=_argv_path("--trace"))
+                    trace=_argv_path("--trace"),
+                    quantize=_argv_path("--quantize"))
         return
+    if "--compare-quant-paths" in sys.argv:
+        # fp32 vs post-training-int8 serving on the same ladder and
+        # traffic — a relative measurement (HLO byte accounting +
+        # accuracy + latency deltas), so it ALWAYS runs on CPU (same
+        # tunnel rationale as --compare-update-paths)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        out = compare_quant_paths()
+        if not out["quant_ok"]:
+            print("bench: quantized path failed the bar (%.2fx fewer "
+                  "compute-op bytes at the worst rung, rel err %.4f, "
+                  "top-1 %.3f, request_path_compiles fp32=%d int8=%d "
+                  "— want >= 2x, <= 0.1, >= 0.95, 0, 0)"
+                  % (out["value"], out["max_rel_err"],
+                     out["top1_agreement"],
+                     out["fp32"]["request_path_compiles"],
+                     out["int8"]["request_path_compiles"]),
+                  file=sys.stderr)
+            return 1
+        return 0
     if "--decompose" in sys.argv:
         return decompose_main()
     if "--compare-decode-paths" in sys.argv:

@@ -105,6 +105,21 @@ def main():
             failures.append("serve instrument %r missing from the "
                             "registry catalog" % name)
 
+    # quantization instruments register on import (serve registry +
+    # quantize package) and the quantize event category must be known
+    # — values are exercised by ci/quant_smoke.py, the contract here
+    # is catalog presence (docs/quantization.md)
+    import mxnet_tpu.quantize  # noqa: F401
+    snap = metrics.snapshot()
+    for name in ("serve_quantized_models",
+                 "quant_calibration_batches_total",
+                 "quant_accuracy_gate_failures_total"):
+        if name not in snap:
+            failures.append("quantization instrument %r missing from "
+                            "the registry catalog" % name)
+    if "quantize" not in events._CATEGORIES:
+        failures.append("'quantize' is not a known event category")
+
     # exposition must render and carry the fused-step counter
     expo = metrics.exposition()
     if "mxnet_fused_step_dispatches %d" % STEPS not in expo:

@@ -1,0 +1,234 @@
+"""Quantized-serving CI smoke (ci/run_tests.sh stage).
+
+The int8 pipeline end to end under MXNET_SAN=all: calibrate a small
+conv+FC model on synthetic batches, save/load the table through the
+atomic round-trip, quantize, load into a ModelRegistry and serve
+CONCURRENT mixed-size traffic through a real DynamicBatcher.  Gates:
+
+* int8 dot/conv ops provably present in the lowered StableHLO of
+  EVERY rung;
+* load-time accuracy gate passed at every rung (and a deliberately
+  strict policy fails typed — a quantized model can never serve
+  silently-wrong answers);
+* a corrupted calibration table fails the load typed at the sha
+  check, never quantizes;
+* zero request-path compiles under the mixed-size traffic;
+* quantize events balanced: every lower has a matching gate /
+  gate_failed, calibrate events carry the table sha;
+* the new instruments move (serve_quantized_models gauge up then
+  back down, quant_calibration_batches_total,
+  quant_accuracy_gate_failures_total);
+* zero graftsan reports.
+
+Last stdout line is the scrapeable summary::
+
+    quant: layers=N covered=M acc_ok compiles=0 ok
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("MXNET_SAN", "all")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("MXNET_OBS", "quantize,serve")
+os.environ.setdefault(
+    "MXNET_OBS_PATH",
+    os.path.join(tempfile.mkdtemp(prefix="quant_smoke_"),
+                 "events.jsonl"))
+
+import numpy as np  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import tools.graftsan as graftsan  # noqa: E402
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import nd  # noqa: E402
+from mxnet_tpu.observability import events  # noqa: E402
+from mxnet_tpu.observability import metrics  # noqa: E402
+from mxnet_tpu.quantize import (CalibTable, QuantizationError,  # noqa: E402
+                                QuantizePolicy, calibrate,
+                                hlo_has_int8_compute)
+from mxnet_tpu.serve.buckets import BucketLadder  # noqa: E402
+from mxnet_tpu.serve.registry import ModelRegistry  # noqa: E402
+
+MODEL = "quant-smoke"
+RUNGS = (1, 2, 4)
+SHAPE = (3, 12, 12)
+
+
+def build_model():
+    data = mx.sym.var("data")
+    c1 = mx.sym.Convolution(data=data, kernel=(3, 3), num_filter=4,
+                            name="qc1")
+    a1 = mx.sym.Activation(data=c1, act_type="relu", name="qa1")
+    p1 = mx.sym.Pooling(data=a1, kernel=(2, 2), stride=(2, 2),
+                        pool_type="max", name="qp1")
+    f1 = mx.sym.FullyConnected(data=p1, num_hidden=8, name="qf1")
+    rs = np.random.RandomState(0)
+    arg_shapes, _, _ = f1.infer_shape(data=(1,) + SHAPE)
+    params = {n: nd.array(rs.randn(*s).astype(np.float32) * 0.15)
+              for n, s in zip(f1.list_arguments(), arg_shapes)
+              if n != "data"}
+    return f1, params
+
+
+def main():
+    failures = []
+    rs = np.random.RandomState(1)
+    sym, params = build_model()
+    batches = [rs.randn(4, *SHAPE).astype(np.float32)
+               for _ in range(5)]
+
+    # -- calibration + atomic table round-trip -------------------------
+    table = calibrate(sym, params, batches, name=MODEL)
+    tmp = tempfile.mkdtemp(prefix="quant_calib_")
+    path = os.path.join(tmp, "calib.json")
+    table.save(path)
+    loaded = CalibTable.load(path)
+    if loaded.sha != table.sha or loaded.ranges != table.ranges:
+        failures.append("calib table atomic round-trip drifted: "
+                        "%s vs %s" % (loaded.sha, table.sha))
+
+    # a corrupted table must fail the LOAD typed, never quantize
+    broken_path = os.path.join(tmp, "broken.json")
+    doc = json.load(open(path))
+    doc["calib_table"]["ranges"]["qc1"] = [-99.0, 99.0]
+    open(broken_path, "w").write(json.dumps(doc))
+    registry = ModelRegistry()
+    report = {"total": 0, "covered": 0}
+    compiles = -1
+    try:
+        try:
+            registry.load(MODEL, sym, params,
+                          data_shapes={"data": (4,) + SHAPE},
+                          quantize="int8", calib=broken_path)
+            failures.append("corrupted calib table quantized a model")
+        except QuantizationError:
+            pass
+
+        # an impossible accuracy threshold must fail the gate typed
+        try:
+            registry.load(MODEL, sym, params,
+                          data_shapes={"data": (4,) + SHAPE},
+                          ladder=BucketLadder(batches=RUNGS),
+                          quantize=QuantizePolicy(mode="int8",
+                                                  max_rel_err=1e-12),
+                          calib=path)
+            failures.append("accuracy gate passed at 1e-12")
+        except QuantizationError:
+            pass
+
+        # -- the real quantized load ----------------------------------
+        pred = registry.load(MODEL, sym, params,
+                             data_shapes={"data": (4,) + SHAPE},
+                             ladder=BucketLadder(batches=RUNGS),
+                             quantize="int8", calib=path)
+        report = pred.quantization
+        if report["calib_sha"] != table.sha:
+            failures.append("served calib sha %r != table sha %r"
+                            % (report["calib_sha"], table.sha))
+        if report["covered"] != report["total"] or \
+                report["covered"] < 2:
+            failures.append("incomplete coverage: %r"
+                            % (report["layers"],))
+        for b in RUNGS:
+            if not hlo_has_int8_compute(
+                    pred.lowered_text(pred.rung_shapes(b))):
+                failures.append("rung %d lost its int8 compute" % b)
+            gate = report["gate"]["rungs"].get(b)
+            if gate is None or gate["rel_err"] > 0.1:
+                failures.append("rung %d accuracy gate: %r"
+                                % (b, gate))
+        health = registry.health(MODEL)
+        if health.get("quantization", {}).get("mode") != "int8":
+            failures.append("health(name) lost the quantization "
+                            "section: %r" % (health,))
+
+        # -- concurrent mixed-size traffic, zero request-path compiles -
+        batcher = registry.batcher(MODEL)
+        warm = pred.compile_count
+        if pred.jit_cache_size() != 0:
+            failures.append("jit cache not empty after warm")
+        futs = [batcher.submit(
+            rs.randn(1 + (i % 4), *SHAPE).astype(np.float32))
+            for i in range(40)]
+        for f in futs:
+            f.result(60)
+        compiles = pred.compile_count - warm
+        if compiles:
+            failures.append("request path compiled %d new programs"
+                            % compiles)
+        if pred.jit_cache_size() != 0:
+            failures.append("request path leaked into the jit cache")
+
+        # quantized outputs actually match fp32 on live traffic
+        x = rs.randn(2, *SHAPE).astype(np.float32)
+        ref = sym.bind(args={**params, "data": nd.array(x)}) \
+            .forward()[0].asnumpy()
+        out = np.asarray(batcher.submit(x).result(60)[0])
+        err = float(np.abs(out - ref).max() / np.abs(ref).max())
+        if err > 0.1:
+            failures.append("served quantized output drifted: rel "
+                            "err %.4f" % err)
+
+        # -- instruments ----------------------------------------------
+        snap = metrics.snapshot()
+        if snap.get("serve_quantized_models", {}).get("value") != 1:
+            failures.append("serve_quantized_models gauge != 1 while "
+                            "loaded: %r"
+                            % snap.get("serve_quantized_models"))
+        if snap.get("quant_calibration_batches_total",
+                    {}).get("value", 0) < len(batches):
+            failures.append("quant_calibration_batches_total did not "
+                            "count the calibration")
+        if snap.get("quant_accuracy_gate_failures_total",
+                    {}).get("value", 0) < 1:
+            failures.append("quant_accuracy_gate_failures_total did "
+                            "not count the strict-policy failure")
+    finally:
+        registry.close()
+    snap = metrics.snapshot()
+    if snap.get("serve_quantized_models", {}).get("value") != 0:
+        failures.append("serve_quantized_models gauge != 0 after "
+                        "close: %r" % snap.get("serve_quantized_models"))
+
+    # -- balanced quantize events --------------------------------------
+    try:
+        evs = events.read_events(events.path())
+    except (OSError, ValueError):
+        evs = []
+    qevs = [e for e in evs if e.get("ev") == "quantize"]
+    kinds = {}
+    for e in qevs:
+        kinds[e["kind"]] = kinds.get(e["kind"], 0) + 1
+    if not kinds.get("calibrate"):
+        failures.append("no calibrate event emitted")
+    if kinds.get("lower", 0) != \
+            kinds.get("gate", 0) + kinds.get("gate_failed", 0):
+        failures.append("unbalanced quantize events: %r" % (kinds,))
+    for e in qevs:
+        if e["kind"] == "calibrate" and \
+                e.get("sha") != table.sha[:12]:
+            failures.append("calibrate event lost the sha: %r" % (e,))
+
+    reports = graftsan.reports()
+    failures.extend(graftsan.format_report(r) for r in reports)
+
+    line = "quant: layers=%d covered=%d acc_ok compiles=%d %s" % (
+        report["total"], report["covered"], compiles,
+        "ok" if not failures else "FAIL")
+    if failures:
+        print("\n".join(failures), file=sys.stderr)
+        print("quant smoke: FAIL", file=sys.stderr)
+        print(line)
+        return 1
+    print(line)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

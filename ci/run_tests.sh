@@ -76,6 +76,19 @@ echo "== perf: autotune smoke (measured search + store pickup) =="
 # scrapeable summary ("autotune: trials=.. pruned=.. ...").
 MXNET_SAN=all python ci/autotune_smoke.py
 
+echo "== perf: quantized-serving smoke (calibrate/lower/gate/serve) =="
+# The int8 post-training quantization pipeline end to end, sanitizers
+# on: calibrate a conv+FC model on synthetic batches, atomic calib-
+# table round-trip (a corrupted table fails the load typed), quantize
+# and load through ModelRegistry with the accuracy gate enforced at
+# every rung (an impossible threshold fails typed), int8 dot/conv ops
+# asserted present in every rung's lowered StableHLO, concurrent
+# mixed-size traffic through a real DynamicBatcher with zero request-
+# path compiles, balanced quantize events, instruments moving, zero
+# graftsan reports (docs/quantization.md).  Last stdout line:
+# "quant: layers=.. covered=.. acc_ok compiles=0 ok".
+MXNET_SAN=all python ci/quant_smoke.py
+
 echo "== serve: request-path chaos drill (shedding/supervision/drain) =="
 # The serving request path through every injected fault class —
 # overload (slow dispatches vs a bounded queue), deadline expiry

@@ -100,6 +100,7 @@ from . import resilience  # noqa: F401
 from . import config  # noqa: F401
 from . import sanitizer  # noqa: F401  (graftsan bridge — see MXNET_SAN)
 from . import serve  # noqa: F401  (compiled inference subsystem)
+from . import quantize  # noqa: F401  (serving-path int8 pipeline)
 from . import rtc  # noqa: F401
 from .runtime import engine  # noqa: F401
 

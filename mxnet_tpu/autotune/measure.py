@@ -110,21 +110,75 @@ class ServeMeasurer(object):
         self._symbol = symbol
         self._params = arg_params
         self._data_shapes = data_shapes
-        self._predictors = {}     # rung tuple -> CompiledPredictor
+        self._predictors = {}     # (rungs, quantize) -> predictor
         self._rung_cost = {}      # rung -> analytic seconds (prior)
+        self._quant_models = {}   # mode -> (qsym, qargs, qaux, report)
+        self._quant_err = {}      # (rungs, mode) -> max rel err
 
     # -- shared warm predictors -------------------------------------------
-    def predictor(self, rungs):
+    def _quantized_model(self, mode):
+        """The model under tuning lowered at *mode* (cached — every
+        candidate sharing a mode shares one calibration + lowering).
+        Calibration runs on seeded batches of the trace's payload
+        family, so the recorded calib sha identifies ranges the
+        measurement actually exercised."""
+        cached = self._quant_models.get(mode)
+        if cached is None:
+            from ..quantize import calibrate, quantize_model
+            table = None
+            if mode == "int8":
+                rs = _np.random.RandomState(0)
+                shape = next(iter(self._data_shapes.values()))
+                table = calibrate(
+                    self._symbol, self._params,
+                    [rs.standard_normal((8,) + tuple(shape[1:]))
+                     .astype(_np.float32) for _ in range(4)],
+                    name=self.name)
+            cached = quantize_model(self._symbol, self._params,
+                                    calib=table, policy=mode,
+                                    name=self.name)
+            self._quant_models[mode] = cached
+        return cached
+
+    def predictor(self, rungs, quantize="off"):
         rungs = tuple(int(r) for r in rungs)
-        pred = self._predictors.get(rungs)
+        mode = quantize or "off"
+        pred = self._predictors.get((rungs, mode))
         if pred is None:
+            if mode == "off":
+                symbol, params = self._symbol, self._params
+                aux = None
+            else:
+                symbol, params, aux, _report = \
+                    self._quantized_model(mode)
             pred = CompiledPredictor(
-                self._symbol, self._params,
+                symbol, params, aux_params=aux,
                 data_shapes=self._data_shapes,
                 ladder=BucketLadder(batches=rungs), name=self.name)
             pred.warm()
-            self._predictors[rungs] = pred
+            self._predictors[(rungs, mode)] = pred
         return pred
+
+    def _quant_accuracy(self, rungs, mode):
+        """Max rel err of the quantized predictor vs fp32 at the top
+        rung (cached) — the measurement's accuracy guard."""
+        key = (tuple(rungs), mode)
+        err = self._quant_err.get(key)
+        if err is None:
+            rs = _np.random.RandomState(1)
+            data = {n: rs.standard_normal((rungs[-1],) + tuple(s[1:]))
+                    .astype(_np.float32)
+                    for n, s in self._data_shapes.items()}
+            q = self.predictor(rungs, mode).predict(data)
+            f = self.predictor(rungs).predict(data)
+            err = 0.0
+            for qo, fo in zip(q, f):
+                qa, fa = qo.asnumpy(), fo.asnumpy()
+                denom = float(_np.abs(fa).max()) or 1.0
+                err = max(err,
+                          float(_np.abs(qa - fa).max()) / denom)
+            self._quant_err[key] = err
+        return err
 
     # -- real measurement --------------------------------------------------
     def measure(self, config, budget_frac=1.0):
@@ -134,7 +188,10 @@ class ServeMeasurer(object):
         that infeasible)."""
         rungs = tuple(config.get("ladder") or
                       BucketLadder().batches)
-        pred = self.predictor(rungs)
+        qmode = config.get("quantize") or "off"
+        pred = self.predictor(rungs, qmode)
+        quant_err = None if qmode == "off" \
+            else self._quant_accuracy(rungs, qmode)
         compiles_warm = pred.compile_count
         batcher = DynamicBatcher(
             pred,
@@ -168,10 +225,23 @@ class ServeMeasurer(object):
         n = len(records)
         sched = self.trace.schedule(budget_frac)
         duration = max(sched[-1][0], 1e-9)
+        # the accuracy guard: a drifting quantized candidate is
+        # INFEASIBLE, not merely slow — the objective never trades
+        # correctness for latency (docs/quantization.md)
+        acc_ok = quant_err is None or quant_err <= 0.1
+        quant_fields = {}
+        if qmode != "off":
+            report = self._quant_models[qmode][3]
+            quant_fields = {
+                "quantize": qmode,
+                "calib_sha": report.get("calib_sha"),
+                "quant_max_rel_err": round(quant_err, 6),
+            }
         return {
             "workload": "serve",
-            "ok": errors == 0 and bool(lats),
+            "ok": errors == 0 and bool(lats) and acc_ok,
             "requests": n,
+            **quant_fields,
             "errors": errors,
             "budget_frac": float(budget_frac),
             "offered_rps": round((n - 1) / duration, 2) if n > 1

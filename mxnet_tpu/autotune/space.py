@@ -242,7 +242,14 @@ def serve_space(max_rows=16, ladders=None, max_wait_hi_ms=8.0):
       ``[0, max_wait_hi_ms]`` (0 = dispatch immediately; the
       latency/throughput trade the tuner is really deciding),
     * ``MXNET_SERVE_MAX_BATCH`` — rows per coalesced dispatch as a
-      structured choice (0 = the ladder's top rung).
+      structured choice (0 = the ladder's top rung),
+    * ``quantize`` — serve the model fp32, int8-weight-only or full
+      int8 (mxnet_tpu.quantize).  The measurer re-calibrates per
+      candidate model and carries an accuracy guard: a quantized
+      candidate whose outputs drift from fp32 measures ``ok=False``
+      (infeasible), so with the default-``off`` baseline guard the
+      tuner can never ship an accuracy- or latency-regressing
+      quantization (docs/quantization.md).
     """
     if ladders is None:
         top = int(max_rows)
@@ -262,6 +269,8 @@ def serve_space(max_rows=16, ladders=None, max_wait_hi_ms=8.0):
                    step=max(0.5, float(max_wait_hi_ms) / 8.0)),
         Choice("MXNET_SERVE_MAX_BATCH", (0, 4, 8, 16), default=0,
                canon=int),
+        Choice("quantize", ("off", "int8-weight-only", "int8"),
+               default="off", canon=str),
     ])
 
 

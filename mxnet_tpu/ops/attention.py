@@ -36,6 +36,7 @@ from jax.experimental.pallas import tpu as pltpu
 _CompilerParams = getattr(pltpu, "CompilerParams", None) or \
     getattr(pltpu, "TPUCompilerParams")
 
+from ._precision import matmul_precision
 from .registry import register_op
 
 __all__ = ["flash_attention", "attention_reference"]
@@ -55,7 +56,9 @@ def attention_reference(q, k, v, causal=False, sm_scale=None):
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * sm_scale
+                   k.astype(jnp.float32),
+                   precision=matmul_precision(q.dtype, k.dtype)) \
+        * sm_scale
     if causal:
         qlen, klen = s.shape[-2], s.shape[-1]
         mask = jnp.tril(jnp.ones((qlen, klen), bool), klen - qlen)
@@ -64,7 +67,8 @@ def attention_reference(q, k, v, causal=False, sm_scale=None):
         p = p * mask.any(-1)[:, None]  # zero fully-masked rows
     else:
         p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+                      precision=matmul_precision(q.dtype, v.dtype)
                       ).astype(q.dtype)
 
 
@@ -87,6 +91,7 @@ def _online_softmax_update(o, m, l, s, vb):
     l = l * alpha + p.sum(axis=-1)
     o = o * alpha[..., None] + jnp.einsum(
         "bhqk,bhkd->bhqd", p.astype(vb.dtype), vb,
+        precision=matmul_precision(vb.dtype, vb.dtype),
         preferred_element_type=jnp.float32)
     return o, m_new, l
 
@@ -132,6 +137,7 @@ def _chunked_attention(q, k, v, causal=False, sm_scale=None, chunk=512):
         # storage-dtype operands, f32 accumulation: bf16 runs at the
         # full MXU rate (a pre-cast to f32 would halve it)
         s = jnp.einsum("bhqd,bhkd->bhqk", q, kb,
+                       precision=matmul_precision(q.dtype, kb.dtype),
                        preferred_element_type=jnp.float32) * sm_scale
         k_pos = ci * chunk + jnp.arange(chunk)
         valid = k_pos < sk

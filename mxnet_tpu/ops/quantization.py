@@ -39,14 +39,24 @@ def _dequantize(data, min_range, max_range, out_type="float32"):
     else:
         qmin, qmax = -127.0, 127.0
     scale = (max_range - min_range) / (qmax - qmin)
-    return (data.astype(jnp.float32) - qmin) * scale + min_range
+    # affine as q*scale + offset, NOT (q - qmin)*scale + min: at int32
+    # magnitudes (q - qmin) ~ 2^31 and float32's ~2^-24 relative
+    # resolution wipes the accumulator's low bits (offset folds the
+    # same constants with no precision loss; for symmetric ranges it
+    # is exactly 0)
+    return data.astype(jnp.float32) * scale + (min_range - qmin * scale)
 
 
 @register_op("_contrib_requantize", num_outputs=3)
 def _requantize(data, min_range, max_range, min_calib_range=None,
                 max_calib_range=None):
-    # int32 -> int8 with (possibly calibrated) range
-    real = data.astype(jnp.float32) * (max_range - min_range) / \
+    # int32 -> int8 with (possibly calibrated) range.  The int32
+    # accumulator carries a SYMMETRIC real range (see _int32_range
+    # below): real = q * MaxAbs(min, max) / (2^31-1) — the reference's
+    # requantize-inl.h MaxAbs convention, and the same scale the int32
+    # branch of _dequantize above resolves to for a symmetric range.
+    real = data.astype(jnp.float32) * \
+        jnp.maximum(jnp.abs(min_range), jnp.abs(max_range)) / \
         (2.0 ** 31 - 1)
     lo = min_calib_range if min_calib_range is not None else min_range
     hi = max_calib_range if max_calib_range is not None else max_range
@@ -132,15 +142,19 @@ def _quantized_pooling(data, dmin, dmax, kernel=(2, 2), stride=None,
     strides = (1, 1) + tuple(int(s) for s in stride)
     pads = ((0, 0), (0, 0)) + tuple((int(p), int(p)) for p in pad)
     if pool_type == "max":
-        out = jax.lax.reduce_window(d, jnp.int8(jnp.iinfo(jnp.int8).min),
-                                    jax.lax.max, dims, strides, pads)
+        # identity element in the INPUT's integer dtype: an int8 init
+        # under a uint8 window is a dtype error, not a silent corner
+        init = jnp.array(jnp.iinfo(d.dtype).min, d.dtype)
+        out = jax.lax.reduce_window(d, init, jax.lax.max, dims,
+                                    strides, pads)
     else:
         s = jax.lax.reduce_window(d.astype(jnp.int32), 0, jax.lax.add,
                                   dims, strides, pads)
         n = 1
         for k in kernel:
             n *= int(k)
-        out = jnp.clip(jnp.round(s / n), -127, 127).astype(jnp.int8)
+        lo, hi = (0, 255) if d.dtype == jnp.uint8 else (-127, 127)
+        out = jnp.clip(jnp.round(s / n), lo, hi).astype(d.dtype)
     return out, dmin, dmax
 
 
@@ -148,6 +162,22 @@ def _quantized_pooling(data, dmin, dmax, kernel=(2, 2), stride=None,
              aliases=("quantized_flatten",))
 def _quantized_flatten(data, dmin, dmax):
     return data.reshape(data.shape[0], -1), dmin, dmax
+
+
+@register_op("_contrib_quantized_act", num_outputs=3,
+             aliases=("quantized_act",))
+def _quantized_act(data, dmin, dmax, act_type="relu"):
+    """Activation that stays in the quantized domain (reference:
+    quantized_activation.cc — relu-only, like the MKLDNN int8 path).
+
+    With the symmetric convention (real = q * M / 127, M > 0) relu
+    commutes with dequantization — max(q, 0) * s == max(q * s, 0) — so
+    the output carries the input's range unchanged and no requantize
+    is needed between a quantized conv/fc and its relu."""
+    if act_type != "relu":
+        raise ValueError("quantized activation supports act_type='relu' "
+                         "only, got %r" % (act_type,))
+    return jnp.maximum(data, jnp.array(0, data.dtype)), dmin, dmax
 
 
 # ---------------------------------------------------------------------------

@@ -23,6 +23,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ._precision import matmul_precision
 from .registry import register_op
 
 
@@ -202,7 +203,9 @@ def _deformable_conv(data, offset, weight, bias=None, kernel=(3, 3),
         colg = col[:, g * cpg:(g + 1) * cpg].reshape(
             N, cpg * kh * kw, Ho * Wo)
         wg = w[g * fpg:(g + 1) * fpg]
-        outs.append(jnp.einsum("fk,nkp->nfp", wg, colg))
+        outs.append(jnp.einsum(
+            "fk,nkp->nfp", wg, colg,
+            precision=matmul_precision(wg.dtype, colg.dtype)))
     out = jnp.concatenate(outs, axis=1).reshape(N, int(num_filter),
                                                 Ho, Wo)
     if bias is not None and not no_bias:

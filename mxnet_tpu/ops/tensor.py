@@ -523,14 +523,17 @@ def _linalg_potri(a):
     l_inv = jax.scipy.linalg.solve_triangular(
         a, jnp.broadcast_to(jnp.eye(a.shape[-1], dtype=a.dtype), a.shape),
         lower=True)
-    return jnp.matmul(jnp.swapaxes(l_inv, -1, -2), l_inv)
+    return jnp.matmul(jnp.swapaxes(l_inv, -1, -2), l_inv,
+                      precision=matmul_precision(a.dtype, a.dtype))
 
 
 @register_op("_linalg_trmm", aliases=("linalg_trmm",))
 def _linalg_trmm(a, b, transpose=False, rightside=False, lower=True,
                  alpha=1.0):
     t = jnp.swapaxes(a, -1, -2) if transpose else a
-    out = jnp.matmul(b, t) if rightside else jnp.matmul(t, b)
+    prec = matmul_precision(a.dtype, b.dtype)
+    out = jnp.matmul(b, t, precision=prec) if rightside \
+        else jnp.matmul(t, b, precision=prec)
     return alpha * out
 
 
@@ -550,9 +553,10 @@ def _linalg_trsm(a, b, transpose=False, rightside=False, lower=True,
 @register_op("_linalg_syrk", aliases=("linalg_syrk",))
 def _linalg_syrk(a, transpose=False, alpha=1.0):
     at = jnp.swapaxes(a, -1, -2)
+    prec = matmul_precision(a.dtype, a.dtype)
     if transpose:
-        return alpha * jnp.matmul(at, a)
-    return alpha * jnp.matmul(a, at)
+        return alpha * jnp.matmul(at, a, precision=prec)
+    return alpha * jnp.matmul(a, at, precision=prec)
 
 
 @register_op("_linalg_sumlogdiag", aliases=("linalg_sumlogdiag",))

@@ -1,0 +1,264 @@
+"""Profile-guided calibration: instrumented forward -> CalibTable.
+
+Glow's recipe (PAPERS.md): run the fp32 graph on representative
+traffic and capture every tensor's numeric range, then lower against
+those ranges.  The capture here is **pure-JAX interception at the
+op-registry boundary** — the same topological walk as
+``executor._build_eval`` with a per-tensor ``min``/``max`` (or
+percentile-of-|x|) reduction appended after each op call, all inside
+ONE jitted program per batch shape.  No Python-level tracing hooks, no
+monkeypatching of kernels, nothing a tracer can leak through
+(graftlint-clean by construction).
+
+The result is a :class:`CalibTable`: per-tensor symmetric-friendly
+(min, max) ranges keyed by tensor name, with a sha256 identity over
+the canonical payload.  Tables persist through the resilience layer's
+``atomic_write`` and verify their sha on load — a torn or hand-edited
+table fails typed (:class:`~mxnet_tpu.quantize.policy.QuantizationError`)
+instead of quantizing a model against garbage ranges.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from .policy import QuantizationError
+from ..observability import events as _obs_events
+from ..observability import metrics as _obs_metrics
+
+__all__ = ["CalibTable", "calibrate", "tensor_name"]
+
+_CALIB_BATCHES_TOTAL = _obs_metrics.counter(
+    "quant_calibration_batches_total",
+    "calibration batches run through the instrumented forward")
+
+
+def tensor_name(node, out_idx=0):
+    """Canonical calibration key of a graph entry: the producing
+    node's name, ``name:k`` for secondary outputs."""
+    return node.name if out_idx == 0 else "%s:%d" % (node.name, out_idx)
+
+
+def _build_collect(symbol, data_names, percentile=None):
+    """The instrumented evaluation fn(arg_map, aux_map, key) ->
+    {tensor name: (min, max)} — ``executor._build_eval`` in eval mode
+    with a range reduction appended at the registry boundary."""
+    order = symbol._topo()
+    data_names = frozenset(data_names)
+    csr_aware = ("dot", "cast_storage")
+
+    def stat(v):
+        if percentile is None:
+            return jnp.min(v), jnp.max(v)
+        m = jnp.percentile(jnp.abs(v).astype(jnp.float32).ravel(),
+                           percentile)
+        return -m, m
+
+    def fn(arg_map, aux_map, key):
+        from ..ops.sparse_graph import CsrCarrier
+        vals = {}
+        stats = {}
+        for pos, node in enumerate(order):
+            if node.is_var:
+                v = arg_map[node.name] if node.name in arg_map \
+                    else aux_map[node.name]
+                vals[(id(node), 0)] = v
+                if node.name in data_names and \
+                        jnp.issubdtype(jnp.asarray(v).dtype,
+                                       jnp.floating):
+                    stats[node.name] = stat(v)
+                continue
+            op = node.op
+            ins = [vals[(id(s), i)] for (s, i) in node.inputs]
+            if op.name not in csr_aware:
+                ins = [v.todense() if isinstance(v, CsrCarrier) else v
+                       for v in ins]
+            params = node.params
+            if "training" in op.param_names:
+                params = dict(params, training=False)
+            if op.needs_rng:
+                out = op.fn(jax.random.fold_in(key, pos), *ins,
+                            **params)
+            else:
+                out = op.fn(*ins, **params)
+            if not isinstance(out, tuple):
+                out = (out,)
+            for i, o in enumerate(out):
+                vals[(id(node), i)] = o
+                if hasattr(o, "dtype") and \
+                        jnp.issubdtype(o.dtype, jnp.floating):
+                    stats[tensor_name(node, i)] = stat(o)
+        return stats
+
+    return fn
+
+
+class CalibTable(object):
+    """Per-tensor calibrated ranges with a sha256 identity.
+
+    ``ranges`` maps tensor name -> (min, max) floats.  The sha covers
+    the canonical JSON payload (ranges + mode + percentile), so two
+    tables with identical ranges share an identity and a corrupted
+    file can never load silently.
+    """
+
+    VERSION = 1
+
+    def __init__(self, ranges, mode="minmax", percentile=None,
+                 batches=0):
+        self.ranges = {str(n): (float(lo), float(hi))
+                       for n, (lo, hi) in ranges.items()}
+        self.mode = str(mode)
+        self.percentile = None if percentile is None \
+            else float(percentile)
+        self.batches = int(batches)
+
+    # -- identity ----------------------------------------------------------
+    def payload(self):
+        return {"version": self.VERSION, "mode": self.mode,
+                "percentile": self.percentile, "batches": self.batches,
+                "ranges": {n: [lo, hi] for n, (lo, hi)
+                           in sorted(self.ranges.items())}}
+
+    @property
+    def sha(self):
+        blob = json.dumps(self.payload(), sort_keys=True,
+                          separators=(",", ":")).encode()
+        return hashlib.sha256(blob).hexdigest()
+
+    # -- lookups -----------------------------------------------------------
+    def covers(self, name):
+        return name in self.ranges
+
+    def range(self, name):
+        return self.ranges.get(name)
+
+    def max_abs(self, name):
+        """Symmetric magnitude M of a tensor's range (real = q*M/127),
+        floored away from zero so a dead tensor cannot divide by 0."""
+        lo, hi = self.ranges[name]
+        return max(abs(lo), abs(hi)) or 1e-8
+
+    def __len__(self):
+        return len(self.ranges)
+
+    # -- persistence (resilience layer: atomic, sha-verified) --------------
+    def save(self, path):
+        from ..resilience.checkpoint import atomic_write
+        blob = json.dumps({"calib_table": self.payload(),
+                           "sha": self.sha},
+                          sort_keys=True, indent=1).encode()
+        atomic_write(path, blob)
+        return self.sha
+
+    @classmethod
+    def load(cls, path):
+        try:
+            with open(path, "rb") as f:
+                doc = json.loads(f.read().decode())
+            payload = doc["calib_table"]
+            table = cls(
+                {n: tuple(v) for n, v in payload["ranges"].items()},
+                mode=payload["mode"],
+                percentile=payload.get("percentile"),
+                batches=payload.get("batches", 0))
+            stored = doc["sha"]
+        except QuantizationError:
+            raise
+        except Exception as exc:
+            raise QuantizationError(
+                "calibration table %r is unreadable: %s: %s"
+                % (path, type(exc).__name__, exc))
+        if table.sha != stored:
+            raise QuantizationError(
+                "calibration table %r failed its sha check "
+                "(stored %s != computed %s) — refusing to quantize "
+                "against corrupted ranges"
+                % (path, stored[:12], table.sha[:12]))
+        return table
+
+
+def calibrate(symbol, arg_params, batches, aux_params=None,
+              mode="minmax", percentile=99.99, data_names=None,
+              name="model"):
+    """Run the instrumented forward over *batches* and return a
+    :class:`CalibTable` covering every floating intermediate tensor.
+
+    Parameters
+    ----------
+    symbol : Symbol
+        The fp32 inference graph.
+    arg_params : dict name -> array
+        Model parameters (anything the symbol's arguments need beyond
+        the data inputs).
+    batches : iterable
+        Calibration batches: dicts ``{input name: array}``, or bare
+        arrays for single-input models.
+    mode : "minmax" | "percentile"
+        Global min/max over all batches, or the per-batch
+        *percentile* of |x| (outlier-robust), aggregated by max.
+    """
+    if mode not in ("minmax", "percentile"):
+        raise QuantizationError(
+            "calibration mode must be 'minmax' or 'percentile', "
+            "got %r" % (mode,))
+    pct = float(percentile) if mode == "percentile" else None
+    params = {}
+    for n, v in (arg_params or {}).items():
+        data = getattr(v, "_data", None)
+        params[n] = data if data is not None else jnp.asarray(v)
+    aux = {}
+    for n, v in (aux_params or {}).items():
+        data = getattr(v, "_data", None)
+        aux[n] = data if data is not None else jnp.asarray(v)
+    if data_names is None:
+        data_names = [n for n in symbol.list_arguments()
+                      if n not in params]
+    data_names = list(data_names)
+    collect = jax.jit(_build_collect(symbol, data_names,
+                                     percentile=pct))
+    key = jax.random.PRNGKey(0)
+
+    agg = {}
+    n_batches = 0
+    for batch in batches:
+        if not isinstance(batch, dict):
+            if len(data_names) != 1:
+                raise QuantizationError(
+                    "calibration batches must be dicts for a model "
+                    "with %d data inputs %s"
+                    % (len(data_names), sorted(data_names)))
+            batch = {data_names[0]: batch}
+        feeds = {}
+        for dn in data_names:
+            if dn not in batch:
+                raise QuantizationError(
+                    "calibration batch is missing input %r" % dn)
+            v = batch[dn]
+            data = getattr(v, "_data", None)
+            feeds[dn] = data if data is not None else jnp.asarray(v)
+        stats = collect(dict(params, **feeds), aux, key)
+        for tname, (lo, hi) in stats.items():
+            lo = float(lo)
+            hi = float(hi)
+            cur = agg.get(tname)
+            if cur is None:
+                agg[tname] = (lo, hi)
+            else:
+                agg[tname] = (min(cur[0], lo), max(cur[1], hi))
+        n_batches += 1
+        _CALIB_BATCHES_TOTAL.inc()
+    if not n_batches:
+        raise QuantizationError(
+            "calibration needs at least one batch (model %r)" % name)
+    table = CalibTable(agg, mode=mode, percentile=pct,
+                       batches=n_batches)
+    _obs_events.emit("quantize", kind="calibrate", model=name,
+                     mode=mode, batches=n_batches, tensors=len(table),
+                     sha=table.sha[:12])
+    return table

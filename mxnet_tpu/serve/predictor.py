@@ -201,6 +201,10 @@ class CompiledPredictor:
         # (None = untuned); DynamicBatcher reads its scalar knobs,
         # health() surfaces it (docs/autotuning.md)
         self.tuning = None
+        # quantization report the registry attached at load time
+        # (None = fp32): mode, calib sha, per-layer coverage, gate
+        # results — surfaced by health() (docs/quantization.md)
+        self.quantization = None
 
     # -- introspection -----------------------------------------------------
     @property
@@ -297,6 +301,22 @@ class CompiledPredictor:
                 seconds=round(dt, 4), programs=len(self._programs))
             return prog
 
+    def rung_shapes(self, b):
+        """The padded input shapes of the rung that serves a natural
+        batch of *b* rows (construction data shapes, bucket-rounded)."""
+        return {n: ((self.ladder.batch_for(b),) + tuple(
+            self.ladder.round_axis(ax, d)
+            for ax, d in enumerate(s[1:], start=1)))
+            if n in self._bucket_inputs else s
+            for n, s in self._data_shapes.items()}
+
+    def lowered_text(self, shapes):
+        """StableHLO of the program for *shapes* (lower only, no
+        compile) — what the quantization gate greps for int8 compute
+        and costs.py prices."""
+        pa, aa, da, ka = self._avals(shapes)
+        return self._jit.lower(pa, aa, da, ka).as_text()
+
     def warm(self, batches=None):
         """Pre-compile one program per batch rung (at the construction
         data shapes) so the request path starts hot, and PRIME each
@@ -305,11 +325,7 @@ class CompiledPredictor:
         Returns the number of programs built."""
         before = self._compiles
         for b in (batches or self.ladder.batches):
-            shapes = {n: ((self.ladder.batch_for(b),) + tuple(
-                self.ladder.round_axis(ax, d)
-                for ax, d in enumerate(s[1:], start=1)))
-                if n in self._bucket_inputs else s
-                for n, s in self._data_shapes.items()}
+            shapes = self.rung_shapes(b)
             prog = self.ensure_program(shapes)
             zeros = {n: _np.zeros(s, self._data_dtypes[n])
                      for n, s in shapes.items()}

@@ -44,6 +44,13 @@ _MODELS_GAUGE = _obs_metrics.gauge(
 _DRAINS_TOTAL = _obs_metrics.counter(
     "serve_drains_total",
     "graceful drains started (Registry.drain + unload(drain=True))")
+_QUANT_MODELS_GAUGE = _obs_metrics.gauge(
+    "serve_quantized_models",
+    "quantized models resident across all serve registries "
+    "(delta-maintained)")
+_QUANT_GATE_FAILURES = _obs_metrics.counter(
+    "quant_accuracy_gate_failures_total",
+    "quantized loads rejected by the load-time accuracy gate")
 
 
 class ModelRegistry:
@@ -61,7 +68,8 @@ class ModelRegistry:
     # -- loading -----------------------------------------------------------
     def load(self, name, symbol, arg_params, aux_params=None,
              data_shapes=None, ladder=None, data_dtypes=None, ctx=None,
-             warm=True, bucket_inputs=None):
+             warm=True, bucket_inputs=None, quantize=None, calib=None,
+             calib_batches=None):
         """Register and (by default) warm-compile a model.  Returns
         the :class:`CompiledPredictor`.  Re-loading a live name
         replaces it atomically (aliases keep pointing at the name; the
@@ -76,7 +84,22 @@ class ModelRegistry:
         for the batcher's scalar knobs, and ``health(name)`` surfaces
         a ``tuning`` section.  Precedence everywhere: explicit
         argument > exported env var > tuned store > registered
-        default (docs/autotuning.md)."""
+        default (docs/autotuning.md).
+
+        *quantize* (``"int8"`` / ``"int8-weight-only"`` / a
+        :class:`~mxnet_tpu.quantize.QuantizePolicy` / ``None``) lowers
+        the model through ``mxnet_tpu.quantize`` before building the
+        rungs.  Weight+activation mode needs ranges: pass *calib* (a
+        ``CalibTable`` or a saved table's path) or *calib_batches*
+        (representative batches to calibrate on at load).  Every rung
+        is then GATED against the fp32 model — int8 compute must be
+        present in the lowered StableHLO and accuracy must be within
+        the policy's thresholds — or the load fails with a typed
+        :class:`~mxnet_tpu.quantize.QuantizationError` and nothing is
+        installed.  ``health(name)`` grows a ``quantization`` section
+        (docs/quantization.md)."""
+        from ..quantize import QuantizePolicy
+        policy = QuantizePolicy.coerce(quantize)
         tuning = self._tuning_entry(name)
         if ladder is None and tuning:
             rungs = (tuning.get("config") or {}).get("ladder")
@@ -96,8 +119,16 @@ class ModelRegistry:
         if not replacing:
             self._board.transition(name, "loading")
         try:
+            qreport = None
+            serve_symbol, serve_args, serve_aux = \
+                symbol, arg_params, aux_params
+            if policy is not None:
+                serve_symbol, serve_args, serve_aux, qreport = \
+                    self._quantize_build(name, symbol, arg_params,
+                                         aux_params, policy, calib,
+                                         calib_batches)
             pred = CompiledPredictor(
-                symbol, arg_params, aux_params=aux_params,
+                serve_symbol, serve_args, aux_params=serve_aux,
                 data_shapes=data_shapes, ladder=ladder,
                 data_dtypes=data_dtypes, ctx=ctx, name=name,
                 bucket_inputs=bucket_inputs)
@@ -107,6 +138,12 @@ class ModelRegistry:
                 built = pred.warm()
             else:
                 built = 0
+            if policy is not None:
+                self._gate_quantized(
+                    name, pred, symbol, arg_params, aux_params,
+                    data_shapes=data_shapes, data_dtypes=data_dtypes,
+                    ctx=ctx, bucket_inputs=bucket_inputs,
+                    policy=policy, report=qreport)
         except Exception as exc:
             if not replacing:
                 self._board.drop(name)
@@ -121,6 +158,12 @@ class ModelRegistry:
             displaced = self._models.get(name)
             if displaced is None:
                 _MODELS_GAUGE.inc()  # delta: aggregates across registries
+            was_q = displaced is not None and \
+                getattr(displaced, "quantization", None) is not None
+            if policy is not None and not was_q:
+                _QUANT_MODELS_GAUGE.inc()
+            elif was_q and policy is None:
+                _QUANT_MODELS_GAUGE.dec()
             self._models[name] = pred
             # ready-mark INSIDE the install lock: marking after release
             # let a fully-completed concurrent unload drop the board
@@ -145,7 +188,9 @@ class ModelRegistry:
         _obs_events.emit("serve", kind="load", model=name,
                          programs=built, warm=bool(warm),
                          buckets=list(pred.ladder.batches),
-                         **({"tuned": True} if tuning else {}))
+                         **dict(({"tuned": True} if tuning else {}),
+                                **({"quantized": policy.mode}
+                                   if policy else {})))
         return pred
 
     @staticmethod
@@ -156,6 +201,133 @@ class ModelRegistry:
         store that is not there must not silently run defaults."""
         from ..autotune.store import lookup
         return lookup(name, workload)
+
+    # -- quantized loading -------------------------------------------------
+    @staticmethod
+    def _quantize_build(name, symbol, arg_params, aux_params, policy,
+                        calib, calib_batches):
+        """Lower the fp32 model per *policy*.  Resolves the
+        calibration source (table object > saved table path >
+        calibrate on *calib_batches* now) and returns the quantized
+        (symbol, args, aux, report)."""
+        from ..quantize import (CalibTable, QuantizationError,
+                                calibrate, quantize_model)
+        table = None
+        if policy.needs_calib:
+            if isinstance(calib, CalibTable):
+                table = calib
+            elif isinstance(calib, str):
+                table = CalibTable.load(calib)
+            elif calib is not None:
+                raise QuantizationError(
+                    "calib must be a CalibTable or a saved table "
+                    "path, got %s" % type(calib).__name__)
+            elif calib_batches is not None:
+                table = calibrate(symbol, arg_params, calib_batches,
+                                  aux_params=aux_params, name=name)
+            else:
+                raise QuantizationError(
+                    "load(%r, quantize='int8') needs calibration "
+                    "ranges: pass calib= (CalibTable or path) or "
+                    "calib_batches=" % name)
+        return quantize_model(symbol, arg_params, calib=table,
+                              policy=policy, aux_params=aux_params,
+                              name=name)
+
+    @staticmethod
+    def _gate_quantized(name, pred, symbol, arg_params, aux_params,
+                        data_shapes, data_dtypes, ctx, bucket_inputs,
+                        policy, report):
+        """Load-time gate: at EVERY rung the quantized predictor must
+        (a) provably carry int8 compute in its lowered StableHLO and
+        (b) agree with an fp32 reference predictor within the policy's
+        accuracy thresholds.  Failure increments
+        ``quant_accuracy_gate_failures_total`` and raises typed — a
+        quantized model never serves silently-wrong answers.  On
+        success the report (+ per-rung gate numbers) rides on
+        ``pred.quantization`` for ``health()``."""
+        import numpy as _np
+        from ..quantize import (QuantizationError, hlo_has_int8_compute,
+                                hlo_has_int8_tensors)
+        ref = CompiledPredictor(
+            symbol, arg_params, aux_params=aux_params,
+            data_shapes=data_shapes, ladder=pred.ladder,
+            data_dtypes=data_dtypes, ctx=ctx, name="%s-fp32ref" % name,
+            bucket_inputs=bucket_inputs)
+        hlo_ok = hlo_has_int8_compute if policy.mode == "int8" \
+            else hlo_has_int8_tensors
+        # NOT seed 0: params initialized from the ubiquitous
+        # RandomState(0) share their leading draws with a seed-0 gate
+        # stream, so the first gate row ~ the first weight row — a
+        # manufactured outlier activation far outside any calibrated
+        # range (observed: rel err 0.18 vs 0.01 on decorrelated input)
+        rng = _np.random.RandomState(0x5EED)
+        rungs = {}
+        worst_err = 0.0
+        worst_top1 = None
+
+        def _fail(why):
+            _QUANT_GATE_FAILURES.inc()
+            _obs_events.emit("quantize", kind="gate_failed",
+                             model=name, mode=policy.mode, error=why)
+            raise QuantizationError(
+                "model %r failed the quantization gate: %s"
+                % (name, why))
+
+        for b in pred.ladder.batches:
+            if not hlo_ok(pred.lowered_text(pred.rung_shapes(b))):
+                _fail("rung %d: no int8 %s in the lowered StableHLO"
+                      % (b, "dot/conv compute" if policy.mode == "int8"
+                         else "tensors"))
+            errs, agree = [], []
+            for _ in range(max(1, policy.gate_batches)):
+                data = {n: rng.standard_normal(
+                    (b,) + tuple(s[1:])).astype(
+                        str(pred._data_dtypes[n]))
+                    for n, s in pred._data_shapes.items()}
+                q_out = pred.predict(data)
+                f_out = ref.predict(data)
+                for qo, fo in zip(q_out, f_out):
+                    qa, fa = qo.asnumpy(), fo.asnumpy()
+                    denom = float(_np.abs(fa).max()) or 1.0
+                    errs.append(float(_np.abs(qa - fa).max()) / denom)
+                    if fa.ndim == 2 and fa.shape[1] > 1:
+                        agree.append(float(_np.mean(
+                            qa.argmax(axis=1) == fa.argmax(axis=1))))
+            err = max(errs)
+            top1 = min(agree) if agree else None
+            rungs[b] = {"rel_err": round(err, 6),
+                        "top1_agreement": top1}
+            worst_err = max(worst_err, err)
+            if top1 is not None:
+                worst_top1 = top1 if worst_top1 is None \
+                    else min(worst_top1, top1)
+            if err > policy.max_rel_err:
+                _fail("rung %d: rel err %.4f > %.4f vs fp32"
+                      % (b, err, policy.max_rel_err))
+            if policy.min_top1_agreement is not None and \
+                    top1 is not None and \
+                    top1 < policy.min_top1_agreement:
+                _fail("rung %d: top-1 agreement %.4f < %.4f vs fp32"
+                      % (b, top1, policy.min_top1_agreement))
+        pred.quantization = {
+            "mode": policy.mode,
+            "calib_sha": report.get("calib_sha"),
+            "layers": report.get("layers"),
+            "passthrough": report.get("passthrough"),
+            "covered": report.get("covered"),
+            "total": report.get("total"),
+            "policy": policy.to_dict(),
+            "gate": {"max_rel_err": round(worst_err, 6),
+                     "min_top1_agreement": worst_top1,
+                     "rungs": rungs},
+        }
+        _obs_events.emit(
+            "quantize", kind="gate", model=name, mode=policy.mode,
+            covered=report.get("covered"), total=report.get("total"),
+            max_rel_err=round(worst_err, 6),
+            rungs=sorted(rungs),
+            calib_sha=(report.get("calib_sha") or "")[:12] or None)
 
     def load_checkpoint(self, name, prefix, epoch, data_shapes,
                         **kwargs):
@@ -364,6 +536,8 @@ class ModelRegistry:
             b = self._batchers.pop(name, None)
             batcher = b or batcher
             _MODELS_GAUGE.dec()
+            if getattr(pred, "quantization", None) is not None:
+                _QUANT_MODELS_GAUGE.dec()
         if batcher is not None:
             # the board entry dies below — a late dispatcher crash must
             # not resurrect it under the dropped name
@@ -464,6 +638,16 @@ class ModelRegistry:
                     "max_wait_ms": batcher._max_wait * 1e3,
                     "max_batch": batcher._max_batch,
                 }
+        quant = getattr(pred, "quantization", None)
+        if quant:
+            info["quantization"] = {
+                "mode": quant.get("mode"),
+                "calib_sha": quant.get("calib_sha"),
+                "covered": quant.get("covered"),
+                "total": quant.get("total"),
+                "layers": quant.get("layers"),
+                "gate": quant.get("gate"),
+            }
         engines = list(getattr(pred, "_decode_engines", ())) \
             if pred is not None else []
         if engines:

@@ -1,0 +1,193 @@
+"""INT8 kernel parity corners (ISSUE 17 satellite).
+
+The existing quantization tests cover the happy paths; these pin the
+numeric conventions the graph-level pipeline (mxnet_tpu/quantize)
+leans on: the requantize scale with and without a pre-computed calib
+range, the int32 accumulator range that bias folding divides by,
+quantized pooling at uint8 vs int8 inputs, and the 2-bit wire pack.
+"""
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import nd
+from mxnet_tpu.ops.quantization import pack_2bit, unpack_2bit
+
+INT32_MAX = 2.0 ** 31 - 1
+
+
+def _acc_of(real, m):
+    """Synthesize the int32 accumulator whose symmetric range is +-m
+    (float64 math: float32 rounds 2^31-1 up and overflows the cast)."""
+    scaled = np.round(np.asarray(real, np.float64) / m * INT32_MAX)
+    return np.clip(scaled, -INT32_MAX, INT32_MAX).astype(np.int32)
+
+
+# -- requantize -------------------------------------------------------------
+
+def test_requantize_without_calib_range():
+    # int32 accumulator carrying a symmetric real range: real =
+    # q * MaxAbs(min, max) / (2^31-1).  Without a calib range the
+    # output range is the input's.
+    m = 3.0
+    real = np.array([-2.5, -1.0, 0.0, 0.5, 3.0], np.float32)
+    acc = _acc_of(real, m)
+    q, lo, hi = nd._contrib_requantize(
+        nd.array(acc), nd.array(-m), nd.array(m))
+    assert str(q.asnumpy().dtype) == "int8"
+    assert float(lo.asnumpy()) == -m and float(hi.asnumpy()) == m
+    back = q.asnumpy().astype(np.float32) * m / 127.0
+    np.testing.assert_allclose(back, real, atol=m / 127.0)
+
+
+def test_requantize_with_calib_range_clips():
+    # a tighter calibrated range rescales AND saturates: values beyond
+    # the calib range pin at +-127
+    m = 4.0
+    real = np.array([-3.5, -1.0, 0.0, 1.0, 3.5], np.float32)
+    acc = _acc_of(real, m)
+    cal = 2.0
+    q, lo, hi = nd._contrib_requantize(
+        nd.array(acc), nd.array(-m), nd.array(m),
+        min_calib_range=-cal, max_calib_range=cal)
+    qv = q.asnumpy()
+    assert float(lo.asnumpy()) == -cal and float(hi.asnumpy()) == cal
+    assert qv[0] == -127 and qv[-1] == 127          # saturated
+    back = qv.astype(np.float32) * cal / 127.0
+    np.testing.assert_allclose(back[1:4], real[1:4], atol=cal / 127.0)
+
+
+def test_requantize_matches_dequantize_scale():
+    # the requantize input scale and _dequantize's int32 branch must
+    # agree, or fused vs unfused graphs drift: dequantize(acc) ==
+    # dequantize(requantize(acc)) within one int8 step
+    rs = np.random.RandomState(0)
+    m = 1.7
+    acc = rs.randint(-2 ** 30, 2 ** 30, 64).astype(np.int32)
+    direct = nd.dequantize(nd.array(acc), nd.array(-m),
+                           nd.array(m)).asnumpy()
+    q, lo, hi = nd._contrib_requantize(nd.array(acc), nd.array(-m),
+                                       nd.array(m))
+    two_step = nd.dequantize(q, lo, hi).asnumpy()
+    np.testing.assert_allclose(two_step, direct, atol=m / 127.0)
+
+
+def test_quantize_qfc_requantize_dequantize_chain_close_to_fp32():
+    # the exact op chain the lowering emits for one FC layer
+    rs = np.random.RandomState(1)
+    x = rs.randn(4, 16).astype(np.float32)
+    w = (rs.randn(8, 16) * 0.3).astype(np.float32)
+    ref = x @ w.T
+    mx_, mw = float(np.abs(x).max()), float(np.abs(w).max())
+    qx, xlo, xhi = nd.quantize(nd.array(x), nd.array(-mx_),
+                               nd.array(mx_), out_type="int8")
+    qw = np.round(w * 127.0 / mw).astype(np.int8)
+    acc, alo, ahi = nd.quantized_fc(
+        qx, nd.array(qw), xlo, xhi, nd.array(-mw), nd.array(mw),
+        num_hidden=8)
+    mo = float(np.abs(ref).max()) * 1.1
+    q8, olo, ohi = nd._contrib_requantize(
+        acc, alo, ahi, min_calib_range=-mo, max_calib_range=mo)
+    out = nd.dequantize(q8, olo, ohi).asnumpy()
+    err = np.abs(out - ref).max() / np.abs(ref).max()
+    assert err < 0.03, err
+
+
+# -- int32 accumulator range ------------------------------------------------
+
+def test_int32_range_bias_accumulation_bounds():
+    # dequantizing the raw accumulator against _int32_range's bounds
+    # must recover real values — including a folded int32 bias at the
+    # accumulator scale s_d * s_w (how the lowering adds biases)
+    rs = np.random.RandomState(2)
+    md, mw = 2.0, 0.5
+    d = rs.randint(-127, 128, (3, 10)).astype(np.int8)
+    w = rs.randint(-127, 128, (5, 10)).astype(np.int8)
+    bias = (rs.randn(5) * 0.2).astype(np.float32)
+    s_acc = (md / 127.0) * (mw / 127.0)
+    bq = np.round(bias / s_acc).astype(np.int32)
+    acc, lo, hi = nd.quantized_fc(
+        nd.array(d), nd.array(w), nd.array(-md), nd.array(md),
+        nd.array(-mw), nd.array(mw), num_hidden=5)
+    acc_b = acc.asnumpy() + bq[None, :]
+    real = (d.astype(np.int64) @ w.T.astype(np.int64)) * s_acc + bias
+    # the advertised range bound really bounds the scale
+    expected_m = s_acc * INT32_MAX
+    np.testing.assert_allclose(float(lo.asnumpy()), -expected_m,
+                               rtol=1e-6)
+    np.testing.assert_allclose(float(hi.asnumpy()), expected_m,
+                               rtol=1e-6)
+    back = nd.dequantize(nd.array(acc_b), lo, hi).asnumpy()
+    np.testing.assert_allclose(back, real, atol=2 * s_acc)
+
+
+# -- quantized pooling dtype corners ---------------------------------------
+
+@pytest.mark.parametrize("pool_type", ["max", "avg"])
+def test_quantized_pooling_uint8(pool_type):
+    rs = np.random.RandomState(3)
+    x = rs.randint(0, 256, (1, 2, 4, 4)).astype(np.uint8)
+    out, _, _ = nd.quantized_pooling(
+        nd.array(x), nd.array(0.0), nd.array(2.0), kernel=(2, 2),
+        stride=(2, 2), pool_type=pool_type)
+    ov = out.asnumpy()
+    assert str(ov.dtype) == "uint8"
+    blocks = x.reshape(1, 2, 2, 2, 2, 2).transpose(0, 1, 2, 4, 3, 5) \
+        .reshape(1, 2, 4, 4)[..., :]  # noqa: F841 (windows below)
+    for i in range(2):
+        for j in range(2):
+            win = x[0, :, 2 * i:2 * i + 2, 2 * j:2 * j + 2]
+            if pool_type == "max":
+                exp = win.reshape(2, -1).max(axis=1)
+            else:
+                exp = np.clip(np.round(
+                    win.reshape(2, -1).astype(np.int32).mean(axis=1)),
+                    0, 255).astype(np.uint8)
+            np.testing.assert_array_equal(ov[0, :, i, j], exp)
+
+
+@pytest.mark.parametrize("pool_type", ["max", "avg"])
+def test_quantized_pooling_int8_negative_values(pool_type):
+    # all-negative int8 input: a zero (or uint8-min) init would
+    # corrupt max pooling; avg must clip to the int8 lattice
+    x = -np.arange(1, 17, dtype=np.int8).reshape(1, 1, 4, 4)
+    out, _, _ = nd.quantized_pooling(
+        nd.array(x), nd.array(-1.0), nd.array(1.0), kernel=(2, 2),
+        stride=(2, 2), pool_type=pool_type)
+    ov = out.asnumpy()
+    assert str(ov.dtype) == "int8"
+    assert ov.max() < 0
+    if pool_type == "max":
+        np.testing.assert_array_equal(
+            ov[0, 0], [[-1, -3], [-9, -11]])
+
+
+def test_quantized_pooling_global_uint8():
+    x = np.arange(32, dtype=np.uint8).reshape(1, 2, 4, 4)
+    out, _, _ = nd.quantized_pooling(
+        nd.array(x), nd.array(0.0), nd.array(1.0), pool_type="max",
+        global_pool=True)
+    np.testing.assert_array_equal(
+        out.asnumpy().ravel(), [15, 31])
+
+
+# -- 2-bit wire pack --------------------------------------------------------
+
+@pytest.mark.parametrize("n", [0, 1, 2, 3, 4, 5, 7, 8, 15, 64, 1001])
+def test_pack_unpack_2bit_roundtrip_ragged(n):
+    rs = np.random.RandomState(n)
+    codes = rs.randint(-1, 2, n).astype(np.int8)
+    packed, count = pack_2bit(codes)
+    assert count == n
+    assert len(packed) == (n + 3) // 4
+    assert str(packed.dtype) == "uint8"
+    back = unpack_2bit(packed, count)
+    np.testing.assert_array_equal(back, codes)
+
+
+def test_pack_2bit_accepts_nd_shapes():
+    rs = np.random.RandomState(7)
+    codes = rs.randint(-1, 2, (3, 5, 2)).astype(np.int8)
+    packed, count = pack_2bit(codes)
+    back = unpack_2bit(packed, count)
+    np.testing.assert_array_equal(back, codes.ravel())
