@@ -1523,6 +1523,40 @@ def decompose_main():
     return 0
 
 
+def audit_main():
+    """``--audit``: lower the graftir representative AOT program set,
+    run rules GI001-GI005, diff per-program flops/bytes/sha against
+    the committed manifest, print the human diff table to stderr and
+    ONE JSON line (BENCH schema: metric=ir_audit) to stdout.  A
+    static measurement over lowered text — nothing executes, so it
+    ALWAYS runs on CPU (the committed manifest shas are CPU lowers)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from tools.graftir import (audit_programs, diff as manifest_diff,
+                               format_diff_table, load as manifest_load,
+                               DEFAULT_MANIFEST)
+    from tools.graftir.programs import build_representative_set
+
+    programs = build_representative_set()
+    engine, findings = audit_programs(programs)
+    rows, violations = manifest_diff(programs,
+                                     manifest_load(DEFAULT_MANIFEST))
+    print(format_diff_table(rows), file=sys.stderr)
+    for v in violations:
+        print("bench: audit: %s" % v, file=sys.stderr)
+    out = {
+        "metric": "ir_audit",
+        "programs": len(programs),
+        "findings": len(findings),
+        "new_findings": engine.stats["new"],
+        "violations": len(violations),
+        "flops_total": round(sum(r["flops"] or 0.0 for r in rows), 1),
+        "bytes_total": round(sum(r["bytes"] or 0.0 for r in rows), 1),
+        "rows": rows,
+    }
+    print(json.dumps(out))
+    return 1 if (engine.stats["new"] or violations) else 0
+
+
 def _argv_path(flag):
     """Value of ``flag PATH`` in sys.argv, or None (bench's dispatch
     is flag-sniffing, not argparse — keep trace flags the same)."""
@@ -1566,6 +1600,8 @@ def main():
         return 0
     if "--decompose" in sys.argv:
         return decompose_main()
+    if "--audit" in sys.argv:
+        return audit_main()
     if "--compare-decode-paths" in sys.argv:
         # batched decode ticks vs serial per-session dense decode — a
         # relative dispatch-count measurement, so it ALWAYS runs on

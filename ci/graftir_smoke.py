@@ -1,0 +1,137 @@
+"""graftir CI smoke (ci/run_tests.sh stage).
+
+Lowers the representative AOT program set on CPU avals (nothing
+executes beyond the builders' own warmups), then proves the auditor
+both PASSES the shipped tree and CATCHES the regressions it exists
+for:
+
+* clean pass — rules GI001-GI005 report zero new findings and the
+  committed manifest diffs all-ok (any drift here is a real PR
+  regression, same as ``python -m tools.graftir --check``);
+* seeded 2x cost regression — duplicating the compute ops of one
+  program must fail the manifest check naming that program;
+* stripped donation — removing the ``tf.aliasing_output`` /
+  ``jax.buffer_donor`` entry attrs from the fused step must raise
+  GI001 naming the program;
+* injected f64 — a smuggled f64 op line must raise GI002 naming the
+  program.
+
+The point is meta-level drift protection: a refactor that silently
+blinds a rule (regex rot against a new jax pretty-printer, a lost
+producer declaration) shows up HERE, in seconds — not as a real
+regression sailing through CI three PRs later.
+
+Last stdout line is the scrapeable summary:
+``graftir: programs=N findings=0 ok``.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("MXNET_SAN", "all")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.graftir import (audit_programs, diff as manifest_diff,  # noqa: E402
+                           load as manifest_load, DEFAULT_MANIFEST)
+from tools.graftir.hlo import Program  # noqa: E402
+from tools.graftir.programs import build_representative_set  # noqa: E402
+
+FAILURES = []
+
+
+def check(ok, what):
+    tag = "ok" if ok else "FAIL"
+    print("  [%s] %s" % (tag, what))
+    if not ok:
+        FAILURES.append(what)
+
+
+def clone(p, text):
+    """A copy of Program *p* with mutated HLO text, declarations kept."""
+    return Program(p.subsystem, p.name, text, model=p.model,
+                   donated=p.donated, dtype_policy=p.dtype_policy,
+                   hot_path=p.hot_path, bucket_rows=p.bucket_rows,
+                   natural_rows=p.natural_rows, budget=p.budget,
+                   suppress=p.suppress, f32_allow=p.f32_allow)
+
+
+def main():
+    print("== graftir smoke: lowering representative set ==")
+    programs = build_representative_set()
+    by_key = {p.key(): p for p in programs}
+    print("  programs: %s" % ", ".join(sorted(by_key)))
+
+    # -- 1. shipped tree must be clean ---------------------------------
+    print("== clean pass (rules + manifest) ==")
+    engine, findings = audit_programs(programs)
+    check(engine.stats["new"] == 0,
+          "rules clean on shipped tree (new=%d)" % engine.stats["new"])
+    rows, violations = manifest_diff(programs,
+                                     manifest_load(DEFAULT_MANIFEST))
+    bad = [r for r in rows if r["status"] != "ok"]
+    check(not violations and not bad,
+          "manifest diff all-ok (%d row(s), %d violation(s))"
+          % (len(rows), len(violations)))
+
+    # -- 2. seeded 2x cost regression must fail the manifest check -----
+    print("== seeded 2x cost regression ==")
+    victim = by_key["serve/predict/b8"]
+    doubled = "\n".join(
+        line + "\n" + line if ("dot_general" in line or
+                               "dot " in line) else line
+        for line in victim.text.splitlines())
+    seeded = [clone(p, doubled) if p is victim else p for p in programs]
+    _, violations = manifest_diff(seeded, manifest_load(DEFAULT_MANIFEST))
+    hits = [v for v in violations
+            if "serve/predict/b8" in v and "grew" in v]
+    check(bool(hits),
+          "manifest names the grown program (%s)"
+          % (hits[0] if hits else "no violation raised"))
+
+    # -- 3. stripped donation must raise GI001 -------------------------
+    print("== stripped donation ==")
+    victim = by_key["train/fused_step"]
+    check(victim.donated_args() > 0,
+          "fused step carries donation attrs before the strip (%d)"
+          % victim.donated_args())
+    stripped = (victim.text
+                .replace("tf.aliasing_output", "tf.stripped_attr")
+                .replace("jax.buffer_donor", "jax.stripped_attr"))
+    _, new = audit_programs([clone(victim, stripped)],
+                            rules=["GI001"], use_baseline=False)
+    hits = [f for f in new if f.rule == "GI001"
+            and f.program.key() == "train/fused_step"]
+    check(bool(hits),
+          "GI001 names the stripped program (%s)"
+          % (hits[0].message if hits else "no finding raised"))
+
+    # -- 4. injected f64 must raise GI002 ------------------------------
+    print("== injected f64 ==")
+    victim = by_key["decode/tick/S2"]
+    poisoned = (victim.text +
+                "\n  %smuggled = stablehlo.constant dense<0.0> "
+                ": tensor<4xf64>\n")
+    _, new = audit_programs([clone(victim, poisoned)],
+                            rules=["GI002"], use_baseline=False)
+    hits = [f for f in new if f.rule == "GI002"
+            and f.program.key() == "decode/tick/S2"]
+    check(bool(hits),
+          "GI002 names the f64 program (%s)"
+          % (hits[0].message if hits else "no finding raised"))
+
+    if FAILURES:
+        print("graftir smoke: %d FAILURE(s):" % len(FAILURES))
+        for f in FAILURES:
+            print("  - %s" % f)
+        return 1
+    print("graftir: programs=%d findings=%d ok"
+          % (len(programs), engine.stats["new"]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,6 +15,21 @@ echo "== sanity: graftlint static analysis =="
 # the last stdout line is the scrapeable summary ("graftlint: ...").
 python -m tools.graftlint mxnet_tpu
 
+echo "== graftir: StableHLO program audit + manifest gate =="
+# Lowers the representative AOT program set (fused step, serve rungs,
+# decode tick/prefill, quantized rung) on CPU avals and audits the
+# StableHLO text: rules GI001-GI005 (donation coverage, dtype policy,
+# host round-trips, pad-waste, program budgets) against the committed
+# baseline, plus the committed per-program cost manifest
+# (tools/graftir/manifest.json — >10% flops/bytes growth or program-
+# count drift fails; --update-manifest to accept an intended change).
+# The smoke also proves the auditor still CATCHES seeded regressions
+# (2x cost, stripped donation, injected f64).  Seconds, CPU-only
+# (docs/ir_audit.md).  Last stdout line is the scrapeable summary
+# ("graftir: programs=.. findings=.. ok").
+MXNET_SAN=all python ci/graftir_smoke.py
+python -m tools.graftir --check
+
 echo "== graftsan: sanitizer-enabled smoke train step =="
 # Fused + partial-fused train steps, PrefetchingIter, local kvstore
 # with ALL FOUR runtime sanitizers on (race/lockset + lock-order,

@@ -201,12 +201,17 @@ register_env("MXNET_SAN", str, "",
              "graftsan runtime sanitizer components to enable: comma "
              "list of race,recompile,donation,transfer, or 'all'; "
              "empty = off (zero overhead; see docs/sanitizers.md)")
+register_env("MXNET_IR_AUDIT", str, "",
+             "Audit every AOT program's lowered StableHLO with the "
+             "graftir rules (tools/graftir) as it is built: findings "
+             "are logged, counted and evented ('iraudit' category); "
+             "empty = off (zero overhead; see docs/ir_audit.md)")
 register_env("MXNET_OBS", str, "",
              "Structured run-event categories to record to "
              "events.jsonl: comma list of compile,guard,chaos,"
              "checkpoint,preempt,retry,respawn,warning,kvstore,"
              "membership,supervisor,watchdog,serve,decode,fleet,"
-             "autotune, or 'all'; "
+             "autotune,iraudit, or 'all'; "
              "empty = off (no file, zero per-event cost; see "
              "docs/observability.md)")
 register_env("MXNET_OBS_PATH", str, "events.jsonl",

@@ -1,0 +1,143 @@
+"""Bridge to the graftir static IR auditor (tools/graftir).
+
+Production code never imports ``tools.graftir`` directly — the AOT
+program producers (the fused train step, ``CompiledPredictor``,
+``DecodeEngine``, the quantize gate) call :func:`audit` here with
+their lowered StableHLO text and their declarations (donation
+promise, dtype policy, bucket geometry, program budget), and the
+bridge falls through to a no-op unless ``MXNET_IR_AUDIT`` is set.
+
+The off-path cost is one environment read per *program build* (not
+per dispatch) and zero extra lowering: every hook sits on a path that
+already has — or is about to produce — the lowered text.
+
+Two consumers:
+
+* **production** (``MXNET_IR_AUDIT=1``): each registered program is
+  audited immediately against the graftir rules + committed baseline;
+  new findings are logged, counted
+  (``mxnet_ir_audit_findings_total``) and evented (``iraudit``
+  category).  The bridge keeps the per-process program list so GI005
+  (program-count budget) sees request-path compiles that sneak in
+  after warmup.
+* **the representative-set builder** (``tools/graftir/programs.py``):
+  :func:`collect` redirects registrations into a list instead of
+  auditing, so ``python -m tools.graftir`` exercises the *same
+  producer hooks* CI ships.
+
+Like the graftsan bridge, the implementation lives in the repo's
+``tools/`` tree; enabling the knob without that tree raises a clear
+error instead of silently auditing nothing.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import threading
+
+__all__ = ["enabled", "audit", "collect"]
+
+_COLLECT = None          # active collector list (forces enabled())
+_SEEN = []               # per-process audited programs (GI005 groups)
+_LOCK = threading.Lock()
+_FINDINGS_TOTAL = None   # lazy counter
+_LOG = logging.getLogger("mxnet_tpu.iraudit")
+
+
+def enabled():
+    """Is the IR audit on?  (read from env each call, like MXNET_SAN)"""
+    if _COLLECT is not None:
+        return True
+    raw = os.environ.get("MXNET_IR_AUDIT", "").strip().lower()
+    return bool(raw) and raw not in ("0", "off", "none", "false")
+
+
+def _graftir():
+    """Import tools.graftir (repo-root layout) with a clear failure."""
+    try:
+        import tools.graftir as g
+        return g
+    except ImportError:
+        import sys
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        if root not in sys.path and \
+                os.path.isdir(os.path.join(root, "tools", "graftir")):
+            sys.path.insert(0, root)
+            import tools.graftir as g
+            return g
+        raise RuntimeError(
+            "MXNET_IR_AUDIT is set but the graftir auditor "
+            "(tools/graftir) is not importable — run from a repo "
+            "checkout, or unset MXNET_IR_AUDIT")
+
+
+@contextlib.contextmanager
+def collect():
+    """Redirect program registrations into a list (yielded) instead of
+    auditing them — the representative-set builder's capture hook.
+    Forces :func:`enabled` True for the duration."""
+    global _COLLECT
+    prev, _COLLECT = _COLLECT, []
+    try:
+        yield _COLLECT
+    finally:
+        _COLLECT = prev
+
+
+def reset_seen():
+    """Drop the per-process GI005 program ledger (tests)."""
+    with _LOCK:
+        del _SEEN[:]
+
+
+def audit(subsystem, name, text, **decl):
+    """Register one lowered program for audit.
+
+    *decl* carries the producer's declarations (``model=``,
+    ``donated=``, ``dtype_policy=``, ``hot_path=``, ``bucket_rows=``,
+    ``natural_rows=``, ``budget=``, ``suppress=``).  Returns the
+    findings list (empty when clean), the collected Program in
+    collector mode, or None when the audit is off.  Never raises on
+    rule findings — the audit observes, CI gates."""
+    if not enabled():
+        return None
+    g = _graftir()
+    prog = g.Program(subsystem, name, text, **decl)
+    if _COLLECT is not None:
+        _COLLECT.append(prog)
+        return prog
+    with _LOCK:
+        _SEEN.append(prog)
+        group = [p for p in _SEEN
+                 if (p.subsystem, p.model) == (subsystem, prog.model)]
+    # per-program rules on the new program; the group-count rule over
+    # everything this process lowered for the same (subsystem, model)
+    # — a request-path compile past the warm set trips GI005 here
+    _, findings = g.audit_programs(
+        [prog], rules=["GI001", "GI002", "GI003", "GI004"])
+    _, group_findings = g.audit_programs(group, rules=["GI005"])
+    findings = list(findings) + list(group_findings)
+    new = [f for f in findings if f.status == "new"]
+    _count(len(new))
+    from .observability import events as _obs_events
+    _obs_events.emit("iraudit", kind="audit", program=prog.key(),
+                     sha=prog.sha(), findings=len(findings),
+                     new=len(new),
+                     rules=sorted({f.rule for f in new}))
+    for f in new:
+        _LOG.warning("graftir: %r", f)
+    return findings
+
+
+def _count(n):
+    global _FINDINGS_TOTAL
+    if _FINDINGS_TOTAL is None:
+        from .observability import metrics as _metrics
+        _FINDINGS_TOTAL = _metrics.counter(
+            "mxnet_ir_audit_findings_total",
+            "new graftir findings surfaced by the MXNET_IR_AUDIT "
+            "production hook")
+    if n:
+        _FINDINGS_TOTAL.inc(n)

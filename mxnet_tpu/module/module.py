@@ -1221,9 +1221,12 @@ class Module(BaseModule):
             ctx["mode"] = "full"
             ctx["donates"] = supports_donation()
             from ..observability import events as _obs_events
+            raw = ex0.init_fused_step(tree_update, guard_nonfinite=guard)
+            # the un-wrapped jit: the MXNET_IR_AUDIT hook lowers
+            # through it (the watch/wrap layers have no .lower)
+            ctx["raw_fn"] = raw
             ctx["fn"] = _obs_events.watch_jit(_sanitizer.wrap_jit(
-                ex0.init_fused_step(tree_update, guard_nonfinite=guard),
-                "fused_step"), "fused_step")
+                raw, "fused_step"), "fused_step")
         else:
             import jax
             from .. import profiler as _prof
@@ -1242,9 +1245,10 @@ class Module(BaseModule):
             ctx["mode"] = "partial"
             ctx["donates"] = bool(donate)
             from ..observability import events as _obs_events
+            raw = jax.jit(tree_apply, donate_argnums=donate)
+            ctx["raw_fn"] = raw
             ctx["fn"] = _obs_events.watch_jit(_sanitizer.wrap_jit(
-                jax.jit(tree_apply, donate_argnums=donate),
-                "tree_apply"), "tree_apply")
+                raw, "tree_apply"), "tree_apply")
         self._fused = ctx
 
     def _import_fused_state(self):
@@ -1322,6 +1326,23 @@ class Module(BaseModule):
         # advances every step — num_update only ratchets via max() and
         # can stall when the optimizer is shared with a module trained
         # further, which would replay the same dropout masks
+        from .. import iraudit as _iraudit
+        if _iraudit.enabled() and not ctx.get("ir_audited"):
+            # first dispatch only: one extra trace (lower() does not
+            # execute or consume the args), zero cost when the knob
+            # is off
+            ctx["ir_audited"] = True
+            import jax as _jax
+            n_don = (len(_jax.tree_util.tree_leaves(params)) +
+                     len(_jax.tree_util.tree_leaves(self._fused_state))
+                     ) if ctx.get("donates") else None
+            _iraudit.audit(
+                "train", "fused_step",
+                ctx["raw_fn"].lower(
+                    params, rest, ex._aux_map(), ex._key,
+                    self._fused_state, lrs, wds, ts,
+                    max(ts.values())).as_text(),
+                hot_path=True, donated=n_don, budget=1)
         import time as _time
         t0 = _time.perf_counter()
         with _sanitizer.transfer_guard("fused train step"):
@@ -1392,6 +1413,18 @@ class Module(BaseModule):
             donated = list(params.values()) + \
                 _jax.tree_util.tree_leaves(self._fused_state)
         import time as _time
+        from .. import iraudit as _iraudit
+        if _iraudit.enabled() and not ctx.get("ir_audited"):
+            ctx["ir_audited"] = True
+            import jax as _jax
+            n_don = (len(_jax.tree_util.tree_leaves(params)) +
+                     len(_jax.tree_util.tree_leaves(self._fused_state))
+                     ) if ctx.get("donates") else None
+            _iraudit.audit(
+                "train", "tree_apply",
+                ctx["raw_fn"].lower(grads, params, self._fused_state,
+                                    lrs, wds, ts).as_text(),
+                hot_path=True, donated=n_don, budget=1)
         t0 = _time.perf_counter()
         with _sanitizer.transfer_guard("partial-fused tree update"):
             res = ctx["fn"](grads, params, self._fused_state, lrs, wds,

@@ -48,13 +48,16 @@ _FEATURE_GROUP_RE = re.compile(r"feature_group_count\s*=\s*(\d+)")
 _KERNEL_SPEC_RE = re.compile(r"x\[([^\]]*)\]->")
 
 # ops that are pure data movement / bookkeeping: zero flops, and for
-# the shape-only ones zero meaningful traffic either
+# the shape-only ones zero meaningful traffic either.  Control-flow
+# headers (while/if/case) are free too: their cost is their REGION
+# bodies, which parse_hlo_ops charges with the loop multiplier.
 _FREE_OPS = frozenset([
     "constant", "iota", "reshape", "bitcast_convert", "transpose",
     "broadcast_in_dim", "broadcast", "slice", "dynamic_slice",
     "dynamic_update_slice", "concatenate", "pad", "reverse",
     "get_tuple_element", "tuple", "optimization_barrier", "copy",
     "convert", "custom_call", "after_all", "create_token",
+    "while", "if", "case", "return",
 ])
 
 # one-flop-per-element ops get 1; costlier elementwise ops get a
@@ -155,44 +158,220 @@ def _op_flops(op, line, operands, result):
     return float(rcount) * _ELEMENTWISE_WEIGHT.get(op, 1)
 
 
+_FUNC_RE = re.compile(r"func\.func\s+(?:(public|private)\s+)?@([\w$.\-]+)")
+_CALL_RE = re.compile(r"(?:func\.)?call\s+@([\w$.\-]+)")
+_INT_CONST_RE = re.compile(
+    r"(%[\w#]+)\s*=\s*stablehlo\.constant\s+dense<(-?\d+)>\s*:"
+    r"\s*tensor<(?:i32|i64|ui32|ui64)>")
+_ITER_INIT_RE = re.compile(r"(%[\w#]+)\s*=\s*(%[\w#]+)")
+_WHILE_CMP_RE = re.compile(
+    r"stablehlo\.compare\s+(LT|LE),\s*(%[\w#]+),\s*(%[\w#]+)")
+
+
+def _cost_row(line, op_match):
+    """One {op, flops, bytes, shapes} row for an instruction line, or
+    None when the line carries no tensor types."""
+    op = op_match.group(1)
+    tensors = [_parse_tensor(t) for t in _TENSOR_RE.findall(line)]
+    if not tensors:
+        return None
+    # pretty form: "... : (operand types) -> result" or
+    # "... : type" (every operand AND the result share the one
+    # printed type — so count the %-operand refs, or a binary
+    # add would be charged 2x tensor bytes instead of 3x and its
+    # arithmetic intensity inflated 1.5x)
+    if "->" in line.split(" : ")[-1] and len(tensors) >= 2:
+        operands, results = tensors[:-1], tensors[-1:]
+    else:
+        seg = line[op_match.end():line.rfind(" : ")]
+        n_operands = max(1, seg.count("%"))
+        operands = [tensors[-1]] * n_operands
+        results = tensors[-1:]
+    flops = _op_flops(op, line, operands, results[0])
+    byts = sum(t[2] for t in operands) + sum(t[2] for t in results)
+    return {
+        "op": op,
+        "flops": flops,
+        "bytes": float(byts),
+        "shapes": "%s->%s" % (
+            ",".join("x".join(map(str, t[0])) or "scalar"
+                     for t in operands[:2]),
+            "x".join(map(str, results[0][0])) or "scalar"),
+    }
+
+
+def _parse_functions(text):
+    """Split StableHLO text into per-function op lists with LOOP
+    multipliers resolved.
+
+    Returns ``{fname: {"public": bool, "rows": [(row, mult)],
+    "calls": [(callee, mult)]}}``.  *mult* is the product of the trip
+    counts of the enclosing ``stablehlo.while`` regions: jax lowers
+    ``lax.scan``/``fori_loop`` to a while whose cond compares the
+    induction iterArg LT/LE a constant bound, with the body outlined
+    into a ``func.func private`` reached via ``func.call`` — so a
+    scanned matmul must charge trip_count x body, not 1x.  A while
+    whose trip count is not statically visible multiplies by 1
+    (conservative)."""
+    funcs = {}
+    cur = None            # current function record
+    consts = {}           # %name -> int (scalar int constants, SSA)
+    # scope stack: [depth_at_open, multiplier] for each open while
+    # region; current multiplier = product over the stack
+    scopes = []
+    depth = 0
+    pending_while = None  # iterArg -> init operand, for the next cond
+    cond_scope = None     # scope collecting the cond of pending_while
+
+    for line in text.splitlines():
+        stripped = line.strip()
+        fm = _FUNC_RE.search(line)
+        if fm:
+            cur = {"public": fm.group(1) != "private",
+                   "rows": [], "calls": []}
+            funcs[fm.group(2)] = cur
+            consts = {}
+            scopes = []
+            depth = line.count("{") - line.count("}")
+            pending_while = None
+            cond_scope = None
+            continue
+        if cur is None:
+            # bare op text with no func.func wrapper (tests, snippets):
+            # treat everything before the first signature as an
+            # implicit entry function
+            if not _OP_RE.search(line):
+                continue
+            cur = {"public": True, "rows": [], "calls": []}
+            funcs["<toplevel>"] = cur
+
+        cm = _INT_CONST_RE.search(line)
+        if cm:
+            consts[cm.group(1)] = int(cm.group(2))
+
+        if "stablehlo.while" in line and "=" in line:
+            inside = line[line.find("(") + 1:line.rfind(")")] \
+                if "(" in line else ""
+            pending_while = dict(_ITER_INIT_RE.findall(inside))
+
+        mult = 1
+        for s in scopes:
+            mult *= s[1]
+
+        if pending_while is not None and stripped.startswith("cond"):
+            # the cond region: runs trip+1 times, but holds only the
+            # bound compare — charge it with the body multiplier once
+            # the trip count is known (scope mult patched at "} do {")
+            cond_scope = [depth + 1, 1, pending_while]
+            scopes.append(cond_scope)
+            depth += line.count("{") - line.count("}")
+            continue
+        if cond_scope is not None and stripped.startswith("}") \
+                and "do" in stripped and "{" in stripped:
+            # "} do {": close the cond scope, open the body scope with
+            # the trip count inferred from the cond's compare
+            trip = cond_scope[1] if cond_scope[1] > 1 else 1
+            scopes.pop()
+            scopes.append([depth, trip])
+            pending_while = None
+            cond_scope = None
+            depth += line.count("{") - line.count("}")
+            continue
+
+        if cond_scope is not None:
+            wm = _WHILE_CMP_RE.search(line)
+            if wm:
+                direction, it, bound = wm.groups()
+                limit = consts.get(bound)
+                init = consts.get(cond_scope[2].get(it, ""), 0)
+                if limit is not None:
+                    trip = limit - init + (1 if direction == "LE" else 0)
+                    if trip > 0:
+                        cond_scope[1] = trip
+
+        om = _OP_RE.search(line)
+        if om and om.group(1) not in _FREE_OPS:
+            row = _cost_row(line, om)
+            if row is not None:
+                cur["rows"].append((row, mult))
+        else:
+            km = _CALL_RE.search(line)
+            if km:
+                cur["calls"].append((km.group(1), mult))
+
+        depth += line.count("{") - line.count("}")
+        while scopes and depth < scopes[-1][0]:
+            scopes.pop()
+            if scopes is not None and cond_scope is not None and \
+                    (not scopes or cond_scope not in scopes):
+                cond_scope = None
+                pending_while = None
+    return funcs
+
+
 def parse_hlo_ops(text):
     """Walk lowered StableHLO/MHLO text; one cost row per
-    instruction: ``{op, flops, bytes, shapes}``.  Lines that are not
-    instructions (signatures, regions, returns) are skipped."""
+    instruction: ``{op, flops, bytes, shapes, count}``.  Lines that
+    are not instructions (signatures, regions, returns) are skipped.
+
+    Nested regions are priced honestly: ops inside a
+    ``stablehlo.while`` body (and in functions the body calls — jax
+    outlines scan/fori bodies into ``func.func private``) are
+    multiplied by the statically-inferred trip count, so a scanned
+    matmul costs trip_count x body flops, not 1x."""
+    funcs = _parse_functions(text)
+    if not funcs:
+        return []
+
+    # function multiplier: how many times each function runs per
+    # program execution.  Public functions are entry points (1x);
+    # private ones run once per call site times the caller's own
+    # multiplier.  MLIR functions cannot recurse, so plain memoized
+    # recursion over the caller edges terminates.
+    callers = {}
+    for fname, rec in funcs.items():
+        for callee, mult in rec["calls"]:
+            callers.setdefault(callee, []).append((fname, mult))
+
+    memo = {}
+
+    def fmult(fname):
+        if fname in memo:
+            return memo[fname]
+        rec = funcs.get(fname)
+        if rec is None:
+            return 0
+        if rec["public"]:
+            memo[fname] = 1
+            return 1
+        edges = callers.get(fname)
+        if not edges:
+            # unreferenced private function: price it once rather
+            # than silently dropping it (unusual dialect output)
+            memo[fname] = 1
+            return 1
+        memo[fname] = 0            # break accidental cycles at 0
+        total = sum(fmult(c) * m for c, m in edges)
+        memo[fname] = total if total > 0 else 1
+        return memo[fname]
+
     rows = []
-    for line in text.splitlines():
-        m = _OP_RE.search(line)
-        if not m:
+    for fname, rec in funcs.items():
+        fm = fmult(fname)
+        if fm <= 0:
             continue
-        op = m.group(1)
-        if op in _FREE_OPS:
-            continue
-        tensors = [_parse_tensor(t) for t in _TENSOR_RE.findall(line)]
-        if not tensors:
-            continue
-        # pretty form: "... : (operand types) -> result" or
-        # "... : type" (every operand AND the result share the one
-        # printed type — so count the %-operand refs, or a binary
-        # add would be charged 2x tensor bytes instead of 3x and its
-        # arithmetic intensity inflated 1.5x)
-        if "->" in line.split(" : ")[-1] and len(tensors) >= 2:
-            operands, results = tensors[:-1], tensors[-1:]
-        else:
-            seg = line[m.end():line.rfind(" : ")]
-            n_operands = max(1, seg.count("%"))
-            operands = [tensors[-1]] * n_operands
-            results = tensors[-1:]
-        flops = _op_flops(op, line, operands, results[0])
-        byts = sum(t[2] for t in operands) + sum(t[2] for t in results)
-        rows.append({
-            "op": op,
-            "flops": flops,
-            "bytes": float(byts),
-            "shapes": "%s->%s" % (
-                ",".join("x".join(map(str, t[0])) or "scalar"
-                         for t in operands[:2]),
-                "x".join(map(str, results[0][0])) or "scalar"),
-        })
+        for row, mult in rec["rows"]:
+            n = fm * mult
+            if n == 1:
+                rows.append(dict(row, count=1))
+            else:
+                rows.append({
+                    "op": row["op"],
+                    "flops": row["flops"] * n,
+                    "bytes": row["bytes"] * n,
+                    "shapes": row["shapes"],
+                    "count": n,
+                })
     return rows
 
 

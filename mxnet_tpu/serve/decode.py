@@ -69,6 +69,7 @@ import numpy as _np
 from .buckets import (BucketLadder, DeadlineExceededError,
                       RequestCancelled, ServeError)
 from .kvpool import KVPool, KVPoolExhausted
+from .. import iraudit as _iraudit
 from .. import sanitizer as _san
 from ..observability import events as _obs_events
 from ..observability import metrics as _obs_metrics
@@ -438,6 +439,17 @@ class DecodeEngine:
     def verify_lowered_text(self):
         return self._verify_text or ""
 
+    def lower_tick_text(self, S):
+        """StableHLO of the S-session tick (lower only, no compile) —
+        the graftir representative-set path on CPU avals."""
+        return self._lower_tick(int(S)).as_text()
+
+    def lower_prefill_text(self, Lr):
+        """StableHLO of the Lr-token prefill (lower only)."""
+        if self._prefill_fn is None:
+            raise ServeError("decode %r has no prefill_fn" % self.label)
+        return self._lower_prefill(int(Lr)).as_text()
+
     # -- program builders ----------------------------------------------------
     def _count_compile(self, kind, key, seconds):
         self._compiles += 1
@@ -462,7 +474,8 @@ class DecodeEngine:
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
             self._params)
 
-    def _build_tick(self, S):
+    def _lower_tick(self, S):
+        """Lower (no compile) the S-session tick program."""
         import jax
         import jax.numpy as jnp
         bs, nb, L = self.block_size, self.max_blocks, self.padded_len
@@ -488,9 +501,28 @@ class DecodeEngine:
         sa = jax.ShapeDtypeStruct((S,), jnp.int32)
         ia = {n: jax.ShapeDtypeStruct((S,) + sp.shape, sp.dtype)
               for n, sp in self._input_spec.items()}
+        return jitted.lower(pa, ka, ta, sa, ia)
+
+    def _audit(self, kind, rung, text):
+        """MXNET_IR_AUDIT hook: one registration per decode program
+        (the pool is the donated input; session rungs + prefill rungs
+        + optional verify are the program budget)."""
+        import jax
+        budget = len(self.ladder.batches) + \
+            (len(self.prefill_rungs) if self._prefill_fn else 0) + \
+            (1 if self.spec_k > 0 else 0)
+        n_pool = len(jax.tree_util.tree_leaves(self._pool.arrays))
+        _iraudit.audit(
+            "decode", "%s/%s" % (kind, rung), text, model=self.label,
+            hot_path=True, donated=n_pool if self._donate else None,
+            budget=budget)
+
+    def _build_tick(self, S):
         t0 = _time.perf_counter()
-        lowered = jitted.lower(pa, ka, ta, sa, ia)
+        lowered = self._lower_tick(S)
         text = lowered.as_text()
+        if _iraudit.enabled():
+            self._audit("tick", "S%d" % S, text)
         prog = lowered.compile()
         del lowered
         # caller (warm) holds self._lock for the whole build pass
@@ -499,7 +531,8 @@ class DecodeEngine:
         self._count_compile("tick", S, _time.perf_counter() - t0)
         return prog
 
-    def _build_prefill(self, Lr):
+    def _lower_prefill(self, Lr):
+        """Lower (no compile) the Lr-token prefill program."""
         import jax
         import jax.numpy as jnp
         bs, nb = self.block_size, self.max_blocks
@@ -524,9 +557,14 @@ class DecodeEngine:
         ia = {n: jax.ShapeDtypeStruct((1, Lr) + sp.shape, sp.dtype)
               for n, sp in self._input_spec.items()}
         la = jax.ShapeDtypeStruct((), jnp.int32)
+        return jitted.lower(pa, ka, ta, ia, la)
+
+    def _build_prefill(self, Lr):
         t0 = _time.perf_counter()
-        lowered = jitted.lower(pa, ka, ta, ia, la)
+        lowered = self._lower_prefill(Lr)
         text = lowered.as_text()
+        if _iraudit.enabled():
+            self._audit("prefill", "L%d" % Lr, text)
         prog = lowered.compile()
         del lowered
         # caller (warm) holds self._lock for the whole build pass
@@ -572,6 +610,8 @@ class DecodeEngine:
         t0 = _time.perf_counter()
         lowered = jitted.lower(pa, ka, ta, sa, ia)
         self._verify_text = lowered.as_text()
+        if _iraudit.enabled():
+            self._audit("verify", "K%d" % K, self._verify_text)
         prog = lowered.compile()
         del lowered
         # caller (warm) holds self._lock for the whole build pass

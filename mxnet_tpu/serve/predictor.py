@@ -33,6 +33,7 @@ import time as _time
 import numpy as _np
 
 from .buckets import BucketLadder, ServeError
+from .. import iraudit as _iraudit
 from .. import sanitizer as _san
 from ..observability import events as _obs_events
 from ..observability import metrics as _obs_metrics
@@ -290,7 +291,10 @@ class CompiledPredictor:
             _servechaos.on_warm(self.name)
             pa, aa, da, ka = self._avals(shapes)
             t0 = _time.perf_counter()
-            prog = self._jit.lower(pa, aa, da, ka).compile()
+            lowered = self._jit.lower(pa, aa, da, ka)
+            if _iraudit.enabled():
+                self._audit_rung(key, shapes, lowered.as_text())
+            prog = lowered.compile()
             dt = _time.perf_counter() - t0
             self._programs[key] = prog
             self._compiles += 1
@@ -300,6 +304,26 @@ class CompiledPredictor:
                 bucket=[list(s) for _, s in key],
                 seconds=round(dt, 4), programs=len(self._programs))
             return prog
+
+    def _audit_rung(self, key, shapes, text):
+        """MXNET_IR_AUDIT hook: register this bucket program with the
+        graftir auditor, declaring the rung geometry (GI004 pad-waste:
+        the worst natural batch this rung serves is one past the rung
+        below) and the ladder size as the program budget (GI005: a
+        request-path compile past the warm set is budget growth)."""
+        rows = next((shapes[n][0] for n in sorted(self._bucket_inputs)
+                     if shapes[n]), None)
+        natural = None
+        if rows is not None:
+            below = [b for b in self.ladder.batches if b < rows]
+            natural = (max(below) + 1) if below else 1
+        qmode = (self.quantization or {}).get("mode") \
+            if isinstance(self.quantization, dict) else None
+        _iraudit.audit(
+            "serve", "predict/b%s" % rows, text, model=self.name,
+            hot_path=True, dtype_policy=qmode,
+            bucket_rows=rows, natural_rows=natural,
+            budget=len(self.ladder.batches))
 
     def rung_shapes(self, b):
         """The padded input shapes of the rung that serves a natural

@@ -32,6 +32,7 @@ from .batcher import DynamicBatcher
 from .buckets import BucketLadder, ServeError
 from .health import HealthBoard
 from .predictor import CompiledPredictor
+from .. import iraudit as _iraudit
 from .. import sanitizer as _san
 from ..observability import events as _obs_events
 from ..observability import metrics as _obs_metrics
@@ -275,7 +276,12 @@ class ModelRegistry:
                 % (name, why))
 
         for b in pred.ladder.batches:
-            if not hlo_ok(pred.lowered_text(pred.rung_shapes(b))):
+            text = pred.lowered_text(pred.rung_shapes(b))
+            _iraudit.audit(
+                "quantize", "quantized/b%d" % b, text, model=name,
+                dtype_policy=policy.mode,
+                budget=len(pred.ladder.batches))
+            if not hlo_ok(text):
                 _fail("rung %d: no int8 %s in the lowered StableHLO"
                       % (b, "dot/conv compute" if policy.mode == "int8"
                          else "tensors"))

@@ -1,0 +1,370 @@
+"""graftir: the StableHLO program auditor + committed manifest.
+
+Three layers, mirroring how test_graftlint.py covers graftlint:
+
+* rule units on hand-crafted HLO text — each rule's positive AND
+  negative case, including the regressions the CI smoke seeds
+  (stripped donation -> GI001, smuggled f64 -> GI002, mis-bucketed
+  rung -> GI004);
+* the engine/manifest plumbing — suppressions, baseline round-trip,
+  canonical-sha stability, manifest round-trip and every drift class;
+* end-to-end — a REAL ``jax.jit(...).lower()`` text through the
+  Program parser, the ``MXNET_IR_AUDIT`` producer bridge, and the
+  shipped representative set staying clean against the committed
+  baseline + manifest (the same gate ``python -m tools.graftir
+  --check`` applies in CI).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tools.graftir import (ALL_RULES, AuditEngine, Program,
+                           audit_programs, canonical_sha, canonicalize)
+from tools.graftir import manifest as gmanifest
+from tools.graftir.rules import (check_gi001, check_gi002, check_gi003,
+                                 check_gi004, check_gi005, RULE_DOCS)
+
+import mxnet_tpu  # noqa: F401  (pins the CPU platform via conftest)
+from mxnet_tpu import iraudit
+
+
+# ---------------------------------------------------------------------------
+# hand-crafted HLO builders
+
+
+def hlo(body, args="%arg0: tensor<4x8xf32>", results="tensor<4x8xf32>"):
+    return (
+        'module @jit_step attributes {mhlo.num_partitions = 1 : i32} {\n'
+        '  func.func public @main(%s) -> (%s) {\n'
+        '%s\n'
+        '    return %%0 : %s\n'
+        '  }\n'
+        '}\n' % (args, results, body, results))
+
+
+DONATED_ARGS = (
+    '%arg0: tensor<4x8xf32> {tf.aliasing_output = 0 : i32, '
+    'mhlo.sharding = "{replicated}"}, '
+    '%arg1: tensor<8x8xf32> {jax.buffer_donor = true}, '
+    '%arg2: tensor<4x8xf32>')
+
+DOT_BODY = ('    %0 = stablehlo.dot_general %arg0, %arg1, '
+            'contracting_dims = [1] x [0] '
+            ': (tensor<4x8xf32>, tensor<8x8xf32>) -> tensor<4x8xf32>')
+
+
+def prog(text, **kw):
+    kw.setdefault("subsystem", "test")
+    kw.setdefault("name", "prog")
+    return Program(kw.pop("subsystem"), kw.pop("name"), text, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Program parsing
+
+
+def test_main_args_parses_donation_behind_nested_brace_attrs():
+    # mhlo.sharding = "{replicated}" nests braces inside the attr dict:
+    # a naive {[^}]*} regex loses the donation attr that follows it
+    p = prog(hlo(DOT_BODY, args=DONATED_ARGS))
+    assert p.avals() == ["4x8xf32", "8x8xf32", "4x8xf32"]
+    assert [d for _, d in p.main_args()] == [True, True, False]
+    assert p.donated_args() == 2
+
+
+def test_op_lines_and_key():
+    p = prog(hlo(DOT_BODY), subsystem="serve", name="predict/b4")
+    ops = [op for _, op, _ in p.op_lines()]
+    assert ops == ["dot_general"]
+    assert p.key() == "serve/predict/b4"
+
+
+def test_canonical_sha_ignores_locs_and_whitespace():
+    base = hlo(DOT_BODY)
+    noisy = base.replace(
+        "stablehlo.dot_general",
+        "stablehlo.dot_general").replace(
+        "return", "return").replace("\n    return", " loc(#loc3)\n"
+                                    "       return")
+    noisy += "#loc3 = loc(unknown)\n"
+    assert canonical_sha(noisy) == canonical_sha(base)
+    # a real op change must move the sha
+    changed = base.replace("dot_general", "add")
+    assert canonical_sha(changed) != canonical_sha(base)
+    assert "#loc" not in canonicalize(noisy)
+
+
+# ---------------------------------------------------------------------------
+# rules: positive + negative per rule
+
+
+def test_gi001_stripped_donation_flagged():
+    clean = prog(hlo(DOT_BODY, args=DONATED_ARGS), donated=2)
+    assert check_gi001([clean]) == []
+    stripped = prog(
+        hlo(DOT_BODY, args=DONATED_ARGS)
+        .replace("tf.aliasing_output", "tf.other")
+        .replace("jax.buffer_donor", "jax.other"),
+        donated=2)
+    found = check_gi001([stripped])
+    assert len(found) == 1
+    assert found[0].rule == "GI001"
+    assert "declares 2" in found[0].message
+
+
+def test_gi001_silent_when_no_declaration():
+    # donated=None -> the producer makes no promise, nothing to check
+    p = prog(hlo(DOT_BODY))
+    assert check_gi001([p]) == []
+
+
+def test_gi002_f64_flagged_including_nonscalar():
+    # tensor<4xf64> has no word boundary before "f64" — the regression
+    # the CI smoke seeds
+    for aval in ("f64", "4xf64", "2x3xf64"):
+        body = ('    %0 = stablehlo.constant dense<0.0> : tensor<'
+                + aval + '>')
+        found = check_gi002([prog(hlo(body))])
+        assert [f.rule for f in found] == ["GI002"], aval
+        assert "f64" in found[0].message
+    assert check_gi002([prog(hlo(DOT_BODY))]) == []
+
+
+def test_gi002_bf16_policy_flags_f32_dot_unless_allowlisted():
+    p = prog(hlo(DOT_BODY), dtype_policy="bf16")
+    found = check_gi002([p])
+    assert [f.rule for f in found] == ["GI002"]
+    assert "bf16" in found[0].message
+    allowed = prog(hlo(DOT_BODY), dtype_policy="bf16",
+                   f32_allow=("dot_general",))
+    assert check_gi002([allowed]) == []
+
+
+def test_gi002_quantized_rung_must_keep_i8_compute():
+    lost = prog(hlo(DOT_BODY), dtype_policy="int8")
+    found = check_gi002([lost])
+    assert [f.rule for f in found] == ["GI002"]
+    assert "quantization was lost" in found[0].message
+    i8_body = ('    %0 = stablehlo.dot_general %arg0, %arg1 '
+               ': (tensor<4x8xi8>, tensor<8x8xi8>) -> tensor<4x8xi32>')
+    kept = prog(hlo(i8_body), dtype_policy="int8")
+    assert check_gi002([kept]) == []
+
+
+def test_gi003_host_roundtrip_only_matters_on_hot_path():
+    body = DOT_BODY + ('\n    %1 = "stablehlo.outfeed"(%0) '
+                       ': (tensor<4x8xf32>) -> !stablehlo.token')
+    hot = prog(hlo(body), hot_path=True)
+    found = check_gi003([hot])
+    assert [f.rule for f in found] == ["GI003"]
+    assert "outfeed" in found[0].message
+    cold = prog(hlo(body), hot_path=False)
+    assert check_gi003([cold]) == []
+
+
+def test_gi003_host_callback_custom_call_flagged_sharding_benign():
+    cb = DOT_BODY + ('\n    %1 = stablehlo.custom_call '
+                     '@xla_python_cpu_callback(%0) : '
+                     '(tensor<4x8xf32>) -> tensor<4x8xf32>')
+    found = check_gi003([prog(hlo(cb), hot_path=True)])
+    assert [f.rule for f in found] == ["GI003"]
+    benign = DOT_BODY + ('\n    %1 = stablehlo.custom_call '
+                         '@Sharding(%0) : (tensor<4x8xf32>) -> '
+                         'tensor<4x8xf32>')
+    assert check_gi003([prog(hlo(benign), hot_path=True)]) == []
+
+
+def test_gi004_misbucketed_rung_flagged():
+    # a (1, 64) ladder routing 2-row requests through the 64-row
+    # program: 97% pad waste
+    bad = prog(hlo(DOT_BODY), bucket_rows=64, natural_rows=2)
+    found = check_gi004([bad])
+    assert [f.rule for f in found] == ["GI004"]
+    assert "rows=64" in found[0].detail
+    ok = prog(hlo(DOT_BODY), bucket_rows=8, natural_rows=5)
+    assert check_gi004([ok]) == []
+
+
+def test_gi005_program_count_budget():
+    group = [prog(hlo(DOT_BODY), subsystem="serve",
+                  name="predict/b%d" % b, model="m", budget=2)
+             for b in (1, 2, 4)]
+    found = check_gi005(group)
+    assert [f.rule for f in found] == ["GI005"]
+    assert "3 programs against a budget of 2" in found[0].message
+    assert check_gi005(group[:2]) == []
+
+
+def test_rule_catalog_consistent():
+    assert set(ALL_RULES) == set(RULE_DOCS)
+    assert sorted(ALL_RULES) == ["GI001", "GI002", "GI003", "GI004",
+                                 "GI005"]
+
+
+# ---------------------------------------------------------------------------
+# engine: suppressions + baseline round-trip
+
+
+def test_suppression_marks_finding_not_new():
+    p = prog(hlo(DOT_BODY), bucket_rows=64, natural_rows=1,
+             suppress=("GI004",))
+    engine, findings = audit_programs([p], use_baseline=False)
+    assert engine.stats["findings"] == 1
+    assert engine.stats["suppressed"] == 1
+    assert engine.stats["new"] == 0
+    assert findings[0].status == "suppressed"
+
+
+def test_baseline_roundtrip(tmp_path):
+    p = prog(hlo(DOT_BODY), bucket_rows=64, natural_rows=1)
+    bl = str(tmp_path / "baseline.json")
+    engine = AuditEngine([p], baseline_path=bl)
+    findings = engine.run()
+    assert engine.stats["new"] == 1
+    engine.update_baseline(findings)
+    engine2 = AuditEngine([p], baseline_path=bl)
+    engine2.run()
+    assert engine2.stats["new"] == 0
+    assert engine2.stats["baselined"] == 1
+    # fingerprints are line-number-free: key on (rule, program, detail)
+    data = json.loads(open(bl).read())
+    assert list(data["findings"]) == ["GI004|test/prog|rows=64"]
+
+
+# ---------------------------------------------------------------------------
+# manifest: round-trip + every drift class
+
+
+def test_manifest_roundtrip_all_ok(tmp_path):
+    programs = [prog(hlo(DOT_BODY), subsystem="serve", name="p/b4")]
+    path = str(tmp_path / "manifest.json")
+    gmanifest.save(gmanifest.build(programs), path)
+    rows, violations = gmanifest.diff(programs, gmanifest.load(path))
+    assert violations == []
+    assert [r["status"] for r in rows] == ["ok"]
+    entry = gmanifest.load(path)["programs"]["serve/p/b4"]
+    assert entry["sha"] == programs[0].sha()
+    assert entry["flops"] > 0
+
+
+def test_manifest_flags_growth_drift_and_count_drift(tmp_path):
+    base = prog(hlo(DOT_BODY), subsystem="serve", name="p/b4")
+    path = str(tmp_path / "manifest.json")
+    gmanifest.save(gmanifest.build([base]), path)
+    man = gmanifest.load(path)
+
+    # 2x cost: duplicate the dot -> grew + violation naming program
+    doubled = prog(hlo(DOT_BODY + "\n" + DOT_BODY.replace("%0", "%9")),
+                   subsystem="serve", name="p/b4")
+    rows, violations = gmanifest.diff([doubled], man)
+    assert [r["status"] for r in rows] == ["grew"]
+    assert any("serve/p/b4" in v and "grew" in v for v in violations)
+
+    # benign change under tolerance: constant tweak, same cost shape
+    nudged = prog(hlo(DOT_BODY + '\n    %8 = stablehlo.constant '
+                      'dense<1.0> : tensor<f32>'),
+                  subsystem="serve", name="p/b4")
+    rows, violations = gmanifest.diff([nudged], man,
+                                      tolerance=0.5)
+    assert [r["status"] for r in rows] == ["changed"]
+    assert violations == []
+
+    # program-count drift both ways
+    extra = prog(hlo(DOT_BODY), subsystem="serve", name="p/b8")
+    rows, violations = gmanifest.diff([base, extra], man)
+    assert {r["status"] for r in rows} == {"ok", "new"}
+    assert any("p/b8" in v and "not in manifest" in v
+               for v in violations)
+    rows, violations = gmanifest.diff([], man)
+    assert [r["status"] for r in rows] == ["removed"]
+    assert any("no longer lowered" in v for v in violations)
+
+
+# ---------------------------------------------------------------------------
+# end to end: real lowered text, the producer bridge, the shipped tree
+
+
+def test_real_lowered_program_parses_and_audits_clean():
+    import jax
+    import jax.numpy as jnp
+
+    def step(w, x):
+        # sgd-shaped: the output aliases the donated w (same aval)
+        return w - 0.1 * jnp.dot(x.T, jnp.dot(x, w))
+
+    w = np.zeros((8, 4), np.float32)
+    x = np.zeros((2, 8), np.float32)
+    text = jax.jit(step, donate_argnums=(0,)).lower(w, x).as_text()
+    p = prog(text, subsystem="train", name="step", donated=1,
+             hot_path=True)
+    # donation attrs render in CPU lowers; the parser must see them
+    assert p.donated_args() >= 1
+    assert "2x8xf32" in p.avals() or "8x4xf32" in p.avals()
+    engine, findings = audit_programs([p], use_baseline=False)
+    assert engine.stats["new"] == 0
+    assert p.sha() == canonical_sha(text)
+
+
+def test_iraudit_bridge_collects_producer_programs(monkeypatch):
+    # the production knob is per-call: collect() forces it on without
+    # touching the env, so producers audit into the collector
+    from mxnet_tpu import nd, sym
+    from mxnet_tpu.serve.buckets import BucketLadder
+    from mxnet_tpu.serve.predictor import CompiledPredictor
+
+    assert not iraudit.enabled()        # env unset -> zero-cost path
+    monkeypatch.setenv("MXNET_IR_AUDIT", "1")
+    assert iraudit.enabled()
+    monkeypatch.delenv("MXNET_IR_AUDIT")
+
+    rng = np.random.RandomState(0)
+    params = {"fc1_weight": nd.array(rng.randn(4, 6).astype(np.float32)),
+              "fc1_bias": nd.array(np.zeros(4, np.float32))}
+    net = sym.FullyConnected(sym.var("data"), num_hidden=4, name="fc1")
+    with iraudit.collect() as programs:
+        pred = CompiledPredictor(net, params,
+                                 data_shapes={"data": (4, 6)},
+                                 ladder=BucketLadder(batches=(2, 4)),
+                                 name="m")
+        pred.warm()
+    keys = sorted(p.key() for p in programs)
+    assert keys == ["serve/predict/b2", "serve/predict/b4"]
+    assert all(p.hot_path for p in programs)
+    engine, _ = audit_programs(programs, use_baseline=False)
+    assert engine.stats["new"] == 0
+
+
+def test_shipped_representative_set_is_clean_and_matches_manifest():
+    # the same gate CI applies: rules clean against the committed
+    # baseline, manifest diff all-ok.  If this fails after an intended
+    # lowering change, run `python -m tools.graftir --update-manifest`
+    # and commit the diff.
+    from tools.graftir.programs import build_representative_set
+
+    programs = build_representative_set()
+    keys = {p.key() for p in programs}
+    # the floor the acceptance demands: fused step, >=2 serve rungs,
+    # >=1 decode tick rung, >=1 quantized rung
+    assert "train/fused_step" in keys
+    assert len([k for k in keys if k.startswith("serve/")]) >= 2
+    assert any(k.startswith("decode/tick/") for k in keys)
+    assert any(k.startswith("quantize/") for k in keys)
+
+    engine, _ = audit_programs(programs)
+    assert engine.stats["new"] == 0, engine.report_text(engine.run())
+    rows, violations = gmanifest.diff(
+        programs, gmanifest.load(gmanifest.DEFAULT_MANIFEST))
+    assert violations == []
+    assert all(r["status"] == "ok" for r in rows), rows
+
+
+def test_cli_check_clean_on_shipped_tree(capsys):
+    # in-process `python -m tools.graftir --check`
+    from tools.graftir.__main__ import main as graftir_main
+    rc = graftir_main(["--check"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert out.strip().splitlines()[-1].startswith("graftir: programs=")

@@ -735,6 +735,51 @@ def test_jg013_negative(tmp_path):
     assert fs == []
 
 
+def test_jg014_positive_chained_and_split(tmp_path):
+    fs = lint(tmp_path, """\
+        import jax
+
+        def build(fn, avals):
+            return jax.jit(fn).lower(*avals).compile()
+
+        def build_split(fn, avals):
+            lowered = jax.jit(fn).lower(*avals)
+            text = lowered.as_text()
+            return lowered.compile(), text
+        """, rules=["JG014"])
+    assert len(fs) == 2, fs
+    assert rule_ids(fs) == ["JG014"] * 2
+    assert "graftir" in fs[0].message
+    assert "audited producers" in fs[0].message
+
+
+def test_jg014_negative_allowlisted_producer(tmp_path):
+    # the audited producers carry the MXNET_IR_AUDIT hooks — their
+    # build sites are the allowlist
+    fs = lint(tmp_path, """\
+        import jax
+
+        def ensure_program(jitted, avals):
+            lowered = jitted.lower(*avals)
+            return lowered.compile()
+        """, filename="serve/predictor.py", rules=["JG014"])
+    assert fs == []
+
+
+def test_jg014_negative_benign_compiles_and_lower_only(tmp_path):
+    fs = lint(tmp_path, """\
+        import re
+
+        def scan(s):
+            pat = re.compile("x+")        # stdlib compile: fine
+            return pat.match(s.lower())   # str.lower: fine
+
+        def inspect(jitted, avals):
+            return jitted.lower(*avals).as_text()   # lower-only: fine
+        """, rules=["JG014"])
+    assert fs == []
+
+
 # ---------------------------------------------------------------------------
 # suppression + baseline workflow
 # ---------------------------------------------------------------------------

@@ -462,6 +462,31 @@ def test_parse_hlo_dot_and_conv_flops():
         4 * (16 * 32 + 32 * 64 + 16 * 64)
 
 
+def test_parse_hlo_scan_counts_trip_count_times():
+    """Ops inside a lax.scan body (lowered to stablehlo.while calling
+    an outlined private function) must be charged trip_count x, not
+    1x — the decode tick programs are scan-shaped."""
+    import jax
+    import jax.numpy as jnp
+
+    def body(c, _):
+        c = c @ c
+        return c, jnp.sum(c)
+
+    def f(x):
+        return jax.lax.scan(body, x, None, length=5)
+
+    text = jax.jit(f).lower(jnp.ones((4, 4), jnp.float32)).as_text()
+    rows = costs.parse_hlo_ops(text)
+    dots = [r for r in rows if r["op"] == "dot_general"]
+    assert len(dots) == 1
+    # one 4x4 @ 4x4 matmul (2*4*4*4 = 128 flops) x 5 trips
+    assert dots[0]["flops"] == 5 * (2 * 4 * 4 * 4)
+    assert dots[0]["count"] == 5
+    # the while header itself must not be priced as an op
+    assert not any(r["op"] == "while" for r in rows)
+
+
 def test_parse_hlo_shared_type_binary_bytes():
     """Binary elementwise ops print in shared-type form; traffic must
     count BOTH operands plus the result (3x), and unary ops 2x."""

@@ -1,0 +1,29 @@
+"""graftir — static analyzer + committed cost manifest for the
+framework's lowered StableHLO programs.
+
+graftlint audits Python source and graftsan audits runtime behavior;
+graftir audits the *programs themselves*: the AOT StableHLO that the
+fused train step, every serve bucket rung, every decode tick and
+every quantized rung actually execute.  Rules GI001-GI005 turn
+whole-program conventions (donation coverage, dtype policy, no host
+round-trips, pad-waste budgets, program-count budgets) into checkable
+facts, and the committed ``manifest.json`` makes per-program
+flops/bytes a reviewable CI diff.
+
+Run ``python -m tools.graftir --check`` (see docs/ir_audit.md).
+"""
+
+from .engine import (AuditEngine, Baseline, Finding, audit_programs,
+                     DEFAULT_BASELINE)
+from .hlo import Program, canonical_sha, canonicalize, cost_summary
+from .manifest import (DEFAULT_MANIFEST, GROWTH_TOLERANCE, build, diff,
+                       format_diff_table, load, save)
+from .rules import ALL_RULES, RULE_DOCS
+
+__all__ = [
+    "AuditEngine", "Baseline", "Finding", "Program", "ALL_RULES",
+    "RULE_DOCS", "audit_programs", "canonical_sha", "canonicalize",
+    "cost_summary", "build", "diff", "load", "save",
+    "format_diff_table", "DEFAULT_BASELINE", "DEFAULT_MANIFEST",
+    "GROWTH_TOLERANCE",
+]

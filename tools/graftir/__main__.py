@@ -1,0 +1,159 @@
+"""CLI for graftir: ``python -m tools.graftir [--check]``.
+
+Lowers the representative AOT program set (CPU avals, the audited
+programs are never executed), runs rules GI001-GI005 against the
+committed baseline, and with ``--check`` also diffs per-program
+cost/structure against the committed manifest.
+
+Exit codes: 0 = clean, 1 = new findings or manifest violations,
+2 = usage/build error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def build_parser():
+    from .engine import DEFAULT_BASELINE
+    from .manifest import DEFAULT_MANIFEST
+    p = argparse.ArgumentParser(
+        prog="python -m tools.graftir",
+        description="Static auditor for the framework's lowered "
+                    "StableHLO programs (donation coverage, dtype "
+                    "policy, host round-trips, pad-waste, program "
+                    "budgets) plus a committed per-program cost "
+                    "manifest.",
+        epilog="Manifest workflow: --check fails on >10%% flops/bytes "
+               "growth, program-count drift, or rule regressions; "
+               "after an INTENDED change, regenerate with "
+               "--update-manifest and commit the diff — the manifest "
+               "diff is the review surface. Full rule catalog: "
+               "docs/ir_audit.md.")
+    p.add_argument("--check", action="store_true",
+                   help="also diff the lowered set against the "
+                        "committed manifest (CI mode)")
+    p.add_argument("--update-manifest", action="store_true",
+                   help="rewrite the manifest from the current tree's "
+                        "lowered programs and exit 0 (commit the "
+                        "result)")
+    p.add_argument("--manifest", default=DEFAULT_MANIFEST,
+                   metavar="PATH",
+                   help="manifest file (default: %(default)s)")
+    p.add_argument("--format", choices=("text", "json"), default="text",
+                   help="report format (default: text)")
+    p.add_argument("--rules", metavar="GI001,GI002,...",
+                   help="comma-separated subset of rules to run")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   metavar="PATH",
+                   help="baseline file (default: %(default)s)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline: report every finding as "
+                        "new")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="accept all current findings into the baseline "
+                        "file and exit 0 (commit the result)")
+    p.add_argument("--show-all", action="store_true",
+                   help="also print baselined/suppressed findings "
+                        "(tagged) in text output")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+
+    from .rules import ALL_RULES, RULE_DOCS
+    if args.list_rules:
+        for rid in sorted(RULE_DOCS):
+            print("%s  %s" % (rid, RULE_DOCS[rid]))
+        return 0
+
+    if args.rules:
+        rules = [r.strip().upper() for r in args.rules.split(",")
+                 if r.strip()]
+        unknown = [r for r in rules if r not in ALL_RULES]
+        if unknown:
+            print("graftir: unknown rule(s): %s (have: %s)"
+                  % (", ".join(unknown), ", ".join(sorted(ALL_RULES))),
+                  file=sys.stderr)
+            return 2
+    else:
+        rules = None
+
+    # the representative set lowers on CPU avals: pin the platform
+    # BEFORE jax initializes so the committed manifest shas reproduce
+    # on any machine
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from . import manifest as _manifest
+    from .engine import AuditEngine
+    from .programs import build_representative_set
+    try:
+        programs = build_representative_set()
+    except Exception as e:     # a build failure must not read as clean
+        print("graftir: representative set failed to lower: %r" % e,
+              file=sys.stderr)
+        return 2
+
+    engine = AuditEngine(programs, rules=rules,
+                         baseline_path=args.baseline,
+                         use_baseline=not args.no_baseline)
+    findings = engine.run()
+
+    if args.update_baseline:
+        n = engine.update_baseline(findings)
+        print("graftir: baseline updated (%d finding(s) accepted) -> %s"
+              % (n, args.baseline))
+        print(engine.summary_line())
+        return 0
+
+    if args.update_manifest:
+        payload = _manifest.build(programs)
+        _manifest.save(payload, args.manifest)
+        print("graftir: manifest updated (%d program(s)) -> %s"
+              % (len(payload["programs"]), args.manifest))
+        print(engine.summary_line())
+        return 0
+
+    violations = []
+    diff_rows = []
+    if args.check:
+        if not os.path.exists(args.manifest):
+            print("graftir: no manifest at %s — run --update-manifest "
+                  "and commit it" % args.manifest, file=sys.stderr)
+            return 2
+        diff_rows, violations = _manifest.diff(
+            programs, _manifest.load(args.manifest))
+
+    if args.format == "json":
+        import json
+        report = json.loads(engine.report_json(findings))
+        report["manifest"] = {"rows": diff_rows,
+                              "violations": violations}
+        print(json.dumps(report, indent=1))
+    else:
+        text = engine.report_text(findings, show_all=args.show_all)
+        if text:
+            print(text)
+        if args.check:
+            print(_manifest.format_diff_table(diff_rows),
+                  file=sys.stderr)
+            for v in violations:
+                print("graftir: manifest: %s" % v)
+    # one-line scrapeable summary, always last on stdout (CI greps
+    # '^graftir: ')
+    print(engine.summary_line())
+    return 1 if (engine.stats["new"] or violations) else 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        # stdout went away mid-report: the run is incomplete, never
+        # report clean — 141 = 128 + SIGPIPE
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(141)

@@ -1,0 +1,168 @@
+"""graftir engine: run the rules over a program set, filter through
+per-program suppressions and the committed baseline, report.
+
+Mirrors graftlint's engine shape (Finding / Baseline / engine.run()
+/ summary line / JSON report) so the two analyzers read the same in
+CI, but the unit of audit is a lowered *program*, not a source file:
+suppressions are declared by the producer at registration
+(``Program(..., suppress=("GI004",))``) instead of line comments, and
+baseline fingerprints key on (rule, program key, detail) — stable
+across HLO line-number drift.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+BASELINE_VERSION = 1
+
+
+class Finding:
+    __slots__ = ("rule", "program", "line", "message", "detail", "status")
+
+    def __init__(self, rule, program, message, line=0, detail=""):
+        self.rule = rule
+        self.program = program          # the Program record
+        self.line = line                # line in the HLO text (0 = n/a)
+        self.message = message
+        self.detail = detail            # stable fingerprint component
+        self.status = "new"             # new | baselined | suppressed
+
+    def fingerprint(self):
+        return "%s|%s|%s" % (self.rule, self.program.key(), self.detail)
+
+    def as_dict(self):
+        return {"rule": self.rule, "program": self.program.key(),
+                "line": self.line, "message": self.message,
+                "status": self.status}
+
+    def __repr__(self):
+        where = self.program.key()
+        if self.line:
+            where += ":%d" % self.line
+        return "%s: %s %s" % (where, self.rule, self.message)
+
+
+class Baseline:
+    """Committed ledger of accepted pre-existing findings."""
+
+    def __init__(self, path=DEFAULT_BASELINE):
+        self.path = path
+        self.counts = {}
+
+    @classmethod
+    def load(cls, path=DEFAULT_BASELINE):
+        b = cls(path)
+        if path and os.path.exists(path):
+            with open(path, encoding="utf-8") as f:
+                data = json.load(f)
+            b.counts = dict(data.get("findings", {}))
+        return b
+
+    def save(self, findings):
+        entries = {}
+        for f in findings:
+            fp = f.fingerprint()
+            entries[fp] = entries.get(fp, 0) + 1
+        payload = {
+            "version": BASELINE_VERSION,
+            "comment": "accepted pre-existing graftir findings; "
+                       "regenerate with --update-baseline (see "
+                       "docs/ir_audit.md)",
+            "findings": dict(sorted(entries.items())),
+        }
+        with open(self.path, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=1)
+            f.write("\n")
+
+    def consume(self, finding):
+        fp = finding.fingerprint()
+        left = self.counts.get(fp, 0)
+        if left > 0:
+            self.counts[fp] = left - 1
+            return True
+        return False
+
+
+class AuditEngine:
+    """Run rule checks over an audited program list."""
+
+    def __init__(self, programs, rules=None,
+                 baseline_path=DEFAULT_BASELINE, use_baseline=True):
+        from .rules import ALL_RULES
+        self.programs = list(programs)
+        self.rule_ids = sorted(rules or ALL_RULES)
+        self.rules = {rid: ALL_RULES[rid] for rid in self.rule_ids}
+        self.baseline_path = baseline_path
+        self.use_baseline = use_baseline
+        self.stats = {}
+
+    def run(self):
+        t0 = time.perf_counter()
+        findings = []
+        for rid in self.rule_ids:
+            findings.extend(self.rules[rid](self.programs))
+        findings.sort(key=lambda f: (f.program.key(), f.rule, f.line))
+
+        baseline = Baseline.load(self.baseline_path) \
+            if self.use_baseline else Baseline(self.baseline_path)
+        n_sup = n_base = 0
+        for f in findings:
+            if f.rule in f.program.suppress:
+                f.status = "suppressed"
+                n_sup += 1
+            elif baseline.consume(f):
+                f.status = "baselined"
+                n_base += 1
+
+        new = [f for f in findings if f.status == "new"]
+        self.stats = {
+            "programs": len(self.programs),
+            "rules": len(self.rule_ids),
+            "findings": len(findings),
+            "suppressed": n_sup,
+            "baselined": n_base,
+            "new": len(new),
+            "seconds": round(time.perf_counter() - t0, 3),
+        }
+        return findings
+
+    def update_baseline(self, findings):
+        keep = [f for f in findings if f.status != "suppressed"]
+        Baseline(self.baseline_path).save(keep)
+        return len(keep)
+
+    # -- reporting --------------------------------------------------------
+
+    def summary_line(self):
+        s = self.stats
+        return ("graftir: programs=%d rules=%d findings=%d baselined=%d "
+                "suppressed=%d new=%d time=%.2fs"
+                % (s["programs"], s["rules"], s["findings"],
+                   s["baselined"], s["suppressed"], s["new"],
+                   s["seconds"]))
+
+    def report_text(self, findings, show_all=False):
+        out = []
+        for f in findings:
+            if f.status == "new" or show_all:
+                tag = "" if f.status == "new" else " [%s]" % f.status
+                where = f.program.key()
+                if f.line:
+                    where += ":%d" % f.line
+                out.append("%s: %s%s %s" % (where, f.rule, tag, f.message))
+        return "\n".join(out)
+
+    def report_json(self, findings):
+        return json.dumps({"summary": self.stats,
+                           "findings": [f.as_dict() for f in findings]},
+                          indent=1)
+
+
+def audit_programs(programs, **kw):
+    """One-call audit (bridge/test entry): (engine, findings)."""
+    eng = AuditEngine(programs, **kw)
+    return eng, eng.run()

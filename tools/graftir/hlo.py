@@ -1,0 +1,165 @@
+"""HLO-text primitives for graftir: the :class:`Program` record plus
+the small parsers the rules share.
+
+graftir audits the *pretty-printed StableHLO text* that
+``jax.jit(...).lower(...)`` produces — the same text
+``mxnet_tpu.observability.costs`` prices — so everything here is
+regex-over-lines, dependency-light, and never executes a program.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+
+# op lines: "%3 = stablehlo.dot_general ..." (quoted generic form too)
+OP_RE = re.compile(r'=\s+"?(?:stablehlo|mhlo|chlo)\.([\w.]+)"?')
+TENSOR_RE = re.compile(r"tensor<([^>]*)>")
+_MAIN_RE = re.compile(r"func\.func\s+(?:public\s+)?@main\s*\(")
+_ARG_RE = re.compile(r"%arg\d+\s*:\s*tensor<([^>]*)>")
+_DONATE_ATTR_RE = re.compile(r"tf\.aliasing_output|jax\.buffer_donor")
+_LOC_RE = re.compile(r"\s*loc\(.*?\)")
+
+# custom_call targets that mean "leave the device / call the host".
+# @Sharding, @cu*, @annotate_device_placement-style markers are benign.
+HOST_CALL_RE = re.compile(
+    r"callback|host|infeed|outfeed|xla_python|py_func", re.IGNORECASE)
+
+
+class Program:
+    """One audited lowered program plus the producer's declarations.
+
+    The declarations are the contract the rules check the HLO against:
+
+    ``donated``
+        number of entry args the producing subsystem declares
+        donatable (``None`` = subsystem makes no donation promise).
+    ``dtype_policy``
+        ``None`` | ``"bf16"`` | ``"int8"`` | ``"int8-weight-only"``.
+    ``hot_path``
+        True for request/step-path programs where a host round-trip
+        is a latency bug (GI003).
+    ``bucket_rows`` / ``natural_rows``
+        padded batch rows of this bucket rung vs the worst-case
+        natural rows routed to it (GI004 pad-waste).
+    ``budget``
+        expected program count for this (subsystem, model) group
+        (GI005); every program in the group should declare the same
+        budget.
+    ``suppress``
+        rule ids accepted for this program (the per-program analogue
+        of graftlint's ``# graftlint: disable=`` comments).
+    """
+
+    __slots__ = ("subsystem", "model", "name", "text", "donated",
+                 "dtype_policy", "hot_path", "bucket_rows",
+                 "natural_rows", "budget", "suppress", "f32_allow")
+
+    def __init__(self, subsystem, name, text, model="", donated=None,
+                 dtype_policy=None, hot_path=False, bucket_rows=None,
+                 natural_rows=None, budget=None, suppress=(),
+                 f32_allow=()):
+        self.subsystem = subsystem
+        self.model = model
+        self.name = name
+        self.text = text
+        self.donated = donated
+        self.dtype_policy = dtype_policy
+        self.hot_path = hot_path
+        self.bucket_rows = bucket_rows
+        self.natural_rows = natural_rows
+        self.budget = budget
+        self.suppress = frozenset(r.upper() for r in suppress)
+        self.f32_allow = frozenset(f32_allow)
+
+    # -- derived views ----------------------------------------------------
+
+    def main_args(self):
+        """[(aval_str, donated_bool)] for the @main entry signature."""
+        m = _MAIN_RE.search(self.text)
+        if not m:
+            return []
+        # consume the balanced-paren arg list (the signature may wrap)
+        depth = 1
+        i = m.end()
+        while i < len(self.text) and depth:
+            c = self.text[i]
+            if c == "(":
+                depth += 1
+            elif c == ")":
+                depth -= 1
+            i += 1
+        sig = self.text[m.end():i - 1]
+        # split on %argN boundaries rather than regexing the attr
+        # dicts: attrs like mhlo.sharding = "{replicated}" nest braces
+        out = []
+        for part in re.split(r"(?=%arg\d+\s*:)", sig):
+            am = _ARG_RE.match(part.strip())
+            if not am:
+                continue
+            out.append((am.group(1).replace(" ", ""),
+                        bool(_DONATE_ATTR_RE.search(part))))
+        return out
+
+    def avals(self):
+        return [a for a, _ in self.main_args()]
+
+    def donated_args(self):
+        return sum(1 for _, d in self.main_args() if d)
+
+    def op_lines(self):
+        """[(lineno, opname, line)] for every dialect instruction."""
+        out = []
+        for i, line in enumerate(self.text.splitlines(), 1):
+            m = OP_RE.search(line)
+            if m:
+                out.append((i, m.group(1), line))
+        return out
+
+    def sha(self):
+        return canonical_sha(self.text)
+
+    def key(self):
+        return "%s/%s" % (self.subsystem, self.name)
+
+
+def canonicalize(text):
+    """Normalize lowered text so the sha is stable across runs:
+    location info, the module-attr header, and whitespace drift carry
+    no program semantics."""
+    lines = []
+    for line in text.splitlines():
+        if line.lstrip().startswith("#loc"):
+            continue
+        line = _LOC_RE.sub("", line)
+        if line.lstrip().startswith("module @"):
+            line = "module"
+        line = " ".join(line.split())
+        if line:
+            lines.append(line)
+    return "\n".join(lines)
+
+
+def canonical_sha(text):
+    return hashlib.sha256(
+        canonicalize(text).encode("utf-8")).hexdigest()[:16]
+
+
+def cost_summary(text, top=5):
+    """{flops, bytes, top_ops} via observability.costs (loop-aware)."""
+    from mxnet_tpu.observability import costs
+    rows = costs.parse_hlo_ops(text)
+    agg = {}
+    for r in rows:
+        a = agg.setdefault(r["op"], {"op": r["op"], "flops": 0.0,
+                                     "bytes": 0.0})
+        a["flops"] += r["flops"]
+        a["bytes"] += r["bytes"]
+    top_ops = sorted(agg.values(),
+                     key=lambda a: (-a["flops"], -a["bytes"], a["op"]))
+    return {
+        "flops": float(sum(r["flops"] for r in rows)),
+        "bytes": float(sum(r["bytes"] for r in rows)),
+        "top_ops": [{"op": a["op"], "flops": a["flops"],
+                     "bytes": a["bytes"]} for a in top_ops[:top]],
+    }

@@ -1,0 +1,142 @@
+"""The committed program manifest: per-program cost/structure facts,
+and the diff that gates CI.
+
+Every audited program registers (subsystem, name, input avals, sha of
+the canonicalized HLO text, flops/bytes and per-op top-k from
+``observability.costs``) into ``tools/graftir/manifest.json``.
+``python -m tools.graftir --check`` re-lowers the representative set
+and diffs it against the committed file; the check fails on
+
+* **program-count drift** — a program appeared or disappeared
+  (new rung, forked variant, dropped coverage);
+* **cost growth** — a program whose canonical sha changed grew >10%
+  in flops or bytes without ``--update-manifest`` being run;
+* anything else is reported as drift-within-tolerance and passes.
+
+This is what makes kernel/lowering PRs carry an attributable,
+reviewable diff: the manifest change IS the review surface, on CPU,
+before any TPU time is spent.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from .hlo import cost_summary
+
+DEFAULT_MANIFEST = os.path.join(os.path.dirname(__file__),
+                                "manifest.json")
+MANIFEST_VERSION = 1
+GROWTH_TOLERANCE = 0.10          # >10% flops/bytes growth fails
+
+
+def build(programs, top=5):
+    """Manifest payload (dict) for a program list."""
+    entries = {}
+    for p in programs:
+        cost = cost_summary(p.text, top=top)
+        entries[p.key()] = {
+            "subsystem": p.subsystem,
+            "model": p.model,
+            "name": p.name,
+            "avals": p.avals(),
+            "sha": p.sha(),
+            "flops": cost["flops"],
+            "bytes": cost["bytes"],
+            "top_ops": cost["top_ops"],
+            "donated": p.donated_args(),
+        }
+    return {
+        "version": MANIFEST_VERSION,
+        "comment": "committed per-program cost/structure manifest; "
+                   "regenerate with --update-manifest (see "
+                   "docs/ir_audit.md)",
+        "programs": dict(sorted(entries.items())),
+    }
+
+
+def save(payload, path=DEFAULT_MANIFEST):
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1, sort_keys=False)
+        f.write("\n")
+
+
+def load(path=DEFAULT_MANIFEST):
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def diff(programs, manifest, tolerance=GROWTH_TOLERANCE):
+    """Compare current programs against a committed manifest.
+
+    Returns ``(rows, violations)``: *rows* is the full per-program
+    diff table (``{program, status, flops, bytes, dflops, dbytes}``
+    with status ``ok | changed | grew | new | removed``); *violations*
+    the subset of human-readable failures."""
+    old = manifest.get("programs", {})
+    cur = build(programs)["programs"]
+    rows, violations = [], []
+
+    for key in sorted(set(old) | set(cur)):
+        o, c = old.get(key), cur.get(key)
+        if o is None:
+            rows.append({"program": key, "status": "new",
+                         "flops": c["flops"], "bytes": c["bytes"],
+                         "dflops": None, "dbytes": None})
+            violations.append(
+                "%s: program not in manifest (program-count drift — "
+                "run --update-manifest to accept)" % key)
+            continue
+        if c is None:
+            rows.append({"program": key, "status": "removed",
+                         "flops": 0.0, "bytes": 0.0,
+                         "dflops": None, "dbytes": None})
+            violations.append(
+                "%s: program in manifest but no longer lowered "
+                "(program-count drift — run --update-manifest to "
+                "accept)" % key)
+            continue
+        if c["sha"] == o["sha"]:
+            rows.append({"program": key, "status": "ok",
+                         "flops": c["flops"], "bytes": c["bytes"],
+                         "dflops": 0.0, "dbytes": 0.0})
+            continue
+        dflops = _rel(o["flops"], c["flops"])
+        dbytes = _rel(o["bytes"], c["bytes"])
+        grew = dflops > tolerance or dbytes > tolerance
+        rows.append({"program": key,
+                     "status": "grew" if grew else "changed",
+                     "flops": c["flops"], "bytes": c["bytes"],
+                     "dflops": dflops, "dbytes": dbytes})
+        if grew:
+            violations.append(
+                "%s: cost grew beyond %.0f%% tolerance "
+                "(flops %+.1f%%, bytes %+.1f%%) — investigate, then "
+                "--update-manifest if intended"
+                % (key, 100 * tolerance, 100 * dflops, 100 * dbytes))
+    return rows, violations
+
+
+def _rel(old, new):
+    if old <= 0:
+        return 0.0 if new <= 0 else float("inf")
+    return (new - old) / old
+
+
+def format_diff_table(rows):
+    """Human diff table (for stderr / bench --audit)."""
+    out = ["%-44s %-8s %12s %12s %8s %8s"
+           % ("program", "status", "flops", "bytes", "dflops",
+              "dbytes")]
+    for r in rows:
+        def pct(v):
+            if v is None:
+                return "-"
+            if v == float("inf"):
+                return "inf"
+            return "%+.1f%%" % (100 * v)
+        out.append("%-44s %-8s %12.3g %12.3g %8s %8s"
+                   % (r["program"], r["status"], r["flops"], r["bytes"],
+                      pct(r["dflops"]), pct(r["dbytes"])))
+    return "\n".join(out)

@@ -1,0 +1,195 @@
+"""The representative AOT program set: every program family the
+framework ships, lowered on tiny CPU avals and captured through the
+SAME producer hooks ``MXNET_IR_AUDIT`` uses in production.
+
+``python -m tools.graftir`` (and ``ci/graftir_smoke.py``) call
+:func:`build_representative_set`; ``--check`` diffs the result
+against the committed ``manifest.json``.  Everything here is
+deterministic — fixed seeds, fixed shapes, lower-only for the serving
+programs (the audited programs are never executed; the fused-step
+capture drives one tiny CPU train step because the production hook
+fires on first dispatch) — so the canonical-sha entries in the
+manifest reproduce bit-for-bit.
+
+Donation note: CPU jax reports ``supports_donation() == False``, so
+the builders force the donation *declaration* (patch / ``donate=True``)
+exactly like the existing CPU CI donation checks — GI001 audits the
+declared aliasing in the lowered text, which is backend-independent.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+# the shared rung geometry of the representative serve ladder: two
+# rungs is the smallest set that exercises bucket routing + GI004
+SERVE_RUNGS = (2, 8)
+DECODE_SESSIONS = 2
+QUANT_RUNG = 4
+
+
+def _ensure_import_path():
+    if _ROOT not in sys.path:
+        sys.path.insert(0, _ROOT)
+
+
+@contextlib.contextmanager
+def _declared_donation():
+    """Force the donation declaration on CPU (the fused-step builder
+    reads ``ops.registry.supports_donation`` at program-build time)."""
+    from mxnet_tpu.ops import registry as _reg
+    orig = _reg.supports_donation
+    _reg.supports_donation = lambda: True
+    try:
+        yield
+    finally:
+        _reg.supports_donation = orig
+
+
+def _build_fused_step():
+    """One tiny full-fused train step, captured via the production
+    first-dispatch hook in ``Module._run_fused_full``."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, sym
+    from mxnet_tpu.io import DataBatch
+
+    rng = np.random.RandomState(7)
+    data = sym.var("data")
+    net = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=4, name="fc2")
+    net = sym.SoftmaxOutput(net, name="softmax")
+
+    os.environ["MXNET_MODULE_FUSED_STEP"] = "1"
+    try:
+        with _declared_donation():
+            mod = mx.Module(net, context=mx.cpu())
+            mod.bind([("data", (4, 6))], [("softmax_label", (4,))])
+            mod.init_params(arg_params={
+                "fc1_weight": nd.array(
+                    rng.randn(8, 6).astype(np.float32) * 0.1),
+                "fc1_bias": nd.array(np.zeros(8, np.float32)),
+                "fc2_weight": nd.array(
+                    rng.randn(4, 8).astype(np.float32) * 0.1),
+                "fc2_bias": nd.array(np.zeros(4, np.float32)),
+            })
+            mod.init_optimizer(optimizer="sgd",
+                               optimizer_params={"learning_rate": 0.1})
+            batch = DataBatch(
+                data=[nd.array(rng.randn(4, 6).astype(np.float32))],
+                label=[nd.array(
+                    rng.randint(0, 4, 4).astype(np.float32))])
+            mod.forward_backward_update(batch)
+    finally:
+        os.environ.pop("MXNET_MODULE_FUSED_STEP", None)
+
+
+def _serve_predictor():
+    import numpy as np
+    from mxnet_tpu import nd, sym
+    from mxnet_tpu.serve.buckets import BucketLadder
+    from mxnet_tpu.serve.predictor import CompiledPredictor
+
+    rng = np.random.RandomState(11)
+    data = sym.var("data")
+    net = sym.FullyConnected(data, num_hidden=8, name="sf1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=4, name="sf2")
+    params = {
+        "sf1_weight": nd.array(rng.randn(8, 6).astype(np.float32) * 0.1),
+        "sf1_bias": nd.array(np.zeros(8, np.float32)),
+        "sf2_weight": nd.array(rng.randn(4, 8).astype(np.float32) * 0.1),
+        "sf2_bias": nd.array(np.zeros(4, np.float32)),
+    }
+    return CompiledPredictor(
+        net, params, data_shapes={"data": (max(SERVE_RUNGS), 6)},
+        ladder=BucketLadder(batches=SERVE_RUNGS), name="rep-mlp")
+
+
+def _build_serve_rungs():
+    """Every serve bucket rung, lower-only, declared through the same
+    ``_audit_rung`` helper ``ensure_program`` uses."""
+    pred = _serve_predictor()
+    for b in SERVE_RUNGS:
+        shapes = pred.rung_shapes(b)
+        pred._audit_rung(None, shapes, pred.lowered_text(shapes))
+
+
+def _build_decode_rungs():
+    """One paged-decode tick rung + one prefill rung, lower-only, with
+    the pool donation declared (donate=True, the CPU CI convention)."""
+    from mxnet_tpu.serve.decode import DecodeEngine
+    from mxnet_tpu.test_utils import tiny_attention_lm
+
+    params, step_fn, prefill_fn, token_spec, input_spec = \
+        tiny_attention_lm(vocab=16, dim=8, seed=3)
+    eng = DecodeEngine(
+        step_fn, prefill_fn=prefill_fn, token_spec=token_spec,
+        input_spec=input_spec, params=params, max_len=16,
+        block_size=4, num_blocks=24,
+        session_rungs=(DECODE_SESSIONS,), prefill_rungs=(4, 16),
+        donate=True, warm=False, label="rep-decode")
+    eng._audit("tick", "S%d" % DECODE_SESSIONS,
+               eng.lower_tick_text(DECODE_SESSIONS))
+    eng._audit("prefill", "L4", eng.lower_prefill_text(4))
+
+
+def _build_quantized_rungs():
+    """One int8-quantized serve rung (calibrate -> quantize_model ->
+    lower), declared with the quantize gate's dtype policy."""
+    import numpy as np
+    from mxnet_tpu import iraudit, nd, sym
+    from mxnet_tpu.quantize import calibrate, quantize_model
+    from mxnet_tpu.serve.buckets import BucketLadder
+    from mxnet_tpu.serve.predictor import CompiledPredictor
+
+    rng = np.random.RandomState(4)
+    data = sym.var("data")
+    c1 = sym.Convolution(data=data, kernel=(3, 3), num_filter=8,
+                         name="qc1")
+    a1 = sym.Activation(data=c1, act_type="relu", name="qa1")
+    f1 = sym.FullyConnected(data=a1, num_hidden=4, name="qf1")
+    params = {
+        "qc1_weight": nd.array(
+            rng.randn(8, 3, 3, 3).astype(np.float32) * 0.2),
+        "qc1_bias": nd.array(rng.randn(8).astype(np.float32) * 0.1),
+        "qf1_weight": nd.array(
+            rng.randn(4, 8 * 10 * 10).astype(np.float32) * 0.1),
+        "qf1_bias": nd.array(rng.randn(4).astype(np.float32) * 0.1),
+    }
+    batches = [rng.randn(4, 3, 12, 12).astype(np.float32)
+               for _ in range(3)]
+    table = calibrate(f1, params, batches)
+    qsym, qargs, qaux, _report = quantize_model(
+        f1, params, calib=table, policy="int8", name="rep-quant")
+    qpred = CompiledPredictor(
+        qsym, qargs, aux_params=qaux,
+        data_shapes={"data": (QUANT_RUNG, 3, 12, 12)},
+        ladder=BucketLadder(batches=(QUANT_RUNG,)), name="rep-quant")
+    for b in qpred.ladder.batches:
+        iraudit.audit(
+            "quantize", "quantized/b%d" % b,
+            qpred.lowered_text(qpred.rung_shapes(b)),
+            model="rep-quant", dtype_policy="int8",
+            budget=len(qpred.ladder.batches))
+
+
+def build_representative_set():
+    """Lower the full representative program set (CPU avals) and
+    return the captured ``Program`` list."""
+    _ensure_import_path()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from mxnet_tpu import iraudit
+
+    with iraudit.collect() as programs:
+        _build_fused_step()
+        _build_serve_rungs()
+        _build_decode_rungs()
+        _build_quantized_rungs()
+    return list(programs)

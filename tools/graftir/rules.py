@@ -1,0 +1,225 @@
+"""graftir rules GI001-GI005: whole-program properties checked as
+facts on the lowered StableHLO text.
+
+Every rule is ``check(programs) -> [Finding]`` over the full audited
+program list (GI005 needs the group view; the others iterate).  Rule
+ids, like graftlint's, are stable API: docs, suppressions, and the
+baseline key on them.
+"""
+
+from __future__ import annotations
+
+import re
+
+from .hlo import HOST_CALL_RE, TENSOR_RE
+from .engine import Finding
+
+# ---------------------------------------------------------------------------
+# GI001 — donation coverage
+
+
+def check_gi001(programs):
+    """Inputs declared donatable must carry input-output aliasing.
+
+    Generalizes the predictor's ad-hoc ``tf.aliasing_output`` grep to
+    every producer: a fused step, decode tick, or quantized rung that
+    promises donation but lowers without the attrs re-allocates its
+    largest buffers every dispatch."""
+    out = []
+    for p in programs:
+        if p.donated is None:
+            continue
+        have = p.donated_args()
+        if have < p.donated:
+            out.append(Finding(
+                "GI001", p,
+                "declares %d donatable input(s) but only %d carry "
+                "tf.aliasing_output/jax.buffer_donor in the lowered "
+                "program" % (p.donated, have),
+                detail="declared=%d" % p.donated))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GI002 — dtype policy conformance
+
+# (?:\b|x): "4xf64" has no word boundary before the "f"
+_F64_RE = re.compile(r"(?:\b|x)f64\b")
+_I8_OPERAND_RE = re.compile(r"tensor<[^>]*i8>")
+_COMPUTE_OPS = ("dot_general", "dot", "convolution")
+
+
+def _line_result_dtype(line):
+    """Element dtype of the result tensor on an instruction line."""
+    tensors = TENSOR_RE.findall(line)
+    if not tensors:
+        return None
+    m = re.search(r"([a-z]+[0-9]+)$", tensors[-1].split("x")[-1].strip())
+    return m.group(1) if m else None
+
+
+def check_gi002(programs):
+    """Dtype policy: no f64 anywhere; under the bf16 matmul policy no
+    dot/conv computes in f32 unless allowlisted; quantized rungs must
+    compute their declared conv/FC ops in i8/i32 (subsumes the
+    ``quantize/lower.py`` int8-dot probe)."""
+    out = []
+    for p in programs:
+        for lineno, op, line in p.op_lines():
+            if _F64_RE.search(line):
+                out.append(Finding(
+                    "GI002", p,
+                    "f64 in lowered program (op %s, line %d) — the "
+                    "framework dtype policy forbids double precision"
+                    % (op, lineno), line=lineno, detail="f64:%s" % op))
+                break       # one finding per program is enough signal
+        if p.dtype_policy == "bf16":
+            for lineno, op, line in p.op_lines():
+                if op in _COMPUTE_OPS and op not in p.f32_allow \
+                        and _line_result_dtype(line) == "f32":
+                    out.append(Finding(
+                        "GI002", p,
+                        "%s computes in f32 at line %d under the bf16 "
+                        "matmul policy (allowlist via f32_allow or a "
+                        "GI002 suppression if intended)" % (op, lineno),
+                        line=lineno, detail="f32:%s" % op))
+                    break
+        elif p.dtype_policy in ("int8", "int8-weight-only"):
+            compute = [ln for _, op, ln in p.op_lines()
+                       if op in _COMPUTE_OPS]
+            if p.dtype_policy == "int8":
+                ok = any(_I8_OPERAND_RE.search(ln) for ln in compute)
+                what = "no dot/conv computes on i8 operands"
+            else:
+                ok = bool(_I8_OPERAND_RE.search(p.text))
+                what = "no i8 tensors present"
+            if compute and not ok:
+                out.append(Finding(
+                    "GI002", p,
+                    "declared %s rung but %s — quantization was lost "
+                    "in lowering" % (p.dtype_policy, what),
+                    detail="lost-int8"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GI003 — host round-trips in hot-path programs
+
+_HOST_OPS = frozenset(["infeed", "outfeed", "send", "recv",
+                       "host_compute"])
+_TARGET_RE = re.compile(r'custom_call\s+@([\w$.]+)|call_target_name\s*=\s*"([^"]+)"')
+
+
+def check_gi003(programs):
+    """A hot-path program (request path, fused step, decode tick) must
+    never round-trip through the host mid-program: infeed/outfeed/
+    send/recv, or a custom_call into a python/host callback, turns a
+    single dispatch into a latency cliff."""
+    out = []
+    for p in programs:
+        if not p.hot_path:
+            continue
+        for lineno, op, line in p.op_lines():
+            if op in _HOST_OPS:
+                out.append(Finding(
+                    "GI003", p,
+                    "host transfer op %s at line %d in a hot-path "
+                    "program" % (op, lineno),
+                    line=lineno, detail="op:%s" % op))
+            elif op == "custom_call":
+                m = _TARGET_RE.search(line)
+                target = (m.group(1) or m.group(2)) if m else ""
+                if target and HOST_CALL_RE.search(target):
+                    out.append(Finding(
+                        "GI003", p,
+                        "custom_call @%s at line %d calls back into "
+                        "the host from a hot-path program"
+                        % (target, lineno),
+                        line=lineno, detail="cc:%s" % target))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GI004 — pad-waste per bucket rung
+
+PAD_WASTE_THRESHOLD = 0.75
+
+
+def check_gi004(programs, threshold=PAD_WASTE_THRESHOLD):
+    """Share of dot/conv flops attributable to padding rows.
+
+    Bucket rungs trade recompiles for padded work; that trade has a
+    budget.  With batch-linear compute, the waste share for a rung
+    padded to ``bucket_rows`` whose worst-case natural batch is
+    ``natural_rows`` is ``1 - natural/bucket``; above the threshold
+    the rung is mis-bucketed (e.g. a (1, 64) ladder sends a 2-row
+    request through the 64-row program at 97% waste)."""
+    out = []
+    for p in programs:
+        if not p.bucket_rows or not p.natural_rows:
+            continue
+        share = 1.0 - float(p.natural_rows) / float(p.bucket_rows)
+        if share > threshold:
+            out.append(Finding(
+                "GI004", p,
+                "pad-waste %.0f%% (bucket rows=%d, worst natural "
+                "rows=%d) exceeds the %.0f%% budget — add an "
+                "intermediate rung" % (100 * share, p.bucket_rows,
+                                       p.natural_rows, 100 * threshold),
+                detail="rows=%d" % p.bucket_rows))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# GI005 — program-count budget per subsystem
+
+
+def check_gi005(programs):
+    """Each (subsystem, model) group declares its expected program
+    count; growth means someone added an AOT program (a new rung, a
+    forked variant) without updating the budget — exactly the silent
+    compile-time/memory creep the manifest exists to catch."""
+    groups = {}
+    for p in programs:
+        groups.setdefault((p.subsystem, p.model), []).append(p)
+    out = []
+    for (subsystem, model), members in sorted(groups.items()):
+        budgets = {m.budget for m in members if m.budget is not None}
+        if not budgets:
+            continue
+        budget = max(budgets)
+        if len(members) > budget:
+            rep = members[0]
+            out.append(Finding(
+                "GI005", rep,
+                "subsystem %s%s lowered %d programs against a budget "
+                "of %d (%s)" % (
+                    subsystem, " model=%s" % model if model else "",
+                    len(members), budget,
+                    ", ".join(sorted(m.name for m in members))),
+                detail="group:%s/%s" % (subsystem, model)))
+    return out
+
+
+ALL_RULES = {
+    "GI001": check_gi001,
+    "GI002": check_gi002,
+    "GI003": check_gi003,
+    "GI004": check_gi004,
+    "GI005": check_gi005,
+}
+
+RULE_DOCS = {
+    "GI001": "donation coverage: declared-donatable inputs must carry "
+             "tf.aliasing_output/jax.buffer_donor in the lowered "
+             "program",
+    "GI002": "dtype policy: no f64; no f32 dot/conv under the bf16 "
+             "policy unless allowlisted; quantized rungs must keep "
+             "their i8 compute",
+    "GI003": "host round-trips: no infeed/outfeed/send/recv or "
+             "host-callback custom_call in hot-path programs",
+    "GI004": "pad-waste: share of dot/conv flops spent on padding "
+             "rows per bucket rung must stay under the budget",
+    "GI005": "program-count budget: each subsystem's AOT program "
+             "count must match its declared budget",
+}

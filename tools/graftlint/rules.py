@@ -1084,6 +1084,64 @@ def _jg013_loop_body_walk(loop):
 
 
 # ---------------------------------------------------------------------------
+# JG014 — un-audited AOT program build (lower().compile() off-path)
+# ---------------------------------------------------------------------------
+
+#: modules allowed to build AOT programs directly: their build sites
+#: carry the MXNET_IR_AUDIT hooks that register every program with
+#: the graftir auditor/manifest (tools/graftir, docs/ir_audit.md)
+_JG014_ALLOWED = {
+    "mxnet_tpu/serve/predictor.py",
+    "mxnet_tpu/serve/decode.py",
+}
+
+
+def check_jg014(project):
+    """A direct ``jit(...).lower(...).compile()`` call site outside
+    the audited producers builds an AOT program that bypasses the
+    graftir manifest: it ships with no donation/dtype/cost audit and
+    CI cannot see it grow.  Route new program families through the
+    audited helpers (CompiledPredictor / DecodeEngine /
+    Executor.init_fused_step) or add an ``iraudit.audit()`` hook at
+    the build site and extend the allowlist."""
+    out = []
+    for m in project.modules:
+        if m.relpath.replace("\\", "/") in _JG014_ALLOWED:
+            continue
+        # names assigned from a .lower(...) call (the split form:
+        # lowered = jit.lower(...); ...; lowered.compile())
+        lowered_names = set()
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call) and \
+                    isinstance(node.value.func, ast.Attribute) and \
+                    node.value.func.attr == "lower":
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        lowered_names.add(t.id)
+        for node in ast.walk(m.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "compile"):
+                continue
+            v = node.func.value
+            chained = (isinstance(v, ast.Call)
+                       and isinstance(v.func, ast.Attribute)
+                       and v.func.attr == "lower")
+            via_var = isinstance(v, ast.Name) and v.id in lowered_names
+            if chained or via_var:
+                out.append(Finding(
+                    "JG014", m.relpath, node.lineno, node.col_offset,
+                    "AOT program compiled outside the audited "
+                    "producers (.lower(...).compile()): it bypasses "
+                    "the graftir manifest/audit — build it through "
+                    "CompiledPredictor/DecodeEngine/init_fused_step, "
+                    "or add an iraudit.audit() hook and extend the "
+                    "JG014 allowlist (docs/ir_audit.md)"))
+    return out
+
+
+# ---------------------------------------------------------------------------
 
 ALL_RULES = {
     "JG001": check_jg001,
@@ -1099,6 +1157,7 @@ ALL_RULES = {
     "JG011": check_jg011,
     "JG012": check_jg012,
     "JG013": check_jg013,
+    "JG014": check_jg014,
 }
 
 RULE_DOCS = {
@@ -1138,4 +1197,8 @@ RULE_DOCS = {
              "train/predict steps — re-serializes the async dispatch "
              "pipeline to host+device per step; hoist the sync or "
              "bound its lag",
+    "JG014": "AOT program built off-path: .lower(...).compile() "
+             "outside the audited producers bypasses the graftir "
+             "manifest/audit (tools/graftir; route through "
+             "CompiledPredictor/DecodeEngine or hook iraudit.audit)",
 }
