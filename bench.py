@@ -1476,6 +1476,155 @@ def serve_decode_bench(rate=12.0, seconds=3.0, prompt_lo=4,
     return out
 
 
+def serve_decode_failover_bench(streams=6, new_tokens=48, replicas=2,
+                                vocab=32, dim=16, seed=5, kill_at=30,
+                                block_size=4, max_len=64):
+    """``--serve-decode --failover``: the decode fault-tolerance path
+    measured, not just gated — N wire decode streams through the
+    fleet router while one replica is armed to hard-kill mid-run
+    (``replica_kill_decode_at``), so the streams it was serving fail
+    over to a survivor and resume from the router journal.
+    Consumers stamp every delivered token client-side.  Prints ONE
+    BENCH-schema JSON line: resume latency p50/p99 out of
+    ``DecodeStream.resume_stamps`` (kill detection → resumed and
+    serving), steady vs dip tokens/sec (best vs worst interior 50 ms
+    delivery window — the dip is what the kill costs the fleet), full
+    bit-equality of every stream to the solo dense decode, and
+    request_path_compiles=0 on the survivors."""
+    import tempfile
+    import threading
+
+    from mxnet_tpu import serve
+    from mxnet_tpu.test_utils import (dense_decode_reference,
+                                      tiny_attention_lm)
+
+    prompt = np.array([3, 1, 2], dtype=np.int32)
+    blocks_per = -(-max_len // block_size)
+    spec = [{"name": "lm", "kind": "decode_lm", "vocab": vocab,
+             "dim": dim, "seed": seed, "dtype": "float32",
+             "max_len": max_len, "block_size": block_size,
+             "num_blocks": streams * blocks_per + 8,
+             "rungs": [1, 2, 4]}]
+    dparams, dstep, _, _, _ = tiny_attention_lm(vocab=vocab, dim=dim,
+                                                seed=seed)
+    ref = dense_decode_reference(dparams, dstep, list(prompt),
+                                 new_tokens, max_len, dim)
+
+    tmp = tempfile.mkdtemp(prefix="bench_decode_fo_")
+    fleet = serve.Fleet(spec, replicas=replicas, workdir=tmp,
+                        max_wait_ms=1.0,
+                        router_kwargs={"probe_interval": 0.2,
+                                       "retries": 4})
+    stamps = []                       # (t_mono, stream_seq) per token
+    errors = []
+    lock = threading.Lock()
+
+    def consume(s):
+        while True:
+            try:
+                s.next_output(timeout=120)
+            except StopIteration:
+                return
+            except Exception as exc:
+                with lock:
+                    errors.append("stream %d: %r" % (s.seq, exc))
+                return
+            with lock:
+                stamps.append((time.monotonic(), s.seq))
+
+    try:
+        fleet.start()
+        armed = fleet.replace(fleet.keys()[0], extra_env={
+            "MXNET_CHAOS": "replica_kill_decode_at=%d" % kill_at})
+        fleet.wait_routable(count=replicas, model="lm")
+        survivors = [k for k in fleet.keys() if k != armed]
+        warm = {k: fleet.stats(k)["decode"]["lm"]["compile_count"]
+                for k in survivors}
+        t0 = time.monotonic()
+        opened = [fleet.router.decode_open("lm", {"tok": prompt},
+                                           max_new_tokens=new_tokens)
+                  for _ in range(streams)]
+        threads = [threading.Thread(target=consume, args=(s,),
+                                    daemon=True) for s in opened]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        wall = time.monotonic() - t0
+        rec = fleet.record(armed)
+        deadline = time.monotonic() + 30
+        while rec["proc"].poll() is None and \
+                time.monotonic() < deadline:
+            time.sleep(0.1)
+        kill_rc = rec["proc"].poll()
+        bit_equal = True
+        for s in opened:
+            got = [int(np.asarray(t)) for t in s.tokens()]
+            if got != ref:
+                bit_equal = False
+                errors.append("stream %d not bit-equal" % s.seq)
+        moved = [s for s in opened if s.failover_count >= 1]
+        resume_lat = sorted(b - a for s in moved
+                            for a, b in s.resume_stamps)
+        request_path = sum(
+            fleet.stats(k)["decode"]["lm"]["compile_count"] - warm[k]
+            for k in survivors)
+        for s in opened:
+            s.close()
+    finally:
+        fleet.stop()
+
+    # interior 50 ms delivery windows: steady = best, dip = worst —
+    # the first/last windows are ramp and tail, not the kill's cost
+    win = 0.05
+    rates = []
+    if stamps:
+        times = sorted(t for t, _ in stamps)
+        t_lo, t_hi = times[0], times[-1]
+        n_win = max(1, int((t_hi - t_lo) / win))
+        counts = [0] * n_win
+        for t in times:
+            counts[min(n_win - 1, int((t - t_lo) / win))] += 1
+        rates = [c / win for c in counts[1:-1]] or \
+            [c / win for c in counts]
+    total_tokens = len(stamps)
+    out = {
+        "metric": "serve_decode_failover",
+        "value": round(resume_lat[-1] * 1e3, 3) if resume_lat
+        else None,
+        "unit": "ms_worst_resume",
+        "streams": streams,
+        "new_tokens": new_tokens,
+        "replicas": replicas,
+        "total_tokens": total_tokens,
+        "tokens_per_sec": round(total_tokens / wall, 2),
+        "failed_over_streams": len(moved),
+        "resumes": len(resume_lat),
+        "resume_p50_ms": round(
+            _percentile(resume_lat, 50) * 1e3, 3)
+        if resume_lat else None,
+        "resume_p99_ms": round(
+            _percentile(resume_lat, 99) * 1e3, 3)
+        if resume_lat else None,
+        "tokens_per_sec_steady": round(max(rates), 2)
+        if rates else None,
+        "tokens_per_sec_dip": round(min(rates), 2) if rates else None,
+        "dip_ratio": round(min(rates) / max(rates), 3)
+        if rates and max(rates) else None,
+        "bit_equal": bit_equal,
+        "kill_rc": kill_rc,
+        "request_path_compiles": request_path,
+        "errors": errors or None,
+    }
+    print(json.dumps(out))
+    if errors or not moved or kill_rc != 137 or request_path:
+        raise RuntimeError(
+            "decode failover bench failed: moved=%d rc=%r "
+            "request_path_compiles=%d errors=%s"
+            % (len(moved), kill_rc, request_path, errors[:3]))
+    return out
+
+
 def decompose_main():
     """``--decompose``: lower the north-star train step, attribute its
     cost per op against probed roofline peaks, print the human table
@@ -1618,8 +1767,13 @@ def main():
         return 0
     if "--serve-decode" in sys.argv:
         # open-loop many-session continuous-batching decode load;
-        # latency distribution + aggregate tokens/sec
+        # latency distribution + aggregate tokens/sec.  --failover
+        # instead measures the fault-tolerance path: resume latency
+        # and the tokens/sec dip around a seeded mid-run replica kill
         _ensure_platform()
+        if "--failover" in sys.argv:
+            serve_decode_failover_bench()
+            return
         serve_decode_bench(record_trace=_argv_path("--record-trace"),
                            trace=_argv_path("--trace"))
         return

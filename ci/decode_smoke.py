@@ -19,12 +19,24 @@ deliberate pool-exhaustion + recovery phase.  Gates:
   admission succeeds (exhaust -> recover);
 * **zero leaks** — every pool block is free and the active-session
   gauge is back to zero at the end;
+* **quarantine-and-rebuild** — a chaos-armed tick crash quarantines
+  the suspect pool, rebuilds a fresh one against the WARM programs
+  (zero new compiles asserted), re-admits every journaled session via
+  one re-prefill, and the finished streams are still bit-equal to the
+  solo dense decode; past ``MXNET_SERVE_DECODE_REBUILDS`` the next
+  crash degrades to a typed ServeError (unhealthy, never wedged);
 * **zero graftsan reports**; decode events (session_start/session_end,
-  tick, pool_exhausted) recorded and consistent.
+  tick, journal, rebuild, pool_exhausted) recorded and consistent.
+
+The event-balance gate runs with ``MXNET_OBS_RATE=0`` (uncapped):
+the default 200 events/sec cap silently drops session_start/
+session_end under CPU contention, which was the root cause of the
+historical "events unbalanced" flake — an accounting artifact of the
+rate limiter, not a decode bug.
 
 Last stdout line is the scrapeable summary::
 
-    decode: sessions=N ticks=M compiles=K ok
+    decode: sessions=N ticks=M compiles=K rebuilds=R ok
 """
 
 import os
@@ -36,6 +48,9 @@ import warnings
 os.environ.setdefault("MXNET_SAN", "all")
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.setdefault("MXNET_OBS", "decode")
+# Uncapped events: the 200/sec default drops start/end events under
+# CPU contention and breaks the balance gate (the old flake).
+os.environ.setdefault("MXNET_OBS_RATE", "0")
 os.environ.setdefault(
     "MXNET_OBS_PATH",
     os.path.join(tempfile.mkdtemp(prefix="decode_smoke_"),
@@ -52,7 +67,9 @@ import jax.numpy as jnp  # noqa: E402
 
 from mxnet_tpu.observability import events as obs_events  # noqa: E402
 from mxnet_tpu.observability import metrics as obs_metrics  # noqa: E402
-from mxnet_tpu.serve.buckets import RequestCancelled  # noqa: E402
+from mxnet_tpu.resilience import chaos  # noqa: E402
+from mxnet_tpu.serve.buckets import (RequestCancelled,  # noqa: E402
+                                     ServeError)
 from mxnet_tpu.serve.decode import (DecodeBatcher,  # noqa: E402
                                     DecodeEngine)
 from mxnet_tpu.serve.kvpool import KVPoolExhausted  # noqa: E402
@@ -216,6 +233,94 @@ def main():
         total_compiles = engine.compile_count
         engine.close()
 
+        # -- phase 4: tick-crash quarantine-and-rebuild ---------------
+        # A chaos-armed crash in the coalesced tick loop: the batcher
+        # must quarantine the suspect pool, rebuild a fresh one
+        # against the WARM programs (zero new compiles), re-admit the
+        # journaled sessions via one re-prefill each, and finish every
+        # stream bit-equal to the solo dense decode.  Past the rebuild
+        # budget the next crash degrades to a typed ServeError.
+        eng2 = DecodeEngine(
+            step_fn, prefill_fn, token_spec, input_spec, params=params,
+            max_len=MAX_LEN, block_size=BLOCK, num_blocks=16,
+            session_rungs=(1, 2), donate=True, label="rebuild")
+        bat2 = DecodeBatcher(eng2, name="rebuild", max_wait_ms=2.0,
+                             rebuilds=2)
+        c0 = eng2.compile_count
+        r_prompts = [list(prompts[0][:3]), list(prompts[1][:2])]
+        r_new = 8
+        r_refs = [dense_reference(params, step_fn, p, r_new,
+                                  eng2.padded_len) for p in r_prompts]
+        chaos.configure(decode_tick_raise_at=3)
+        try:
+            rsessions = [bat2.start({"tok": np.asarray(p, np.int32)},
+                                    max_new_tokens=r_new)
+                         for p in r_prompts]
+            rstreams = []
+            for s in rsessions:
+                try:
+                    rstreams.append([int(o) for o in s.result(60)])
+                except Exception as exc:
+                    failures.append("session %d lost across rebuild: "
+                                    "%r" % (s.sid, exc))
+                    rstreams.append(None)
+        finally:
+            chaos.reset()
+        for st, ref in zip(rstreams, r_refs):
+            if st is not None and st != ref:
+                failures.append("post-rebuild stream != solo dense "
+                                "decode: %s vs %s" % (st, ref))
+        if eng2.compile_count != c0:
+            failures.append(
+                "rebuild compiled %d NEW programs (must rebuild "
+                "against warm programs)" % (eng2.compile_count - c0))
+        if bat2.rebuild_count != 1:
+            failures.append("expected exactly 1 rebuild, got %d"
+                            % bat2.rebuild_count)
+        if bat2.health_state() != "ready":
+            failures.append("batcher not ready after rebuild: %r"
+                            % bat2.health_state())
+        if eng2.pool.blocks_in_use != 0:
+            failures.append("rebuild leaked %d pool blocks"
+                            % eng2.pool.blocks_in_use)
+        # burn the second (last) budgeted rebuild...
+        chaos.configure(decode_tick_raise_at=1,
+                        decode_tick_raise_for=1)
+        try:
+            s = bat2.start({"tok": np.asarray(r_prompts[0], np.int32)},
+                           max_new_tokens=4)
+            got = [int(o) for o in s.result(60)]
+            if got != r_refs[0][:4]:
+                failures.append("second-rebuild stream is not "
+                                "bit-equal: %s vs %s"
+                                % (got, r_refs[0][:4]))
+        except Exception as exc:
+            failures.append("second rebuild failed: %r" % (exc,))
+        finally:
+            chaos.reset()
+        # ...then the crash PAST the budget must fail typed, not wedge
+        chaos.configure(decode_tick_raise_at=1)
+        try:
+            s = bat2.start({"tok": np.asarray(r_prompts[0], np.int32)},
+                           max_new_tokens=4)
+            try:
+                s.result(60)
+                failures.append("past-budget crash resolved cleanly "
+                                "instead of failing typed")
+            except ServeError:
+                pass
+            except Exception as exc:
+                failures.append("past-budget failure not typed: %r"
+                                % (exc,))
+        finally:
+            chaos.reset()
+        if not bat2.unhealthy:
+            failures.append(
+                "batcher not unhealthy past the rebuild budget")
+        rebuilds = bat2.rebuild_count
+        bat2.close()
+        eng2.close()
+
     # decode events: starts == ends, tick + pool_exhausted present
     try:
         evs = [e for e in obs_events.read_events()
@@ -230,7 +335,7 @@ def main():
                         "ends" % (kinds.get("session_start", 0),
                                   kinds.get("session_end", 0)))
     for kind in ("session_start", "session_end", "tick",
-                 "pool_exhausted"):
+                 "pool_exhausted", "journal", "rebuild", "resume"):
         if not kinds.get(kind):
             failures.append("no %r decode event recorded (have %s)"
                             % (kind, sorted(kinds)))
@@ -242,11 +347,11 @@ def main():
     if failures:
         print("\n".join(failures), file=sys.stderr)
         print("decode smoke: FAIL", file=sys.stderr)
-        print("decode: sessions=%d ticks=%d compiles=%d FAIL"
-              % (n_sessions, ticks, total_compiles))
+        print("decode: sessions=%d ticks=%d compiles=%d rebuilds=%d "
+              "FAIL" % (n_sessions, ticks, total_compiles, rebuilds))
         return 1
-    print("decode: sessions=%d ticks=%d compiles=%d ok"
-          % (n_sessions, ticks, total_compiles))
+    print("decode: sessions=%d ticks=%d compiles=%d rebuilds=%d ok"
+          % (n_sessions, ticks, total_compiles, rebuilds))
     return 0
 
 

@@ -17,20 +17,35 @@ points and docs/serving.md "Serving fleet"):
              that warms from the shared persistent compile cache
              with ZERO new cache entries and ZERO request-path
              compiles under traffic
+  decode-kill  (streaming decode) a replica armed with
+             replica_kill_decode_at=K dies holding a DECODE rpc
+             mid-stream under concurrent predict load: every open
+             stream resumes transparently from the router journal on
+             a survivor — the full token stream bit-equal to the
+             SOLO dense-cache decode, zero request-path compiles on
+             the survivors, zero leaked KV pool blocks, and the
+             failover/resume counters advance
   deploy     fleet.deploy() cycles all 3 replicas onto checkpoint v2
              under concurrent load: zero dropped/failed requests,
              every answer bit-equal to v1 or v2, only v2 after the
              deploy completes, and the drain record reports zero
              abandoned work per replica
+  decode-deploy  (streaming decode) the same deploy rolls under
+             ACTIVE decode sessions: live sessions are evicted typed
+             at drain (journal handoff), every stream resumes on a
+             successor and finishes bit-equal to the solo dense
+             decode, with zero request-path compiles after the warm
+             start and zero leaked pool blocks
   partition  fleet_partition_at cuts router<->replica traffic to one
              replica: requests fail over, staleness ejects it from
              the rotation, healing the partition rejoins it, and the
              fleet serves through all of it with zero lost requests
 
 Cross-cutting: every submitter thread joins (nothing hangs), every
-submitted request resolves (nothing is lost), the fleet scrape
-aggregates 3 ready replicas, and the fleet event trail records
-failover/eject/rejoin/deploy.  Bounded child cleanup on any failure.
+submitted request/stream resolves (nothing is lost), the fleet scrape
+aggregates 3 ready replicas, and the fleet+decode event trail records
+failover/eject/rejoin/deploy and journal/session_place/failover/
+resume/migrate.  Bounded child cleanup on any failure.
 
 Scrapeable last stdout line::
 
@@ -44,7 +59,7 @@ import threading
 import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ.setdefault("MXNET_OBS", "fleet")
+os.environ.setdefault("MXNET_OBS", "fleet,decode")
 os.environ.setdefault("MXNET_OBS_RATE", "0")
 os.environ.setdefault(
     "MXNET_OBS_PATH",
@@ -64,10 +79,23 @@ from mxnet_tpu.observability import events as obs_events  # noqa: E402
 from mxnet_tpu.observability import metrics as obs_metrics  # noqa: E402
 from mxnet_tpu.resilience import chaos  # noqa: E402
 from mxnet_tpu.serve import Fleet, ServeError  # noqa: E402
+from mxnet_tpu.test_utils import (dense_decode_reference,  # noqa: E402
+                                  tiny_attention_lm)
 
 DIM = 8
 BATCHES = (1, 2, 4)
 REPLICAS = 3
+
+# the streaming-decode workload: the deterministic tiny attention LM
+# (same seed on every replica -> identical params -> bit-equal
+# cross-replica failover); max_len sized so deploy-time streams are
+# long-lived enough to be caught LIVE by the rolling drains
+DVOCAB, DDIM, DSEED = 32, 16, 5
+DMAX_LEN = 128
+DECODE_SPEC = {"name": "lm", "kind": "decode_lm", "vocab": DVOCAB,
+               "dim": DDIM, "seed": DSEED, "dtype": "float32",
+               "max_len": DMAX_LEN, "block_size": 4,
+               "num_blocks": 320, "rungs": [1, 2, 4]}
 
 failures = []
 faults = 0
@@ -246,12 +274,130 @@ def scenario_kill(fleet, xs, refs_v1):
           "from cache in-rotation" % (n, n2))
 
 
-def scenario_deploy(fleet, prefix, xs, refs_v1, refs_v2):
+def scenario_decode_kill(fleet, xs, refs_v1, dref):
+    """Scenario E: a replica armed to die on its 6th DECODE rpc is
+    killed mid-stream under concurrent predict load.  Every open
+    stream must resume transparently from the router journal on a
+    survivor — the full token stream bit-equal to the solo dense
+    decode — with zero request-path compiles on the survivors and
+    zero leaked KV pool blocks."""
+    global faults, recovered
+    before = len(failures)
+    victim = fleet.keys()[0]
+    armed = fleet.replace(victim, extra_env={
+        "MXNET_CHAOS": "replica_kill_decode_at=6"})
+    fleet.wait_routable(count=REPLICAS, model="m")
+    fleet.wait_routable(count=REPLICAS, model="lm")
+    survivors = [k for k in fleet.keys() if k != armed]
+    warm = {k: fleet.stats(k)["decode"]["lm"]["compile_count"]
+            for k in survivors}
+    snap0 = obs_metrics.snapshot()
+    fo0 = snap0.get("serve_decode_failovers_total",
+                    {}).get("value", 0)
+    rs0 = snap0.get("serve_decode_resumed_sessions_total",
+                    {}).get("value", 0)
+    prompt = np.array([3, 1, 2], dtype=np.int32)
+    n_new = 12
+    ref = dref(n_new)
+    # round-robin placement spreads 6 streams over 3 replicas — at
+    # least one lands on the armed replica, whose NEXT polls then
+    # trip the kill mid-stream
+    streams = [fleet.router.decode_open("lm", {"tok": prompt},
+                                        max_new_tokens=n_new)
+               for _ in range(2 * REPLICAS)]
+    check(any(s.replica == armed for s in streams),
+          "decode-kill: no stream placed on the armed replica")
+    load = {}
+
+    def _drive():
+        n, dt = drive(fleet, xs, [refs_v1], threads=4, per_thread=8,
+                      tag="decode-kill-load")
+        load["n"] = n
+    loader = threading.Thread(target=_drive, daemon=True)
+    loader.start()
+    for s in streams:
+        try:
+            got = [int(np.asarray(t)) for t in s.result(timeout=120)]
+        except Exception as exc:    # noqa: BLE001 - the gate
+            failures.append("decode-kill: stream %d LOST: %r"
+                            % (s.seq, exc))
+            continue
+        if got != ref:
+            failures.append(
+                "decode-kill: stream %d not bit-equal to the solo "
+                "dense decode: %s vs %s" % (s.seq, got, ref))
+    loader.join(timeout=120)
+    check(not loader.is_alive(), "decode-kill: predict load hung")
+    check(load.get("n") == 32,
+          "decode-kill: %r/32 predicts answered under the kill"
+          % (load.get("n"),))
+    rec = fleet.record(armed)
+    deadline = time.monotonic() + 30
+    while rec["proc"].poll() is None and time.monotonic() < deadline:
+        time.sleep(0.1)
+    check(rec["proc"].poll() == 137,
+          "decode-kill: armed replica rc=%r, expected 137"
+          % (rec["proc"].poll(),))
+    faults += 1
+    moved = [s for s in streams if s.failover_count >= 1]
+    check(moved, "decode-kill: no stream failed over")
+    check(all(s.replica != armed for s in moved),
+          "decode-kill: a resumed stream still points at the corpse")
+    snap = obs_metrics.snapshot()
+    check(snap.get("serve_decode_failovers_total",
+                   {}).get("value", 0) > fo0,
+          "decode-kill: serve_decode_failovers_total did not advance")
+    check(snap.get("serve_decode_resumed_sessions_total",
+                   {}).get("value", 0) > rs0,
+          "decode-kill: serve_decode_resumed_sessions_total did not "
+          "advance")
+    for k in survivors:
+        check(fleet.stats(k)["decode"]["lm"]["compile_count"]
+              == warm[k],
+              "decode-kill: survivor %s compiled in the request path "
+              "during failover" % k)
+    fleet.replace(armed)
+    fleet.wait_routable(count=REPLICAS, model="lm")
+    for s in streams:
+        s.close()
+    view = fleet.scrape()
+    for key, entry in view["replicas"].items():
+        blocks = entry.get("metrics", {}).get(
+            "mxnet_serve_kv_blocks_in_use")
+        check(blocks == 0,
+              "decode-kill: replica %s leaked %r KV pool blocks"
+              % (key, blocks))
+    resume_ms = [1e3 * (b - a) for s in moved
+                 for a, b in s.resume_stamps]
+    if len(failures) == before:
+        recovered += 1
+    print("  decode-kill: %d streams bit-equal around a 137-kill "
+          "(%d resumed, %.1fms worst resume), %r predicts answered"
+          % (len(streams), len(moved),
+             max(resume_ms) if resume_ms else -1.0, load.get("n")))
+
+
+def scenario_deploy(fleet, prefix, xs, refs_v1, refs_v2, dref):
     global recovered
     before = len(failures)
     spec_v2 = [{"name": "m", "prefix": prefix, "epoch": 2,
                 "data_shapes": {"data": [1, DIM]},
-                "batches": list(BATCHES)}]
+                "batches": list(BATCHES)},
+               dict(DECODE_SPEC)]
+    # scenario F rides the same deploy: long-lived decode streams
+    # opened BEFORE the roll, only partially delivered — every one of
+    # their replicas will be cycled, so every stream must hand off
+    # through its journal (drain eviction or dead-handle resume) and
+    # still finish bit-equal on a successor
+    d_new = 120
+    dref_full = dref(d_new)
+    dprompt = np.array([3, 1, 2], dtype=np.int32)
+    dstreams = [fleet.router.decode_open("lm", {"tok": dprompt},
+                                         max_new_tokens=d_new)
+                for _ in range(REPLICAS + 1)]
+    for s in dstreams:
+        for _ in range(2):
+            s.next_output(timeout=60)
     stop = threading.Event()
     load_failures = []
     answered = [0]
@@ -319,6 +465,62 @@ def scenario_deploy(fleet, prefix, xs, refs_v1, refs_v2):
           "requests answered, 0 new cache entries"
           % (deploy_dt, answered[0]))
 
+    # -- scenario F: the decode streams across that deploy ------------
+    before_decode = len(failures)
+    rs0 = obs_metrics.snapshot().get(
+        "serve_decode_resumed_sessions_total", {}).get("value", 0)
+    warm = {k: fleet.stats(k)["decode"]["lm"]["compile_count"]
+            for k in fleet.keys()}
+    for s in dstreams:
+        try:
+            got = [int(np.asarray(t)) for t in s.result(timeout=120)]
+        except Exception as exc:    # noqa: BLE001 - the gate
+            failures.append("deploy: decode stream %d LOST across "
+                            "the roll: %r" % (s.seq, exc))
+            continue
+        if got != dref_full:
+            failures.append(
+                "deploy: decode stream %d not bit-equal across the "
+                "roll (first diff at %s)"
+                % (s.seq, next((i for i, (a, b)
+                                in enumerate(zip(got, dref_full))
+                                if a != b), "len")))
+    # every original replica was cycled with the streams only 2/120
+    # delivered — each stream MUST have migrated at least once
+    check(all(s.failover_count >= 1 for s in dstreams),
+          "deploy: a decode stream finished without migrating off "
+          "its cycled replica")
+    check(obs_metrics.snapshot().get(
+              "serve_decode_resumed_sessions_total",
+              {}).get("value", 0) > rs0,
+          "deploy: no decode session resume was recorded")
+    evs = obs_events.read_events(obs_events.path())
+    evicted = sum(int(e.get("decode_evicted") or 0) for e in evs
+                  if e.get("ev") == "fleet"
+                  and e.get("kind") == "deploy_drain")
+    check(evicted >= 1,
+          "deploy: no LIVE decode session was evicted at drain "
+          "(journal handoff never exercised)")
+    for k in fleet.keys():
+        check(fleet.stats(k)["decode"]["lm"]["compile_count"]
+              == warm[k],
+              "deploy: decode resume compiled in the request path "
+              "on %s" % k)
+    for s in dstreams:
+        s.close()
+    view = fleet.scrape()
+    for key, entry in view["replicas"].items():
+        blocks = entry.get("metrics", {}).get(
+            "mxnet_serve_kv_blocks_in_use")
+        check(blocks == 0,
+              "deploy: replica %s leaked %r KV pool blocks after "
+              "the migrated streams finished" % (key, blocks))
+    if len(failures) == before_decode:
+        recovered += 1
+    print("  decode-deploy: %d streams migrated across the roll "
+          "(%d evicted live at drain), all bit-equal"
+          % (len(dstreams), evicted))
+
 
 def scenario_partition(fleet, xs, refs_v2):
     global faults, recovered
@@ -365,10 +567,18 @@ def check_event_trail():
     evs = obs_events.read_events(obs_events.path())
     kinds = {e.get("kind") for e in evs if e.get("ev") == "fleet"}
     for expected in ("spawn", "reap", "failover", "eject", "rejoin",
-                     "deploy", "deploy_drain", "replica_drain"):
+                     "deploy", "deploy_drain", "replica_drain",
+                     "decode_open"):
         check(expected in kinds,
               "event trail: no fleet %r event (have %s)"
               % (expected, sorted(kinds)))
+    dkinds = {e.get("kind") for e in evs if e.get("ev") == "decode"}
+    for expected in ("journal", "session_start", "session_end",
+                     "session_place", "failover", "resume",
+                     "migrate"):
+        check(expected in dkinds,
+              "event trail: no decode %r event (have %s)"
+              % (expected, sorted(dkinds)))
     drains = [e for e in evs if e.get("ev") == "fleet"
               and e.get("kind") == "deploy_drain"]
     check(all(e.get("timed_out") is False and
@@ -391,7 +601,20 @@ def main():
 
     spec_v1 = [{"name": "m", "prefix": prefix, "epoch": 1,
                 "data_shapes": {"data": [1, DIM]},
-                "batches": list(BATCHES)}]
+                "batches": list(BATCHES)},
+               dict(DECODE_SPEC)]
+    # solo dense-cache decode oracle for the streaming scenarios
+    # (same lm seed as every replica's spec entry)
+    dparams, dstep, _, _, _ = tiny_attention_lm(
+        vocab=DVOCAB, dim=DDIM, seed=DSEED)
+    dref_cache = {}
+
+    def dref(n):
+        if n not in dref_cache:
+            dref_cache[n] = dense_decode_reference(
+                dparams, dstep, [3, 1, 2], n, DMAX_LEN, DDIM)
+        return dref_cache[n]
+
     t0 = time.monotonic()
     fleet = Fleet(spec_v1, replicas=REPLICAS, workdir=tmp,
                   max_wait_ms=1.0,
@@ -405,7 +628,8 @@ def main():
                  cache_entries(fleet)))
         scenario_baseline(fleet, xs, refs_v1)
         scenario_kill(fleet, xs, refs_v1)
-        scenario_deploy(fleet, prefix, xs, refs_v1, refs_v2)
+        scenario_decode_kill(fleet, xs, refs_v1, dref)
+        scenario_deploy(fleet, prefix, xs, refs_v1, refs_v2, dref)
         scenario_partition(fleet, xs, refs_v2)
         check_event_trail()
     finally:
@@ -415,7 +639,7 @@ def main():
     if failures:
         for f in failures:
             print("fleet drill FAILURE: %s" % f, file=sys.stderr)
-    print("fleet: replicas=%d faults=%d recovered=%d/4 %s"
+    print("fleet: replicas=%d faults=%d recovered=%d/6 %s"
           % (REPLICAS, faults, recovered,
              "FAIL" if failures else "ok"))
     return 1 if failures else 0

@@ -202,6 +202,12 @@ def main():
         "serve_decode_active_sessions": lambda s: s["value"] == 0,
         "serve_kv_blocks_in_use": lambda s: s["value"] == 0,
         "serve_kv_blocks_total": lambda s: s["value"] == 0,
+        # the fault-tolerance counters must exist (registered at
+        # import) even when this clean workout never trips them
+        "serve_decode_failovers_total": lambda s: s["value"] >= 0,
+        "serve_decode_rebuilds_total": lambda s: s["value"] >= 0,
+        "serve_decode_resumed_sessions_total":
+            lambda s: s["value"] >= 0,
     }
     for name, check in decode_expected.items():
         if name not in snap:
@@ -391,10 +397,11 @@ def main():
                             % (e,))
     decode_kinds = {e.get("kind") for e in evs
                     if e.get("ev") == "decode"}
-    if not {"session_start", "session_end", "tick"} <= decode_kinds:
+    if not {"session_start", "session_end", "tick",
+            "journal"} <= decode_kinds:
         failures.append("decode workout should have recorded "
-                        "session_start/session_end/tick events, got "
-                        "kinds %s" % sorted(decode_kinds))
+                        "session_start/session_end/tick/journal "
+                        "events, got kinds %s" % sorted(decode_kinds))
     fleet_kinds = {e.get("kind") for e in evs if e.get("ev") == "fleet"}
     if not {"replica_admit", "failover"} <= fleet_kinds:
         failures.append("fleet workout should have recorded "

@@ -73,9 +73,12 @@ echo "== serve: continuous-batching decode drill (paged KV pool) =="
 # gather/scatter, co-tenant garbage, rung padding and join/leave
 # churn invisible in the tokens), one AOT compile per tick/prefill
 # rung and ZERO in the request path, a mid-decode cancel keeping its
-# accepted tokens, typed KVPoolExhausted shedding + recovery, zero
-# leaked blocks, zero graftsan reports (docs/serving.md).  Last
-# stdout line: "decode: sessions=.. ticks=.. compiles=.. ok".
+# accepted tokens, typed KVPoolExhausted shedding + recovery, a
+# chaos-armed tick crash surviving quarantine-and-rebuild (fresh pool
+# against warm programs, journaled sessions re-admitted bit-equal,
+# past-budget crash failing typed), zero leaked blocks, zero graftsan
+# reports (docs/serving.md).  Last stdout line:
+# "decode: sessions=.. ticks=.. compiles=.. rebuilds=.. ok".
 MXNET_SAN=all python ci/decode_smoke.py
 
 echo "== perf: autotune smoke (measured search + store pickup) =="

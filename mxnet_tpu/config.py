@@ -446,6 +446,14 @@ register_env("MXNET_SERVE_DEDUP_WINDOW", int, 256,
              "a retried or hedged RPC is answered from cache "
              "instead of re-dispatched (in-flight entries are "
              "never trimmed)")
+register_env("MXNET_SERVE_DECODE_REBUILDS", int, 2,
+             "How many decode tick-loop crashes a DecodeBatcher "
+             "survives by quarantine-and-rebuild: the suspect KVPool "
+             "is quarantined, a fresh same-shape pool is allocated "
+             "against the already-warm tick/prefill programs (zero "
+             "new compiles) and journaled sessions are re-admitted "
+             "via re-prefill + replayed ticks; past the budget the "
+             "batcher degrades to unhealthy typed-fail")
 
 
 def enable_compile_cache():

@@ -48,6 +48,18 @@ process):
     ``_exit`` seam) on receiving its K-th PREDICT request — BEFORE
     dispatch, so the router sees the connection die mid-request and
     must fail the request over to another replica.
+``replica_kill_decode_at=K``
+    Same hard-exit, but counting DECODE_* requests (OPEN/NEXT): the
+    replica dies mid-stream, so the router must re-open every live
+    decode session on a healthy replica from its journal and resume
+    bit-equal.
+``decode_tick_raise_at=K`` (+ optional ``decode_tick_raise_for=N``)
+    Raise ``RuntimeError`` out of the K-th decode-engine tick (and
+    the following N-1 with ``decode_tick_raise_for``) — the crash
+    escapes the DecodeBatcher loop mid-donation, so the suspect pool
+    must be quarantined and rebuilt (bounded by
+    ``MXNET_SERVE_DECODE_REBUILDS``) with journaled sessions
+    re-admitted via re-prefill.
 ``slow_replica_ms=X`` (+ optional ``slow_replica_for=N``)
     Every PREDICT (or the first N with ``slow_replica_for``) sleeps
     X milliseconds before dispatch — the straggling-replica bait for
@@ -76,7 +88,8 @@ from . import chaos
 from .. import sanitizer as _san
 
 __all__ = ["on_dispatch", "on_warm", "on_replica_request",
-           "on_router_send", "release_hangs", "reset_hangs"]
+           "on_replica_decode", "on_decode_tick", "on_router_send",
+           "release_hangs", "reset_hangs"]
 
 log = logging.getLogger(__name__)
 
@@ -164,6 +177,51 @@ def on_replica_request(replica):
         log.warning("servechaos: hard-killing replica %r at predict "
                     "%d", replica, n)
         _exit(137)
+
+
+def on_replica_decode(replica):
+    """Replica-side decode choke point, consulted by the replica's
+    connection handler for every DECODE_OPEN / DECODE_NEXT request
+    BEFORE it reaches the decode batcher.  ``replica_kill_decode_at=K``
+    hard-exits the process on the K-th decode request — the router
+    must re-open this replica's live sessions elsewhere from their
+    journals and resume them bit-equal."""
+    if not chaos.enabled():
+        return
+    kill_at = chaos.active().get("replica_kill_decode_at")
+    if kill_at is None:
+        return
+    n = chaos.tick("replica_decode")
+    if n == kill_at:
+        chaos.note_injection("replica_kill_decode_at", at=n,
+                             replica=replica)
+        log.warning("servechaos: hard-killing replica %r at decode "
+                    "request %d", replica, n)
+        _exit(137)
+
+
+def on_decode_tick(name):
+    """Decode tick choke point, consulted by
+    :meth:`~mxnet_tpu.serve.decode.DecodeEngine.tick` before the
+    coalesced tick dispatch.  ``decode_tick_raise_at=K`` (+
+    ``decode_tick_raise_for=N``) raises ``RuntimeError`` so the crash
+    escapes the DecodeBatcher loop mid-donation — the
+    quarantine-and-rebuild path (fresh pool, warm programs, journaled
+    re-admission) must run."""
+    if not chaos.enabled():
+        return
+    spec = chaos.active()
+    raise_at = spec.get("decode_tick_raise_at")
+    if raise_at is None:
+        return
+    n = chaos.tick("decode_tick")
+    if raise_at <= n < raise_at + spec.get("decode_tick_raise_for", 1):
+        chaos.note_injection("decode_tick_raise_at", at=n, engine=name)
+        log.warning("servechaos: raising on decode tick %d of engine "
+                    "%r", n, name)
+        raise RuntimeError(
+            "servechaos: injected decode tick failure (tick %d, "
+            "engine %r)" % (n, name))
 
 
 def on_router_send(replica, port=None):

@@ -49,11 +49,12 @@ from .kvpool import KVPool, KVPoolExhausted  # noqa: F401
 from .predictor import CompiledPredictor, DecodeSession  # noqa: F401
 from .batcher import DynamicBatcher, ServeFuture  # noqa: F401
 from .decode import (DecodeBatcher, DecodeEngine,  # noqa: F401
-                     PagedSession, SpeculativeDecoder)
+                     DecodeJournal, PagedSession, SpeculativeDecoder)
 from .registry import ModelRegistry, c_registry  # noqa: F401
 from .replica import (ReplicaDraining, ReplicaServer,  # noqa: F401
                       start_http_probe)
-from .router import CircuitBreaker, ReplicaHandle, Router  # noqa: F401
+from .router import (CircuitBreaker, DecodeStream,  # noqa: F401
+                     ReplicaHandle, Router)
 from .fleet import Fleet  # noqa: F401
 
 __all__ = ["BucketLadder", "ServeError", "OverloadError",
@@ -61,6 +62,7 @@ __all__ = ["BucketLadder", "ServeError", "OverloadError",
            "CompiledPredictor", "DecodeSession", "DynamicBatcher",
            "ServeFuture", "ModelRegistry", "c_registry", "HealthBoard",
            "STATES", "KVPool", "KVPoolExhausted", "DecodeEngine",
-           "DecodeBatcher", "PagedSession", "SpeculativeDecoder",
-           "ReplicaServer", "ReplicaDraining", "start_http_probe",
-           "CircuitBreaker", "ReplicaHandle", "Router", "Fleet"]
+           "DecodeBatcher", "DecodeJournal", "PagedSession",
+           "SpeculativeDecoder", "ReplicaServer", "ReplicaDraining",
+           "start_http_probe", "CircuitBreaker", "DecodeStream",
+           "ReplicaHandle", "Router", "Fleet"]

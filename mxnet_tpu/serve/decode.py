@@ -54,6 +54,21 @@ Step contract (what a model plugs in)::
   regular decode tick, so every emitted token comes from the same
   tick program — the bit-equality anchor.
 
+Fault tolerance (PR: streaming decode fault tolerance): every
+session rides an idempotent append-only :class:`DecodeJournal`
+record — identity ``(client, session_seq, incarnation)``, prompt,
+sampling config, params sha and the accepted-token log — so greedy
+decode is deterministically resumable from prompt + accepted tokens
+via ONE re-prefill plus replayed ticks (delivery suppressed, each
+replayed output bit-checked against the journal).  A tick-loop crash
+no longer marks the batcher unhealthy forever: the suspect pool is
+quarantined, a fresh same-shape :class:`~mxnet_tpu.serve.kvpool.KVPool`
+is swapped in against the already-warm programs (zero new compiles,
+asserted) and journaled sessions are re-admitted — bounded by
+``MXNET_SERVE_DECODE_REBUILDS``, past which the batcher degrades to
+the old unhealthy typed-fail behavior.  See docs/serving.md ("Decode
+fault tolerance").
+
 See docs/serving.md ("Continuous-batching decode") for the pool
 layout, scheduling and knob table.
 """
@@ -70,12 +85,13 @@ from .buckets import (BucketLadder, DeadlineExceededError,
                       RequestCancelled, ServeError)
 from .kvpool import KVPool, KVPoolExhausted
 from .. import iraudit as _iraudit
+from ..resilience import servechaos as _servechaos
 from .. import sanitizer as _san
 from ..observability import events as _obs_events
 from ..observability import metrics as _obs_metrics
 
 __all__ = ["DecodeEngine", "PagedSession", "DecodeBatcher",
-           "SpeculativeDecoder"]
+           "DecodeJournal", "SpeculativeDecoder"]
 
 log = logging.getLogger(__name__)
 
@@ -104,10 +120,160 @@ _COMPILES_TOTAL = _obs_metrics.counter(
     "serve_compiles_total",
     "AOT program builds (bucket warmups + decode steps); flat after "
     "warmup or the request path is compiling")
+_FAILOVERS_TOTAL = _obs_metrics.counter(
+    "serve_decode_failovers_total",
+    "decode sessions re-opened on another replica after their "
+    "replica died / ejected / drained (router-side journal resume)")
+_REBUILDS_TOTAL = _obs_metrics.counter(
+    "serve_decode_rebuilds_total",
+    "decode pool quarantine-and-rebuild cycles after a tick-loop "
+    "crash (bounded by MXNET_SERVE_DECODE_REBUILDS)")
+_RESUMED_TOTAL = _obs_metrics.counter(
+    "serve_decode_resumed_sessions_total",
+    "journaled decode sessions re-admitted via re-prefill + replayed "
+    "ticks (in-process rebuilds and router-side failovers)")
 
 
 def _ceil_div(a, b):
     return -(-int(a) // int(b))
+
+
+def _token_bytes(out):
+    """Canonical byte identity of one step-output tree — the journal
+    replay bit-equality check (and the speculative accept test)."""
+    import jax
+    return tuple(_np.asarray(leaf).tobytes()
+                 for leaf in jax.tree_util.tree_leaves(out))
+
+
+class JournalRecord:
+    """One session's journal entry: identity, everything needed to
+    re-prefill, and the accepted-token log."""
+
+    __slots__ = ("client", "seq", "incarnation", "prompt", "length",
+                 "max_new_tokens", "sampling", "params_sha", "tokens",
+                 "closed", "reason")
+
+    def __init__(self, client, seq, incarnation, prompt, length,
+                 max_new_tokens, sampling, params_sha):
+        self.client = client
+        self.seq = int(seq)
+        self.incarnation = int(incarnation)
+        self.prompt = prompt          # {name: (L,)+shape} host arrays
+        self.length = int(length)
+        self.max_new_tokens = max_new_tokens
+        self.sampling = sampling      # e.g. {"mode": "greedy"}
+        self.params_sha = params_sha
+        self.tokens = []              # accepted host output trees
+        self.closed = False
+        self.reason = None
+
+    @property
+    def key(self):
+        return (self.client, self.seq)
+
+
+class DecodeJournal:
+    """Idempotent append-only record of decode sessions — the resume
+    source of truth.
+
+    Each record carries the session identity ``(client, session_seq,
+    incarnation)``, the normalized prompt, the sampling config, the
+    engine's params sha and the accepted-token log.  ``append`` is
+    idempotent by token index (a replayed tick re-appending token *i*
+    is a no-op; a gap is a bug and raises), so crash-retried writers
+    never double-log.  Greedy decode is deterministically resumable
+    from a record: one re-prefill of the prompt prefix plus replayed
+    ticks feeding the journaled tokens reproduces the interrupted
+    stream bit-equal (proven against
+    ``test_utils.dense_decode_reference``).
+
+    Used in-process by :class:`DecodeEngine` (direct ``DecodeBatcher``
+    sessions, key ``("local", sid, 0)``) and router-side for fleet
+    sessions (the router journals what the replica streamed back, and
+    re-opens elsewhere from it on failover).  Closed records are kept
+    for a bounded window so late duplicate RPCs can still be answered
+    from the log."""
+
+    def __init__(self, label="journal", keep_closed=64):
+        self.label = label
+        self._keep_closed = int(keep_closed)
+        self._lock = _san.lock(label="serve.decode.journal.%s" % label)
+        self._records = collections.OrderedDict()
+        _san.track(self, ("_records",),
+                   label="serve.decode.journal.%s" % label)
+
+    def open(self, client, seq, incarnation, prompt, length,
+             max_new_tokens=None, sampling=None, params_sha=None):
+        """Open (or re-open) a record — idempotent on ``(client,
+        seq)``: a retried OPEN returns the existing record; a resume
+        under a bumped *incarnation* updates the stamp and keeps the
+        accepted-token log."""
+        key = (client, int(seq))
+        with self._lock:
+            rec = self._records.get(key)
+            if rec is not None:
+                if int(incarnation) > rec.incarnation:
+                    rec.incarnation = int(incarnation)
+                return rec
+            rec = JournalRecord(client, seq, incarnation, prompt,
+                                length, max_new_tokens,
+                                sampling or {"mode": "greedy"},
+                                params_sha)
+            self._records[key] = rec
+            self._trim_locked()
+            return rec
+
+    def append(self, key, index, token):
+        """Log accepted token *index* — idempotent: re-appending an
+        already-logged index is a no-op, a gap raises (accepted
+        tokens are never lost, so a gap means the caller skipped
+        one)."""
+        with self._lock:
+            rec = self._records.get((key[0], int(key[1])))
+            if rec is None or rec.closed:
+                return
+            index = int(index)
+            if index < len(rec.tokens):
+                return            # duplicate (replayed tick) — no-op
+            if index > len(rec.tokens):
+                raise ServeError(
+                    "decode journal %r: token %d appended with %d "
+                    "logged — the accepted-token log has a gap"
+                    % (self.label, index, len(rec.tokens)))
+            rec.tokens.append(token)
+
+    def record(self, key):
+        with self._lock:
+            return self._records.get((key[0], int(key[1])))
+
+    def tokens(self, key):
+        """The accepted-token log (a copy) — the replay source."""
+        with self._lock:
+            rec = self._records.get((key[0], int(key[1])))
+            return list(rec.tokens) if rec is not None else []
+
+    def close(self, key, reason):
+        """Mark a record terminal (idempotent).  Kept for the closed
+        window, then trimmed."""
+        with self._lock:
+            rec = self._records.get((key[0], int(key[1])))
+            if rec is None or rec.closed:
+                return
+            rec.closed = True
+            rec.reason = reason
+            self._trim_locked()
+
+    def live_records(self):
+        """Records not yet terminal — what a rebuild/failover must
+        re-admit (or fail typed)."""
+        with self._lock:
+            return [r for r in self._records.values() if not r.closed]
+
+    def _trim_locked(self):
+        closed = [k for k, r in self._records.items() if r.closed]
+        while len(closed) > self._keep_closed:
+            self._records.pop(closed.pop(0), None)
 
 
 class PagedSession:
@@ -135,6 +301,11 @@ class PagedSession:
         self.max_new_tokens = max_new_tokens
         self.stop_fn = stop_fn
         self._deadline = deadline     # monotonic; bounds time-to-join
+        self.journal_key = None       # (client, seq) — set by admit
+        self._replay = collections.deque()  # journaled outs to replay
+        self._base = 0                # tokens emitted before a resume
+                                      # (wire resume: delivery starts
+                                      # fresh, budgets count the total)
         self._cond = _san.condition(
             label="serve.decode.session%d" % self.sid)
         self._outputs = []
@@ -201,6 +372,36 @@ class PagedSession:
             raise StopIteration("decode session %d finished (%s)"
                                 % (self.sid, self.finish_reason))
 
+    def output_at(self, i, timeout=None):
+        """Non-consuming read of delivered token *i* (0-based in this
+        session's delivered stream): blocks until it exists, the
+        session finishes short of it, or *timeout*.  The wire
+        DECODE_NEXT dedup path — a retried index is answered from the
+        retained stream, never re-decoded.  Raises the typed error
+        after a failure, ``StopIteration`` when the stream finished
+        before index *i*, ``TimeoutError`` on *timeout*."""
+        i = int(i)
+        deadline = None if timeout is None \
+            else _time.monotonic() + timeout
+        with self._cond:
+            while True:
+                if len(self._outputs) > i:
+                    return self._outputs[i]
+                if self._done:
+                    if self._error is not None:
+                        raise self._error
+                    raise StopIteration(
+                        "decode session %d finished (%s) at %d "
+                        "token(s)" % (self.sid, self.finish_reason,
+                                      len(self._outputs)))
+                remaining = None if deadline is None \
+                    else deadline - _time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError(
+                        "decode session %d: token %d not delivered "
+                        "after %ss" % (self.sid, i, timeout))
+                self._cond.wait(remaining)
+
     def result(self, timeout=None):
         """Wait for the session to finish; returns the full output
         stream, or raises the typed failure."""
@@ -234,6 +435,12 @@ class PagedSession:
     def cancelled(self):
         with self._cond:
             return self._cancel
+
+    @property
+    def resuming(self):
+        """True while journaled tokens are still being replayed (the
+        session is catching its cache up; delivery is suppressed)."""
+        return bool(self._replay)
 
     # -- engine side --------------------------------------------------------
     def _deliver(self, out, now):
@@ -398,6 +605,9 @@ class DecodeEngine:
         self._live = []               # admitted, not yet released
         self._batchers = []
         self._closed = False
+        self._journal = DecodeJournal(label)
+        self._params_sha_cache = None
+        self._rebuilds = 0            # pool quarantine-and-rebuilds
         _san.track(self, ("_tick_progs", "_prefill_progs", "_compiles",
                           "_dispatches", "_live", "_closed"),
                    label="serve.decode.%s" % label)
@@ -429,6 +639,30 @@ class DecodeEngine:
     def active_sessions(self):
         with self._lock:
             return len(self._live)
+
+    @property
+    def journal(self):
+        """The engine's in-process :class:`DecodeJournal`."""
+        return self._journal
+
+    @property
+    def rebuild_count(self):
+        with self._lock:
+            return self._rebuilds
+
+    def params_sha(self):
+        """sha256 over the host bytes of every parameter leaf
+        (computed once, cached) — the journal's model-identity stamp:
+        a resume onto drifted params would not be bit-equal, so the
+        caller can refuse it up front."""
+        if self._params_sha_cache is None:
+            import hashlib
+            import jax
+            h = hashlib.sha256()
+            for leaf in jax.tree_util.tree_leaves(self._params):
+                h.update(_np.asarray(leaf).tobytes())
+            self._params_sha_cache = h.hexdigest()[:16]
+        return self._params_sha_cache
 
     def tick_lowered_text(self, rung):
         return self._tick_text.get(int(rung), "")
@@ -705,11 +939,21 @@ class DecodeEngine:
         return out, length
 
     def admit(self, prompt, max_new_tokens=None, stop_fn=None,
-              deadline_ms=None):
+              deadline_ms=None, journal_key=None, incarnation=0,
+              resume_tokens=None):
         """Admission: validate the prompt, allocate its blocks (typed
         :class:`KVPoolExhausted` when the pool cannot hold it — shed
-        at the front door), register the session.  Prefill/decode
-        have not run yet — call :meth:`prefill` (the batcher does)."""
+        at the front door), register the session and open its journal
+        record.  Prefill/decode have not run yet — call
+        :meth:`prefill` (the batcher does).
+
+        *journal_key* is the ``(client, session_seq)`` identity (a
+        direct session defaults to ``("local", sid)``); *incarnation*
+        bumps on every resume.  *resume_tokens* (journaled host
+        output trees) arms replay: after re-prefill the session
+        replays them through ordinary ticks with delivery suppressed,
+        each replayed output bit-checked — resume is bit-equal to an
+        uninterrupted stream or fails typed."""
         prompt, length = self._normalize_prompt(prompt)
         with self._lock:
             if self._closed:
@@ -723,6 +967,19 @@ class DecodeEngine:
                     if deadline_ms else None)
         sess = PagedSession(self, prompt, length, blocks, table,
                             max_new_tokens, stop_fn, deadline)
+        sess.journal_key = tuple(journal_key) if journal_key \
+            else ("local", sess.sid)
+        if resume_tokens:
+            sess._replay = collections.deque(resume_tokens)
+            sess._base = len(resume_tokens)
+        rec = self._journal.open(
+            sess.journal_key[0], sess.journal_key[1], incarnation,
+            prompt, length, max_new_tokens=max_new_tokens,
+            params_sha=self.params_sha())
+        if resume_tokens and not rec.tokens:
+            # a resume journaled elsewhere (router handoff): seed the
+            # local log so replayed ticks dedup against it
+            rec.tokens.extend(resume_tokens)
         with self._lock:
             if self._closed:
                 self._pool.free(blocks)
@@ -730,10 +987,17 @@ class DecodeEngine:
                                  % self.label)
             self._live.append(sess)
         _ACTIVE_SESSIONS.inc()
+        _obs_events.emit("decode", kind="journal", sid=sess.sid,
+                         model=self.label, client=str(rec.client),
+                         session_seq=rec.seq,
+                         incarnation=rec.incarnation,
+                         params_sha=rec.params_sha,
+                         tokens_logged=len(rec.tokens))
         _obs_events.emit("decode", kind="session_start", sid=sess.sid,
                          model=self.label, prompt_len=length,
                          blocks=n0,
-                         max_new_tokens=max_new_tokens)
+                         max_new_tokens=max_new_tokens,
+                         resume=bool(resume_tokens))
         return sess
 
     def prefill(self, sess):
@@ -792,6 +1056,7 @@ class DecodeEngine:
         cap) are released with their reason.  Returns the sessions
         that actually rode the dispatch."""
         import jax
+        _servechaos.on_decode_tick(self.label)
         with self._lock:
             if self._closed:
                 raise ServeError("decode engine %r is closed"
@@ -856,8 +1121,31 @@ class DecodeEngine:
             for i, s in enumerate(ready):
                 out_i = jax.tree_util.tree_map(lambda a: a[i], host)
                 s.pos += 1
+                if s._replay:
+                    # replayed tick of a resumed session: the token
+                    # was accepted (and delivered) before the crash —
+                    # bit-check it against the journal, advance the
+                    # cache, suppress delivery/counters.  Finish
+                    # checks are skipped: the session was live when
+                    # it journaled this token, and greedy replay is
+                    # deterministic.
+                    expect = s._replay.popleft()
+                    if _token_bytes(out_i) != _token_bytes(expect):
+                        self._release_locked(
+                            s, "resume_divergence", ServeError(
+                                "decode session %d resume diverged "
+                                "at token %d — replayed output is "
+                                "not bit-equal to the journal "
+                                "(params or program drift)"
+                                % (s.sid, s.token_count)))
+                        continue
+                    s.pending_input = self._feed(out_i)
+                    continue
                 s._deliver(out_i, now)
                 _DECODE_TOKENS.inc()
+                self._journal.append(s.journal_key,
+                                     s._base + s.token_count - 1,
+                                     out_i)
                 if self._finished(s, out_i):
                     self._release_locked(s, "finished", None)
                 else:
@@ -868,7 +1156,7 @@ class DecodeEngine:
 
     def _finished(self, s, out):
         if s.max_new_tokens is not None and \
-                s.token_count >= s.max_new_tokens:
+                s._base + s.token_count >= s.max_new_tokens:
             return True
         if s.stop_fn is not None and s.stop_fn(out):
             return True
@@ -968,10 +1256,87 @@ class DecodeEngine:
                 sess.pos += 1
                 sess._deliver(out, now)
                 _DECODE_TOKENS.inc()
+                self._journal.append(sess.journal_key,
+                                     sess._base + sess.token_count - 1,
+                                     out)
                 if self._finished(sess, out):
                     self._release_locked(sess, "finished", None)
                 else:
                     sess.pending_input = self._feed(out)
+
+    # -- fault tolerance -----------------------------------------------------
+    def rebuild_pool(self):
+        """Quarantine the current pool and swap in a fresh, empty
+        same-shape one — the crashed-tick recovery primitive.  A
+        dispatch that died mid-donation leaves the pool state
+        untrustworthy; a clone has identical leaf avals, so every
+        already-warm tick/prefill/verify program runs it with ZERO
+        new compiles (asserted).  Live sessions' block tables are
+        cleared FIRST (their ids belong to the quarantined pool and
+        must never be freed into the fresh one) — the caller must
+        then :meth:`readmit` or :meth:`release` every live session."""
+        with self._lock:
+            if self._closed:
+                raise ServeError("decode engine %r is closed"
+                                 % self.label)
+            before = self._compiles
+            old = self._pool
+            for s in self._live:
+                with s._cond:
+                    s.blocks = []
+                s.table = _np.zeros((self.max_blocks,), _np.int32)
+                s.pos = 0
+                s.pending_input = None
+            self._pool = old.clone_empty()
+            old.close()
+            self._rebuilds += 1
+            if self._compiles != before:
+                raise ServeError(
+                    "decode %r: pool rebuild compiled %d new "
+                    "program(s) — the fresh pool's avals drifted "
+                    "from the quarantined one's" % (
+                        self.label, self._compiles - before))
+        return self._pool
+
+    def readmit(self, sess):
+        """Re-admit a live journaled session onto the current (fresh)
+        pool after :meth:`rebuild_pool`: fresh prompt blocks (typed
+        :class:`KVPoolExhausted` sheds it without wedging the
+        rebuild), cursor reset, replay armed from the journal.  The
+        batcher then re-prefills it and replays its accepted tokens
+        through ordinary ticks — delivery suppressed and bit-checked,
+        so the caller-visible stream continues exactly where it
+        stopped."""
+        with self._lock:
+            if self._closed:
+                raise ServeError("decode engine %r is closed"
+                                 % self.label)
+            if sess.done():
+                return sess
+            tokens = self._journal.tokens(sess.journal_key) \
+                if sess.journal_key is not None else list(sess.outputs())
+            n0 = _ceil_div(sess.length, self.block_size)
+            blocks = self._pool.alloc(n0, owner=self.label)
+            table = _np.zeros((self.max_blocks,), _np.int32)
+            table[:n0] = blocks
+            with sess._cond:
+                sess.blocks = list(blocks)
+            sess.table = table
+            sess.pos = 0
+            sess.pending_input = None
+            sess._replay = collections.deque(tokens)
+            # the join deadline bounded time-to-FIRST-join; a
+            # re-admission must not expire a session that already
+            # joined before the crash
+            sess._deadline = None
+            if sess not in self._live:
+                self._live.append(sess)
+                _ACTIVE_SESSIONS.inc()
+        _RESUMED_TOTAL.inc()
+        _obs_events.emit("decode", kind="resume", sid=sess.sid,
+                         model=self.label,
+                         tokens_replayed=len(tokens))
+        return sess
 
     # -- teardown ------------------------------------------------------------
     def release(self, sess, reason, error=None):
@@ -999,6 +1364,8 @@ class DecodeEngine:
             sess._error = error
             sess.finish_reason = reason
             sess._cond.notify_all()
+        if sess.journal_key is not None:
+            self._journal.close(sess.journal_key, reason)
         _obs_events.emit("decode", kind="session_end", sid=sess.sid,
                          model=self.label, reason=reason,
                          tokens=sess.token_count,
@@ -1037,15 +1404,23 @@ class DecodeBatcher:
     ``MXNET_SERVE_DECODE_MAX_WAIT_MS`` before the first tick, exactly
     like the predict batcher's window.
 
-    Supervision differs from :class:`DynamicBatcher` deliberately: a
-    crash escaping the tick loop marks the batcher unhealthy and
-    fails every session typed WITHOUT a restart — the donated pool
-    state cannot be trusted after a dispatch died mid-donation, and
-    restarting over a corrupt pool would serve wrong tokens instead
-    of a typed error."""
+    Supervision: a crash escaping the tick loop cannot simply restart
+    over the same pool — the donated state cannot be trusted after a
+    dispatch died mid-donation, and decoding over a corrupt pool
+    would serve wrong tokens instead of a typed error.  Instead the
+    batcher QUARANTINES the suspect pool (``engine.rebuild_pool``
+    swaps in a fresh same-shape one against the already-warm
+    programs, zero new compiles), re-admits every journaled live
+    session via re-prefill + replayed ticks (bit-checked, so the
+    caller-visible stream continues seamlessly; a session the fresh
+    pool cannot hold sheds typed without wedging the rebuild) and
+    restarts the tick loop on a fresh thread — bounded by
+    ``MXNET_SERVE_DECODE_REBUILDS``.  Past the budget it degrades to
+    the old behavior: unhealthy forever, every session failed
+    typed."""
 
     def __init__(self, engine, max_wait_ms=None, name=None,
-                 on_state=None):
+                 on_state=None, rebuilds=None):
         from ..config import resolve_env
         self._engine = engine
         self.name = name or engine.label
@@ -1057,6 +1432,11 @@ class DecodeBatcher:
                 tcfg.get("MXNET_SERVE_DECODE_MAX_WAIT_MS"))
         self._max_wait = max(0.0, float(max_wait_ms)) / 1e3
         self._on_state = on_state
+        if rebuilds is None:
+            rebuilds = resolve_env("MXNET_SERVE_DECODE_REBUILDS", None)
+        self._rebuild_budget = max(0, int(rebuilds))
+        self._rebuilds = 0
+        self._rebuilding = False
         self._lock = _san.lock(label="serve.decode.batcher.%s"
                                % self.name)
         self._cond = _san.condition(self._lock,
@@ -1077,7 +1457,7 @@ class DecodeBatcher:
         self._last_tick = _time.monotonic()
         _san.track(self, ("_joins", "_sessions", "_inflight",
                           "_stopped", "_draining", "_unhealthy",
-                          "_ticks"),
+                          "_rebuilding", "_rebuilds", "_ticks"),
                    label="serve.decode.batcher.%s" % self.name)
         with engine._lock:
             engine._batchers.append(self)
@@ -1087,6 +1467,10 @@ class DecodeBatcher:
         self._thread.start()
 
     # -- stats / health ------------------------------------------------------
+    @property
+    def engine(self):
+        return self._engine
+
     @property
     def tick_count(self):
         with self._lock:
@@ -1101,6 +1485,20 @@ class DecodeBatcher:
     def unhealthy(self):
         with self._lock:
             return self._unhealthy
+
+    @property
+    def rebuilding(self):
+        with self._lock:
+            return self._rebuilding
+
+    @property
+    def rebuild_count(self):
+        with self._lock:
+            return self._rebuilds
+
+    @property
+    def rebuild_budget(self):
+        return self._rebuild_budget
 
     @property
     def draining(self):
@@ -1126,13 +1524,25 @@ class DecodeBatcher:
         with self._lock:
             if self._unhealthy:
                 return "unhealthy"
+            if self._rebuilding:
+                return "rebuilding"
             if self._stopped or self._draining:
                 return "draining"
             return "ready"
 
+    def rebuild_state(self):
+        """The quarantine/rebuild surface for ``health(name)``:
+        spent/budgeted rebuild counts and whether a rebuild is in
+        flight right now."""
+        with self._lock:
+            return {"rebuilds": self._rebuilds,
+                    "budget": self._rebuild_budget,
+                    "rebuilding": self._rebuilding}
+
     # -- client side ---------------------------------------------------------
     def start(self, prompt, max_new_tokens=None, stop_fn=None,
-              deadline_ms=None):
+              deadline_ms=None, journal_key=None, incarnation=0,
+              resume_tokens=None):
         """Admit one decode session.  Raises a typed
         :class:`KVPoolExhausted` when the pool cannot hold the prompt
         (shed at submit — PR-10 semantics), a :class:`ServeError`
@@ -1140,7 +1550,10 @@ class DecodeBatcher:
         *deadline_ms* bounds time-to-join: a session the dispatcher
         cannot prefill by then is shed typed
         (:class:`~mxnet_tpu.serve.buckets.DeadlineExceededError`).
-        Returns the :class:`PagedSession`."""
+        *journal_key*/*incarnation*/*resume_tokens* pass through to
+        :meth:`DecodeEngine.admit` — the wire-resume path (a router
+        re-opening a journaled session here after its old replica
+        died).  Returns the :class:`PagedSession`."""
         with self._lock:
             if self._stopped:
                 raise ServeError("decode batcher %r is closed"
@@ -1148,13 +1561,21 @@ class DecodeBatcher:
             if self._unhealthy:
                 raise ServeError("decode batcher %r is unhealthy "
                                  "(tick loop crashed)" % self.name)
+            if self._rebuilding:
+                raise ServeError("decode batcher %r is rebuilding "
+                                 "its pool after a tick-loop crash — "
+                                 "admissions shed until the rebuild "
+                                 "lands" % self.name)
             if self._draining:
                 raise ServeError("decode batcher %r is draining — "
                                  "admissions are stopped" % self.name)
         sess = self._engine.admit(prompt,
                                   max_new_tokens=max_new_tokens,
                                   stop_fn=stop_fn,
-                                  deadline_ms=deadline_ms)
+                                  deadline_ms=deadline_ms,
+                                  journal_key=journal_key,
+                                  incarnation=incarnation,
+                                  resume_tokens=resume_tokens)
         with self._cond:
             if self._stopped or self._draining:
                 stopped = self._stopped
@@ -1248,14 +1669,30 @@ class DecodeBatcher:
 
     def _crashed(self, exc):
         with self._cond:
-            self._unhealthy = True
             leftovers = list(dict.fromkeys(
                 self._sessions + list(self._joins)
                 + list(self._inflight)))
             self._sessions = []
             self._joins.clear()
             self._inflight = ()
+            rebuild = (not self._stopped
+                       and self._rebuilds < self._rebuild_budget)
+            if rebuild:
+                self._rebuilding = True
+                self._rebuilds += 1
+                nth = self._rebuilds
+            else:
+                self._unhealthy = True
             self._cond.notify_all()
+        if rebuild:
+            self._rebuild(exc, leftovers, nth)
+        else:
+            self._fail_unhealthy(exc, leftovers)
+
+    def _fail_unhealthy(self, exc, leftovers):
+        """Past the rebuild budget (or closed): the pre-rebuild
+        behavior, verbatim — unhealthy forever, every session failed
+        typed, delivered tokens stay readable."""
         log.error("decode batcher %r: tick loop crashed (%s: %s) — "
                   "unhealthy, failing %d sessions (no restart: the "
                   "donated pool state cannot be trusted)", self.name,
@@ -1275,6 +1712,84 @@ class DecodeBatcher:
             except Exception:
                 log.exception("decode batcher %r: on_state hook "
                               "failed", self.name)
+
+    def _rebuild(self, exc, leftovers, nth):
+        """Quarantine-and-rebuild (runs ON the dying dispatcher
+        thread): swap in a fresh pool against the warm programs,
+        re-admit journaled live sessions via re-prefill + replay,
+        hand the loop to a fresh thread."""
+        eng = self._engine
+        log.warning("decode batcher %r: tick loop crashed (%s: %s) — "
+                    "quarantining the pool and rebuilding (%d/%d), "
+                    "%d sessions to re-admit", self.name,
+                    type(exc).__name__, exc, nth,
+                    self._rebuild_budget, len(leftovers))
+        compiles_before = eng.compile_count
+        try:
+            eng.rebuild_pool()
+        except Exception as rexc:
+            # the rebuild itself failed: degrade to the typed-fail
+            # terminal state — never hang, never retry-loop here
+            log.exception("decode batcher %r: pool rebuild failed",
+                          self.name)
+            with self._cond:
+                self._rebuilding = False
+                self._unhealthy = True
+                self._cond.notify_all()
+            self._fail_unhealthy(rexc, leftovers)
+            return
+        _REBUILDS_TOTAL.inc()
+        _obs_events.emit("decode", kind="rebuild", model=self.name,
+                         rebuilds=nth,
+                         budget=self._rebuild_budget,
+                         sessions=len(leftovers),
+                         compiles_before=compiles_before,
+                         compiles_after=eng.compile_count,
+                         error="%s: %s" % (type(exc).__name__,
+                                           str(exc)[:200]))
+        if self._on_state is not None:
+            # after the fresh pool, before re-admission: lets a
+            # registry hook (or a test seam) observe "rebuilding"
+            # while re-admission can still shed typed
+            try:
+                self._on_state("rebuilding")
+            except Exception:
+                log.exception("decode batcher %r: on_state hook "
+                              "failed", self.name)
+        readmitted = []
+        for s in leftovers:
+            if s.done():
+                continue
+            if s.cancelled:
+                # a cancel racing the crash wins: never resumed
+                eng.release(s, "cancelled", RequestCancelled(
+                    "decode session %d cancelled during the pool "
+                    "rebuild" % s.sid))
+                continue
+            try:
+                eng.readmit(s)
+            except KVPoolExhausted as aexc:
+                # shed THIS session typed; the rebuild itself lands
+                eng.release(s, "pool_exhausted", aexc)
+                continue
+            except Exception as aexc:
+                eng.release(s, "failed", aexc)
+                continue
+            readmitted.append(s)
+        with self._cond:
+            self._joins.extend(readmitted)
+            self._rebuilding = False
+            # the crash handler runs on the dying thread — a fresh
+            # one must own the loop from here
+            self._thread = _san.thread(
+                target=self._run,
+                name="serve-decode-%s" % self.name, daemon=True)
+            self._thread.start()
+            self._cond.notify_all()
+        log.info("decode batcher %r: rebuild %d/%d complete — "
+                 "%d/%d sessions re-admitted", self.name,
+                 nth, self._rebuild_budget,
+                 len(readmitted), len(leftovers))
 
     # -- lifecycle -----------------------------------------------------------
     def drain(self, timeout=None):
@@ -1393,6 +1908,12 @@ class SpeculativeDecoder:
     (typically a much smaller model).  This is a single-session
     driver — the batched tick path stays the default; speculative
     decode is the latency play for sparse traffic.
+
+    Degradation: a draft-engine failure (crash, pool exhaustion,
+    rebuild in progress) falls back to plain greedy target ticks for
+    the rest of the run — invisible to callers, since bit-equality
+    to greedy already holds; ``fallback_reason`` and a ``decode``
+    event of kind ``spec_fallback`` name the cause.
     """
 
     def __init__(self, target, draft):
@@ -1406,12 +1927,37 @@ class SpeculativeDecoder:
         self.draft = draft
         self.k = target.spec_k
         self.stats = {"rounds": 0, "proposed": 0, "accepted": 0,
-                      "target_dispatches": 0}
+                      "target_dispatches": 0, "fallbacks": 0}
+        # a draft-engine failure (crash, pool exhaustion, rebuild in
+        # progress) degrades this run to plain greedy target ticks —
+        # bit-equal to greedy already holds, so callers never see it
+        self.fallback_reason = None
 
     def _token_key(self, out):
-        import jax
-        return tuple(_np.asarray(leaf).tobytes()
-                     for leaf in jax.tree_util.tree_leaves(out))
+        return _token_bytes(out)
+
+    def _fall_back(self, reason, exc, d_sess=None):
+        """Degrade to plain greedy ticks: note why, emit the decode
+        event, retire the draft session.  The stream is unaffected —
+        every emitted token is the target's own step output either
+        way."""
+        self.fallback_reason = reason
+        self.stats["fallbacks"] += 1
+        log.warning("speculative decode %r: draft engine failed "
+                    "(%s: %s) — falling back to plain greedy ticks",
+                    self.target.label, reason, exc)
+        _obs_events.emit("decode", kind="spec_fallback",
+                         model=self.target.label, reason=reason,
+                         error=None if exc is None else
+                         "%s: %s" % (type(exc).__name__,
+                                     str(exc)[:200]))
+        if d_sess is not None and not d_sess.done():
+            try:
+                self.draft.release(d_sess, "failed", ServeError(
+                    "draft engine abandoned: %s" % reason))
+            except Exception:
+                log.exception("speculative decode %r: draft release "
+                              "failed", self.target.label)
 
     def run(self, prompt, max_new_tokens):
         """Decode one session speculatively; returns the finished
@@ -1420,10 +1966,26 @@ class SpeculativeDecoder:
         t_sess = self.target.admit(prompt,
                                    max_new_tokens=max_new_tokens)
         self.target.prefill(t_sess)
-        d_sess = self.draft.admit(prompt)
-        self.draft.prefill(d_sess)
+        d_sess = None
+        try:
+            d_sess = self.draft.admit(prompt)
+            self.draft.prefill(d_sess)
+        except Exception as exc:
+            self._fall_back("draft_admit", exc, d_sess)
+            d_sess = None
         try:
             while not t_sess.done():
+                if self.fallback_reason is None and d_sess is not None \
+                        and d_sess.done() and d_sess.error is not None:
+                    # the draft died typed mid-run (pool exhausted,
+                    # engine closed/rebuilding): permanent fallback
+                    self._fall_back(
+                        "draft_%s" % (d_sess.finish_reason
+                                      or "failed"), d_sess.error)
+                if self.fallback_reason is not None:
+                    self.target.tick([t_sess])
+                    self.stats["target_dispatches"] += 1
+                    continue
                 base_pos = t_sess.pos
                 base_input = dict(t_sess.pending_input)
                 # draft proposes continuations of the pending token.
@@ -1437,14 +1999,20 @@ class SpeculativeDecoder:
                 d_sess.pos = base_pos
                 d_sess.pending_input = dict(base_input)
                 proposals = []
-                for _ in range(self.k):
-                    if d_sess.pos >= self.draft.padded_len:
-                        break
-                    before = d_sess.token_count
-                    self.draft.tick([d_sess])
-                    if d_sess.token_count == before:
-                        break
-                    proposals.append(d_sess.outputs()[-1])
+                try:
+                    for _ in range(self.k):
+                        if d_sess.pos >= self.draft.padded_len:
+                            break
+                        before = d_sess.token_count
+                        self.draft.tick([d_sess])
+                        if d_sess.token_count == before:
+                            break
+                        proposals.append(d_sess.outputs()[-1])
+                except Exception as exc:
+                    # a draft crash degrades, never surfaces: the
+                    # target continues on plain greedy ticks
+                    self._fall_back("draft_tick", exc, d_sess)
+                    continue
                 if len(proposals) < self.k:
                     # tail of the sequence: fall back to plain ticks
                     self.target.tick([t_sess])
@@ -1484,6 +2052,6 @@ class SpeculativeDecoder:
                     "(%s: %s)" % (type(exc).__name__, exc)))
             raise
         finally:
-            if not d_sess.done():
+            if d_sess is not None and not d_sess.done():
                 self.draft.release(d_sess, "finished", None)
         return t_sess

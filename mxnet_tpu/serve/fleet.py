@@ -280,9 +280,13 @@ class Fleet:
         """Drain-aware rolling deploy: cycle replicas one at a time
         onto *model_specs* (the new checkpoint) — drain -> swap ->
         warm from the shared compile cache -> readmit — dropping zero
-        accepted requests.  A drain that times out (abandoned
-        accepted work) aborts the deploy loudly.  Returns the list of
-        successor replica keys."""
+        accepted requests.  Live streaming decode sessions are
+        MIGRATED, not waited out: the DRAIN evicts them with the
+        typed ``draining`` code and the router re-opens each on a
+        healthy replica from its journal (same handle, bit-equal
+        resume).  A drain that times out (abandoned accepted work)
+        aborts the deploy loudly.  Returns the list of successor
+        replica keys."""
         model_specs = list(model_specs)
         names = sorted({m["name"] for m in model_specs})
         _obs_events.emit("fleet", kind="deploy_start", models=names,
@@ -345,6 +349,7 @@ class Fleet:
                 _obs_events.emit(
                     "fleet", kind="deploy_drain", replica=key,
                     waited_requests=stats.get("waited_requests"),
+                    decode_evicted=stats.get("decode_evicted", 0),
                     timed_out=False)
             new_key = self.replace(key, model_specs=model_specs)
             # the successor is only READY after load+warm (spawn

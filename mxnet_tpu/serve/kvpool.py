@@ -182,6 +182,16 @@ class KVPool:
             "MXNET_SERVE_KV_BLOCKS" % (n, free, in_use,
                                        self.blocks_total))
 
+    def clone_empty(self):
+        """A fresh, empty pool with this pool's token spec, geometry
+        and device — the quarantine-and-rebuild primitive: the clone's
+        leaf avals are identical, so every AOT tick/prefill program
+        built against this pool runs the clone with ZERO new compiles
+        (programs depend only on pool shapes/dtypes).  The suspect
+        pool itself is quarantined by :meth:`close`."""
+        return KVPool(self._spec, num_blocks=self.num_blocks,
+                      block_size=self.block_size, device=self._device)
+
     def free(self, blocks):
         """Return *blocks* to the pool (session end, any reason)."""
         if not blocks:

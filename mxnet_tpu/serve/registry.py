@@ -665,10 +665,19 @@ class ModelRegistry:
                 "kv_blocks_total": sum(e.pool.blocks_total
                                        for e in engines),
                 "batchers": [db.health_state() for db in dbs],
+                # quarantine-and-rebuild surface: spent/budgeted
+                # rebuilds and whether one is in flight right now
+                "rebuilds": sum(db.rebuild_count for db in dbs),
+                "rebuild_budget": sum(db.rebuild_budget
+                                      for db in dbs),
+                "rebuilding": any(db.rebuilding for db in dbs),
             }
             if info["state"] == "ready" and \
                     any(db.unhealthy for db in dbs):
                 info["state"] = "unhealthy"
+            elif info["state"] == "ready" and \
+                    info["decode"]["rebuilding"]:
+                info["state"] = "rebuilding"
         return info
 
     def ready(self, name):
@@ -696,6 +705,11 @@ class ModelRegistry:
                 for db in list(eng._batchers):
                     if db.unhealthy:
                         return False
+                    if db.rebuilding:
+                        # a quarantine-and-rebuild in flight: the old
+                        # dispatcher thread is executing the rebuild,
+                        # not ticking — alive, not wedged
+                        continue
                     if not db.stopped and not db.dispatcher_alive():
                         return False
                     if db.session_count > 0 and \

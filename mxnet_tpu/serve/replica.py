@@ -23,6 +23,18 @@ model the training stack already has:
   internal failures reply with a ``code`` the router maps back onto
   the same typed exception classes (:class:`OverloadError`,
   :class:`DeadlineExceededError`, ...), never a silent drop.
+* **Streaming decode over the wire** — ``DECODE_OPEN`` / ``NEXT`` /
+  ``CANCEL`` / ``CLOSE`` expose the continuous-batching decode path
+  with the same discipline: OPEN is keyed by ``(client, session_seq)``
+  and is idempotent (a retried OPEN reuses the live session; a resume
+  OPEN carries the router's journaled tokens and replays them
+  bit-checked), NEXT(i) answers token *i* from the session's retained
+  stream — a retried index is served from cache, never re-decoded —
+  and blocks bounded (a not-yet-decoded index answers ``pending`` so
+  the router polls instead of hanging), and a DRAIN evicts live wire
+  sessions with the typed ``draining`` code so the router migrates
+  them to a successor from its journal instead of waiting out long
+  streams.
 * **Probe surface** — the PR-10 health state machine is exported two
   ways: a HEALTH RPC for the router's heartbeat loop, and a stdlib
   ``http.server`` probe endpoint (``MXNET_SERVE_HTTP_PORT``) serving
@@ -61,7 +73,9 @@ from ..resilience import servechaos as _servechaos
 __all__ = ["ReplicaServer", "ReplicaDraining", "start_http_probe",
            "MSG_PREDICT", "MSG_HEALTH", "MSG_LOAD", "MSG_UNLOAD",
            "MSG_DRAIN", "MSG_STATS", "MSG_CANCEL", "MSG_STOP",
-           "MSG_REPLY", "error_code", "error_class"]
+           "MSG_DECODE_OPEN", "MSG_DECODE_NEXT", "MSG_DECODE_CANCEL",
+           "MSG_DECODE_CLOSE", "MSG_REPLY", "error_code",
+           "error_class"]
 
 log = logging.getLogger(__name__)
 
@@ -77,6 +91,10 @@ MSG_DRAIN = 5
 MSG_STATS = 6
 MSG_CANCEL = 7
 MSG_STOP = 8
+MSG_DECODE_OPEN = 9
+MSG_DECODE_NEXT = 10
+MSG_DECODE_CANCEL = 11
+MSG_DECODE_CLOSE = 12
 
 _REPLICA_REQUESTS = _obs_metrics.counter(
     "fleet_replica_requests_total",
@@ -185,9 +203,17 @@ class ReplicaServer:
         self._requests_received = 0
         self._dup_hits = 0
         self._cancels_received = 0
+        # wire decode surface: name -> DecodeBatcher, and the session
+        # map keyed by the (client, session_seq) identity — the
+        # session's retained output stream IS the NEXT dedup cache
+        self._decoders = collections.OrderedDict()
+        self._dsessions = collections.OrderedDict()
+        self._decode_requests = 0
         _san.track(self, ("_dedup", "_draining",
                           "_predicts_dispatched", "_requests_received",
-                          "_dup_hits", "_cancels_received"),
+                          "_dup_hits", "_cancels_received",
+                          "_decoders", "_dsessions",
+                          "_decode_requests"),
                    label="serve.replica.%s" % self.name)
         self.http_server = None
         if http_port is None:
@@ -228,6 +254,24 @@ class ReplicaServer:
     def cancels_received(self):
         with self._lock:
             return self._cancels_received
+
+    @property
+    def decode_requests(self):
+        with self._lock:
+            return self._decode_requests
+
+    # -- wire decode surface -----------------------------------------------
+    def add_decoder(self, name, batcher):
+        """Expose *batcher* (a :class:`~mxnet_tpu.serve.decode.
+        DecodeBatcher`) over the DECODE_* wire surface as model
+        *name*.  Returns the batcher."""
+        with self._lock:
+            self._decoders[name] = batcher
+        return batcher
+
+    def decoders(self):
+        with self._lock:
+            return dict(self._decoders)
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
@@ -279,10 +323,13 @@ class ReplicaServer:
             self.sock.close()
         except OSError:
             pass
-        if self.http_server is not None:
-            self.http_server.shutdown()
-            self.http_server.server_close()
-            self.http_server = None
+        # swap-then-close: stop() races with itself when a STOP rpc
+        # and the CLI's finally both tear down — only one closer wins
+        with self._lock:
+            http, self.http_server = self.http_server, None
+        if http is not None:
+            http.shutdown()
+            http.server_close()
 
     def wait(self, timeout=None):
         """Block until the accept loop stops (CLI main thread)."""
@@ -290,6 +337,13 @@ class ReplicaServer:
 
     def close(self):
         self.stop()
+        for b in self.decoders().values():
+            try:
+                b.close()
+                b.engine.close()
+            except Exception:
+                log.exception("replica %r: decoder close failed",
+                              self.name)
         self.registry.close()
 
     # -- connection handling -----------------------------------------------
@@ -328,6 +382,14 @@ class ReplicaServer:
             return self._handle_health(meta)
         if kind == MSG_CANCEL:
             return self._handle_cancel(meta)
+        if kind == MSG_DECODE_OPEN:
+            return self._handle_decode_open(meta, tensors)
+        if kind == MSG_DECODE_NEXT:
+            return self._handle_decode_next(meta)
+        if kind == MSG_DECODE_CANCEL:
+            return self._handle_decode_cancel(meta)
+        if kind == MSG_DECODE_CLOSE:
+            return self._handle_decode_close(meta)
         if kind == MSG_LOAD:
             return self._handle_load(meta)
         if kind == MSG_UNLOAD:
@@ -345,7 +407,13 @@ class ReplicaServer:
                 return {"status": "ok", "resumed": resumed}, ()
             with self._lock:
                 self._draining = True
+            # evict live wire decode sessions BEFORE draining the
+            # registry: each fails typed 'draining', so the router
+            # migrates it to a successor from its journal instead of
+            # this drain waiting out (or killing) long streams
+            evicted = self._evict_decode_sessions()
             stats = self.registry.drain_all(meta.get("timeout"))
+            stats = dict(stats, decode_evicted=evicted)
             _obs_events.emit("fleet", kind="replica_drain",
                              replica=self.name, **stats)
             return dict(stats, status="ok"), ()
@@ -528,10 +596,225 @@ class ReplicaServer:
                                  "msg": "RequestCancelled: cancelled "
                                         "by the router (hedge "
                                         "loser)"}, ()))
+        # req_seq, not seq: a bare ``seq`` field would clobber the
+        # event envelope's own monotone seq in the JSONL record
         _obs_events.emit("fleet", kind="replica_cancel",
-                         replica=self.name, client=client, seq=seq,
-                         reclaimed=reclaimed)
+                         replica=self.name, client=client,
+                         req_seq=seq, reclaimed=reclaimed)
         return {"status": "ok", "reclaimed": reclaimed}, ()
+
+    # -- wire decode (idempotent streaming sessions) -----------------------
+    @staticmethod
+    def _out_wire(out):
+        """``(out_names, tensors)`` for one delivered output tree —
+        dict outputs go as sorted named leaves, anything else as the
+        single bare leaf (the shapes :meth:`DecodeEngine._feed`
+        accepts)."""
+        if isinstance(out, dict):
+            names = sorted(out)
+            return names, [_np.asarray(out[n]) for n in names]
+        return None, [_np.asarray(out)]
+
+    @staticmethod
+    def _out_unwire(names, leaves):
+        if names:
+            return {n: _np.array(a) for n, a in zip(names, leaves)}
+        return _np.array(leaves[0])
+
+    def _handle_decode_open(self, meta, tensors):
+        # decode chaos choke point first (replica_kill_decode_at):
+        # an armed kill dies holding the OPEN, and the router must
+        # re-place the session from its journal
+        _servechaos.on_replica_decode(self.name)
+        with self._lock:
+            self._decode_requests += 1
+        ident = meta["session"]
+        client, seq, inc = ident[0], int(ident[1]), int(ident[2])
+        key = (client, seq)
+        with self._lock:
+            ent = self._dsessions.get(key)
+        if ent is not None:
+            if ent.get("cancelled"):
+                raise RequestCancelled(
+                    "decode session (%s, %d) was cancelled — a "
+                    "cancelled session is never resumed"
+                    % (client, seq))
+            if ent["sess"] is not None:
+                # duplicate OPEN (router retry after a torn reply):
+                # the live session IS the cached answer
+                return {"status": "ok", "dup": True,
+                        "sid": ent["sess"].sid,
+                        "base": ent["base"]}, ()
+        if self.draining:
+            raise ReplicaDraining(
+                "replica %r is draining — open decode session "
+                "(%s, %d) elsewhere" % (self.name, client, seq))
+        model = meta["model"]
+        with self._lock:
+            batcher = self._decoders.get(model)
+        if batcher is None:
+            raise ServeError(
+                "replica %r serves no decode model %r (have %s)"
+                % (self.name, model, sorted(self.decoders())))
+        if batcher.rebuilding:
+            # mid-quarantine: shed reroutable, like overload — the
+            # router places the session on a healthy replica
+            raise OverloadError(
+                "replica %r decode model %r is rebuilding its pool — "
+                "open elsewhere" % (self.name, model))
+        names = meta.get("inputs") or []
+        n_in = len(names) if names else 1
+        if names:
+            prompt = {n: _np.array(t)
+                      for n, t in zip(names, tensors[:n_in])}
+        else:
+            prompt = _np.array(tensors[0])
+        resume = []
+        count = int(meta.get("resume") or 0)
+        if count:
+            out_names = meta.get("out_names")
+            per = len(out_names) if out_names else 1
+            flat = [_np.array(t) for t in tensors[n_in:]]
+            if len(flat) != count * per:
+                raise ServeError(
+                    "decode OPEN (%s, %d): %d resume tensors for %d "
+                    "journaled token(s) of %d leaf/leaves"
+                    % (client, seq, len(flat), count, per))
+            for i in range(count):
+                resume.append(self._out_unwire(
+                    out_names, flat[i * per:(i + 1) * per]))
+        sess = batcher.start(
+            prompt, max_new_tokens=meta.get("max_new_tokens"),
+            deadline_ms=meta.get("deadline_ms"),
+            journal_key=key, incarnation=inc,
+            resume_tokens=resume or None)
+        entry = {"sess": sess, "model": model, "incarnation": inc,
+                 "base": len(resume), "cancelled": False}
+        with self._lock:
+            old = self._dsessions.get(key)
+            if old is not None and old.get("cancelled"):
+                # a CANCEL raced this open: honor it
+                sess.cancel()
+                entry["cancelled"] = True
+            self._dsessions[key] = entry
+            self._trim_dsessions_locked()
+        _obs_events.emit("fleet", kind="decode_open",
+                         replica=self.name, model=model,
+                         client=str(client), session_seq=seq,
+                         incarnation=inc, resumed=len(resume))
+        return {"status": "ok", "sid": sess.sid,
+                "base": len(resume)}, ()
+
+    def _handle_decode_next(self, meta):
+        _servechaos.on_replica_decode(self.name)
+        with self._lock:
+            self._decode_requests += 1
+        ident = meta["session"]
+        key = (ident[0], int(ident[1]))
+        with self._lock:
+            ent = self._dsessions.get(key)
+        if ent is None or ent["sess"] is None:
+            if ent is not None and ent.get("cancelled"):
+                raise RequestCancelled(
+                    "decode session (%s, %d) was cancelled"
+                    % (key[0], key[1]))
+            raise ServeError("replica %r knows no decode session "
+                             "(%s, %d)" % (self.name, key[0], key[1]))
+        sess = ent["sess"]
+        i = int(meta["index"])
+        local = i - ent["base"]
+        if local < 0:
+            raise ServeError(
+                "decode session (%s, %d): token %d predates this "
+                "replica's resume base %d — the router already holds "
+                "it" % (key[0], key[1], i, ent["base"]))
+        wait_s = float(meta.get("wait_s") or 10.0)
+        if self._rpc_timeout:
+            wait_s = min(wait_s, self._rpc_timeout * 0.5)
+        try:
+            out = sess.output_at(local, timeout=wait_s)
+        except StopIteration:
+            return {"status": "ok", "done": True,
+                    "reason": sess.finish_reason,
+                    "total": ent["base"] + sess.token_count}, ()
+        except TimeoutError:
+            # bounded wait: token *i* is not decoded yet — answer
+            # 'pending' so the router polls again instead of the RPC
+            # hanging into its transport timeout
+            return {"status": "ok", "pending": True, "index": i}, ()
+        names, leaves = self._out_wire(out)
+        return {"status": "ok", "index": i, "out_names": names}, leaves
+
+    def _handle_decode_cancel(self, meta):
+        ident = meta["session"]
+        key = (ident[0], int(ident[1]))
+        with self._lock:
+            self._cancels_received += 1
+            ent = self._dsessions.get(key)
+            if ent is None:
+                # cancel racing a failover re-open: pin the id so a
+                # LATE resume OPEN answers cancelled — a cancelled
+                # session is never resumed
+                ent = {"sess": None, "model": None, "incarnation": -1,
+                       "base": 0, "cancelled": True}
+                self._dsessions[key] = ent
+            else:
+                ent["cancelled"] = True
+            sess = ent["sess"]
+        reclaimed = bool(sess.cancel()) if sess is not None else False
+        _obs_events.emit("fleet", kind="decode_cancel",
+                         replica=self.name, client=str(key[0]),
+                         session_seq=key[1], reclaimed=reclaimed)
+        return {"status": "ok", "reclaimed": reclaimed}, ()
+
+    def _handle_decode_close(self, meta):
+        ident = meta["session"]
+        key = (ident[0], int(ident[1]))
+        with self._lock:
+            ent = self._dsessions.pop(key, None)
+        sess = ent["sess"] if ent else None
+        if sess is not None and not sess.done():
+            sess.cancel()
+        return {"status": "ok", "closed": ent is not None}, ()
+
+    def _trim_dsessions_locked(self):
+        # settled entries (finished session or cancel pin) age out
+        # past the dedup window; live sessions are never trimmed —
+        # their retries must keep finding them
+        while len(self._dsessions) > self._dedup_window:
+            for k, e in list(self._dsessions.items()):
+                if e["sess"] is None or e["sess"].done():
+                    del self._dsessions[k]
+                    break
+            else:
+                return
+
+    def _evict_decode_sessions(self):
+        """Fail every live wire decode session with the typed
+        ``draining`` code — the deploy-migration handoff: the router
+        re-opens each on a successor from its journal and the stream
+        resumes bit-equal under the same handle."""
+        with self._lock:
+            entries = [(k, e) for k, e in self._dsessions.items()
+                       if e["sess"] is not None]
+            decoders = dict(self._decoders)
+        evicted = 0
+        for key, ent in entries:
+            sess = ent["sess"]
+            batcher = decoders.get(ent["model"])
+            if sess.done() or batcher is None:
+                continue
+            batcher.engine.release(
+                sess, "migrated", ReplicaDraining(
+                    "replica %r is draining — resume decode session "
+                    "(%s, %d) on a successor"
+                    % (self.name, key[0], key[1])))
+            evicted += 1
+            _obs_events.emit("decode", kind="migrate",
+                             replica=self.name, model=ent["model"],
+                             client=str(key[0]), session_seq=key[1],
+                             tokens=ent["base"] + sess.token_count)
+        return evicted
 
     # -- control plane -----------------------------------------------------
     def _handle_health(self, meta):
@@ -540,6 +823,13 @@ class ReplicaServer:
             models[n] = {"state": info.get("state"),
                          "ready": info.get("state") == "ready",
                          "queue_depth": info.get("queue_depth", 0)}
+        # wire decode models ride the same surface so the router's
+        # eligible(model) placement sees them
+        for n, b in self.decoders().items():
+            state = b.health_state()
+            models.setdefault(n, {
+                "state": state, "ready": state == "ready",
+                "queue_depth": b.session_count, "decode": True})
         with self._lock:
             draining = self._draining
         return {"status": "ok", "replica": self.name,
@@ -569,7 +859,8 @@ class ReplicaServer:
             stats = {"predicts_dispatched": self._predicts_dispatched,
                      "requests_received": self._requests_received,
                      "dup_hits": self._dup_hits,
-                     "cancels_received": self._cancels_received}
+                     "cancels_received": self._cancels_received,
+                     "decode_requests": self._decode_requests}
         compiles = {}
         for n in self.registry.names():
             try:
@@ -577,6 +868,13 @@ class ReplicaServer:
             except ServeError:
                 continue
         stats["compile_count"] = compiles
+        decode = {}
+        for n, b in self.decoders().items():
+            decode[n] = dict(b.rebuild_state(),
+                             compile_count=b.engine.compile_count,
+                             sessions=b.session_count,
+                             state=b.health_state())
+        stats["decode"] = decode
         return dict(stats, status="ok"), ()
 
 
@@ -662,7 +960,18 @@ def main(argv=None):
          "max_wait_ms": 1.0,                # optional batcher knob
          "models": [{"name": "m", "prefix": "/ckpt/m", "epoch": 3,
                      "data_shapes": {"data": [1, 16]},
-                     "batches": [1, 2, 4]}]}
+                     "batches": [1, 2, 4]},
+                    {"name": "lm", "kind": "decode_lm",
+                     "vocab": 32, "dim": 16, "seed": 0,
+                     "dtype": "float32", "max_len": 32,
+                     "block_size": 4, "num_blocks": 24,
+                     "rungs": [1, 2, 4]}]}
+
+    A ``"kind": "decode_lm"`` entry builds the deterministic
+    ``test_utils.tiny_attention_lm`` (same seed on every replica →
+    identical params → bit-equal cross-replica failover) behind a
+    :class:`~mxnet_tpu.serve.decode.DecodeBatcher` on the DECODE_*
+    wire surface — the fleet chaos drill's streaming workload.
 
     Loads + warms every model (hitting the shared persistent XLA
     compile cache when ``MXNET_COMPILE_CACHE_DIR`` is set), starts
@@ -690,6 +999,29 @@ def main(argv=None):
     if spec.get("max_wait_ms") is not None:
         batcher_kwargs["max_wait_ms"] = float(spec["max_wait_ms"])
     for m in spec.get("models", ()):
+        if m.get("kind") == "decode_lm":
+            from ..test_utils import tiny_attention_lm
+            from .decode import DecodeBatcher, DecodeEngine
+            params, step_fn, prefill_fn, token_spec, input_spec = \
+                tiny_attention_lm(vocab=int(m.get("vocab", 32)),
+                                  dim=int(m.get("dim", 16)),
+                                  seed=int(m.get("seed", 0)),
+                                  dtype=m.get("dtype", "float32"))
+            eng = DecodeEngine(
+                step_fn, prefill_fn=prefill_fn,
+                token_spec=token_spec, input_spec=input_spec,
+                params=params, max_len=int(m.get("max_len", 32)),
+                block_size=int(m["block_size"])
+                if m.get("block_size") else None,
+                num_blocks=int(m["num_blocks"])
+                if m.get("num_blocks") else None,
+                session_rungs=tuple(m["rungs"])
+                if m.get("rungs") else None,
+                label=m["name"])
+            server.add_decoder(
+                m["name"], DecodeBatcher(eng, name=m["name"],
+                                         **batcher_kwargs))
+            continue
         ladder = BucketLadder(batches=tuple(m["batches"])) \
             if m.get("batches") else None
         registry.load_checkpoint(
@@ -701,7 +1033,8 @@ def main(argv=None):
     _obs_events.emit("fleet", kind="replica_start",
                      replica=server.name, port=server.port,
                      http=server.http_port, pid=_os.getpid(),
-                     models=registry.names())
+                     models=registry.names()
+                     + sorted(server.decoders()))
     print("REPLICA READY port=%d http=%d pid=%d"
           % (server.port, server.http_port, _os.getpid()),
           flush=True)
@@ -710,7 +1043,7 @@ def main(argv=None):
     finally:
         _obs_events.emit("fleet", kind="replica_exit",
                          replica=server.name, pid=_os.getpid())
-        registry.close()
+        server.close()
     return 0
 
 

@@ -43,15 +43,20 @@ import time as _time
 
 import numpy as _np
 
-from .buckets import ServeError
-from .replica import (MSG_CANCEL, MSG_HEALTH, MSG_PREDICT, MSG_REPLY,
+from .buckets import RequestCancelled, ServeError
+from .decode import DecodeJournal, _FAILOVERS_TOTAL, _RESUMED_TOTAL
+from .replica import (MSG_CANCEL, MSG_DECODE_CANCEL, MSG_DECODE_CLOSE,
+                      MSG_DECODE_NEXT, MSG_DECODE_OPEN, MSG_HEALTH,
+                      MSG_PREDICT, MSG_REPLY, ReplicaServer,
                       error_class)
 from .. import sanitizer as _san
 from ..observability import events as _obs_events
 from ..observability import metrics as _obs_metrics
 from ..resilience import servechaos as _servechaos
+from ..resilience.retry import backoff_delays
 
-__all__ = ["CircuitBreaker", "ReplicaHandle", "Router"]
+__all__ = ["CircuitBreaker", "DecodeStream", "ReplicaHandle",
+           "Router"]
 
 _REPLICAS_READY = _obs_metrics.gauge(
     "fleet_replicas_ready",
@@ -303,6 +308,11 @@ class Router:
         self._replicas = {}     # key -> ReplicaHandle
         self._seq = 0
         self._rr = 0
+        # router-side half of the decode journal contract: identity,
+        # prompt and accepted-token log per fleet streaming session —
+        # the resume payload when a replica dies or drains mid-stream
+        self._decode_journal = DecodeJournal(
+            "router.%s" % self.client_id)
         self._stop = _san.event()
         _san.track(self, ("_replicas", "_seq", "_rr"),
                    label="serve.router")
@@ -650,6 +660,32 @@ class Router:
         except (ConnectionError, OSError):
             pass
 
+    # -- streaming decode --------------------------------------------------
+    @property
+    def decode_journal(self):
+        """The router-side session journal (resume source of truth
+        for fleet streaming sessions)."""
+        return self._decode_journal
+
+    def decode_open(self, model, prompt, max_new_tokens=None,
+                    deadline_ms=None):
+        """Open one fleet streaming decode session on an eligible
+        replica.  Returns a :class:`DecodeStream` — the stable handle
+        the caller keeps across replica death and deploys: tokens are
+        journaled as they stream back, and a dead/draining replica's
+        session transparently re-opens on a successor from the
+        journal, resuming bit-equal.  Raises the typed serve errors
+        (``KVPoolExhausted``/``OverloadError`` when no replica can
+        hold the session, ``ServeError`` when none is routable)."""
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        stream = DecodeStream(self, model, prompt, seq,
+                              max_new_tokens=max_new_tokens,
+                              deadline_ms=deadline_ms)
+        stream._open_somewhere("open")
+        return stream
+
     # -- health probing ----------------------------------------------------
     def _probe_loop(self):
         while not self._stop.wait(self._probe_interval):
@@ -693,3 +729,346 @@ class Router:
             self._probe_thread.join(timeout=5.0)
         for handle in self.replicas().values():
             handle.close_pool()
+
+
+class DecodeStream:
+    """One fleet streaming decode session under a stable handle.
+
+    The router places the session on an eligible replica
+    (DECODE_OPEN) and the caller pulls tokens with
+    :meth:`next_output` (DECODE_NEXT per index — answered from the
+    replica's retained stream, so a retried index dedups instead of
+    re-decoding).  Every accepted token is journaled router-side; when
+    the serving replica dies (transport failure) or drains (deploy
+    migration), the session re-opens on a successor with the journal
+    as the resume payload — the successor re-prefills and replays the
+    log bit-checked, and the caller keeps reading under the SAME
+    handle.  Resume attempts ride the shared jittered backoff,
+    bounded by the router's retry budget per failover; past the
+    budget the stream fails typed.  A cancelled stream is NEVER
+    resumed — a cancel racing a failover wins."""
+
+    def __init__(self, router, model, prompt, seq,
+                 max_new_tokens=None, deadline_ms=None):
+        import random
+        self._router = router
+        self.model = model
+        self.client = router.client_id
+        self.seq = int(seq)
+        self.incarnation = 0
+        names, tensors = router._serialize(prompt)
+        self._prompt_names = names
+        self._prompt_tensors = tensors
+        self.max_new_tokens = max_new_tokens
+        self._deadline_ms = deadline_ms
+        self._rng = random.Random()
+        self._lock = _san.lock(label="serve.decode.stream.%d" % seq)
+        self._handle = None         # current ReplicaHandle
+        self._base = 0              # successor-side resume offset
+        self._ntokens = 0
+        self._out_names = None      # leaf names of one output tree
+        self._done = False
+        self.finish_reason = None
+        self._error = None
+        self._cancelled = False
+        self.failover_count = 0
+        self.resume_stamps = []     # (t_detect, t_resumed) monotonic
+        length = int(tensors[0].shape[0]) if tensors else 0
+        router._decode_journal.open(
+            self.client, self.seq, 0,
+            prompt=dict(zip(names, tensors)) if names else tensors[0],
+            length=length, max_new_tokens=max_new_tokens)
+
+    @property
+    def key(self):
+        return (self.client, self.seq)
+
+    @property
+    def replica(self):
+        """The key of the replica currently serving this stream."""
+        with self._lock:
+            return self._handle.key if self._handle is not None \
+                else None
+
+    def tokens(self):
+        """Every accepted token so far (the journal log — survives
+        failovers, readable after a typed failure)."""
+        return self._router._decode_journal.tokens(self.key)
+
+    def done(self):
+        with self._lock:
+            return self._done
+
+    @property
+    def error(self):
+        with self._lock:
+            return self._error
+
+    # -- placement / failover ----------------------------------------------
+    def _open_meta(self, resume_tokens):
+        meta = {"model": self.model,
+                "session": [self.client, self.seq, self.incarnation],
+                "inputs": self._prompt_names,
+                "resume": len(resume_tokens),
+                "out_names": self._out_names}
+        if self.max_new_tokens is not None:
+            meta["max_new_tokens"] = self.max_new_tokens
+        if self._deadline_ms is not None:
+            meta["deadline_ms"] = float(self._deadline_ms)
+        tensors = list(self._prompt_tensors)
+        for tok in resume_tokens:
+            _, leaves = ReplicaServer._out_wire(tok)
+            tensors.extend(leaves)
+        return meta, tensors
+
+    def _open_somewhere(self, why, failed=None):
+        """Place (or re-place) the session on an eligible replica —
+        DECODE_OPEN with the journal as the resume payload.  Typed
+        sheds (draining/overload/rebuilding) reroute; transport
+        failures back off on the shared jittered schedule; the
+        router's retry budget bounds the attempts."""
+        router = self._router
+        resume_tokens = self.tokens()
+        meta, tensors = self._open_meta(resume_tokens)
+        delays = backoff_delays(router._retries + 1, 0.05, 1.0, 2.0,
+                                0.5, self._rng)
+        errors = []
+        last_shed = None
+        attempts = 0
+        while attempts < router._retries:
+            if self._cancelled:
+                raise RequestCancelled(
+                    "decode session (%s, %d) cancelled — a cancelled "
+                    "session is never resumed"
+                    % (self.client, self.seq))
+            candidates = [h for h in router._candidates(self.model)
+                          if h is not failed] \
+                or router._candidates(self.model)
+            handle = next((h for h in candidates
+                           if h.breaker.allow()), None)
+            if handle is None:
+                errors.append("no routable replica")
+                attempts += 1
+                _time.sleep(next(delays))
+                continue
+            attempts += 1
+            try:
+                rmeta, _ = router._call(handle, MSG_DECODE_OPEN, meta,
+                                        tensors)
+            except ConnectionError as exc:
+                handle.breaker.record_failure()
+                failed = handle
+                errors.append("%s: %s" % (handle.key, exc))
+                _time.sleep(next(delays))
+                continue
+            handle.breaker.record_success()
+            if rmeta.get("status") != "ok":
+                code = rmeta.get("code")
+                if code in Router._REROUTE_CODES:
+                    # admission-time shed: never dispatched there
+                    last_shed = rmeta
+                    failed = handle
+                    errors.append("%s: shed (%s)" % (handle.key, code))
+                    _time.sleep(next(delays))
+                    continue
+                raise error_class(code)(rmeta.get("msg")
+                                        or "replica error")
+            with self._lock:
+                self._handle = handle
+                self._base = int(rmeta.get("base", 0))
+            _obs_events.emit(
+                "decode",
+                kind="migrate" if why == "migrate" else "resume"
+                if why != "open" else "session_place",
+                model=self.model, client=str(self.client),
+                session_seq=self.seq, incarnation=self.incarnation,
+                to=handle.key, tokens=len(resume_tokens), why=why)
+            return
+        if last_shed is not None:
+            raise error_class(last_shed.get("code"))(
+                last_shed.get("msg") or "replica shed")
+        raise ServeError(
+            "decode session (%s, %d): %s budget exhausted after %d "
+            "attempt(s): %s"
+            % (self.client, self.seq,
+               "open" if why == "open" else "resume", attempts,
+               "; ".join(errors) or "no replica admitted it"))
+
+    def _failover(self, why, exc=None):
+        """The serving replica died or drained mid-stream: bump the
+        incarnation and re-open on a successor from the journal —
+        transparent to the caller, bit-equal to an uninterrupted
+        stream (the successor replays the log bit-checked)."""
+        with self._lock:
+            if self._cancelled:
+                raise RequestCancelled(
+                    "decode session (%s, %d) cancelled during "
+                    "failover — never resumed"
+                    % (self.client, self.seq))
+            failed = self._handle
+            self._handle = None
+            self.incarnation += 1
+            self.failover_count += 1
+        t0 = _time.monotonic()
+        _FAILOVERS_TOTAL.inc()
+        _obs_events.emit("decode", kind="failover", model=self.model,
+                         client=str(self.client), session_seq=self.seq,
+                         incarnation=self.incarnation,
+                         from_=failed.key if failed else None,
+                         why=why,
+                         error=str(exc)[:200] if exc else None)
+        try:
+            self._open_somewhere(why, failed=failed)
+        except Exception as oexc:
+            with self._lock:
+                self._done = True
+                self._error = oexc
+                self.finish_reason = "failover_exhausted"
+            self._router._decode_journal.close(
+                self.key, "failover_exhausted")
+            raise
+        self.resume_stamps.append((t0, _time.monotonic()))
+        _RESUMED_TOTAL.inc()
+
+    # -- token stream ------------------------------------------------------
+    def next_output(self, timeout=None):
+        """The next accepted token (host tree).  Blocks across
+        failovers; raises ``StopIteration`` on a clean finish, the
+        typed error on failure, ``TimeoutError`` past *timeout*."""
+        deadline = None if timeout is None \
+            else _time.monotonic() + timeout
+        with self._lock:
+            if self._error is not None:
+                raise self._error
+            if self._cancelled:
+                raise RequestCancelled(
+                    "decode session (%s, %d) cancelled"
+                    % (self.client, self.seq))
+            if self._done:
+                raise StopIteration(
+                    "decode session (%s, %d) finished (%s)"
+                    % (self.client, self.seq, self.finish_reason))
+            index = self._ntokens
+            handle = self._handle
+        while True:
+            if deadline is not None and \
+                    _time.monotonic() >= deadline:
+                raise TimeoutError(
+                    "decode session (%s, %d): token %d not available "
+                    "after %ss" % (self.client, self.seq, index,
+                                   timeout))
+            if handle is None:
+                self._failover("resume")
+                with self._lock:
+                    handle = self._handle
+            wait_s = 5.0
+            if deadline is not None:
+                wait_s = max(0.05, min(
+                    wait_s, deadline - _time.monotonic()))
+            try:
+                rmeta, rtensors = self._router._call(
+                    handle, MSG_DECODE_NEXT,
+                    {"session": [self.client, self.seq,
+                                 self.incarnation],
+                     "index": index, "wait_s": wait_s})
+            except ConnectionError as exc:
+                handle.breaker.record_failure()
+                self._failover("resume", exc)
+                with self._lock:
+                    handle = self._handle
+                continue
+            handle.breaker.record_success()
+            if rmeta.get("status") != "ok":
+                code = rmeta.get("code")
+                if code == "draining":
+                    # deploy drain mid-stream: migrate to a successor
+                    self._failover("migrate")
+                    with self._lock:
+                        handle = self._handle
+                    continue
+                err = error_class(code)(rmeta.get("msg")
+                                        or "replica error")
+                with self._lock:
+                    self._done = True
+                    self._error = err
+                    self.finish_reason = code
+                self._router._decode_journal.close(self.key, code)
+                raise err
+            if rmeta.get("pending"):
+                continue        # bounded wait elapsed — poll again
+            if rmeta.get("done"):
+                with self._lock:
+                    self._done = True
+                    self.finish_reason = rmeta.get("reason")
+                self._router._decode_journal.close(
+                    self.key, rmeta.get("reason") or "finished")
+                raise StopIteration(
+                    "decode session (%s, %d) finished (%s)"
+                    % (self.client, self.seq, self.finish_reason))
+            names = rmeta.get("out_names")
+            out = ReplicaServer._out_unwire(names, rtensors)
+            self._router._decode_journal.append(self.key, index, out)
+            with self._lock:
+                self._out_names = names
+                self._ntokens = index + 1
+            return out
+
+    def result(self, timeout=None):
+        """Drain the stream to completion; returns the FULL accepted
+        token list (journal log — pre-failover tokens included), or
+        raises the typed failure."""
+        deadline = None if timeout is None \
+            else _time.monotonic() + timeout
+        while True:
+            remaining = None if deadline is None \
+                else max(0.001, deadline - _time.monotonic())
+            try:
+                self.next_output(timeout=remaining)
+            except StopIteration:
+                return self.tokens()
+
+    def cancel(self):
+        """Abandon the stream.  The cancel is pinned on the serving
+        replica (a late failover re-open answers ``cancelled``) and
+        the session is never resumed."""
+        with self._lock:
+            if self._done:
+                return False
+            self._cancelled = True
+            self._done = True
+            self.finish_reason = "cancelled"
+            self._error = RequestCancelled(
+                "decode session (%s, %d) cancelled by its caller"
+                % (self.client, self.seq))
+            handle = self._handle
+        self._router._decode_journal.close(self.key, "cancelled")
+        if handle is not None:
+            try:
+                self._router._call(
+                    handle, MSG_DECODE_CANCEL,
+                    {"session": [self.client, self.seq,
+                                 self.incarnation]},
+                    timeout=min(5.0, self._router._rpc_timeout or 5.0))
+            except (ConnectionError, OSError):
+                pass
+        return True
+
+    def close(self):
+        """Release the replica-side session record (best effort; a
+        live stream is cancelled first)."""
+        with self._lock:
+            live = not self._done
+            handle = self._handle
+        if live:
+            self.cancel()
+            with self._lock:
+                handle = self._handle
+        if handle is not None:
+            try:
+                self._router._call(
+                    handle, MSG_DECODE_CLOSE,
+                    {"session": [self.client, self.seq,
+                                 self.incarnation]},
+                    timeout=min(5.0, self._router._rpc_timeout or 5.0))
+            except (ConnectionError, OSError):
+                pass

@@ -21,6 +21,7 @@ from mxnet_tpu.serve import (BucketLadder, CompiledPredictor,
                              DecodeEngine, KVPool, KVPoolExhausted,
                              ModelRegistry, RequestCancelled,
                              ServeError, SpeculativeDecoder)
+from mxnet_tpu.resilience import chaos
 from mxnet_tpu.test_utils import (dense_decode_reference,
                                   tiny_attention_lm)
 
@@ -661,4 +662,197 @@ class TestSpeculative:
                          max_new_tokens=2)
         with pytest.raises(ServeError, match="spec_k"):
             eng.verify(sess, {"tok": np.zeros((4,), np.int32)})
+        eng.close()
+
+    def test_draft_crash_falls_back_bit_equal(self, monkeypatch):
+        """A draft engine dying mid-run degrades to plain greedy
+        target ticks — invisible in the stream (bit-equality to
+        greedy already holds), named in ``fallback_reason``, and the
+        draft session is retired, never stranded."""
+        eng_t, params, step_fn = _engine(session_rungs=(1,), spec_k=3,
+                                         max_len=24, num_blocks=40,
+                                         prefill_rungs=(4,))
+        eng_d, _, _ = _engine(session_rungs=(1,), max_len=24,
+                              num_blocks=40, prefill_rungs=(4,))
+        spec = SpeculativeDecoder(eng_t, eng_d)
+        calls = [0]
+        orig_tick = eng_d.tick
+        def dying_tick(sessions):
+            calls[0] += 1
+            if calls[0] > 2:
+                raise RuntimeError("injected draft device loss")
+            return orig_tick(sessions)
+        monkeypatch.setattr(eng_d, "tick", dying_tick)
+        prompt = np.asarray([1, 2, 3], np.int32)
+        sess = spec.run({"tok": prompt}, max_new_tokens=10)
+        assert [int(o) for o in sess.outputs()] == _dense_ref(
+            params, step_fn, prompt, 10, eng_t.padded_len)
+        assert spec.fallback_reason == "draft_tick"
+        assert spec.stats["fallbacks"] == 1
+        assert eng_d.active_sessions == 0      # draft retired
+        eng_t.close()
+        eng_d.close()
+
+
+# ---------------------------------------------------------------------------
+# quarantine-and-rebuild: resume-edge determinism
+# ---------------------------------------------------------------------------
+
+class TestRebuildResume:
+    """The chaos-armed tick-crash path, edge by edge: the batcher
+    quarantines the suspect pool, rebuilds a fresh one against the
+    warm programs, and re-admits journaled sessions via one
+    re-prefill + replayed ticks — bit-equal to an uninterrupted
+    stream, or typed, never wrong and never wedged.
+    ci/decode_smoke.py drives the happy path at scale; here each
+    resume EDGE is pinned in isolation."""
+
+    @pytest.fixture(autouse=True)
+    def _clean_chaos(self):
+        chaos.reset()
+        yield
+        chaos.reset()
+
+    @pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+    def test_crash_before_first_token_resumes_bit_equal(self, dtype):
+        # mid-prefill kill: the crash lands on the very first tick,
+        # so the journal holds the identity and prompt but ZERO
+        # accepted tokens — resume is one re-prefill, no replay
+        eng, params, step_fn = _engine(dtype, session_rungs=(1,),
+                                       prefill_rungs=(4,))
+        bat = DecodeBatcher(eng, max_wait_ms=1.0, rebuilds=1)
+        p = np.asarray([3, 1, 4], np.int32)
+        chaos.configure(decode_tick_raise_at=1)
+        sess = bat.start({"tok": p}, max_new_tokens=6)
+        got = [int(o) for o in sess.result(60)]
+        assert got == _dense_ref(params, step_fn, p, 6,
+                                 eng.padded_len, dtype)
+        assert bat.rebuild_count == 1
+        assert bat.health_state() == "ready"
+        assert eng.pool.blocks_in_use == 0
+        bat.close()
+        eng.close()
+
+    @pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+    def test_crash_at_block_boundary_resumes_bit_equal(self, dtype):
+        # the 3-token prompt plus the first generated token exactly
+        # fills one block (block_size=4), so the crash on tick 2
+        # leaves the journal frontier block-ALIGNED — re-admission
+        # must grow a fresh block for the replayed cache before the
+        # first new step, the classic off-by-one edge
+        eng, params, step_fn = _engine(dtype, session_rungs=(1,),
+                                       prefill_rungs=(4,))
+        bat = DecodeBatcher(eng, max_wait_ms=1.0, rebuilds=1)
+        p = np.asarray([3, 1, 4], np.int32)
+        chaos.configure(decode_tick_raise_at=2)
+        sess = bat.start({"tok": p}, max_new_tokens=6)
+        got = [int(o) for o in sess.result(60)]
+        assert got == _dense_ref(params, step_fn, p, 6,
+                                 eng.padded_len, dtype)
+        assert bat.rebuild_count == 1
+        assert eng.pool.blocks_in_use == 0
+        bat.close()
+        eng.close()
+
+    @pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+    def test_cancel_racing_rebuild_is_never_resumed(self, dtype):
+        # a CANCEL landing in the rebuild window (fresh pool up,
+        # re-admission not yet run — exactly where a wire CANCEL
+        # races the router's failover) wins: the session is released
+        # typed with its accepted prefix intact and is never
+        # replayed; its co-tenant still resumes bit-equal
+        eng, params, step_fn = _engine(dtype, session_rungs=(1, 2),
+                                       prefill_rungs=(4,))
+        seen = []
+        def on_state(state):
+            seen.append(state)
+            if state == "rebuilding":
+                victim.cancel()
+        bat = DecodeBatcher(eng, max_wait_ms=1.0, rebuilds=1,
+                            on_state=on_state)
+        chaos.configure(decode_tick_raise_at=2)
+        victim = bat.start({"tok": np.asarray([1, 2], np.int32)},
+                           max_new_tokens=8)
+        other = bat.start({"tok": np.asarray([5, 6], np.int32)},
+                          max_new_tokens=8)
+        with pytest.raises(RequestCancelled, match="rebuild"):
+            victim.result(60)
+        got = [int(o) for o in other.result(60)]
+        assert "rebuilding" in seen
+        assert got == _dense_ref(params, step_fn,
+                                 np.asarray([5, 6], np.int32), 8,
+                                 eng.padded_len, dtype)
+        # the cancelled stream kept its pre-crash prefix, bit-equal
+        kept = [int(o) for o in victim.outputs()]
+        assert kept == _dense_ref(params, step_fn,
+                                  np.asarray([1, 2], np.int32),
+                                  len(kept), eng.padded_len, dtype)
+        deadline = time.monotonic() + 5
+        while eng.pool.blocks_in_use and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert eng.pool.blocks_in_use == 0
+        bat.close()
+        eng.close()
+
+    @pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+    def test_pool_exhausted_readmission_sheds_typed(self, dtype,
+                                                    monkeypatch):
+        # a fresh pool that cannot hold one session's resume prompt
+        # sheds THAT session typed — the rebuild itself still lands,
+        # the co-tenant resumes bit-equal, and the batcher stays open
+        eng, params, step_fn = _engine(dtype, session_rungs=(1, 2),
+                                       prefill_rungs=(4,))
+        bat = DecodeBatcher(eng, max_wait_ms=1.0, rebuilds=1)
+        orig_readmit = eng.readmit
+        def starved_readmit(s):
+            if s.sid == victim.sid:
+                raise KVPoolExhausted(
+                    "injected: fresh pool cannot hold the resume")
+            return orig_readmit(s)
+        monkeypatch.setattr(eng, "readmit", starved_readmit)
+        chaos.configure(decode_tick_raise_at=2)
+        victim = bat.start({"tok": np.asarray([1, 2], np.int32)},
+                           max_new_tokens=8)
+        other = bat.start({"tok": np.asarray([5, 6], np.int32)},
+                          max_new_tokens=8)
+        with pytest.raises(KVPoolExhausted):
+            victim.result(60)
+        got = [int(o) for o in other.result(60)]
+        assert got == _dense_ref(params, step_fn,
+                                 np.asarray([5, 6], np.int32), 8,
+                                 eng.padded_len, dtype)
+        assert bat.rebuild_count == 1
+        assert bat.health_state() == "ready"
+        chaos.reset()
+        # not wedged: a new session decodes end to end
+        fresh = bat.start({"tok": np.asarray([7], np.int32)},
+                          max_new_tokens=3)
+        assert [int(o) for o in fresh.result(60)] == _dense_ref(
+            params, step_fn, np.asarray([7], np.int32), 3,
+            eng.padded_len, dtype)
+        deadline = time.monotonic() + 5
+        while eng.pool.blocks_in_use and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert eng.pool.blocks_in_use == 0
+        bat.close()
+        eng.close()
+
+    def test_past_budget_crash_degrades_typed_never_wedged(self):
+        # past MXNET_SERVE_DECODE_REBUILDS the batcher must fail
+        # typed and report unhealthy — never decode over a pool it
+        # cannot trust, never hang callers
+        eng, _, _ = _engine(session_rungs=(1,), prefill_rungs=(4,))
+        bat = DecodeBatcher(eng, max_wait_ms=1.0, rebuilds=0)
+        chaos.configure(decode_tick_raise_at=1)
+        sess = bat.start({"tok": np.asarray([1, 2], np.int32)},
+                         max_new_tokens=4)
+        with pytest.raises(ServeError, match="unhealthy"):
+            sess.result(60)
+        assert bat.unhealthy
+        assert bat.health_state() == "unhealthy"
+        assert bat.rebuild_count == 0
+        with pytest.raises(ServeError, match="unhealthy"):
+            bat.start({"tok": np.asarray([1], np.int32)})
+        assert eng.pool.blocks_in_use == 0
+        bat.close()
         eng.close()
