@@ -120,6 +120,20 @@ def main():
     if "quantize" not in events._CATEGORIES:
         failures.append("'quantize' is not a known event category")
 
+    # graftsched registers its explorer counters on import and emits
+    # under the "sched" category (docs/sanitizers.md "Schedule
+    # exploration"); values are exercised by ci/sched_drill.py, the
+    # contract here is catalog presence
+    import tools.graftsched  # noqa: F401
+    snap = metrics.snapshot()
+    for name in ("graftsched_schedules_total",
+                 "graftsched_findings_total"):
+        if name not in snap:
+            failures.append("graftsched instrument %r missing from "
+                            "the registry catalog" % name)
+    if "sched" not in events._CATEGORIES:
+        failures.append("'sched' is not a known event category")
+
     # exposition must render and carry the fused-step counter
     expo = metrics.exposition()
     if "mxnet_fused_step_dispatches %d" % STEPS not in expo:

@@ -38,6 +38,16 @@ echo "== graftsan: sanitizer-enabled smoke train step =="
 # contract.  Seconds, CPU-only (docs/sanitizers.md).
 MXNET_SAN=all python ci/graftsan_smoke.py
 
+echo "== graftsched: deterministic schedule exploration drill =="
+# Serializing-scheduler model check of the threaded serving/kvstore
+# subsystems: every shipped scenario explores its bounded schedule
+# set (preemption bounding + DPOR pruning) with zero findings, the
+# seeded PR-19 stop() double-teardown is re-found and its trace
+# replays bit-exactly, and the graftsched counters move.  Seconds,
+# CPU-only (docs/sanitizers.md "Schedule exploration").  Last stdout
+# line: "graftsched: scenarios=.. schedules=.. findings=0 ok".
+MXNET_SAN=sched python ci/sched_drill.py
+
 echo "== observability: telemetry smoke train step =="
 # Short fused-step run with MXNET_OBS=all: asserts the expected
 # instruments exist with sane values, events.jsonl is well-formed

@@ -599,7 +599,7 @@ class _InFlight:
     __slots__ = ("event", "result")
 
     def __init__(self, done=False, result=None):
-        self.event = threading.Event()
+        self.event = _san.event()
         self.result = result
         if done:
             self.event.set()
@@ -815,8 +815,6 @@ class KVStoreServer:
         sent yet, but the state change is durable)."""
         grad = nd.array(grad_np)
         with self.lock:
-            self.applies += 1
-            _APPLIES.inc()
             if key not in self.store:
                 self.store[key] = grad.copy()
             elif self.updater is not None:
@@ -835,6 +833,13 @@ class KVStoreServer:
                     "dist_async push for key %r before an optimizer was "
                     "set — call kv.set_optimizer() first (async mode "
                     "requires the server-side updater)" % (key,))
+            # counted AFTER the mutation branches: a push that raised
+            # above mutated nothing, and bumping the exactly-once
+            # proof counter for it breaks snapshot accounting (found
+            # by graftsched's kvserver scenario — an owner push that
+            # beat SET_OPT left applies == pushes despite applying 0)
+            self.applies += 1
+            _APPLIES.inc()
             if applied_reqs:
                 self._applied_inflight.update(applied_reqs)
             self._maybe_snapshot()

@@ -63,7 +63,7 @@ __all__ = ["enabled", "emit", "emitter", "watch_jit", "configure",
 _CATEGORIES = ("compile", "guard", "chaos", "checkpoint", "preempt",
                "retry", "respawn", "warning", "kvstore", "membership",
                "supervisor", "watchdog", "serve", "decode", "fleet",
-               "autotune", "quantize", "iraudit")
+               "autotune", "quantize", "iraudit", "sched")
 
 
 def _spec():

@@ -255,8 +255,17 @@ class CheckpointManager:
         # write+commit section — shared per manifest path across
         # manager instances in this process
         self._lock = _commit_lock(prefix + ".manifest.json")
+        # _plock (leaf — never held across a join or a write+commit)
+        # guards the background bookkeeping: two background saves, or a
+        # save racing wait(), otherwise lose threads from _pending via
+        # the filter-then-reassign below (found by graftsched's
+        # checkpoint scenario: the un-joined writer commits after
+        # wait() returned)
+        self._plock = _san.lock(label="checkpoint.pending")
         self._pending = []                         # background threads
         self._bg_error = None
+        _san.track(self, ("_pending", "_bg_error"),
+                   label="CheckpointManager")
 
     @property
     def manifest_path(self):
@@ -332,10 +341,12 @@ class CheckpointManager:
         if background is None:
             background = self.background
         if background:
-            self._pending = [t for t in self._pending if t.is_alive()]
             t = _san.thread(target=self._write_and_commit_guarded,
                             args=(files, entry), daemon=True)
-            self._pending.append(t)
+            with self._plock:
+                self._pending = [p for p in self._pending
+                                 if p.is_alive()]
+                self._pending.append(t)
             t.start()
         else:
             self._write_and_commit(files, entry)
@@ -364,7 +375,8 @@ class CheckpointManager:
             self._write_and_commit(files, entry)
         except Exception as exc:
             self.logger.error("background checkpoint save failed: %s", exc)
-            self._bg_error = exc
+            with self._plock:
+                self._bg_error = exc
 
     def _write_and_commit(self, files, entry):
         import time
@@ -430,14 +442,16 @@ class CheckpointManager:
     def wait(self):
         """Join outstanding background saves; re-raise the first
         background failure."""
-        pending, self._pending = self._pending, []
+        with self._plock:
+            pending, self._pending = self._pending, []
         for t in pending:
             t.join()
         self._raise_pending()
 
     def _raise_pending(self):
-        if self._bg_error is not None:
+        with self._plock:
             exc, self._bg_error = self._bg_error, None
+        if exc is not None:
             raise exc
 
     # -- restore -----------------------------------------------------------

@@ -4,7 +4,7 @@ Production code never imports ``tools.graftsan`` directly — it calls
 the factories and hooks here, which fall through to the plain
 ``threading``/``queue`` primitives (or to no-ops) unless the matching
 component is enabled via ``MXNET_SAN`` (comma list of
-``race,recompile,donation,transfer``, or ``all``).  The off-path cost
+``race,recompile,donation,transfer,sched``, or ``all``).  The off-path cost
 is one environment read at *creation* time and zero per access, so
 the wrappers can stay threaded through the hot subsystems
 unconditionally.
@@ -27,10 +27,10 @@ import queue as _queue
 import threading as _threading
 
 __all__ = ["enabled", "lock", "rlock", "condition", "event", "queue",
-           "thread", "track", "wrap_jit", "poison_donated",
-           "transfer_guard", "transfer_check"]
+           "thread", "track", "sched_point", "wrap_jit",
+           "poison_donated", "transfer_guard", "transfer_check"]
 
-_VALID = ("race", "recompile", "donation", "transfer")
+_VALID = ("race", "recompile", "donation", "transfer", "sched")
 
 
 def enabled(component):
@@ -62,31 +62,69 @@ def _graftsan():
             "MXNET_SAN")
 
 
-# -- race: instrumented primitive factories ---------------------------------
+def _sched():
+    """The graftsched scheduler controlling the calling thread, or
+    None.  Three gates, cheapest first: the ``sched`` component must
+    be on, ``tools.graftsched.core`` must be importable, and a
+    scheduler must be installed with the *calling thread* under its
+    control.  ``MXNET_SAN=all`` therefore never reroutes ordinary
+    code — only threads a graftsched explorer itself spawned."""
+    if not enabled("sched"):
+        return None
+    try:
+        import tools.graftsched.core as core
+    except ImportError:
+        import sys
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        if root not in sys.path and \
+                os.path.isdir(os.path.join(root, "tools", "graftsched")):
+            sys.path.insert(0, root)
+            import tools.graftsched.core as core
+        else:
+            return None
+    return core.current_controlled()
+
+
+# -- race / sched: instrumented primitive factories --------------------------
 
 def lock(label=None):
+    s = _sched()
+    if s is not None:
+        return s.make_lock(label)
     if enabled("race"):
         return _graftsan().race.lock(label)
     return _threading.Lock()
 
 
 def rlock(label=None):
+    s = _sched()
+    if s is not None:
+        return s.make_rlock(label)
     if enabled("race"):
         return _graftsan().race.rlock(label)
     return _threading.RLock()
 
 
 def condition(lock=None, label=None):
+    s = _sched()
+    if s is not None:
+        return s.make_condition(lock, label)
     if enabled("race"):
         return _graftsan().race.condition(lock, label)
     return _threading.Condition(lock)
 
 
 def event():
+    s = _sched()
+    if s is not None:
+        return s.make_event()
     return _threading.Event()
 
 
 def queue(maxsize=0):
+    s = _sched()
+    if s is not None:
+        return s.make_queue(maxsize)
     if enabled("race"):
         return _graftsan().race.queue_(maxsize)
     return _queue.Queue(maxsize)
@@ -94,6 +132,10 @@ def queue(maxsize=0):
 
 def thread(group=None, target=None, name=None, args=(), kwargs=None,
            daemon=None):
+    s = _sched()
+    if s is not None:
+        return s.make_thread(target=target, name=name, args=args,
+                             kwargs=kwargs, daemon=daemon)
     if enabled("race"):
         return _graftsan().race.thread(group=group, target=target,
                                        name=name, args=args,
@@ -106,11 +148,25 @@ def thread(group=None, target=None, name=None, args=(), kwargs=None,
 
 
 def track(obj, attrs, label=None):
-    """Register *attrs* of *obj* with the lockset race tracker.
-    Call at the end of ``__init__``; no-op when race is off."""
+    """Register *attrs* of *obj* with the lockset race tracker (or,
+    under a graftsched run, with the schedule explorer's per-object
+    access recorder).  Call at the end of ``__init__``; no-op when
+    both components are off."""
+    s = _sched()
+    if s is not None:
+        return s.track_object(obj, attrs, label)
     if enabled("race"):
         _graftsan().race.track_object(obj, attrs, label)
     return obj
+
+
+def sched_point(label=None):
+    """Explicit schedule yield point for graftsched scenarios; no-op
+    (one env read) unless the calling thread is under an installed
+    graftsched scheduler."""
+    s = _sched()
+    if s is not None:
+        s.explicit_point(label)
 
 
 # -- recompile ---------------------------------------------------------------

@@ -780,6 +780,69 @@ def test_jg014_negative_benign_compiles_and_lower_only(tmp_path):
     assert fs == []
 
 
+def test_jg015_positive_if_guarded_wait(tmp_path):
+    fs = lint(tmp_path, """\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self.cv = threading.Condition()
+                self.ready = False
+
+            def get(self):
+                with self.cv:
+                    if not self.ready:
+                        self.cv.wait()
+                    return 1
+
+            def get_else_arm(self):
+                with self.cv:
+                    if self.ready:
+                        pass
+                    else:
+                        self.cv.wait(timeout=1.0)
+        """, rules=["JG015"])
+    assert len(fs) == 2, fs
+    assert rule_ids(fs) == ["JG015"] * 2
+    assert "lost" in fs[0].message
+    assert "while" in fs[0].message
+
+
+def test_jg015_negative_while_and_wait_for(tmp_path):
+    fs = lint(tmp_path, """\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self.cv = threading.Condition()
+                self.ready = False
+
+            def get(self):
+                with self.cv:
+                    while not self.ready:
+                        self.cv.wait()
+
+            def get_pred(self):
+                with self.cv:
+                    if not self.ready:
+                        self.cv.wait_for(lambda: self.ready)
+
+            def get_loop_recheck(self):
+                with self.cv:
+                    while True:
+                        if self.ready:
+                            break
+                        self.cv.wait(timeout=0.5)
+
+            def other_event(self, ev):
+                done = threading.Event()
+                with self.cv:
+                    if not self.ready:
+                        done.wait()   # not the condition: out of scope
+        """, rules=["JG015"])
+    assert fs == []
+
+
 # ---------------------------------------------------------------------------
 # suppression + baseline workflow
 # ---------------------------------------------------------------------------

@@ -424,6 +424,8 @@ def test_unset_means_no_wrappers(monkeypatch):
     # wrap_jit is identity
     f = lambda: None
     assert san.wrap_jit(f, "f") is f
+    # sched_point (graftsched yield point) is a no-op off the explorer
+    san.sched_point("anywhere")
     # transfer guard is a nullcontext and the choke point stays silent
     with san.transfer_guard():
         assert nd.ones((1,)).item() == 1.0

@@ -1,0 +1,378 @@
+"""graftsched schedule-exploration suite (tools/graftsched + the
+mxnet_tpu.sanitizer ``sched`` component).
+
+Covers: scheduler-core determinism (same input, identical decision
+sequence), bit-exact replay of a recorded trace, deadlock and livelock
+reports carrying every live thread's stack, thread exceptions and
+invariant violations surfacing as findings, the DPOR-pruned explorer
+finding a seeded lost-update, trace round-tripping, zero wrappers when
+``MXNET_SAN`` is unset, and pinned regressions for the real bugs the
+explorer surfaced (the CheckpointManager unlocked pending-writers
+bookkeeping and the kvstore applies-counter inflation)."""
+
+import os
+import threading
+
+import pytest
+
+from mxnet_tpu import sanitizer as san
+
+import tools.graftsched.core as core
+from tools.graftsched import explore
+
+
+@pytest.fixture
+def sched_on(monkeypatch):
+    monkeypatch.setenv("MXNET_SAN", "sched")
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_scheduler():
+    yield
+    assert core.current() is None, "a test leaked an installed scheduler"
+
+
+# ---------------------------------------------------------------------------
+# toy scenarios
+# ---------------------------------------------------------------------------
+
+class _LostUpdate:
+    """Two unsynchronized read-modify-writes on a tracked counter:
+    somewhere in the schedule set the increments overlap and one is
+    lost."""
+
+    name = "toy-lost-update"
+    budget = 64
+
+    def run(self):
+        class Box:
+            counter = 0
+        box = Box()
+        san.track(box, ("counter",), label="box")
+
+        def bump():
+            v = box.counter
+            box.counter = v + 1
+
+        t1 = san.thread(target=bump, name="bump-1")
+        t2 = san.thread(target=bump, name="bump-2")
+        t1.start()
+        t2.start()
+        t1.join()
+        t2.join()
+        # hand check() the plain int: a tracked-object repr in the
+        # assertion message would differ per run and defeat the
+        # bit-exact replay comparison
+        return int(box.counter)
+
+    def check(self, counter):
+        assert counter == 2, counter
+
+
+class _Deadlock:
+    name = "toy-deadlock"
+    budget = 16
+
+    def run(self):
+        a = san.lock(label="A")
+        b = san.lock(label="B")
+
+        def ab():
+            with a:
+                with b:
+                    pass
+
+        def ba():
+            with b:
+                with a:
+                    pass
+
+        t1 = san.thread(target=ab, name="ab")
+        t2 = san.thread(target=ba, name="ba")
+        t1.start()
+        t2.start()
+        t1.join()
+        t2.join()
+        return None
+
+    def check(self, state):
+        pass
+
+
+def _run_toy(factory, **kw):
+    return explore.run_schedule(factory, **kw)
+
+
+# ---------------------------------------------------------------------------
+# determinism + replay
+# ---------------------------------------------------------------------------
+
+def test_same_input_identical_decision_sequence(sched_on):
+    r1 = _run_toy(_LostUpdate)
+    r2 = _run_toy(_LostUpdate)
+    assert r1["decisions"] == r2["decisions"]
+    assert len(r1["decisions"]) > 4
+    # every decision is (tid, kind, key, reason)
+    for d in r1["decisions"]:
+        assert len(d) == 4 and isinstance(d[0], int)
+
+
+def test_explorer_finds_lost_update_and_replay_is_bit_exact(
+        sched_on, tmp_path):
+    res = explore.explore(_LostUpdate, trace_dir=str(tmp_path))
+    finding = res["finding"]
+    assert finding is not None, "lost update not found"
+    assert finding["type"] == "invariant"
+    assert res["trace_path"] is not None
+
+    trace = explore.load_trace(res["trace_path"])
+    assert trace["scenario"] == "toy-lost-update"
+    rep = explore.replay(_LostUpdate, trace)
+    assert rep["finding"] is not None
+    assert rep["finding"]["type"] == finding["type"]
+    assert rep["finding"]["message"] == finding["message"]
+    assert list(rep["decisions"]) == \
+        [tuple(d) for d in trace["decisions"]]
+
+
+def test_replay_divergence_is_reported(sched_on, tmp_path):
+    res = explore.explore(_LostUpdate, trace_dir=str(tmp_path))
+    trace = explore.load_trace(res["trace_path"])
+    # doctor the recorded decisions: force an impossible grant early
+    doctored = [list(d) for d in trace["decisions"]]
+    doctored[2][0] = 99
+    trace["decisions"] = doctored
+    rep = explore.replay(_LostUpdate, trace)
+    assert rep["finding"] is not None
+    assert rep["finding"]["type"] == "divergence"
+
+
+# ---------------------------------------------------------------------------
+# deadlock / livelock / exception findings
+# ---------------------------------------------------------------------------
+
+def test_deadlock_report_carries_both_stacks(sched_on):
+    res = explore.explore(_Deadlock)
+    finding = res["finding"]
+    assert finding is not None
+    assert finding["type"] == "deadlock"
+    live = {s["name"]: "\n".join(s["stack"])
+            for s in finding["stacks"]}
+    assert "ab" in live and "ba" in live, live.keys()
+    # each stack points into the scenario body, not scheduler guts
+    assert "ab()" in live["ab"] or "with b" in live["ab"], live["ab"]
+    assert "ba()" in live["ba"] or "with a" in live["ba"], live["ba"]
+
+
+def test_livelock_guard_reports_with_stacks(sched_on):
+    class Spinner:
+        name = "toy-livelock"
+
+        def run(self):
+            def spin():
+                while True:
+                    san.sched_point("spin")
+
+            t = san.thread(target=spin, name="spinner")
+            t.start()
+            t.join()
+
+        def check(self, state):
+            pass
+
+    res = explore.run_schedule(Spinner, max_steps=80)
+    finding = res["finding"]
+    assert finding is not None
+    assert finding["type"] == "livelock"
+    assert "80" in finding["message"]
+    names = {s["name"] for s in finding["stacks"]}
+    assert "spinner" in names
+    spin_stack = "\n".join(
+        s["stack"][-1] for s in finding["stacks"]
+        if s["name"] == "spinner")
+    assert "spin" in spin_stack
+
+
+def test_thread_exception_becomes_finding(sched_on):
+    class Boom:
+        name = "toy-boom"
+
+        def run(self):
+            def die():
+                raise ValueError("seeded boom")
+
+            t = san.thread(target=die, name="dier")
+            t.start()
+            t.join()
+
+        def check(self, state):
+            pass
+
+    res = explore.run_schedule(Boom)
+    finding = res["finding"]
+    assert finding is not None
+    assert finding["type"] == "exception"
+    assert "ValueError" in finding["message"]
+    assert "seeded boom" in finding["message"]
+
+
+def test_queue_and_event_primitives_schedule_cleanly(sched_on):
+    class PingPong:
+        name = "toy-queue"
+        budget = 32
+
+        def run(self):
+            q = san.queue(maxsize=1)
+            done = san.event()
+            out = []
+
+            def producer():
+                for i in range(3):
+                    q.put(i)
+                done.set()
+
+            def consumer():
+                for _ in range(3):
+                    out.append(q.get())
+                done.wait()
+
+            t1 = san.thread(target=producer, name="producer")
+            t2 = san.thread(target=consumer, name="consumer")
+            t1.start()
+            t2.start()
+            t1.join()
+            t2.join()
+            return out
+
+        def check(self, out):
+            assert out == [0, 1, 2], out
+
+    res = explore.explore(PingPong)
+    assert res["finding"] is None
+    assert res["schedules"] > 1
+
+
+# ---------------------------------------------------------------------------
+# MXNET_SAN unset: plain primitives, no indirection
+# ---------------------------------------------------------------------------
+
+def test_unset_means_plain_primitives_and_noop_sched_point(monkeypatch):
+    monkeypatch.delenv("MXNET_SAN", raising=False)
+    assert type(san.lock()) is type(threading.Lock())
+    assert isinstance(san.condition(), threading.Condition)
+    assert type(san.event()) is threading.Event
+    assert type(san.thread(target=lambda: None)) is threading.Thread
+    san.sched_point("noop")     # must not raise, must not install
+
+    class Obj:
+        x = 0
+    o = Obj()
+    san.track(o, ("x",), "o")
+    assert type(o) is Obj
+
+
+def test_sched_alone_without_scheduler_stays_plain(monkeypatch):
+    # MXNET_SAN=sched but no scheduler installed (normal pytest
+    # thread): the factories must hand back plain primitives, not
+    # reroute to a scheduler that is not there
+    monkeypatch.setenv("MXNET_SAN", "sched")
+    assert core.current_controlled() is None
+    assert type(san.lock()) is type(threading.Lock())
+    assert type(san.event()) is threading.Event
+    san.sched_point("noop")
+
+
+# ---------------------------------------------------------------------------
+# pinned regressions: real bugs graftsched surfaced
+# ---------------------------------------------------------------------------
+
+def test_pinned_checkpoint_unlocked_pending_bookkeeping(
+        sched_on, tmp_path):
+    """The pre-fix CheckpointManager registered background writers
+    with an UNLOCKED filter-then-reassign of ``_pending``: two
+    concurrent saves could interleave so one writer thread vanished
+    from the list, and ``wait()`` returned without joining it — the
+    manifest then lacked that epoch.  Re-introduce the buggy shape in
+    a subclass: graftsched must find it and the trace must replay."""
+    import numpy as np
+    from mxnet_tpu import nd
+    from mxnet_tpu.resilience.checkpoint import CheckpointManager
+
+    class Buggy(CheckpointManager):
+        def save_background_buggy(self, epoch, arg_params):
+            t = san.thread(
+                target=CheckpointManager.save_checkpoint,
+                args=(self, epoch),
+                kwargs={"arg_params": arg_params,
+                        "background": False})
+            # pre-fix shape: no _plock around the read-filter-write
+            self._pending = [p for p in self._pending
+                             if p.is_alive()]
+            self._pending.append(t)
+            t.start()
+
+    params = {"w": nd.array(np.arange(2, dtype=np.float32))}
+    base = str(tmp_path)
+    counter = [0]
+
+    class Scenario:
+        name = "pinned-checkpoint"
+        budget = 64
+
+        def run(self):
+            counter[0] += 1
+            prefix = os.path.join(base, "run%d" % counter[0], "model")
+            os.makedirs(os.path.dirname(prefix))
+            mgr = Buggy(prefix, keep_last=0, background=True)
+
+            def save(epoch):
+                mgr.save_background_buggy(epoch, params)
+
+            t1 = san.thread(target=save, args=(1,), name="save-1")
+            t2 = san.thread(target=save, args=(2,), name="save-2")
+            t1.start()
+            t2.start()
+            t1.join()
+            t2.join()
+            mgr.wait()
+            return sorted(mgr.epochs())
+
+        def check(self, epochs):
+            assert epochs == [1, 2], epochs
+
+    res = explore.explore(Scenario, trace_dir=str(tmp_path))
+    finding = res["finding"]
+    assert finding is not None, \
+        "the unlocked pending bookkeeping was not found"
+    assert finding["type"] == "invariant"
+
+    rep = explore.replay(Scenario, res["trace_path"])
+    assert rep["finding"] is not None
+    assert rep["finding"]["type"] == "invariant"
+
+    # and the SHIPPED manager (locked bookkeeping) explores clean
+    from tools.graftsched.scenarios.checkpoint import CheckpointScenario
+    clean = explore.explore(CheckpointScenario, budget=24)
+    assert clean["finding"] is None, clean["finding"]
+
+
+def test_pinned_kvstore_applies_counts_only_real_mutations():
+    """Found by the kvserver scenario: a dist_async push arriving
+    before SET_OPT raises typed — but the pre-fix ``_apply`` had
+    already bumped ``applies``, inflating the exactly-once proof
+    counter (and snapshot accounting) with a mutation that never
+    happened."""
+    import numpy as np
+    from mxnet_tpu._kvstore_impl import KVStoreServer, _MSG_PUSH
+    from mxnet_tpu.base import MXNetError
+
+    srv = KVStoreServer(sync_mode=False, num_workers=1)
+    try:
+        srv.store["w"] = __import__("mxnet_tpu").nd.ones((2,))
+        with pytest.raises(MXNetError, match="before an optimizer"):
+            srv._dispatch(_MSG_PUSH, {"req": (0, 1, 0), "key": "w"},
+                          [np.ones((2,), np.float32)])
+        assert srv.applies == 0, srv.applies      # nothing mutated
+        assert srv.pushes_received == 1
+    finally:
+        srv.sock.close()

@@ -1142,6 +1142,76 @@ def check_jg014(project):
 
 
 # ---------------------------------------------------------------------------
+# JG015 — condition wait() guarded by `if` instead of `while`
+# ---------------------------------------------------------------------------
+
+
+def check_jg015(project):
+    """``with cond: if not pred: cond.wait()`` loses wakeups: a
+    spurious wakeup, a stolen wakeup (another waiter consumed the
+    state between notify and this thread's re-acquire) or a notify
+    that raced ahead of the wait leaves the thread running with the
+    predicate still false.  The condition-variable contract is a
+    re-checked loop — ``while not pred: cond.wait()`` — or
+    ``cond.wait_for(pred)``, which loops internally.  Flagged: a
+    ``.wait(...)`` on the object named in the enclosing ``with``
+    whose nearest guard is an ``if`` with no loop between them
+    (a wait inside any while/for re-check loop is fine)."""
+    out = []
+
+    def scan(m, body, conds, in_if, in_loop):
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, (ast.While, ast.For)):
+                scan(m, stmt.body, conds, False, True)
+                scan(m, stmt.orelse, conds, in_if, in_loop)
+                continue
+            if isinstance(stmt, ast.If):
+                scan(m, stmt.body, conds, True, in_loop)
+                scan(m, stmt.orelse, conds, True, in_loop)
+                continue
+            if isinstance(stmt, ast.With):
+                inner = conds | {dotted_name(i.context_expr)
+                                 for i in stmt.items} - {None}
+                scan(m, stmt.body, inner, in_if, in_loop)
+                continue
+            if isinstance(stmt, ast.Try):
+                scan(m, stmt.body, conds, in_if, in_loop)
+                scan(m, stmt.orelse, conds, in_if, in_loop)
+                scan(m, stmt.finalbody, conds, in_if, in_loop)
+                for h in stmt.handlers:
+                    scan(m, h.body, conds, in_if, in_loop)
+                continue
+            if not (in_if and not in_loop):
+                continue
+            for n in ast.walk(stmt):
+                if isinstance(n, ast.Call) and \
+                        isinstance(n.func, ast.Attribute) and \
+                        n.func.attr == "wait" and \
+                        dotted_name(n.func.value) in conds:
+                    out.append(_f(
+                        "JG015", m, n,
+                        "condition wait() guarded by 'if' instead of "
+                        "'while': a spurious or stolen wakeup resumes "
+                        "with the predicate still false (lost "
+                        "wakeup) — re-check in a loop ('while not "
+                        "pred: cond.wait()') or use "
+                        "cond.wait_for(pred)"))
+
+    for m in project.modules:
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.With):
+                continue
+            conds = {dotted_name(i.context_expr)
+                     for i in node.items} - {None}
+            if conds:
+                scan(m, node.body, conds, False, False)
+    return out
+
+
+# ---------------------------------------------------------------------------
 
 ALL_RULES = {
     "JG001": check_jg001,
@@ -1158,6 +1228,7 @@ ALL_RULES = {
     "JG012": check_jg012,
     "JG013": check_jg013,
     "JG014": check_jg014,
+    "JG015": check_jg015,
 }
 
 RULE_DOCS = {
@@ -1201,4 +1272,9 @@ RULE_DOCS = {
              "outside the audited producers bypasses the graftir "
              "manifest/audit (tools/graftir; route through "
              "CompiledPredictor/DecodeEngine or hook iraudit.audit)",
+    "JG015": "condition wait() guarded by 'if' instead of 'while' — "
+             "a spurious or stolen wakeup resumes with the predicate "
+             "still false (lost wakeup); re-check in a loop or use "
+             "wait_for (static companion of the graftsched "
+             "schedule explorer)",
 }

@@ -1,0 +1,28 @@
+"""graftsched — deterministic schedule-exploration checker.
+
+A CHESS-style cooperative scheduler (iterative preemption bounding,
+DPOR-lite pruning) that commandeers the ``mxnet_tpu.sanitizer``
+primitive factories under ``MXNET_SAN=sched`` and drives the threaded
+serving/kvstore subsystems through bounded interleavings, replaying
+any failing schedule bit-deterministically from a JSON trace.
+
+Entry points: ``python -m tools.graftsched`` (CLI), ``ci/sched_drill.py``
+(CI stage), ``tools.graftsched.explore`` (library).
+"""
+
+from __future__ import annotations
+
+try:
+    from mxnet_tpu.observability import metrics as _metrics
+    SCHEDULES_TOTAL = _metrics.counter(
+        "graftsched_schedules_total",
+        help="schedules executed by the graftsched explorer")
+    FINDINGS_TOTAL = _metrics.counter(
+        "graftsched_findings_total",
+        help="failing interleavings found (deadlock/livelock/exception/"
+             "invariant/divergence)")
+except Exception:  # pragma: no cover - standalone checkout use
+    SCHEDULES_TOTAL = None
+    FINDINGS_TOTAL = None
+
+from . import core  # noqa: E402,F401

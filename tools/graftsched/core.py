@@ -1,0 +1,922 @@
+"""graftsched core: a cooperative serializing scheduler.
+
+One controlled thread runs at a time; every synchronization operation
+(lock acquire/release, condition wait/notify, event set/wait, queue
+put/get, thread start/join, tracked-attribute read/write, explicit
+``san.sched_point()``) is a *yield point* where the scheduler decides
+which thread proceeds.  The decision sequence is recorded so the
+explorer (``tools.graftsched.explore``) can branch on it (iterative
+preemption bounding + DPOR-lite pruning) and a failing run can be
+replayed bit-deterministically from its serialized trace.
+
+Design notes
+------------
+* Token passing: each thread has a control block (``_TCB``) with a real
+  ``threading.Event`` gate.  A thread announces its pending op at a
+  yield point, the scheduler picks a grantee (under one real mutex),
+  and either the caller continues or it parks on its gate while the
+  grantee's gate is set.
+* Blocking ops carry a *pred* callable (e.g. "lock is free"); a thread
+  is *enabled* when its pred is true.  Preds are re-evaluated at every
+  pick, which is safe because no other controlled thread is running.
+* Logical time: a timed waiter (``wait(timeout=...)``) is granted with
+  reason ``"timeout"`` only when **no** untimed-enabled thread exists.
+  Real clocks never gate progress, so schedules are deterministic.
+* Deadlock: nothing enabled and no timed waiters => finding with every
+  live thread's stack.  Livelock: more than ``max_steps`` decisions.
+* Abort: ``_SchedAbort`` derives from ``BaseException`` so scenario
+  code's ``except Exception`` blocks cannot swallow the teardown.
+
+The scheduler is installed process-globally (``install``/``uninstall``)
+but only threads it spawned are *controlled*; everything else —
+including the explorer driving it — sees plain primitives via the
+``mxnet_tpu.sanitizer`` gating.
+"""
+
+from __future__ import annotations
+
+import os
+import queue as _queue
+import sys
+import threading as _threading
+import traceback as _traceback
+
+__all__ = [
+    "Scheduler", "SchedulerError", "install", "uninstall", "current",
+    "current_controlled", "DEFAULT_MAX_STEPS",
+]
+
+DEFAULT_MAX_STEPS = int(os.environ.get("MXNET_SCHED_MAX_STEPS", "4000"))
+
+# ops where two accesses to the same object are independent
+_READ_KINDS = frozenset(["rd"])
+
+
+class SchedulerError(RuntimeError):
+    """Misuse of the scheduler or its primitives."""
+
+
+class _SchedAbort(BaseException):
+    """Raised inside controlled threads to unwind them at teardown.
+
+    BaseException on purpose: scenario code's ``except Exception``
+    recovery paths must not capture the scheduler's own abort.
+    """
+
+
+class _TCB(object):
+    __slots__ = ("tid", "name", "thread", "gate", "op_kind", "op_key",
+                 "pred", "timed", "wake_reason", "finished")
+
+    def __init__(self, tid, name):
+        self.tid = tid
+        self.name = name
+        self.thread = None          # real threading.Thread
+        self.gate = _threading.Event()
+        self.op_kind = None         # pending op, None while running
+        self.op_key = None
+        self.pred = None            # None => unconditionally enabled
+        self.timed = False          # pending op carries a timeout
+        self.wake_reason = None     # "run" | "timeout", set at grant
+        self.finished = False
+
+
+# -- module-level installation ------------------------------------------------
+
+_INSTALLED = None
+
+
+def install(sch):
+    global _INSTALLED
+    _INSTALLED = sch
+
+
+def uninstall():
+    global _INSTALLED
+    _INSTALLED = None
+
+
+def current():
+    return _INSTALLED
+
+
+def current_controlled():
+    """The installed scheduler iff the *calling thread* is one of its
+    controlled threads; None otherwise (the sanitizer bridge's gate)."""
+    s = _INSTALLED
+    if s is not None and s.controls_current():
+        return s
+    return None
+
+
+class Scheduler(object):
+    """One exploration/replay run: spawn a root thread, serialize every
+    controlled thread through yield points, record the decisions."""
+
+    def __init__(self, overrides=None, replay=None, max_steps=None,
+                 wedge_timeout=30.0):
+        self._mu = _threading.Lock()          # real: guards all state below
+        self._tcbs = {}                       # tid -> _TCB
+        self._idents = {}                     # real thread ident -> _TCB
+        self._next_tid = 0
+        self._obj_seq = 0
+        self._decisions = []                  # [(tid, kind, key, reason)]
+        self._enabled_others = []             # per step: [tid] untimed-enabled
+        self._ops_by_tid = {}                 # tid -> [(step, kind, key)]
+        self._overrides = dict(overrides or {})   # step -> forced tid
+        self._replay = list(replay) if replay is not None else None
+        self._max_steps = max_steps if max_steps else DEFAULT_MAX_STEPS
+        self._wedge = wedge_timeout
+        self._finding = None
+        self._aborting = False
+        self._done = _threading.Event()
+
+    # -- identity ------------------------------------------------------------
+
+    def controls_current(self):
+        return _threading.get_ident() in self._idents
+
+    def current_tid(self):
+        return self._idents[_threading.get_ident()].tid
+
+    def _self_tcb(self):
+        return self._idents.get(_threading.get_ident())
+
+    def _next_key(self, prefix):
+        with self._mu:
+            self._obj_seq += 1
+            return "%s%d" % (prefix, self._obj_seq)
+
+    # -- factories (called via mxnet_tpu.sanitizer) --------------------------
+
+    def make_lock(self, label=None):
+        return SchedLock(self, label)
+
+    def make_rlock(self, label=None):
+        return SchedRLock(self, label)
+
+    def make_condition(self, lock=None, label=None):
+        return SchedCondition(self, lock, label)
+
+    def make_event(self):
+        return SchedEvent(self)
+
+    def make_queue(self, maxsize=0):
+        return SchedQueue(self, maxsize)
+
+    def make_thread(self, target=None, name=None, args=(), kwargs=None,
+                    daemon=None):
+        return SchedThread(self, target=target, name=name, args=args,
+                           kwargs=kwargs or {}, daemon=daemon)
+
+    def track_object(self, obj, attrs, label=None):
+        return track_object(self, obj, attrs, label)
+
+    def explicit_point(self, label=None):
+        self._yield("point", "P.%s" % (label or "?"))
+
+    # -- run lifecycle -------------------------------------------------------
+
+    def run(self, fn, args=(), kwargs=None, name="root"):
+        """Execute *fn* as controlled thread 0, schedule every spawned
+        thread until all finish (or a finding aborts the run).  Returns
+        the finding dict, or None on a clean run."""
+        if self._tcbs:
+            raise SchedulerError("Scheduler.run() is single-shot")
+        tcb = self._new_tcb(name)
+        tcb.op_kind, tcb.op_key = "th_entry", None
+        real = _threading.Thread(
+            target=self._bootstrap, args=(tcb, fn, args, kwargs or {}),
+            name="graftsched-%s" % name, daemon=True)
+        tcb.thread = real
+        real.start()
+        with self._mu:
+            ok = self._grant_locked(tcb, "run")
+            if ok:
+                tcb.gate.set()
+        if not self._done.wait(self._wedge * 4):
+            with self._mu:
+                if self._finding is None:
+                    self._finding = self._mk_finding_locked(
+                        "wedged", "run did not complete within %.0fs — a "
+                        "controlled thread is blocked outside the "
+                        "scheduler (real I/O?)" % (self._wedge * 4))
+                self._abort_locked()
+            self._done.wait(5.0)
+        for t in list(self._tcbs.values()):
+            if t.thread is not None:
+                t.thread.join(2.0)
+        return self._finding
+
+    def result(self):
+        return {
+            "decisions": list(self._decisions),
+            "enabled_others": [list(e) for e in self._enabled_others],
+            "ops_by_tid": {t: list(o) for t, o in self._ops_by_tid.items()},
+            "finding": self._finding,
+        }
+
+    def _new_tcb(self, name):
+        with self._mu:
+            tid = self._next_tid
+            self._next_tid += 1
+            tcb = _TCB(tid, name or ("thread-%d" % tid))
+            self._tcbs[tid] = tcb
+            self._ops_by_tid[tid] = []
+            return tcb
+
+    def _bootstrap(self, tcb, fn, args, kwargs):
+        self._idents[_threading.get_ident()] = tcb
+        tcb.gate.wait(self._wedge * 4)
+        exc = None
+        try:
+            if not self._aborting:
+                fn(*args, **kwargs)
+        except _SchedAbort:
+            pass
+        except BaseException as e:          # noqa: BLE001 — becomes a finding
+            exc = e
+        self._finish(tcb, exc)
+
+    def _finish(self, tcb, exc):
+        with self._mu:
+            tcb.finished = True
+            tcb.op_kind = tcb.op_key = None
+            tcb.pred = None
+            if exc is not None and self._finding is None:
+                tb = "".join(_traceback.format_exception(
+                    type(exc), exc, exc.__traceback__))
+                self._finding = {
+                    "type": "exception",
+                    "message": "thread %d (%s) raised %s: %s" % (
+                        tcb.tid, tcb.name, type(exc).__name__, exc),
+                    "step": len(self._decisions),
+                    "stacks": [{"tid": tcb.tid, "name": tcb.name,
+                                "stack": self._clean(tb.splitlines())}],
+                }
+                self._abort_locked()
+            if all(t.finished for t in self._tcbs.values()):
+                self._done.set()
+                return
+            if self._aborting:
+                return
+            pick = self._pick_locked(prefer=None)
+            if pick is not None:
+                nxt, reason = pick
+                if self._grant_locked(nxt, reason):
+                    nxt.gate.set()
+
+    # -- the yield point -----------------------------------------------------
+
+    def _yield(self, kind, key, pred=None, timeout=None):
+        """Announce a pending op and block until granted.  Returns the
+        grant reason ("run" or "timeout")."""
+        tcb = self._self_tcb()
+        if tcb is None:
+            return "run"                    # uncontrolled: degrade
+        if self._aborting:
+            raise _SchedAbort()
+        park = False
+        with self._mu:
+            if self._aborting:
+                raise _SchedAbort()
+            tcb.gate.clear()
+            tcb.op_kind, tcb.op_key = kind, key
+            tcb.pred = pred
+            tcb.timed = timeout is not None
+            tcb.wake_reason = None
+            pick = self._pick_locked(prefer=tcb)
+            if pick is None:                # deadlock/livelock: aborted
+                raise _SchedAbort()
+            nxt, reason = pick
+            if not self._grant_locked(nxt, reason):
+                raise _SchedAbort()
+            if nxt is tcb:
+                return tcb.wake_reason
+            nxt.gate.set()
+            park = True
+        if park:
+            self._park(tcb)
+        if self._aborting:
+            raise _SchedAbort()
+        return tcb.wake_reason
+
+    def _park(self, tcb):
+        while not tcb.gate.wait(self._wedge):
+            if self._aborting or tcb.gate.is_set():
+                return
+            with self._mu:
+                if self._finding is None:
+                    self._finding = self._mk_finding_locked(
+                        "wedged", "thread %d (%s) parked past the wedge "
+                        "timeout" % (tcb.tid, tcb.name))
+                self._abort_locked()
+            return
+
+    def _enabled_locked(self, tcb):
+        if tcb.finished or tcb.op_kind is None:
+            return False
+        if tcb.pred is None:
+            return True
+        try:
+            return bool(tcb.pred())
+        except Exception:
+            return False
+
+    def _pick_locked(self, prefer):
+        """Choose the next grantee.  Returns (tcb, reason) or None after
+        recording a deadlock finding and aborting."""
+        step = len(self._decisions)
+        enabled = [t for t in self._tcbs.values()
+                   if self._enabled_locked(t)]
+        enabled.sort(key=lambda t: t.tid)
+        # replay: force the recorded tid at each step
+        if self._replay is not None and step < len(self._replay):
+            want_tid = self._replay[step][0]
+            want = self._tcbs.get(want_tid)
+            if want is not None and want in enabled:
+                return want, "run"
+            if want is not None and not want.finished and \
+                    want.op_kind is not None and want.timed:
+                return want, "timeout"
+            if self._finding is None:
+                self._finding = self._mk_finding_locked(
+                    "divergence", "replay step %d wants thread %d but it "
+                    "is not schedulable" % (step, want_tid))
+            self._abort_locked()
+            return None
+        # exploration: a branch override forces a specific enabled thread
+        forced = self._overrides.get(step)
+        if forced is not None:
+            for t in enabled:
+                if t.tid == forced:
+                    return t, "run"
+            # state diverged from the parent run: fall through to default
+        if prefer is not None and prefer in enabled:
+            return prefer, "run"
+        if enabled:
+            return enabled[0], "run"
+        timed = sorted((t for t in self._tcbs.values()
+                        if not t.finished and t.op_kind is not None
+                        and t.timed), key=lambda t: t.tid)
+        if timed:
+            return timed[0], "timeout"
+        live = [t for t in self._tcbs.values() if not t.finished]
+        if live and self._finding is None:
+            self._finding = self._mk_finding_locked(
+                "deadlock", "all %d live threads blocked: %s" % (
+                    len(live), ", ".join(
+                        "%d(%s) on %s %s" % (t.tid, t.name, t.op_kind,
+                                             t.op_key)
+                        for t in sorted(live, key=lambda t: t.tid))))
+        self._abort_locked()
+        return None
+
+    def _grant_locked(self, tcb, reason):
+        """Record the decision and hand the token to *tcb*.  Returns
+        False when the step budget trips (livelock guard)."""
+        step = len(self._decisions)
+        if step >= self._max_steps:
+            if self._finding is None:
+                self._finding = self._mk_finding_locked(
+                    "livelock", "schedule exceeded %d steps without "
+                    "terminating (livelock bound)" % self._max_steps)
+            self._abort_locked()
+            return False
+        decision = (tcb.tid, tcb.op_kind, tcb.op_key, reason)
+        if self._replay is not None and step < len(self._replay):
+            exp = tuple(self._replay[step])
+            if tuple(decision) != exp:
+                self._finding = self._mk_finding_locked(
+                    "divergence", "replay step %d recorded %r but run "
+                    "produced %r" % (step, exp, decision))
+                self._abort_locked()
+                return False
+        self._decisions.append(decision)
+        self._enabled_others.append(
+            [t.tid for t in self._tcbs.values()
+             if t is not tcb and self._enabled_locked(t)])
+        self._ops_by_tid[tcb.tid].append((step, tcb.op_kind, tcb.op_key))
+        tcb.wake_reason = reason
+        tcb.op_kind = tcb.op_key = None
+        tcb.pred = None
+        tcb.timed = False
+        return True
+
+    # -- findings ------------------------------------------------------------
+
+    def _abort_locked(self):
+        self._aborting = True
+        for t in self._tcbs.values():
+            if not t.finished:
+                t.gate.set()
+        # if every thread already finished the run is over
+        if all(t.finished for t in self._tcbs.values()):
+            self._done.set()
+
+    @staticmethod
+    def _clean(lines):
+        """Drop scheduler-internal frames (a File line plus its source
+        echo) so reports show scenario code, not graftsched plumbing."""
+        drop = (os.sep + "graftsched" + os.sep, "sanitizer.py",
+                os.sep + "threading.py")
+        kept = []
+        skip = False
+        for ln in lines:
+            if ln.lstrip().startswith('File "'):
+                skip = any(d in ln for d in drop)
+            if not skip:
+                kept.append(ln)
+        return kept or lines
+
+    def _mk_finding_locked(self, kind, message):
+        frames = sys._current_frames()
+        me = _threading.get_ident()
+        stacks = []
+        for t in sorted(self._tcbs.values(), key=lambda t: t.tid):
+            if t.finished or t.thread is None:
+                continue
+            if t.thread.ident == me:
+                stack = _traceback.format_stack()
+            else:
+                fr = frames.get(t.thread.ident)
+                stack = _traceback.format_stack(fr) if fr is not None \
+                    else ["<thread not started>"]
+            flat = []
+            for s in stack:
+                flat.extend(s.rstrip("\n").splitlines())
+            stacks.append({"tid": t.tid, "name": t.name,
+                           "stack": self._clean(flat)})
+        return {"type": kind, "message": message,
+                "step": len(self._decisions), "stacks": stacks}
+
+
+# -- controlled primitives ----------------------------------------------------
+
+class _SchedBase(object):
+    """Shared inactive-degradation: when the owning scheduler is no
+    longer installed or the calling thread is not controlled (e.g. the
+    scenario ``check()`` phase), ops run against the logical state with
+    no yields and no blocking."""
+
+    def _active(self):
+        return _INSTALLED is self._sch and self._sch.controls_current()
+
+
+class SchedLock(_SchedBase):
+    def __init__(self, sch, label=None):
+        self._sch = sch
+        self.key = sch._next_key("L")
+        self.label = label
+        self._owner = None                  # tid, or -1 when inactive-held
+
+    def acquire(self, blocking=True, timeout=-1):
+        if not self._active():
+            self._owner = -1
+            return True
+        sch = self._sch
+        if not blocking:
+            sch._yield("lk_try", self.key)
+            if self._owner is None:
+                self._owner = sch.current_tid()
+                return True
+            return False
+        tmo = None if timeout is None or timeout < 0 else timeout
+        reason = sch._yield("lk_acq", self.key,
+                            pred=lambda: self._owner is None, timeout=tmo)
+        if reason == "timeout":
+            return False
+        self._owner = sch.current_tid()
+        return True
+
+    def release(self):
+        if not self._active():
+            self._owner = None
+            return
+        sch = self._sch
+        if self._owner != sch.current_tid():
+            raise SchedulerError("release of %s not held by tid %d"
+                                 % (self.key, sch.current_tid()))
+        sch._yield("lk_rel", self.key)
+        self._owner = None
+
+    def locked(self):
+        return self._owner is not None
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    # condition support
+    def _free(self):
+        return self._owner is None
+
+    def _held_by(self, tid):
+        return self._owner == tid
+
+    def _cond_release_save(self):
+        self._owner = None
+        return 1
+
+    def _cond_restore(self, saved, tid):
+        self._owner = tid
+
+
+class SchedRLock(_SchedBase):
+    def __init__(self, sch, label=None):
+        self._sch = sch
+        self.key = sch._next_key("R")
+        self.label = label
+        self._owner = None
+        self._count = 0
+
+    def acquire(self, blocking=True, timeout=-1):
+        if not self._active():
+            self._owner = -1
+            self._count += 1
+            return True
+        sch = self._sch
+        me = sch.current_tid()
+        if self._owner == me:
+            sch._yield("lk_acq", self.key)
+            self._count += 1
+            return True
+        if not blocking:
+            sch._yield("lk_try", self.key)
+            if self._owner is None:
+                self._owner, self._count = me, 1
+                return True
+            return False
+        tmo = None if timeout is None or timeout < 0 else timeout
+        reason = sch._yield(
+            "lk_acq", self.key,
+            pred=lambda: self._owner is None or self._owner == me,
+            timeout=tmo)
+        if reason == "timeout":
+            return False
+        self._owner, self._count = me, self._count + 1
+        return True
+
+    def release(self):
+        if not self._active():
+            self._count = max(0, self._count - 1)
+            if self._count == 0:
+                self._owner = None
+            return
+        sch = self._sch
+        if self._owner != sch.current_tid():
+            raise SchedulerError("release of %s not held by tid %d"
+                                 % (self.key, sch.current_tid()))
+        sch._yield("lk_rel", self.key)
+        self._count -= 1
+        if self._count == 0:
+            self._owner = None
+
+    def locked(self):
+        return self._owner is not None
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def _free(self):
+        return self._owner is None
+
+    def _held_by(self, tid):
+        return self._owner == tid
+
+    def _cond_release_save(self):
+        saved = self._count
+        self._owner, self._count = None, 0
+        return saved
+
+    def _cond_restore(self, saved, tid):
+        self._owner, self._count = tid, saved
+
+
+class SchedCondition(_SchedBase):
+    def __init__(self, sch, lock=None, label=None):
+        self._sch = sch
+        self.key = sch._next_key("C")
+        self.label = label
+        if lock is None:
+            lock = SchedRLock(sch, label)
+        elif not isinstance(lock, (SchedLock, SchedRLock)):
+            raise SchedulerError(
+                "SchedCondition needs a scheduler-controlled lock; got %r"
+                % (lock,))
+        self._lock = lock
+        self._waiting = []                  # FIFO of waiting tids
+        self._notified = set()
+
+    # delegate the lock protocol
+    def acquire(self, *a, **kw):
+        return self._lock.acquire(*a, **kw)
+
+    def release(self):
+        return self._lock.release()
+
+    def __enter__(self):
+        self._lock.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self._lock.release()
+        return False
+
+    def wait(self, timeout=None):
+        if not self._active():
+            return True                     # single-threaded check phase
+        sch = self._sch
+        me = sch.current_tid()
+        if not self._lock._held_by(me):
+            raise SchedulerError("cond %s wait() without the lock"
+                                 % self.key)
+        saved = self._lock._cond_release_save()
+        self._waiting.append(me)
+        reason = sch._yield(
+            "cond_wait", self.key,
+            pred=lambda: me in self._notified and self._lock._free(),
+            timeout=timeout)
+        if reason == "timeout":
+            try:
+                self._waiting.remove(me)
+            except ValueError:
+                pass
+            if me in self._notified:
+                # the wakeup arrived while the lock was still held:
+                # hand it to the next waiter instead of losing it
+                self._notified.discard(me)
+                if self._waiting:
+                    self._notified.add(self._waiting[0])
+            sch._yield("cond_reacq", self.key,
+                       pred=self._lock._free)
+            self._lock._cond_restore(saved, me)
+            return False
+        self._notified.discard(me)
+        try:
+            self._waiting.remove(me)
+        except ValueError:
+            pass
+        self._lock._cond_restore(saved, me)
+        return True
+
+    def wait_for(self, predicate, timeout=None):
+        result = predicate()
+        while not result:
+            if not self.wait(timeout):
+                return predicate()
+            result = predicate()
+        return result
+
+    def notify(self, n=1):
+        if not self._active():
+            return
+        sch = self._sch
+        if not self._lock._held_by(sch.current_tid()):
+            raise SchedulerError("cond %s notify() without the lock"
+                                 % self.key)
+        sch._yield("cond_notify", self.key)
+        for tid in self._waiting:
+            if n <= 0:
+                break
+            if tid not in self._notified:
+                self._notified.add(tid)
+                n -= 1
+
+    def notify_all(self):
+        if not self._active():
+            return
+        sch = self._sch
+        if not self._lock._held_by(sch.current_tid()):
+            raise SchedulerError("cond %s notify_all() without the lock"
+                                 % self.key)
+        sch._yield("cond_nall", self.key)
+        self._notified.update(self._waiting)
+
+
+class SchedEvent(_SchedBase):
+    def __init__(self, sch):
+        self._sch = sch
+        self.key = sch._next_key("E")
+        self._flag = False
+
+    def set(self):
+        if self._active():
+            self._sch._yield("ev_set", self.key)
+        self._flag = True
+
+    def clear(self):
+        if self._active():
+            self._sch._yield("ev_clear", self.key)
+        self._flag = False
+
+    def is_set(self):
+        return self._flag
+
+    def wait(self, timeout=None):
+        if not self._active():
+            return self._flag
+        reason = self._sch._yield("ev_wait", self.key,
+                                  pred=lambda: self._flag,
+                                  timeout=timeout)
+        if reason == "timeout":
+            return self._flag
+        return True
+
+
+class SchedQueue(_SchedBase):
+    def __init__(self, sch, maxsize=0):
+        self._sch = sch
+        self.key = sch._next_key("Q")
+        self.maxsize = maxsize
+        self._items = []
+
+    def _room(self):
+        return self.maxsize <= 0 or len(self._items) < self.maxsize
+
+    def put(self, item, block=True, timeout=None):
+        if not self._active():
+            self._items.append(item)
+            return
+        if not block:
+            self._sch._yield("q_put", self.key)
+            if not self._room():
+                raise _queue.Full()
+            self._items.append(item)
+            return
+        reason = self._sch._yield("q_put", self.key, pred=self._room,
+                                  timeout=timeout)
+        if reason == "timeout":
+            raise _queue.Full()
+        self._items.append(item)
+
+    def put_nowait(self, item):
+        self.put(item, block=False)
+
+    def get(self, block=True, timeout=None):
+        if not self._active():
+            if not self._items:
+                raise _queue.Empty()
+            return self._items.pop(0)
+        if not block:
+            self._sch._yield("q_get", self.key)
+            if not self._items:
+                raise _queue.Empty()
+            return self._items.pop(0)
+        reason = self._sch._yield("q_get", self.key,
+                                  pred=lambda: len(self._items) > 0,
+                                  timeout=timeout)
+        if reason == "timeout":
+            raise _queue.Empty()
+        return self._items.pop(0)
+
+    def get_nowait(self):
+        return self.get(block=False)
+
+    def qsize(self):
+        return len(self._items)
+
+    def empty(self):
+        return not self._items
+
+    def full(self):
+        return not self._room()
+
+
+class SchedThread(_SchedBase):
+    """Controlled thread handle mirroring threading.Thread's surface."""
+
+    def __init__(self, sch, target=None, name=None, args=(), kwargs=None,
+                 daemon=None):
+        self._sch = sch
+        self.key = sch._next_key("T")
+        self._target = target
+        self._args = args
+        self._kwargs = kwargs or {}
+        self.name = name or "sched-%s" % self.key
+        self.daemon = True if daemon is None else daemon
+        self._tcb = None
+        self._plain = None
+
+    def start(self):
+        if self._tcb is not None or self._plain is not None:
+            raise SchedulerError("thread %s started twice" % self.key)
+        if not self._active():
+            self._plain = _threading.Thread(  # graftlint: disable=JG011
+                target=self._target, name=self.name, args=self._args,
+                kwargs=self._kwargs, daemon=self.daemon)
+            self._plain.start()
+            return
+        sch = self._sch
+        sch._yield("th_start", self.key)
+        tcb = sch._new_tcb(self.name)
+        tcb.op_kind, tcb.op_key = "th_entry", self.key
+        real = _threading.Thread(
+            target=sch._bootstrap,
+            args=(tcb, self._target, self._args, self._kwargs),
+            name="graftsched-%s" % self.name, daemon=True)
+        tcb.thread = real
+        self._tcb = tcb
+        real.start()
+
+    def join(self, timeout=None):
+        if self._plain is not None:
+            self._plain.join(timeout)
+            return
+        if self._tcb is None:
+            raise SchedulerError("join of %s before start" % self.key)
+        if not self._active():
+            if self._tcb.thread is not None:
+                self._tcb.thread.join(timeout if timeout is not None
+                                      else 2.0)
+            return
+        tcb = self._tcb
+        self._sch._yield("th_join", self.key,
+                         pred=lambda: tcb.finished, timeout=timeout)
+
+    def is_alive(self):
+        if self._plain is not None:
+            return self._plain.is_alive()
+        if self._tcb is None:
+            return False
+        return not self._tcb.finished
+
+    @property
+    def ident(self):
+        if self._plain is not None:
+            return self._plain.ident
+        return self._tcb.thread.ident if self._tcb is not None else None
+
+
+# -- tracked shared objects ---------------------------------------------------
+
+_TRACKED_CACHE = {}
+
+
+def _tracked_class(base, sch_ref_unused=None):
+    cached = _TRACKED_CACHE.get(base)
+    if cached is not None:
+        return cached
+
+    class Tracked(base):
+        __doc__ = base.__doc__
+
+        def __getattribute__(self, name):
+            d = object.__getattribute__(self, "__dict__")
+            attrs = d.get("_graftsched_attrs")
+            if attrs is not None and name in attrs:
+                sch = d.get("_graftsched_sch")
+                if sch is not None and _INSTALLED is sch and \
+                        sch.controls_current():
+                    sch._yield("rd", "%s.%s"
+                               % (d.get("_graftsched_key"), name))
+            return object.__getattribute__(self, name)
+
+        def __setattr__(self, name, value):
+            d = object.__getattribute__(self, "__dict__")
+            attrs = d.get("_graftsched_attrs")
+            if attrs is not None and name in attrs:
+                sch = d.get("_graftsched_sch")
+                if sch is not None and _INSTALLED is sch and \
+                        sch.controls_current():
+                    sch._yield("wr", "%s.%s"
+                               % (d.get("_graftsched_key"), name))
+            object.__setattr__(self, name, value)
+
+    Tracked.__name__ = base.__name__
+    Tracked.__qualname__ = base.__qualname__
+    _TRACKED_CACHE[base] = Tracked
+    return Tracked
+
+
+def track_object(sch, obj, attrs, label=None):
+    """Swap *obj*'s class for a subclass whose tracked attribute
+    accesses are yield points (mirrors graftsan's lockset tracker)."""
+    base = type(obj)
+    if getattr(base, "__getattribute__", None) is not \
+            object.__getattribute__ and \
+            object.__getattribute__(obj, "__dict__").get(
+                "_graftsched_attrs") is not None:
+        # already tracked: widen the attr set
+        d = object.__getattribute__(obj, "__dict__")
+        d["_graftsched_attrs"] = frozenset(d["_graftsched_attrs"]) \
+            | frozenset(attrs)
+        return obj
+    cls = _tracked_class(base)
+    key = sch._next_key("O")
+    d = object.__getattribute__(obj, "__dict__")
+    d["_graftsched_attrs"] = frozenset(attrs)
+    d["_graftsched_key"] = key
+    d["_graftsched_sch"] = sch
+    d["_graftsched_label"] = label or base.__name__
+    obj.__class__ = cls
+    return obj

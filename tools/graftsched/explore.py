@@ -1,0 +1,223 @@
+"""graftsched explorer: iterative preemption bounding + DPOR-lite.
+
+Runs a scenario under ``tools.graftsched.core.Scheduler`` repeatedly,
+branching the recorded decision sequence at conflicting yield points:
+
+* Bound 0 is the default (continue-current, lowest-tid) schedule.
+* A *branch* forces a different enabled thread at one decision step
+  (``overrides[step] = tid``); bound k schedules carry k overrides.
+  BFS over the override sets realizes iterative context bounding —
+  every 0-preemption schedule before any 1-preemption one, etc.
+* DPOR-lite pruning: a branch (step i -> thread t') is generated only
+  when the op granted at step i *conflicts* with some op t' performs
+  later in the parent run (same object key, not both reads).
+  Independent ops commute, so forcing the swap would reach an
+  already-seen state.
+
+A finding (deadlock, livelock, exception, invariant violation, replay
+divergence) stops the scenario's exploration and serializes the
+decision trace to JSON; ``replay()`` re-executes it bit-exactly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from . import core
+
+try:
+    from . import SCHEDULES_TOTAL, FINDINGS_TOTAL
+except ImportError:  # pragma: no cover - circular-import guard
+    SCHEDULES_TOTAL = FINDINGS_TOTAL = None
+
+try:
+    from mxnet_tpu.observability import events as _events
+except Exception:  # pragma: no cover - standalone use
+    _events = None
+
+DEFAULT_BUDGET = int(os.environ.get("MXNET_SCHED_BUDGET", "128"))
+DEFAULT_PREEMPTIONS = int(os.environ.get("MXNET_SCHED_PREEMPTIONS", "2"))
+
+TRACE_VERSION = 1
+
+
+def run_schedule(factory, overrides=None, replay=None, max_steps=None):
+    """One schedule: fresh scenario instance, fresh scheduler.  Returns
+    the scheduler's result dict (decisions/enabled_others/ops_by_tid/
+    finding); the scenario's ``check(state)`` runs uncontrolled after a
+    clean run and its failure becomes an ``invariant`` finding."""
+    scn = factory()
+    sch = core.Scheduler(overrides=overrides, replay=replay,
+                         max_steps=max_steps
+                         or getattr(scn, "max_steps", None))
+    core.install(sch)
+    box = {}
+
+    def _root():
+        box["state"] = scn.run()
+
+    try:
+        sch.run(_root)
+    finally:
+        core.uninstall()
+    res = sch.result()
+    if SCHEDULES_TOTAL is not None:
+        SCHEDULES_TOTAL.inc()
+    if res["finding"] is None:
+        try:
+            scn.check(box.get("state"))
+        except BaseException as exc:  # noqa: BLE001 — becomes the finding
+            import traceback
+            res["finding"] = {
+                "type": "invariant",
+                "message": "%s: %s" % (type(exc).__name__, exc),
+                "step": len(res["decisions"]),
+                "stacks": [{"tid": -1, "name": "check",
+                            "stack": traceback.format_exc().splitlines()}],
+            }
+    return res
+
+
+def _conflicts(kind, key, t2_ops, after_step):
+    """Does thread t2 perform an op after *after_step* that conflicts
+    with (kind, key)?  key None (pure scheduling ops) never conflicts;
+    two reads of the same attr are independent."""
+    if key is None:
+        return False
+    for step, k2, key2 in t2_ops:
+        if step <= after_step:
+            continue
+        if key2 == key and not (kind == "rd" and k2 == "rd"):
+            return True
+    return False
+
+
+def explore(factory, name=None, budget=None, max_preemptions=None,
+            max_steps=None, trace_dir=None):
+    """Explore a scenario's bounded schedule space.  Returns a dict:
+    ``{scenario, schedules, finding, trace_path, preemption_bound}``.
+    Stops at the first finding and serializes its trace."""
+    name = name or getattr(factory, "name", factory.__name__)
+    budget = budget or getattr(factory, "budget", DEFAULT_BUDGET)
+    if max_preemptions is None:
+        max_preemptions = getattr(factory, "max_preemptions",
+                                  DEFAULT_PREEMPTIONS)
+    schedules = 0
+    seen_overrides = set()
+    seen_decisions = set()
+    frontier = []                       # BFS: (overrides, result)
+    finding = None
+    finding_overrides = None
+    finding_result = None
+
+    root = run_schedule(factory, overrides={}, max_steps=max_steps)
+    schedules += 1
+    seen_overrides.add(frozenset())
+    seen_decisions.add(tuple(map(tuple, root["decisions"])))
+    if root["finding"] is not None:
+        finding, finding_overrides, finding_result = \
+            root["finding"], {}, root
+    else:
+        frontier.append(({}, root))
+
+    i = 0
+    while i < len(frontier) and finding is None and schedules < budget:
+        overrides, parent = frontier[i]
+        i += 1
+        if len(overrides) >= max_preemptions:
+            continue
+        base = max(overrides) if overrides else -1
+        decisions = parent["decisions"]
+        enabled_others = parent["enabled_others"]
+        ops_by_tid = parent["ops_by_tid"]
+        for step in range(base + 1, len(decisions)):
+            if finding is not None or schedules >= budget:
+                break
+            _tid, kind, key, _reason = decisions[step]
+            for t2 in enabled_others[step]:
+                if finding is not None or schedules >= budget:
+                    break
+                if not _conflicts(kind, key, ops_by_tid.get(t2, ()),
+                                  step):
+                    continue
+                child_over = dict(overrides)
+                child_over[step] = t2
+                fs = frozenset(child_over.items())
+                if fs in seen_overrides:
+                    continue
+                seen_overrides.add(fs)
+                child = run_schedule(factory, overrides=child_over,
+                                     max_steps=max_steps)
+                schedules += 1
+                if child["finding"] is not None:
+                    finding, finding_overrides, finding_result = \
+                        child["finding"], child_over, child
+                    break
+                dh = tuple(map(tuple, child["decisions"]))
+                if dh not in seen_decisions:
+                    seen_decisions.add(dh)
+                    frontier.append((child_over, child))
+
+    trace_path = None
+    if finding is not None:
+        if FINDINGS_TOTAL is not None:
+            FINDINGS_TOTAL.inc()
+        trace_path = write_trace(
+            trace_dir or os.environ.get("MXNET_SCHED_TRACE_DIR", "/tmp"),
+            name, finding_overrides, finding_result)
+    if _events is not None:
+        _events.emit("sched", kind="explore", scenario=name,
+                     schedules=schedules,
+                     findings=0 if finding is None else 1,
+                     finding_type=None if finding is None
+                     else finding["type"],
+                     trace=trace_path)
+    return {
+        "scenario": name,
+        "schedules": schedules,
+        "finding": finding,
+        "trace_path": trace_path,
+        "preemption_bound": max_preemptions,
+        "budget": budget,
+    }
+
+
+def write_trace(trace_dir, name, overrides, result):
+    os.makedirs(trace_dir, exist_ok=True)
+    path = os.path.join(trace_dir, "graftsched-%s.trace.json" % name)
+    payload = {
+        "version": TRACE_VERSION,
+        "scenario": name,
+        "overrides": {str(k): v for k, v in (overrides or {}).items()},
+        "decisions": [list(d) for d in result["decisions"]],
+        "finding": result["finding"],
+    }
+    with open(path, "w") as fh:
+        json.dump(payload, fh, indent=1, sort_keys=True)
+    return path
+
+
+def load_trace(path):
+    with open(path) as fh:
+        payload = json.load(fh)
+    if payload.get("version") != TRACE_VERSION:
+        raise ValueError("unsupported trace version %r in %s"
+                         % (payload.get("version"), path))
+    return payload
+
+
+def replay(factory, trace, max_steps=None):
+    """Re-execute a recorded trace bit-deterministically.  *trace* is a
+    path or a loaded payload.  Returns the new run's result dict; the
+    caller compares its finding/decisions against the recording."""
+    if isinstance(trace, str):
+        trace = load_trace(trace)
+    decisions = [tuple(d) for d in trace["decisions"]]
+    res = run_schedule(factory, replay=decisions, max_steps=max_steps)
+    if _events is not None:
+        _events.emit("sched", kind="replay", scenario=trace["scenario"],
+                     steps=len(decisions),
+                     finding_type=None if res["finding"] is None
+                     else res["finding"]["type"])
+    return res

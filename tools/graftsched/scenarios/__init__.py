@@ -1,0 +1,57 @@
+"""First-class checkable scenario units for the graftsched explorer.
+
+A scenario is a class with:
+
+* ``name``        — registry key / trace file stem
+* ``budget``      — per-scenario schedule budget (optional)
+* ``max_preemptions`` — context bound (optional, default 2)
+* ``run(self)``   — executed inside the controlled root thread; builds
+  the subsystem under test, drives it from a few spawned threads, and
+  returns a *state* object.  Every sync primitive must come from the
+  ``mxnet_tpu.sanitizer`` factories (they do, by construction) and the
+  scenario must fake any real-I/O boundary (sockets, XLA dispatch):
+  a controlled thread blocked in real I/O never reaches a yield point.
+* ``check(self, state)`` — runs *uncontrolled* after each clean
+  schedule; raises (usually AssertionError) to turn an invariant
+  violation into a finding.
+
+Scenarios must be deterministic modulo the schedule: no wall-clock
+branches (pass ``max_wait_ms=0`` and generous timeouts so logical
+timeouts, not real ones, drive control flow) and no unseeded
+randomness on any path that reaches a yield point.
+"""
+
+from __future__ import annotations
+
+from .batcher import BatcherScenario
+from .checkpoint import CheckpointScenario
+from .decode import DecodeScenario
+from .kvserver import KVServerScenario
+from .replica import ReplicaScenario, SeededReplicaTeardown
+from .router import RouterScenario
+
+# shipped drill set: every scenario here must explore its bounded
+# schedule set with zero findings
+SCENARIOS = {
+    cls.name: cls
+    for cls in (BatcherScenario, DecodeScenario, ReplicaScenario,
+                RouterScenario, CheckpointScenario, KVServerScenario)
+}
+
+# the teeth check: a deliberately re-introduced historical bug
+# (PR-19 ReplicaServer stop() double-teardown) that the explorer MUST
+# find within budget — not part of the zero-findings drill set
+SEEDED = {SeededReplicaTeardown.name: SeededReplicaTeardown}
+
+
+def get(name):
+    try:
+        return SCENARIOS.get(name) or SEEDED[name]
+    except KeyError:
+        raise KeyError("unknown graftsched scenario %r (have: %s)"
+                       % (name, ", ".join(sorted(SCENARIOS) +
+                                          sorted(SEEDED))))
+
+
+def names():
+    return sorted(SCENARIOS)

@@ -1,0 +1,69 @@
+"""CheckpointManager scenario: concurrent background saves + rotation.
+
+Two threads issue background saves for different epochs, the root
+waits, then commits a third foreground save that triggers rotation
+(``keep_last=2``).  Every schedule uses a fresh prefix (the
+write+commit lock is cached per manifest path across manager
+instances).  Invariants:
+
+* ``wait()`` returns only after BOTH background commits are on disk
+  (the lost-writer filter-then-reassign bug this scenario found)
+* no background error leaked
+* after the rotating save, exactly ``keep_last`` entries remain and
+  the newest epoch is among them
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+
+import numpy as _np
+
+
+class CheckpointScenario:
+    name = "checkpoint"
+    budget = 48
+
+    def run(self):
+        from mxnet_tpu import ndarray as nd
+        from mxnet_tpu import sanitizer as _san
+        from mxnet_tpu.resilience.checkpoint import CheckpointManager
+
+        tmp = tempfile.mkdtemp(prefix="graftsched-ckpt-")
+        prefix = os.path.join(tmp, "model")
+        mgr = CheckpointManager(prefix, keep_last=2, background=True)
+        params = {"w": nd.array(_np.arange(2, dtype=_np.float32))}
+        state = {"tmp": tmp, "mgr": mgr}
+
+        def save(epoch):
+            mgr.save_checkpoint(epoch, arg_params=params)
+
+        t1 = _san.thread(target=save, args=(1,), name="save-1")
+        t2 = _san.thread(target=save, args=(2,), name="save-2")
+        t1.start()
+        t2.start()
+        t1.join()
+        t2.join()
+        mgr.wait()
+        # wait() is the commit barrier: both epochs must be on disk
+        # NOW, before rotation — record what it guaranteed
+        state["after_wait"] = sorted(mgr.epochs())
+        mgr.save_checkpoint(3, arg_params=params, background=False)
+        state["after_rotate"] = mgr.epochs()
+        return state
+
+    def check(self, state):
+        mgr = state["mgr"]
+        try:
+            assert state["after_wait"] == [1, 2], state["after_wait"]
+            assert mgr._bg_error is None, mgr._bg_error
+            assert mgr._pending == [], mgr._pending
+            rotated = state["after_rotate"]
+            assert len(rotated) == 2, rotated
+            assert 3 in rotated, rotated
+            assert rotated[-1] == 3, rotated
+            assert set(rotated) - {3} <= {1, 2}, rotated
+        finally:
+            shutil.rmtree(state["tmp"], ignore_errors=True)

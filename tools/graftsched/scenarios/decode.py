@@ -1,0 +1,203 @@
+"""DecodeBatcher scenario: join / cancel / crash-rebuild / flush / close.
+
+A fake engine (numpy-free token counter — no XLA dispatch, no pool)
+feeds the real DecodeBatcher tick loop.  The first ``tick`` raises, so
+every explored schedule also drives the quarantine/rebuild path with a
+budget of one; two client threads race joins and a cancel against the
+crash.  Invariants after every schedule:
+
+* the never-cancelled session finishes "complete" with all its tokens
+* the cancelled session resolves typed (or its admission shed typed
+  while the rebuild was in flight)
+* exactly one rebuild happened, and every admitted session was
+  released exactly once (the engine-side release is idempotent by
+  contract; the fake counts effective releases)
+* flush() and close() report clean, the batcher leaves the engine's
+  registry, and no session lingers in joins/sessions/inflight
+"""
+
+from __future__ import annotations
+
+
+class _FakeSession:
+    _next_sid = [0]
+
+    def __init__(self, san, max_new):
+        self.sid = _FakeSession._next_sid[0]
+        _FakeSession._next_sid[0] += 1
+        self.cancelled = False
+        self._deadline = None
+        self._t_enq = 0.0
+        self.max_new = max_new
+        self.tokens = 0
+        self.prefills = 0
+        self._done = False
+        self._released = False
+        self.error = None
+        self.finish_reason = None
+        self.done_ev = san.event()
+        san.track(self, ("cancelled", "tokens", "_done", "_released"),
+                  label="sess%d" % self.sid)
+
+    def done(self):
+        return self._done
+
+
+class _FakeEngine:
+    """The DecodeBatcher-facing slice of DecodeEngine: admit/prefill/
+    tick/readmit/release/rebuild_pool over plain counters.  The first
+    tick crashes (seeded) so the rebuild path runs every schedule."""
+
+    class _Ladder:
+        max_batch = 4
+
+    def __init__(self, san):
+        self._san = san
+        self.label = "sched-decode"
+        self.ladder = self._Ladder()
+        self._lock = san.lock(label="fake-engine")
+        self._batchers = []
+        self.compile_count = 0
+        self.sessions = []
+        self.releases = []
+        self.rebuilds = 0
+        self.crash_armed = True
+        san.track(self, ("sessions", "releases", "rebuilds",
+                         "crash_armed"), label="fake-engine")
+
+    def admit(self, prompt, max_new_tokens=None, stop_fn=None,
+              deadline_ms=None, journal_key=None, incarnation=0,
+              resume_tokens=None):
+        sess = _FakeSession(self._san, max_new_tokens or 1)
+        with self._lock:
+            self.sessions = self.sessions + [sess]
+        return sess
+
+    def prefill(self, sess):
+        with self._lock:
+            sess.prefills += 1
+
+    def tick(self, sessions):
+        with self._lock:
+            if self.crash_armed:
+                self.crash_armed = False
+                raise RuntimeError("seeded tick crash")
+        for s in sessions:
+            if s.done():
+                continue
+            if s.cancelled:
+                from mxnet_tpu.serve.batcher import RequestCancelled
+                self.release(s, "cancelled", RequestCancelled(
+                    "decode session %d cancelled" % s.sid))
+                continue
+            with self._lock:
+                s.tokens += 1
+                finished = s.tokens >= s.max_new
+            if finished:
+                self.release(s, "complete", None)
+
+    def readmit(self, sess):
+        with self._lock:
+            if sess.done():
+                return sess
+            sess._deadline = None
+        return sess
+
+    def rebuild_pool(self):
+        with self._lock:
+            self.rebuilds += 1
+            # a fresh pool: the seeded fault does not recur
+            self.crash_armed = False
+
+    def release(self, sess, reason, error=None):
+        with self._lock:
+            if sess._released:
+                return
+            sess._released = True
+            self.releases = self.releases + [(sess.sid, reason)]
+            sess._done = True
+            sess.error = error
+            sess.finish_reason = reason
+        sess.done_ev.set()
+
+
+class DecodeScenario:
+    name = "decode"
+    budget = 80
+
+    def run(self):
+        from mxnet_tpu import sanitizer as _san
+        from mxnet_tpu.serve.decode import DecodeBatcher
+
+        eng = _FakeEngine(_san)
+        b = DecodeBatcher(eng, max_wait_ms=0, name="sched-decode",
+                          rebuilds=1)
+        state = {"engine": eng, "batcher": b, "outcomes": {}}
+
+        def client_keep():
+            s = b.start("hello", max_new_tokens=2)
+            s.done_ev.wait()
+            state["outcomes"]["keep"] = (s.finish_reason,
+                                         type(s.error).__name__
+                                         if s.error else None,
+                                         s.tokens)
+
+        def client_cancel():
+            try:
+                s = b.start("world", max_new_tokens=4)
+            except Exception as exc:
+                # admission shed typed while rebuilding/draining
+                state["outcomes"]["cancel"] = ("shed",
+                                               type(exc).__name__,
+                                               0)
+                return
+            s.cancelled = True
+            s.done_ev.wait()
+            state["outcomes"]["cancel"] = (s.finish_reason,
+                                           type(s.error).__name__
+                                           if s.error else None,
+                                           s.tokens)
+
+        t1 = _san.thread(target=client_keep, name="keep")
+        t2 = _san.thread(target=client_cancel, name="cancel")
+        t1.start()
+        t2.start()
+        t1.join()
+        t2.join()
+        state["flushed"] = b.flush(timeout=30.0)
+        state["closed"] = b.close(timeout=30.0)
+        state["rebuilds"] = b.rebuild_count
+        return state
+
+    def check(self, state):
+        eng = state["engine"]
+        b = state["batcher"]
+        out = state["outcomes"]
+        assert set(out) == {"keep", "cancel"}, out
+        reason, err, tokens = out["keep"]
+        assert reason == "complete" and err is None and tokens == 2, \
+            out
+        reason, err, tokens = out["cancel"]
+        if reason == "shed":
+            assert err == "ServeError", out
+        else:
+            # the cancel either lost the race (session completed) or
+            # resolved typed
+            assert (reason, err) in (
+                ("cancelled", "RequestCancelled"),
+                ("complete", None)), out
+        assert state["flushed"] is True, state
+        assert state["closed"] is True, state
+        assert state["rebuilds"] == 1, state["rebuilds"]
+        assert eng.rebuilds == 1, eng.rebuilds
+        # exactly-once release per admitted session
+        sids = [sid for sid, _ in eng.releases]
+        assert len(sids) == len(set(sids)), eng.releases
+        assert len(sids) == len(eng.sessions), (eng.releases,
+                                                len(eng.sessions))
+        for s in eng.sessions:
+            assert s._released and s._done, s.sid
+        assert eng._batchers == [], eng._batchers
+        assert not b._joins and not b._sessions, (b._joins,
+                                                  b._sessions)
+        assert b._inflight == (), b._inflight

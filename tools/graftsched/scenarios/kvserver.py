@@ -1,0 +1,95 @@
+"""KVStoreServer scenario: SET_OPT racing an owner push + its retry.
+
+One dist_async server, one key initialised by the root.  Three
+threads race through ``_handle`` (no sockets — the handler IS the
+subject): a SET_OPT installing the SGD updater, an owner PUSH and a
+duplicate PUSH with the same request id.  A push that beats SET_OPT
+fails typed (async pushes require the server-side updater) and leaves
+the dedup window, so its retry re-executes.  Invariants:
+
+* at most one apply ever commits (exactly-once through the window)
+* the stored value proves it: ``1 - lr * applies`` — a double apply
+  would show ``1 - 2*lr``
+* a dup-flagged ok reply implies an owner ok reply, and applies
+  equals the count of non-dup ok replies
+* dispatch accounting: 1 <= pushes_received <= 2, never below applies
+"""
+
+from __future__ import annotations
+
+import pickle
+
+import numpy as _np
+
+_LR = 0.1
+
+
+class KVServerScenario:
+    name = "kvserver"
+    budget = 80
+
+    def run(self):
+        from mxnet_tpu import sanitizer as _san
+        from mxnet_tpu._kvstore_impl import (_MSG_INIT, _MSG_PUSH,
+                                             _MSG_SET_OPT,
+                                             KVStoreServer)
+        from mxnet_tpu.base import MXNetError
+        from mxnet_tpu.optimizer.optimizer import SGD
+
+        server = KVStoreServer(False, 1)
+        server._dispatch(_MSG_INIT, {"key": "w"},
+                         [_np.ones((2,), _np.float32)])
+        blob = _np.frombuffer(pickle.dumps(SGD(learning_rate=_LR)),
+                              _np.uint8)
+        state = {"server": server, "outcomes": {}}
+
+        def set_opt():
+            server._handle(_MSG_SET_OPT, {"req": (0, 10, 0)}, [blob])
+
+        def push(key):
+            try:
+                rmeta, _ = server._handle(
+                    _MSG_PUSH, {"req": (1, 1, 0), "key": "w"},
+                    [_np.ones((2,), _np.float32)])
+                state["outcomes"][key] = ("ok",
+                                          bool(rmeta.get("dup")))
+            except MXNetError:
+                state["outcomes"][key] = ("err", None)
+
+        threads = [_san.thread(target=set_opt, name="set-opt"),
+                   _san.thread(target=push, args=("p1",),
+                               name="push-owner"),
+                   _san.thread(target=push, args=("p2",),
+                               name="push-dup")]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        state["value"] = server.store["w"].asnumpy()
+        return state
+
+    def check(self, state):
+        server = state["server"]
+        out = state["outcomes"]
+        try:
+            assert set(out) == {"p1", "p2"}, out
+            oks = [k for k in out if out[k][0] == "ok"]
+            owner_oks = [k for k in oks if not out[k][1]]
+            dup_oks = [k for k in oks if out[k][1]]
+            assert server.applies in (0, 1), server.applies
+            assert server.applies == len(owner_oks), (server.applies,
+                                                      out)
+            if dup_oks:
+                assert owner_oks, out
+            assert 1 <= server.pushes_received <= 2, \
+                server.pushes_received
+            assert server.pushes_received >= server.applies
+            assert server.updater is not None
+            expected = 1.0 - _LR * server.applies
+            assert _np.allclose(state["value"], expected), \
+                (state["value"], expected, server.applies)
+        finally:
+            try:
+                server.sock.close()
+            except OSError:
+                pass

@@ -1,0 +1,216 @@
+"""ReplicaServer scenarios: the idempotency window and stop().
+
+``ReplicaScenario`` races an owner predict, a duplicate of the same
+request id, a CANCEL for that id and two concurrent ``stop()`` calls
+over a fake registry (no XLA, no accept loop).  Invariants:
+
+* owner and duplicate replies are identical modulo the ``dup`` flag
+  (the ``_publish`` exactly-once contract)
+* at most one dispatch reached the registry
+* the probe http server is shut down exactly once and the listen
+  socket is closed — two racing stop() calls must not double-teardown
+
+``SeededReplicaTeardown`` re-introduces the PR-19 ``stop()``
+double-teardown (check-then-act on ``self.http_server`` instead of
+swap-then-close) in a subclass: the explorer must find the race —
+either the NoneType crash or the double-shutdown invariant — within
+budget, and the trace must replay to the same failure.  It is the
+drill's teeth check and is not part of the shipped zero-findings set.
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+
+class _FakeFuture:
+    """ServeFuture's replica-facing surface: result()/cancel() with
+    the compute faked at result() time so a cancel can win the
+    race before the 'dispatch' lands."""
+
+    def __init__(self, san, registry):
+        self._registry = registry
+        self._lock = san.lock(label="fake-future")
+        self._cancelled = False
+        self._done = False
+        san.track(self, ("_cancelled", "_done"), label="fake-future")
+
+    def result(self, timeout=None):
+        from mxnet_tpu.serve.batcher import RequestCancelled
+        with self._lock:
+            if self._cancelled:
+                raise RequestCancelled("cancelled before dispatch")
+            self._done = True
+        self._registry.computes += 1
+        return [_np.full((1, 2), 3.0, _np.float32)]
+
+    def cancel(self):
+        with self._lock:
+            if self._done:
+                return False
+            self._cancelled = True
+            return True
+
+
+class _FakeRegistry:
+    """ModelRegistry's submit surface over _FakeFuture."""
+
+    def __init__(self, san):
+        self._san = san
+        self.submits = 0
+        self.computes = 0
+        san.track(self, ("submits", "computes"), label="fake-registry")
+
+    def submit(self, model, data, deadline_ms=None):
+        self.submits += 1
+        return _FakeFuture(self._san, self)
+
+    def close(self):
+        pass
+
+
+class _FakeHttp:
+    """The two teardown calls stop() makes, as counters."""
+
+    def __init__(self):
+        self.shutdowns = 0
+        self.closes = 0
+        self.server_address = ("127.0.0.1", 0)
+
+    def shutdown(self):
+        self.shutdowns += 1
+
+    def server_close(self):
+        self.closes += 1
+
+
+def _build_server(cls_name=None):
+    import os
+    from mxnet_tpu import sanitizer as _san
+    from mxnet_tpu.serve import replica as _replica
+
+    os.environ.setdefault("MXNET_SERVE_HTTP_PORT", "0")
+    cls = _replica.ReplicaServer if cls_name is None else cls_name
+    server = cls(registry=_FakeRegistry(_san), port=0,
+                 name="sched-replica")
+    http = _FakeHttp()
+    server.http_server = http
+    # widen the server's tracked set: stop() races on this attribute
+    _san.track(server, ("http_server",), label="sched-replica-http")
+    return server, http
+
+
+class ReplicaScenario:
+    name = "replica"
+    budget = 96
+
+    def run(self):
+        from mxnet_tpu import sanitizer as _san
+
+        server, http = _build_server()
+        state = {"server": server, "http": http, "outcomes": {}}
+        meta = {"req": ("c", 1, 0), "model": "m"}
+        payload = [_np.ones((1, 2), _np.float32)]
+
+        def predict(key):
+            try:
+                rmeta, rts = server._handle_predict(dict(meta),
+                                                    list(payload))
+                state["outcomes"][key] = ("reply", dict(rmeta),
+                                          len(rts))
+            except Exception as exc:
+                state["outcomes"][key] = ("raise",
+                                          type(exc).__name__, 0)
+
+        def cancel():
+            rmeta, _ = server._handle_cancel({"req": ("c", 1, 0)})
+            state["outcomes"]["cancel"] = ("reply", dict(rmeta), 0)
+
+        threads = [
+            _san.thread(target=predict, args=("p1",), name="owner"),
+            _san.thread(target=predict, args=("p2",), name="dup"),
+            _san.thread(target=cancel, name="cancel"),
+            _san.thread(target=server.stop, name="stop1"),
+            _san.thread(target=server.stop, name="stop2"),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return state
+
+    def check(self, state):
+        server = state["server"]
+        http = state["http"]
+        out = state["outcomes"]
+        assert set(out) == {"p1", "p2", "cancel"}, out
+        # both predict replies must tell one story modulo the dup flag
+        replies = []
+        for key in ("p1", "p2"):
+            kind, rmeta, nts = out[key]
+            assert kind == "reply", out
+            rmeta = dict(rmeta)
+            rmeta.pop("dup", None)
+            replies.append((tuple(sorted(rmeta.items())), nts))
+        assert replies[0] == replies[1], out
+        # exactly-once dispatch per id
+        assert server.predicts_dispatched <= 1, \
+            server.predicts_dispatched
+        assert server.registry.computes <= 1, server.registry.computes
+        assert server.requests_received == 2, server.requests_received
+        assert server.cancels_received == 1, server.cancels_received
+        assert server.dup_hits in (1, 2), server.dup_hits
+        # stop() ran twice but tore down once
+        assert http.shutdowns == 1, http.shutdowns
+        assert http.closes == 1, http.closes
+        assert server.http_server is None, server.http_server
+        assert server.sock.fileno() == -1, "listen socket still open"
+        assert server._stop.is_set()
+
+
+def _make_seeded_class():
+    from mxnet_tpu.serve.replica import ReplicaServer
+
+    class Seeded(ReplicaServer):
+        def stop(self):
+            # the PR-19 bug, verbatim shape: check-then-act on
+            # http_server with no swap — two stoppers can both pass
+            # the None check (double shutdown) or one can null the
+            # attribute between the other's check and call
+            # (AttributeError)
+            self._stop.set()
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            if self.http_server is not None:
+                self.http_server.shutdown()
+                self.http_server.server_close()
+                self.http_server = None
+
+    return Seeded
+
+
+class SeededReplicaTeardown:
+    name = "seeded-replica-teardown"
+    budget = 96
+
+    def run(self):
+        from mxnet_tpu import sanitizer as _san
+
+        server, http = _build_server(_make_seeded_class())
+        state = {"server": server, "http": http}
+        t1 = _san.thread(target=server.stop, name="stop1")
+        t2 = _san.thread(target=server.stop, name="stop2")
+        t1.start()
+        t2.start()
+        t1.join()
+        t2.join()
+        return state
+
+    def check(self, state):
+        http = state["http"]
+        assert http.shutdowns == 1, \
+            "http shutdown called %d times" % http.shutdowns
+        assert http.closes == 1, \
+            "http server_close called %d times" % http.closes
